@@ -1,0 +1,21 @@
+"""easydl_tpu — a TPU-native elastic distributed training framework.
+
+Re-implements the capability set of the EasyDL design (reference:
+``/root/reference`` README.md:9-13 — ElasticTrainer + ElasticOperator + Brain)
+as an idiomatic JAX/XLA/Pallas stack:
+
+- ``easydl_tpu.api``      — job/resource contracts (≙ ElasticJob / JobResource CRDs)
+- ``easydl_tpu.core``     — mesh, sharding, train loop, checkpointing, data
+- ``easydl_tpu.elastic``  — master, agents, rendezvous, fault handling
+- ``easydl_tpu.brain``    — autoscaling plan service (step-metric driven)
+- ``easydl_tpu.operator`` — ResourcePlan → pod/slice reconciliation controller
+- ``easydl_tpu.ps``       — host-side sparse-embedding parameter server
+- ``easydl_tpu.models``   — model zoo (MLP, ResNet-50, BERT, GPT-2, DeepFM, ...)
+- ``easydl_tpu.ops``      — Pallas TPU kernels (flash attention, ...)
+- ``easydl_tpu.parallel`` — DP/FSDP/TP/SP machinery: ring attention, Ulysses, collectives
+"""
+
+__version__ = "0.1.0"
+
+from easydl_tpu.api.job_spec import JobSpec, RoleSpec, ResourceSpec, TpuSpec  # noqa: F401
+from easydl_tpu.api.resource_plan import ResourcePlan, RolePlan, ResourceUpdation  # noqa: F401

@@ -1,0 +1,21 @@
+"""Job/resource contracts — the user-facing API surface.
+
+Mirrors the reference's two CRDs (ElasticJob and JobResource, API group
+``elastic.easydl.org/v1alpha1`` — reference
+docs/design/elastic-training-operator.md:16-18,32,58) as Python dataclasses
+with YAML round-trip in CRD form, extended with a first-class ``tpu``
+resource type.
+"""
+
+from easydl_tpu.api.job_spec import JobSpec, RoleSpec, ResourceSpec, TpuSpec
+from easydl_tpu.api.resource_plan import ResourcePlan, RolePlan, ResourceUpdation
+
+__all__ = [
+    "JobSpec",
+    "RoleSpec",
+    "ResourceSpec",
+    "TpuSpec",
+    "ResourcePlan",
+    "RolePlan",
+    "ResourceUpdation",
+]

@@ -1,0 +1,264 @@
+"""JobSpec — the user's job submission document (≙ the ElasticJob CRD).
+
+The reference specifies (docs/design/elastic-training-operator.md:24-45) that a
+user submits an ``ElasticJob`` naming per-role images and an entrypoint command,
+with **no resource or replica information required** (README.md:19-23: "users
+don't need to configure any resources") — resources are decided later by Brain
+and materialised in a :class:`~easydl_tpu.api.resource_plan.ResourcePlan`.
+
+This module keeps CRD-compatible YAML round-trip (kind ``ElasticJob``, group
+``elastic.easydl.org/v1alpha1``) so reference users can submit their existing
+manifests unchanged, and adds TPU-native fields (accelerator type/topology
+preferences) that the reference left unspecified.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import yaml
+
+API_GROUP = "elastic.easydl.org"
+API_VERSION = f"{API_GROUP}/v1alpha1"
+JOB_KIND = "ElasticJob"
+
+#: The pod roles the reference defines (docs/design/elastic-training-operator.md:39-44)
+#: plus the trainer pod the operator launches first (:47-48).
+ROLES = ("trainer", "parameter_server", "worker", "evaluator")
+
+
+class SpecError(ValueError):
+    """Raised when a spec document fails validation."""
+
+
+@dataclass
+class TpuSpec:
+    """TPU accelerator request — the resource type the reference lacked.
+
+    ``type`` is an accelerator family (``v4``, ``v5e``, ``v5p``), ``chips`` the
+    chip count, ``topology`` an optional physical topology (e.g. ``2x2x4``).
+    """
+
+    type: str = "v5e"
+    chips: int = 0
+    topology: str = ""
+
+    def validate(self) -> None:
+        if self.chips < 0:
+            raise SpecError(f"tpu.chips must be >= 0, got {self.chips}")
+        if self.topology:
+            dims = self.topology.lower().split("x")
+            if not all(d.isdigit() and int(d) > 0 for d in dims):
+                raise SpecError(f"malformed tpu.topology {self.topology!r}")
+            n = 1
+            for d in dims:
+                n *= int(d)
+            if self.chips and n != self.chips:
+                raise SpecError(
+                    f"tpu.topology {self.topology!r} implies {n} chips, "
+                    f"but tpu.chips={self.chips}"
+                )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"type": self.type, "chips": self.chips, "topology": self.topology}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TpuSpec":
+        return cls(
+            type=str(d.get("type", "v5e")),
+            chips=int(d.get("chips", 0)),
+            topology=str(d.get("topology", "")),
+        )
+
+
+@dataclass
+class ResourceSpec:
+    """Per-pod resource quantities.
+
+    Field set mirrors the JobResource schema's ``resource`` block —
+    ``cpu`` / ``memory`` / ``disk`` / ``gpu``
+    (docs/design/elastic-training-operator.md:67-71) — plus ``tpu``.
+    Memory/disk are megabytes, matching the reference's integral examples
+    (``memory: 4096``, :68).
+    """
+
+    cpu: float = 0.0
+    memory: int = 0  # MB
+    disk: int = 0  # MB
+    gpu: int = 0
+    tpu: Optional[TpuSpec] = None
+
+    def validate(self) -> None:
+        if self.cpu < 0 or self.memory < 0 or self.disk < 0 or self.gpu < 0:
+            raise SpecError(f"negative resource quantity in {self}")
+        if self.tpu is not None:
+            self.tpu.validate()
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "cpu": self.cpu,
+            "memory": self.memory,
+            "disk": self.disk,
+            "gpu": self.gpu,
+        }
+        if self.tpu is not None:
+            d["tpu"] = self.tpu.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ResourceSpec":
+        d = d or {}
+        tpu = d.get("tpu")
+        return cls(
+            cpu=float(d.get("cpu", 0)),
+            memory=int(d.get("memory", 0)),
+            disk=int(d.get("disk", 0)),
+            gpu=int(d.get("gpu", 0)),
+            tpu=TpuSpec.from_dict(tpu) if tpu else None,
+        )
+
+    def merged_over(self, base: "ResourceSpec") -> "ResourceSpec":
+        """Non-zero fields of ``self`` override ``base`` (vertical-scaling merge)."""
+        return ResourceSpec(
+            cpu=self.cpu or base.cpu,
+            memory=self.memory or base.memory,
+            disk=self.disk or base.disk,
+            gpu=self.gpu or base.gpu,
+            tpu=self.tpu if self.tpu is not None else base.tpu,
+        )
+
+
+@dataclass
+class RoleSpec:
+    """Per-role section of a JobSpec: image + optional command override.
+
+    The ElasticJob example carries ``image`` per role and a shared top-level
+    ``command`` (docs/design/elastic-training-operator.md:36-44).
+    """
+
+    image: str = ""
+    command: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.image:
+            d["image"] = self.image
+        if self.command:
+            d["command"] = self.command
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RoleSpec":
+        d = d or {}
+        return cls(image=str(d.get("image", "")), command=str(d.get("command", "")))
+
+
+@dataclass
+class JobSpec:
+    """The job submission document (≙ ElasticJob).
+
+    No replicas, no resources — intent only. Resources arrive later as a
+    :class:`~easydl_tpu.api.resource_plan.ResourcePlan` generated by the
+    trainer from Brain's answer (docs/design/elastic-training-operator.md:105-108).
+    """
+
+    name: str = ""
+    image: str = ""
+    command: str = ""
+    roles: Dict[str, RoleSpec] = field(default_factory=dict)
+    # TPU-native extensions (absent in the reference CRD):
+    accelerator: Optional[TpuSpec] = None  # preferred accelerator family/topology
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.name:
+            raise SpecError("JobSpec.name is required")
+        if not self.command and not any(r.command for r in self.roles.values()):
+            raise SpecError(f"job {self.name!r}: no entrypoint command anywhere")
+        for role in self.roles:
+            if role not in ROLES:
+                raise SpecError(f"unknown role {role!r}; valid roles: {ROLES}")
+        if self.accelerator is not None:
+            self.accelerator.validate()
+
+    def role_command(self, role: str) -> str:
+        r = self.roles.get(role)
+        return (r.command if r and r.command else self.command)
+
+    def role_image(self, role: str) -> str:
+        r = self.roles.get(role)
+        return (r.image if r and r.image else self.image)
+
+    # ------------------------------------------------------------------ CRD IO
+    def to_crd(self) -> Dict[str, Any]:
+        spec: Dict[str, Any] = {}
+        if self.image:
+            spec["image"] = self.image
+        if self.command:
+            spec["command"] = self.command
+        for role, rs in self.roles.items():
+            rd = rs.to_dict()
+            if rd:
+                spec[role] = rd
+        if self.accelerator is not None:
+            spec["accelerator"] = self.accelerator.to_dict()
+        return {
+            "apiVersion": API_VERSION,
+            "kind": JOB_KIND,
+            "metadata": {"name": self.name, **({"labels": self.labels} if self.labels else {})},
+            "spec": spec,
+        }
+
+    @classmethod
+    def from_crd(cls, doc: Dict[str, Any]) -> "JobSpec":
+        if not isinstance(doc, dict):
+            raise SpecError(f"expected a mapping document, got {type(doc).__name__}")
+        if doc.get("kind") != JOB_KIND:
+            raise SpecError(f"expected kind {JOB_KIND}, got {doc.get('kind')!r}")
+        meta = doc.get("metadata") or {}
+        spec = doc.get("spec") or {}
+        known = set(ROLES) | {"image", "command", "accelerator"}
+        unknown = sorted(k for k in spec if k not in known)
+        if unknown:
+            raise SpecError(
+                f"unknown spec field(s) {unknown} in ElasticJob "
+                f"{meta.get('name')!r}; valid roles: {ROLES}"
+            )
+        roles = {
+            role: RoleSpec.from_dict(spec[role])
+            for role in ROLES
+            if isinstance(spec.get(role), dict)
+        }
+        acc = spec.get("accelerator")
+        js = cls(
+            name=str(meta.get("name", "")),
+            image=str(spec.get("image", "")),
+            command=str(spec.get("command", "")),
+            roles=roles,
+            accelerator=TpuSpec.from_dict(acc) if acc else None,
+            labels=dict(meta.get("labels") or {}),
+        )
+        js.validate()
+        return js
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_crd(), sort_keys=False)
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "JobSpec":
+        return cls.from_crd(yaml.safe_load(text))
+
+    def features(self) -> Dict[str, Any]:
+        """Job features extracted for Brain's startup plan
+        (docs/design/elastic-training-operator.md:106: the trainer
+        "extracts features from the job")."""
+        return {
+            "name": self.name,
+            "command": self.command,
+            "uses_ps": "parameter_server" in self.roles,
+            "uses_evaluator": "evaluator" in self.roles,
+            "accelerator": dataclasses.asdict(self.accelerator) if self.accelerator else None,
+            "labels": self.labels,
+        }

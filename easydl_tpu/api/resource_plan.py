@@ -1,0 +1,215 @@
+"""ResourcePlan — the system's materialised resource decision (≙ JobResource CRD).
+
+The reference's JobResource (docs/design/elastic-training-operator.md:50-101)
+carries:
+
+- ``spec.selector.name`` binding the plan to a job (:61-62),
+- per-role ``replicas`` + ``resource`` blocks for parameter_server / worker /
+  evaluator (:63-85),
+- a ``resource_updation`` list for per-pod **vertical scaling with
+  replace-then-retire semantics**: "launch a new Pod with the ``resource`` ...
+  to replace the Pod with the ``resource_updation.name``" (:86-101).
+
+Either the trainer (normal path, :107-108) or an advanced user (:50-55) creates
+it; the operator reconciles pods against it (:97-98). We keep that contract and
+extend ``resource`` with TPU chips/topology so a plan can demand pod slices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from easydl_tpu.api.job_spec import (
+    API_VERSION,
+    ROLES,
+    ResourceSpec,
+    SpecError,
+)
+
+PLAN_KIND = "JobResource"
+
+#: Roles that may appear in a plan (the trainer pod is created from the
+#: ElasticJob itself, before any plan exists — :47-48 — but including it here
+#: lets a plan vertically scale the trainer too).
+PLAN_ROLES = ("parameter_server", "worker", "evaluator", "trainer")
+
+
+@dataclass
+class RolePlan:
+    """``replicas`` + per-replica ``resource`` for one role
+    (docs/design/elastic-training-operator.md:63-85)."""
+
+    replicas: int = 0
+    resource: ResourceSpec = field(default_factory=ResourceSpec)
+
+    def validate(self) -> None:
+        if self.replicas < 0:
+            raise SpecError(f"replicas must be >= 0, got {self.replicas}")
+        self.resource.validate()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"replicas": self.replicas, "resource": self.resource.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RolePlan":
+        return cls(
+            replicas=int(d.get("replicas", 0)),
+            resource=ResourceSpec.from_dict(d.get("resource")),
+        )
+
+
+@dataclass
+class ResourceUpdation:
+    """One vertical-scaling entry: replace the pod named ``name`` with a new
+    pod using ``resource`` (docs/design/elastic-training-operator.md:86-101).
+
+    Field name kept as the reference spells it ("updation") for manifest
+    compatibility.
+    """
+
+    name: str
+    resource: ResourceSpec = field(default_factory=ResourceSpec)
+
+    def validate(self) -> None:
+        if not self.name:
+            raise SpecError("resource_updation entry needs a pod name")
+        self.resource.validate()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "resource": self.resource.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResourceUpdation":
+        return cls(
+            name=str(d.get("name", "")),
+            resource=ResourceSpec.from_dict(d.get("resource")),
+        )
+
+
+@dataclass
+class ResourcePlan:
+    """The full plan document (≙ JobResource)."""
+
+    name: str = ""
+    job_name: str = ""  # spec.selector.name (:61-62)
+    roles: Dict[str, RolePlan] = field(default_factory=dict)
+    resource_updation: List[ResourceUpdation] = field(default_factory=list)
+    #: monotonically increasing version so the operator/master can order plans
+    #: (the reference relies on k8s resourceVersion implicitly; we make it explicit)
+    version: int = 0
+
+    def validate(self) -> None:
+        if not self.job_name:
+            raise SpecError("ResourcePlan.job_name (spec.selector.name) is required")
+        for role, rp in self.roles.items():
+            if role not in PLAN_ROLES:
+                raise SpecError(f"unknown role {role!r}; valid: {PLAN_ROLES}")
+            rp.validate()
+        for u in self.resource_updation:
+            u.validate()
+
+    def replicas(self, role: str) -> int:
+        rp = self.roles.get(role)
+        return rp.replicas if rp else 0
+
+    @property
+    def total_tpu_chips(self) -> int:
+        n = 0
+        for rp in self.roles.values():
+            if rp.resource.tpu:
+                n += rp.replicas * rp.resource.tpu.chips
+        return n
+
+    def with_role(self, role: str, replicas: int, resource: Optional[ResourceSpec] = None) -> "ResourcePlan":
+        """Functional update: new plan with ``role`` set, version bumped."""
+        roles = dict(self.roles)
+        old = roles.get(role)
+        roles[role] = RolePlan(
+            replicas=replicas,
+            resource=resource if resource is not None else (old.resource if old else ResourceSpec()),
+        )
+        return ResourcePlan(
+            name=self.name,
+            job_name=self.job_name,
+            roles=roles,
+            resource_updation=list(self.resource_updation),
+            version=self.version + 1,
+        )
+
+    # ------------------------------------------------------------------ CRD IO
+    def to_crd(self) -> Dict[str, Any]:
+        spec: Dict[str, Any] = {"selector": {"name": self.job_name}}
+        for role, rp in self.roles.items():
+            spec[role] = rp.to_dict()
+        if self.resource_updation:
+            spec["resource_updation"] = [u.to_dict() for u in self.resource_updation]
+        meta: Dict[str, Any] = {"version": self.version}
+        if self.name:
+            meta["name"] = self.name
+        return {
+            "apiVersion": API_VERSION,
+            "kind": PLAN_KIND,
+            "metadata": meta,
+            "spec": spec,
+        }
+
+    @classmethod
+    def from_crd(cls, doc: Dict[str, Any]) -> "ResourcePlan":
+        if not isinstance(doc, dict):
+            raise SpecError(f"expected a mapping document, got {type(doc).__name__}")
+        if doc.get("kind") != PLAN_KIND:
+            raise SpecError(f"expected kind {PLAN_KIND}, got {doc.get('kind')!r}")
+        meta = doc.get("metadata") or {}
+        spec = doc.get("spec") or {}
+        known = set(PLAN_ROLES) | {"selector", "resource_updation"}
+        unknown = sorted(k for k in spec if k not in known)
+        if unknown:
+            raise SpecError(
+                f"unknown spec field(s) {unknown} in JobResource "
+                f"{meta.get('name')!r}; valid roles: {PLAN_ROLES}"
+            )
+        selector = spec.get("selector") or {}
+        roles = {
+            role: RolePlan.from_dict(spec[role])
+            for role in PLAN_ROLES
+            if isinstance(spec.get(role), dict)
+        }
+        plan = cls(
+            name=str(meta.get("name", "")),
+            job_name=str(selector.get("name", "")),
+            roles=roles,
+            resource_updation=[
+                ResourceUpdation.from_dict(u) for u in spec.get("resource_updation") or []
+            ],
+            version=int(meta.get("version", 0)),
+        )
+        plan.validate()
+        return plan
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_crd(), sort_keys=False)
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "ResourcePlan":
+        return cls.from_crd(yaml.safe_load(text))
+
+    # ------------------------------------------------------------------ diffing
+    def diff(self, other: "ResourcePlan") -> Dict[str, Any]:
+        """Role-level delta from ``self`` to ``other`` — what the operator must
+        reconcile (create/delete pods) and the master must absorb (world-size
+        change)."""
+        delta: Dict[str, Any] = {"scale": {}, "replace": []}
+        for role in set(self.roles) | set(other.roles):
+            before, after = self.replicas(role), other.replicas(role)
+            if before != after:
+                delta["scale"][role] = (before, after)
+        seen = {(u.name, tuple(sorted(u.resource.to_dict().items(), key=str))) for u in self.resource_updation}
+        delta["replace"] = [
+            u.name
+            for u in other.resource_updation
+            if (u.name, tuple(sorted(u.resource.to_dict().items(), key=str))) not in seen
+        ]
+        return delta

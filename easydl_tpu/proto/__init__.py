@@ -1,0 +1,3 @@
+"""Generated protobuf messages. Regenerate with scripts/gen_proto.sh."""
+
+from easydl_tpu.proto import easydl_pb2  # noqa: F401

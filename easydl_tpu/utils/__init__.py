@@ -1,0 +1,1 @@
+from easydl_tpu.utils.logging import get_logger  # noqa: F401

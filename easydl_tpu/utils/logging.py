@@ -1,0 +1,53 @@
+"""Structured per-component logging.
+
+The reference has no logging subsystem (lint-only CI); Brain's inputs imply one
+(README.md:21-23 performance monitoring). Every easydl_tpu process logs through
+here so component/role/host are always attached.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from typing import Optional
+
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root = logging.getLogger("easydl_tpu")
+    root.addHandler(handler)
+    level = os.environ.get("EASYDL_LOG_LEVEL", "INFO").upper()
+    if level not in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
+        level = "INFO"
+    root.setLevel(level)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(component: str, role: Optional[str] = None) -> logging.Logger:
+    """Logger named ``easydl_tpu.<component>[.<role>]``."""
+    _configure_root()
+    name = f"easydl_tpu.{component}" + (f".{role}" if role else "")
+    return logging.getLogger(name)
+
+
+class StepTimer:
+    """Cheap wall-clock step timer used by the trainer's metrics loop."""
+
+    def __init__(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def lap(self) -> float:
+        now = time.perf_counter()
+        dt = now - self._t0
+        self._t0 = now
+        return dt

@@ -1,0 +1,128 @@
+"""Minimal gRPC service plumbing without protoc's grpc plugin.
+
+This image ships ``protoc`` (message codegen) and the ``grpcio`` runtime but
+not ``grpc_python_plugin``, so instead of generated ``_pb2_grpc`` stubs each
+service declares a method table and we register it with
+``grpc.method_handlers_generic_handler``. Clients go through
+:class:`RpcClient`, which builds unary-unary callables lazily.
+
+Usage::
+
+    SERVICE = ServiceDef("easydl.Brain", {
+        "GetStartupPlan": (pb.JobFeatures, pb.PlanResponse),
+        ...
+    })
+
+    server = serve(SERVICE, handler_obj, port=0)   # handler_obj.GetStartupPlan(req, ctx)
+    client = RpcClient(SERVICE, f"localhost:{server.port}")
+    resp = client.GetStartupPlan(pb.JobFeatures(job_name="j"))
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import grpc
+
+
+@dataclass(frozen=True)
+class ServiceDef:
+    """A gRPC service: full name + {method: (request_cls, response_cls)}."""
+
+    name: str
+    methods: Dict[str, Tuple[Any, Any]]
+
+
+class Server:
+    """A running gRPC server bound to ``port`` (picks a free one if 0)."""
+
+    def __init__(self, server: grpc.Server, port: int):
+        self._server = server
+        self.port = port
+
+    @property
+    def address(self) -> str:
+        return f"localhost:{self.port}"
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._server.stop(grace)
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()
+
+
+def _handlers_for(service: ServiceDef, impl: Any) -> grpc.GenericRpcHandler:
+    table = {}
+    for method, (req_cls, resp_cls) in service.methods.items():
+        fn = getattr(impl, method)
+        table[method] = grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    return grpc.method_handlers_generic_handler(service.name, table)
+
+
+def serve(
+    service: ServiceDef,
+    impl: Any,
+    port: int = 0,
+    max_workers: int = 16,
+    extra: Optional[list] = None,
+) -> Server:
+    """Start a server hosting ``service`` (and optionally more
+    ``(ServiceDef, impl)`` pairs via ``extra``)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_handlers_for(service, impl),))
+    for svc, obj in extra or []:
+        server.add_generic_rpc_handlers((_handlers_for(svc, obj),))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    if bound == 0:
+        raise OSError(f"failed to bind gRPC server to port {port}")
+    server.start()
+    return Server(server, bound)
+
+
+class RpcClient:
+    """Typed unary-unary client for a :class:`ServiceDef`."""
+
+    def __init__(self, service: ServiceDef, address: str, timeout: float = 30.0):
+        self._service = service
+        self._address = address
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(address)
+        self._calls: Dict[str, Callable] = {}
+        self._lock = threading.Lock()
+
+    def _call(self, method: str) -> Callable:
+        with self._lock:
+            if method not in self._calls:
+                req_cls, resp_cls = self._service.methods[method]
+                self._calls[method] = self._channel.unary_unary(
+                    f"/{self._service.name}/{method}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                )
+            return self._calls[method]
+
+    def __getattr__(self, method: str) -> Callable:
+        if method.startswith("_"):
+            raise AttributeError(method)
+        if method not in self._service.methods:
+            raise AttributeError(f"{self._service.name} has no method {method}")
+        call = self._call(method)
+        timeout = self._timeout
+
+        def invoke(request, timeout_s: Optional[float] = None):
+            return call(request, timeout=timeout_s or timeout)
+
+        return invoke
+
+    def wait_ready(self, timeout: float = 10.0) -> None:
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+
+    def close(self) -> None:
+        self._channel.close()

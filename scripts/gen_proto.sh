@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Regenerate protobuf Python code. (No grpc plugin in this image — services are
+# registered at runtime via grpc generic handlers, see easydl_tpu/utils/rpc.py.)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+protoc --python_out=easydl_tpu/proto -I easydl_tpu/proto easydl_tpu/proto/easydl.proto
+echo "regenerated easydl_tpu/proto/easydl_pb2.py"

@@ -1,0 +1,24 @@
+"""Test bootstrap: force an 8-device CPU platform so every sharding/collective
+path runs without TPU hardware (SURVEY.md §4 item 3).
+
+Must run before jax initialises its backends, hence the env vars are set at
+import time of conftest (pytest imports conftest before test modules).
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Keep XLA single-threaded enough to be stable in CI containers.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 forced CPU devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,130 @@
+"""Contract tests for JobSpec (≙ ElasticJob) and ResourcePlan (≙ JobResource).
+
+The YAML fixtures below are transcriptions of the reference's CRD examples
+(docs/design/elastic-training-operator.md:31-45 and :57-95) — round-tripping
+them proves manifest compatibility.
+"""
+
+import pytest
+
+from easydl_tpu.api import (
+    JobSpec,
+    ResourcePlan,
+    ResourceSpec,
+    RolePlan,
+    TpuSpec,
+)
+from easydl_tpu.api.job_spec import SpecError
+
+ELASTIC_JOB_YAML = """
+apiVersion: elastic.easydl.org/v1alpha1
+kind: ElasticJob
+metadata:
+  name: deepctr
+spec:
+  image: elasticdl:iris_estimator
+  command: python -m model_zoo.iris.dnn_estimator
+  parameter_server:
+    image: elasticdl:iris_estimator
+  worker:
+    image: elasticdl:iris_estimator
+  evaluator:
+    image: elasticdl:iris_estimator
+"""
+
+JOB_RESOURCE_YAML = """
+apiVersion: elastic.easydl.org/v1alpha1
+kind: JobResource
+metadata:
+  name: deepctr-resource
+spec:
+  selector:
+    name: deepctr
+  parameter_server:
+    replicas: 1
+    resource:
+      cpu: 4
+      memory: 4096
+  worker:
+    replicas: 2
+    resource:
+      cpu: 4
+      memory: 4096
+  evaluator:
+    replicas: 1
+    resource:
+      cpu: 4
+      memory: 4096
+  resource_updation:
+    - name: deepctr-ps-0
+      resource:
+        cpu: 8
+        memory: 8192
+"""
+
+
+def test_elastic_job_round_trip():
+    job = JobSpec.from_yaml(ELASTIC_JOB_YAML)
+    assert job.name == "deepctr"
+    assert job.command == "python -m model_zoo.iris.dnn_estimator"
+    assert set(job.roles) == {"parameter_server", "worker", "evaluator"}
+    assert job.role_image("worker") == "elasticdl:iris_estimator"
+    # role command falls back to the shared top-level command
+    assert job.role_command("worker") == job.command
+    again = JobSpec.from_yaml(job.to_yaml())
+    assert again == job
+
+
+def test_job_resource_round_trip_and_updation():
+    plan = ResourcePlan.from_yaml(JOB_RESOURCE_YAML)
+    assert plan.job_name == "deepctr"
+    assert plan.replicas("worker") == 2
+    assert plan.replicas("parameter_server") == 1
+    assert plan.roles["worker"].resource.cpu == 4
+    assert len(plan.resource_updation) == 1
+    upd = plan.resource_updation[0]
+    assert upd.name == "deepctr-ps-0"
+    assert upd.resource.memory == 8192
+    again = ResourcePlan.from_yaml(plan.to_yaml())
+    assert again == plan
+
+
+def test_tpu_resource_extension():
+    plan = ResourcePlan(
+        job_name="bert",
+        roles={
+            "worker": RolePlan(
+                replicas=4,
+                resource=ResourceSpec(tpu=TpuSpec(type="v4", chips=8, topology="2x2x2")),
+            )
+        },
+    )
+    plan.validate()
+    assert plan.total_tpu_chips == 32
+    again = ResourcePlan.from_yaml(plan.to_yaml())
+    assert again.roles["worker"].resource.tpu.topology == "2x2x2"
+
+
+def test_topology_chip_mismatch_rejected():
+    with pytest.raises(SpecError):
+        TpuSpec(type="v4", chips=16, topology="2x2x2").validate()
+
+
+def test_job_requires_command():
+    with pytest.raises(SpecError):
+        JobSpec(name="x").validate()
+
+
+def test_plan_diff_scale_and_replace():
+    p1 = ResourcePlan.from_yaml(JOB_RESOURCE_YAML)
+    p2 = p1.with_role("worker", 5)
+    delta = p1.diff(p2)
+    assert delta["scale"] == {"worker": (2, 5)}
+    assert p2.version == p1.version + 1
+
+
+def test_vertical_merge():
+    base = ResourceSpec(cpu=4, memory=4096)
+    upd = ResourceSpec(cpu=8)
+    merged = upd.merged_over(base)
+    assert merged.cpu == 8 and merged.memory == 4096

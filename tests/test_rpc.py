@@ -1,0 +1,37 @@
+"""gRPC plumbing test: serve a ServiceDef via generic handlers, call it."""
+
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.rpc import RpcClient, ServiceDef, serve
+
+ECHO = ServiceDef(
+    "easydl.test.Echo",
+    {
+        "Plan": (pb.PlanRequest, pb.PlanResponse),
+        "Report": (pb.StepMetrics, pb.Ack),
+    },
+)
+
+
+class EchoImpl:
+    def Plan(self, req, ctx):
+        plan = pb.ResourcePlanProto(job_name=req.job_name, version=req.current_version + 1)
+        plan.roles["worker"].replicas = 8
+        return pb.PlanResponse(has_plan=True, plan=plan)
+
+    def Report(self, req, ctx):
+        return pb.Ack(ok=True, message=f"step={req.step}")
+
+
+def test_rpc_round_trip():
+    server = serve(ECHO, EchoImpl())
+    try:
+        client = RpcClient(ECHO, server.address)
+        client.wait_ready()
+        resp = client.Plan(pb.PlanRequest(job_name="bert", current_version=4))
+        assert resp.has_plan and resp.plan.version == 5
+        assert resp.plan.roles["worker"].replicas == 8
+        ack = client.Report(pb.StepMetrics(job_name="bert", step=17))
+        assert ack.ok and ack.message == "step=17"
+        client.close()
+    finally:
+        server.stop()
