@@ -199,9 +199,9 @@ class JobSpec:
         if self.command:
             spec["command"] = self.command
         for role, rs in self.roles.items():
-            rd = rs.to_dict()
-            if rd:
-                spec[role] = rd
+            # Emit the role key even when empty: declaring a role (inheriting
+            # the shared image/command) is meaningful membership information.
+            spec[role] = rs.to_dict()
         if self.accelerator is not None:
             spec["accelerator"] = self.accelerator.to_dict()
         return {
@@ -226,11 +226,15 @@ class JobSpec:
                 f"unknown spec field(s) {unknown} in ElasticJob "
                 f"{meta.get('name')!r}; valid roles: {ROLES}"
             )
-        roles = {
-            role: RoleSpec.from_dict(spec[role])
-            for role in ROLES
-            if isinstance(spec.get(role), dict)
-        }
+        roles = {}
+        for role in ROLES:
+            if role not in spec:
+                continue
+            if not isinstance(spec[role], dict):
+                raise SpecError(
+                    f"role {role!r} must be a mapping, got {type(spec[role]).__name__}"
+                )
+            roles[role] = RoleSpec.from_dict(spec[role])
         acc = spec.get("accelerator")
         js = cls(
             name=str(meta.get("name", "")),

@@ -17,8 +17,9 @@ extend ``resource`` with TPU chips/topology so a plan can demand pod slices.
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import yaml
 
@@ -172,11 +173,15 @@ class ResourcePlan:
                 f"{meta.get('name')!r}; valid roles: {PLAN_ROLES}"
             )
         selector = spec.get("selector") or {}
-        roles = {
-            role: RolePlan.from_dict(spec[role])
-            for role in PLAN_ROLES
-            if isinstance(spec.get(role), dict)
-        }
+        roles = {}
+        for role in PLAN_ROLES:
+            if role not in spec:
+                continue
+            if not isinstance(spec[role], dict):
+                raise SpecError(
+                    f"role {role!r} must be a mapping, got {type(spec[role]).__name__}"
+                )
+            roles[role] = RolePlan.from_dict(spec[role])
         plan = cls(
             name=str(meta.get("name", "")),
             job_name=str(selector.get("name", "")),
@@ -206,10 +211,9 @@ class ResourcePlan:
             before, after = self.replicas(role), other.replicas(role)
             if before != after:
                 delta["scale"][role] = (before, after)
-        seen = {(u.name, tuple(sorted(u.resource.to_dict().items(), key=str))) for u in self.resource_updation}
-        delta["replace"] = [
-            u.name
-            for u in other.resource_updation
-            if (u.name, tuple(sorted(u.resource.to_dict().items(), key=str))) not in seen
-        ]
+        def key(u: "ResourceUpdation") -> Tuple[str, str]:
+            return (u.name, json.dumps(u.resource.to_dict(), sort_keys=True))
+
+        seen = {key(u) for u in self.resource_updation}
+        delta["replace"] = [u.name for u in other.resource_updation if key(u) not in seen]
         return delta

@@ -1,0 +1,298 @@
+"""Sharded, async, reshard-on-restore checkpointing.
+
+The reference promises "resume the training" after failures (README.md:27)
+with no mechanism; for TPU elasticity the checkpoint layer is the linchpin
+(SURVEY.md §5.4, §7): a save taken on an 8-chip mesh must restore onto a
+32-chip mesh (and vice versa) without materialising full arrays on any single
+host.
+
+Layout (one directory per step)::
+
+    <dir>/step_00000010/
+        manifest.json            # leaf keys, shapes, dtypes, mesh meta
+        leaf_00003/0-128_0-64.npy   # chunk covering [0:128, 0:64]
+        ...
+        COMMITTED                # written last — step is valid iff present
+
+Mechanics:
+- **save**: every process writes the chunks for its addressable, replica-0
+  shards (`jax.Array.addressable_shards`), so write bandwidth scales with
+  hosts and nothing is gathered. Host copies are snapshotted synchronously
+  (donation-safe), file IO runs on a background thread.
+- **restore**: ``jax.make_array_from_callback`` asks for exactly the slices
+  the *new* sharding places on local devices; the reader assembles them from
+  whichever chunks overlap (memory-mapped), so an 8→32 or 32→8 reshard reads
+  only what each host needs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("core", "checkpoint")
+
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+_COMMITTED = "COMMITTED"
+
+
+def _keystr(path) -> str:
+    return jax.tree_util.keystr(path)
+
+
+def _chunk_name(index: Tuple[slice, ...], shape: Tuple[int, ...]) -> str:
+    if not shape:
+        return "scalar.npy"
+    parts = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else sl.start
+        stop = dim if sl.stop is None else sl.stop
+        parts.append(f"{start}-{stop}")
+    return "_".join(parts) + ".npy"
+
+
+def _parse_chunk_name(name: str) -> Optional[List[Tuple[int, int]]]:
+    if name == "scalar.npy":
+        return []
+    if not name.endswith(".npy"):
+        return None
+    try:
+        return [
+            (int(a), int(b))
+            for a, b in (p.split("-") for p in name[:-4].split("_"))
+        ]
+    except ValueError:
+        return None
+
+
+class _LeafReader:
+    """Assembles arbitrary slices of one leaf from its saved chunks."""
+
+    def __init__(self, leaf_dir: str, shape: Tuple[int, ...], dtype: np.dtype):
+        self.shape = shape
+        self.dtype = dtype
+        self._chunks: List[Tuple[List[Tuple[int, int]], str]] = []
+        for name in os.listdir(leaf_dir):
+            bounds = _parse_chunk_name(name)
+            if bounds is not None:
+                self._chunks.append((bounds, os.path.join(leaf_dir, name)))
+        if not self._chunks:
+            raise FileNotFoundError(f"no chunks in {leaf_dir}")
+
+    def read(self, index: Tuple[slice, ...]) -> np.ndarray:
+        if not self.shape:
+            return np.load(self._chunks[0][1])
+        want = [
+            (0 if sl.start is None else sl.start, dim if sl.stop is None else sl.stop)
+            for sl, dim in zip(index, self.shape)
+        ]
+        out = np.empty([b - a for a, b in want], dtype=self.dtype)
+        filled = 0
+        for bounds, path in self._chunks:
+            # overlap of chunk bounds with wanted region
+            inter = [
+                (max(a, ca), min(b, cb))
+                for (a, b), (ca, cb) in zip(want, bounds)
+            ]
+            if any(a >= b for a, b in inter):
+                continue
+            data = np.load(path, mmap_mode="r")
+            src = tuple(
+                slice(a - ca, b - ca) for (a, b), (ca, cb) in zip(inter, bounds)
+            )
+            dst = tuple(
+                slice(a - wa, b - wa) for (a, b), (wa, wb) in zip(inter, want)
+            )
+            out[dst] = data[src]
+            filled += int(np.prod([b - a for a, b in inter]))
+        if filled != out.size:
+            raise ValueError(
+                f"chunks cover {filled}/{out.size} elements of requested slice "
+                f"{want} (shape {self.shape})"
+            )
+        return out
+
+
+class CheckpointManager:
+    """Save/restore sharded pytrees, keeping the last ``keep`` committed steps."""
+
+    def __init__(self, directory: str, keep: int = 3, async_save: bool = True):
+        self.directory = directory
+        self.keep = keep
+        self.async_save = async_save
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ save
+    def save(self, step: int, state: Any, metadata: Optional[Dict[str, Any]] = None) -> None:
+        """Snapshot shards to host, then write asynchronously (unless
+        ``async_save=False``). Call :meth:`wait` before donating buffers is
+        NOT needed — the snapshot happens here, synchronously."""
+        self.wait()
+        leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+        snapshot = []  # (leaf_idx, keystr, global_shape, dtype, [(bounds, np.ndarray)])
+        for i, (path, leaf) in enumerate(leaves):
+            key = _keystr(path)
+            if isinstance(leaf, jax.Array):
+                shape, dtype = tuple(leaf.shape), np.dtype(leaf.dtype)
+                chunks = []
+                for shard in leaf.addressable_shards:
+                    if shard.replica_id != 0:
+                        continue
+                    chunks.append((shard.index, np.asarray(shard.data)))
+                snapshot.append((i, key, shape, dtype, chunks))
+            else:
+                arr = np.asarray(leaf)
+                snapshot.append(
+                    (i, key, tuple(arr.shape), arr.dtype,
+                     [(tuple(slice(0, d) for d in arr.shape), arr)])
+                )
+
+        def write():
+            t0 = time.perf_counter()
+            step_dir = os.path.join(self.directory, f"step_{step:08d}")
+            tmp_dir = step_dir + f".tmp.{jax.process_index()}"
+            os.makedirs(tmp_dir, exist_ok=True)
+            manifest = {
+                "step": step,
+                "metadata": metadata or {},
+                "leaves": [
+                    {"index": i, "key": key, "shape": list(shape), "dtype": str(dtype)}
+                    for i, key, shape, dtype, _ in snapshot
+                ],
+            }
+            for i, key, shape, dtype, chunks in snapshot:
+                leaf_dir = os.path.join(tmp_dir, f"leaf_{i:05d}")
+                os.makedirs(leaf_dir, exist_ok=True)
+                for index, data in chunks:
+                    np.save(os.path.join(leaf_dir, _chunk_name(index, shape)), data)
+            if jax.process_index() == 0:
+                with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
+                    json.dump(manifest, f)
+            # Single-host commit: rename tmp → final, then COMMITTED marker.
+            # (Multi-host: every process renames its own tmp dir contents in;
+            # process 0 writes the marker after a barrier — see note below.)
+            if jax.process_count() == 1:
+                os.replace(tmp_dir, step_dir)
+            else:
+                os.makedirs(step_dir, exist_ok=True)
+                for name in os.listdir(tmp_dir):
+                    src, dst = os.path.join(tmp_dir, name), os.path.join(step_dir, name)
+                    if os.path.isdir(src):
+                        os.makedirs(dst, exist_ok=True)
+                        for chunk in os.listdir(src):
+                            os.replace(os.path.join(src, chunk), os.path.join(dst, chunk))
+                    else:
+                        os.replace(src, dst)
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+            if jax.process_index() == 0:
+                with open(os.path.join(step_dir, _COMMITTED), "w") as f:
+                    f.write(str(step))
+            log.info("saved step %d in %.2fs -> %s", step, time.perf_counter() - t0, step_dir)
+            self._gc()
+
+        if self.async_save:
+            def run():
+                try:
+                    write()
+                except BaseException as e:  # surfaced on next wait()/save()
+                    self._error = e
+
+            self._thread = threading.Thread(target=run, daemon=True)
+            self._thread.start()
+        else:
+            write()
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(f"async checkpoint save failed: {err!r}") from err
+
+    # ---------------------------------------------------------------- restore
+    def steps(self) -> List[int]:
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m and os.path.exists(os.path.join(self.directory, name, _COMMITTED)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def metadata(self, step: int) -> Dict[str, Any]:
+        with open(os.path.join(self.directory, f"step_{step:08d}", "manifest.json")) as f:
+            return json.load(f)
+
+    def restore(
+        self,
+        step: int,
+        abstract_state: Any,
+        shardings: Any,
+    ) -> Any:
+        """Rebuild ``abstract_state``'s tree with arrays sharded per
+        ``shardings`` — which may describe a completely different mesh than
+        the one that saved. Leaf matching is by tree-path key."""
+        step_dir = os.path.join(self.directory, f"step_{step:08d}")
+        manifest = self.metadata(step)
+        by_key = {leaf["key"]: leaf for leaf in manifest["leaves"]}
+
+        flat_abs = jax.tree_util.tree_flatten_with_path(abstract_state)
+        flat_shd = jax.tree_util.tree_flatten(shardings)[0]
+        leaves_abs, treedef = flat_abs
+        if len(flat_shd) != len(leaves_abs):
+            raise ValueError(
+                f"shardings tree has {len(flat_shd)} leaves, state has {len(leaves_abs)}"
+            )
+        out_leaves = []
+        for (path, abs_leaf), sharding_ in zip(leaves_abs, flat_shd):
+            key = _keystr(path)
+            if key not in by_key:
+                raise KeyError(f"checkpoint step {step} missing leaf {key}")
+            rec = by_key[key]
+            saved_shape = tuple(rec["shape"])
+            want_shape = tuple(abs_leaf.shape)
+            if saved_shape != want_shape:
+                raise ValueError(
+                    f"{key}: saved shape {saved_shape} != target {want_shape}"
+                )
+            dtype = np.dtype(rec["dtype"])
+            reader = _LeafReader(
+                os.path.join(step_dir, f"leaf_{rec['index']:05d}"), saved_shape, dtype
+            )
+            arr = jax.make_array_from_callback(
+                want_shape, sharding_, lambda idx, r=reader: r.read(idx)
+            )
+            if arr.dtype != abs_leaf.dtype:
+                arr = arr.astype(abs_leaf.dtype)
+            out_leaves.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+    # -------------------------------------------------------------------- gc
+    def _gc(self) -> None:
+        if jax.process_index() != 0:
+            return
+        steps = self.steps()
+        for old in steps[: -self.keep] if self.keep > 0 else []:
+            shutil.rmtree(
+                os.path.join(self.directory, f"step_{old:08d}"), ignore_errors=True
+            )

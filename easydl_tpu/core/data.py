@@ -1,0 +1,178 @@
+"""Host-side input pipeline feeding device buffers.
+
+The reference leaves data loading entirely unspecified (SURVEY.md §3.4); on
+TPU the pattern that matters is: each *process* produces its local slice of the
+global batch as numpy, ``jax.make_array_from_process_local_data`` assembles the
+global sharded array, and a small prefetch queue overlaps host step N+1 with
+device step N.
+
+Includes the synthetic datasets the five BASELINE configs need (image/MNIST,
+LM token streams, recommender click logs) so benchmarks run hermetically.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from easydl_tpu.core.mesh import batch_divisor
+
+
+@dataclass
+class DataSpec:
+    """Shapes/dtypes of one global batch (leaf name → (shape, dtype))."""
+
+    global_batch: int
+    leaves: Dict[str, Any]
+
+
+class SyntheticImages:
+    """Deterministic synthetic image classification stream (MNIST/ImageNet
+    stand-in: the BASELINE configs 1-2)."""
+
+    def __init__(self, global_batch: int, shape=(28, 28, 1), classes: int = 10, seed: int = 0):
+        self.global_batch = global_batch
+        self.shape = shape
+        self.classes = classes
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            yield {
+                "image": self._rng.standard_normal(
+                    (self.global_batch, *self.shape), dtype=np.float32
+                ),
+                "label": self._rng.integers(
+                    0, self.classes, (self.global_batch,), dtype=np.int32
+                ),
+            }
+
+
+class SyntheticTokens:
+    """LM token stream (BERT/GPT configs 3-4)."""
+
+    def __init__(self, global_batch: int, seq_len: int, vocab: int = 32000, seed: int = 0):
+        self.global_batch = global_batch
+        self.seq_len = seq_len
+        self.vocab = vocab
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            tokens = self._rng.integers(
+                0, self.vocab, (self.global_batch, self.seq_len + 1), dtype=np.int32
+            )
+            yield {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+
+class SyntheticClicks:
+    """Recommender click log: sparse categorical ids + dense features + label
+    (DeepFM/Wide&Deep, BASELINE config 5)."""
+
+    def __init__(
+        self,
+        global_batch: int,
+        num_sparse: int = 26,
+        num_dense: int = 13,
+        vocab: int = 1_000_000,
+        seed: int = 0,
+    ):
+        self.global_batch = global_batch
+        self.num_sparse = num_sparse
+        self.num_dense = num_dense
+        self.vocab = vocab
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            yield {
+                "sparse_ids": self._rng.integers(
+                    0, self.vocab, (self.global_batch, self.num_sparse), dtype=np.int64
+                ),
+                "dense": self._rng.standard_normal(
+                    (self.global_batch, self.num_dense), dtype=np.float32
+                ),
+                "label": self._rng.integers(
+                    0, 2, (self.global_batch,), dtype=np.int32
+                ).astype(np.float32),
+            }
+
+
+class ShardedLoader:
+    """Wraps a host-batch iterator; yields global device arrays batch-sharded
+    over the mesh's dp axes, with background prefetch.
+
+    The iterator must yield the full global batch per process in
+    single-process mode, or the per-process slice under multi-process JAX —
+    ``make_array_from_process_local_data`` handles both.
+    """
+
+    def __init__(
+        self,
+        source: Any,
+        mesh,
+        sharding=None,
+        prefetch: int = 2,
+        transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
+    ):
+        from easydl_tpu.core import sharding as shd
+
+        self.mesh = mesh
+        self.sharding = sharding if sharding is not None else shd.batch_sharding(mesh)
+        self._source = iter(source)
+        self._transform = transform
+        self._prefetch = max(prefetch, 0)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self._prefetch or 1)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        gb = getattr(source, "global_batch", None)
+        if gb is not None:
+            div = batch_divisor(mesh)
+            if gb % div:
+                raise ValueError(
+                    f"global_batch={gb} not divisible by mesh batch ways={div}"
+                )
+
+    def _device_put(self, host_batch: Dict[str, np.ndarray]) -> Any:
+        if self._transform:
+            host_batch = self._transform(host_batch)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(self.sharding, x),
+            host_batch,
+        )
+
+    def _worker(self) -> None:
+        try:
+            for host_batch in self._source:
+                if self._stop.is_set():
+                    return
+                self._queue.put(self._device_put(host_batch))
+        finally:
+            self._queue.put(None)  # sentinel: source exhausted
+
+    def __iter__(self) -> Iterator[Any]:
+        if self._prefetch == 0:
+            for host_batch in self._source:
+                yield self._device_put(host_batch)
+            return
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            yield item
+
+    def close(self) -> None:
+        self._stop.set()
+        # Drain so the worker's blocked put() can observe the stop flag.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass

@@ -1,0 +1,109 @@
+"""Device-mesh construction — the TPU-native substrate for every parallelism.
+
+The reference scales by adding/removing PS and worker *pods*
+(README.md:31-35); here the unit of scale is a chip in a
+``jax.sharding.Mesh``. One mesh with named axes expresses every strategy the
+framework supports — data (``dp``), fully-sharded data (``fsdp``), tensor
+(``tp``), sequence/context (``sp``), expert (``ep``) and pipeline (``pp``)
+parallelism — and GSPMD inserts the matching ICI/DCN collectives.
+
+Axis order puts ``tp``/``sp`` innermost so their collectives ride
+nearest-neighbour ICI links on real TPU topologies
+(``mesh_utils.create_device_mesh`` does the physical assignment).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+#: Canonical axis order, outermost (DCN-friendly) → innermost (ICI-hungry).
+AXES: Tuple[str, ...] = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+#: Axes a batch dimension is sharded over (pure data parallelism axes).
+BATCH_AXES: Tuple[str, ...] = ("dp", "fsdp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. Unset axes default to 1 and collapse away in the
+    physical mesh only if every axis is 1 (we keep all names so PartitionSpecs
+    stay valid regardless of shape)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep * self.pp
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        m = {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp, "ep": self.ep,
+             "sp": self.sp, "tp": self.tp}
+        return tuple(m[a] for a in AXES)
+
+    @classmethod
+    def from_world(
+        cls,
+        world: int,
+        *,
+        tp: int = 1,
+        sp: int = 1,
+        ep: int = 1,
+        pp: int = 1,
+        fsdp: int = 1,
+    ) -> "MeshSpec":
+        """Fill the ``dp`` axis with whatever ``world`` leaves after the model
+        axes — the elastic master uses this to rebuild the mesh at a new world
+        size without touching the model-parallel layout."""
+        denom = tp * sp * ep * pp * fsdp
+        if world % denom:
+            raise ValueError(
+                f"world={world} not divisible by tp*sp*ep*pp*fsdp={denom}"
+            )
+        return cls(dp=world // denom, fsdp=fsdp, tp=tp, sp=sp, ep=ep, pp=pp)
+
+    def describe(self) -> str:
+        parts = [f"{a}={s}" for a, s in zip(AXES, self.axis_sizes()) if s > 1]
+        return "x".join(parts) if parts else "single-device"
+
+
+def build_mesh(
+    spec: MeshSpec,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Materialise a :class:`MeshSpec` over real (or forced-CPU) devices.
+
+    On TPU, ``mesh_utils.create_device_mesh`` maps logical axes onto the
+    physical torus so innermost axes get contiguous ICI neighbours; elsewhere
+    (CPU tests) a plain reshape suffices.
+    """
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n = spec.size
+    if len(devices) < n:
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    devices = devices[:n]
+    shape = spec.axis_sizes()
+    if devices[0].platform == "tpu":
+        try:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ValueError, AssertionError):
+            dev_array = np.asarray(devices).reshape(shape)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def batch_divisor(mesh: Mesh) -> int:
+    """Number of ways the global batch is split (product of batch axes)."""
+    return math.prod(mesh.shape[a] for a in BATCH_AXES)

@@ -1,0 +1,8 @@
+"""Model zoo — JAX/Flax models covering the BASELINE configs.
+
+Reference anchor: the quickstart `model_zoo.iris.dnn_estimator`
+(docs/design/elastic-training-operator.md:37) and the BASELINE.json families:
+MLP, ResNet-50, BERT-base, GPT-2 345M, DeepFM/Wide&Deep.
+"""
+
+from easydl_tpu.models.registry import get_model, register_model, list_models  # noqa: F401

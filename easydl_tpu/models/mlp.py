@@ -1,0 +1,88 @@
+"""MLP classifier — BASELINE config 1 (the reference quickstart:
+``model_zoo.iris.dnn_estimator``, docs/design/elastic-training-operator.md:37,
+and "MNIST MLP" in BASELINE.json).
+
+Parameters carry logical axis names so the same model runs pure-DP, FSDP, or
+TP by changing sharding rules only.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from easydl_tpu.core.data import SyntheticImages
+from easydl_tpu.models.registry import ModelBundle, register_model
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 128)
+    classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for i, width in enumerate(self.features):
+            x = nn.Dense(
+                width,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "mlp")
+                ),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("mlp",)
+                ),
+                name=f"dense_{i}",
+            )(x)
+            x = nn.relu(x)
+        return nn.Dense(
+            self.classes,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("vocab",)
+            ),
+            name="head",
+        )(x)
+
+
+@register_model("mlp")
+def make_mlp(
+    input_shape=(28, 28, 1),
+    features=(128, 128),
+    classes: int = 10,
+) -> ModelBundle:
+    model = MLP(features=tuple(features), classes=classes)
+
+    def init_fn(rng):
+        x = jnp.zeros((1, *input_shape), jnp.float32)
+        return model.init(rng, x)["params"]
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["image"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        acc = (jnp.argmax(logits, -1) == batch["label"]).mean()
+        return loss, {"accuracy": acc}
+
+    def make_data(global_batch: int, seed: int = 0):
+        return SyntheticImages(global_batch, shape=input_shape, classes=classes, seed=seed)
+
+    return ModelBundle(
+        name="mlp",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_data=make_data,
+        eval_fn=loss_fn,
+        param_count_hint=int(
+            np.prod(input_shape) * features[0]
+            + sum(a * b for a, b in zip(features[:-1], features[1:]))
+            + features[-1] * classes
+        ),
+    )

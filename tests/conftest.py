@@ -7,10 +7,23 @@ import time of conftest (pytest imports conftest before test modules).
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# Keep XLA single-threaded enough to be stable in CI containers.
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Force, not setdefault: the image ships JAX_PLATFORMS=axon (TPU tunnel) in the
+# environment and a sitecustomize that registers the axon PJRT plugin; tests
+# must run on the forced-multi-device CPU platform regardless.
+# Appended (not prepended): XLA parses duplicate flags last-wins, so ours must
+# come after any copy inherited from the environment.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "0"
+
+# The image's sitecustomize registers the axon TPU plugin and pins
+# jax_platforms="axon,cpu" via jax.config — env vars alone don't win. Re-pin
+# to cpu before any backend initialises.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,9 @@
+"""Sanity: the test environment really presents >=8 CPU devices."""
+
+import jax
+
+
+def test_eight_cpu_devices(eight_devices):
+    assert len(eight_devices) == 8
+    assert all(d.platform == "cpu" for d in eight_devices)
+    assert jax.default_backend() == "cpu"
