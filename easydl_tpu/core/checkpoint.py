@@ -140,6 +140,16 @@ class CheckpointManager:
         ``async_save=False``). Call :meth:`wait` before donating buffers is
         NOT needed — the snapshot happens here, synchronously."""
         self.wait()
+        if step in self.steps():
+            # Already committed (e.g. quiesce landing on a periodic-save step).
+            log.info("step %d already checkpointed; skipping", step)
+            return
+        multiproc = jax.process_count() > 1
+        if multiproc and self.async_save:
+            # The commit barrier is a collective; collectives must run on the
+            # main thread alongside no other device work — force sync saves.
+            log.warning("multi-process run: forcing synchronous checkpoint save")
+            self.async_save = False
         leaves = jax.tree_util.tree_flatten_with_path(state)[0]
         snapshot = []  # (leaf_idx, keystr, global_shape, dtype, [(bounds, np.ndarray)])
         for i, (path, leaf) in enumerate(leaves):
@@ -163,6 +173,19 @@ class CheckpointManager:
             t0 = time.perf_counter()
             step_dir = os.path.join(self.directory, f"step_{step:08d}")
             tmp_dir = step_dir + f".tmp.{jax.process_index()}"
+            # A step_dir without COMMITTED is debris from an aborted save (we
+            # may be retraining through the same step after a restore): clear
+            # it so stale chunks can't mix into — or block — this commit.
+            if os.path.exists(step_dir) and not os.path.exists(
+                os.path.join(step_dir, _COMMITTED)
+            ):
+                if jax.process_index() == 0:
+                    log.warning("clearing aborted save at %s", step_dir)
+                    shutil.rmtree(step_dir, ignore_errors=True)
+                if multiproc:
+                    from jax.experimental import multihost_utils
+
+                    multihost_utils.sync_global_devices(f"easydl_ckpt_clean_{step}")
             os.makedirs(tmp_dir, exist_ok=True)
             manifest = {
                 "step": step,
@@ -196,6 +219,12 @@ class CheckpointManager:
                     else:
                         os.replace(src, dst)
                 shutil.rmtree(tmp_dir, ignore_errors=True)
+            if multiproc:
+                # Every process has renamed its chunks in; only then may the
+                # marker appear (restore treats COMMITTED as "all shards on disk").
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"easydl_ckpt_{step}")
             if jax.process_index() == 0:
                 with open(os.path.join(step_dir, _COMMITTED), "w") as f:
                     f.write(str(step))

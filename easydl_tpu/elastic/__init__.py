@@ -1,0 +1,10 @@
+"""Elastic runtime: master (rendezvous, plans, checkpoint coordination),
+per-host agents, and the training worker process.
+
+This fills the gap the reference leaves open (SURVEY.md §3.2: "the reference
+is silent on how running workers learn the world size changed"): a master-
+owned rendezvous over gRPC, with agents restarting worker processes across
+membership generations and checkpoint/reshard-restore carrying state.
+"""
+
+from easydl_tpu.elastic.membership import Rendezvous, AgentView, JobPhase  # noqa: F401

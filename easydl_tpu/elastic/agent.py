@@ -1,0 +1,263 @@
+"""Per-host worker agent: launches/supervises the training process and speaks
+the master's directive protocol.
+
+On a TPU VM this is the process the operator's pod entrypoint starts; it
+handles the host's preemption notice (GKE sends SIGTERM / metadata notice —
+here surfaced via :meth:`Agent.notify_preemption`, also the fault-injection
+hook, SURVEY.md §5.3) and restarts the worker across membership generations.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.rpc import RpcClient
+
+from easydl_tpu.elastic.master import MASTER_SERVICE
+
+log = get_logger("elastic", "agent")
+
+
+class Agent:
+    def __init__(
+        self,
+        agent_id: str,
+        master_address: str,
+        workdir: str,
+        slots: int = 1,
+        host: str = "localhost",
+        platform: str = "cpu",
+        heartbeat_interval: float = 0.3,
+        worker_argv: Optional[List[str]] = None,
+    ):
+        self.agent_id = agent_id
+        self.master_address = master_address
+        self.workdir = workdir
+        self.slots = slots
+        self.host = host
+        self.platform = platform
+        self.heartbeat_interval = heartbeat_interval
+        self.worker_argv = worker_argv or [
+            sys.executable, "-m", "easydl_tpu.elastic.worker"
+        ]
+        self.metrics_path = os.path.join(workdir, f"metrics-{agent_id}.jsonl")
+        self._proc: Optional[subprocess.Popen] = None
+        self._log_file = None
+        self._applied_gen = -1
+        self._state = "idle"
+        self._quiesce_sent = False
+        self._preempting = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._client: Optional[RpcClient] = None
+
+    # ------------------------------------------------------------------ control
+    def start(self) -> "Agent":
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float = 30.0) -> None:
+        if self._thread:
+            self._thread.join(timeout)
+
+    def notify_preemption(self) -> None:
+        """Simulates the cloud preemption notice (fault-injection hook)."""
+        self._preempting.set()
+
+    def kill_worker_hard(self) -> None:
+        """Fault injection: SIGKILL the worker with no notice."""
+        if self._proc and self._proc.poll() is None:
+            self._proc.kill()
+
+    @property
+    def worker_pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc and self._proc.poll() is None else None
+
+    # ------------------------------------------------------------------ loop
+    def run(self) -> None:
+        self._client = RpcClient(MASTER_SERVICE, self.master_address, timeout=10.0)
+        self._client.wait_ready(30.0)
+        directive = self._client.Register(
+            pb.RegisterRequest(
+                agent_id=self.agent_id,
+                host=self.host,
+                slots=self.slots,
+                preemption_notice="preempt" if self._preempting.is_set() else "",
+            )
+        )
+        while not self._stop.is_set():
+            self._apply(directive)
+            self._refresh_state()
+            if self._state == "shutdown":
+                break
+            time.sleep(self.heartbeat_interval)
+            metrics = self._read_metrics()
+            try:
+                directive = self._client.Heartbeat(
+                    pb.HeartbeatRequest(
+                        agent_id=self.agent_id,
+                        generation=self._applied_gen,
+                        state=self._state,
+                        step=int(metrics.get("step", 0)),
+                        metrics=pb.StepMetrics(
+                            step=int(metrics.get("step", 0)),
+                            step_time_s=float(metrics.get("step_time_s", 0.0)),
+                            samples_per_sec=float(metrics.get("samples_per_sec", 0.0)),
+                            loss=float(metrics.get("loss", 0.0)),
+                            world_size=int(metrics.get("world_size", 0)),
+                        ),
+                        preemption_notice="preempt" if self._preempting.is_set() else "",
+                        host=self.host,
+                        slots=self.slots,
+                    )
+                )
+            except Exception as e:
+                log.warning("%s: heartbeat failed: %s", self.agent_id, e)
+                time.sleep(self.heartbeat_interval)
+        self._terminate_worker(graceful=False)
+        if self._log_file is not None:
+            self._log_file.close()
+            self._log_file = None
+        if self._client:
+            self._client.close()
+        log.info("%s: agent exited", self.agent_id)
+
+    # ------------------------------------------------------------------ state
+    def _refresh_state(self) -> None:
+        if self._proc is None:
+            if self._state not in ("quiesced", "done"):
+                self._state = "idle"
+            return
+        code = self._proc.poll()
+        if code is None:
+            self._state = "running"
+            return
+        # Worker exited.
+        done_marker = os.path.join(self.workdir, "DONE")
+        if code == 0 and os.path.exists(done_marker):
+            self._state = "done"
+        elif code == 0 and self._quiesce_sent:
+            self._state = "quiesced"
+        else:
+            if self._state == "running":
+                log.warning("%s: worker exited unexpectedly (code %s)", self.agent_id, code)
+            self._state = "idle"
+        self._proc = None
+        self._quiesce_sent = False
+
+    def _apply(self, directive: pb.Directive) -> None:
+        kind = directive.kind
+        if kind == pb.DirectiveKind.RUN:
+            m = directive.membership
+            if self._applied_gen != m.generation or self._proc is None:
+                self._terminate_worker(graceful=False)
+                self._spawn(m)
+        elif kind == pb.DirectiveKind.QUIESCE:
+            if self._proc and self._proc.poll() is None and not self._quiesce_sent:
+                log.info("%s: quiescing worker (SIGUSR1)", self.agent_id)
+                self._proc.send_signal(signal.SIGUSR1)
+                self._quiesce_sent = True
+        elif kind == pb.DirectiveKind.KILL:
+            if self._proc and self._proc.poll() is None:
+                log.info("%s: killing worker", self.agent_id)
+                self._proc.kill()
+                self._proc.wait()
+        elif kind == pb.DirectiveKind.SHUTDOWN:
+            self._terminate_worker(graceful=True)
+            self._state = "shutdown"
+
+    def _spawn(self, m: pb.Membership) -> None:
+        rank = list(m.hosts).index(self.agent_id)
+        env = os.environ.copy()
+        env.update(
+            {
+                "EASYDL_RANK": str(rank),
+                "EASYDL_WORLD": str(m.world_size),
+                "EASYDL_COORD": m.coordinator,
+                "EASYDL_GEN": str(m.generation),
+                "EASYDL_WORKDIR": self.workdir,
+                "EASYDL_METRICS": self.metrics_path,
+            }
+        )
+        if self.platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""  # neutralise TPU plugin in subproc
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={self.slots}"
+            )
+        log_path = os.path.join(self.workdir, f"worker-{self.agent_id}.log")
+        if self._log_file is not None:
+            self._log_file.close()
+        self._log_file = open(log_path, "ab")
+        self._proc = subprocess.Popen(
+            self.worker_argv, env=env, stdout=self._log_file, stderr=self._log_file
+        )
+        self._applied_gen = m.generation
+        self._state = "running"
+        log.info(
+            "%s: spawned worker rank %d/%d gen %d (pid %d)",
+            self.agent_id, rank, m.world_size, m.generation, self._proc.pid,
+        )
+
+    def _terminate_worker(self, graceful: bool) -> None:
+        if self._proc and self._proc.poll() is None:
+            if graceful:
+                self._proc.terminate()
+                try:
+                    self._proc.wait(5.0)
+                except subprocess.TimeoutExpired:
+                    self._proc.kill()
+            else:
+                self._proc.kill()
+                self._proc.wait()
+        self._proc = None
+
+    def _read_metrics(self) -> Dict[str, Any]:
+        try:
+            with open(self.metrics_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 4096))
+                lines = f.read().decode(errors="replace").strip().splitlines()
+            return json.loads(lines[-1]) if lines else {}
+        except (OSError, json.JSONDecodeError, IndexError):
+            return {}
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import argparse
+
+    p = argparse.ArgumentParser(description="easydl_tpu host agent")
+    p.add_argument("--id", required=True)
+    p.add_argument("--master", required=True)
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--slots", type=int, default=1)
+    p.add_argument("--platform", default="cpu")
+    args = p.parse_args()
+    agent = Agent(
+        agent_id=args.id,
+        master_address=args.master,
+        workdir=args.workdir,
+        slots=args.slots,
+        platform=args.platform,
+    )
+    signal.signal(signal.SIGTERM, lambda *_: agent.notify_preemption())
+    agent.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,293 @@
+"""The job master: gRPC authority for rendezvous, plans, and job lifecycle.
+
+TPU-native counterpart of the reference's ElasticTrainer pod
+(docs/design/elastic-training-operator.md:103-114): it owns the resource plan
+loop (queries Brain, applies ResourcePlans) and — unlike the reference, which
+leaves it unspecified — the in-training membership protocol: agents register
+and heartbeat; directives drive quiesce/kill/run across generations.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from easydl_tpu.api.resource_plan import ResourcePlan
+from easydl_tpu.elastic.membership import Directive, JobPhase, Rendezvous
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.rpc import RpcClient, ServiceDef, serve
+
+log = get_logger("elastic", "master")
+
+MASTER_SERVICE = ServiceDef(
+    "easydl.Master",
+    {
+        "Register": (pb.RegisterRequest, pb.Directive),
+        "Heartbeat": (pb.HeartbeatRequest, pb.Directive),
+    },
+)
+
+_KIND_TO_PROTO = {
+    "noop": pb.DirectiveKind.NOOP,
+    "run": pb.DirectiveKind.RUN,
+    "quiesce": pb.DirectiveKind.QUIESCE,
+    "shutdown": pb.DirectiveKind.SHUTDOWN,
+    "kill": pb.DirectiveKind.KILL,
+}
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class _Servicer:
+    def __init__(self, master: "Master"):
+        self._m = master
+
+    def Register(self, req: pb.RegisterRequest, ctx) -> pb.Directive:
+        with self._m._lock:
+            d = self._m.rendezvous.register(
+                req.agent_id, req.host, req.slots, bool(req.preemption_notice)
+            )
+            return self._m._to_proto(d)
+
+    def Heartbeat(self, req: pb.HeartbeatRequest, ctx) -> pb.Directive:
+        with self._m._lock:
+            if req.agent_id not in self._m.rendezvous.agents and req.host:
+                # Master restarted: adopt the heartbeating agent.
+                log.info("auto-registering unknown agent %s (master restart?)",
+                         req.agent_id)
+                self._m.rendezvous.register(
+                    req.agent_id, req.host, req.slots,
+                    bool(req.preemption_notice),
+                )
+            d = self._m.rendezvous.heartbeat(
+                req.agent_id,
+                req.generation,
+                req.state,
+                step=req.step,
+                preempting=bool(req.preemption_notice),
+            )
+            if req.metrics.step_time_s > 0:
+                self._m._record_metrics(req.agent_id, req.metrics)
+            return self._m._to_proto(d)
+
+
+class Master:
+    """Runs the rendezvous over gRPC + background lost-agent ticking +
+    (optionally) the Brain plan-polling loop."""
+
+    def __init__(
+        self,
+        job_name: str,
+        workdir: str,
+        desired_workers: int = 1,
+        min_workers: int = 1,
+        heartbeat_timeout: float = 5.0,
+        worker_config: Optional[Dict[str, Any]] = None,
+        brain_address: Optional[str] = None,
+        brain_poll_interval: float = 2.0,
+        port: int = 0,
+    ):
+        self.job_name = job_name
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.rendezvous = Rendezvous(
+            desired_workers=desired_workers,
+            min_workers=min_workers,
+            heartbeat_timeout=heartbeat_timeout,
+            port_alloc=free_port,
+        )
+        self._lock = threading.RLock()
+        self._server = None
+        self._port = port
+        self._stop = threading.Event()
+        self._tick_thread: Optional[threading.Thread] = None
+        self._brain_thread: Optional[threading.Thread] = None
+        self.brain_address = brain_address
+        self.brain_poll_interval = brain_poll_interval
+        self.plan_version = 0
+        self.events: List[Dict[str, Any]] = []  # timeline for recovery metrics
+        self._last_metrics: Dict[str, pb.StepMetrics] = {}
+        self._metrics_q: "queue.Queue" = queue.Queue(maxsize=4)
+        self._reporter_thread: Optional[threading.Thread] = None
+        if worker_config is not None:
+            with open(os.path.join(workdir, "job.json"), "w") as f:
+                json.dump(worker_config, f)
+
+    # ------------------------------------------------------------------ server
+    @property
+    def address(self) -> str:
+        return f"localhost:{self._server.port}"
+
+    def start(self) -> "Master":
+        self._server = serve(MASTER_SERVICE, _Servicer(self), port=self._port)
+        self._tick_thread = threading.Thread(target=self._tick_loop, daemon=True)
+        self._tick_thread.start()
+        if self.brain_address:
+            self._brain_thread = threading.Thread(target=self._brain_loop, daemon=True)
+            self._brain_thread.start()
+            self._reporter_thread = threading.Thread(target=self._reporter_loop, daemon=True)
+            self._reporter_thread.start()
+        log.info("master for job %r on %s", self.job_name, self.address)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server:
+            self._server.stop()
+
+    def _tick_loop(self) -> None:
+        last_phase = None
+        while not self._stop.is_set():
+            with self._lock:
+                self.rendezvous.tick()
+                phase = self.rendezvous.phase
+                if phase != last_phase:
+                    self._event("phase", phase=phase.value,
+                                generation=self.rendezvous.generation)
+                    last_phase = phase
+            self._stop.wait(0.2)
+
+    # ------------------------------------------------------------------ plans
+    def apply_plan(self, plan: ResourcePlan) -> None:
+        """The reference's JobResource-update path
+        (docs/design/elastic-training-operator.md:110-114), applied directly
+        to the rendezvous."""
+        with self._lock:
+            if plan.version and plan.version <= self.plan_version:
+                return
+            self.plan_version = plan.version
+            workers = plan.replicas("worker")
+            if workers > 0:
+                self._event("plan", version=plan.version, workers=workers)
+                self.rendezvous.set_desired_workers(workers)
+
+    def _brain_loop(self) -> None:
+        from easydl_tpu.brain.service import BRAIN_SERVICE  # local import: optional dep
+
+        client = RpcClient(BRAIN_SERVICE, self.brain_address)
+        while not self._stop.is_set():
+            try:
+                resp = client.GetPlan(
+                    pb.PlanRequest(job_name=self.job_name, current_version=self.plan_version)
+                )
+                if resp.has_plan:
+                    from easydl_tpu.brain.convert import plan_from_proto
+
+                    self.apply_plan(plan_from_proto(resp.plan))
+            except Exception as e:  # Brain outage must not kill the job
+                log.warning("brain poll failed: %s", e)
+            self._stop.wait(self.brain_poll_interval)
+
+    # ------------------------------------------------------------------ misc
+    def _record_metrics(self, agent_id: str, m: pb.StepMetrics) -> None:
+        self._last_metrics[agent_id] = m
+        if self.brain_address and agent_id == (self.rendezvous.members[0] if self.rendezvous.members else None):
+            # Latest-wins queue drained by one reporter thread: a slow Brain
+            # drops stale samples instead of piling up threads/connections.
+            try:
+                self._metrics_q.put_nowait(m)
+            except queue.Full:
+                try:
+                    self._metrics_q.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    self._metrics_q.put_nowait(m)
+                except queue.Full:
+                    pass
+
+    def _reporter_loop(self) -> None:
+        from easydl_tpu.brain.service import BRAIN_SERVICE
+
+        client = RpcClient(BRAIN_SERVICE, self.brain_address, timeout=5.0)
+        while not self._stop.is_set():
+            try:
+                m = self._metrics_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                m.job_name = self.job_name
+                client.ReportMetrics(m)
+            except Exception as e:
+                log.debug("metrics report failed: %s", e)
+        client.close()
+
+    def _event(self, kind: str, **data: Any) -> None:
+        self.events.append({"t": time.time(), "kind": kind, **data})
+
+    def _to_proto(self, d: Directive) -> pb.Directive:
+        out = pb.Directive(kind=_KIND_TO_PROTO[d.kind])
+        if d.kind == "run":
+            out.membership.generation = d.generation
+            out.membership.world_size = d.world_size
+            out.membership.hosts.extend(d.hosts)
+            out.membership.coordinator = d.coordinator
+        return out
+
+    # ------------------------------------------------------------------ status
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            s = self.rendezvous.status()
+        s["plan_version"] = self.plan_version
+        s["job"] = self.job_name
+        return s
+
+    @property
+    def done(self) -> bool:
+        with self._lock:
+            return self.rendezvous.phase == JobPhase.DONE
+
+    def wait_done(self, timeout: float = 300.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.done:
+                return True
+            time.sleep(0.2)
+        return False
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import argparse
+
+    p = argparse.ArgumentParser(description="easydl_tpu job master")
+    p.add_argument("--job", required=True)
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--min-workers", type=int, default=1)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--brain", default=None)
+    p.add_argument("--worker-config", default=None, help="path to job.json")
+    args = p.parse_args()
+    cfg = None
+    if args.worker_config:
+        with open(args.worker_config) as f:
+            cfg = json.load(f)
+    m = Master(
+        job_name=args.job,
+        workdir=args.workdir,
+        desired_workers=args.workers,
+        min_workers=args.min_workers,
+        worker_config=cfg,
+        brain_address=args.brain,
+        port=args.port,
+    ).start()
+    print(json.dumps({"address": m.address}), flush=True)
+    try:
+        while not m.done:
+            time.sleep(1)
+    finally:
+        m.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,317 @@
+"""The elastic rendezvous state machine (pure logic, no IO).
+
+The reference's elasticity is pod-level reconciliation
+(docs/design/elastic-training-operator.md:97-101); the missing piece — how a
+*running* job absorbs a world-size change — is this FSM. XLA's compiled world
+is static (SURVEY.md §7 hard part 1), so membership changes are generations:
+
+  STABLE ──(plan change / member lost / preemption notice)──► DRAINING
+  DRAINING: planned → QUIESCE members (checkpoint at the exact step boundary:
+            zero lost work); unplanned (member died) → KILL members (restore
+            from the last periodic checkpoint)
+  all members idle/quiesced/lost ──► new membership, generation+1 ──► STABLE,
+            members get RUN(membership)
+
+Deterministic and synchronous: every external event is a method call that
+returns/updates per-agent directives; a driver (gRPC master) applies them.
+This makes the FSM replayable in unit tests (SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("elastic", "rendezvous")
+
+
+class JobPhase(Enum):
+    INIT = "init"        # waiting for the first agents
+    STABLE = "stable"    # a generation is running
+    DRAINING = "draining"  # stopping members before reshaping
+    DONE = "done"
+
+
+class AgentState(str, Enum):
+    IDLE = "idle"            # no worker process
+    RUNNING = "running"      # worker at current generation
+    QUIESCED = "quiesced"    # worker checkpointed and exited cleanly
+    DONE = "done"            # worker finished the job
+    LOST = "lost"            # heartbeat timeout
+
+
+@dataclass
+class AgentView:
+    agent_id: str
+    host: str
+    slots: int
+    state: AgentState = AgentState.IDLE
+    generation: int = -1
+    step: int = 0
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    preempting: bool = False
+
+
+@dataclass
+class Directive:
+    kind: str  # "noop" | "run" | "quiesce" | "kill" | "shutdown"
+    generation: int = 0
+    world_size: int = 0
+    hosts: Tuple[str, ...] = ()
+    coordinator: str = ""
+
+
+class Rendezvous:
+    """Master-side membership authority.
+
+    ``port_alloc`` supplies a fresh coordinator port per generation (the jax
+    coordination service can't be rebound on a stale port immediately).
+    """
+
+    def __init__(
+        self,
+        desired_workers: int = 1,
+        heartbeat_timeout: float = 10.0,
+        min_workers: int = 1,
+        port_alloc: Optional[Callable[[], int]] = None,
+    ):
+        self.desired_workers = desired_workers
+        self.min_workers = min_workers
+        self.heartbeat_timeout = heartbeat_timeout
+        self._port_alloc = port_alloc or (lambda: 0)
+        self.agents: Dict[str, AgentView] = {}
+        self.phase = JobPhase.INIT
+        self.generation = 0
+        self.members: List[str] = []
+        self._drain_planned = True
+        self._coordinator = ""
+
+    # ------------------------------------------------------------------ events
+    def register(self, agent_id: str, host: str, slots: int, preempting: bool = False) -> Directive:
+        a = self.agents.get(agent_id)
+        if a is None:
+            self.agents[agent_id] = AgentView(
+                agent_id=agent_id, host=host, slots=slots, preempting=preempting
+            )
+            log.info("agent %s registered (%d slots)%s", agent_id, slots,
+                     " [preempting]" if preempting else "")
+        else:
+            # Re-registration after agent restart: treat as fresh.
+            a.state = AgentState.IDLE
+            a.last_heartbeat = time.monotonic()
+            a.preempting = preempting
+        self._evaluate()
+        return self.directive_for(agent_id)
+
+    def heartbeat(
+        self,
+        agent_id: str,
+        generation: int,
+        state: str,
+        step: int = 0,
+        preempting: bool = False,
+    ) -> Directive:
+        a = self.agents.get(agent_id)
+        if a is None:
+            # Unknown agent (master restarted): ask it to register by NOOP —
+            # agents re-register when they see generation 0 noop repeatedly.
+            return Directive(kind="noop")
+        a.last_heartbeat = time.monotonic()
+        a.generation = generation
+        a.step = max(a.step, step)
+        if preempting and not a.preempting:
+            log.warning("agent %s reports preemption notice", agent_id)
+            a.preempting = True
+        if a.state != AgentState.LOST:
+            try:
+                a.state = AgentState(state)
+            except ValueError:
+                pass
+        self._evaluate()
+        return self.directive_for(agent_id)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Advance time: mark lost agents, re-evaluate."""
+        now = now if now is not None else time.monotonic()
+        for a in self.agents.values():
+            if a.state not in (AgentState.LOST, AgentState.DONE) and (
+                now - a.last_heartbeat > self.heartbeat_timeout
+            ):
+                log.warning("agent %s lost (no heartbeat for %.1fs)",
+                            a.agent_id, now - a.last_heartbeat)
+                a.state = AgentState.LOST
+        self._evaluate()
+
+    def set_desired_workers(self, n: int) -> None:
+        if n != self.desired_workers:
+            log.info("desired workers %d -> %d", self.desired_workers, n)
+            self.desired_workers = n
+            self._evaluate()
+
+    def shutdown(self) -> None:
+        self.phase = JobPhase.DONE
+        self._evaluate()
+
+    # ------------------------------------------------------------------ logic
+    def _healthy(self) -> List[AgentView]:
+        out = [
+            a for a in self.agents.values()
+            if a.state not in (AgentState.LOST, AgentState.DONE) and not a.preempting
+        ]
+        return sorted(out, key=lambda a: a.agent_id)
+
+    def _member_views(self) -> List[AgentView]:
+        return [self.agents[m] for m in self.members if m in self.agents]
+
+    def _target(self) -> List[str]:
+        """Next membership: keep current healthy members (stability — no
+        churn when an equivalent agent appears), fill the remainder from
+        standbys in id order."""
+        healthy_ids = [a.agent_id for a in self._healthy()]
+        keep = [m for m in self.members if m in healthy_ids]
+        extra = [i for i in healthy_ids if i not in keep]
+        return (keep + extra)[: self.desired_workers]
+
+    def _want_reshape(self) -> Tuple[bool, bool]:
+        """(reshape needed, planned?)"""
+        target = self._target()
+        if not self.members:
+            return (len(target) >= self.min_workers, True)
+        member_lost = any(
+            self.agents[m].state == AgentState.LOST
+            for m in self.members
+            if m in self.agents
+        )
+        if member_lost:
+            return True, False
+        # A member whose worker died (agent alive, reports idle at the current
+        # generation): peers are hung in collectives — unplanned reshape.
+        member_crashed = any(
+            self.agents[m].state == AgentState.IDLE
+            and self.agents[m].generation == self.generation
+            for m in self.members
+            if m in self.agents
+        )
+        if member_crashed:
+            return True, False
+        member_preempting = any(
+            self.agents[m].preempting for m in self.members if m in self.agents
+        )
+        if member_preempting:
+            # Planned: the notice arrives before the VM disappears — drain now.
+            return True, True
+        if set(target) != set(self.members) and len(target) >= self.min_workers:
+            return True, True
+        return False, True
+
+    def _evaluate(self) -> None:
+        # Run to a fixpoint: a single event can complete several transitions
+        # (e.g. STABLE -> DRAINING -> formed, when no member has started yet).
+        for _ in range(4):
+            before = (self.phase, self.generation, tuple(self.members))
+            self._evaluate_once()
+            if (self.phase, self.generation, tuple(self.members)) == before:
+                return
+
+    def _evaluate_once(self) -> None:
+        if self.phase == JobPhase.DONE:
+            return
+        if any(a.state == AgentState.DONE for a in self._member_views()):
+            log.info("job complete (worker reported done)")
+            self.phase = JobPhase.DONE
+            return
+
+        if self.phase in (JobPhase.INIT, JobPhase.STABLE):
+            need, planned = self._want_reshape()
+            if need:
+                self._drain_planned = planned
+                if self.members:
+                    log.info("reshaping (%s): draining %d members",
+                             "planned" if planned else "UNPLANNED", len(self.members))
+                    self.phase = JobPhase.DRAINING
+                else:
+                    self._form_generation()
+            return
+
+        if self.phase == JobPhase.DRAINING:
+            # Escalate a planned drain if a member dies mid-drain: survivors
+            # are stuck in the quiesce consensus waiting for the dead peer —
+            # graceful QUIESCE can never complete, switch them to KILL.
+            if self._drain_planned and any(
+                a.state == AgentState.LOST or
+                (a.state == AgentState.IDLE and a.generation == self.generation)
+                for a in self._member_views()
+            ):
+                log.warning("member died mid-drain; escalating QUIESCE -> KILL")
+                self._drain_planned = False
+            pending = [
+                a for a in self._member_views()
+                if a.state in (AgentState.RUNNING,)
+            ]
+            if not pending:
+                self._form_generation()
+
+    def _form_generation(self) -> None:
+        target = [self.agents[i] for i in self._target()]
+        if len(target) < self.min_workers:
+            log.warning("only %d healthy agents (< min %d); waiting",
+                        len(target), self.min_workers)
+            self.members = []
+            self.phase = JobPhase.INIT
+            return
+        self.generation += 1
+        self.members = [a.agent_id for a in target]
+        port = self._port_alloc()
+        self._coordinator = f"{target[0].host}:{port}"
+        self.phase = JobPhase.STABLE
+        log.info(
+            "generation %d: world=%d members=%s coordinator=%s",
+            self.generation, len(self.members), self.members, self._coordinator,
+        )
+
+    # -------------------------------------------------------------- directives
+    def directive_for(self, agent_id: str) -> Directive:
+        a = self.agents.get(agent_id)
+        if a is None:
+            return Directive(kind="noop")
+        if self.phase == JobPhase.DONE:
+            return Directive(kind="shutdown")
+        if self.phase == JobPhase.DRAINING:
+            if agent_id in self.members and a.state == AgentState.RUNNING:
+                return Directive(kind="quiesce" if self._drain_planned else "kill")
+            return Directive(kind="noop")
+        if self.phase == JobPhase.STABLE and agent_id in self.members:
+            if a.generation != self.generation or a.state in (
+                AgentState.IDLE, AgentState.QUIESCED
+            ):
+                return Directive(
+                    kind="run",
+                    generation=self.generation,
+                    world_size=len(self.members),
+                    hosts=tuple(self.members),
+                    coordinator=self._coordinator,
+                )
+            return Directive(kind="noop")
+        return Directive(kind="noop")
+
+    # ------------------------------------------------------------------ status
+    def status(self) -> Dict:
+        return {
+            "phase": self.phase.value,
+            "generation": self.generation,
+            "members": list(self.members),
+            "desired_workers": self.desired_workers,
+            "agents": {
+                a.agent_id: {
+                    "state": a.state.value,
+                    "gen": a.generation,
+                    "step": a.step,
+                    "preempting": a.preempting,
+                }
+                for a in self.agents.values()
+            },
+        }

@@ -1,0 +1,169 @@
+"""The training worker process — one per host, (re)launched by the agent for
+each membership generation.
+
+Lifecycle: join the jax.distributed group for this generation → build mesh
+over the (new) world → restore the latest committed checkpoint with
+resharding → train, appending step metrics for the agent → on SIGUSR1
+(quiesce) reach a step-boundary consensus with peers, checkpoint, exit 0.
+
+The quiesce consensus matters: SIGUSR1 lands on different hosts at slightly
+different times, but the checkpoint save is a collective — all ranks must
+enter it at the same step. A tiny ``process_allgather`` of the local flag each
+``sync_every`` steps makes the boundary agreement explicit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+
+_QUIESCE = {"flag": False}
+
+
+def _on_sigusr1(signum, frame) -> None:
+    _QUIESCE["flag"] = True
+
+
+def run_worker(env: Dict[str, str]) -> int:
+    # Install the quiesce handler FIRST: a SIGUSR1 arriving during the long
+    # jax import / distributed init must set the flag, not kill the process
+    # (default SIGUSR1 disposition is terminate).
+    signal.signal(signal.SIGUSR1, _on_sigusr1)
+    rank = int(env["EASYDL_RANK"])
+    world = int(env["EASYDL_WORLD"])
+    coordinator = env["EASYDL_COORD"]
+    generation = int(env["EASYDL_GEN"])
+    workdir = env["EASYDL_WORKDIR"]
+    metrics_path = env["EASYDL_METRICS"]
+
+    with open(os.path.join(workdir, "job.json")) as f:
+        cfg: Dict[str, Any] = json.load(f)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    if world > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world,
+            process_id=rank,
+        )
+    from jax.experimental import multihost_utils
+
+    import optax
+
+    from easydl_tpu.core import MeshSpec, Trainer, TrainConfig, build_mesh
+    from easydl_tpu.core.checkpoint import CheckpointManager
+    from easydl_tpu.models import get_model
+    from easydl_tpu.utils.logging import get_logger
+
+    log = get_logger("elastic", f"worker-r{rank}")
+
+    devices = jax.device_count()
+    mesh_axes = dict(cfg.get("mesh", {}))
+    mesh = build_mesh(MeshSpec.from_world(devices, **mesh_axes))
+    bundle = get_model(cfg["model"], **cfg.get("model_kwargs", {}))
+    global_batch = int(cfg.get("global_batch", 32))
+    trainer = Trainer(
+        init_fn=bundle.init_fn,
+        loss_fn=bundle.loss_fn,
+        optimizer=optax.adam(float(cfg.get("lr", 1e-3))),
+        config=TrainConfig(
+            global_batch=global_batch,
+            grad_accum=int(cfg.get("grad_accum", 1)),
+            seed=int(cfg.get("seed", 0)),
+        ),
+        mesh=mesh,
+    )
+    ckpt = CheckpointManager(os.path.join(workdir, "ckpt"), keep=3, async_save=False)
+
+    # Agree on the restore step (a marker committed between two processes'
+    # directory listings must not split the group).
+    local_latest = ckpt.latest_step()
+    latest = int(
+        multihost_utils.broadcast_one_to_all(
+            np.int32(-1 if local_latest is None else local_latest)
+        )
+    ) if world > 1 else (-1 if local_latest is None else local_latest)
+
+    if latest >= 0:
+        abstract, _, _ = trainer._abstract_state()
+        state = ckpt.restore(latest, abstract, trainer.state_shardings())
+        start_step = latest
+        log.info("gen %d: restored step %d onto world=%d (%d devices)",
+                 generation, latest, world, devices)
+    else:
+        state = trainer.init_state()
+        start_step = 0
+        log.info("gen %d: fresh init, world=%d (%d devices)", generation, world, devices)
+
+    total_steps = int(cfg.get("total_steps", 100))
+    ckpt_interval = int(cfg.get("ckpt_interval", 20))
+    sync_every = int(cfg.get("sync_every", 1))
+    per_process_batch = global_batch // max(world, 1)
+    data = iter(bundle.make_data(per_process_batch, seed=int(cfg.get("seed", 0)) + rank))
+
+    def append_metrics(step: int, loss: float, dt: float) -> None:
+        rec = {
+            "step": step,
+            "loss": loss,
+            "step_time_s": dt,
+            "samples_per_sec": (global_batch / dt) if dt > 0 else 0.0,
+            "world_size": devices,
+            "generation": generation,
+            "t": time.time(),
+        }
+        with open(metrics_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    step = start_step
+    while step < total_steps:
+        # Quiesce consensus at the step boundary. Multi-process workers may
+        # only act on the *agreed* flag (acting on the local flag alone would
+        # leave peers hanging in the next collective).
+        want_quiesce = _QUIESCE["flag"]
+        if world > 1:
+            if step % sync_every == 0:
+                flags = multihost_utils.process_allgather(
+                    np.asarray([1 if want_quiesce else 0], np.int32)
+                )
+                want_quiesce = bool(np.asarray(flags).sum() > 0)
+            else:
+                want_quiesce = False
+        if want_quiesce:
+            log.info("gen %d: quiescing at step %d", generation, step)
+            ckpt.save(step, state)  # no-op if this step is already committed
+            return 0
+
+        t0 = time.perf_counter()
+        state, metrics = trainer.train_step(state, next(data))
+        loss = float(metrics["loss"])  # blocks: real step time
+        dt = time.perf_counter() - t0
+        step += 1
+        append_metrics(step, loss, dt)
+
+        if ckpt_interval > 0 and step % ckpt_interval == 0 and step < total_steps:
+            ckpt.save(step, state)
+
+    ckpt.save(total_steps, state)
+    if rank == 0:
+        with open(os.path.join(workdir, "DONE"), "w") as f:
+            f.write(str(total_steps))
+    log.info("gen %d: job complete at step %d", generation, total_steps)
+    return 0
+
+
+def main() -> None:
+    sys.exit(run_worker(dict(os.environ)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+"""Simulated-distributed elastic tests (SURVEY.md §4 item 2): real master
+(gRPC), real agents (threads), real worker subprocesses running
+jax.distributed over CPU with forced device counts.
+
+Covers the full elastic paths the reference promises but never specifies:
+scale-up mid-run (README.md:31-35), worker preemption recovery
+(README.md:25-29), and checkpoint-carried membership changes.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from easydl_tpu.elastic.agent import Agent
+from easydl_tpu.elastic.master import Master
+
+JOB_CFG = {
+    "model": "mlp",
+    "model_kwargs": {"input_shape": [8, 8, 1], "features": [32, 32]},
+    "global_batch": 32,
+    "total_steps": 24,
+    "ckpt_interval": 4,
+    "lr": 0.01,
+    "seed": 0,
+}
+
+
+def wait_for(cond, timeout=120.0, interval=0.2, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+def read_metrics(workdir, agent_id):
+    path = os.path.join(workdir, f"metrics-{agent_id}.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture
+def workdir(tmp_path):
+    return str(tmp_path)
+
+
+def test_elastic_end_to_end_two_workers(workdir):
+    master = Master(
+        job_name="mnist-mlp",
+        workdir=workdir,
+        desired_workers=2,
+        min_workers=2,
+        worker_config=JOB_CFG,
+    ).start()
+    agents = [
+        Agent(f"a{i}", master.address, workdir, slots=2).start() for i in range(2)
+    ]
+    try:
+        assert master.wait_done(timeout=180), f"job did not finish: {master.status()}"
+        assert os.path.exists(os.path.join(workdir, "DONE"))
+        # both agents trained at generation 1, world 2 (4 devices)
+        m0 = read_metrics(workdir, "a0")
+        assert m0 and m0[-1]["step"] == JOB_CFG["total_steps"]
+        assert m0[-1]["world_size"] == 4
+        # checkpoints were taken and retained
+        ckpts = os.listdir(os.path.join(workdir, "ckpt"))
+        assert any(n.startswith("step_") for n in ckpts)
+    finally:
+        for a in agents:
+            a.stop()
+        master.stop()
+
+
+def test_scale_up_mid_run(workdir):
+    cfg = dict(JOB_CFG, total_steps=600, ckpt_interval=50, sync_every=5)
+    master = Master(
+        job_name="scale-up",
+        workdir=workdir,
+        desired_workers=1,
+        min_workers=1,
+        worker_config=cfg,
+    ).start()
+    agents = [
+        Agent(f"a{i}", master.address, workdir, slots=2).start() for i in range(2)
+    ]
+    try:
+        # One member running (whichever registered first), one standby.
+        def member_progressing():
+            st = master.status()
+            return st["members"] and any(
+                st["agents"][m]["step"] >= 5 for m in st["members"]
+            )
+
+        wait_for(member_progressing, desc="member worker to reach step 5")
+        assert master.status()["generation"] == 1
+
+        # Brain-style plan: scale workers 1 -> 2 (the JobResource-update path)
+        from easydl_tpu.api import ResourcePlan, RolePlan
+
+        plan = ResourcePlan(job_name="scale-up", version=1,
+                            roles={"worker": RolePlan(replicas=2)})
+        master.apply_plan(plan)
+
+        assert master.wait_done(timeout=240), f"stuck: {master.status()}"
+        st = master.status()
+        assert st["generation"] >= 2, st
+        # After the reshape, steps ran at world 2 (4 devices across 2 procs).
+        m = read_metrics(workdir, "a0") + read_metrics(workdir, "a1")
+        gen2 = [r for r in m if r["generation"] >= 2]
+        assert gen2 and all(r["world_size"] == 4 for r in gen2)
+        assert max(r["step"] for r in gen2) == cfg["total_steps"]
+        # Quiesce was graceful: training resumed exactly one step after the
+        # quiesce boundary (zero lost work).
+        gen1_last = max(r["step"] for r in m if r["generation"] == 1)
+        gen2_first = min(r["step"] for r in gen2)
+        assert gen2_first == gen1_last + 1, (gen1_last, gen2_first)
+    finally:
+        for a in agents:
+            a.stop()
+        master.stop()
+
+
+def test_preemption_kill_recovery(workdir):
+    cfg = dict(JOB_CFG, total_steps=30, ckpt_interval=3)
+    master = Master(
+        job_name="preempt",
+        workdir=workdir,
+        desired_workers=2,
+        min_workers=1,
+        heartbeat_timeout=2.0,
+        worker_config=cfg,
+    ).start()
+    a0 = Agent("a0", master.address, workdir, slots=2).start()
+    a1 = Agent("a1", master.address, workdir, slots=2).start()
+    try:
+        wait_for(
+            lambda: min(
+                master.status()["agents"].get("a0", {}).get("step", 0),
+                master.status()["agents"].get("a1", {}).get("step", 0),
+            ) >= 6,
+            desc="both workers past step 6",
+        )
+        # Hard preemption: kill a1's worker AND its agent (no notice).
+        t_kill = time.monotonic()
+        a1.kill_worker_hard()
+        a1.stop()
+        # Master must detect, reshape to world 1, and finish the job.
+        assert master.wait_done(timeout=240), f"stuck: {master.status()}"
+        st = master.status()
+        assert st["generation"] >= 2
+        assert st["agents"]["a1"]["state"] in ("lost", "idle")
+        m0 = read_metrics(workdir, "a0")
+        assert m0[-1]["step"] == 30
+        # Recovery happened: the job finished in a generation without a1
+        # (intermediate generations may briefly include a1 — its agent can
+        # report the crash before going silent; that's two-phase recovery).
+        final_gen = st["generation"]
+        final = [r for r in m0 if r["generation"] == final_gen]
+        assert final and all(r["world_size"] == 2 for r in final)
+        # Lost work bounded by ckpt_interval: recovery resumed within interval
+        merged = m0 + read_metrics(workdir, "a1")
+        pre_last = max(r["step"] for r in merged if r["generation"] < final_gen)
+        resumed_first = min(r["step"] for r in final)
+        assert resumed_first >= pre_last - cfg["ckpt_interval"]
+        recovery_s = time.monotonic() - t_kill
+        print(f"preemption recovery (kill -> job done path resumed): {recovery_s:.1f}s")
+    finally:
+        a0.stop()
+        a1.stop()
+        master.stop()

@@ -1,0 +1,84 @@
+"""Regression tests for review findings: drain escalation, checkpoint
+double-save/aborted-save handling, master-restart agent adoption."""
+
+import itertools
+import os
+
+import optax
+
+from easydl_tpu.core import MeshSpec, Trainer, TrainConfig, build_mesh
+from easydl_tpu.core.checkpoint import CheckpointManager
+from easydl_tpu.elastic.master import Master
+from easydl_tpu.elastic.membership import Rendezvous
+from easydl_tpu.models import get_model
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.rpc import RpcClient
+from easydl_tpu.elastic.master import MASTER_SERVICE
+
+ports = itertools.count(9500)
+
+
+def test_member_death_mid_planned_drain_escalates_to_kill():
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports))
+    for a in ("a0", "a1"):
+        rdv.register(a, "h", 2)
+    for a in ("a0", "a1"):
+        d = rdv.directive_for(a)
+        if d.kind == "run":
+            rdv.heartbeat(a, d.generation, "running")
+    gen = rdv.generation
+    # planned drain begins (scale 2 -> 1)
+    rdv.set_desired_workers(1)
+    assert rdv.directive_for("a0").kind == "quiesce"
+    # a1 dies before reaching its quiesce boundary
+    rdv.agents["a1"].last_heartbeat -= 100.0
+    rdv.tick()
+    # survivors must be escalated to KILL, not left waiting on the dead peer
+    assert rdv.directive_for("a0").kind == "kill"
+    rdv.heartbeat("a0", gen, "idle")
+    assert rdv.generation == gen + 1 and rdv.members == ["a0"]
+
+
+def test_checkpoint_double_save_is_noop(tmp_path, eight_devices):
+    bundle = get_model("mlp", input_shape=(8, 8, 1), features=(32, 32))
+    t = Trainer(bundle.init_fn, bundle.loss_fn, optax.adam(1e-2),
+                TrainConfig(global_batch=32), mesh=build_mesh(MeshSpec(dp=8)))
+    s = t.init_state()
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(7, s)
+    mgr.save(7, s)  # must not raise ENOTEMPTY / duplicate
+    assert mgr.steps() == [7]
+
+
+def test_checkpoint_aborted_save_is_cleared(tmp_path, eight_devices):
+    bundle = get_model("mlp", input_shape=(8, 8, 1), features=(32, 32))
+    t = Trainer(bundle.init_fn, bundle.loss_fn, optax.adam(1e-2),
+                TrainConfig(global_batch=32), mesh=build_mesh(MeshSpec(dp=8)))
+    s = t.init_state()
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    # Simulate a crash mid-save: step dir with junk, no COMMITTED marker.
+    debris = tmp_path / "step_00000003" / "leaf_00000"
+    os.makedirs(debris)
+    (debris / "0-999.npy").write_bytes(b"garbage")
+    mgr.save(3, s)  # must clear debris and commit cleanly
+    assert mgr.steps() == [3]
+    abstract, _, _ = t._abstract_state()
+    restored = mgr.restore(3, abstract, t.state_shardings())
+    assert restored is not None
+
+
+def test_master_adopts_unknown_heartbeat(tmp_path):
+    master = Master(job_name="adopt", workdir=str(tmp_path), desired_workers=1).start()
+    try:
+        client = RpcClient(MASTER_SERVICE, master.address)
+        client.wait_ready()
+        # Heartbeat from an agent the (restarted) master has never seen.
+        d = client.Heartbeat(pb.HeartbeatRequest(
+            agent_id="ghost", generation=5, state="running", host="h9", slots=4,
+        ))
+        assert "ghost" in master.rendezvous.agents
+        # The adopted agent is re-formed into a fresh generation.
+        assert master.rendezvous.members == ["ghost"]
+        client.close()
+    finally:
+        master.stop()

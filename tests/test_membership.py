@@ -1,0 +1,141 @@
+"""Deterministic replay tests for the elastic rendezvous FSM
+(SURVEY.md §5.2: deterministic replay of the rendezvous state machine)."""
+
+import itertools
+
+from easydl_tpu.elastic.membership import AgentState, JobPhase, Rendezvous
+
+ports = itertools.count(9000)
+
+
+def mk(desired=2, **kw):
+    return Rendezvous(desired_workers=desired, port_alloc=lambda: next(ports), **kw)
+
+
+def start_gen(rdv, agents):
+    """Register agents and walk them into RUNNING at the current generation."""
+    for a in agents:
+        rdv.register(a, host="localhost", slots=2)
+    for a in agents:
+        d = rdv.directive_for(a)
+        if d.kind == "run":
+            rdv.heartbeat(a, d.generation, "running")
+    return rdv.generation
+
+
+def test_initial_formation():
+    rdv = mk(desired=2)
+    d0 = rdv.register("a0", "h0", 2)
+    # only one agent, min_workers=1 -> forms immediately with world 1
+    assert d0.kind == "run" and d0.world_size == 1
+    rdv.heartbeat("a0", d0.generation, "running")
+    d1 = rdv.register("a1", "h1", 2)
+    # second agent arrives -> planned reshape to world 2
+    assert rdv.phase == JobPhase.DRAINING
+    assert rdv.directive_for("a0").kind == "quiesce"
+    rdv.heartbeat("a0", rdv.generation, "quiesced")
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == 2
+    d0 = rdv.directive_for("a0")
+    d1 = rdv.directive_for("a1")
+    assert d0.kind == d1.kind == "run"
+    assert d0.world_size == 2 and d0.hosts == ("a0", "a1")
+    assert d0.coordinator.startswith("h0:")
+
+
+def test_min_workers_gate():
+    rdv = mk(desired=4, min_workers=2)
+    d = rdv.register("a0", "h0", 2)
+    assert d.kind == "noop" and rdv.phase == JobPhase.INIT
+    d = rdv.register("a1", "h1", 2)
+    assert d.kind == "run" and d.world_size == 2
+
+
+def test_scale_up_via_plan():
+    rdv = mk(desired=2)
+    gen = start_gen(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)
+    assert rdv.phase == JobPhase.STABLE  # desired still 2: standby agent
+    assert rdv.directive_for("a2").kind == "noop"
+    rdv.set_desired_workers(3)
+    assert rdv.phase == JobPhase.DRAINING
+    for a in ("a0", "a1"):
+        assert rdv.directive_for(a).kind == "quiesce"
+        rdv.heartbeat(a, gen, "quiesced")
+    assert rdv.generation == gen + 1
+    d = rdv.directive_for("a2")
+    assert d.kind == "run" and d.world_size == 3
+
+
+def test_scale_down():
+    rdv = mk(desired=3)
+    gen = start_gen(rdv, ["a0", "a1", "a2"])
+    rdv.set_desired_workers(1)
+    for a in ("a0", "a1", "a2"):
+        if rdv.directive_for(a).kind == "quiesce":
+            rdv.heartbeat(a, gen, "quiesced")
+    assert rdv.generation == gen + 1
+    assert len(rdv.members) == 1
+    # the non-members stand by
+    standby = [a for a in ("a0", "a1", "a2") if a not in rdv.members]
+    assert all(rdv.directive_for(a).kind == "noop" for a in standby)
+
+
+def test_unplanned_member_loss():
+    rdv = mk(desired=2, heartbeat_timeout=0.0)
+    gen = start_gen(rdv, ["a0", "a1"])
+    # a1 stops heartbeating; tick() with timeout 0 marks everything stale —
+    # keep a0 fresh by heartbeating right after tick.
+    rdv.agents["a1"].last_heartbeat -= 100.0
+    rdv.heartbeat_timeout = 5.0
+    rdv.tick()
+    assert rdv.agents["a1"].state == AgentState.LOST
+    assert rdv.phase == JobPhase.DRAINING
+    # survivors get KILL (peers hung in collectives), not graceful quiesce
+    assert rdv.directive_for("a0").kind == "kill"
+    rdv.heartbeat("a0", gen, "idle")
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == gen + 1
+    d = rdv.directive_for("a0")
+    assert d.kind == "run" and d.world_size == 1 and d.hosts == ("a0",)
+
+
+def test_worker_crash_triggers_unplanned_reshape():
+    rdv = mk(desired=2)
+    gen = start_gen(rdv, ["a0", "a1"])
+    # a1's worker process dies; agent reports idle at the current generation
+    rdv.heartbeat("a1", gen, "idle")
+    assert rdv.phase == JobPhase.DRAINING
+    assert rdv.directive_for("a0").kind == "kill"
+    rdv.heartbeat("a0", gen, "idle")
+    # a1's agent is healthy -> rejoins the new generation
+    assert rdv.generation == gen + 1 and set(rdv.members) == {"a0", "a1"}
+
+
+def test_preemption_notice_drains_gracefully():
+    rdv = mk(desired=2)
+    gen = start_gen(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)  # standby replacement
+    rdv.heartbeat("a1", gen, "running", preempting=True)
+    assert rdv.phase == JobPhase.DRAINING
+    # planned drain: graceful quiesce, zero lost work
+    assert rdv.directive_for("a0").kind == "quiesce"
+    rdv.heartbeat("a0", gen, "quiesced")
+    rdv.heartbeat("a1", gen, "quiesced")
+    assert rdv.phase == JobPhase.STABLE
+    assert set(rdv.members) == {"a0", "a2"}  # preempting a1 excluded
+
+
+def test_done_propagates_shutdown():
+    rdv = mk(desired=1)
+    gen = start_gen(rdv, ["a0"])
+    rdv.heartbeat("a0", gen, "done")
+    assert rdv.phase == JobPhase.DONE
+    assert rdv.directive_for("a0").kind == "shutdown"
+
+
+def test_generation_run_directive_idempotent():
+    rdv = mk(desired=2)
+    gen = start_gen(rdv, ["a0", "a1"])
+    # running members get noop, not repeated run
+    assert rdv.directive_for("a0").kind == "noop"
+    status = rdv.status()
+    assert status["phase"] == "stable" and len(status["members"]) == 2
