@@ -1,0 +1,19 @@
+"""Brain — the resource-plan optimization service.
+
+The reference names Brain as its third component: "An optimization service to
+generate resources plans" (README.md:13) answering two query types from the
+trainer — a startup plan from job features and periodic re-plans from runtime
+performance (docs/design/elastic-training-operator.md:106-112). The TPU-native
+rebuild consumes XLA step-time metrics and plans in *chips* over pod slices.
+"""
+
+from easydl_tpu.brain.policy import Autoscaler, AutoscalerConfig, startup_plan
+from easydl_tpu.brain.service import BRAIN_SERVICE, Brain
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "startup_plan",
+    "BRAIN_SERVICE",
+    "Brain",
+]

@@ -1,0 +1,62 @@
+"""ResourcePlan/JobFeatures ↔ proto conversion.
+
+Keeps the wire layer (easydl.proto) and the CRD-compatible dataclasses
+(api/resource_plan.py) decoupled: Brain and the master exchange protos; the
+operator and users exchange YAML CRDs; both views are the same plan.
+"""
+
+from __future__ import annotations
+
+from easydl_tpu.api.job_spec import ResourceSpec, TpuSpec
+from easydl_tpu.api.resource_plan import ResourcePlan, ResourceUpdation, RolePlan
+from easydl_tpu.proto import easydl_pb2 as pb
+
+
+def _resource_to_proto(r: ResourceSpec) -> pb.ResourceSpec:
+    out = pb.ResourceSpec(cpu=r.cpu, memory=r.memory, disk=r.disk, gpu=r.gpu)
+    if r.tpu is not None:
+        out.tpu.type = r.tpu.type
+        out.tpu.chips = r.tpu.chips
+        out.tpu.topology = r.tpu.topology
+    return out
+
+
+def _resource_from_proto(p: pb.ResourceSpec) -> ResourceSpec:
+    tpu = None
+    if p.HasField("tpu"):
+        tpu = TpuSpec(type=p.tpu.type, chips=p.tpu.chips, topology=p.tpu.topology)
+    return ResourceSpec(
+        cpu=p.cpu, memory=p.memory, disk=p.disk, gpu=p.gpu, tpu=tpu
+    )
+
+
+def plan_to_proto(plan: ResourcePlan) -> pb.ResourcePlanProto:
+    out = pb.ResourcePlanProto(
+        name=plan.name, job_name=plan.job_name, version=plan.version
+    )
+    for role, rp in plan.roles.items():
+        out.roles[role].replicas = rp.replicas
+        out.roles[role].resource.CopyFrom(_resource_to_proto(rp.resource))
+    for u in plan.resource_updation:
+        entry = out.resource_updation.add()
+        entry.name = u.name
+        entry.resource.CopyFrom(_resource_to_proto(u.resource))
+    return out
+
+
+def plan_from_proto(p: pb.ResourcePlanProto) -> ResourcePlan:
+    return ResourcePlan(
+        name=p.name,
+        job_name=p.job_name,
+        roles={
+            role: RolePlan(
+                replicas=rp.replicas, resource=_resource_from_proto(rp.resource)
+            )
+            for role, rp in p.roles.items()
+        },
+        resource_updation=[
+            ResourceUpdation(name=u.name, resource=_resource_from_proto(u.resource))
+            for u in p.resource_updation
+        ],
+        version=p.version,
+    )

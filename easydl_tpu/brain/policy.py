@@ -1,0 +1,303 @@
+"""Brain decision logic: startup plans and the autoscaling policy.
+
+Pure functions/objects with an injectable clock — no IO, no gRPC — so the
+scale-decision loop is unit-testable and replayable (SURVEY.md §5.2). The
+service layer (brain/service.py) wires this to the wire protocol.
+
+The reference promises: "EasyDL can automatically configure the resources"
+at startup and "monitor the performance of a training job and dynamically
+adjust the resources" during it (README.md:19-23); the trainer queries
+startup resources once and new plans periodically
+(docs/design/elastic-training-operator.md:106-112). Plan quality — avoiding
+oscillation — is SURVEY.md §7 hard part 5; the damping here (cooldown,
+hysteresis band, remembered bad sizes, marginal-efficiency test) is the
+answer.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from easydl_tpu.api.job_spec import ResourceSpec, TpuSpec
+from easydl_tpu.api.resource_plan import ResourcePlan, RolePlan
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("brain", "policy")
+
+
+# ---------------------------------------------------------------------------
+# Startup plans (docs/design/elastic-training-operator.md:106-107)
+# ---------------------------------------------------------------------------
+
+#: Per model family: (startup worker replicas, chips per worker, PS replicas).
+#: Families match JobFeatures.model_family; sized for the five BASELINE
+#: configs (BASELINE.md) — e.g. the MNIST quickstart is 1 PS + 2 workers.
+_FAMILY_DEFAULTS: Dict[str, Tuple[int, int, int]] = {
+    "mlp": (2, 0, 1),       # quickstart: CPU workers + 1 PS
+    "resnet": (8, 1, 0),    # static 8-worker all-reduce DDP
+    "bert": (8, 1, 0),      # elastic DP on a v4 slice
+    "gpt": (8, 1, 0),       # starts at 8 chips; Brain may grow it to 32
+    "deepfm": (4, 1, 2),    # async PS for sparse tables + dense TPU workers
+    "widedeep": (4, 1, 2),
+}
+_DEFAULT = (2, 1, 0)
+
+#: Parameter-count escalation: huge models start wider regardless of family.
+_PARAMS_TO_MIN_WORKERS = (
+    (5_000_000_000, 32),
+    (1_000_000_000, 16),
+    (200_000_000, 8),
+)
+
+
+def startup_plan(features: pb.JobFeatures, version: int = 1) -> ResourcePlan:
+    """First resource plan from extracted job features.
+
+    Mirrors the trainer flow the reference specifies: "extracts features from
+    the job, and queries the startup resources from EasyDL Brain"
+    (docs/design/elastic-training-operator.md:106-107).
+    """
+    family = (features.model_family or "").lower()
+    workers, chips, ps = _FAMILY_DEFAULTS.get(family, _DEFAULT)
+    if features.uses_ps and ps == 0:
+        ps = 1
+    if not features.uses_ps:
+        ps = 0
+    for threshold, min_workers in _PARAMS_TO_MIN_WORKERS:
+        if features.model_params >= threshold:
+            workers = max(workers, min_workers)
+            break
+
+    tpu_type = features.accelerator.type or "v5e"
+    if features.accelerator.chips:
+        chips = max(chips, 1)
+
+    roles = {
+        "worker": RolePlan(
+            replicas=workers,
+            resource=ResourceSpec(
+                cpu=4.0,
+                memory=16384,
+                tpu=TpuSpec(type=tpu_type, chips=chips) if chips else None,
+            ),
+        ),
+    }
+    if ps:
+        roles["parameter_server"] = RolePlan(
+            replicas=ps, resource=ResourceSpec(cpu=8.0, memory=32768)
+        )
+    if features.uses_evaluator:
+        roles["evaluator"] = RolePlan(
+            replicas=1, resource=ResourceSpec(cpu=4.0, memory=8192)
+        )
+    plan = ResourcePlan(
+        name=f"{features.job_name}-plan",
+        job_name=features.job_name,
+        roles=roles,
+        version=version,
+    )
+    plan.validate()
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler (docs/design/elastic-training-operator.md:110-112)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AutoscalerConfig:
+    """Damped scale policy knobs.
+
+    The decision loop doubles the worker count while scaling stays efficient
+    and retreats when marginal efficiency collapses — the north-star shape
+    (8→32 chips with <5% throughput loss) climbs 8→16→32.
+    """
+
+    min_workers: int = 1
+    max_workers: int = 32
+    #: samples needed at the current size before any decision
+    min_samples: int = 5
+    #: seconds between scale decisions (cooldown against oscillation)
+    cooldown_s: float = 30.0
+    #: scale up only if measured efficiency at the current size is above this
+    #: (perfect linear scaling = 1.0)
+    scaleup_efficiency_floor: float = 0.80
+    #: after a scale-up, demand at least this marginal efficiency — otherwise
+    #: revert and remember the size as bad
+    marginal_efficiency_floor: float = 0.60
+    #: scale down when per-chip throughput is this far below the best seen
+    #: (the job shrank or stalled; fewer chips waste less)
+    scaledown_throughput_ratio: float = 0.35
+    #: growth factor per decision (2 ⇒ 8→16→32)
+    growth: int = 2
+    #: sliding window per world size
+    window: int = 20
+
+
+@dataclass
+class _SizeStats:
+    samples: Deque[float] = field(default_factory=lambda: deque(maxlen=64))
+
+    def add(self, samples_per_sec: float, window: int) -> None:
+        if self.samples.maxlen != window:
+            self.samples = deque(self.samples, maxlen=window)
+        self.samples.append(samples_per_sec)
+
+    @property
+    def count(self) -> int:
+        return len(self.samples)
+
+    @property
+    def throughput(self) -> float:
+        if not self.samples:
+            return 0.0
+        return sum(self.samples) / len(self.samples)
+
+
+class Autoscaler:
+    """Per-job damped scale decider.
+
+    Feed it :class:`pb.StepMetrics` via :meth:`observe`; ask :meth:`decide`
+    for a target worker count. Deterministic given the metric stream and the
+    injected ``clock``.
+    """
+
+    def __init__(
+        self,
+        config: Optional[AutoscalerConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or AutoscalerConfig()
+        self._clock = clock
+        self._per_size: Dict[int, _SizeStats] = {}
+        self._last_decision_t: float = -1e18
+        self._last_size: int = 0
+        #: best windowed per-chip rate ever observed (collapse detector baseline)
+        self._best_per_chip: float = 0.0
+        #: sizes that failed the marginal-efficiency test (don't retry them)
+        self._bad_sizes: set = set()
+        #: (from_size, to_size) of the last scale-up, for the marginal check
+        self._pending_check: Optional[Tuple[int, int]] = None
+
+    # ------------------------------------------------------------------ intake
+    def observe(self, m: pb.StepMetrics) -> None:
+        size = max(int(m.world_size), 1)
+        if m.samples_per_sec <= 0:
+            return
+        stats = self._per_size.setdefault(size, _SizeStats())
+        stats.add(m.samples_per_sec, self.config.window)
+        self._last_size = size
+        if stats.count >= self.config.min_samples:
+            self._best_per_chip = max(self._best_per_chip, stats.throughput / size)
+
+    # ---------------------------------------------------------------- decision
+    def _efficiency(self, size: int) -> Optional[float]:
+        """Throughput(size) / (size × best per-chip throughput at any smaller
+        size). 1.0 = perfectly linear vs the best small-size baseline."""
+        stats = self._per_size.get(size)
+        if not stats or stats.count < self.config.min_samples:
+            return None
+        base = [
+            (s, st.throughput / s)
+            for s, st in self._per_size.items()
+            if s < size and st.count >= self.config.min_samples
+        ]
+        if not base:
+            return None
+        best_per_chip = max(per_chip for _, per_chip in base)
+        if best_per_chip <= 0:
+            return None
+        return stats.throughput / (size * best_per_chip)
+
+    def decide(self, current_workers: int) -> int:
+        """Target worker count (== current to hold steady)."""
+        cfg = self.config
+        now = self._clock()
+        cur = max(current_workers, 1)
+        stats = self._per_size.get(cur)
+        if not stats or stats.count < cfg.min_samples:
+            return cur
+        if now - self._last_decision_t < cfg.cooldown_s:
+            return cur
+
+        # 1. Marginal-efficiency audit of the last scale-up.
+        if self._pending_check and self._pending_check[1] == cur:
+            frm, to = self._pending_check
+            eff = self._efficiency(to)
+            if eff is not None:
+                self._pending_check = None
+                if eff < cfg.marginal_efficiency_floor:
+                    log.warning(
+                        "scale-up %d→%d inefficient (eff=%.2f < %.2f); reverting",
+                        frm, to, eff, cfg.marginal_efficiency_floor,
+                    )
+                    self._bad_sizes.add(to)
+                    self._last_decision_t = now
+                    return frm
+
+        # 2. Scale down if we're far off the best per-chip rate ever seen.
+        per_chip = stats.throughput / cur
+        best_per_chip = self._best_per_chip
+        if (
+            cur > cfg.min_workers
+            and best_per_chip > 0
+            and per_chip < cfg.scaledown_throughput_ratio * best_per_chip
+        ):
+            target = max(cfg.min_workers, cur // cfg.growth)
+            if target != cur:
+                log.info(
+                    "scaling down %d→%d (per-chip %.1f « best %.1f)",
+                    cur, target, per_chip, best_per_chip,
+                )
+                self._last_decision_t = now
+                return target
+
+        # 3. Scale up while efficient.
+        target = min(cur * cfg.growth, cfg.max_workers)
+        if target > cur and target not in self._bad_sizes:
+            eff = self._efficiency(cur)
+            # At the smallest measured size there is no baseline: treat as
+            # efficient (the north-star run must leave 8 chips somehow) —
+            # provided the current rate is healthy vs the best ever seen.
+            if eff is None:
+                smaller = [s for s in self._per_size if s < cur]
+                if not smaller and per_chip >= cfg.scaleup_efficiency_floor * best_per_chip:
+                    eff = 1.0
+            if eff is not None and eff >= cfg.scaleup_efficiency_floor:
+                log.info("scaling up %d→%d (eff=%.2f)", cur, target, eff)
+                self._last_decision_t = now
+                self._pending_check = (cur, target)
+                return target
+
+        return cur
+
+    # ------------------------------------------------------------------ status
+    def status(self) -> Dict[str, object]:
+        return {
+            "sizes": {
+                s: {"n": st.count, "samples_per_sec": round(st.throughput, 2)}
+                for s, st in sorted(self._per_size.items())
+            },
+            "bad_sizes": sorted(self._bad_sizes),
+            "last_size": self._last_size,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Plan evolution
+# ---------------------------------------------------------------------------
+
+
+def replan(
+    prev: ResourcePlan,
+    target_workers: int,
+) -> Optional[ResourcePlan]:
+    """New plan if the target differs from ``prev`` (else None)."""
+    if prev.replicas("worker") == target_workers:
+        return None
+    return prev.with_role("worker", target_workers)

@@ -1,0 +1,165 @@
+"""Brain gRPC service: startup plans, periodic re-plans, metric ingestion.
+
+Wire-level realisation of the reference's Brain (README.md:13): the trainer
+"queries the startup resources from EasyDL Brain" once
+(docs/design/elastic-training-operator.md:106-107) and "quer[ies] new
+[re]sources plans periodically" (:110-112); here those are GetStartupPlan and
+GetPlan, and the runtime-performance input the reference implies
+(README.md:21-23) is an explicit ReportMetrics stream of XLA step timings.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from easydl_tpu.api.resource_plan import ResourcePlan
+from easydl_tpu.brain.convert import plan_from_proto, plan_to_proto
+from easydl_tpu.brain.policy import Autoscaler, AutoscalerConfig, replan, startup_plan
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.rpc import ServiceDef, serve
+
+log = get_logger("brain", "service")
+
+BRAIN_SERVICE = ServiceDef(
+    "easydl.Brain",
+    {
+        "GetStartupPlan": (pb.JobFeatures, pb.PlanResponse),
+        "GetPlan": (pb.PlanRequest, pb.PlanResponse),
+        "ReportMetrics": (pb.StepMetrics, pb.Ack),
+    },
+)
+
+
+class _JobState:
+    def __init__(self, autoscaler: Autoscaler):
+        self.autoscaler = autoscaler
+        self.plan: Optional[ResourcePlan] = None
+        self.last_metrics_t: float = 0.0
+
+
+class Brain:
+    """In-memory Brain: per-job autoscaler + latest plan, served over gRPC.
+
+    Also usable fully in-process (no server) via :meth:`startup_plan_for`,
+    :meth:`observe`, :meth:`current_plan` — the simulated-distributed tests
+    and the benchmarks drive it both ways.
+    """
+
+    def __init__(self, config: Optional[AutoscalerConfig] = None, clock=time.monotonic):
+        self._config = config or AutoscalerConfig()
+        self._clock = clock
+        self._jobs: Dict[str, _JobState] = {}
+        self._lock = threading.Lock()
+        self._server = None
+
+    # ------------------------------------------------------------------ core
+    def _job(self, name: str) -> _JobState:
+        st = self._jobs.get(name)
+        if st is None:
+            st = _JobState(Autoscaler(self._config, clock=self._clock))
+            self._jobs[name] = st
+        return st
+
+    def startup_plan_for(self, features: pb.JobFeatures) -> ResourcePlan:
+        with self._lock:
+            st = self._job(features.job_name)
+            if st.plan is None:
+                st.plan = startup_plan(features)
+                log.info(
+                    "startup plan for %r: %s",
+                    features.job_name,
+                    {r: rp.replicas for r, rp in st.plan.roles.items()},
+                )
+            return st.plan
+
+    def observe(self, m: pb.StepMetrics) -> None:
+        with self._lock:
+            st = self._job(m.job_name)
+            st.autoscaler.observe(m)
+            st.last_metrics_t = self._clock()
+            if st.plan is not None:
+                target = st.autoscaler.decide(st.plan.replicas("worker"))
+                new = replan(st.plan, target)
+                if new is not None:
+                    log.info(
+                        "re-plan for %r: workers %d→%d (v%d)",
+                        m.job_name, st.plan.replicas("worker"), target, new.version,
+                    )
+                    st.plan = new
+
+    def current_plan(self, job_name: str, newer_than: int = 0) -> Optional[ResourcePlan]:
+        with self._lock:
+            st = self._jobs.get(job_name)
+            if st is None or st.plan is None or st.plan.version <= newer_than:
+                return None
+            return st.plan
+
+    def set_plan(self, plan: ResourcePlan) -> None:
+        """Directly install a plan (the advanced-user JobResource path,
+        docs/design/elastic-training-operator.md:50-55)."""
+        with self._lock:
+            self._job(plan.job_name).plan = plan
+
+    # ------------------------------------------------------------------ rpc
+    def GetStartupPlan(self, req: pb.JobFeatures, ctx) -> pb.PlanResponse:
+        plan = self.startup_plan_for(req)
+        return pb.PlanResponse(has_plan=True, plan=plan_to_proto(plan))
+
+    def GetPlan(self, req: pb.PlanRequest, ctx) -> pb.PlanResponse:
+        plan = self.current_plan(req.job_name, newer_than=req.current_version)
+        if plan is None:
+            return pb.PlanResponse(has_plan=False)
+        return pb.PlanResponse(has_plan=True, plan=plan_to_proto(plan))
+
+    def ReportMetrics(self, req: pb.StepMetrics, ctx) -> pb.Ack:
+        self.observe(req)
+        return pb.Ack(ok=True)
+
+    # ------------------------------------------------------------------ server
+    def start(self, port: int = 0) -> "Brain":
+        self._server = serve(BRAIN_SERVICE, self, port=port)
+        log.info("brain serving on %s", self.address)
+        return self
+
+    @property
+    def address(self) -> str:
+        return f"localhost:{self._server.port}"
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.stop()
+
+    def status(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                name: {
+                    "plan_version": st.plan.version if st.plan else 0,
+                    "workers": st.plan.replicas("worker") if st.plan else 0,
+                    "autoscaler": st.autoscaler.status(),
+                }
+                for name, st in self._jobs.items()
+            }
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description="easydl_tpu Brain service")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--max-workers", type=int, default=32)
+    args = p.parse_args()
+    brain = Brain(AutoscalerConfig(max_workers=args.max_workers)).start(args.port)
+    print(json.dumps({"address": brain.address}), flush=True)
+    try:
+        while True:
+            time.sleep(5)
+    except KeyboardInterrupt:
+        brain.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -140,11 +140,21 @@ class CheckpointManager:
         ``async_save=False``). Call :meth:`wait` before donating buffers is
         NOT needed — the snapshot happens here, synchronously."""
         self.wait()
-        if step in self.steps():
-            # Already committed (e.g. quiesce landing on a periodic-save step).
+        multiproc = jax.process_count() > 1
+        # Skip if already committed (e.g. quiesce landing on a periodic-save
+        # step). The decision must be COLLECTIVE: with per-process FS views
+        # (GCS/NFS lag) some ranks could skip while others enter the save's
+        # barriers and hang — so process 0's verdict is broadcast to all.
+        skip = step in self.steps()
+        if multiproc:
+            from jax.experimental import multihost_utils
+
+            skip = bool(
+                multihost_utils.broadcast_one_to_all(np.asarray(skip, np.int32))
+            )
+        if skip:
             log.info("step %d already checkpointed; skipping", step)
             return
-        multiproc = jax.process_count() > 1
         if multiproc and self.async_save:
             # The commit barrier is a collective; collectives must run on the
             # main thread alongside no other device work — force sync saves.
@@ -176,16 +186,19 @@ class CheckpointManager:
             # A step_dir without COMMITTED is debris from an aborted save (we
             # may be retraining through the same step after a restore): clear
             # it so stale chunks can't mix into — or block — this commit.
-            if os.path.exists(step_dir) and not os.path.exists(
-                os.path.join(step_dir, _COMMITTED)
+            # Process 0 decides and clears; the barrier is UNCONDITIONAL in
+            # multi-process runs so every rank enters the same collectives
+            # regardless of its local FS view.
+            if jax.process_index() == 0 and (
+                os.path.exists(step_dir)
+                and not os.path.exists(os.path.join(step_dir, _COMMITTED))
             ):
-                if jax.process_index() == 0:
-                    log.warning("clearing aborted save at %s", step_dir)
-                    shutil.rmtree(step_dir, ignore_errors=True)
-                if multiproc:
-                    from jax.experimental import multihost_utils
+                log.warning("clearing aborted save at %s", step_dir)
+                shutil.rmtree(step_dir, ignore_errors=True)
+            if multiproc:
+                from jax.experimental import multihost_utils
 
-                    multihost_utils.sync_global_devices(f"easydl_ckpt_clean_{step}")
+                multihost_utils.sync_global_devices(f"easydl_ckpt_clean_{step}")
             os.makedirs(tmp_dir, exist_ok=True)
             manifest = {
                 "step": step,

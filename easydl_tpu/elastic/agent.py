@@ -52,7 +52,9 @@ class Agent:
         self.metrics_path = os.path.join(workdir, f"metrics-{agent_id}.jsonl")
         self._proc: Optional[subprocess.Popen] = None
         self._log_file = None
+        self._exit0_deadline: Optional[float] = None
         self._applied_gen = -1
+        self._applied_key = (-1, "")
         self._state = "idle"
         self._quiesce_sent = False
         self._preempting = threading.Event()
@@ -138,12 +140,13 @@ class Agent:
     # ------------------------------------------------------------------ state
     def _refresh_state(self) -> None:
         if self._proc is None:
-            if self._state not in ("quiesced", "done"):
+            if self._state not in ("quiesced", "done", "shutdown"):
                 self._state = "idle"
             return
         code = self._proc.poll()
         if code is None:
             self._state = "running"
+            self._exit0_deadline = None
             return
         # Worker exited.
         done_marker = os.path.join(self.workdir, "DONE")
@@ -151,18 +154,36 @@ class Agent:
             self._state = "done"
         elif code == 0 and self._quiesce_sent:
             self._state = "quiesced"
+        elif code == 0 and not self._quiesce_sent:
+            # Clean exit with no DONE marker *yet*: on multi-host jobs rank 0
+            # (another host) may still be writing it. Reporting "idle" now
+            # would trigger a spurious unplanned reshape of a finished job —
+            # hold state briefly and re-check before classifying as a crash.
+            if self._exit0_deadline is None:
+                self._exit0_deadline = time.monotonic() + 2.0
+                return
+            if time.monotonic() < self._exit0_deadline:
+                return
+            log.warning("%s: worker exited 0 with no DONE marker", self.agent_id)
+            self._state = "idle"
         else:
             if self._state == "running":
                 log.warning("%s: worker exited unexpectedly (code %s)", self.agent_id, code)
             self._state = "idle"
         self._proc = None
         self._quiesce_sent = False
+        self._exit0_deadline = None
 
     def _apply(self, directive: pb.Directive) -> None:
         kind = directive.kind
         if kind == pb.DirectiveKind.RUN:
             m = directive.membership
-            if self._applied_gen != m.generation or self._proc is None:
+            # Spawn at most once per formed generation: if our worker exited,
+            # only the master may restart it (it always does so under a fresh
+            # generation — or, after a master restart, a fresh coordinator
+            # port). Re-applying a stale RUN while the master is unreachable
+            # would respawn-loop against a dead coordinator.
+            if self._applied_key != (m.generation, m.coordinator):
                 self._terminate_worker(graceful=False)
                 self._spawn(m)
         elif kind == pb.DirectiveKind.QUIESCE:
@@ -207,6 +228,7 @@ class Agent:
             self.worker_argv, env=env, stdout=self._log_file, stderr=self._log_file
         )
         self._applied_gen = m.generation
+        self._applied_key = (m.generation, m.coordinator)
         self._state = "running"
         log.info(
             "%s: spawned worker rank %d/%d gen %d (pid %d)",

@@ -238,6 +238,15 @@ class Master:
     def status(self) -> Dict[str, Any]:
         with self._lock:
             s = self.rendezvous.status()
+            s["metrics"] = {
+                aid: {
+                    "step": m.step,
+                    "step_time_s": round(m.step_time_s, 4),
+                    "samples_per_sec": round(m.samples_per_sec, 2),
+                    "loss": round(m.loss, 4),
+                }
+                for aid, m in self._last_metrics.items()
+            }
         s["plan_version"] = self.plan_version
         s["job"] = self.job_name
         return s

@@ -126,11 +126,15 @@ class Rendezvous:
         if preempting and not a.preempting:
             log.warning("agent %s reports preemption notice", agent_id)
             a.preempting = True
-        if a.state != AgentState.LOST:
-            try:
-                a.state = AgentState(state)
-            except ValueError:
-                pass
+        # A heartbeat proves liveness — this rehabilitates an agent previously
+        # marked LOST by a transient gap (it rejoins as a standby; its stale
+        # worker, if any, is killed via directive_for).
+        if a.state == AgentState.LOST:
+            log.info("agent %s returned after being marked lost", agent_id)
+        try:
+            a.state = AgentState(state)
+        except ValueError:
+            pass
         self._evaluate()
         return self.directive_for(agent_id)
 
@@ -280,6 +284,17 @@ class Rendezvous:
             return Directive(kind="noop")
         if self.phase == JobPhase.DONE:
             return Directive(kind="shutdown")
+        # A non-member still running a worker is at a stale generation (e.g.
+        # it was dropped from membership while unreachable): that worker hangs
+        # in collectives against a dead coordinator — kill it so the host
+        # becomes a usable standby.
+        if (
+            agent_id not in self.members
+            and a.state == AgentState.RUNNING
+            and a.generation != 0
+            and (a.generation != self.generation or self.phase != JobPhase.STABLE)
+        ):
+            return Directive(kind="kill")
         if self.phase == JobPhase.DRAINING:
             if agent_id in self.members and a.state == AgentState.RUNNING:
                 return Directive(kind="quiesce" if self._drain_planned else "kill")

@@ -1,0 +1,233 @@
+"""Brain tests: startup-plan heuristics, the damped autoscaler (north-star
+8→16→32 climb, oscillation resistance, bad-size memory), and the gRPC service
+round trip — including a live master polling a live Brain.
+
+The reference specifies only Brain's two query types
+(docs/design/elastic-training-operator.md:106-112); the decision policy is
+this framework's own (SURVEY.md §7 hard part 5).
+"""
+
+import time
+
+from easydl_tpu.api import ResourcePlan, RolePlan
+from easydl_tpu.brain.convert import plan_from_proto, plan_to_proto
+from easydl_tpu.brain.policy import Autoscaler, AutoscalerConfig, startup_plan
+from easydl_tpu.brain.service import BRAIN_SERVICE, Brain
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.rpc import RpcClient
+
+
+def features(family="mlp", **kw):
+    f = pb.JobFeatures(job_name="j", model_family=family)
+    for k, v in kw.items():
+        setattr(f, k, v)
+    return f
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def metrics(world, sps, step=1):
+    return pb.StepMetrics(
+        job_name="j", step=step, world_size=world, samples_per_sec=sps,
+        step_time_s=0.1,
+    )
+
+
+# ---------------------------------------------------------------- startup plan
+
+def test_startup_plan_quickstart_mlp_matches_reference_shape():
+    # BASELINE config 1: "MNIST MLP, 1 PS + 2 CPU workers"
+    plan = startup_plan(features("mlp", uses_ps=True))
+    assert plan.replicas("worker") == 2
+    assert plan.replicas("parameter_server") == 1
+    assert plan.roles["worker"].resource.tpu is None  # CPU workers
+
+
+def test_startup_plan_resnet_ddp():
+    plan = startup_plan(features("resnet"))
+    assert plan.replicas("worker") == 8
+    assert plan.roles["worker"].resource.tpu.chips == 1
+    assert plan.replicas("parameter_server") == 0
+
+
+def test_startup_plan_scales_with_param_count():
+    plan = startup_plan(features("gpt", model_params=1_500_000_000))
+    assert plan.replicas("worker") >= 16
+
+
+def test_startup_plan_deepfm_has_ps():
+    plan = startup_plan(features("deepfm", uses_ps=True))
+    assert plan.replicas("parameter_server") >= 1
+    assert plan.replicas("worker") >= 1
+
+
+def test_startup_plan_evaluator():
+    plan = startup_plan(features("bert", uses_evaluator=True))
+    assert plan.replicas("evaluator") == 1
+
+
+# ---------------------------------------------------------------- autoscaler
+
+def feed(a, world, sps, n=6, step0=0):
+    for i in range(n):
+        a.observe(metrics(world, sps, step=step0 + i))
+
+
+def test_autoscaler_north_star_climb_8_to_32():
+    clock = FakeClock()
+    a = Autoscaler(AutoscalerConfig(max_workers=32, cooldown_s=10), clock)
+    feed(a, 8, 800.0)  # 100 samples/sec/chip
+    clock.advance(60)
+    assert a.decide(8) == 16  # no smaller baseline -> assumed efficient
+
+    feed(a, 16, 1550.0)  # ~97% efficiency vs 8-chip per-chip rate
+    clock.advance(60)
+    assert a.decide(16) == 32
+
+    feed(a, 32, 3000.0)  # ~94% marginal efficiency: keep it
+    clock.advance(60)
+    assert a.decide(32) == 32
+
+
+def test_autoscaler_reverts_inefficient_scaleup_and_remembers():
+    clock = FakeClock()
+    a = Autoscaler(AutoscalerConfig(max_workers=32, cooldown_s=10), clock)
+    feed(a, 8, 800.0)
+    clock.advance(60)
+    assert a.decide(8) == 16
+
+    # 16 chips barely faster than 8: marginal efficiency ~0.53 < 0.60 floor.
+    feed(a, 16, 850.0)
+    clock.advance(60)
+    assert a.decide(16) == 8  # reverted
+
+    # Even with renewed good numbers at 8, it won't retry the bad size.
+    feed(a, 8, 800.0, n=10)
+    clock.advance(60)
+    assert a.decide(8) == 8
+    assert 16 in a.status()["bad_sizes"]
+
+
+def test_autoscaler_cooldown_prevents_oscillation():
+    clock = FakeClock()
+    a = Autoscaler(AutoscalerConfig(cooldown_s=30), clock)
+    feed(a, 8, 800.0)
+    clock.advance(60)
+    assert a.decide(8) == 16
+    feed(a, 16, 1550.0)
+    clock.advance(5)  # within cooldown
+    assert a.decide(16) == 16  # held despite good numbers
+
+
+def test_autoscaler_scales_down_on_throughput_collapse():
+    clock = FakeClock()
+    a = Autoscaler(AutoscalerConfig(cooldown_s=1), clock)
+    feed(a, 8, 800.0)
+    clock.advance(10)
+    # Collapse: per-chip rate drops to 20% of best.
+    feed(a, 8, 160.0, n=20)
+    clock.advance(10)
+    assert a.decide(8) == 4
+
+
+def test_autoscaler_needs_min_samples():
+    clock = FakeClock()
+    a = Autoscaler(AutoscalerConfig(min_samples=5), clock)
+    feed(a, 8, 800.0, n=3)
+    clock.advance(100)
+    assert a.decide(8) == 8  # not enough evidence
+
+
+# ---------------------------------------------------------------- conversion
+
+def test_plan_proto_roundtrip():
+    plan = startup_plan(features("deepfm", uses_ps=True, uses_evaluator=True))
+    plan2 = plan_from_proto(plan_to_proto(plan))
+    assert plan2.to_crd() == plan.to_crd()
+    assert plan2.version == plan.version
+
+
+# ---------------------------------------------------------------- service
+
+def test_brain_grpc_roundtrip():
+    brain = Brain().start()
+    try:
+        client = RpcClient(BRAIN_SERVICE, brain.address)
+        resp = client.GetStartupPlan(features("resnet"))
+        assert resp.has_plan and resp.plan.roles["worker"].replicas == 8
+
+        # No newer plan yet.
+        resp2 = client.GetPlan(pb.PlanRequest(job_name="j", current_version=resp.plan.version))
+        assert not resp2.has_plan
+
+        ack = client.ReportMetrics(metrics(8, 800.0))
+        assert ack.ok
+        client.close()
+    finally:
+        brain.stop()
+
+
+def test_brain_replans_from_metrics():
+    clock = FakeClock()
+    brain = Brain(AutoscalerConfig(cooldown_s=0.0, min_samples=3), clock=clock)
+    brain.set_plan(ResourcePlan(job_name="j", version=1,
+                                roles={"worker": RolePlan(replicas=8)}))
+    for i in range(5):
+        clock.advance(5)
+        brain.observe(metrics(8, 800.0, step=i))
+    plan = brain.current_plan("j", newer_than=1)
+    assert plan is not None and plan.replicas("worker") == 16
+    assert plan.version == 2
+
+
+def test_master_polls_brain_and_applies_plan():
+    """Full loop: master polls a live Brain over gRPC and applies the replan
+    to its rendezvous (docs/design/elastic-training-operator.md:110-114)."""
+    from easydl_tpu.elastic.master import Master
+
+    clock = FakeClock()
+    brain = Brain(AutoscalerConfig(cooldown_s=0.0, min_samples=3), clock=clock).start()
+    master = None
+    try:
+        brain.set_plan(ResourcePlan(job_name="poll-job", version=1,
+                                    roles={"worker": RolePlan(replicas=2)}))
+        master = Master(
+            job_name="poll-job",
+            workdir="/tmp/easydl-test-poll",
+            desired_workers=1,
+            brain_address=brain.address,
+            brain_poll_interval=0.1,
+        ).start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if master.rendezvous.desired_workers == 2:
+                break
+            time.sleep(0.05)
+        assert master.rendezvous.desired_workers == 2
+        assert master.plan_version == 1
+
+        # Metrics arrive at Brain -> replan -> master picks it up on next poll.
+        for i in range(5):
+            clock.advance(5)
+            brain.observe(pb.StepMetrics(job_name="poll-job", step=i,
+                                         world_size=2, samples_per_sec=100.0,
+                                         step_time_s=0.1))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if master.rendezvous.desired_workers == 4:
+                break
+            time.sleep(0.05)
+        assert master.rendezvous.desired_workers == 4
+    finally:
+        if master:
+            master.stop()
+        brain.stop()
