@@ -73,8 +73,9 @@ def startup_plan(features: pb.JobFeatures, version: int = 1) -> ResourcePlan:
             break
 
     tpu_type = features.accelerator.type or "v5e"
+    # accelerator.chips is the user's per-worker chip request; honor it.
     if features.accelerator.chips:
-        chips = max(chips, 1)
+        chips = max(chips, features.accelerator.chips)
 
     roles = {
         "worker": RolePlan(

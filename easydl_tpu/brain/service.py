@@ -80,15 +80,25 @@ class Brain:
             st = self._job(m.job_name)
             st.autoscaler.observe(m)
             st.last_metrics_t = self._clock()
-            if st.plan is not None:
-                target = st.autoscaler.decide(st.plan.replicas("worker"))
-                new = replan(st.plan, target)
-                if new is not None:
-                    log.info(
-                        "re-plan for %r: workers %d→%d (v%d)",
-                        m.job_name, st.plan.replicas("worker"), target, new.version,
-                    )
-                    st.plan = new
+            if st.plan is None or m.world_size <= 0:
+                return
+            # The autoscaler reasons in CHIPS (StepMetrics.world_size — the
+            # "8→32 chips" north star); the plan is in WORKER replicas.
+            # Convert via the observed chips-per-worker ratio.
+            cur_workers = st.plan.replicas("worker")
+            if cur_workers <= 0:
+                return
+            chips_per_worker = max(1, round(m.world_size / cur_workers))
+            target_chips = st.autoscaler.decide(int(m.world_size))
+            target_workers = max(1, target_chips // chips_per_worker)
+            new = replan(st.plan, target_workers)
+            if new is not None:
+                log.info(
+                    "re-plan for %r: workers %d→%d (%d→%d chips, v%d)",
+                    m.job_name, cur_workers, target_workers,
+                    m.world_size, target_chips, new.version,
+                )
+                st.plan = new
 
     def current_plan(self, job_name: str, newer_than: int = 0) -> Optional[ResourcePlan]:
         with self._lock:

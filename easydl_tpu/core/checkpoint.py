@@ -199,6 +199,12 @@ class CheckpointManager:
                 from jax.experimental import multihost_utils
 
                 multihost_utils.sync_global_devices(f"easydl_ckpt_clean_{step}")
+            # Our own tmp dir may hold chunks from a save that crashed mid-way
+            # (possibly under a different sharding); the commit loop moves
+            # every file in it, so start from a clean slate. Per-process dir —
+            # a local decision, no barrier needed.
+            if os.path.exists(tmp_dir):
+                shutil.rmtree(tmp_dir, ignore_errors=True)
             os.makedirs(tmp_dir, exist_ok=True)
             manifest = {
                 "step": step,

@@ -37,7 +37,9 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("conv_in", None),
     ("conv_out", "fsdp"),
     ("stage", "pp"),
+    ("layers", None),           # nn.scan'd block axis (stacked layer params)
     ("table", None),            # sparse embedding tables live on host PS
+    ("table_vocab", "fsdp"),    # on-device embedding tables: shard the vocab dim
 )
 
 

@@ -53,8 +53,7 @@ class Agent:
         self._proc: Optional[subprocess.Popen] = None
         self._log_file = None
         self._exit0_deadline: Optional[float] = None
-        self._applied_gen = -1
-        self._applied_key = (-1, "")
+        self._applied_key = (-1, "")  # (generation, coordinator) last spawned
         self._state = "idle"
         self._quiesce_sent = False
         self._preempting = threading.Event()
@@ -111,7 +110,7 @@ class Agent:
                 directive = self._client.Heartbeat(
                     pb.HeartbeatRequest(
                         agent_id=self.agent_id,
-                        generation=self._applied_gen,
+                        generation=self._applied_key[0],
                         state=self._state,
                         step=int(metrics.get("step", 0)),
                         metrics=pb.StepMetrics(
@@ -227,7 +226,6 @@ class Agent:
         self._proc = subprocess.Popen(
             self.worker_argv, env=env, stdout=self._log_file, stderr=self._log_file
         )
-        self._applied_gen = m.generation
         self._applied_key = (m.generation, m.coordinator)
         self._state = "running"
         log.info(

@@ -1,0 +1,81 @@
+"""BERT family — BASELINE config 3 ("BERT-base pretraining, elastic DP with
+one injected worker preemption").
+
+Masked-language-model pretraining on the bidirectional (non-causal)
+transformer stack. Masking is done *inside the jitted loss* from the step rng
+— no host-side preprocessing, fully deterministic given (seed, step), and the
+mask stays fused with the forward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from easydl_tpu.core.data import SyntheticTokens
+from easydl_tpu.models.registry import ModelBundle, register_model
+from easydl_tpu.models.transformer import Transformer, TransformerConfig
+
+#: name -> (n_layers, d_model, n_heads)
+SIZES: Dict[str, Tuple[int, int, int]] = {
+    "base": (12, 768, 12),
+    "large": (24, 1024, 16),
+    "test": (2, 128, 4),
+}
+
+MASK_ID = 0  # reserved [MASK] token id in the synthetic vocab
+
+
+@register_model("bert")
+def make_bert(
+    size: str = "base",
+    seq_len: int = 512,
+    vocab: int = 30720,  # 30522 padded up to a multiple of 128 for MXU tiling
+    mask_prob: float = 0.15,
+    remat: bool = False,
+    attention_impl: str = "auto",
+) -> ModelBundle:
+    n_layers, d_model, n_heads = SIZES[size]
+    cfg = TransformerConfig(
+        vocab=vocab,
+        d_model=d_model,
+        n_heads=n_heads,
+        n_layers=n_layers,
+        d_ff=4 * d_model,
+        max_seq=seq_len,
+        causal=False,
+        remat=remat,
+        attention_impl=attention_impl,
+        tied_head=True,
+    )
+    model = Transformer(cfg)
+
+    def init_fn(rng):
+        tokens = jnp.zeros((1, seq_len), jnp.int32)
+        return model.init(rng, tokens)["params"]
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["inputs"]
+        mask = jax.random.bernoulli(rng, mask_prob, tokens.shape)
+        masked = jnp.where(mask, MASK_ID, tokens)
+        logits = model.apply({"params": params}, masked).astype(jnp.float32)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, tokens)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = jnp.where(mask, losses, 0.0).sum() / denom
+        correct = jnp.where(mask, jnp.argmax(logits, -1) == tokens, False)
+        return loss, {"mlm_accuracy": correct.sum() / denom}
+
+    def make_data(global_batch: int, seed: int = 0):
+        return SyntheticTokens(global_batch, seq_len=seq_len, vocab=vocab, seed=seed)
+
+    return ModelBundle(
+        name=f"bert-{size}",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_data=make_data,
+        eval_fn=loss_fn,
+        param_count_hint=cfg.param_count,
+    )

@@ -1,0 +1,168 @@
+"""DeepFM / Wide&Deep recommenders — BASELINE config 5 ("async PS with sparse
+embedding tables").
+
+Two embedding placements, same model code:
+
+- ``embedding="device"`` — the table is a sharded on-device parameter
+  (logical axis ``table_vocab`` → ``fsdp``): the all-JAX path, best when the
+  table fits HBM.
+- ``embedding="ps"`` — the table lives on host parameter servers (the
+  reference's PS role, docs/design/elastic-training-operator.md:39-40); the
+  batch arrives with embeddings already pulled (``sparse_emb``) and gradients
+  flow back to the PS through the lookup's custom VJP
+  (easydl_tpu/ps/client.py). The TPU-side model is identical from the first
+  dense op on.
+
+DeepFM = FM second-order interactions + DNN over the same embeddings
+(wide&deep drops the FM term; both registered).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from easydl_tpu.core.data import SyntheticClicks
+from easydl_tpu.models.registry import ModelBundle, register_model
+
+
+class DeepFMDense(nn.Module):
+    """Everything after the embedding lookup: FM + deep tower.
+
+    Input ``emb``: [batch, fields, dim] embeddings, ``dense``: [batch, d]
+    continuous features.
+    """
+
+    hidden: Sequence[int] = (400, 400, 400)
+    use_fm: bool = True
+
+    @nn.compact
+    def __call__(self, emb, dense):
+        batch = emb.shape[0]
+        parts = []
+        # First-order/wide: per-field scalar weights on the embeddings.
+        wide = nn.Dense(
+            1,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (None, None)
+            ),
+            name="wide",
+        )(emb.reshape(batch, -1))
+        parts.append(wide)
+        if self.use_fm:
+            # FM second-order: 0.5 * ((Σv)² - Σv²), summed over dim.
+            sum_sq = jnp.square(emb.sum(axis=1))
+            sq_sum = jnp.square(emb).sum(axis=1)
+            fm = 0.5 * (sum_sq - sq_sum).sum(axis=-1, keepdims=True)
+            parts.append(fm)
+        # Deep tower over [embeddings ; dense features].
+        # Input dim is fields·dim + num_dense (ragged — not shardable), so the
+        # kernels shard only their output/"mlp" dim.
+        h = jnp.concatenate([emb.reshape(batch, -1), dense], axis=-1)
+        for i, width in enumerate(self.hidden):
+            h = nn.Dense(
+                width,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), (None, "mlp")
+                ),
+                name=f"deep_{i}",
+            )(h)
+            h = nn.relu(h)
+        deep = nn.Dense(
+            1,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("mlp", None)
+            ),
+            name="deep_out",
+        )(h)
+        parts.append(deep)
+        return sum(parts)[:, 0]  # logits [batch]
+
+
+class DeviceEmbedding(nn.Module):
+    """On-device embedding table, vocab-sharded via ``table_vocab``."""
+
+    vocab: int
+    dim: int
+
+    @nn.compact
+    def __call__(self, ids):
+        table = self.param(
+            "table",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.01), ("table_vocab", "embed")
+            ),
+            (self.vocab, self.dim),
+        )
+        return jnp.asarray(table)[ids]
+
+
+@register_model("deepfm")
+def make_deepfm(
+    num_sparse: int = 26,
+    num_dense: int = 13,
+    vocab: int = 1_000_000,
+    dim: int = 16,
+    hidden: Sequence[int] = (400, 400, 400),
+    use_fm: bool = True,
+    embedding: str = "device",
+) -> ModelBundle:
+    dense_model = DeepFMDense(hidden=tuple(hidden), use_fm=use_fm)
+    device_emb = DeviceEmbedding(vocab=vocab, dim=dim)
+
+    def init_fn(rng):
+        ids = jnp.zeros((1, num_sparse), jnp.int32)
+        dense = jnp.zeros((1, num_dense), jnp.float32)
+        if embedding == "device":
+            import jax
+
+            rng_e, rng_d = jax.random.split(rng)
+            emb_params = device_emb.init(rng_e, ids)["params"]
+            emb = device_emb.apply({"params": emb_params}, ids)
+            return {
+                "embedding": emb_params,
+                "dense": dense_model.init(rng_d, emb, dense)["params"],
+            }
+        emb = jnp.zeros((1, num_sparse, dim), jnp.float32)
+        return {"dense": dense_model.init(rng, emb, dense)["params"]}
+
+    def loss_fn(params, batch, rng):
+        if embedding == "device":
+            emb = device_emb.apply(
+                {"params": params["embedding"]}, batch["sparse_ids"]
+            )
+        else:
+            emb = batch["sparse_emb"]  # pulled from the host PS by the client
+        logits = dense_model.apply({"params": params["dense"]}, emb, batch["dense"])
+        logits = logits.astype(jnp.float32)
+        label = batch["label"]
+        loss = optax.sigmoid_binary_cross_entropy(logits, label).mean()
+        auc_proxy = ((logits > 0) == (label > 0.5)).mean()
+        return loss, {"accuracy": auc_proxy}
+
+    def make_data(global_batch: int, seed: int = 0):
+        return SyntheticClicks(
+            global_batch,
+            num_sparse=num_sparse,
+            num_dense=num_dense,
+            vocab=vocab,
+            seed=seed,
+        )
+
+    return ModelBundle(
+        name="deepfm" if use_fm else "widedeep",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_data=make_data,
+        eval_fn=loss_fn,
+        param_count_hint=vocab * dim,
+    )
+
+
+@register_model("widedeep")
+def make_widedeep(**kwargs) -> ModelBundle:
+    kwargs.setdefault("use_fm", False)
+    return make_deepfm(**kwargs)

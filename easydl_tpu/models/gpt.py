@@ -1,0 +1,87 @@
+"""GPT family — BASELINE config 4 ("GPT-2 345M data-parallel, Brain-driven
+autoscale 8→32 chips"). The flagship model for the driver's entry point.
+
+Sizes follow the GPT-2 paper naming; "345m" (a.k.a. GPT-2 medium:
+24 layers, d_model 1024, 16 heads) is the benchmark config. Vocab is padded
+to a multiple of 128 so the embedding/logits matmuls tile cleanly on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import optax
+
+from easydl_tpu.core.data import SyntheticTokens
+from easydl_tpu.models.registry import ModelBundle, register_model
+from easydl_tpu.models.transformer import Transformer, TransformerConfig
+
+#: name -> (n_layers, d_model, n_heads)
+SIZES: Dict[str, Tuple[int, int, int]] = {
+    "124m": (12, 768, 12),
+    "345m": (24, 1024, 16),
+    "762m": (36, 1280, 20),
+    "1558m": (48, 1600, 25),
+    # tiny sizes for tests/dryruns
+    "test": (2, 128, 4),
+}
+
+
+def lm_loss(logits, targets, ignore_id: int = -1):
+    """Mean next-token cross-entropy (fp32 accumulation)."""
+    logits = logits.astype(jnp.float32)
+    mask = (targets != ignore_id).astype(jnp.float32)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.maximum(targets, 0)
+    )
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (losses * mask).sum() / denom
+    return loss, denom
+
+
+@register_model("gpt")
+def make_gpt(
+    size: str = "345m",
+    seq_len: int = 1024,
+    vocab: int = 50304,
+    remat: bool = False,
+    attention_impl: str = "auto",
+    dropout: float = 0.0,
+) -> ModelBundle:
+    n_layers, d_model, n_heads = SIZES[size]
+    cfg = TransformerConfig(
+        vocab=vocab,
+        d_model=d_model,
+        n_heads=n_heads,
+        n_layers=n_layers,
+        d_ff=4 * d_model,
+        max_seq=seq_len,
+        causal=True,
+        dropout=dropout,
+        remat=remat,
+        attention_impl=attention_impl,
+        tied_head=True,
+    )
+    model = Transformer(cfg)
+
+    def init_fn(rng):
+        tokens = jnp.zeros((1, seq_len), jnp.int32)
+        return model.init(rng, tokens)["params"]
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["inputs"])
+        loss, _ = lm_loss(logits, batch["targets"])
+        return loss, {"perplexity": jnp.exp(loss)}
+
+    def make_data(global_batch: int, seed: int = 0):
+        return SyntheticTokens(global_batch, seq_len=seq_len, vocab=vocab, seed=seed)
+
+    return ModelBundle(
+        name=f"gpt-{size}",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_data=make_data,
+        eval_fn=loss_fn,
+        param_count_hint=cfg.param_count,
+    )

@@ -1,0 +1,164 @@
+"""ResNet family — BASELINE config 2 ("ResNet-50/ImageNet all-reduce DDP,
+static 8-worker job").
+
+TPU-first normalisation choice: **GroupNorm instead of BatchNorm.**
+BatchNorm's running statistics are mutable state that must be cross-replica
+synchronised every step (an extra collective, and state the elastic
+checkpoint/reshard path would have to carry); GroupNorm is stateless,
+batch-size independent (so resharding the batch over a new mesh never changes
+semantics), and within ~0.1% top-1 of BN on ResNet-50 at ImageNet scale.
+Convs stay NHWC (XLA's native TPU conv layout) and kernels carry
+``conv_in``/``conv_out`` logical axes for optional FSDP sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from easydl_tpu.core.data import SyntheticImages
+from easydl_tpu.models.registry import ModelBundle, register_model
+
+#: name -> (block counts, bottleneck?)
+SIZES = {
+    "18": ((2, 2, 2, 2), False),
+    "50": ((3, 4, 6, 3), True),
+    "101": ((3, 4, 23, 3), True),
+    "test": ((1, 1), False),
+}
+
+
+def _conv(features: int, kernel: Tuple[int, int], strides=1, name=None):
+    return nn.Conv(
+        features,
+        kernel,
+        strides=strides,
+        padding="SAME",
+        use_bias=False,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+            (None, None, "conv_in", "conv_out"),
+        ),
+        name=name,
+    )
+
+
+def _norm(name=None, groups: int = 32):
+    return nn.GroupNorm(
+        num_groups=groups,
+        scale_init=nn.with_logical_partitioning(
+            nn.initializers.ones_init(), ("conv_out",)
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), ("conv_out",)
+        ),
+        name=name,
+    )
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = _conv(self.features, (3, 3), self.strides, name="conv1")(x)
+        y = nn.relu(_norm(name="norm1")(y))
+        y = _conv(self.features, (3, 3), name="conv2")(y)
+        y = _norm(name="norm2")(y)
+        if residual.shape != y.shape:
+            residual = _conv(self.features, (1, 1), self.strides, name="proj")(x)
+            residual = _norm(name="norm_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = _conv(self.features, (1, 1), name="conv1")(x)
+        y = nn.relu(_norm(name="norm1")(y))
+        y = _conv(self.features, (3, 3), self.strides, name="conv2")(y)
+        y = nn.relu(_norm(name="norm2")(y))
+        y = _conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = _norm(name="norm3")(y)
+        if residual.shape != y.shape:
+            residual = _conv(self.features * 4, (1, 1), self.strides, name="proj")(x)
+            residual = _norm(name="norm_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    bottleneck: bool = True
+    classes: int = 1000
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        block = BottleneckBlock if self.bottleneck else BasicBlock
+        x = _conv(self.width, (7, 7), 2, name="stem")(x)
+        x = nn.relu(_norm(name="stem_norm")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = block(self.width * 2**i, strides, name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(
+            self.classes,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("embed", "vocab")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("vocab",)
+            ),
+            name="head",
+        )(x)
+
+
+@register_model("resnet")
+def make_resnet(
+    size: str = "50",
+    classes: int = 1000,
+    image_size: int = 224,
+    width: int = 64,
+) -> ModelBundle:
+    stage_sizes, bottleneck = SIZES[size]
+    model = ResNet(
+        stage_sizes=stage_sizes, bottleneck=bottleneck, classes=classes, width=width
+    )
+    input_shape = (image_size, image_size, 3)
+
+    def init_fn(rng):
+        x = jnp.zeros((1, *input_shape), jnp.float32)
+        return model.init(rng, x)["params"]
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["image"]).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        acc = (jnp.argmax(logits, -1) == batch["label"]).mean()
+        return loss, {"accuracy": acc}
+
+    def make_data(global_batch: int, seed: int = 0):
+        return SyntheticImages(
+            global_batch, shape=input_shape, classes=classes, seed=seed
+        )
+
+    return ModelBundle(
+        name=f"resnet-{size}",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_data=make_data,
+        eval_fn=loss_fn,
+        param_count_hint=25_600_000 if size == "50" else 0,
+    )

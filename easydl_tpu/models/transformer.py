@@ -1,0 +1,201 @@
+"""Shared transformer stack for the LM families (GPT-2, BERT).
+
+TPU-first choices:
+- every parameter carries logical axis names (``embed``/``heads``/``kv``/
+  ``mlp``/``vocab``) so one rule table retargets the model across DP, FSDP,
+  TP and SP meshes with zero model edits (core/sharding.py);
+- blocks run under ``nn.scan`` — one traced layer, XLA unrolls on device —
+  keeping compile time flat in depth; the scan axis is a logical ``layers``
+  axis (mapped to ``pp`` for pipeline-style stage sharding, or None);
+- optional ``nn.remat`` per block trades FLOPs for HBM (gradient
+  rematerialisation — the standard long-sequence memory lever);
+- attention goes through :func:`easydl_tpu.ops.multihead_attention` which
+  swaps in the Pallas flash kernel on TPU;
+- activations are annotated with ``nn.with_logical_constraint`` so GSPMD
+  shards the sequence dim over ``sp`` when sequence parallelism is on.
+
+The reference has no model code at all (SURVEY.md §0); these models exist to
+hit the BASELINE configs 3-4 (BERT-base, GPT-2 345M).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from easydl_tpu.ops import multihead_attention
+
+Init = nn.initializers.Initializer
+
+
+def _dense(
+    features,
+    kernel_axes,
+    bias_axes,
+    name=None,
+    use_bias=True,
+    init_scale=1.0,
+    axis=-1,
+):
+    return nn.DenseGeneral(
+        features,
+        axis=axis,
+        use_bias=use_bias,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02 * init_scale), kernel_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), bias_axes
+        ),
+        name=name,
+    )
+
+
+def _layernorm(name):
+    return nn.LayerNorm(
+        use_bias=True,
+        scale_init=nn.with_logical_partitioning(
+            nn.initializers.ones_init(), ("embed",)
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), ("embed",)
+        ),
+        name=name,
+    )
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 50304            # GPT-2 vocab padded to a multiple of 128 (MXU tiling)
+    d_model: int = 1024
+    n_heads: int = 16
+    n_layers: int = 24
+    d_ff: int = 4096
+    max_seq: int = 1024
+    causal: bool = True
+    dropout: float = 0.0
+    remat: bool = False
+    attention_impl: str = "auto"
+    #: tie the LM head to the token embedding (GPT-2 does)
+    tied_head: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def param_count(self) -> int:
+        per_block = (
+            4 * self.d_model * self.d_model      # qkv + out projections
+            + 2 * self.d_model * self.d_ff       # mlp in + out
+            + 4 * self.d_model                   # biases-ish + 2 LN
+        )
+        emb = self.vocab * self.d_model + self.max_seq * self.d_model
+        head = 0 if self.tied_head else self.vocab * self.d_model
+        return emb + self.n_layers * per_block + head
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block (attention + MLP).
+
+    Returns ``(x, None)`` — the (carry, per-step-output) pair ``nn.scan``
+    expects; standalone callers unpack the first element.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        # NB: ``deterministic`` is positional — nn.scan drops kwargs.
+        cfg = self.cfg
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        h = _layernorm("ln_attn")(x)
+        qkv_shape = (cfg.n_heads, cfg.head_dim)
+        q = _dense(qkv_shape, ("embed", "heads", "kv"), ("heads", "kv"), name="q")(h)
+        k = _dense(qkv_shape, ("embed", "heads", "kv"), ("heads", "kv"), name="k")(h)
+        v = _dense(qkv_shape, ("embed", "heads", "kv"), ("heads", "kv"), name="v")(h)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+        attn = multihead_attention(
+            q, k, v, causal=cfg.causal, impl=cfg.attention_impl
+        )
+        attn = _dense(
+            cfg.d_model,
+            ("heads", "kv", "embed"),
+            ("embed",),
+            name="out",
+            init_scale=(2 * cfg.n_layers) ** -0.5,  # GPT-2 residual scaling
+            axis=(-2, -1),
+        )(attn)
+        if cfg.dropout and not deterministic:
+            attn = nn.Dropout(cfg.dropout, deterministic=False)(attn)
+        x = x + attn
+
+        h = _layernorm("ln_mlp")(x)
+        h = _dense(cfg.d_ff, ("embed", "mlp"), ("mlp",), name="up")(h)
+        h = nn.gelu(h)
+        h = _dense(
+            cfg.d_model, ("mlp", "embed"), ("embed",), name="down",
+            init_scale=(2 * cfg.n_layers) ** -0.5,
+        )(h)
+        if cfg.dropout and not deterministic:
+            h = nn.Dropout(cfg.dropout, deterministic=False)(h)
+        x = x + h
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed")), None
+
+
+class Transformer(nn.Module):
+    """Token-in, logits-out decoder/encoder stack."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic: bool = True):
+        cfg = self.cfg
+        tok_emb = nn.Embed(
+            cfg.vocab,
+            cfg.d_model,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            name="tok_emb",
+        )
+        pos_emb = self.param(
+            "pos_emb",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.01), ("seq", "embed")
+            ),
+            (cfg.max_seq, cfg.d_model),
+        )
+        seq = tokens.shape[1]
+        x = tok_emb(tokens) + jnp.asarray(pos_emb)[None, :seq]
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, prevent_cse=False)
+        # One traced block, scanned over a stacked 'layers' param axis.
+        x, _ = nn.scan(
+            block_cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(nn.broadcast,),
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, name="blocks")(x, deterministic)
+
+        x = _layernorm("ln_f")(x)
+        if cfg.tied_head:
+            logits = tok_emb.attend(x)
+        else:
+            logits = _dense(
+                cfg.vocab, ("embed", "vocab"), (), name="head", use_bias=False
+            )(x)
+        return logits

@@ -1,0 +1,11 @@
+"""TPU compute ops: attention (reference + Pallas flash kernels), ring
+attention for sequence parallelism, and fused helpers.
+
+The reference anticipated CUDA kernels (`.cu` in lint scope,
+.pre-commit-config.yaml:31,40) but contains none; on TPU the equivalents are
+XLA-fused jnp code and Pallas kernels (SURVEY.md §2.1 item 5).
+"""
+
+from easydl_tpu.ops.attention import multihead_attention
+
+__all__ = ["multihead_attention"]

@@ -1,0 +1,80 @@
+"""Multi-head attention with swappable implementations.
+
+``impl="auto"`` picks the Pallas flash kernel on TPU (large HBM win: the
+[B,H,S,S] score matrix never materialises) and the XLA reference path
+elsewhere; models call :func:`multihead_attention` and never care which runs.
+
+Shapes follow the [batch, seq, heads, head_dim] convention throughout (the
+layout XLA prefers for TPU attention: contraction dims innermost).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    scale: float,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """XLA-fused reference path: einsum → mask → softmax → einsum.
+
+    fp32 softmax accumulation regardless of input dtype (bf16-safe).
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    if segment_ids is not None:
+        # segment_ids: [batch, seq] -> mask [batch, 1, q, k]
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.partial(
+    jax.named_call, name="multihead_attention"
+)
+def multihead_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Attention over [batch, seq, heads, head_dim] tensors.
+
+    Args:
+      impl: "auto" | "flash" (Pallas, TPU) | "reference" (XLA einsum).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if impl == "auto":
+        on_tpu = jax.devices()[0].platform == "tpu"
+        impl = "flash" if on_tpu else "reference"
+    if impl == "flash":
+        try:
+            from easydl_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(
+                q, k, v, causal=causal, scale=scale, segment_ids=segment_ids
+            )
+        except ImportError:
+            impl = "reference"
+    return _reference_attention(
+        q, k, v, causal=causal, scale=scale, segment_ids=segment_ids
+    )
