@@ -89,18 +89,28 @@ class SyntheticClicks:
         self._rng = np.random.default_rng(seed)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        # Labels carry deterministic structure so training has signal: each
+        # sparse id contributes a fixed hash-derived weight and dense features
+        # a fixed linear term — embeddings can memorise per-id weights and the
+        # dense tower the linear part. (Pure-noise labels would make every
+        # learns-something test and the config-5 bench meaningless.)
+        from easydl_tpu.ps.table import splitmix64
+
+        dense_w = np.linspace(-1.0, 1.0, self.num_dense).astype(np.float32)
         while True:
-            yield {
-                "sparse_ids": self._rng.integers(
-                    0, self.vocab, (self.global_batch, self.num_sparse), dtype=np.int64
-                ),
-                "dense": self._rng.standard_normal(
-                    (self.global_batch, self.num_dense), dtype=np.float32
-                ),
-                "label": self._rng.integers(
-                    0, 2, (self.global_batch,), dtype=np.int32
-                ).astype(np.float32),
-            }
+            ids = self._rng.integers(
+                0, self.vocab, (self.global_batch, self.num_sparse), dtype=np.int64
+            )
+            dense = self._rng.standard_normal(
+                (self.global_batch, self.num_dense), dtype=np.float32
+            )
+            id_w = (
+                (splitmix64(ids) >> np.uint64(40)).astype(np.float32)
+                / np.float32(16777216.0)
+            ) * 2.0 - 1.0  # per-id fixed weight in [-1, 1)
+            score = id_w.mean(axis=1) + 0.5 * (dense @ dense_w) / self.num_dense
+            label = (score > 0).astype(np.float32)
+            yield {"sparse_ids": ids, "dense": dense, "label": label}
 
 
 class ShardedLoader:

@@ -35,13 +35,31 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _pick_block(s: int, target: int) -> Optional[int]:
+    """Largest block <= target that divides s, preferring multiples of 128
+    (MXU/lane tiling). None when s can't be tiled — caller falls back to the
+    reference path."""
+    b = min(target, s)
+    if s % b == 0:
+        return b
+    if s % 128 == 0:
+        b -= b % 128
+        while b >= 128:
+            if s % b == 0:
+                return b
+            b -= 128
+    return None
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float, offset: int):
     # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S_k, d]
+    # offset = s_k - s_q: causal masking is bottom-right aligned (matches the
+    # reference path's tril(k=s_k-s_q) — row r attends cols <= r + offset).
     block_q, d = q_ref.shape[-2:]
     s_k = k_ref.shape[-2]
     q_idx = pl.program_id(1)
@@ -54,7 +72,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
     n_k = s_k // block_k
     if causal:
         # Only K-blocks at or before this Q-block's last row contribute.
-        n_k_live = jnp.minimum(((q_idx + 1) * block_q + block_k - 1) // block_k, n_k)
+        n_k_live = jnp.clip(
+            ((q_idx + 1) * block_q + offset + block_k - 1) // block_k, 0, n_k
+        )
     else:
         n_k_live = n_k
 
@@ -72,7 +92,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         correction = jnp.exp(m - m_new)
@@ -98,7 +118,7 @@ def _fwd(q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int, int
     block_k = min(block_k, s_k)
     assert s_q % block_q == 0 and s_k % block_k == 0, (s_q, s_k, block_q, block_k)
     kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, causal=causal, scale=scale
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale, offset=s_k - s_q
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -128,7 +148,7 @@ def _fwd(q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int, int
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_k: int, causal: bool, scale: float,
+    *, block_k: int, causal: bool, scale: float, offset: int,
 ):
     block_q, d = q_ref.shape[-2:]
     s_k = k_ref.shape[-2]
@@ -140,7 +160,9 @@ def _bwd_dq_kernel(
 
     n_k = s_k // block_k
     if causal:
-        n_k_live = jnp.minimum(((q_idx + 1) * block_q + block_k - 1) // block_k, n_k)
+        n_k_live = jnp.clip(
+            ((q_idx + 1) * block_q + offset + block_k - 1) // block_k, 0, n_k
+        )
     else:
         n_k_live = n_k
 
@@ -153,7 +175,7 @@ def _bwd_dq_kernel(
         if causal:
             rows = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -169,7 +191,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, causal: bool, scale: float,
+    *, block_q: int, causal: bool, scale: float, offset: int,
 ):
     block_k, d = dk_ref.shape[-2:]
     s_q = q_ref.shape[-2]
@@ -178,8 +200,11 @@ def _bwd_dkv_kernel(
     v = v_ref[...].reshape(block_k, d).astype(jnp.float32)
 
     n_q = s_q // block_q
-    # Q-blocks strictly before this K-block never attend to it (causal).
-    first_q = (k_idx * block_k) // block_q if causal else 0
+    # Q-blocks whose rows all satisfy row + offset < col never attend (causal).
+    if causal:
+        first_q = jnp.clip((k_idx * block_k - offset) // block_q, 0, n_q)
+    else:
+        first_q = 0
 
     def body(qb, carry):
         dk, dv = carry
@@ -193,7 +218,7 @@ def _bwd_dkv_kernel(
         if causal:
             rows = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = k_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)  # [block_q, block_k]
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -228,8 +253,11 @@ def _bwd(
         "bsd,bsd->bs", do.astype(jnp.float32), out.astype(jnp.float32)
     )[..., None]
 
+    offset = s_k - s_q
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
+        functools.partial(
+            _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale, offset=offset
+        ),
         grid=(bh, s_q // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi: (b, qi, 0)),
@@ -245,7 +273,9 @@ def _bwd(
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale, offset=offset
+        ),
         grid=(bh, s_k // block_k),
         in_specs=[
             pl.BlockSpec((1, s_q, d), lambda b, ki: (b, 0, 0)),
@@ -314,9 +344,15 @@ def flash_attention(
     block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Flash attention over [batch, seq, heads, head_dim] tensors."""
-    if segment_ids is not None:
-        # Kernel v1 doesn't fuse the segment mask; use the XLA path.
+    """Flash attention over [batch, seq, heads, head_dim] tensors.
+
+    Falls back to the XLA reference path when the kernel can't tile the
+    sequence lengths (no block divisor) or a segment mask is requested."""
+    b, s, h, d = q.shape
+    s_k = k.shape[1]
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s_k, block_k)
+    if segment_ids is not None or bq is None or bk is None:
         from easydl_tpu.ops.attention import _reference_attention
 
         return _reference_attention(
@@ -324,12 +360,11 @@ def flash_attention(
             scale=scale if scale is not None else q.shape[-1] ** -0.5,
             segment_ids=segment_ids,
         )
+    block_q, block_k = bq, bk
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = _interpret_default()
-    b, s, h, d = q.shape
-    s_k = k.shape[1]
     # [B, S, H, d] -> [B*H, S, d]
     def to_bh(x, sl):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, sl, d)

@@ -1,0 +1,17 @@
+"""Sparse parameter-server tier: host-resident embedding tables for
+recommender models (reference PS role,
+docs/design/elastic-training-operator.md:39-40; BASELINE config 5).
+
+C++ core (native/embedding_store.cc) + gRPC shards (server) + sharded client
+and jit-visible lookup (client) + the async-PS worker loop (trainer).
+"""
+
+from easydl_tpu.ps.client import (  # noqa: F401
+    LocalPsClient,
+    ShardedPsClient,
+    ps_lookup,
+    register_lookup,
+)
+from easydl_tpu.ps.server import PS_SERVICE, PsShard  # noqa: F401
+from easydl_tpu.ps.table import EmbeddingTable, TableSpec, shard_of  # noqa: F401
+from easydl_tpu.ps.trainer import PsTrainer, make_ps_model  # noqa: F401

@@ -1,0 +1,95 @@
+"""Compile-on-first-use build of the native embedding store.
+
+No pip/pybind11 in the image, so the C++ core
+(:file:`easydl_tpu/ps/native/embedding_store.cc`) is compiled with ``g++``
+into a shared library the first time it's needed and cached next to the
+source, keyed by a hash of the source + compile flags. Concurrent builders
+(e.g. pytest-xdist, multiple PS shards starting at once) race safely: the
+compile writes to a unique temp file and ``os.replace``\\ s it into place.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import Optional
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("ps", "build")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SOURCE = os.path.join(_NATIVE_DIR, "embedding_store.cc")
+_CXXFLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-Wall"]
+
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def _lib_path() -> str:
+    with open(_SOURCE, "rb") as f:
+        digest = hashlib.sha256(f.read() + " ".join(_CXXFLAGS).encode()).hexdigest()[:16]
+    return os.path.join(_NATIVE_DIR, "_build", f"embedding_store-{digest}.so")
+
+
+def _compile(target: str) -> None:
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(target))
+    os.close(fd)
+    try:
+        cmd = ["g++", *_CXXFLAGS, "-o", tmp, _SOURCE]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, target)  # atomic; last concurrent builder wins
+        log.info("compiled %s", os.path.basename(target))
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"g++ failed building embedding store:\n{e.stderr}") from e
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.eds_create.argtypes = [
+        ctypes.c_int, ctypes.c_float, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_float, ctypes.c_float,
+    ]
+    lib.eds_create.restype = ctypes.c_void_p
+    lib.eds_destroy.argtypes = [ctypes.c_void_p]
+    lib.eds_row_width.argtypes = [ctypes.c_void_p]
+    lib.eds_row_width.restype = ctypes.c_int
+    lib.eds_pull.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+    lib.eds_push.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p, ctypes.c_float]
+    lib.eds_size.argtypes = [ctypes.c_void_p]
+    lib.eds_size.restype = ctypes.c_int64
+    lib.eds_export.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
+    lib.eds_export.restype = ctypes.c_int64
+    lib.eds_import.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
+    return lib
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """The compiled library, or None when no C++ toolchain is available
+    (callers fall back to the numpy store)."""
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    if shutil.which("g++") is None:
+        _load_error = "g++ not found"
+        log.warning("no g++ in PATH — PS tables use the numpy fallback")
+        return None
+    try:
+        path = _lib_path()
+        if not os.path.exists(path):
+            _compile(path)
+        _lib = _bind(ctypes.CDLL(path))
+    except (RuntimeError, OSError) as e:
+        _load_error = str(e)
+        log.warning("native embedding store unavailable (%s) — numpy fallback", e)
+        return None
+    return _lib

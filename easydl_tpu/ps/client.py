@@ -1,0 +1,260 @@
+"""PS client: shard routing, pull/push, and the jit-visible lookup.
+
+Two transports behind one interface:
+
+- :class:`ShardedPsClient` — gRPC to N :class:`~easydl_tpu.ps.server.PsShard`
+  servers, ids routed by ``shard_of`` (splitmix64 hash), per-shard requests
+  issued concurrently.
+- :class:`LocalPsClient` — in-process shards, same routing math, zero RPC;
+  single-host runs and tests.
+
+:func:`ps_lookup` makes the PS visible *inside* a jitted step: forward pulls
+rows via ``jax.pure_callback``, and the custom VJP pushes gradients back via
+``jax.experimental.io_callback`` — so the reference's async PS pull/push hot
+loop (SURVEY.md §3.4) becomes two host callbacks flanking an XLA-compiled
+dense step. For multi-process meshes prefer the explicit
+:class:`~easydl_tpu.ps.trainer.PsTrainer` loop, where each process pulls only
+its local batch shard.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps.server import PS_SERVICE, PsShard, spec_to_proto
+from easydl_tpu.ps.table import TableSpec, shard_of
+from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.rpc import RpcClient
+
+log = get_logger("ps", "client")
+
+
+class _PsClientBase:
+    """Routing + scatter/gather shared by both transports."""
+
+    num_shards: int
+
+    # Subclasses implement the per-shard primitives.
+    def _pull_shard(self, shard: int, table: str, ids: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _push_shard(self, shard: int, table: str, ids: np.ndarray,
+                    grads: np.ndarray, scale: float) -> None:
+        raise NotImplementedError
+
+    def _create_shard(self, shard: int, spec: TableSpec) -> None:
+        raise NotImplementedError
+
+    def _for_all(self, fn) -> list:
+        # One persistent pool per client: _for_all runs twice per training
+        # step (pull + push), so per-call pool setup/teardown would sit on
+        # the hot path.
+        if self.num_shards == 1:
+            return [fn(0)]
+        pool = getattr(self, "_pool", None)
+        if pool is None:
+            pool = self._pool = ThreadPoolExecutor(
+                max_workers=self.num_shards, thread_name_prefix="ps-client"
+            )
+        return list(pool.map(fn, range(self.num_shards)))
+
+    # ------------------------------------------------------------------- api
+    def create_table(self, spec: TableSpec) -> None:
+        self._for_all(lambda s: self._create_shard(s, spec))
+
+    def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
+        """ids any shape -> float32 ``ids.shape + (dim,)``."""
+        ids = np.asarray(ids)
+        flat = ids.reshape(-1).astype(np.int64)
+        owner = shard_of(flat, self.num_shards)
+        parts = self._for_all(
+            lambda s: self._pull_shard(s, table, flat[owner == s])
+        )
+        dim = next(p.shape[-1] for p in parts if p.size) if flat.size else 0
+        out = np.zeros((len(flat), dim), np.float32)
+        for s, part in enumerate(parts):
+            if part.size:
+                out[owner == s] = part
+        return out.reshape(ids.shape + (dim,))
+
+    def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
+             scale: float = 1.0) -> None:
+        ids = np.asarray(ids)
+        flat = ids.reshape(-1).astype(np.int64)
+        g = np.ascontiguousarray(grads, np.float32).reshape(len(flat), -1)
+        owner = shard_of(flat, self.num_shards)
+        self._for_all(
+            lambda s: self._push_shard(
+                s, table, flat[owner == s], g[owner == s], scale
+            )
+        )
+
+    def save(self, directory: str, step: int) -> None:
+        self._for_all(lambda s: self._save_shard(s, directory, step))
+
+    def restore(self, directory: str, step: int = -1) -> None:
+        self._for_all(lambda s: self._restore_shard(s, directory, step))
+
+    def stats(self) -> List[pb.PsStatsResponse]:
+        return self._for_all(self._stats_shard)
+
+    def total_rows(self, table: str) -> int:
+        return sum(
+            t.rows for st in self.stats() for t in st.tables if t.name == table
+        )
+
+
+class LocalPsClient(_PsClientBase):
+    """In-process PS cluster: N shards, no sockets."""
+
+    def __init__(self, num_shards: int = 1, backend: str = "auto"):
+        self.num_shards = num_shards
+        self.shards = [
+            PsShard(shard_index=i, num_shards=num_shards, backend=backend)
+            for i in range(num_shards)
+        ]
+
+    def _pull_shard(self, s, table, ids):
+        if ids.size == 0:
+            sh = self.shards[s]
+            return np.zeros((0, sh.table(table).dim), np.float32)
+        return self.shards[s].table(table).pull(ids)
+
+    def _push_shard(self, s, table, ids, grads, scale):
+        if ids.size:
+            self.shards[s].table(table).push(ids, grads, scale)
+
+    def _create_shard(self, s, spec):
+        self.shards[s].create_table(spec)
+
+    def _save_shard(self, s, directory, step):
+        self.shards[s].save(directory, step)
+
+    def _restore_shard(self, s, directory, step):
+        self.shards[s].restore(directory, step)
+
+    def _stats_shard(self, s):
+        return self.shards[s].Stats(pb.PsStatsRequest(), None)
+
+
+class ShardedPsClient(_PsClientBase):
+    """gRPC PS cluster client. ``addresses[i]`` must be shard i of N —
+    routing is positional, the same order every worker must use."""
+
+    def __init__(self, addresses: Sequence[str], timeout: float = 60.0):
+        self.addresses = list(addresses)
+        self.num_shards = len(self.addresses)
+        self._clients = [
+            RpcClient(PS_SERVICE, a, timeout=timeout) for a in self.addresses
+        ]
+
+    def close(self) -> None:
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+        for c in self._clients:
+            c.close()
+
+    def _pull_shard(self, s, table, ids):
+        if ids.size == 0:
+            return np.zeros((0, 0), np.float32)
+        resp = self._clients[s].Pull(pb.PullRequest(table=table, ids=ids.tolist()))
+        return np.frombuffer(resp.values, np.float32).reshape(len(ids), resp.dim)
+
+    def _push_shard(self, s, table, ids, grads, scale):
+        if ids.size == 0:
+            return
+        ack = self._clients[s].Push(
+            pb.PushRequest(
+                table=table, ids=ids.tolist(), grads=grads.tobytes(), scale=scale
+            )
+        )
+        if not ack.ok:
+            raise RuntimeError(f"ps shard {s} push failed: {ack.message}")
+
+    def _create_shard(self, s, spec):
+        ack = self._clients[s].CreateTable(spec_to_proto(spec))
+        if not ack.ok:
+            raise RuntimeError(f"ps shard {s} create_table failed: {ack.message}")
+
+    def _save_shard(self, s, directory, step):
+        ack = self._clients[s].Save(pb.PsSaveRequest(directory=directory, step=step))
+        if not ack.ok:
+            raise RuntimeError(f"ps shard {s} save failed: {ack.message}")
+
+    def _restore_shard(self, s, directory, step):
+        ack = self._clients[s].Restore(
+            pb.PsRestoreRequest(directory=directory, step=step)
+        )
+        if not ack.ok:
+            raise RuntimeError(f"ps shard {s} restore failed: {ack.message}")
+
+    def _stats_shard(self, s):
+        return self._clients[s].Stats(pb.PsStatsRequest())
+
+
+# --------------------------------------------------------------- jit lookup
+
+_LOOKUP_CLIENTS: Dict[int, tuple] = {}
+_next_handle = [0]
+
+
+def register_lookup(client: _PsClientBase, table: str, dim: int,
+                    scale: float = 1.0) -> int:
+    """Register a (client, table) pair for :func:`ps_lookup`; returns the
+    static handle to pass into jitted code."""
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _LOOKUP_CLIENTS[h] = (client, table, dim, scale)
+    return h
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def ps_lookup(handle: int, ids: jax.Array, anchor: jax.Array) -> jax.Array:
+    """Differentiable embedding lookup against a host PS.
+
+    Forward: host pulls rows for ``ids`` (shape ``[...]``) → ``[..., dim]``
+    float32. Backward: host pushes the cotangent to the PS (the table's own
+    sparse optimizer applies it); no gradient flows to ``ids``.
+
+    ``anchor`` must be a float scalar whose gradient the caller requests
+    (e.g. a zero parameter — see :func:`easydl_tpu.ps.trainer.make_ps_model`).
+    ``ids`` are integers with no tangent space, so without a differentiable
+    input on the path JAX's partial evaluation would prune this VJP — and the
+    push with it.
+    """
+    client, table, dim, _ = _LOOKUP_CLIENTS[handle]
+    out_shape = jax.ShapeDtypeStruct(ids.shape + (dim,), jnp.float32)
+    emb = jax.pure_callback(
+        lambda i: client.pull(table, np.asarray(i)), out_shape, ids,
+        vmap_method="sequential",
+    )
+    return emb + anchor.astype(jnp.float32) * 0.0
+
+
+def _lookup_fwd(handle, ids, anchor):
+    return ps_lookup(handle, ids, anchor), ids
+
+
+def _lookup_bwd(handle, ids, g):
+    client, table, _, scale = _LOOKUP_CLIENTS[handle]
+
+    def push(i, grad):
+        client.push(table, np.asarray(i), np.asarray(grad, np.float32), scale)
+
+    # io_callback is effectful — it survives DCE even with no outputs, so the
+    # push happens exactly once per backward pass, in program order.
+    io_callback(push, None, ids, g, ordered=True)
+    # ids are integers: no tangent space — float0 cotangent.
+    return (np.zeros(ids.shape, jax.dtypes.float0), jnp.zeros((), jnp.float32))
+
+
+ps_lookup.defvjp(_lookup_fwd, _lookup_bwd)

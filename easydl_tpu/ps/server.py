@@ -1,0 +1,257 @@
+"""Parameter-server shard: embedding tables served over gRPC.
+
+The reference's PS role (docs/design/elastic-training-operator.md:39-40,
+65-71) reborn TPU-native (SURVEY.md §7 step 5): dense compute lives on TPU;
+only the huge sparse embedding tables stay host-resident, behind pull/push.
+A PS *cluster* is N identical shards; ids are routed by
+:func:`easydl_tpu.ps.table.shard_of`, so shards never coordinate.
+
+Elasticity: Save writes each table's rows (with their ids) to
+``<dir>/step_<k>/<table>.shard-<i>-of-<n>.npz``. Restore reads ALL shard
+files and keeps only ids that hash to this shard under the *current* shard
+count — reshard-on-restore for the PS tier, the host-side sibling of the
+dense checkpoint resharding (easydl_tpu/core/checkpoint.py). The reference
+promises recovery of "failed parameter servers" (README.md:26-29) without a
+mechanism; this is ours.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import threading
+from typing import Dict
+
+import numpy as np
+
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps.table import EmbeddingTable, TableSpec, shard_of
+from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.rpc import ServiceDef, serve
+
+log = get_logger("ps", "server")
+
+PS_SERVICE = ServiceDef(
+    "easydl.Ps",
+    {
+        "CreateTable": (pb.TableConfig, pb.Ack),
+        "Pull": (pb.PullRequest, pb.PullResponse),
+        "Push": (pb.PushRequest, pb.Ack),
+        "Save": (pb.PsSaveRequest, pb.Ack),
+        "Restore": (pb.PsRestoreRequest, pb.Ack),
+        "Stats": (pb.PsStatsRequest, pb.PsStatsResponse),
+    },
+)
+
+
+def spec_to_proto(spec: TableSpec) -> pb.TableConfig:
+    return pb.TableConfig(
+        name=spec.name,
+        dim=spec.dim,
+        init_std=spec.init_std,
+        seed=spec.seed,
+        optimizer=spec.optimizer,
+        lr=spec.lr,
+        eps=spec.eps,
+    )
+
+
+def spec_from_proto(msg: pb.TableConfig) -> TableSpec:
+    return TableSpec(
+        name=msg.name,
+        dim=msg.dim,
+        init_std=msg.init_std,
+        seed=msg.seed,
+        optimizer=msg.optimizer or "adagrad",
+        lr=msg.lr,
+        eps=msg.eps,
+    )
+
+
+class PsShard:
+    """One PS shard process: a set of tables + the gRPC service over them.
+
+    Usable in-process (no server) via the same methods the RPC handlers
+    call — the local client and tests drive it directly.
+    """
+
+    def __init__(self, shard_index: int = 0, num_shards: int = 1, backend: str = "auto"):
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        self._backend = backend
+        self._tables: Dict[str, EmbeddingTable] = {}
+        self._lock = threading.Lock()
+        self._server = None
+
+    # ----------------------------------------------------------- table admin
+    def create_table(self, spec: TableSpec) -> EmbeddingTable:
+        """Idempotent when the spec matches; error on a conflicting respec."""
+        with self._lock:
+            existing = self._tables.get(spec.name)
+            if existing is not None:
+                if existing.spec != spec:
+                    raise ValueError(
+                        f"table {spec.name!r} exists with different spec"
+                    )
+                return existing
+            t = EmbeddingTable(spec, backend=self._backend)
+            self._tables[spec.name] = t
+            return t
+
+    def table(self, name: str) -> EmbeddingTable:
+        t = self._tables.get(name)
+        if t is None:
+            raise KeyError(f"no such table {name!r}")
+        return t
+
+    # ------------------------------------------------------------ checkpoint
+    def save(self, directory: str, step: int) -> None:
+        d = os.path.join(directory, f"step_{step:010d}")
+        os.makedirs(d, exist_ok=True)
+        for name, t in list(self._tables.items()):
+            ids, rows = t.export_rows()
+            path = os.path.join(
+                d, f"{name}.shard-{self.shard_index}-of-{self.num_shards}.npz"
+            )
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:  # file handle: savez won't append .npz
+                np.savez(f, ids=ids, rows=rows, spec=_spec_json(t.spec))
+            os.replace(tmp, path)
+        # done marker lets restorers skip torn saves; the content records the
+        # shard count so completeness = all n markers present.
+        with open(os.path.join(d, f".done-{self.shard_index}"), "w") as f:
+            f.write(str(self.num_shards))
+        log.info("ps shard %d saved %d tables at step %d", self.shard_index,
+                 len(self._tables), step)
+
+    @staticmethod
+    def saved_steps(directory: str):
+        """Steps whose save completed on EVERY shard — a torn save (some
+        shards crashed mid-save) is invisible here, so a restore can never
+        silently drop that shard's rows."""
+        steps = []
+        for d in glob.glob(os.path.join(directory, "step_*")):
+            m = re.fullmatch(r"step_(\d+)", os.path.basename(d))
+            if not m:
+                continue
+            markers = glob.glob(os.path.join(d, ".done-*"))
+            if not markers:
+                continue
+            try:
+                with open(markers[0]) as f:
+                    expected = int(f.read().strip())
+            except (OSError, ValueError):
+                continue
+            if len(markers) == expected:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def restore(self, directory: str, step: int = -1) -> int:
+        """Load rows from a save taken under ANY shard count, keeping ids
+        that belong to this shard now. Returns the restored step."""
+        steps = self.saved_steps(directory)
+        if not steps:
+            raise FileNotFoundError(f"no PS checkpoints under {directory}")
+        step = steps[-1] if step < 0 else step
+        if step not in steps:
+            raise FileNotFoundError(f"no PS checkpoint for step {step}")
+        d = os.path.join(directory, f"step_{step:010d}")
+        by_table: Dict[str, list] = {}
+        for path in sorted(glob.glob(os.path.join(d, "*.shard-*-of-*.npz"))):
+            name = os.path.basename(path).rsplit(".shard-", 1)[0]
+            by_table.setdefault(name, []).append(path)
+        for name, paths in by_table.items():
+            with np.load(paths[0]) as z:
+                spec = TableSpec(**json.loads(str(z["spec"])))
+            # Drop any warm in-memory table first: rows touched after the
+            # checkpoint must re-init lazily, identically to a fresh shard.
+            with self._lock:
+                self._tables.pop(name, None)
+            t = self.create_table(spec)
+            for path in paths:
+                with np.load(path) as z:
+                    ids, rows = z["ids"], z["rows"]
+                if len(ids) == 0:
+                    continue
+                mine = shard_of(ids, self.num_shards) == self.shard_index
+                if mine.any():
+                    t.import_rows(ids[mine], rows[mine])
+        log.info("ps shard %d/%d restored step %d (%s)", self.shard_index,
+                 self.num_shards, step,
+                 ", ".join(f"{n}:{self._tables[n].rows}" for n in by_table))
+        return step
+
+    # ---------------------------------------------------------- rpc handlers
+    def CreateTable(self, req: pb.TableConfig, ctx) -> pb.Ack:
+        try:
+            self.create_table(spec_from_proto(req))
+            return pb.Ack(ok=True)
+        except ValueError as e:
+            return pb.Ack(ok=False, message=str(e))
+
+    def Pull(self, req: pb.PullRequest, ctx) -> pb.PullResponse:
+        t = self.table(req.table)
+        ids = np.asarray(req.ids, np.int64)
+        values = t.pull(ids)
+        return pb.PullResponse(values=values.tobytes(), dim=t.dim)
+
+    def Push(self, req: pb.PushRequest, ctx) -> pb.Ack:
+        t = self.table(req.table)
+        ids = np.asarray(req.ids, np.int64)
+        grads = np.frombuffer(req.grads, np.float32).reshape(len(ids), t.dim)
+        t.push(ids, grads, scale=req.scale)  # scale is required on the wire
+        return pb.Ack(ok=True)
+
+    def Save(self, req: pb.PsSaveRequest, ctx) -> pb.Ack:
+        try:
+            self.save(req.directory, req.step)
+            return pb.Ack(ok=True)
+        except OSError as e:
+            return pb.Ack(ok=False, message=str(e))
+
+    def Restore(self, req: pb.PsRestoreRequest, ctx) -> pb.Ack:
+        try:
+            # step < 0 = latest; 0 is a valid step, so no truthiness here.
+            step = self.restore(req.directory, req.step)
+            return pb.Ack(ok=True, message=str(step))
+        except (FileNotFoundError, ValueError) as e:
+            return pb.Ack(ok=False, message=str(e))
+
+    def Stats(self, req: pb.PsStatsRequest, ctx) -> pb.PsStatsResponse:
+        resp = pb.PsStatsResponse(
+            shard_index=self.shard_index, num_shards=self.num_shards
+        )
+        with self._lock:
+            for name, t in self._tables.items():
+                resp.tables.add(name=name, rows=t.rows, dim=t.dim)
+        return resp
+
+    # ----------------------------------------------------------------- serve
+    def serve(self, port: int = 0):
+        self._server = serve(PS_SERVICE, self, port=port)
+        log.info("ps shard %d/%d serving on :%d", self.shard_index,
+                 self.num_shards, self._server.port)
+        return self._server
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+
+def _spec_json(spec: TableSpec) -> str:
+    return json.dumps(
+        {
+            "name": spec.name,
+            "dim": spec.dim,
+            "init_std": spec.init_std,
+            "seed": spec.seed,
+            "optimizer": spec.optimizer,
+            "lr": spec.lr,
+            "eps": spec.eps,
+        }
+    )

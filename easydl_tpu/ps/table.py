@@ -1,0 +1,245 @@
+"""Sparse embedding tables: ctypes front-end over the C++ store, with a
+bit-compatible numpy fallback.
+
+The table is the unit the PS serves (reference PS role,
+docs/design/elastic-training-operator.md:39-40). Rows materialise lazily with
+a deterministic per-id init (splitmix64 of ``seed ^ id``), so any shard
+layout — or a restore onto a different shard count — produces identical
+parameters for the same ids.
+
+Optimizers live *in* the table (classic PS design): ``push`` applies a sparse
+SGD/Adagrad update; duplicate ids within one push accumulate first, matching
+the dense scatter-add gradient semantics of the on-device embedding path
+(easydl_tpu/models/deepfm.py DeviceEmbedding).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+from easydl_tpu.ps import build as _build
+
+OPTIMIZERS = {"sgd": 0, "adagrad": 1}
+
+_SQRT3 = np.float32(1.7320508075688772)
+_U24 = np.float32(1.0 / 16777216.0)
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorised splitmix64 — identical to the C++ core's."""
+    x = np.asarray(x).astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+def shard_of(ids: np.ndarray, num_shards: int) -> np.ndarray:
+    """Which PS shard owns each id. Hash-based (not modulo on the raw id) so
+    skewed id spaces still balance."""
+    return (splitmix64(ids) % np.uint64(num_shards)).astype(np.int64)
+
+
+@dataclass(frozen=True)
+class TableSpec:
+    name: str
+    dim: int
+    init_std: float = 0.01
+    seed: int = 0
+    optimizer: str = "adagrad"
+    lr: float = 0.05
+    eps: float = 1e-8
+
+    def __post_init__(self):
+        if self.optimizer not in OPTIMIZERS:
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
+
+    @property
+    def row_width(self) -> int:
+        return 2 * self.dim if self.optimizer == "adagrad" else self.dim
+
+
+class _NumpyStore:
+    """Fallback store; same math as embedding_store.cc, pure numpy.
+
+    One coarse lock stands in for the C++ store's stripe locks: the gRPC
+    shard serves pulls/pushes from a thread pool, so the fallback must be
+    just as safe under concurrent workers (it only trades throughput)."""
+
+    def __init__(self, spec: TableSpec):
+        self.spec = spec
+        self._rows: dict = {}
+        self._mu = threading.Lock()
+
+    def _init_row(self, id_: int) -> np.ndarray:
+        base = splitmix64(np.uint64(self.spec.seed) ^ np.uint64(np.int64(id_)))
+        with np.errstate(over="ignore"):
+            bits = splitmix64(base + np.arange(self.spec.dim, dtype=np.uint64))
+        u = (bits >> np.uint64(40)).astype(np.float32) * _U24
+        a = np.float32(self.spec.init_std) * _SQRT3
+        row = np.zeros(self.spec.row_width, np.float32)
+        row[: self.spec.dim] = (np.float32(2.0) * u - np.float32(1.0)) * a
+        return row
+
+    def _row(self, id_: int) -> np.ndarray:
+        r = self._rows.get(id_)
+        if r is None:
+            r = self._rows[id_] = self._init_row(id_)
+        return r
+
+    def pull(self, ids: np.ndarray, out: np.ndarray) -> None:
+        dim = self.spec.dim
+        with self._mu:
+            for i, id_ in enumerate(ids):
+                out[i] = self._row(int(id_))[:dim]
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, scale: float) -> None:
+        spec = self.spec
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((len(uniq), spec.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        lr, eps = np.float32(spec.lr), np.float32(spec.eps)
+        with self._mu:
+            for u, id_ in enumerate(uniq):
+                row = self._row(int(id_))
+                g = acc[u] * np.float32(scale)
+                if spec.optimizer == "adagrad":
+                    slot = row[spec.dim:]
+                    slot += g * g
+                    row[: spec.dim] -= lr * g / (np.sqrt(slot) + eps)
+                else:
+                    row[: spec.dim] -= lr * g
+
+    def size(self) -> int:
+        with self._mu:
+            return len(self._rows)
+
+    def export_rows(self) -> Tuple[np.ndarray, np.ndarray]:
+        with self._mu:
+            n = len(self._rows)
+            ids = np.fromiter(self._rows.keys(), np.int64, n)
+            rows = np.stack([self._rows[int(i)] for i in ids]) if n else np.zeros(
+                (0, self.spec.row_width), np.float32
+            )
+        return ids, rows
+
+    def import_rows(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        with self._mu:
+            for i, id_ in enumerate(ids):
+                self._rows[int(id_)] = rows[i].astype(np.float32).copy()
+
+
+class _NativeStore:
+    """ctypes wrapper over the C++ store."""
+
+    def __init__(self, spec: TableSpec, lib: ctypes.CDLL):
+        self.spec = spec
+        self._lib = lib
+        self._h = lib.eds_create(
+            spec.dim,
+            ctypes.c_float(spec.init_std),
+            ctypes.c_uint64(np.uint64(spec.seed)),
+            OPTIMIZERS[spec.optimizer],
+            ctypes.c_float(spec.lr),
+            ctypes.c_float(spec.eps),
+        )
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.eds_destroy(h)
+
+    @staticmethod
+    def _i64p(a: np.ndarray):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+    @staticmethod
+    def _f32p(a: np.ndarray):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+    def pull(self, ids: np.ndarray, out: np.ndarray) -> None:
+        self._lib.eds_pull(self._h, self._i64p(ids), len(ids), self._f32p(out))
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, scale: float) -> None:
+        grads = np.ascontiguousarray(grads, np.float32)
+        self._lib.eds_push(
+            self._h, self._i64p(ids), len(ids), self._f32p(grads), ctypes.c_float(scale)
+        )
+
+    def size(self) -> int:
+        return self._lib.eds_size(self._h)
+
+    def export_rows(self) -> Tuple[np.ndarray, np.ndarray]:
+        n = self.size()
+        ids = np.zeros(n, np.int64)
+        rows = np.zeros((n, self.spec.row_width), np.float32)
+        written = self._lib.eds_export(self._h, self._i64p(ids), self._f32p(rows), n)
+        return ids[:written], rows[:written]
+
+    def import_rows(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        ids = np.ascontiguousarray(ids, np.int64)
+        rows = np.ascontiguousarray(rows, np.float32)
+        self._lib.eds_import(self._h, self._i64p(ids), self._f32p(rows), len(ids))
+
+
+class EmbeddingTable:
+    """One named table. ``backend`` is ``"auto"`` (native if buildable),
+    ``"native"`` (require C++), or ``"numpy"``."""
+
+    def __init__(self, spec: TableSpec, backend: str = "auto"):
+        self.spec = spec
+        lib = _build.load_native() if backend in ("auto", "native") else None
+        if backend == "native" and lib is None:
+            raise RuntimeError("native embedding store requested but unavailable")
+        self._store = _NativeStore(spec, lib) if lib is not None else _NumpyStore(spec)
+        self.backend = "native" if lib is not None else "numpy"
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    @property
+    def dim(self) -> int:
+        return self.spec.dim
+
+    @property
+    def rows(self) -> int:
+        return self._store.size()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """ids of any shape -> float32 values of shape ``ids.shape + (dim,)``."""
+        ids = np.asarray(ids)
+        flat = np.ascontiguousarray(ids.reshape(-1), np.int64)
+        out = np.zeros((len(flat), self.spec.dim), np.float32)
+        self._store.pull(flat, out)
+        return out.reshape(ids.shape + (self.spec.dim,))
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, scale: float = 1.0) -> None:
+        """Apply one sparse optimizer step. ``grads`` shape must be
+        ``ids.shape + (dim,)``; duplicates accumulate before the update."""
+        ids = np.asarray(ids)
+        grads = np.asarray(grads)
+        if grads.shape != ids.shape + (self.spec.dim,):
+            raise ValueError(
+                f"grads shape {grads.shape} != ids {ids.shape} + (dim={self.spec.dim},)"
+            )
+        flat = np.ascontiguousarray(ids.reshape(-1), np.int64)
+        g = np.ascontiguousarray(grads.reshape(len(flat), self.spec.dim), np.float32)
+        self._store.push(flat, g, scale)
+
+    def export_rows(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(ids [n], rows [n, row_width]) — embedding values + optimizer slots."""
+        return self._store.export_rows()
+
+    def import_rows(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        if rows.shape[1:] != (self.spec.row_width,):
+            raise ValueError(
+                f"rows width {rows.shape[1:]} != ({self.spec.row_width},)"
+            )
+        self._store.import_rows(ids, rows)

@@ -1,0 +1,146 @@
+"""The async-PS worker loop: pull → compiled dense step → push.
+
+TPU-native shape of the reference's PS hot loop (SURVEY.md §3.4: "worker …
+pull params from PS shards → local fwd/bwd → push grads → PS applies
+update"): the *dense* model stays a pjit-compiled step on the mesh — exactly
+:class:`easydl_tpu.core.train_loop.Trainer` — while the embedding rows for
+the current batch travel host↔device per step. The compiled step treats the
+pulled embeddings as a differentiable input and returns their gradient,
+which the host pushes back; the PS's own sparse optimizer (SGD/Adagrad)
+applies it. Per-process pulls touch only the local batch shard, so the loop
+is multi-host correct by construction.
+
+For single-process conveniences there is also :func:`make_ps_loss_fn`, which
+moves the pull/push *inside* the jitted step via
+:func:`easydl_tpu.ps.client.ps_lookup` host callbacks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from easydl_tpu.core import sharding as shd
+from easydl_tpu.core.mesh import MeshSpec
+from easydl_tpu.core.train_loop import (
+    InitFn,
+    LossFn,
+    TrainConfig,
+    Trainer,
+    TrainState,
+    cast_floating,
+)
+from easydl_tpu.ps.client import _PsClientBase, ps_lookup, register_lookup
+from easydl_tpu.ps.table import TableSpec
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("ps", "trainer")
+
+
+def make_ps_model(init_fn: InitFn, loss_fn: LossFn, handle: int,
+                  ids_key: str = "sparse_ids",
+                  emb_key: str = "sparse_emb") -> Tuple[InitFn, LossFn]:
+    """Wrap ``(init_fn, loss_fn)`` of a model that expects ``batch[emb_key]``
+    so embeddings are pulled *inside* the jitted step via :func:`ps_lookup`
+    (gradients push back through the custom VJP). The wrapped init adds a
+    zero ``ps_anchor`` parameter — the differentiable input that keeps the
+    lookup's VJP (and its push) alive under autodiff pruning.
+    Single-process meshes only; multi-host uses :class:`PsTrainer`."""
+
+    def init2(rng):
+        return {"model": init_fn(rng), "ps_anchor": jnp.zeros((), jnp.float32)}
+
+    def loss2(params, batch, rng):
+        batch = dict(batch)
+        batch[emb_key] = ps_lookup(handle, batch[ids_key], params["ps_anchor"])
+        return loss_fn(params["model"], batch, rng)
+
+    return init2, loss2
+
+
+class PsTrainer(Trainer):
+    """Trainer whose step also differentiates w.r.t. the pulled embeddings.
+
+    ``train_step`` takes the raw host batch (with ``ids_key``), performs the
+    pull, runs the compiled step, pushes the embedding grads, and returns
+    ``(state, metrics)`` like the base Trainer.
+    """
+
+    def __init__(
+        self,
+        init_fn: InitFn,
+        loss_fn: LossFn,
+        optimizer: optax.GradientTransformation,
+        config: TrainConfig,
+        client: _PsClientBase,
+        table: TableSpec,
+        mesh: Optional[Mesh] = None,
+        mesh_spec: Optional[MeshSpec] = None,
+        ids_key: str = "sparse_ids",
+        emb_key: str = "sparse_emb",
+        push_scale: float = 1.0,
+    ):
+        if config.grad_accum > 1:
+            raise ValueError("PsTrainer does not support grad_accum > 1")
+        super().__init__(init_fn, loss_fn, optimizer, config, mesh=mesh,
+                         mesh_spec=mesh_spec)
+        self.client = client
+        self.table = table
+        self.ids_key = ids_key
+        self.emb_key = emb_key
+        self.push_scale = push_scale
+        client.create_table(table)
+
+    def _build_step(self):
+        compute_dtype = self.config.compute_dtype
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+        emb_key = self.emb_key
+
+        def forward(params, emb, batch, rng):
+            batch = dict(batch)
+            batch[emb_key] = emb
+            loss, aux = loss_fn(cast_floating(params, compute_dtype), batch, rng)
+            return loss.astype(jnp.float32), aux
+
+        grad_fn = jax.value_and_grad(forward, argnums=(0, 1), has_aux=True)
+
+        def train_step(
+            state: TrainState, emb: jax.Array, batch
+        ) -> Tuple[TrainState, Dict[str, jax.Array], jax.Array]:
+            step_rng = jax.random.fold_in(state.rng, state.step)
+            (loss, aux), (grads, gemb) = grad_fn(state.params, emb, batch, step_rng)
+            updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = {"loss": loss, "grad_norm": optax.global_norm(grads), **aux}
+            new_state = state.replace(
+                step=state.step + 1, params=new_params, opt_state=new_opt_state
+            )
+            return new_state, metrics, gemb
+
+        shardings = self.state_shardings()
+        batch_shd = shd.batch_sharding(self.mesh)
+        replicated = NamedSharding(self.mesh, P())
+        return jax.jit(
+            train_step,
+            in_shardings=(shardings, batch_shd, batch_shd),
+            out_shardings=(shardings, replicated, batch_shd),
+            donate_argnums=(0,) if self.config.donate_state else (),
+        )
+
+    def train_step(self, state: TrainState, host_batch: Any):
+        ids = np.asarray(host_batch[self.ids_key])
+        emb = self.client.pull(self.table.name, ids)
+        batch = {k: v for k, v in host_batch.items() if k != self.emb_key}
+        state, metrics, gemb = self.step_fn(
+            state, self.shard_batch(emb), self.shard_batch(batch)
+        )
+        self.client.push(
+            self.table.name, ids, np.asarray(jax.device_get(gemb)), self.push_scale
+        )
+        return state, metrics

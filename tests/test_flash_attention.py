@@ -65,6 +65,47 @@ def test_uneven_blocks_and_rectangular():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_causal_cross_length_bottom_right_aligned():
+    """s_q != s_k causal masking must match the reference path's
+    bottom-right alignment (tril k=s_k-s_q) — e.g. decode: q_len 32 against a
+    64-long KV cache attends all past keys, not just the first 32."""
+    key = jax.random.PRNGKey(4)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, h, d, s_q, s_k = 2, 2, 32, 32, 64
+    q = jax.random.normal(kq, (b, s_q, h, d))
+    k = jax.random.normal(kk, (b, s_k, h, d))
+    v = jax.random.normal(kv, (b, s_k, h, d))
+    scale = d**-0.5
+    ref = _reference_attention(q, k, v, causal=True, scale=scale)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=16, block_k=16, interpret=True)
+        return (o * jnp.cos(o)).sum()
+
+    def loss_ref(q, k, v):
+        o = _reference_attention(q, k, v, causal=True, scale=scale)
+        return (o * jnp.cos(o)).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_untileable_length_falls_back_to_reference():
+    """Lengths with no usable block divisor (e.g. 72 with block 48 → none
+    ≥128-aligned) must not assert — the wrapper falls back to the XLA path."""
+    q, k, v = rand_qkv(jax.random.PRNGKey(5), s=72, d=16)
+    ref = _reference_attention(q, k, v, causal=True, scale=16**-0.5)
+    out = flash_attention(q, k, v, causal=True, block_q=48, block_k=48, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_bf16_inputs():
     q, k, v = rand_qkv(jax.random.PRNGKey(3), dtype=jnp.bfloat16, s=64)
     ref = _reference_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)

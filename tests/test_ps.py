@@ -1,0 +1,301 @@
+"""Sparse PS tier tests: native/numpy store parity, optimizer math, shard
+routing, gRPC pull/push, reshard-on-restore, and the jit-visible lookup
+(SURVEY.md §7 step 5; BASELINE config 5)."""
+
+import numpy as np
+import pytest
+
+from easydl_tpu.ps import (
+    LocalPsClient,
+    PsShard,
+    ShardedPsClient,
+    TableSpec,
+    shard_of,
+)
+from easydl_tpu.ps.build import load_native
+from easydl_tpu.ps.table import EmbeddingTable
+
+
+def spec(**kw):
+    base = dict(name="emb", dim=8, init_std=0.01, seed=7, optimizer="sgd", lr=0.5)
+    base.update(kw)
+    return TableSpec(**base)
+
+
+# ------------------------------------------------------------------- table
+
+
+def test_native_store_builds():
+    assert load_native() is not None, "C++ embedding store must compile in CI"
+
+
+def test_pull_is_deterministic_and_lazy():
+    t = EmbeddingTable(spec())
+    ids = np.array([[3, 5], [3, 9]])
+    v1 = t.pull(ids)
+    v2 = t.pull(ids)
+    assert v1.shape == (2, 2, 8)
+    np.testing.assert_array_equal(v1, v2)
+    # same id -> same row wherever it appears
+    np.testing.assert_array_equal(v1[0, 0], v1[1, 0])
+    assert t.rows == 3  # lazy: only touched ids exist
+    # init statistics: uniform(-a, a), a = std*sqrt(3)
+    big = t.pull(np.arange(10_000))
+    assert abs(big.std() - 0.01) < 1e-3
+    assert abs(big.mean()) < 1e-3
+
+
+def test_native_numpy_bit_parity():
+    if load_native() is None:
+        pytest.skip("no g++")
+    ids = np.array([0, 1, 42, -7, 2**40, 12345])
+    grads = np.random.default_rng(0).standard_normal((len(ids), 8)).astype(np.float32)
+    for opt in ("sgd", "adagrad"):
+        nat = EmbeddingTable(spec(optimizer=opt), backend="native")
+        ref = EmbeddingTable(spec(optimizer=opt), backend="numpy")
+        np.testing.assert_array_equal(nat.pull(ids), ref.pull(ids))
+        for _ in range(3):
+            nat.push(ids, grads, scale=0.5)
+            ref.push(ids, grads, scale=0.5)
+        np.testing.assert_allclose(nat.pull(ids), ref.pull(ids), rtol=1e-6)
+
+
+def test_sgd_push_matches_dense_update():
+    t = EmbeddingTable(spec(lr=0.1))
+    ids = np.array([1, 2, 1])  # duplicate id 1: grads must accumulate
+    before = t.pull(np.array([1, 2]))
+    g = np.ones((3, 8), np.float32)
+    t.push(ids, g, scale=2.0)
+    after = t.pull(np.array([1, 2]))
+    np.testing.assert_allclose(before[0] - 0.1 * 2.0 * 2.0, after[0], rtol=1e-6)
+    np.testing.assert_allclose(before[1] - 0.1 * 2.0 * 1.0, after[1], rtol=1e-6)
+
+
+def test_adagrad_push():
+    t = EmbeddingTable(spec(optimizer="adagrad", lr=0.1, eps=0.0))
+    ids = np.array([5])
+    w0 = t.pull(ids).copy()
+    g = np.full((1, 8), 2.0, np.float32)
+    t.push(ids, g)
+    # slot = 4, update = lr * 2/sqrt(4) = 0.1
+    np.testing.assert_allclose(t.pull(ids), w0 - 0.1, rtol=1e-5)
+    t.push(ids, g)
+    # slot = 8, update = lr * 2/sqrt(8)
+    np.testing.assert_allclose(
+        t.pull(ids), w0 - 0.1 - 0.1 * 2 / np.sqrt(8), rtol=1e-5
+    )
+
+
+def test_export_import_roundtrip():
+    t = EmbeddingTable(spec(optimizer="adagrad"))
+    ids = np.arange(100)
+    t.push(ids, np.ones((100, 8), np.float32))
+    exp_ids, rows = t.export_rows()
+    assert rows.shape == (100, 16)  # dim + adagrad slot
+    t2 = EmbeddingTable(spec(optimizer="adagrad", seed=999))  # different seed
+    t2.import_rows(exp_ids, rows)
+    np.testing.assert_array_equal(t.pull(ids), t2.pull(ids))
+    # and further pushes continue from imported optimizer slots
+    t.push(ids, np.ones((100, 8), np.float32))
+    t2.push(ids, np.ones((100, 8), np.float32))
+    np.testing.assert_allclose(t.pull(ids), t2.pull(ids), rtol=1e-6)
+
+
+# ------------------------------------------------------------------ routing
+
+
+def test_shard_of_balances():
+    owners = shard_of(np.arange(100_000), 4)
+    counts = np.bincount(owners, minlength=4)
+    assert counts.min() > 20_000  # ~25k each
+
+
+def test_local_cluster_matches_single_table():
+    single = EmbeddingTable(spec())
+    cluster = LocalPsClient(num_shards=4)
+    cluster.create_table(spec())
+    ids = np.random.default_rng(1).integers(0, 1000, (64, 3))
+    np.testing.assert_array_equal(cluster.pull("emb", ids), single.pull(ids))
+    g = np.random.default_rng(2).standard_normal((64, 3, 8)).astype(np.float32)
+    cluster.push("emb", ids, g)
+    single.push(ids, g)
+    np.testing.assert_allclose(cluster.pull("emb", ids), single.pull(ids), rtol=1e-6)
+    assert cluster.total_rows("emb") == single.rows
+
+
+# --------------------------------------------------------------------- grpc
+
+
+def test_grpc_ps_cluster(tmp_path):
+    shards = [PsShard(shard_index=i, num_shards=2) for i in range(2)]
+    servers = [s.serve() for s in shards]
+    try:
+        client = ShardedPsClient([sv.address for sv in servers])
+        client.create_table(spec())
+        ids = np.arange(200).reshape(50, 4)
+        local = EmbeddingTable(spec())
+        np.testing.assert_array_equal(client.pull("emb", ids), local.pull(ids))
+        g = np.ones((50, 4, 8), np.float32)
+        client.push("emb", ids, g, scale=0.25)
+        local.push(ids, g, scale=0.25)
+        np.testing.assert_allclose(client.pull("emb", ids), local.pull(ids), rtol=1e-6)
+        # save from 2 shards…
+        client.save(str(tmp_path), step=3)
+        stats = client.stats()
+        assert sum(t.rows for st in stats for t in st.tables) == 200
+        client.close()
+    finally:
+        for sv in servers:
+            sv.stop()
+    # …restore into 3 shards (reshard-on-restore)
+    new_shards = [PsShard(shard_index=i, num_shards=3) for i in range(3)]
+    for s in new_shards:
+        s.restore(str(tmp_path))
+    restored = LocalPsClient(num_shards=3)
+    restored.shards = new_shards
+    np.testing.assert_allclose(
+        restored.pull("emb", ids), local.pull(ids), rtol=1e-6
+    )
+    assert restored.total_rows("emb") == 200
+
+
+def test_torn_save_is_invisible(tmp_path):
+    """A save that only completed on some shards must not be restorable —
+    otherwise the missing shard's ids silently re-init to fresh values."""
+    shards = [PsShard(shard_index=i, num_shards=2) for i in range(2)]
+    ids = np.arange(100)
+    for s in shards:
+        s.create_table(spec())
+        mine = shard_of(ids, 2) == s.shard_index
+        s.table("emb").pull(ids[mine])
+    shards[0].save(str(tmp_path), step=7)  # shard 1 "crashed" before saving
+    assert PsShard.saved_steps(str(tmp_path)) == []
+    with pytest.raises(FileNotFoundError):
+        PsShard(shard_index=0, num_shards=2).restore(str(tmp_path))
+    shards[1].save(str(tmp_path), step=7)  # now complete
+    assert PsShard.saved_steps(str(tmp_path)) == [7]
+
+
+def test_restore_clears_warm_rows(tmp_path):
+    """Restoring onto a warm shard must not keep post-checkpoint rows: ids
+    first touched after the save re-init lazily, same as on a fresh shard."""
+    s = PsShard()
+    s.create_table(spec(lr=1.0))
+    s.table("emb").pull(np.arange(10))
+    s.save(str(tmp_path), step=1)
+    # train past the checkpoint: update old ids, touch new ones
+    s.table("emb").push(np.arange(20), np.ones((20, 8), np.float32))
+    s.restore(str(tmp_path), step=1)
+    fresh = PsShard()
+    fresh.restore(str(tmp_path), step=1)
+    np.testing.assert_array_equal(
+        s.table("emb").pull(np.arange(30)), fresh.table("emb").pull(np.arange(30))
+    )
+
+
+# ------------------------------------------------------------- jit lookup
+
+
+def test_ps_lookup_custom_vjp():
+    import jax
+    import jax.numpy as jnp
+
+    from easydl_tpu.ps import register_lookup
+    from easydl_tpu.ps.client import ps_lookup
+
+    client = LocalPsClient(num_shards=2)
+    client.create_table(spec(lr=1.0))
+    handle = register_lookup(client, "emb", dim=8)
+
+    ids = np.array([[1, 2], [3, 1]])
+    w = jnp.ones((8,), jnp.float32)
+    anchor = jnp.zeros((), jnp.float32)
+    before = client.pull("emb", ids).copy()
+
+    @jax.jit
+    def loss(w, anchor, ids):
+        emb = ps_lookup(handle, ids, anchor)
+        return (emb * w).sum()
+
+    val, (gw, _) = jax.value_and_grad(loss, argnums=(0, 1))(w, anchor, ids)
+    np.testing.assert_allclose(val, before.sum(), rtol=1e-5)
+    np.testing.assert_allclose(gw, before.sum(axis=(0, 1)), rtol=1e-5)
+    # the backward pushed d(loss)/d(emb) = w = ones; sgd lr=1 ⇒ row -= count(id)
+    after = client.pull("emb", np.array([1, 2, 3]))
+    b = {1: before[0, 0], 2: before[0, 1], 3: before[1, 0]}
+    np.testing.assert_allclose(after[0], b[1] - 2.0, rtol=1e-5)  # id 1 twice
+    np.testing.assert_allclose(after[1], b[2] - 1.0, rtol=1e-5)
+    np.testing.assert_allclose(after[2], b[3] - 1.0, rtol=1e-5)
+
+
+# ------------------------------------------------------- end-to-end deepfm
+
+
+def test_make_ps_model_inside_jit_step():
+    """The convenience path: pull/push as host callbacks inside the compiled
+    step, driven through the unmodified core Trainer."""
+    import jax
+    import optax
+
+    from easydl_tpu.core.mesh import MeshSpec
+    from easydl_tpu.core.train_loop import TrainConfig, Trainer
+    from easydl_tpu.models.registry import get_model
+    from easydl_tpu.ps import register_lookup
+    from easydl_tpu.ps.trainer import make_ps_model
+
+    dim = 8
+    bundle = get_model(
+        "deepfm", vocab=2000, dim=dim, hidden=(16,), embedding="ps",
+        num_sparse=4, num_dense=3,
+    )
+    client = LocalPsClient(num_shards=2)
+    client.create_table(TableSpec(name="emb", dim=dim, optimizer="sgd", lr=0.1))
+    handle = register_lookup(client, "emb", dim=dim)
+    init2, loss2 = make_ps_model(bundle.init_fn, bundle.loss_fn, handle)
+    trainer = Trainer(
+        init_fn=init2,
+        loss_fn=loss2,
+        optimizer=optax.adam(1e-2),
+        config=TrainConfig(global_batch=16, compute_dtype=jax.numpy.float32),
+        mesh_spec=MeshSpec(dp=1),
+    )
+    state = trainer.init_state()
+    data = iter(bundle.make_data(16, seed=9))
+    for _ in range(3):
+        state, metrics = trainer.train_step(state, next(data))
+    jax.block_until_ready(metrics["loss"])
+    assert client.total_rows("emb") > 0  # backward pushes materialised rows
+
+
+def test_deepfm_ps_training_learns(tmp_path):
+    import jax
+    import optax
+
+    from easydl_tpu.core.mesh import MeshSpec
+    from easydl_tpu.core.train_loop import TrainConfig
+    from easydl_tpu.models.registry import get_model
+    from easydl_tpu.ps.trainer import PsTrainer
+
+    dim = 8
+    bundle = get_model(
+        "deepfm", vocab=5000, dim=dim, hidden=(32, 32), embedding="ps",
+        num_sparse=6, num_dense=4,
+    )
+    client = LocalPsClient(num_shards=2)
+    trainer = PsTrainer(
+        init_fn=bundle.init_fn,
+        loss_fn=bundle.loss_fn,
+        optimizer=optax.adam(1e-2),
+        config=TrainConfig(global_batch=32, compute_dtype=jax.numpy.float32),
+        client=client,
+        table=TableSpec(name="emb", dim=dim, optimizer="adagrad", lr=0.05, seed=3),
+        mesh_spec=MeshSpec(dp=4),
+    )
+    state = trainer.init_state()
+    data = iter(bundle.make_data(32, seed=5))
+    losses = []
+    for _ in range(30):
+        state, metrics = trainer.train_step(state, next(data))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], f"no learning: {losses[0]:.3f} -> {losses[-1]:.3f}"
+    assert client.total_rows("emb") > 0
