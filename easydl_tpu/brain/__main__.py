@@ -1,0 +1,6 @@
+"""``python -m easydl_tpu.brain`` — serve the Brain (see service.py)."""
+
+from easydl_tpu.brain.service import main
+
+if __name__ == "__main__":
+    main()

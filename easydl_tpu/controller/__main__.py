@@ -1,0 +1,89 @@
+"""``python -m easydl_tpu.controller`` — run the elastic operator.
+
+Standalone mode: watches a directory for ElasticJob / JobResource YAML
+documents (the k8s-API-server stand-in; drop or update files to drive the
+job) and reconciles against the selected pod backend. ``--pod-api memory``
+logs decisions against the in-memory fake — useful to validate manifests and
+plans without a cluster; a real k8s PodApi plugs in behind the same
+interface (easydl_tpu/controller/pod_api.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import yaml
+
+from easydl_tpu.api.job_spec import JOB_KIND, JobSpec
+from easydl_tpu.api.resource_plan import PLAN_KIND, ResourcePlan
+from easydl_tpu.controller import CrStore, ElasticJobController, InMemoryPodApi
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("controller", "main")
+
+
+def ingest(store: CrStore, path: str, seen: dict) -> None:
+    for fname in sorted(os.listdir(path)):
+        if not fname.endswith((".yaml", ".yml")):
+            continue
+        full = os.path.join(path, fname)
+        # One bad file (syntax error, deleted mid-scan) must not take the
+        # operator down with it — log and move to the next file.
+        try:
+            mtime = os.path.getmtime(full)
+            if seen.get(full) == mtime:
+                continue
+            seen[full] = mtime
+            with open(full) as f:
+                docs = [d for d in yaml.safe_load_all(f) if isinstance(d, dict)]
+        except (OSError, yaml.YAMLError) as e:
+            log.error("unreadable manifest %s: %s", fname, e)
+            continue
+        for doc in docs:
+            try:
+                if doc.get("kind") == JOB_KIND:
+                    job = JobSpec.from_crd(doc)
+                    if store.job(job.name) is None:
+                        store.submit_job(job)
+                        log.info("submitted job %s from %s", job.name, fname)
+                elif doc.get("kind") == PLAN_KIND:
+                    plan = ResourcePlan.from_crd(doc)
+                    try:
+                        store.apply_plan(plan)
+                        log.info("applied plan v%d for %s from %s",
+                                 plan.version, plan.job_name, fname)
+                    except ValueError:
+                        pass  # stale version: file unchanged since apply
+            except Exception as e:
+                log.error("bad document in %s: %s", fname, e)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="easydl_tpu elastic operator")
+    ap.add_argument("--watch-dir", required=True,
+                    help="directory of ElasticJob/JobResource YAMLs")
+    ap.add_argument("--pod-api", choices=["memory"], default="memory")
+    ap.add_argument("--resync-s", type=float, default=2.0)
+    args = ap.parse_args()
+
+    store = CrStore()
+    pod_api = InMemoryPodApi()
+    ctl = ElasticJobController(store, pod_api)
+    ctl.start(resync_s=args.resync_s)
+    log.info("operator watching %s (pod api: %s)", args.watch_dir, args.pod_api)
+    seen: dict = {}
+    try:
+        while True:
+            ingest(store, args.watch_dir, seen)
+            pod_api.tick()
+            time.sleep(min(args.resync_s, 1.0))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ctl.stop()
+
+
+if __name__ == "__main__":
+    main()

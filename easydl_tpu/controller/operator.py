@@ -1,0 +1,232 @@
+"""The elastic operator: watches job/plan resources, reconciles pods.
+
+Control flow mirrors the reference's figure steps 1-6
+(docs/design/elastic-training-operator.md:20-22,47-55):
+
+1. user submits an ElasticJob (``CrStore.submit_job``);
+2-3. controller sees the create event and launches the **trainer pod only**
+   (:47-48 "the controller only creates a trainer Pod");
+4. the trainer (or an advanced user, :50-55) applies a JobResource
+   (``CrStore.apply_plan``);
+5-6. controller reconciles worker/PS/evaluator pods against the plan —
+   create/delete/replace decisions come from the native reconcile core
+   (easydl_tpu/controller/reconciler.py).
+
+The CrStore stands in for the k8s API server as the event bus (SURVEY.md
+"Cross-cutting" note); the PodApi stands in for kubelet. Both are interfaces
+so the same controller logic drives the in-memory fake (tests, simulation)
+or a real cluster.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from easydl_tpu.api.job_spec import JobSpec, ResourceSpec
+from easydl_tpu.api.resource_plan import ResourcePlan
+from easydl_tpu.controller.pod_api import Pod, PodApi
+from easydl_tpu.controller.reconciler import _trailing_index, reconcile
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("controller", "operator")
+
+
+class CrStore:
+    """In-memory custom-resource store with a watch queue — the event bus the
+    reference routes all control flow through."""
+
+    def __init__(self):
+        self._jobs: Dict[str, JobSpec] = {}
+        self._plans: Dict[str, ResourcePlan] = {}
+        self._lock = threading.Lock()
+        self._events: "queue.Queue[tuple]" = queue.Queue()
+
+    def submit_job(self, job: JobSpec) -> None:
+        job.validate()
+        with self._lock:
+            if job.name in self._jobs:
+                raise ValueError(f"job {job.name!r} already exists")
+            self._jobs[job.name] = job
+        self._events.put(("job_added", job.name))
+
+    def delete_job(self, name: str) -> None:
+        with self._lock:
+            self._jobs.pop(name, None)
+            self._plans.pop(name, None)
+        self._events.put(("job_deleted", name))
+
+    def apply_plan(self, plan: ResourcePlan) -> None:
+        """Create-or-update keyed by the plan's job binding; stale versions
+        (≤ current) are rejected so late writers can't roll the plan back."""
+        plan.validate()
+        with self._lock:
+            if plan.job_name not in self._jobs:
+                raise KeyError(f"no such job {plan.job_name!r}")
+            cur = self._plans.get(plan.job_name)
+            if cur is not None and plan.version <= cur.version:
+                raise ValueError(
+                    f"stale plan version {plan.version} <= {cur.version}"
+                )
+            self._plans[plan.job_name] = plan
+        self._events.put(("plan_applied", plan.job_name))
+
+    def job(self, name: str) -> Optional[JobSpec]:
+        with self._lock:
+            return self._jobs.get(name)
+
+    def plan(self, job_name: str) -> Optional[ResourcePlan]:
+        with self._lock:
+            return self._plans.get(job_name)
+
+    def jobs(self) -> List[str]:
+        with self._lock:
+            return sorted(self._jobs)
+
+    def next_event(self, timeout: Optional[float] = None):
+        try:
+            return self._events.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def poke(self, job_name: str) -> None:
+        """External nudge (pod event, resync timer) → reconcile this job."""
+        self._events.put(("poke", job_name))
+
+
+@dataclass
+class JobStatus:
+    job: str
+    trainer_created: bool = False
+    pods: Dict[str, int] = field(default_factory=dict)  # role -> live count
+    last_ops: List[str] = field(default_factory=list)
+
+
+class ElasticJobController:
+    """The reconcile loop. Run :meth:`step` manually (tests/simulation) or
+    :meth:`start` a background thread that drains store events."""
+
+    def __init__(self, store: CrStore, pod_api: PodApi,
+                 force_python_core: bool = False):
+        self.store = store
+        self.pods = pod_api
+        self._force_py = force_python_core
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- reconcile
+    def reconcile_job(self, job_name: str) -> JobStatus:
+        """One level-triggered pass for one job; idempotent."""
+        status = JobStatus(job=job_name)
+        job = self.store.job(job_name)
+        observed = self.pods.list_pods(job_name)
+        if job is None:
+            # Job deleted: tear down whatever remains.
+            for p in observed:
+                self.pods.delete_pod(p.name)
+                status.last_ops.append(f"DELETE {p.name} (job gone)")
+            return status
+
+        # Figure step 3: trainer pod first, before any plan exists. The
+        # trainer is operator-owned: a Failed trainer is retired and replaced
+        # under a fresh name (names are never reused), independent of any plan.
+        trainer_pods = [p for p in observed if p.role == "trainer"]
+        for p in trainer_pods:
+            if p.phase == "Failed":
+                self.pods.delete_pod(p.name)
+                status.last_ops.append(f"DELETE {p.name} (failed)")
+        if not any(p.phase in ("Pending", "Running") for p in trainer_pods):
+            indices = [_trailing_index(p.name) for p in trainer_pods]
+            name = f"{job_name}-trainer-{max(indices, default=-1) + 1}"
+            self.pods.create_pod(
+                Pod(
+                    name=name, job=job_name, role="trainer",
+                    # ElasticJob carries no resources (README.md:19-23); the
+                    # trainer pod starts with defaults and can be vertically
+                    # scaled later via resource_updation.
+                    resource=ResourceSpec(),
+                    command=job.role_command("trainer"),
+                    image=job.role_image("trainer"),
+                )
+            )
+            status.last_ops.append(f"CREATE {name}")
+            status.trainer_created = True
+            observed = self.pods.list_pods(job_name)
+
+        plan = self.store.plan(job_name)
+        if plan is not None:
+            # Trainer pods are operator-owned (created above); the plan
+            # governs them only via resource_updation, never replica
+            # levelling, so strip any trainer role block before diffing (the
+            # core itself exempts "trainer" from absent-role scale-down).
+            plan_for_diff = plan
+            if "trainer" in plan.roles:
+                roles = {r: rp for r, rp in plan.roles.items() if r != "trainer"}
+                plan_for_diff = ResourcePlan(
+                    name=plan.name, job_name=plan.job_name, roles=roles,
+                    resource_updation=plan.resource_updation, version=plan.version,
+                )
+            ops, sigs = reconcile(
+                job_name, plan_for_diff, observed, force_python=self._force_py
+            )
+            for op in ops:
+                if op.verb == "CREATE":
+                    self.pods.create_pod(
+                        Pod(
+                            name=op.name, job=job_name, role=op.role,
+                            resource=sigs.get(op.resource_sig, ResourceSpec()),
+                            replaces=op.replaces,
+                            command=job.role_command(op.role),
+                            image=job.role_image(op.role),
+                        )
+                    )
+                else:
+                    self.pods.delete_pod(op.name)
+                status.last_ops.append(f"{op.verb} {op.name}"
+                                       + (f" ({op.reason})" if op.reason else ""))
+
+        for p in self.pods.list_pods(job_name):
+            if p.phase in ("Pending", "Running"):
+                status.pods[p.role] = status.pods.get(p.role, 0) + 1
+        if status.last_ops:
+            log.info("reconciled %s: %s", job_name, "; ".join(status.last_ops))
+        return status
+
+    def step(self, timeout: float = 0.0) -> Optional[JobStatus]:
+        """Process one store event (or return None on timeout)."""
+        ev = self.store.next_event(timeout=timeout)
+        if ev is None:
+            return None
+        kind, job_name = ev
+        return self.reconcile_job(job_name)
+
+    def reconcile_all(self) -> Dict[str, JobStatus]:
+        return {j: self.reconcile_job(j) for j in self.store.jobs()}
+
+    # ------------------------------------------------------------ background
+    def start(self, resync_s: float = 2.0) -> None:
+        def loop():
+            while not self._stop.is_set():
+                ev = self.store.next_event(timeout=resync_s)
+                if ev is not None:
+                    try:
+                        self.reconcile_job(ev[1])
+                    except Exception:  # keep the loop alive; next pass retries
+                        log.exception("reconcile failed for %s", ev[1])
+                else:
+                    for j in self.store.jobs():
+                        try:
+                            self.reconcile_job(j)
+                        except Exception:
+                            log.exception("resync failed for %s", j)
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="operator")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

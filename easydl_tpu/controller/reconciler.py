@@ -1,0 +1,201 @@
+"""Reconcile decision function: (ResourcePlan, observed pods) → pod ops.
+
+The C++ core (native/reconciler_core.cc) is the production decision engine;
+:func:`_py_reconcile` is its pure-Python twin (same wire format, same rules)
+used when no toolchain exists — and pinned to the core by a parity test
+(tests/test_controller.py) so the two can't drift.
+
+Semantics implemented (all from the reference design doc):
+- failed pods are retired and their slots recreated (README.md:26-29);
+- ``resource_updation`` entries replace-then-retire: new pod first, old pod
+  deleted only when the replacement is Running
+  (docs/design/elastic-training-operator.md:99-101);
+- per-role replica counts are levelled, creating fresh names / deleting the
+  highest indices (:53-55, :97-98).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from easydl_tpu.api.job_spec import ResourceSpec
+from easydl_tpu.api.resource_plan import ResourcePlan
+from easydl_tpu.controller.pod_api import Pod
+from easydl_tpu.utils.native import load_native
+
+_SOURCE = os.path.join(os.path.dirname(__file__), "native", "reconciler_core.cc")
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.edr_reconcile.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.edr_reconcile.restype = ctypes.c_void_p  # manual free via edr_free
+    lib.edr_free.argtypes = [ctypes.c_void_p]
+
+
+def resource_sig(resource: ResourceSpec) -> str:
+    """Deterministic short signature for change detection on the wire."""
+    blob = json.dumps(resource.to_dict(), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
+@dataclass(frozen=True)
+class PodOp:
+    verb: str  # "CREATE" | "DELETE"
+    name: str
+    role: str = ""
+    resource_sig: str = ""
+    replaces: str = ""
+    reason: str = ""
+
+
+def encode_desired(job: str, plan: ResourcePlan) -> Tuple[str, Dict[str, ResourceSpec]]:
+    """Wire-encode the plan; also return sig→ResourceSpec so ops can be
+    materialised back into full pod specs."""
+    sigs: Dict[str, ResourceSpec] = {}
+    lines = [f"J|{job}"]
+    for role, rp in plan.roles.items():
+        sig = resource_sig(rp.resource)
+        sigs[sig] = rp.resource
+        lines.append(f"R|{role}|{rp.replicas}|{sig}")
+    for u in plan.resource_updation:
+        sig = resource_sig(u.resource)
+        sigs[sig] = u.resource
+        lines.append(f"U|{u.name}|{sig}")
+    return "\n".join(lines) + "\n", sigs
+
+
+def encode_observed(pods: List[Pod]) -> str:
+    return "".join(
+        f"P|{p.name}|{p.role}|{p.phase}|{resource_sig(p.resource)}|{p.replaces}\n"
+        for p in pods
+    )
+
+
+def decode_ops(text: str) -> List[PodOp]:
+    ops: List[PodOp] = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        f = line.split("|")
+        if f[0] == "CREATE":
+            ops.append(PodOp("CREATE", f[1], role=f[2], resource_sig=f[3],
+                             replaces=f[4] if len(f) > 4 else ""))
+        elif f[0] == "DELETE":
+            ops.append(PodOp("DELETE", f[1], reason=f[2] if len(f) > 2 else ""))
+    return ops
+
+
+# --------------------------------------------------------------- python twin
+
+
+def _trailing_index(name: str) -> int:
+    head, _, tail = name.rpartition("-")
+    return int(tail) if head and tail.isdigit() else -1
+
+
+def _py_reconcile(desired: str, observed: str) -> str:
+    job, roles, updations, pods = "", {}, [], []
+    for line in desired.splitlines():
+        f = line.split("|")
+        if f[0] == "J" and len(f) >= 2:
+            job = f[1]
+        elif f[0] == "R" and len(f) >= 4:
+            roles[f[1]] = (int(f[2]), f[3])
+        elif f[0] == "U" and len(f) >= 3:
+            updations.append((f[1], f[2]))
+    for line in observed.splitlines():
+        f = line.split("|")
+        if f[0] == "P" and len(f) >= 6:
+            pods.append(
+                {"name": f[1], "role": f[2], "phase": f[3], "sig": f[4],
+                 "replaces": f[5], "index": _trailing_index(f[1])}
+            )
+
+    next_index: Dict[str, int] = {}
+    for p in pods:
+        next_index[p["role"]] = max(next_index.get(p["role"], 0), p["index"] + 1)
+
+    def next_name(role: str) -> str:
+        n = next_index[role] = next_index.get(role, 0)
+        next_index[role] = n + 1
+        return f"{job}-{role}-{n}"
+
+    ops: List[str] = []
+    gone = set()
+    for p in pods:
+        if p["phase"] == "Failed":
+            ops.append(f"DELETE|{p['name']}|failed")
+            gone.add(p["name"])
+
+    by_name = {p["name"]: p for p in pods if p["name"] not in gone}
+    replacement_of = {
+        p["replaces"]: p
+        for p in pods
+        if p["name"] not in gone and p["replaces"] and p["replaces"] in by_name
+    }
+
+    for name, sig in updations:
+        old = by_name.get(name)
+        if old is None or old["phase"] == "Terminating":
+            continue
+        rep = replacement_of.get(name)
+        if rep is not None:
+            if rep["phase"] == "Running":
+                ops.append(f"DELETE|{name}|replaced")
+                gone.add(name)
+        else:
+            ops.append(f"CREATE|{next_name(old['role'])}|{old['role']}|{sig}|{name}")
+
+    # Roles with pods but absent from the plan mean replicas 0 (omission must
+    # not orphan pods); trainer is operator-owned, never levelled here.
+    for p in pods:
+        if p["role"] != "trainer" and p["role"] not in roles:
+            roles[p["role"]] = (0, "")
+
+    def replacement_in_flight(p) -> bool:
+        # Excluded from the count only while the pod it replaces still serves.
+        if not p["replaces"] or p["replaces"] in gone:
+            return False
+        old = by_name.get(p["replaces"])
+        return old is not None and old["phase"] in ("Pending", "Running")
+
+    for role in sorted(roles):  # C++ core iterates a std::map: sorted
+        want, sig = roles[role]
+        active = [
+            p for p in pods
+            if p["role"] == role and p["name"] not in gone
+            and p["phase"] in ("Pending", "Running")
+            and not replacement_in_flight(p)
+        ]
+        for _ in range(max(0, want - len(active))):
+            ops.append(f"CREATE|{next_name(role)}|{role}|{sig}|")
+        if len(active) > want:
+            for p in sorted(active, key=lambda p: -p["index"])[: len(active) - want]:
+                ops.append(f"DELETE|{p['name']}|scale_down")
+                gone.add(p["name"])
+    return "".join(op + "\n" for op in ops)
+
+
+def reconcile_wire(desired: str, observed: str, force_python: bool = False) -> str:
+    """Run the decision function on wire-format inputs."""
+    lib = None if force_python else load_native(_SOURCE, _bind)
+    if lib is None:
+        return _py_reconcile(desired, observed)
+    ptr = lib.edr_reconcile(desired.encode(), observed.encode())
+    try:
+        return ctypes.string_at(ptr).decode()
+    finally:
+        lib.edr_free(ptr)
+
+
+def reconcile(job: str, plan: ResourcePlan, pods: List[Pod],
+              force_python: bool = False) -> Tuple[List[PodOp], Dict[str, ResourceSpec]]:
+    """High-level entry: returns (ops, sig→ResourceSpec)."""
+    desired, sigs = encode_desired(job, plan)
+    observed = encode_observed(pods)
+    return decode_ops(reconcile_wire(desired, observed, force_python)), sigs

@@ -1,58 +1,18 @@
-"""Compile-on-first-use build of the native embedding store.
-
-No pip/pybind11 in the image, so the C++ core
-(:file:`easydl_tpu/ps/native/embedding_store.cc`) is compiled with ``g++``
-into a shared library the first time it's needed and cached next to the
-source, keyed by a hash of the source + compile flags. Concurrent builders
-(e.g. pytest-xdist, multiple PS shards starting at once) race safely: the
-compile writes to a unique temp file and ``os.replace``\\ s it into place.
-"""
+"""Loader for the native embedding store (see easydl_tpu/utils/native.py for
+the compile-and-cache machinery shared by all C++ cores)."""
 
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import shutil
-import subprocess
-import tempfile
 from typing import Optional
 
-from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.native import load_native as _load
 
-log = get_logger("ps", "build")
-
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
-_SOURCE = os.path.join(_NATIVE_DIR, "embedding_store.cc")
-_CXXFLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-Wall"]
-
-_lib: Optional[ctypes.CDLL] = None
-_load_error: Optional[str] = None
+_SOURCE = os.path.join(os.path.dirname(__file__), "native", "embedding_store.cc")
 
 
-def _lib_path() -> str:
-    with open(_SOURCE, "rb") as f:
-        digest = hashlib.sha256(f.read() + " ".join(_CXXFLAGS).encode()).hexdigest()[:16]
-    return os.path.join(_NATIVE_DIR, "_build", f"embedding_store-{digest}.so")
-
-
-def _compile(target: str) -> None:
-    os.makedirs(os.path.dirname(target), exist_ok=True)
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(target))
-    os.close(fd)
-    try:
-        cmd = ["g++", *_CXXFLAGS, "-o", tmp, _SOURCE]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, target)  # atomic; last concurrent builder wins
-        log.info("compiled %s", os.path.basename(target))
-    except subprocess.CalledProcessError as e:
-        raise RuntimeError(f"g++ failed building embedding store:\n{e.stderr}") from e
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-
-
-def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+def _bind(lib: ctypes.CDLL) -> None:
     i64p = ctypes.POINTER(ctypes.c_int64)
     f32p = ctypes.POINTER(ctypes.c_float)
     lib.eds_create.argtypes = [
@@ -70,26 +30,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.eds_export.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
     lib.eds_export.restype = ctypes.c_int64
     lib.eds_import.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
-    return lib
 
 
 def load_native() -> Optional[ctypes.CDLL]:
-    """The compiled library, or None when no C++ toolchain is available
-    (callers fall back to the numpy store)."""
-    global _lib, _load_error
-    if _lib is not None or _load_error is not None:
-        return _lib
-    if shutil.which("g++") is None:
-        _load_error = "g++ not found"
-        log.warning("no g++ in PATH — PS tables use the numpy fallback")
-        return None
-    try:
-        path = _lib_path()
-        if not os.path.exists(path):
-            _compile(path)
-        _lib = _bind(ctypes.CDLL(path))
-    except (RuntimeError, OSError) as e:
-        _load_error = str(e)
-        log.warning("native embedding store unavailable (%s) — numpy fallback", e)
-        return None
-    return _lib
+    """The compiled embedding store, or None (numpy fallback)."""
+    return _load(_SOURCE, _bind)

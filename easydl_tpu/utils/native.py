@@ -1,0 +1,79 @@
+"""Compile-on-first-use loader for the framework's C++ cores.
+
+No pip/pybind11 in the image, so native components (the PS embedding store,
+the controller's reconciler core — the C++ surfaces the reference anticipated
+via its clang-format/cpplint hooks, .pre-commit-config.yaml:24-41) are built
+with ``g++`` into shared libraries on first use and cached next to their
+source, keyed by a hash of source + flags. Concurrent builders race safely:
+each writes a unique temp file and ``os.replace``\\ s it into place.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import Callable, Dict, Optional
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("utils", "native")
+
+CXXFLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-Wall"]
+
+_cache: Dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def _compile(source: str, target: str) -> None:
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(target))
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", *CXXFLAGS, "-o", tmp, source],
+            check=True, capture_output=True, text=True,
+        )
+        os.replace(tmp, target)  # atomic; last concurrent builder wins
+        log.info("compiled %s", os.path.basename(target))
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"g++ failed building {os.path.basename(source)}:\n{e.stderr}"
+        ) from e
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_native(source: str, bind: Callable[[ctypes.CDLL], None]) -> Optional[ctypes.CDLL]:
+    """Compile (if needed) and load ``source``; ``bind`` sets argtypes.
+    Returns None when no toolchain is available — callers fall back to their
+    pure-Python twin. The result (including failure) is cached per source."""
+    if source in _cache:
+        return _cache[source]
+    lib: Optional[ctypes.CDLL] = None
+    if shutil.which("g++") is None:
+        log.warning("no g++ in PATH — %s uses its Python fallback",
+                    os.path.basename(source))
+    else:
+        try:
+            with open(source, "rb") as f:
+                digest = hashlib.sha256(
+                    f.read() + " ".join(CXXFLAGS).encode()
+                ).hexdigest()[:16]
+            base = os.path.splitext(os.path.basename(source))[0]
+            path = os.path.join(
+                os.path.dirname(source), "_build", f"{base}-{digest}.so"
+            )
+            if not os.path.exists(path):
+                _compile(source, path)
+            lib = ctypes.CDLL(path)
+            bind(lib)
+        except (RuntimeError, OSError) as e:
+            log.warning("native %s unavailable (%s) — Python fallback",
+                        os.path.basename(source), e)
+            lib = None
+    _cache[source] = lib
+    return lib

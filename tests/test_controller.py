@@ -1,0 +1,320 @@
+"""Controller tests: reconcile decisions (native core + Python twin parity),
+job lifecycle (trainer-pod-first), scaling, failure recovery, and
+replace-then-retire vertical scaling (SURVEY.md §4 item 4;
+docs/design/elastic-training-operator.md:47-55,97-101)."""
+
+import random
+
+import pytest
+
+from easydl_tpu.api.job_spec import JobSpec, ResourceSpec, RoleSpec
+from easydl_tpu.api.resource_plan import ResourcePlan, ResourceUpdation, RolePlan
+from easydl_tpu.controller import (
+    CrStore,
+    ElasticJobController,
+    InMemoryPodApi,
+    Pod,
+    reconcile,
+    reconcile_wire,
+)
+from easydl_tpu.controller.reconciler import _SOURCE, _bind, _py_reconcile
+from easydl_tpu.utils.native import load_native
+
+
+def make_job(name="deepctr"):
+    return JobSpec(
+        name=name, image="easydl:iris", command="python -m model_zoo.iris",
+        roles={"worker": RoleSpec(), "parameter_server": RoleSpec()},
+    )
+
+
+def make_plan(job="deepctr", ps=1, workers=2, version=1, updations=()):
+    return ResourcePlan(
+        name=f"{job}-plan", job_name=job, version=version,
+        roles={
+            "parameter_server": RolePlan(ps, ResourceSpec(cpu=4, memory=4096)),
+            "worker": RolePlan(workers, ResourceSpec(cpu=8, memory=8192)),
+        },
+        resource_updation=list(updations),
+    )
+
+
+# ----------------------------------------------------------------- decision
+
+
+def test_native_core_builds():
+    assert load_native(_SOURCE, _bind) is not None
+
+
+def test_native_python_parity_randomized():
+    """The C++ core and its Python twin must make identical decisions on
+    randomized cluster states."""
+    rng = random.Random(0)
+    phases = ["Pending", "Running", "Failed", "Terminating"]
+    for trial in range(200):
+        job = "j"
+        n_pods = rng.randint(0, 8)
+        observed_lines = []
+        names = set()
+        for i in range(n_pods):
+            role = rng.choice(["worker", "parameter_server"])
+            name = f"{job}-{role}-{rng.randint(0, 9)}"
+            if name in names:
+                continue
+            names.add(name)
+            replaces = rng.choice(["", *names - {name}]) if rng.random() < 0.3 else ""
+            observed_lines.append(
+                f"P|{name}|{role}|{rng.choice(phases)}|sig{rng.randint(0,2)}|{replaces}"
+            )
+        desired_lines = [f"J|{job}"]
+        for role in ("worker", "parameter_server"):
+            if rng.random() < 0.9:
+                desired_lines.append(f"R|{role}|{rng.randint(0,5)}|sig0")
+        for name in list(names)[:2]:
+            if rng.random() < 0.4:
+                desired_lines.append(f"U|{name}|sig9")
+        desired = "\n".join(desired_lines) + "\n"
+        observed = "".join(line + "\n" for line in observed_lines)
+        native = reconcile_wire(desired, observed)
+        python = _py_reconcile(desired, observed)
+        assert native == python, (
+            f"trial {trial}: core/twin divergence\n"
+            f"desired:\n{desired}observed:\n{observed}"
+            f"native:\n{native}python:\n{python}"
+        )
+
+
+# ---------------------------------------------------------------- lifecycle
+
+
+def test_trainer_pod_first():
+    """Figure steps 1-3: job submission creates ONLY the trainer pod."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    ctl.step(timeout=1)
+    pods = api.list_pods("deepctr")
+    assert [p.name for p in pods] == ["deepctr-trainer-0"]
+    assert pods[0].command == "python -m model_zoo.iris"
+
+
+def test_plan_creates_role_pods():
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    ctl.step(timeout=1)
+    store.apply_plan(make_plan(ps=1, workers=2))
+    ctl.step(timeout=1)
+    roles = sorted((p.role, p.name) for p in api.list_pods("deepctr"))
+    assert roles == [
+        ("parameter_server", "deepctr-parameter_server-0"),
+        ("trainer", "deepctr-trainer-0"),
+        ("worker", "deepctr-worker-0"),
+        ("worker", "deepctr-worker-1"),
+    ]
+    # pods carry the plan's resources
+    w = api.get_pod("deepctr-worker-0")
+    assert w.resource.cpu == 8 and w.resource.memory == 8192
+
+
+def test_scale_up_and_down():
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(workers=2))
+    ctl.reconcile_job("deepctr")
+    api.tick()  # all Running
+    store.apply_plan(make_plan(workers=4, version=2))
+    ctl.reconcile_job("deepctr")
+    workers = [p for p in api.list_pods("deepctr") if p.role == "worker"]
+    assert len(workers) == 4
+    store.apply_plan(make_plan(workers=1, version=3))
+    ctl.reconcile_job("deepctr")
+    workers = [p for p in api.list_pods("deepctr") if p.role == "worker"]
+    # highest indices retired first
+    assert [p.name for p in workers] == ["deepctr-worker-0"]
+
+
+def test_failed_pod_recovered_with_fresh_name():
+    """README.md:26-29: failed workers are recovered; names never reused."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(workers=2))
+    ctl.reconcile_job("deepctr")
+    api.tick()
+    api.fail("deepctr-worker-0")
+    ctl.reconcile_job("deepctr")
+    workers = sorted(p.name for p in api.list_pods("deepctr") if p.role == "worker")
+    assert workers == ["deepctr-worker-1", "deepctr-worker-2"]
+
+
+def test_replace_then_retire():
+    """docs/design/elastic-training-operator.md:99-101: the replacement pod
+    launches first; the old pod is retired only once it's Running."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(ps=2, workers=1))
+    ctl.reconcile_job("deepctr")
+    api.tick()
+
+    upd = ResourceUpdation("deepctr-parameter_server-0", ResourceSpec(cpu=16, memory=16384))
+    store.apply_plan(make_plan(ps=2, workers=1, version=2, updations=[upd]))
+    ctl.reconcile_job("deepctr")
+    ps = {p.name: p for p in api.list_pods("deepctr") if p.role == "parameter_server"}
+    # replacement created (Pending), old still present and serving
+    assert len(ps) == 3
+    rep = next(p for p in ps.values() if p.replaces == "deepctr-parameter_server-0")
+    assert rep.phase == "Pending" and rep.resource.cpu == 16
+    assert ps["deepctr-parameter_server-0"].phase == "Running"
+
+    # a second pass while the replacement is still Pending must not create
+    # another replacement (idempotence)
+    ctl.reconcile_job("deepctr")
+    assert len([p for p in api.list_pods("deepctr") if p.role == "parameter_server"]) == 3
+
+    api.tick()  # replacement becomes Running
+    ctl.reconcile_job("deepctr")
+    ps_after = [p for p in api.list_pods("deepctr") if p.role == "parameter_server"]
+    names = sorted(p.name for p in ps_after)
+    assert "deepctr-parameter_server-0" not in names and len(ps_after) == 2
+    # steady state: nothing more to do
+    ctl.reconcile_job("deepctr")
+    assert len([p for p in api.list_pods("deepctr") if p.role == "parameter_server"]) == 2
+
+
+def test_replace_then_retire_graceful_no_churn():
+    """With graceful deletion the retired pod lingers Terminating; the
+    running replacement owns the slot — no spurious extra pod may appear."""
+    store, api = CrStore(), InMemoryPodApi(graceful=True)
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(ps=1, workers=1))
+    ctl.reconcile_job("deepctr")
+    api.tick()
+    upd = ResourceUpdation("deepctr-parameter_server-0", ResourceSpec(cpu=16))
+    store.apply_plan(make_plan(ps=1, workers=1, version=2, updations=[upd]))
+    ctl.reconcile_job("deepctr")
+    api.tick()  # replacement Running
+    ctl.reconcile_job("deepctr")  # retires old ps-0 -> Terminating
+    old = api.get_pod("deepctr-parameter_server-0")
+    assert old is not None and old.phase == "Terminating"
+    ctl.reconcile_job("deepctr")  # must NOT create a third ps pod
+    ps = [p for p in api.list_pods("deepctr") if p.role == "parameter_server"]
+    assert sorted(p.phase for p in ps) == ["Running", "Terminating"]
+
+
+def test_role_omitted_from_plan_scales_to_zero():
+    """Dropping a role key from a newer plan means replicas 0 — its pods
+    must be retired, not orphaned."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    plan = make_plan(ps=1, workers=2)
+    plan.roles["evaluator"] = RolePlan(2, ResourceSpec(cpu=2))
+    store.apply_plan(plan)
+    ctl.reconcile_job("deepctr")
+    api.tick()
+    assert len([p for p in api.list_pods("deepctr") if p.role == "evaluator"]) == 2
+    store.apply_plan(make_plan(ps=1, workers=2, version=2))  # no evaluator key
+    ctl.reconcile_job("deepctr")
+    assert [p for p in api.list_pods("deepctr") if p.role == "evaluator"] == []
+    # trainer is exempt from absent-role scale-down
+    assert [p.role for p in api.list_pods("deepctr") if p.role == "trainer"]
+
+
+def test_failed_trainer_recreated_fresh_name():
+    """A trainer crash before any plan exists must not strand the job; the
+    replacement gets a fresh index."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    ctl.reconcile_job("deepctr")
+    api.tick()
+    api.fail("deepctr-trainer-0")
+    ctl.reconcile_job("deepctr")
+    trainers = [p for p in api.list_pods("deepctr") if p.role == "trainer"]
+    assert [p.name for p in trainers] == ["deepctr-trainer-1"]
+    assert trainers[0].phase == "Pending"
+
+
+def test_stale_plan_rejected():
+    store, api = CrStore(), InMemoryPodApi()
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(version=2))
+    with pytest.raises(ValueError, match="stale"):
+        store.apply_plan(make_plan(version=2))
+    with pytest.raises(KeyError):
+        store.apply_plan(make_plan(job="nosuch", version=1))
+
+
+def test_job_deletion_tears_down_pods():
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    store.apply_plan(make_plan())
+    ctl.reconcile_job("deepctr")
+    assert api.list_pods("deepctr")
+    store.delete_job("deepctr")
+    ctl.reconcile_job("deepctr")
+    assert api.list_pods("deepctr") == []
+
+
+def test_example_manifests_parse():
+    """The shipped manifests/examples must round-trip through the API
+    contracts (schema drift between manifests/ and api/ fails here)."""
+    import glob
+    import os
+
+    import yaml
+
+    root = os.path.join(os.path.dirname(__file__), "..", "manifests", "examples")
+    docs = []
+    for path in sorted(glob.glob(os.path.join(root, "*.yaml"))):
+        with open(path) as f:
+            docs.extend(d for d in yaml.safe_load_all(f) if isinstance(d, dict))
+    assert docs, "no example manifests found"
+    kinds = set()
+    for doc in docs:
+        if doc["kind"] == "ElasticJob":
+            JobSpec.from_crd(doc).validate()
+        elif doc["kind"] == "JobResource":
+            plan = ResourcePlan.from_crd(doc)
+            plan.validate()
+            assert plan.total_tpu_chips > 0  # the TPU example demands chips
+        kinds.add(doc["kind"])
+    assert kinds == {"ElasticJob", "JobResource"}
+
+
+def test_background_controller_converges():
+    """Event-driven loop: submit → plan → pod failure, all absorbed without
+    manual reconcile calls."""
+    import time
+
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    api.watch(lambda verb, name: store.poke("deepctr") if verb == "failed" else None)
+    ctl.start(resync_s=0.05)
+    try:
+        store.submit_job(make_job())
+        store.apply_plan(make_plan(workers=3))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if len([p for p in api.list_pods("deepctr") if p.role == "worker"]) == 3:
+                break
+            time.sleep(0.02)
+        api.tick()
+        api.fail("deepctr-worker-1")
+        while time.time() < deadline:
+            live = [
+                p for p in api.list_pods("deepctr")
+                if p.role == "worker" and p.phase in ("Pending", "Running")
+            ]
+            if len(live) == 3 and "deepctr-worker-1" not in {p.name for p in live}:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("controller did not recover failed worker")
+    finally:
+        ctl.stop()
