@@ -30,17 +30,20 @@ def ingest(store: CrStore, path: str, seen: dict) -> None:
             continue
         full = os.path.join(path, fname)
         # One bad file (syntax error, deleted mid-scan) must not take the
-        # operator down with it — log and move to the next file.
+        # operator down with it — log and move to the next file. A file is
+        # marked seen only after every document lands, so transient failures
+        # (a plan whose job file sorts after it, a momentary read error) are
+        # retried on the next scan instead of being dropped forever.
         try:
             mtime = os.path.getmtime(full)
             if seen.get(full) == mtime:
                 continue
-            seen[full] = mtime
             with open(full) as f:
                 docs = [d for d in yaml.safe_load_all(f) if isinstance(d, dict)]
         except (OSError, yaml.YAMLError) as e:
             log.error("unreadable manifest %s: %s", fname, e)
             continue
+        retry = False
         for doc in docs:
             try:
                 if doc.get("kind") == JOB_KIND:
@@ -56,8 +59,12 @@ def ingest(store: CrStore, path: str, seen: dict) -> None:
                                  plan.version, plan.job_name, fname)
                     except ValueError:
                         pass  # stale version: file unchanged since apply
+                    except KeyError:
+                        retry = True  # job not ingested yet: next scan
             except Exception as e:
                 log.error("bad document in %s: %s", fname, e)
+        if not retry:
+            seen[full] = mtime
 
 
 def main() -> None:

@@ -171,6 +171,7 @@ class ElasticJobController:
             ops, sigs = reconcile(
                 job_name, plan_for_diff, observed, force_python=self._force_py
             )
+            self._warn_resource_drift(job_name, plan_for_diff, observed)
             for op in ops:
                 if op.verb == "CREATE":
                     self.pods.create_pod(
@@ -193,6 +194,31 @@ class ElasticJobController:
         if status.last_ops:
             log.info("reconciled %s: %s", job_name, "; ".join(status.last_ops))
         return status
+
+    def _warn_resource_drift(self, job_name: str, plan: ResourcePlan,
+                             observed) -> None:
+        """Existing pods are never resized by a role-resource edit (reference
+        semantics: vertical scaling is explicit resource_updation,
+        docs/design/elastic-training-operator.md:86-101) — surface the drift
+        so the user knows to issue one."""
+        from easydl_tpu.controller.reconciler import resource_sig
+
+        warned = getattr(self, "_drift_warned", set())
+        self._drift_warned = warned
+        for role, rp in plan.roles.items():
+            want_sig = resource_sig(rp.resource)
+            for p in observed:
+                if (p.role == role and p.phase in ("Pending", "Running")
+                        and not p.replaces
+                        and resource_sig(p.resource) != want_sig
+                        and (job_name, p.name, want_sig) not in warned):
+                    warned.add((job_name, p.name, want_sig))
+                    log.warning(
+                        "%s: pod %s resources differ from plan role %r; "
+                        "existing pods are not auto-resized — add a "
+                        "resource_updation entry to replace it",
+                        job_name, p.name, role,
+                    )
 
     def step(self, timeout: float = 0.0) -> Optional[JobStatus]:
         """Process one store event (or return None on timeout)."""

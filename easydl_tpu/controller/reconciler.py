@@ -38,7 +38,14 @@ def _bind(lib: ctypes.CDLL) -> None:
 
 
 def resource_sig(resource: ResourceSpec) -> str:
-    """Deterministic short signature for change detection on the wire."""
+    """Deterministic short signature identifying a resource shape.
+
+    Used to materialise CREATE ops back into full specs and to *detect* (not
+    act on) role-level resource drift: per the reference, a changed role
+    resource applies to newly created pods only — existing pods are resized
+    exclusively through explicit ``resource_updation`` replace-then-retire
+    entries (docs/design/elastic-training-operator.md:86-101). The operator
+    logs drift so users know a resource_updation is needed."""
     blob = json.dumps(resource.to_dict(), sort_keys=True).encode()
     return hashlib.sha256(blob).hexdigest()[:12]
 

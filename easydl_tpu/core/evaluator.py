@@ -1,0 +1,99 @@
+"""Checkpoint-following evaluator — the reference's evaluator role
+(docs/design/elastic-training-operator.md:43-44,79-85: side evaluation,
+replicas 1) reshaped for TPU elasticity.
+
+The evaluator never joins the training collective: it follows the checkpoint
+directory, restoring each newly *committed* step onto its own (usually
+smaller) mesh — reshard-on-restore makes the mesh mismatch a non-event —
+and runs the model's eval function over held-out batches. Training world
+membership can change or crash freely without touching evaluation, which is
+exactly why the reference keeps the evaluator a separate pod.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from easydl_tpu.core.checkpoint import CheckpointManager
+from easydl_tpu.core.train_loop import LossFn, Trainer
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("core", "evaluator")
+
+
+class Evaluator:
+    """Evaluate every new checkpoint step.
+
+    Args:
+      trainer: a Trainer built with the SAME init_fn/optimizer as training
+        (it defines the abstract state tree + this process's shardings);
+        its compiled train step is never used here.
+      eval_fn: ``(params, batch, rng) -> (loss, metrics)`` (defaults to the
+        trainer's loss_fn).
+      checkpoint: manager over the training run's checkpoint directory.
+      data: host-batch iterator of held-out data.
+      batches_per_eval: batches averaged per checkpoint.
+    """
+
+    def __init__(
+        self,
+        trainer: Trainer,
+        checkpoint: CheckpointManager,
+        data: Iterator[Any],
+        eval_fn: Optional[LossFn] = None,
+        batches_per_eval: int = 8,
+        on_result: Optional[Callable[[Dict[str, float]], None]] = None,
+    ):
+        self.trainer = trainer
+        self.checkpoint = checkpoint
+        self.data = data
+        self.batches_per_eval = batches_per_eval
+        self.on_result = on_result
+        self._eval_step = trainer.build_eval_step(eval_fn or trainer.loss_fn)
+        self._last_step: Optional[int] = None
+        self._stop = threading.Event()
+        self.results: list = []
+
+    def poll_once(self) -> Optional[Dict[str, float]]:
+        """Evaluate the latest checkpoint if it's new; None otherwise."""
+        step = self.checkpoint.latest_step()
+        if step is None or step == self._last_step:
+            return None
+        abstract, _, _ = self.trainer._abstract_state()
+        state = self.checkpoint.restore(
+            step, abstract, self.trainer.state_shardings()
+        )
+        sums: Dict[str, float] = {}
+        for _ in range(self.batches_per_eval):
+            aux = self._eval_step(state, self.trainer.shard_batch(next(self.data)))
+            for k, v in aux.items():
+                sums[k] = sums.get(k, 0.0) + float(jax.device_get(v))
+        result = {k: v / self.batches_per_eval for k, v in sums.items()}
+        result["step"] = float(step)
+        self._last_step = step
+        self.results.append(result)
+        log.info("eval @ step %d: %s", step,
+                 ", ".join(f"{k}={v:.4f}" for k, v in result.items() if k != "step"))
+        if self.on_result is not None:
+            self.on_result(result)
+        return result
+
+    def run(self, poll_interval_s: float = 5.0,
+            max_evals: Optional[int] = None) -> None:
+        """Follow the checkpoint dir until :meth:`stop` (or ``max_evals``)."""
+        n = 0
+        while not self._stop.is_set():
+            if self.poll_once() is not None:
+                n += 1
+                if max_evals is not None and n >= max_evals:
+                    return
+            else:
+                self._stop.wait(poll_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,108 @@
+"""``python -m easydl_tpu.models.run`` — the model-zoo entrypoint.
+
+This is the command a job's pods execute (the reference quickstart runs
+``python -m model_zoo.iris.dnn_estimator``,
+docs/design/elastic-training-operator.md:37; our manifests point here).
+Roles:
+
+- ``--role trainer`` (default): single-process training loop with periodic
+  checkpointing — the path worker pods run under the elastic runtime too
+  (the agent sets the distributed env; see easydl_tpu/elastic/worker.py).
+- ``--role evaluator``: checkpoint-following side evaluation
+  (easydl_tpu/core/evaluator.py).
+
+Data is synthetic per model bundle, so any config runs hermetically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description="easydl_tpu model zoo runner")
+    ap.add_argument("--model", required=True, help="registry name (mlp, resnet, bert, gpt, deepfm, widedeep)")
+    ap.add_argument("--role", choices=["trainer", "evaluator"], default="trainer")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--ckpt-every", type=int, default=50)
+    ap.add_argument("--dp", type=int, default=0, help="data-parallel size (0 = all devices)")
+    ap.add_argument("--eval-polls", type=int, default=0, help="evaluator: stop after N evals (0 = forever)")
+    ap.add_argument("--model-arg", action="append", default=[],
+                    help="k=v forwarded to the model factory (repeatable)")
+    return ap
+
+
+def main() -> None:
+    ap = build_parser()
+    args = ap.parse_args()
+
+    import jax
+    import optax
+
+    from easydl_tpu.core.checkpoint import CheckpointManager
+    from easydl_tpu.core.mesh import MeshSpec
+    from easydl_tpu.core.metrics import MetricsRecorder
+    from easydl_tpu.core.train_loop import TrainConfig, Trainer
+    from easydl_tpu.models.registry import get_model
+    from easydl_tpu.utils.logging import get_logger
+
+    log = get_logger("models", "run")
+
+    kwargs = {}
+    for kv in args.model_arg:
+        k, _, v = kv.partition("=")
+        try:
+            kwargs[k] = json.loads(v)
+        except json.JSONDecodeError:
+            kwargs[k] = v
+    bundle = get_model(args.model, **kwargs)
+
+    dp = args.dp or jax.device_count()
+    trainer = Trainer(
+        init_fn=bundle.init_fn,
+        loss_fn=bundle.loss_fn,
+        optimizer=optax.adamw(args.lr),
+        config=TrainConfig(global_batch=args.batch),
+        mesh_spec=MeshSpec(dp=dp),
+    )
+    ckpt = CheckpointManager(args.ckpt_dir) if args.ckpt_dir else None
+
+    if args.role == "evaluator":
+        if ckpt is None:
+            ap.error("--role evaluator requires --ckpt-dir")
+        from easydl_tpu.core.evaluator import Evaluator
+
+        ev = Evaluator(
+            trainer, ckpt, iter(bundle.make_data(args.batch, seed=1)),
+            eval_fn=bundle.eval_fn,
+        )
+        ev.run(poll_interval_s=2.0, max_evals=args.eval_polls or None)
+        return
+
+    state = trainer.init_state()
+    if ckpt is not None and ckpt.latest_step() is not None:
+        abstract, _, _ = trainer._abstract_state()
+        state = ckpt.restore(ckpt.latest_step(), abstract, trainer.state_shardings())
+        log.info("resumed from step %d", state.int_step)
+    data = iter(bundle.make_data(args.batch, seed=0))
+    recorder = MetricsRecorder(args.batch, world_size=dp)
+    while state.int_step < args.steps:
+        recorder.start_step()
+        state, metrics = trainer.train_step(state, next(data))
+        step = state.int_step
+        rec = recorder.end_step(step, float(metrics["loss"]))
+        if step % 10 == 0 or step == args.steps:
+            log.info("step %d loss %.4f (%.1f samples/s)", step, rec.loss,
+                     rec.samples_per_sec)
+        if ckpt is not None and (step % args.ckpt_every == 0 or step == args.steps):
+            ckpt.save(step, state)
+    if ckpt is not None:
+        ckpt.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -276,9 +276,18 @@ def test_example_manifests_parse():
             docs.extend(d for d in yaml.safe_load_all(f) if isinstance(d, dict))
     assert docs, "no example manifests found"
     kinds = set()
+    runner_prefix = "python -m easydl_tpu.models.run "
     for doc in docs:
         if doc["kind"] == "ElasticJob":
-            JobSpec.from_crd(doc).validate()
+            job = JobSpec.from_crd(doc)
+            job.validate()
+            # the entry command's flags must be accepted by the zoo runner
+            # (example-vs-CLI drift crashloops every pod)
+            if job.command.startswith(runner_prefix):
+                from easydl_tpu.models.run import build_parser
+
+                argv = job.command[len(runner_prefix):].split()
+                build_parser().parse_args(argv)  # SystemExit on bad flags
         elif doc["kind"] == "JobResource":
             plan = ResourcePlan.from_crd(doc)
             plan.validate()

@@ -1,0 +1,105 @@
+"""Evaluator-role tests: checkpoint-following side evaluation, including
+restore onto a different mesh than training saved (SURVEY.md §2 evaluator
+row; docs/design/elastic-training-operator.md:43-44,79-85)."""
+
+import jax.numpy as jnp
+import optax
+import pytest
+
+from easydl_tpu.core.checkpoint import CheckpointManager
+from easydl_tpu.core.evaluator import Evaluator
+from easydl_tpu.core.mesh import MeshSpec
+from easydl_tpu.core.train_loop import TrainConfig, Trainer
+from easydl_tpu.models.registry import get_model
+
+
+def make_trainer(bundle, spec, batch=16):
+    return Trainer(
+        init_fn=bundle.init_fn,
+        loss_fn=bundle.loss_fn,
+        optimizer=optax.adam(1e-2),
+        config=TrainConfig(global_batch=batch, compute_dtype=jnp.float32),
+        mesh_spec=spec,
+    )
+
+
+@pytest.fixture(scope="module")
+def mlp_bundle():
+    return get_model("mlp", features=(32, 32))
+
+
+def test_evaluator_follows_checkpoints(tmp_path, eight_devices, mlp_bundle):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    trainer = make_trainer(mlp_bundle, MeshSpec(dp=4))
+    state = trainer.init_state()
+    data = iter(mlp_bundle.make_data(16, seed=0))
+
+    # evaluator on a DIFFERENT mesh (dp=2) — reshard-on-restore
+    ev_trainer = make_trainer(mlp_bundle, MeshSpec(dp=2))
+    ev = Evaluator(
+        ev_trainer, mgr, iter(mlp_bundle.make_data(16, seed=7)),
+        eval_fn=mlp_bundle.eval_fn, batches_per_eval=2,
+    )
+    assert ev.poll_once() is None  # nothing saved yet
+
+    for _ in range(3):
+        state, _ = trainer.train_step(state, next(data))
+    mgr.save(3, state)
+    r1 = ev.poll_once()
+    assert r1 is not None and r1["step"] == 3 and "accuracy" in r1
+    assert ev.poll_once() is None  # same step: not re-evaluated
+
+    for _ in range(3):
+        state, _ = trainer.train_step(state, next(data))
+    mgr.save(6, state)
+    r2 = ev.poll_once()
+    assert r2 is not None and r2["step"] == 6
+    assert len(ev.results) == 2
+
+
+def test_evaluator_run_loop_stops(tmp_path, eight_devices, mlp_bundle):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    trainer = make_trainer(mlp_bundle, MeshSpec(dp=1))
+    state = trainer.init_state()
+    mgr.save(1, state)
+    ev = Evaluator(
+        trainer, mgr, iter(mlp_bundle.make_data(16, seed=3)), batches_per_eval=1
+    )
+    ev.run(poll_interval_s=0.01, max_evals=1)  # returns after one eval
+    assert [r["step"] for r in ev.results] == [1.0]
+
+
+def test_model_zoo_runner_cli(tmp_path):
+    """The manifests' entry command works end-to-end: train with
+    checkpoints, then side-evaluate the saved steps."""
+    import subprocess
+    import sys
+
+    env_cmd = [sys.executable, "-m", "easydl_tpu.models.run"]
+    ck = str(tmp_path / "ck")
+    r = subprocess.run(
+        env_cmd + ["--model", "mlp", "--steps", "6", "--batch", "8",
+                   "--ckpt-dir", ck, "--ckpt-every", "3",
+                   "--model-arg", "features=[16,16]"],
+        capture_output=True, text=True, timeout=300,
+        env=_cpu_env(),
+    )
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        env_cmd + ["--model", "mlp", "--role", "evaluator", "--ckpt-dir", ck,
+                   "--eval-polls", "1", "--batch", "8",
+                   "--model-arg", "features=[16,16]"],
+        capture_output=True, text=True, timeout=300,
+        env=_cpu_env(),
+    )
+    assert r.returncode == 0, r.stderr
+    assert "eval @ step" in r.stderr
+
+
+def _cpu_env():
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    return env
