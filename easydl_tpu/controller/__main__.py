@@ -19,12 +19,13 @@ import yaml
 from easydl_tpu.api.job_spec import JOB_KIND, JobSpec
 from easydl_tpu.api.resource_plan import PLAN_KIND, ResourcePlan
 from easydl_tpu.controller import CrStore, ElasticJobController, InMemoryPodApi
+from easydl_tpu.controller.operator import StalePlanError
 from easydl_tpu.utils.logging import get_logger
 
 log = get_logger("controller", "main")
 
 
-def ingest(store: CrStore, path: str, seen: dict) -> None:
+def ingest(store: CrStore, path: str, seen: dict, pending: set) -> None:
     for fname in sorted(os.listdir(path)):
         if not fname.endswith((".yaml", ".yml")):
             continue
@@ -57,10 +58,20 @@ def ingest(store: CrStore, path: str, seen: dict) -> None:
                         store.apply_plan(plan)
                         log.info("applied plan v%d for %s from %s",
                                  plan.version, plan.job_name, fname)
-                    except ValueError:
-                        pass  # stale version: file unchanged since apply
+                        pending.discard(full)
+                    except StalePlanError:
+                        pass  # already applied: file unchanged since
                     except KeyError:
-                        retry = True  # job not ingested yet: next scan
+                        # Job not ingested yet (or misspelled selector) —
+                        # retry next scan, but say so once per file.
+                        retry = True
+                        if full not in pending:
+                            pending.add(full)
+                            log.warning(
+                                "plan in %s targets unknown job %r; will "
+                                "retry until the job appears",
+                                fname, plan.job_name,
+                            )
             except Exception as e:
                 log.error("bad document in %s: %s", fname, e)
         if not retry:
@@ -81,9 +92,10 @@ def main() -> None:
     ctl.start(resync_s=args.resync_s)
     log.info("operator watching %s (pod api: %s)", args.watch_dir, args.pod_api)
     seen: dict = {}
+    pending: set = set()
     try:
         while True:
-            ingest(store, args.watch_dir, seen)
+            ingest(store, args.watch_dir, seen, pending)
             pod_api.tick()
             time.sleep(min(args.resync_s, 1.0))
     except KeyboardInterrupt:

@@ -28,10 +28,18 @@ from typing import Dict, List, Optional
 from easydl_tpu.api.job_spec import JobSpec, ResourceSpec
 from easydl_tpu.api.resource_plan import ResourcePlan
 from easydl_tpu.controller.pod_api import Pod, PodApi
-from easydl_tpu.controller.reconciler import _trailing_index, reconcile
+from easydl_tpu.controller.reconciler import (
+    _trailing_index,
+    reconcile,
+    resource_sig,
+)
 from easydl_tpu.utils.logging import get_logger
 
 log = get_logger("controller", "operator")
+
+
+class StalePlanError(ValueError):
+    """A plan write with version <= the currently applied one."""
 
 
 class CrStore:
@@ -67,7 +75,7 @@ class CrStore:
                 raise KeyError(f"no such job {plan.job_name!r}")
             cur = self._plans.get(plan.job_name)
             if cur is not None and plan.version <= cur.version:
-                raise ValueError(
+                raise StalePlanError(
                     f"stale plan version {plan.version} <= {cur.version}"
                 )
             self._plans[plan.job_name] = plan
@@ -115,6 +123,7 @@ class ElasticJobController:
         self._force_py = force_python_core
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._drift_warned: set = set()  # (job, pod, sig) already reported
 
     # ------------------------------------------------------------- reconcile
     def reconcile_job(self, job_name: str) -> JobStatus:
@@ -127,6 +136,9 @@ class ElasticJobController:
             for p in observed:
                 self.pods.delete_pod(p.name)
                 status.last_ops.append(f"DELETE {p.name} (job gone)")
+            self._drift_warned = {
+                w for w in self._drift_warned if w[0] != job_name
+            }
             return status
 
         # Figure step 3: trainer pod first, before any plan exists. The
@@ -201,10 +213,7 @@ class ElasticJobController:
         semantics: vertical scaling is explicit resource_updation,
         docs/design/elastic-training-operator.md:86-101) — surface the drift
         so the user knows to issue one."""
-        from easydl_tpu.controller.reconciler import resource_sig
-
-        warned = getattr(self, "_drift_warned", set())
-        self._drift_warned = warned
+        warned = self._drift_warned
         for role, rp in plan.roles.items():
             want_sig = resource_sig(rp.resource)
             for p in observed:

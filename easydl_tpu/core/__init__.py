@@ -1,3 +1,6 @@
+"""Training core: mesh construction, sharding rules, the pjit train loop,
+checkpointing, metrics, data pipeline, and the evaluator role."""
+
 from easydl_tpu.core.mesh import MeshSpec, build_mesh  # noqa: F401
 from easydl_tpu.core.sharding import DEFAULT_RULES, state_shardings  # noqa: F401
 from easydl_tpu.core.train_loop import Trainer, TrainConfig, TrainState  # noqa: F401

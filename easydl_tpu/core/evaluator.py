@@ -54,7 +54,14 @@ class Evaluator:
         self.data = data
         self.batches_per_eval = batches_per_eval
         self.on_result = on_result
-        self._eval_step = trainer.build_eval_step(eval_fn or trainer.loss_fn)
+        base_fn = eval_fn or trainer.loss_fn
+        # Fold the loss into the aux metrics: build_eval_step returns aux
+        # only, and the held-out loss is the primary side-eval signal.
+        def with_loss(params, batch, rng):
+            loss, aux = base_fn(params, batch, rng)
+            return loss, {"loss": loss, **aux}
+
+        self._eval_step = trainer.build_eval_step(with_loss)
         self._last_step: Optional[int] = None
         self._stop = threading.Event()
         self.results: list = []
@@ -64,10 +71,7 @@ class Evaluator:
         step = self.checkpoint.latest_step()
         if step is None or step == self._last_step:
             return None
-        abstract, _, _ = self.trainer._abstract_state()
-        state = self.checkpoint.restore(
-            step, abstract, self.trainer.state_shardings()
-        )
+        state = self.trainer.restore_from(self.checkpoint, step)
         sums: Dict[str, float] = {}
         for _ in range(self.batches_per_eval):
             aux = self._eval_step(state, self.trainer.shard_batch(next(self.data)))

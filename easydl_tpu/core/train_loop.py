@@ -105,6 +105,7 @@ class Trainer:
         self.optimizer = optimizer
         self._state_shardings: Any = None
         self._step_fn = None
+        self._abstract: Any = None
 
     # ------------------------------------------------------------------ init
     def _abstract_state(self) -> TrainState:
@@ -120,7 +121,9 @@ class Trainer:
 
         # Old-style uint32 PRNG keys: checkpointable as plain arrays.
         rng = jax.random.PRNGKey(self.config.seed)
-        return jax.eval_shape(make, rng), make, rng
+        if self._abstract is None:  # eval_shape re-traces init+opt: cache it
+            self._abstract = jax.eval_shape(make, rng)
+        return self._abstract, make, rng
 
     def state_shardings(self) -> Any:
         if self._state_shardings is None:
@@ -144,6 +147,25 @@ class Trainer:
             sum(x.size for x in jax.tree.leaves(shd.unbox(abstract.params))),
         )
         return state
+
+    def abstract_state(self) -> TrainState:
+        """Shape/dtype tree of the state (no allocation) — what checkpoint
+        restore matches leaves against."""
+        return self._abstract_state()[0]
+
+    def restore_from(self, checkpoint, step: Optional[int] = None) -> TrainState:
+        """Restore ``step`` (default: latest) from a CheckpointManager onto
+        THIS trainer's mesh — the save may have used any other mesh shape
+        (reshard-on-restore). The single public entry for resuming: the
+        elastic worker, the evaluator, and the zoo runner all come through
+        here."""
+        if step is None:
+            step = checkpoint.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {checkpoint.directory}"
+                )
+        return checkpoint.restore(step, self.abstract_state(), self.state_shardings())
 
     # ------------------------------------------------------------------ step
     def _build_step(self):

@@ -95,8 +95,7 @@ def run_worker(env: Dict[str, str]) -> int:
     ) if world > 1 else (-1 if local_latest is None else local_latest)
 
     if latest >= 0:
-        abstract, _, _ = trainer._abstract_state()
-        state = ckpt.restore(latest, abstract, trainer.state_shardings())
+        state = trainer.restore_from(ckpt, latest)
         start_step = latest
         log.info("gen %d: restored step %d onto world=%d (%d devices)",
                  generation, latest, world, devices)

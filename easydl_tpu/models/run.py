@@ -85,8 +85,7 @@ def main() -> None:
 
     state = trainer.init_state()
     if ckpt is not None and ckpt.latest_step() is not None:
-        abstract, _, _ = trainer._abstract_state()
-        state = ckpt.restore(ckpt.latest_step(), abstract, trainer.state_shardings())
+        state = trainer.restore_from(ckpt)
         log.info("resumed from step %d", state.int_step)
     data = iter(bundle.make_data(args.batch, seed=0))
     recorder = MetricsRecorder(args.batch, world_size=dp)

@@ -1,1 +1,4 @@
+"""Shared plumbing: structured logging, gRPC service helpers, and the
+compile-and-cache loader for the C++ cores."""
+
 from easydl_tpu.utils.logging import get_logger  # noqa: F401
