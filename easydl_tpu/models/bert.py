@@ -37,6 +37,7 @@ def make_bert(
     mask_prob: float = 0.15,
     remat: bool = False,
     attention_impl: str = "auto",
+    attention_fn=None,
 ) -> ModelBundle:
     n_layers, d_model, n_heads = SIZES[size]
     cfg = TransformerConfig(
@@ -49,6 +50,7 @@ def make_bert(
         causal=False,
         remat=remat,
         attention_impl=attention_impl,
+        attention_fn=attention_fn,
         tied_head=True,
     )
     model = Transformer(cfg)

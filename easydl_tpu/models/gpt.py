@@ -47,6 +47,7 @@ def make_gpt(
     vocab: int = 50304,
     remat: bool = False,
     attention_impl: str = "auto",
+    attention_fn=None,
     dropout: float = 0.0,
 ) -> ModelBundle:
     n_layers, d_model, n_heads = SIZES[size]
@@ -61,6 +62,7 @@ def make_gpt(
         dropout=dropout,
         remat=remat,
         attention_impl=attention_impl,
+        attention_fn=attention_fn,
         tied_head=True,
     )
     model = Transformer(cfg)

@@ -21,7 +21,7 @@ hit the BASELINE configs 3-4 (BERT-base, GPT-2 345M).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -80,6 +80,10 @@ class TransformerConfig:
     dropout: float = 0.0
     remat: bool = False
     attention_impl: str = "auto"
+    #: sequence-parallel attention override: a ``(q, k, v) -> out`` callable
+    #: (e.g. from :func:`easydl_tpu.ops.sequence_parallel.make_sp_attention`)
+    #: replacing the local attention — ring/Ulysses over the mesh's sp axis.
+    attention_fn: Optional[Callable] = None
     #: tie the LM head to the token embedding (GPT-2 does)
     tied_head: bool = True
 
@@ -123,9 +127,12 @@ class Block(nn.Module):
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
-        attn = multihead_attention(
-            q, k, v, causal=cfg.causal, impl=cfg.attention_impl
-        )
+        if cfg.attention_fn is not None:  # sequence-parallel (ring/Ulysses)
+            attn = cfg.attention_fn(q, k, v, causal=cfg.causal)
+        else:
+            attn = multihead_attention(
+                q, k, v, causal=cfg.causal, impl=cfg.attention_impl
+            )
         attn = _dense(
             cfg.d_model,
             ("heads", "kv", "embed"),

@@ -106,8 +106,7 @@ def test_config3_bert_elastic_preemption_resume(tmp_path, eight_devices):
 
     # preemption takes half the slice; survivors rebuild at world=4
     t4 = make_trainer(bundle, MeshSpec(dp=4), batch=16, dtype=jnp.bfloat16)
-    abstract, _, _ = t4._abstract_state()
-    state4 = mgr.restore(6, abstract, t4.state_shardings())
+    state4 = t4.restore_from(mgr, 6)
     assert state4.int_step == 6
     # bit-exact parameter fidelity across the 8→4 reshard
     from easydl_tpu.core import sharding as shd
@@ -153,8 +152,7 @@ def test_config4_gpt2_brain_autoscale(tmp_path, eight_devices):
     assert target == 4, f"expected scale-up to 4, got {target}"
 
     t4 = make_trainer(bundle, MeshSpec.from_world(target), batch=8, dtype=jnp.bfloat16)
-    abstract, _, _ = t4._abstract_state()
-    state4 = mgr.restore(4, abstract, t4.state_shardings())
+    state4 = t4.restore_from(mgr, 4)
     state4, losses = train_steps(t4, state4, data, 2)
     assert state4.int_step == 6
 
