@@ -36,6 +36,7 @@ def make_bert(
     vocab: int = 30720,  # 30522 padded up to a multiple of 128 for MXU tiling
     mask_prob: float = 0.15,
     remat: bool = False,
+    remat_policy: str = "full",
     attention_impl: str = "auto",
     attention_fn=None,
 ) -> ModelBundle:
@@ -49,6 +50,7 @@ def make_bert(
         max_seq=seq_len,
         causal=False,
         remat=remat,
+        remat_policy=remat_policy,
         attention_impl=attention_impl,
         attention_fn=attention_fn,
         tied_head=True,

@@ -46,6 +46,7 @@ def make_gpt(
     seq_len: int = 1024,
     vocab: int = 50304,
     remat: bool = False,
+    remat_policy: str = "full",
     attention_impl: str = "auto",
     attention_fn=None,
     dropout: float = 0.0,
@@ -61,6 +62,7 @@ def make_gpt(
         causal=True,
         dropout=dropout,
         remat=remat,
+        remat_policy=remat_policy,
         attention_impl=attention_impl,
         attention_fn=attention_fn,
         tied_head=True,

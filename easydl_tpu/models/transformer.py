@@ -79,6 +79,10 @@ class TransformerConfig:
     causal: bool = True
     dropout: float = 0.0
     remat: bool = False
+    #: remat granularity: "full" recomputes the whole block (min memory);
+    #: "dots" keeps matmul outputs and recomputes only elementwise/softmax
+    #: (jax dots_saveable policy — ~8% faster on TPU when HBM allows).
+    remat_policy: str = "full"
     attention_impl: str = "auto"
     #: sequence-parallel attention override: a ``(q, k, v) -> out`` callable
     #: (e.g. from :func:`easydl_tpu.ops.sequence_parallel.make_sp_attention`)
@@ -187,7 +191,16 @@ class Transformer(nn.Module):
 
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, prevent_cse=False)
+            if cfg.remat_policy not in ("full", "dots"):
+                raise ValueError(
+                    f"remat_policy must be 'full' or 'dots', got "
+                    f"{cfg.remat_policy!r}"
+                )
+            policy = (
+                jax.checkpoint_policies.dots_saveable
+                if cfg.remat_policy == "dots" else None
+            )
+            block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
         # One traced block, scanned over a stacked 'layers' param axis.
         x, _ = nn.scan(
             block_cls,
