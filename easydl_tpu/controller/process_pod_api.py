@@ -1,0 +1,146 @@
+"""Process-backed pod API: each "pod" is a real OS process on this machine.
+
+The single-machine realisation of the reference's kubelet layer — the same
+:class:`~easydl_tpu.controller.pod_api.PodApi` interface the reconciler
+drives against k8s or the in-memory fake, but ``create_pod`` actually
+launches the pod's command as a subprocess. This is what makes the full
+reference lifecycle (figure steps 1-6, docs/design/elastic-training-
+operator.md:20-22) runnable end-to-end without a cluster: operator →
+trainer process → Brain → JobResource → worker processes.
+
+Phases map to process state: Pending until first :meth:`poll` sees the
+process alive, Running while it lives, Succeeded/Failed by exit code,
+deletion is SIGTERM → (grace) → SIGKILL. Command templates may reference
+``{name} {role} {job} {workdir}``.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional
+
+from easydl_tpu.controller.pod_api import Pod, PodApi
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("controller", "procpods")
+
+
+class _Proc:
+    def __init__(self, pod: Pod, proc: subprocess.Popen, log_path: str):
+        self.pod = pod
+        self.proc = proc
+        self.log_path = log_path
+        self.term_sent_at: Optional[float] = None
+
+
+class LocalProcessPodApi(PodApi):
+    """Pods as local subprocesses; stdout/err captured per pod."""
+
+    def __init__(self, workdir: str, env: Optional[Dict[str, str]] = None,
+                 grace_s: float = 5.0):
+        self.workdir = workdir
+        self.extra_env = env or {}
+        self.grace_s = grace_s
+        self._procs: Dict[str, _Proc] = {}
+        self._lock = threading.RLock()
+        os.makedirs(os.path.join(workdir, "pod-logs"), exist_ok=True)
+
+    # ----------------------------------------------------------------- PodApi
+    def create_pod(self, pod: Pod) -> None:
+        with self._lock:
+            if pod.name in self._procs:
+                raise ValueError(f"pod {pod.name!r} already exists")
+            cmd = pod.command.format(
+                name=pod.name, role=pod.role, job=pod.job, workdir=self.workdir
+            )
+            log_path = os.path.join(self.workdir, "pod-logs", f"{pod.name}.log")
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            env.update(
+                EASYDL_POD_NAME=pod.name,
+                EASYDL_POD_ROLE=pod.role,
+                EASYDL_JOB=pod.job,
+                EASYDL_WORKDIR=self.workdir,
+            )
+            with open(log_path, "ab") as logf:
+                proc = subprocess.Popen(
+                    shlex.split(cmd),
+                    stdout=logf, stderr=subprocess.STDOUT,
+                    env=env, start_new_session=True,  # own pgid: clean kill
+                )
+            self._procs[pod.name] = _Proc(pod, proc, log_path)
+            log.info("launched pod %s (%s): pid=%d", pod.name, pod.role, proc.pid)
+
+    def delete_pod(self, name: str) -> None:
+        with self._lock:
+            entry = self._procs.get(name)
+            if entry is None:
+                return
+            if entry.proc.poll() is None:
+                if entry.term_sent_at is None:
+                    try:
+                        os.killpg(entry.proc.pid, signal.SIGTERM)
+                    except ProcessLookupError:
+                        pass
+                    entry.term_sent_at = time.monotonic()
+                    entry.pod.phase = "Terminating"
+                    return  # graceful: poll() escalates after grace_s
+                return
+            del self._procs[name]
+
+    def list_pods(self, job: Optional[str] = None) -> List[Pod]:
+        self.poll()
+        with self._lock:
+            pods = [
+                e.pod for e in self._procs.values()
+                if job is None or e.pod.job == job
+            ]
+            return sorted(pods, key=lambda p: p.name)
+
+    # ------------------------------------------------------------------ state
+    def poll(self) -> None:
+        """Refresh phases from process state; escalate overdue TERMs."""
+        with self._lock:
+            for name in list(self._procs):
+                e = self._procs[name]
+                rc = e.proc.poll()
+                if rc is None:
+                    if e.term_sent_at is not None:
+                        if time.monotonic() - e.term_sent_at > self.grace_s:
+                            try:
+                                os.killpg(e.proc.pid, signal.SIGKILL)
+                            except ProcessLookupError:
+                                pass
+                    elif e.pod.phase == "Pending":
+                        e.pod.phase = "Running"
+                elif e.term_sent_at is not None:
+                    del self._procs[name]  # deletion completed
+                else:
+                    e.pod.phase = "Succeeded" if rc == 0 else "Failed"
+
+    def tail_log(self, name: str, n: int = 30) -> str:
+        with self._lock:
+            e = self._procs.get(name)
+        if e is None:
+            return ""
+        try:
+            with open(e.log_path) as f:
+                return "".join(f.readlines()[-n:])
+        except OSError:
+            return ""
+
+    def shutdown(self) -> None:
+        """Kill everything (test teardown)."""
+        with self._lock:
+            for e in self._procs.values():
+                if e.proc.poll() is None:
+                    try:
+                        os.killpg(e.proc.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+            self._procs.clear()

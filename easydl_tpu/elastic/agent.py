@@ -263,11 +263,34 @@ def main() -> None:  # pragma: no cover - CLI entry
 
     p = argparse.ArgumentParser(description="easydl_tpu host agent")
     p.add_argument("--id", required=True)
-    p.add_argument("--master", required=True)
+    p.add_argument("--master", default="",
+                   help="master host:port (or use --master-file)")
+    p.add_argument("--master-file", default="",
+                   help="JSON file with {'address': host:port}; polled until "
+                        "it appears (worker pods may start before the "
+                        "trainer publishes the master)")
     p.add_argument("--workdir", required=True)
     p.add_argument("--slots", type=int, default=1)
     p.add_argument("--platform", default="cpu")
     args = p.parse_args()
+    if not args.master and not args.master_file:
+        p.error("one of --master / --master-file is required")
+    if args.master_file:
+        deadline = time.monotonic() + 120.0
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                with open(args.master_file) as f:
+                    args.master = json.load(f)["address"]
+                break
+            except (OSError, ValueError, KeyError) as e:
+                last_err = e
+                time.sleep(0.5)
+        else:
+            raise SystemExit(
+                f"master file {args.master_file} unusable after 120s "
+                f"(last error: {last_err!r})"
+            )
     agent = Agent(
         agent_id=args.id,
         master_address=args.master,

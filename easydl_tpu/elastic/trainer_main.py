@@ -1,0 +1,182 @@
+"""The trainer pod's entrypoint — the reference's ElasticTrainer
+(README.md:11; docs/design/elastic-training-operator.md:103-114).
+
+Launched FIRST and alone by the operator (figure step 3). It then:
+
+1. **extracts features from the job** (:106) — parses the ElasticJob's
+   entry command with the zoo runner's own parser (model family, batch,
+   parameter-count hint from the model registry);
+2. **queries the startup resources from Brain** (:106-107) — gRPC
+   GetStartupPlan, or the same policy locally when no Brain is deployed;
+3. **generates and applies a JobResource** (:107-108) — written as YAML
+   into the operator's resource directory (the k8s-apply equivalent in the
+   standalone/file-watch deployment);
+4. runs the **job master**: elastic rendezvous for the worker pods the
+   operator is about to launch, Brain re-plan polling mid-run (:110-114),
+   and the checkpoint/reshard machinery.
+
+``python -m easydl_tpu.elastic.trainer_main --job-file job.yaml
+--plan-dir <operator watch dir> --workdir <shared dir> [--brain host:port]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def extract_features(job, brain_pb):
+    """Job → JobFeatures proto (reference :106 'extracts features')."""
+    from easydl_tpu.models.registry import get_model
+    from easydl_tpu.models.run import build_parser
+
+    command = job.role_command("worker") or job.command
+    family, params, batch = "", 0, 32
+    uses_ps = False
+    runner_prefix = "python -m easydl_tpu.models.run "
+    if command.startswith(runner_prefix):
+        args, _ = build_parser().parse_known_args(
+            command[len(runner_prefix):].split()
+        )
+        family = args.model
+        batch = args.batch
+        kwargs = {}
+        for kv in args.model_arg:
+            k, _, v = kv.partition("=")
+            try:
+                kwargs[k] = json.loads(v)
+            except json.JSONDecodeError:
+                kwargs[k] = v
+        try:
+            bundle = get_model(family, **kwargs)
+            params = bundle.param_count_hint
+        except Exception:
+            params = 0
+        uses_ps = kwargs.get("embedding") == "ps" or family in ("deepfm", "widedeep")
+    acc = brain_pb.TpuSpec()
+    if job.accelerator is not None:
+        acc = brain_pb.TpuSpec(
+            type=job.accelerator.type, chips=job.accelerator.chips,
+            topology=job.accelerator.topology,
+        )
+    return brain_pb.JobFeatures(
+        job_name=job.name,
+        command=command,
+        uses_ps=uses_ps,
+        uses_evaluator="evaluator" in job.roles,
+        model_params=params,
+        per_host_batch=batch,
+        model_family=family,
+        accelerator=acc,
+    )
+
+
+def get_startup_plan(features, brain_address):
+    """Brain RPC when deployed, identical local policy otherwise."""
+    from easydl_tpu.brain.convert import plan_from_proto
+    from easydl_tpu.brain.policy import startup_plan
+
+    if brain_address:
+        from easydl_tpu.brain.service import BRAIN_SERVICE
+        from easydl_tpu.utils.rpc import RpcClient
+
+        client = RpcClient(BRAIN_SERVICE, brain_address)
+        try:
+            resp = client.GetStartupPlan(features)
+            if resp.has_plan:
+                return plan_from_proto(resp.plan)
+        finally:
+            client.close()
+    return startup_plan(features)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="easydl_tpu trainer pod (ElasticTrainer)")
+    ap.add_argument("--job-file", required=True, help="ElasticJob YAML")
+    ap.add_argument("--plan-dir", required=True,
+                    help="operator resource dir to apply the JobResource into")
+    ap.add_argument("--workdir", required=True, help="shared job workdir")
+    ap.add_argument("--brain", default="", help="Brain host:port (optional)")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="override plan worker count (0 = use the plan)")
+    ap.add_argument("--min-workers", type=int, default=1)
+    ap.add_argument("--total-steps", type=int, default=0,
+                    help="override the command's --steps")
+    args = ap.parse_args()
+
+    from easydl_tpu.api.job_spec import JobSpec
+    from easydl_tpu.elastic.master import Master
+    from easydl_tpu.models.run import build_parser
+    from easydl_tpu.proto import easydl_pb2 as pb
+    from easydl_tpu.utils.logging import get_logger
+
+    log = get_logger("elastic", "trainer")
+
+    with open(args.job_file) as f:
+        job = JobSpec.from_yaml(f.read())
+
+    # 1-2. features -> startup plan (Brain or local policy)
+    features = extract_features(job, pb)
+    plan = get_startup_plan(features, args.brain)
+    if args.workers:
+        plan = plan.with_role("worker", args.workers)
+    log.info("startup plan for %s: %s", job.name,
+             {r: rp.replicas for r, rp in plan.roles.items()})
+
+    # 3. apply the JobResource: write YAML where the operator watches
+    os.makedirs(args.plan_dir, exist_ok=True)
+    plan_path = os.path.join(args.plan_dir, f"{job.name}-plan.yaml")
+    tmp = plan_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(plan.to_yaml())
+    os.replace(tmp, plan_path)
+    log.info("applied JobResource v%d -> %s", plan.version, plan_path)
+
+    # 4. worker config for the elastic workers (from the job command)
+    command = job.role_command("worker") or job.command
+    runner_prefix = "python -m easydl_tpu.models.run "
+    cfg = {"model": "mlp", "model_kwargs": {}, "global_batch": 32,
+           "total_steps": 50, "ckpt_interval": 10, "lr": 1e-3, "seed": 0}
+    if command.startswith(runner_prefix):
+        ns, _ = build_parser().parse_known_args(command[len(runner_prefix):].split())
+        kwargs = {}
+        for kv in ns.model_arg:
+            k, _, v = kv.partition("=")
+            try:
+                kwargs[k] = json.loads(v)
+            except json.JSONDecodeError:
+                kwargs[k] = v
+        cfg.update(model=ns.model, model_kwargs=kwargs,
+                   global_batch=ns.batch, total_steps=ns.steps,
+                   ckpt_interval=ns.ckpt_every, lr=ns.lr)
+    if args.total_steps:
+        cfg["total_steps"] = args.total_steps
+
+    master = Master(
+        job_name=job.name,
+        workdir=args.workdir,
+        desired_workers=plan.replicas("worker"),
+        min_workers=args.min_workers,
+        worker_config=cfg,
+        brain_address=args.brain or None,
+    ).start()
+    # Worker pods discover the master through this file (the k8s service
+    # stand-in for the standalone deployment).
+    with open(os.path.join(args.workdir, "master.json.tmp"), "w") as f:
+        json.dump({"address": master.address, "job": job.name}, f)
+    os.replace(os.path.join(args.workdir, "master.json.tmp"),
+               os.path.join(args.workdir, "master.json"))
+    log.info("master up at %s; waiting for workers", master.address)
+
+    try:
+        while not master.done:
+            time.sleep(0.5)
+    finally:
+        master.stop()
+    log.info("job %s complete", job.name)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""The reference's primary call stack end-to-end on one machine (figure
+steps 1-6, docs/design/elastic-training-operator.md:20-22; SURVEY.md §3.1):
+
+  submit ElasticJob → operator launches the TRAINER POD ONLY (a real
+  process) → the trainer extracts features, asks Brain (real gRPC) for a
+  startup plan, applies a JobResource (YAML into the operator's watch dir)
+  → operator launches WORKER PODS (real processes running the host agent)
+  → agents rendezvous with the trainer's master, run jax.distributed
+  training to completion → every pod exits Succeeded.
+
+Every boundary in the reference design is a real process/socket boundary
+here; only kubelet is played by LocalProcessPodApi.
+"""
+
+import os
+import threading
+import time
+
+from easydl_tpu.api.job_spec import JobSpec, RoleSpec
+from easydl_tpu.brain.service import Brain
+from easydl_tpu.controller import CrStore, ElasticJobController
+from easydl_tpu.controller.__main__ import ingest
+from easydl_tpu.controller.process_pod_api import LocalProcessPodApi
+
+
+def wait_for(cond, timeout, desc):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.3)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+def test_full_reference_lifecycle(tmp_path):
+    workdir = str(tmp_path / "work")
+    plan_dir = str(tmp_path / "resources")
+    os.makedirs(workdir)
+    os.makedirs(plan_dir)
+
+    brain = Brain().start(port=0)
+    job_name = "lifecycle"
+    job = JobSpec(
+        name=job_name,
+        command="python -m easydl_tpu.models.run --model mlp "
+                "--model-arg features=[32,32] --batch 16 --steps 8 "
+                "--ckpt-every 4",
+        roles={
+            "trainer": RoleSpec(command=(
+                "python -m easydl_tpu.elastic.trainer_main "
+                f"--job-file {tmp_path}/job.yaml --plan-dir {plan_dir} "
+                "--workdir {workdir} "
+                f"--brain {brain.address} --workers 2 --min-workers 1"
+            )),
+            "worker": RoleSpec(command=(
+                "python -m easydl_tpu.elastic.agent --id {name} "
+                "--master-file {workdir}/master.json --workdir {workdir} "
+                "--slots 1 --platform cpu"
+            )),
+        },
+    )
+    with open(tmp_path / "job.yaml", "w") as f:
+        f.write(job.to_yaml())
+
+    store = CrStore()
+    api = LocalProcessPodApi(workdir)
+    ctl = ElasticJobController(store, api)
+    stop = threading.Event()
+
+    def pump():
+        # the standalone operator's main loop: ingest resource files (the
+        # trainer's applied JobResource lands here) + level-triggered resync
+        seen, pending = {}, set()
+        while not stop.is_set():
+            ingest(store, plan_dir, seen, pending)
+            for j in store.jobs():
+                ctl.reconcile_job(j)
+            stop.wait(0.5)
+
+    pump_thread = threading.Thread(target=pump, daemon=True)
+    try:
+        # step 1: submit the job
+        store.submit_job(job)
+        pump_thread.start()
+
+        # steps 2-3: trainer pod only
+        wait_for(
+            lambda: [p.role for p in api.list_pods(job_name)] == ["trainer"],
+            30, "trainer pod launched first (and alone)",
+        )
+
+        # steps 4-6: trainer applies the plan; operator launches workers
+        wait_for(
+            lambda: len([p for p in api.list_pods(job_name)
+                         if p.role == "worker"]) == 2,
+            120, f"2 worker pods (trainer log: {api.tail_log(job_name + '-trainer-0')})",
+        )
+        assert os.path.exists(os.path.join(plan_dir, f"{job_name}-plan.yaml"))
+
+        # training runs to completion: every pod exits Succeeded
+        def all_succeeded():
+            pods = api.list_pods(job_name)
+            return pods and all(p.phase == "Succeeded" for p in pods)
+
+        wait_for(
+            lambda: all_succeeded(),
+            240,
+            "all pods Succeeded "
+            f"(phases: {[(p.name, p.phase) for p in api.list_pods(job_name)]}; "
+            f"trainer log: {api.tail_log(job_name + '-trainer-0')})",
+        )
+
+        # the run left real artifacts: checkpoints + the master's address file
+        ckpt_dir = os.path.join(workdir, "ckpt")
+        ckpts = [d for d in os.listdir(ckpt_dir) if d.startswith("step_")]
+        assert ckpts, f"no checkpoints in {ckpt_dir}"
+        assert os.path.exists(os.path.join(workdir, "master.json"))
+    finally:
+        stop.set()
+        if pump_thread.is_alive():
+            pump_thread.join(timeout=5)
+        api.shutdown()
+        brain.stop()
