@@ -1,0 +1,75 @@
+// Stress/fuzz driver for the reconciler core under TSan/ASan
+// (scripts/sanitize_native.sh). The core is pure, so the properties checked
+// are memory-safety under randomized inputs (ASan/UBSan) and safe
+// CONCURRENT use from many reconcile threads (TSan) — the operator serves
+// multiple jobs from one process.
+
+#include "reconciler_core.cc"  // NOLINT(build/include)
+
+#include <cassert>
+#include <cstdio>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+uint64_t mix(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+std::string random_state(uint64_t seed, std::string* desired_out) {
+  uint64_t r = seed;
+  const char* roles[] = {"worker", "parameter_server", "evaluator"};
+  const char* phases[] = {"Pending", "Running", "Failed", "Terminating",
+                          "Succeeded"};
+  std::string desired = "J|job\n";
+  std::string observed;
+  for (int i = 0; i < 3; ++i) {
+    r = mix(r);
+    desired += "R|" + std::string(roles[r % 3]) + "|" +
+               std::to_string(r % 5) + "|sig" + std::to_string(r % 3) + "\n";
+  }
+  std::vector<std::string> names;
+  for (int i = 0; i < 10; ++i) {
+    r = mix(r);
+    std::string name =
+        "job-" + std::string(roles[r % 3]) + "-" + std::to_string(r % 8);
+    std::string replaces;
+    if (!names.empty() && (r >> 8) % 4 == 0) replaces = names[(r >> 16) % names.size()];
+    names.push_back(name);
+    observed += "P|" + name + "|" + roles[r % 3] + "|" + phases[(r >> 4) % 5] +
+                "|sig" + std::to_string(r % 3) + "|" + replaces + "\n";
+    if ((r >> 24) % 5 == 0) {
+      desired += "U|" + name + "|sig9\n";
+    }
+  }
+  // Adversarial junk lines: the parser must not crash on any of these.
+  observed += "P|short\n||\nGARBAGE\nP|a|b|c|d|e|extra|fields\n";
+  *desired_out = desired + "R|onlytworows\nU|x\nJ\n";
+  return observed;
+}
+
+void hammer(int seed) {
+  for (int it = 0; it < 300; ++it) {
+    std::string desired;
+    std::string observed =
+        random_state(static_cast<uint64_t>(seed) * 7919 + it, &desired);
+    char* ops = edr_reconcile(desired.c_str(), observed.c_str());
+    assert(ops != nullptr);
+    edr_free(ops);
+  }
+}
+
+}  // namespace
+
+int main() {
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 8; ++t) threads.emplace_back(hammer, t);
+  for (auto& th : threads) th.join();
+  std::printf("stress OK\n");
+  return 0;
+}

@@ -55,9 +55,15 @@ class LocalProcessPodApi(PodApi):
         with self._lock:
             if pod.name in self._procs:
                 raise ValueError(f"pod {pod.name!r} already exists")
-            cmd = pod.command.format(
-                name=pod.name, role=pod.role, job=pod.job, workdir=self.workdir
-            )
+            # Substitute ONLY the known tokens (str.format would choke on
+            # literal braces in commands, e.g. JSON model-args); quote the
+            # workdir so paths with spaces survive shlex.split.
+            cmd = pod.command
+            for token, value in (
+                ("{name}", pod.name), ("{role}", pod.role), ("{job}", pod.job),
+                ("{workdir}", shlex.quote(self.workdir)),
+            ):
+                cmd = cmd.replace(token, value)
             log_path = os.path.join(self.workdir, "pod-logs", f"{pod.name}.log")
             env = dict(os.environ)
             env.update(self.extra_env)

@@ -27,28 +27,42 @@ import os
 import time
 
 
+_RUNNER_PREFIX = "python -m easydl_tpu.models.run "
+
+
+def parse_runner_command(command: str):
+    """Parse a zoo-runner command into ``(namespace, model_kwargs)``, or
+    ``None`` when it isn't one. The single interpretation both feature
+    extraction and worker-config derivation use — note this parses the
+    ElasticJob's TRAINING command (``spec.command``), never a pod role's
+    entrypoint override (those are launcher commands, e.g. the agent)."""
+    if not command.startswith(_RUNNER_PREFIX):
+        return None
+    from easydl_tpu.models.run import build_parser
+
+    ns, _ = build_parser().parse_known_args(command[len(_RUNNER_PREFIX):].split())
+    kwargs = {}
+    for kv in ns.model_arg:
+        k, _, v = kv.partition("=")
+        try:
+            kwargs[k] = json.loads(v)
+        except json.JSONDecodeError:
+            kwargs[k] = v
+    return ns, kwargs
+
+
 def extract_features(job, brain_pb):
     """Job → JobFeatures proto (reference :106 'extracts features')."""
     from easydl_tpu.models.registry import get_model
-    from easydl_tpu.models.run import build_parser
 
-    command = job.role_command("worker") or job.command
+    command = job.command
     family, params, batch = "", 0, 32
     uses_ps = False
-    runner_prefix = "python -m easydl_tpu.models.run "
-    if command.startswith(runner_prefix):
-        args, _ = build_parser().parse_known_args(
-            command[len(runner_prefix):].split()
-        )
-        family = args.model
-        batch = args.batch
-        kwargs = {}
-        for kv in args.model_arg:
-            k, _, v = kv.partition("=")
-            try:
-                kwargs[k] = json.loads(v)
-            except json.JSONDecodeError:
-                kwargs[k] = v
+    parsed = parse_runner_command(command)
+    if parsed is not None:
+        ns, kwargs = parsed
+        family = ns.model
+        batch = ns.batch
         try:
             bundle = get_model(family, **kwargs)
             params = bundle.param_count_hint
@@ -108,7 +122,6 @@ def main() -> None:
 
     from easydl_tpu.api.job_spec import JobSpec
     from easydl_tpu.elastic.master import Master
-    from easydl_tpu.models.run import build_parser
     from easydl_tpu.proto import easydl_pb2 as pb
     from easydl_tpu.utils.logging import get_logger
 
@@ -134,20 +147,13 @@ def main() -> None:
     os.replace(tmp, plan_path)
     log.info("applied JobResource v%d -> %s", plan.version, plan_path)
 
-    # 4. worker config for the elastic workers (from the job command)
-    command = job.role_command("worker") or job.command
-    runner_prefix = "python -m easydl_tpu.models.run "
+    # 4. worker config for the elastic workers, from the SAME parse of the
+    # job's training command that produced the features
     cfg = {"model": "mlp", "model_kwargs": {}, "global_batch": 32,
            "total_steps": 50, "ckpt_interval": 10, "lr": 1e-3, "seed": 0}
-    if command.startswith(runner_prefix):
-        ns, _ = build_parser().parse_known_args(command[len(runner_prefix):].split())
-        kwargs = {}
-        for kv in ns.model_arg:
-            k, _, v = kv.partition("=")
-            try:
-                kwargs[k] = json.loads(v)
-            except json.JSONDecodeError:
-                kwargs[k] = v
+    parsed = parse_runner_command(job.command)
+    if parsed is not None:
+        ns, kwargs = parsed
         cfg.update(model=ns.model, model_kwargs=kwargs,
                    global_batch=ns.batch, total_steps=ns.steps,
                    ckpt_interval=ns.ckpt_every, lr=ns.lr)

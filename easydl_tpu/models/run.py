@@ -17,6 +17,7 @@ Data is synthetic per model bundle, so any config runs hermetically.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 
 
@@ -33,6 +34,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--eval-polls", type=int, default=0, help="evaluator: stop after N evals (0 = forever)")
     ap.add_argument("--model-arg", action="append", default=[],
                     help="k=v forwarded to the model factory (repeatable)")
+    ap.add_argument("--profile-dir", default="",
+                    help="capture an XLA trace of 3 steady-state steps here")
     return ap
 
 
@@ -89,16 +92,37 @@ def main() -> None:
         log.info("resumed from step %d", state.int_step)
     data = iter(bundle.make_data(args.batch, seed=0))
     recorder = MetricsRecorder(args.batch, world_size=dp)
-    while state.int_step < args.steps:
-        recorder.start_step()
-        state, metrics = trainer.train_step(state, next(data))
-        step = state.int_step
-        rec = recorder.end_step(step, float(metrics["loss"]))
-        if step % 10 == 0 or step == args.steps:
-            log.info("step %d loss %.4f (%.1f samples/s)", step, rec.loss,
-                     rec.samples_per_sec)
-        if ckpt is not None and (step % args.ckpt_every == 0 or step == args.steps):
-            ckpt.save(step, state)
+    profiler = None
+    if args.profile_dir:
+        from easydl_tpu.utils.profiling import StepProfiler, step_annotation
+
+        profiler = StepProfiler(args.profile_dir, start_step=3, num_steps=3)
+    try:
+        while state.int_step < args.steps:
+            step = state.int_step
+            if profiler is not None:
+                profiler.maybe_start(step)
+            annotation = (
+                step_annotation("train", step) if profiler is not None
+                else contextlib.nullcontext()
+            )
+            recorder.start_step()
+            with annotation:
+                state, metrics = trainer.train_step(state, next(data))
+            step = state.int_step
+            rec = recorder.end_step(step, float(metrics["loss"]))
+            if profiler is not None:
+                profiler.maybe_stop(step - 1)
+            if step % 10 == 0 or step == args.steps:
+                log.info("step %d loss %.4f (%.1f samples/s)", step, rec.loss,
+                         rec.samples_per_sec)
+            if ckpt is not None and (step % args.ckpt_every == 0 or step == args.steps):
+                ckpt.save(step, state)
+    finally:
+        # Flush an in-flight trace even on a crash — the traced steps are
+        # exactly the ones worth inspecting afterwards.
+        if profiler is not None:
+            profiler.close()
     if ckpt is not None:
         ckpt.wait()
 

@@ -1,0 +1,73 @@
+// Concurrency stress driver for the embedding store, compiled with
+// TSan/ASan by scripts/sanitize_native.sh (SURVEY.md §5.2). Includes the
+// store's translation unit directly so the sanitizer instruments the real
+// code, then hammers the concurrent surface the gRPC shard exposes: many
+// threads pulling/pushing overlapping id ranges while another exports for
+// checkpointing.
+
+#include "embedding_store.cc"  // NOLINT(build/include)
+
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kThreads = 8;
+constexpr int kIters = 400;
+constexpr int kDim = 16;
+constexpr int64_t kIds = 512;  // small id space: maximal contention
+
+void worker(void* store, int seed, std::atomic<bool>* stop) {
+  uint64_t rng = static_cast<uint64_t>(seed) * 0x9e3779b97f4a7c15ULL + 1;
+  std::vector<int64_t> ids(32);
+  std::vector<float> buf(ids.size() * kDim, 0.25f);
+  for (int it = 0; it < kIters && !stop->load(); ++it) {
+    for (auto& id : ids) {
+      rng = splitmix64(rng);
+      id = static_cast<int64_t>(rng % kIds);
+    }
+    if (it % 3 == 0) {
+      eds_push(store, ids.data(), static_cast<int64_t>(ids.size()),
+               buf.data(), 0.5f);
+    } else {
+      eds_pull(store, ids.data(), static_cast<int64_t>(ids.size()),
+               buf.data());
+    }
+  }
+}
+
+void exporter(void* store, std::atomic<bool>* stop) {
+  while (!stop->load()) {
+    int64_t n = eds_size(store);
+    if (n > 0) {
+      std::vector<int64_t> ids(static_cast<size_t>(n) + 64);
+      std::vector<float> rows(ids.size() * 2 * kDim);
+      int64_t written = eds_export(store, ids.data(), rows.data(),
+                                   static_cast<int64_t>(ids.size()));
+      assert(written <= static_cast<int64_t>(ids.size()));
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  void* store = eds_create(kDim, 0.01f, 7, /*adagrad=*/1, 0.05f, 1e-8f);
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> threads;
+  threads.emplace_back(exporter, store, &stop);
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back(worker, store, t, &stop);
+  }
+  for (size_t t = 1; t < threads.size(); ++t) threads[t].join();
+  stop.store(true);
+  threads[0].join();
+  const int64_t rows = eds_size(store);
+  assert(rows > 0 && rows <= kIds);
+  std::printf("stress OK: %lld rows\n", static_cast<long long>(rows));
+  eds_destroy(store);
+  return 0;
+}

@@ -1,0 +1,84 @@
+"""Profiling hooks: XLA traces + step annotations (SURVEY.md §5.1).
+
+The reference promises performance monitoring (README.md:21-23) with no
+mechanism; the coarse per-step pipeline here is
+:class:`easydl_tpu.core.metrics.MetricsRecorder` → Brain. This module is the
+deep-dive layer on top: ``jax.profiler`` device traces viewable in
+TensorBoard/Perfetto (compute/communication overlap, HBM, per-op time) and
+named step/phase annotations that show up inside those traces.
+
+Usage::
+
+    with trace("/tmp/profile"):          # whole-region trace
+        for step in range(10):
+            with step_annotation("train", step):
+                state, m = trainer.train_step(state, batch)
+
+    prof = StepProfiler("/tmp/profile", start_step=5, num_steps=3)
+    for step in range(20):
+        prof.maybe_start(step)           # traces only steps [5, 8)
+        ...
+        prof.maybe_stop(step)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("utils", "profiling")
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture an XLA device trace for the enclosed region."""
+    jax.profiler.start_trace(logdir)
+    log.info("profiler trace started -> %s", logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written -> %s", logdir)
+
+
+def step_annotation(name: str, step: Optional[int] = None):
+    """Label the enclosed work in the trace timeline (StepTraceAnnotation
+    when a step number is given, else a named TraceAnnotation)."""
+    if step is not None:
+        return jax.profiler.StepTraceAnnotation(name, step_num=step)
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepProfiler:
+    """Window-triggered tracing inside a training loop: skips compile/warmup
+    steps and captures exactly ``num_steps`` steady-state steps."""
+
+    def __init__(self, logdir: str, start_step: int = 5, num_steps: int = 3):
+        self.logdir = logdir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+        self._done = False
+
+    def maybe_start(self, step: int) -> None:
+        if not self._done and not self._active and step >= self.start_step:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+            log.info("profiling steps [%d, %d) -> %s", step, self.stop_step,
+                     self.logdir)
+
+    def maybe_stop(self, step: int) -> None:
+        if self._active and step + 1 >= self.stop_step:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# TSan/ASan gate for the C++ cores (SURVEY.md §5.2 — the reference had no
+# sanitizers, CI was lint-only). Builds each core with the sanitizer runtime
+# plus a stress driver that hammers the concurrent paths, and fails on any
+# report. Run locally or in CI: scripts/sanitize_native.sh [tsan|asan|all]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mode="${1:-all}"
+build() {  # $1 sanitizer flag, $2 tag
+  local flag="$1" tag="$2" out
+  out="$(mktemp -d)"
+  g++ -O1 -g -std=c++17 -fsanitize="$flag" -fno-omit-frame-pointer -Wall \
+    -DEDS_STRESS_MAIN -o "$out/eds_stress" \
+    easydl_tpu/ps/native/embedding_store_stress.cc -lpthread
+  "$out/eds_stress"
+  echo "embedding store: $tag clean"
+  g++ -O1 -g -std=c++17 -fsanitize="$flag" -fno-omit-frame-pointer -Wall \
+    -DEDR_STRESS_MAIN -o "$out/edr_stress" \
+    easydl_tpu/controller/native/reconciler_stress.cc -lpthread
+  "$out/edr_stress"
+  echo "reconciler core: $tag clean"
+  rm -rf "$out"
+}
+[[ "$mode" == "tsan" || "$mode" == "all" ]] && build thread tsan
+[[ "$mode" == "asan" || "$mode" == "all" ]] && build address,undefined asan+ubsan
+echo "sanitizers OK"

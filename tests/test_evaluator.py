@@ -103,3 +103,22 @@ def _cpu_env():
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     return env
+
+
+def test_profiling_trace_capture(tmp_path):
+    """--profile-dir captures an XLA trace of steady-state steps."""
+    import glob
+    import subprocess
+    import sys
+
+    prof = str(tmp_path / "prof")
+    r = subprocess.run(
+        [sys.executable, "-m", "easydl_tpu.models.run", "--model", "mlp",
+         "--steps", "8", "--batch", "8", "--model-arg", "features=[16,16]",
+         "--profile-dir", prof],
+        capture_output=True, text=True, timeout=300, env=_cpu_env(),
+    )
+    assert r.returncode == 0, r.stderr
+    traces = glob.glob(prof + "/**/*.trace.json.gz", recursive=True) + \
+        glob.glob(prof + "/**/*.xplane.pb", recursive=True)
+    assert traces, f"no trace files under {prof}: {r.stderr[-500:]}"

@@ -115,6 +115,20 @@ def test_full_reference_lifecycle(tmp_path):
         ckpts = [d for d in os.listdir(ckpt_dir) if d.startswith("step_")]
         assert ckpts, f"no checkpoints in {ckpt_dir}"
         assert os.path.exists(os.path.join(workdir, "master.json"))
+
+        # the workers trained the JOB'S command, not defaults: the trainer
+        # derived the worker config from ElasticJob spec.command
+        import json
+
+        with open(os.path.join(workdir, "job.json")) as f:
+            cfg = json.load(f)
+        assert cfg["model"] == "mlp"
+        assert cfg["total_steps"] == 8
+        assert cfg["global_batch"] == 16
+        assert cfg["ckpt_interval"] == 4
+        assert cfg["model_kwargs"] == {"features": [32, 32]}
+        # and training stopped at the commanded step count
+        assert max(int(d.split("_")[1]) for d in ckpts) == 8
     finally:
         stop.set()
         if pump_thread.is_alive():
