@@ -50,6 +50,10 @@ def make_gpt(
     attention_impl: str = "auto",
     attention_fn=None,
     dropout: float = 0.0,
+    moe_experts: int = 0,
+    moe_k: int = 2,
+    moe_aux_weight: float = 0.01,
+    moe_capacity_factor: float = 1.25,
 ) -> ModelBundle:
     n_layers, d_model, n_heads = SIZES[size]
     cfg = TransformerConfig(
@@ -66,6 +70,9 @@ def make_gpt(
         attention_impl=attention_impl,
         attention_fn=attention_fn,
         tied_head=True,
+        moe_experts=moe_experts,
+        moe_k=moe_k,
+        moe_capacity_factor=moe_capacity_factor,
     )
     model = Transformer(cfg)
 
@@ -74,6 +81,25 @@ def make_gpt(
         return model.init(rng, tokens)["params"]
 
     def loss_fn(params, batch, rng):
+        if moe_experts:
+            logits, mut = model.apply(
+                {"params": params}, batch["inputs"], mutable=["intermediates"]
+            )
+            aux = jnp.sum(
+                jnp.asarray(mut["intermediates"]["moe_aux_loss"][0])
+            )
+            loss, _ = lm_loss(logits, batch["targets"])
+            return loss + moe_aux_weight * aux, {
+                "perplexity": jnp.exp(loss),
+                "moe_balance": aux / max(n_layers, 1),
+            }
+        logits = model.apply({"params": params}, batch["inputs"])
+        loss, _ = lm_loss(logits, batch["targets"])
+        return loss, {"perplexity": jnp.exp(loss)}
+
+    def eval_fn(params, batch, rng):
+        # Pure LM loss — no balance regularizer, so eval is comparable
+        # across dense/MoE configs and aux weights.
         logits = model.apply({"params": params}, batch["inputs"])
         loss, _ = lm_loss(logits, batch["targets"])
         return loss, {"perplexity": jnp.exp(loss)}
@@ -82,10 +108,17 @@ def make_gpt(
         return SyntheticTokens(global_batch, seq_len=seq_len, vocab=vocab, seed=seed)
 
     return ModelBundle(
-        name=f"gpt-{size}",
+        name=f"gpt-{size}" + (f"-moe{moe_experts}" if moe_experts else ""),
         init_fn=init_fn,
         loss_fn=loss_fn,
         make_data=make_data,
-        eval_fn=loss_fn,
+        eval_fn=eval_fn,
         param_count_hint=cfg.param_count,
     )
+
+
+@register_model("gpt_moe")
+def make_gpt_moe(**kwargs) -> ModelBundle:
+    """GPT with mixture-of-experts FFNs (experts shard over ``ep``)."""
+    kwargs.setdefault("moe_experts", 8)
+    return make_gpt(**kwargs)

@@ -96,7 +96,11 @@ def main() -> None:
     if args.profile_dir:
         from easydl_tpu.utils.profiling import StepProfiler, step_annotation
 
-        profiler = StepProfiler(args.profile_dir, start_step=3, num_steps=3)
+        # Window relative to the (possibly resumed) first step, so the
+        # recompile-after-restore step is skipped just like a cold start's.
+        profiler = StepProfiler(
+            args.profile_dir, start_step=state.int_step + 3, num_steps=3
+        )
     try:
         while state.int_step < args.steps:
             step = state.int_step

@@ -90,6 +90,12 @@ class TransformerConfig:
     attention_fn: Optional[Callable] = None
     #: tie the LM head to the token embedding (GPT-2 does)
     tied_head: bool = True
+    #: mixture-of-experts: replace each block's FFN with ``moe_experts``
+    #: expert FFNs routed top-``moe_k`` (0 = dense). Experts shard over the
+    #: mesh's ``ep`` axis (easydl_tpu/ops/moe.py).
+    moe_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -98,9 +104,16 @@ class TransformerConfig:
 
     @property
     def param_count(self) -> int:
+        if self.moe_experts:
+            ffn = (
+                self.moe_experts * 2 * self.d_model * self.d_ff  # expert FFNs
+                + self.d_model * self.moe_experts                # router
+            )
+        else:
+            ffn = 2 * self.d_model * self.d_ff
         per_block = (
             4 * self.d_model * self.d_model      # qkv + out projections
-            + 2 * self.d_model * self.d_ff       # mlp in + out
+            + ffn
             + 4 * self.d_model                   # biases-ish + 2 LN
         )
         emb = self.vocab * self.d_model + self.max_seq * self.d_model
@@ -150,16 +163,29 @@ class Block(nn.Module):
         x = x + attn
 
         h = _layernorm("ln_mlp")(x)
-        h = _dense(cfg.d_ff, ("embed", "mlp"), ("mlp",), name="up")(h)
-        h = nn.gelu(h)
-        h = _dense(
-            cfg.d_model, ("mlp", "embed"), ("embed",), name="down",
-            init_scale=(2 * cfg.n_layers) ** -0.5,
-        )(h)
+        aux = jnp.zeros((), jnp.float32)
+        if cfg.moe_experts:
+            from easydl_tpu.ops.moe import MoeMlp
+
+            h, aux = MoeMlp(
+                num_experts=cfg.moe_experts,
+                d_ff=cfg.d_ff,
+                k=cfg.moe_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                out_init_scale=(2 * cfg.n_layers) ** -0.5,
+                name="moe",
+            )(h)
+        else:
+            h = _dense(cfg.d_ff, ("embed", "mlp"), ("mlp",), name="up")(h)
+            h = nn.gelu(h)
+            h = _dense(
+                cfg.d_model, ("mlp", "embed"), ("embed",), name="down",
+                init_scale=(2 * cfg.n_layers) ** -0.5,
+            )(h)
         if cfg.dropout and not deterministic:
             h = nn.Dropout(cfg.dropout, deterministic=False)(h)
         x = x + h
-        return nn.with_logical_constraint(x, ("batch", "seq", "embed")), None
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed")), aux
 
 
 class Transformer(nn.Module):
@@ -202,7 +228,7 @@ class Transformer(nn.Module):
             )
             block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
         # One traced block, scanned over a stacked 'layers' param axis.
-        x, _ = nn.scan(
+        x, layer_aux = nn.scan(
             block_cls,
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
@@ -210,6 +236,10 @@ class Transformer(nn.Module):
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(cfg, name="blocks")(x, deterministic)
+        # Per-layer MoE load-balance losses (zeros for dense blocks); read
+        # back by MoE loss fns via mutable=["intermediates"] — a no-op sow
+        # for plain apply() calls.
+        self.sow("intermediates", "moe_aux_loss", jnp.sum(layer_aux))
 
         x = _layernorm("ln_f")(x)
         if cfg.tied_head:

@@ -10,12 +10,12 @@ build() {  # $1 sanitizer flag, $2 tag
   local flag="$1" tag="$2" out
   out="$(mktemp -d)"
   g++ -O1 -g -std=c++17 -fsanitize="$flag" -fno-omit-frame-pointer -Wall \
-    -DEDS_STRESS_MAIN -o "$out/eds_stress" \
+    -o "$out/eds_stress" \
     easydl_tpu/ps/native/embedding_store_stress.cc -lpthread
   "$out/eds_stress"
   echo "embedding store: $tag clean"
   g++ -O1 -g -std=c++17 -fsanitize="$flag" -fno-omit-frame-pointer -Wall \
-    -DEDR_STRESS_MAIN -o "$out/edr_stress" \
+    -o "$out/edr_stress" \
     easydl_tpu/controller/native/reconciler_stress.cc -lpthread
   "$out/edr_stress"
   echo "reconciler core: $tag clean"

@@ -1,0 +1,98 @@
+"""MoE / expert-parallelism tests: routing invariants, capacity handling,
+load-balance signal, and GPT-MoE training over an ep-sharded mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from easydl_tpu.core.mesh import MeshSpec
+from easydl_tpu.core.train_loop import TrainConfig, Trainer
+from easydl_tpu.models.registry import get_model
+from easydl_tpu.ops.moe import MoeMlp, top_k_routing
+
+
+def test_routing_dispatch_combine_invariants():
+    rng = jax.random.PRNGKey(0)
+    g, s, e, c, k = 2, 16, 4, 8, 2
+    logits = jax.random.normal(rng, (g, s, e))
+    dispatch, combine, aux = top_k_routing(logits, k=k, capacity=c)
+    assert dispatch.shape == (g, s, e, c) and combine.shape == (g, s, e, c)
+    d = np.asarray(dispatch)
+    # every (expert, slot) holds at most one token
+    assert d.sum(axis=1).max() <= 1.0 + 1e-6
+    # each token dispatched to at most k slots, each at most once
+    assert d.sum(axis=(2, 3)).max() <= k + 1e-6
+    assert d.max() <= 1.0 + 1e-6
+    # combine weights live only where dispatch does, with softmax gates <= 1
+    cmb = np.asarray(combine)
+    assert (cmb[d == 0] == 0).all()
+    assert cmb.max() <= 1.0 + 1e-6
+    # balance term is ~1 at uniform randomness, >= 1 - eps in general
+    assert 0.5 < float(aux) < 2.5
+
+
+def test_routing_respects_capacity():
+    # All tokens prefer expert 0: only `capacity` of them may land there.
+    g, s, e, c = 1, 32, 4, 4
+    logits = jnp.zeros((g, s, e)).at[..., 0].set(10.0)
+    dispatch, combine, aux = top_k_routing(logits, k=1, capacity=c)
+    d = np.asarray(dispatch)
+    assert d[:, :, 0, :].sum() == c  # capacity filled, overflow dropped
+    assert float(aux) > 1.5  # imbalance detected
+
+
+def test_moe_mlp_forward_and_grads():
+    layer = MoeMlp(num_experts=4, d_ff=32, k=2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 8))
+    params = layer.init(jax.random.PRNGKey(2), x)
+
+    def loss(params, x):
+        y, aux = layer.apply(params, x)
+        return (y ** 2).mean() + 0.01 * aux
+
+    from easydl_tpu.core import sharding as shd
+
+    val, grads = jax.value_and_grad(loss)(params, x)
+    assert np.isfinite(float(val))
+    grads = shd.unbox(grads)  # strip LogicallyPartitioned boxes
+    flat = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+    # router must receive gradient (combine weights depend on it)
+    g_router = np.asarray(grads["params"]["router"]["kernel"])
+    assert np.abs(g_router).sum() > 0
+
+
+def test_gpt_moe_trains_on_ep_mesh(eight_devices):
+    """GPT-MoE: experts sharded over ep=4, batch over dp=2 — the full grad
+    + optimizer step, loss finite and decreasing, balance metric reported."""
+    bundle = get_model(
+        "gpt_moe", size="test", seq_len=32, vocab=256, moe_experts=4
+    )
+    trainer = Trainer(
+        init_fn=bundle.init_fn,
+        loss_fn=bundle.loss_fn,
+        optimizer=optax.adam(1e-3),
+        config=TrainConfig(global_batch=8, compute_dtype=jnp.float32),
+        mesh_spec=MeshSpec(dp=2, ep=4),
+    )
+    state = trainer.init_state()
+    # expert FFN params actually shard over ep
+    from easydl_tpu.core import sharding as shd
+
+    flat = shd.flatten_dict(shd.unbox(state.params))
+    moe_leaves = {k: v for k, v in flat.items() if "moe" in k and "w_in" in k}
+    assert moe_leaves, f"no moe params found: {list(flat)[:8]}"
+    (key, w_in), = list(moe_leaves.items())[:1]
+    ep_shard = w_in.sharding.spec
+    assert "ep" in str(ep_shard), f"w_in not ep-sharded: {ep_shard}"
+
+    data = iter(bundle.make_data(8, seed=0))
+    losses, balance = [], []
+    for _ in range(6):
+        state, m = trainer.train_step(state, next(data))
+        losses.append(float(m["loss"]))
+        balance.append(float(m["moe_balance"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert all(0.3 < b < 4.0 for b in balance), balance
