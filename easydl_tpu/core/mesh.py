@@ -81,12 +81,22 @@ class MeshSpec:
 def build_mesh(
     spec: MeshSpec,
     devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: int = 1,
 ) -> Mesh:
     """Materialise a :class:`MeshSpec` over real (or forced-CPU) devices.
 
     On TPU, ``mesh_utils.create_device_mesh`` maps logical axes onto the
     physical torus so innermost axes get contiguous ICI neighbours; elsewhere
     (CPU tests) a plain reshape suffices.
+
+    ``num_slices > 1`` builds a **hybrid ICI+DCN mesh** for multi-slice
+    jobs (the scaling-book recipe): the slice dimension becomes the MAJOR
+    stride of the ``dp`` axis — gradient all-reduce then decomposes into a
+    fast per-slice ICI reduce plus one cross-slice DCN exchange per step
+    (XLA's hierarchical collectives), while model axes (fsdp/tp/sp/ep/pp)
+    stay entirely within a slice. Requires ``spec.dp % num_slices == 0``;
+    slice membership comes from ``device.slice_index`` when the platform
+    reports it, else devices are chunked evenly in order (tests).
     """
     devices = list(devices) if devices is not None else list(jax.devices())
     n = spec.size
@@ -94,6 +104,8 @@ def build_mesh(
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
     devices = devices[:n]
     shape = spec.axis_sizes()
+    if num_slices > 1:
+        return _build_hybrid_mesh(spec, devices, num_slices)
     if devices[0].platform == "tpu":
         try:
             dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
@@ -101,6 +113,49 @@ def build_mesh(
             dev_array = np.asarray(devices).reshape(shape)
     else:
         dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def _build_hybrid_mesh(
+    spec: MeshSpec, devices: Sequence[jax.Device], num_slices: int
+) -> Mesh:
+    if spec.dp % num_slices:
+        raise ValueError(
+            f"dp={spec.dp} must be divisible by num_slices={num_slices} "
+            "(dp is the only axis that may cross DCN)"
+        )
+    per_slice = len(devices) // num_slices
+    by_slice: dict = {}
+    for i, d in enumerate(devices):
+        key = getattr(d, "slice_index", i // per_slice)
+        by_slice.setdefault(key, []).append(d)
+    if len(by_slice) != num_slices or any(
+        len(v) != per_slice for v in by_slice.values()
+    ):
+        raise ValueError(
+            f"devices don't form {num_slices} equal slices: "
+            f"{ {k: len(v) for k, v in by_slice.items()} }"
+        )
+    # Per-slice ICI mesh with the slice's dp share, then stack slices as the
+    # major dp dimension.
+    slice_spec = MeshSpec(
+        dp=spec.dp // num_slices, fsdp=spec.fsdp, tp=spec.tp,
+        sp=spec.sp, ep=spec.ep, pp=spec.pp,
+    )
+    slice_shape = slice_spec.axis_sizes()
+    stacks = []
+    for key in sorted(by_slice):
+        devs = by_slice[key]
+        if devs[0].platform == "tpu":
+            try:
+                arr = mesh_utils.create_device_mesh(slice_shape, devices=devs)
+            except (ValueError, AssertionError):
+                arr = np.asarray(devs).reshape(slice_shape)
+        else:
+            arr = np.asarray(devs).reshape(slice_shape)
+        stacks.append(arr)
+    dp_axis = AXES.index("dp")
+    dev_array = np.concatenate(stacks, axis=dp_axis)
     return Mesh(dev_array, AXES)
 
 

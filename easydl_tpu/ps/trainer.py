@@ -144,3 +144,42 @@ class PsTrainer(Trainer):
             self.table.name, ids, np.asarray(jax.device_get(gemb)), self.push_scale
         )
         return state, metrics
+
+    def train_steps(self, state: TrainState, data, n: int,
+                    on_metrics=None):
+        """Pipelined loop: the NEXT batch's embedding pull overlaps the
+        device step (classic async-PS software pipeline). Pulls may observe
+        one-step-stale rows for ids pushed by the in-flight step — the
+        standard async-PS staleness; use :meth:`train_step` for the strict
+        pull→step→push ordering.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(max_workers=1,
+                                  thread_name_prefix="ps-prefetch")
+
+        def fetch():
+            b = next(data)
+            ids = np.asarray(b[self.ids_key])
+            return b, ids, self.client.pull(self.table.name, ids)
+
+        metrics = None
+        fut = pool.submit(fetch)
+        try:
+            for _ in range(n):
+                batch, ids, emb = fut.result()
+                fut = pool.submit(fetch)  # overlap with the device step
+                rest = {k: v for k, v in batch.items() if k != self.emb_key}
+                state, metrics, gemb = self.step_fn(
+                    state, self.shard_batch(emb), self.shard_batch(rest)
+                )
+                self.client.push(
+                    self.table.name, ids,
+                    np.asarray(jax.device_get(gemb)), self.push_scale,
+                )
+                if on_metrics is not None:
+                    on_metrics(metrics)
+        finally:
+            fut.cancel()
+            pool.shutdown(wait=False)
+        return state, metrics

@@ -7,3 +7,48 @@ def test_eight_cpu_devices(eight_devices):
     assert len(eight_devices) == 8
     assert all(d.platform == "cpu" for d in eight_devices)
     assert jax.default_backend() == "cpu"
+
+
+def test_hybrid_multislice_mesh(eight_devices):
+    """num_slices>1 stacks per-slice ICI meshes along dp's major stride:
+    model axes never cross DCN, dp's outer halves align with slices."""
+    import numpy as np
+    import pytest
+
+    from easydl_tpu.core.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dp=4, tp=2), num_slices=2)
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    arr = mesh.devices  # [pp, dp, fsdp, ep, sp, tp]
+    dp_first = arr[0, :2].flatten()   # dp indices 0-1 = slice 0
+    dp_second = arr[0, 2:].flatten()  # dp indices 2-3 = slice 1
+    first_ids = {d.id for d in dp_first}
+    second_ids = {d.id for d in dp_second}
+    # even chunking on CPU: slice 0 = devices 0-3, slice 1 = devices 4-7
+    assert first_ids == {0, 1, 2, 3}
+    assert second_ids == {4, 5, 6, 7}
+
+    with pytest.raises(ValueError, match="divisible by num_slices"):
+        build_mesh(MeshSpec(dp=3, tp=2), num_slices=2)
+
+
+def test_hybrid_mesh_trains(eight_devices):
+    """A training step runs on the hybrid mesh (dp crossing 'slices')."""
+    import jax.numpy as jnp
+    import optax
+
+    from easydl_tpu.core.mesh import MeshSpec, build_mesh
+    from easydl_tpu.core.train_loop import TrainConfig, Trainer
+    from easydl_tpu.models.registry import get_model
+
+    mesh = build_mesh(MeshSpec(dp=4, fsdp=2), num_slices=2)
+    bundle = get_model("mlp", features=(16, 16))
+    trainer = Trainer(
+        init_fn=bundle.init_fn, loss_fn=bundle.loss_fn,
+        optimizer=optax.adam(1e-2),
+        config=TrainConfig(global_batch=16, compute_dtype=jnp.float32),
+        mesh=mesh,
+    )
+    state = trainer.init_state()
+    state, m = trainer.train_step(state, next(iter(bundle.make_data(16))))
+    assert float(m["loss"]) > 0

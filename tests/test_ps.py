@@ -267,6 +267,38 @@ def test_make_ps_model_inside_jit_step():
     assert client.total_rows("emb") > 0  # backward pushes materialised rows
 
 
+def test_ps_pipelined_steps_learn():
+    """The prefetch-pipelined loop (pull overlaps device step) still
+    learns; one-step staleness is benign."""
+    import jax
+    import optax
+
+    from easydl_tpu.core.mesh import MeshSpec
+    from easydl_tpu.core.train_loop import TrainConfig
+    from easydl_tpu.models.registry import get_model
+    from easydl_tpu.ps.trainer import PsTrainer
+
+    bundle = get_model("deepfm", vocab=2000, dim=8, hidden=(32,),
+                       embedding="ps", num_sparse=5, num_dense=4)
+    client = LocalPsClient(num_shards=2)
+    trainer = PsTrainer(
+        init_fn=bundle.init_fn, loss_fn=bundle.loss_fn,
+        optimizer=optax.adam(3e-3),
+        config=TrainConfig(global_batch=32, compute_dtype=jax.numpy.float32),
+        client=client,
+        table=TableSpec(name="emb", dim=8, optimizer="adagrad"),
+        mesh_spec=MeshSpec(dp=4),
+    )
+    state = trainer.init_state()
+    data = iter(bundle.make_data(32, seed=11))
+    seen = []
+    state, metrics = trainer.train_steps(
+        state, data, 25, on_metrics=lambda m: seen.append(float(m["loss"]))
+    )
+    assert len(seen) == 25 and state.int_step == 25
+    assert np.mean(seen[-5:]) < np.mean(seen[:5])
+
+
 def test_deepfm_ps_training_learns(tmp_path):
     import jax
     import optax
