@@ -113,7 +113,8 @@ class Reconciler {
     // absent from the plan means replicas 0 — omission must not orphan pods.
     // (The trainer role is operator-owned, never replica-levelled here.)
     for (const auto& p : pods_) {
-      if (p.role != "trainer" && !roles_.count(p.role)) {
+      if (p.role != "trainer" && !roles_.count(p.role) &&
+          !frozen_roles_.count(p.role)) {
         roles_[p.role] = {0, ""};
       }
     }
@@ -164,7 +165,25 @@ class Reconciler {
       if (f[0] == "J" && f.size() >= 2) {
         job_ = f[1];
       } else if (f[0] == "R" && f.size() >= 4) {
-        roles_[f[1]] = {std::atoi(f[2].c_str()), f[3]};
+        // Replicas must be all ASCII digits AND at most 7 of them (bounds
+        // the value far below INT_MAX — atoi overflow is UB — and bounds
+        // the levelling loop); a malformed count FREEZES the role for this
+        // pass (no creates, no deletes) — merely skipping the line would
+        // hand the role to the absent-role-means-replicas-0 fallback and
+        // delete every healthy pod; atoi's silent 0 would do the same.
+        // Identical in the Python twin — pinned by the fuzzer.
+        bool valid = !f[2].empty() && f[2].size() <= 7;
+        for (char c : f[2]) {
+          if (c < '0' || c > '9') {
+            valid = false;
+            break;
+          }
+        }
+        if (valid) {
+          roles_[f[1]] = {std::atoi(f[2].c_str()), f[3]};
+        } else {
+          frozen_roles_.insert(f[1]);
+        }
       } else if (f[0] == "U" && f.size() >= 3) {
         updations_.push_back({f[1], f[2]});
       }
@@ -194,6 +213,7 @@ class Reconciler {
   }
 
   std::string job_;
+  std::set<std::string> frozen_roles_;  // malformed replicas: don't level
   std::map<std::string, std::pair<int, std::string>> roles_;
   std::vector<std::pair<std::string, std::string>> updations_;
   std::vector<Pod> pods_;

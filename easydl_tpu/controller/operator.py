@@ -22,8 +22,9 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from easydl_tpu.api.job_spec import JobSpec, ResourceSpec
 from easydl_tpu.api.resource_plan import ResourcePlan
@@ -117,13 +118,49 @@ class ElasticJobController:
     :meth:`start` a background thread that drains store events."""
 
     def __init__(self, store: CrStore, pod_api: PodApi,
-                 force_python_core: bool = False):
+                 force_python_core: bool = False,
+                 restart_backoff_base: float = 0.5,
+                 restart_backoff_max: float = 30.0,
+                 restart_backoff_reset: float = 60.0):
         self.store = store
         self.pods = pod_api
         self._force_py = force_python_core
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._drift_warned: set = set()  # (job, pod, sig) already reported
+        # Crash-loop backoff: hot-respawning a Failed pod every reconcile
+        # pass starves a loaded machine (the round-1 lifecycle flake). Pod
+        # failures back replacement creates off exponentially per
+        # (job, role); a quiet restart_backoff_reset window forgives.
+        self._bo_base = restart_backoff_base
+        self._bo_max = restart_backoff_max
+        self._bo_reset = restart_backoff_reset
+        # (job, role) -> (consecutive failures, last failure t, next create t)
+        self._backoff: Dict[Tuple[str, str], Tuple[int, float, float]] = {}
+
+    # -------------------------------------------------------------- backoff
+    def _note_failure(self, job: str, role: str) -> None:
+        now = time.monotonic()
+        count, last, _ = self._backoff.get((job, role), (0, 0.0, 0.0))
+        count = 1 if now - last > self._bo_reset else count + 1
+        # First failure recovers instantly (post-preemption recovery time is
+        # a headline metric); only a crash LOOP backs off.
+        delay = (
+            0.0 if count == 1
+            else min(self._bo_max, self._bo_base * (2 ** (count - 2)))
+        )
+        self._backoff[(job, role)] = (count, now, now + delay)
+        if count > 1:
+            log.warning(
+                "%s/%s: %d consecutive pod failures; backing off creates %.1fs",
+                job, role, count, delay,
+            )
+
+    def _create_deferred(self, job: str, role: str) -> bool:
+        """True while replacement creates for this role should wait (the
+        level-triggered resync retries them once the backoff expires)."""
+        entry = self._backoff.get((job, role))
+        return entry is not None and time.monotonic() < entry[2]
 
     # ------------------------------------------------------------- reconcile
     def reconcile_job(self, job_name: str) -> JobStatus:
@@ -139,6 +176,9 @@ class ElasticJobController:
             self._drift_warned = {
                 w for w in self._drift_warned if w[0] != job_name
             }
+            self._backoff = {
+                k: v for k, v in self._backoff.items() if k[0] != job_name
+            }
             return status
 
         # Figure step 3: trainer pod first, before any plan exists. The
@@ -149,7 +189,10 @@ class ElasticJobController:
             if p.phase == "Failed":
                 self.pods.delete_pod(p.name)
                 status.last_ops.append(f"DELETE {p.name} (failed)")
-        if not any(p.phase in ("Pending", "Running") for p in trainer_pods):
+                self._note_failure(job_name, "trainer")
+        if self._create_deferred(job_name, "trainer"):
+            pass  # crash-looping trainer: let the backoff window elapse
+        elif not any(p.phase in ("Pending", "Running") for p in trainer_pods):
             indices = [_trailing_index(p.name) for p in trainer_pods]
             name = f"{job_name}-trainer-{max(indices, default=-1) + 1}"
             self.pods.create_pod(
@@ -184,8 +227,11 @@ class ElasticJobController:
                 job_name, plan_for_diff, observed, force_python=self._force_py
             )
             self._warn_resource_drift(job_name, plan_for_diff, observed)
+            role_of = {p.name: p.role for p in observed}
             for op in ops:
                 if op.verb == "CREATE":
+                    if self._create_deferred(job_name, op.role):
+                        continue  # crash-loop backoff; resync retries
                     self.pods.create_pod(
                         Pod(
                             name=op.name, job=job_name, role=op.role,
@@ -197,6 +243,8 @@ class ElasticJobController:
                     )
                 else:
                     self.pods.delete_pod(op.name)
+                    if op.reason == "failed":
+                        self._note_failure(job_name, role_of.get(op.name, ""))
                 status.last_ops.append(f"{op.verb} {op.name}"
                                        + (f" ({op.reason})" if op.reason else ""))
 

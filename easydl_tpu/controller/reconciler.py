@@ -107,12 +107,21 @@ def _trailing_index(name: str) -> int:
 
 def _py_reconcile(desired: str, observed: str) -> str:
     job, roles, updations, pods = "", {}, [], []
+    frozen_roles = set()  # malformed replicas: don't level this pass
     for line in desired.splitlines():
         f = line.split("|")
         if f[0] == "J" and len(f) >= 2:
             job = f[1]
         elif f[0] == "R" and len(f) >= 4:
-            roles[f[1]] = (int(f[2]), f[3])
+            # ASCII-digits-only, max 7 digits — matching the C++ core's
+            # validation exactly (not int(): that accepts "+3"/" 3"/unicode
+            # digits and unbounded magnitudes the core rejects). A malformed
+            # count freezes the role — falling through to the
+            # absent-role-means-0 fallback would delete every healthy pod.
+            if f[2] and len(f[2]) <= 7 and all("0" <= c <= "9" for c in f[2]):
+                roles[f[1]] = (int(f[2]), f[3])
+            else:
+                frozen_roles.add(f[1])
         elif f[0] == "U" and len(f) >= 3:
             updations.append((f[1], f[2]))
     for line in observed.splitlines():
@@ -161,7 +170,8 @@ def _py_reconcile(desired: str, observed: str) -> str:
     # Roles with pods but absent from the plan mean replicas 0 (omission must
     # not orphan pods); trainer is operator-owned, never levelled here.
     for p in pods:
-        if p["role"] != "trainer" and p["role"] not in roles:
+        if (p["role"] != "trainer" and p["role"] not in roles
+                and p["role"] not in frozen_roles):
             roles[p["role"]] = (0, "")
 
     def replacement_in_flight(p) -> bool:

@@ -132,13 +132,21 @@ class CheckpointManager:
         self.async_save = async_save
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        # Multi-process async saves split in two: chunk IO runs on a
+        # background thread (local files only, no collectives), while the
+        # commit — whose barriers are collectives and must run on the main
+        # thread — is deferred until :meth:`finalize` (or :meth:`wait`) is
+        # called from the training loop at a later step boundary.
+        self._pending_commit = None
         os.makedirs(directory, exist_ok=True)
 
     # ------------------------------------------------------------------ save
     def save(self, step: int, state: Any, metadata: Optional[Dict[str, Any]] = None) -> None:
         """Snapshot shards to host, then write asynchronously (unless
         ``async_save=False``). Call :meth:`wait` before donating buffers is
-        NOT needed — the snapshot happens here, synchronously."""
+        NOT needed — the snapshot happens here, synchronously. In
+        multi-process runs an async save defers its commit barrier: call
+        :meth:`finalize` each step (all ranks together) to complete it."""
         self.wait()
         multiproc = jax.process_count() > 1
         # Skip if already committed (e.g. quiesce landing on a periodic-save
@@ -155,11 +163,6 @@ class CheckpointManager:
         if skip:
             log.info("step %d already checkpointed; skipping", step)
             return
-        if multiproc and self.async_save:
-            # The commit barrier is a collective; collectives must run on the
-            # main thread alongside no other device work — force sync saves.
-            log.warning("multi-process run: forcing synchronous checkpoint save")
-            self.async_save = False
         leaves = jax.tree_util.tree_flatten_with_path(state)[0]
         snapshot = []  # (leaf_idx, keystr, global_shape, dtype, [(bounds, np.ndarray)])
         for i, (path, leaf) in enumerate(leaves):
@@ -179,26 +182,12 @@ class CheckpointManager:
                      [(tuple(slice(0, d) for d in arr.shape), arr)])
                 )
 
-        def write():
-            t0 = time.perf_counter()
-            step_dir = os.path.join(self.directory, f"step_{step:08d}")
-            tmp_dir = step_dir + f".tmp.{jax.process_index()}"
-            # A step_dir without COMMITTED is debris from an aborted save (we
-            # may be retraining through the same step after a restore): clear
-            # it so stale chunks can't mix into — or block — this commit.
-            # Process 0 decides and clears; the barrier is UNCONDITIONAL in
-            # multi-process runs so every rank enters the same collectives
-            # regardless of its local FS view.
-            if jax.process_index() == 0 and (
-                os.path.exists(step_dir)
-                and not os.path.exists(os.path.join(step_dir, _COMMITTED))
-            ):
-                log.warning("clearing aborted save at %s", step_dir)
-                shutil.rmtree(step_dir, ignore_errors=True)
-            if multiproc:
-                from jax.experimental import multihost_utils
+        t0 = time.perf_counter()
+        step_dir = os.path.join(self.directory, f"step_{step:08d}")
+        tmp_dir = step_dir + f".tmp.{jax.process_index()}"
 
-                multihost_utils.sync_global_devices(f"easydl_ckpt_clean_{step}")
+        def write_chunks():
+            # LOCAL file IO only — safe on a background thread.
             # Our own tmp dir may hold chunks from a save that crashed mid-way
             # (possibly under a different sharding); the commit loop moves
             # every file in it, so start from a clean slate. Per-process dir —
@@ -222,6 +211,26 @@ class CheckpointManager:
             if jax.process_index() == 0:
                 with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
                     json.dump(manifest, f)
+
+        def commit():
+            # Contains the collective barriers — must run on the MAIN thread
+            # in multi-process runs (via finalize()/wait() or the sync path).
+            # A step_dir without COMMITTED is debris from an aborted save (we
+            # may be retraining through the same step after a restore): clear
+            # it so stale chunks can't mix into — or block — this commit.
+            # Process 0 decides and clears; the barrier is UNCONDITIONAL in
+            # multi-process runs so every rank enters the same collectives
+            # regardless of its local FS view.
+            if jax.process_index() == 0 and (
+                os.path.exists(step_dir)
+                and not os.path.exists(os.path.join(step_dir, _COMMITTED))
+            ):
+                log.warning("clearing aborted save at %s", step_dir)
+                shutil.rmtree(step_dir, ignore_errors=True)
+            if multiproc:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"easydl_ckpt_clean_{step}")
             # Single-host commit: rename tmp → final, then COMMITTED marker.
             # (Multi-host: every process renames its own tmp dir contents in;
             # process 0 writes the marker after a barrier — see note below.)
@@ -251,24 +260,62 @@ class CheckpointManager:
             self._gc()
 
         if self.async_save:
-            def run():
+            def run_io():
                 try:
-                    write()
+                    write_chunks()
+                    if not multiproc:
+                        # No collectives involved — commit on the IO thread
+                        # so single-process saves complete with no further
+                        # calls (pre-existing contract).
+                        commit()
                 except BaseException as e:  # surfaced on next wait()/save()
                     self._error = e
 
-            self._thread = threading.Thread(target=run, daemon=True)
+            if multiproc:
+                self._pending_commit = commit
+            self._thread = threading.Thread(target=run_io, daemon=True)
             self._thread.start()
         else:
-            write()
+            write_chunks()
+            commit()
+
+    def finalize(self, block: bool = False) -> bool:
+        """Complete a pending deferred commit, running its collective
+        barriers on the caller's (main) thread.
+
+        Multi-process contract: every process calls this at the same step
+        boundary with the same ``block`` value. With ``block=False`` the
+        commit happens only once ALL ranks' chunk IO has finished (agreed via
+        a tiny allgather, so no rank enters the barrier alone). Returns True
+        when nothing remains pending."""
+        if self._pending_commit is None:
+            return True
+        ready = block or self._thread is None or not self._thread.is_alive()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            ready = bool(
+                multihost_utils.process_allgather(
+                    np.asarray([1 if ready else 0], np.int32)
+                ).min()
+            )
+        if not ready:
+            return False
+        self.wait()
+        return True
 
     def wait(self) -> None:
+        """Block until any in-flight save (IO + deferred commit) completes."""
         if self._thread is not None:
             self._thread.join()
             self._thread = None
         if self._error is not None:
             err, self._error = self._error, None
+            self._pending_commit = None  # chunks incomplete: never commit
             raise RuntimeError(f"async checkpoint save failed: {err!r}") from err
+        if self._pending_commit is not None:
+            commit, self._pending_commit = self._pending_commit, None
+            commit()
 
     # ---------------------------------------------------------------- restore
     def steps(self) -> List[int]:

@@ -38,6 +38,8 @@ class Agent:
         platform: str = "cpu",
         heartbeat_interval: float = 0.3,
         worker_argv: Optional[List[str]] = None,
+        master_file: Optional[str] = None,
+        master_refresh_s: float = 5.0,
     ):
         self.agent_id = agent_id
         self.master_address = master_address
@@ -46,6 +48,13 @@ class Agent:
         self.host = host
         self.platform = platform
         self.heartbeat_interval = heartbeat_interval
+        # When the trainer pod is replaced, the new master publishes a NEW
+        # address into master_file; after master_refresh_s of failed
+        # heartbeats the agent re-reads it and re-registers there (without
+        # this, persisted master state is useless — surviving agents would
+        # retry the dead address forever).
+        self.master_file = master_file
+        self.master_refresh_s = master_refresh_s
         self.worker_argv = worker_argv or [
             sys.executable, "-m", "easydl_tpu.elastic.worker"
         ]
@@ -88,10 +97,8 @@ class Agent:
         return self._proc.pid if self._proc and self._proc.poll() is None else None
 
     # ------------------------------------------------------------------ loop
-    def run(self) -> None:
-        self._client = RpcClient(MASTER_SERVICE, self.master_address, timeout=10.0)
-        self._client.wait_ready(30.0)
-        directive = self._client.Register(
+    def _register(self) -> pb.Directive:
+        return self._client.Register(
             pb.RegisterRequest(
                 agent_id=self.agent_id,
                 host=self.host,
@@ -99,6 +106,44 @@ class Agent:
                 preemption_notice="preempt" if self._preempting.is_set() else "",
             )
         )
+
+    def _maybe_follow_master(self) -> Optional[pb.Directive]:
+        """Re-read master_file; if the master moved, reconnect + re-register."""
+        if not self.master_file:
+            return None
+        try:
+            with open(self.master_file) as f:
+                new_addr = json.load(f)["address"]
+        except (OSError, ValueError, KeyError):
+            return None
+        if not new_addr or new_addr == self.master_address:
+            return None
+        log.info("%s: master moved %s -> %s; re-registering",
+                 self.agent_id, self.master_address, new_addr)
+        client = RpcClient(MASTER_SERVICE, new_addr, timeout=10.0)
+        try:
+            client.wait_ready(10.0)
+        except Exception as e:
+            log.warning("%s: reconnect to %s failed: %s",
+                        self.agent_id, new_addr, e)
+            client.close()
+            return None
+        old, self._client = self._client, client
+        self.master_address = new_addr
+        if old:
+            old.close()
+        try:
+            return self._register()
+        except Exception as e:
+            log.warning("%s: re-register at %s failed: %s",
+                        self.agent_id, new_addr, e)
+            return None
+
+    def run(self) -> None:
+        self._client = RpcClient(MASTER_SERVICE, self.master_address, timeout=10.0)
+        self._client.wait_ready(30.0)
+        directive = self._register()
+        fail_since: Optional[float] = None
         while not self._stop.is_set():
             self._apply(directive)
             self._refresh_state()
@@ -125,8 +170,16 @@ class Agent:
                         slots=self.slots,
                     )
                 )
+                fail_since = None
             except Exception as e:
                 log.warning("%s: heartbeat failed: %s", self.agent_id, e)
+                now = time.monotonic()
+                fail_since = fail_since if fail_since is not None else now
+                if now - fail_since > self.master_refresh_s:
+                    refreshed = self._maybe_follow_master()
+                    if refreshed is not None:
+                        directive = refreshed
+                        fail_since = None
                 time.sleep(self.heartbeat_interval)
         self._terminate_worker(graceful=False)
         if self._log_file is not None:
@@ -213,12 +266,9 @@ class Agent:
             }
         )
         if self.platform == "cpu":
-            env["JAX_PLATFORMS"] = "cpu"
-            env["PALLAS_AXON_POOL_IPS"] = ""  # neutralise TPU plugin in subproc
-            env["XLA_FLAGS"] = (
-                env.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={self.slots}"
-            )
+            from easydl_tpu.utils.env import cpu_subprocess_env
+
+            env = cpu_subprocess_env(self.slots, base=env)
         log_path = os.path.join(self.workdir, f"worker-{self.agent_id}.log")
         if self._log_file is not None:
             self._log_file.close()
@@ -272,11 +322,19 @@ def main() -> None:  # pragma: no cover - CLI entry
     p.add_argument("--workdir", required=True)
     p.add_argument("--slots", type=int, default=1)
     p.add_argument("--platform", default="cpu")
+    p.add_argument(
+        "--master-wait", type=float,
+        default=float(os.environ.get("EASYDL_MASTER_WAIT_S", "600")),
+        help="seconds to poll --master-file before giving up (default 600 "
+             "or $EASYDL_MASTER_WAIT_S; under load the trainer pod can take "
+             "minutes to import jax and publish the master address)")
     args = p.parse_args()
     if not args.master and not args.master_file:
         p.error("one of --master / --master-file is required")
     if args.master_file:
-        deadline = time.monotonic() + 120.0
+        start = time.monotonic()
+        deadline = start + args.master_wait
+        next_log = start + 10.0
         last_err: Optional[Exception] = None
         while time.monotonic() < deadline:
             try:
@@ -285,11 +343,19 @@ def main() -> None:  # pragma: no cover - CLI entry
                 break
             except (OSError, ValueError, KeyError) as e:
                 last_err = e
+                now = time.monotonic()
+                if now >= next_log:
+                    log.info(
+                        "%s: waiting for master file %s (%.0fs elapsed, "
+                        "last error: %r)",
+                        args.id, args.master_file, now - start, last_err,
+                    )
+                    next_log = now + 10.0
                 time.sleep(0.5)
         else:
             raise SystemExit(
-                f"master file {args.master_file} unusable after 120s "
-                f"(last error: {last_err!r})"
+                f"master file {args.master_file} unusable after "
+                f"{args.master_wait:.0f}s (last error: {last_err!r})"
             )
     agent = Agent(
         agent_id=args.id,
@@ -297,6 +363,7 @@ def main() -> None:  # pragma: no cover - CLI entry
         workdir=args.workdir,
         slots=args.slots,
         platform=args.platform,
+        master_file=args.master_file or None,
     )
     signal.signal(signal.SIGTERM, lambda *_: agent.notify_preemption())
     agent.run()

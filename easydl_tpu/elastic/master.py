@@ -100,11 +100,25 @@ class Master:
         self.job_name = job_name
         self.workdir = workdir
         os.makedirs(workdir, exist_ok=True)
+        # Control-loop state survives trainer-pod replacement: the operator
+        # will happily replace the trainer pod (resource_updation / failure),
+        # and a fresh master must resume the plan loop, not reset it.
+        self._state_path = os.path.join(workdir, "master-state.json")
+        self._events_path = os.path.join(workdir, "events.jsonl")
+        persisted = self._load_state()
         self.rendezvous = Rendezvous(
-            desired_workers=desired_workers,
+            # Persisted desired_workers wins over the constructor's startup
+            # count: the applied plan's effect must survive the restart too —
+            # restoring only plan_version would pin the job at startup scale
+            # (equal-version plans are rejected as stale, and the Brain
+            # answers has_plan=False for a version the master already has).
+            desired_workers=int(
+                persisted.get("desired_workers", desired_workers)
+            ),
             min_workers=min_workers,
             heartbeat_timeout=heartbeat_timeout,
             port_alloc=free_port,
+            start_generation=int(persisted.get("generation", 0)),
         )
         self._lock = threading.RLock()
         self._server = None
@@ -114,14 +128,61 @@ class Master:
         self._brain_thread: Optional[threading.Thread] = None
         self.brain_address = brain_address
         self.brain_poll_interval = brain_poll_interval
-        self.plan_version = 0
-        self.events: List[Dict[str, Any]] = []  # timeline for recovery metrics
+        self.plan_version = int(persisted.get("plan_version", 0))
+        # Timeline for recovery metrics; restored so post-restart analysis
+        # (scripts/measure_recovery.py) sees the whole job, not one pod's life.
+        self.events: List[Dict[str, Any]] = self._load_events()
+        if persisted:
+            log.info(
+                "restored master state: plan v%d, generation %d, %d events",
+                self.plan_version, self.rendezvous.generation, len(self.events),
+            )
         self._last_metrics: Dict[str, pb.StepMetrics] = {}
         self._metrics_q: "queue.Queue" = queue.Queue(maxsize=4)
         self._reporter_thread: Optional[threading.Thread] = None
         if worker_config is not None:
             with open(os.path.join(workdir, "job.json"), "w") as f:
                 json.dump(worker_config, f)
+
+    # ------------------------------------------------------------- persistence
+    def _load_state(self) -> Dict[str, Any]:
+        try:
+            with open(self._state_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _load_events(self) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        try:
+            with open(self._events_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            events.append(json.loads(line))
+                        except ValueError:
+                            pass  # torn tail line from a killed master
+        except OSError:
+            pass
+        return events
+
+    def _persist_state(self) -> None:
+        tmp = self._state_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "plan_version": self.plan_version,
+                        "generation": self.rendezvous.generation,
+                        "desired_workers": self.rendezvous.desired_workers,
+                        "job": self.job_name,
+                    },
+                    f,
+                )
+            os.replace(tmp, self._state_path)
+        except OSError as e:
+            log.warning("master state persist failed: %s", e)
 
     # ------------------------------------------------------------------ server
     @property
@@ -168,8 +229,14 @@ class Master:
             self.plan_version = plan.version
             workers = plan.replicas("worker")
             if workers > 0:
-                self._event("plan", version=plan.version, workers=workers)
+                # Apply BEFORE persisting: the state file must never pair the
+                # new plan_version with the old desired_workers (a restart in
+                # that window would pin the job at the stale scale, since
+                # equal versions are rejected as stale).
                 self.rendezvous.set_desired_workers(workers)
+                self._event("plan", version=plan.version, workers=workers)
+            else:
+                self._persist_state()
 
     def _brain_loop(self) -> None:
         from easydl_tpu.brain.service import BRAIN_SERVICE  # local import: optional dep
@@ -223,7 +290,14 @@ class Master:
         client.close()
 
     def _event(self, kind: str, **data: Any) -> None:
-        self.events.append({"t": time.time(), "kind": kind, **data})
+        ev = {"t": time.time(), "kind": kind, **data}
+        self.events.append(ev)
+        try:
+            with open(self._events_path, "a") as f:
+                f.write(json.dumps(ev) + "\n")
+        except OSError as e:
+            log.warning("event append failed: %s", e)
+        self._persist_state()
 
     def _to_proto(self, d: Directive) -> pb.Directive:
         out = pb.Directive(kind=_KIND_TO_PROTO[d.kind])

@@ -50,6 +50,21 @@ def run_worker(env: Dict[str, str]) -> int:
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    # Persistent compilation cache shared across generations: every
+    # membership change rebuilds the trainer and re-jits, and without this
+    # the recompile dominates recovery time (SURVEY.md §7 hard part 1).
+    # Thresholds at 0 so even fast test-scale compiles are cached.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get(
+                "EASYDL_COMPILE_CACHE", os.path.join(workdir, "jax_cache")
+            ),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # older jax without these knobs: cache is best-effort
+        pass
     if world > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator,
@@ -83,7 +98,9 @@ def run_worker(env: Dict[str, str]) -> int:
         ),
         mesh=mesh,
     )
-    ckpt = CheckpointManager(os.path.join(workdir, "ckpt"), keep=3, async_save=False)
+    # Async saves overlap chunk IO with training; the commit barrier runs on
+    # this (main) thread via ckpt.finalize() at step boundaries below.
+    ckpt = CheckpointManager(os.path.join(workdir, "ckpt"), keep=3, async_save=True)
 
     # Agree on the restore step (a marker committed between two processes'
     # directory listings must not split the group).
@@ -140,6 +157,7 @@ def run_worker(env: Dict[str, str]) -> int:
         if want_quiesce:
             log.info("gen %d: quiescing at step %d", generation, step)
             ckpt.save(step, state)  # no-op if this step is already committed
+            ckpt.wait()  # commit must land before this process exits
             return 0
 
         t0 = time.perf_counter()
@@ -151,8 +169,12 @@ def run_worker(env: Dict[str, str]) -> int:
 
         if ckpt_interval > 0 and step % ckpt_interval == 0 and step < total_steps:
             ckpt.save(step, state)
+        # Complete any deferred multi-process commit once every rank's chunk
+        # IO is done (collective agreement; barriers on this main thread).
+        ckpt.finalize()
 
     ckpt.save(total_steps, state)
+    ckpt.wait()
     if rank == 0:
         with open(os.path.join(workdir, "DONE"), "w") as f:
             f.write(str(total_steps))

@@ -50,6 +50,7 @@ def make_gpt(
     attention_impl: str = "auto",
     attention_fn=None,
     dropout: float = 0.0,
+    dtype: str = "float32",
     moe_experts: int = 0,
     moe_k: int = 2,
     moe_aux_weight: float = 0.01,
@@ -69,6 +70,7 @@ def make_gpt(
         remat_policy=remat_policy,
         attention_impl=attention_impl,
         attention_fn=attention_fn,
+        dtype=dtype,
         tied_head=True,
         moe_experts=moe_experts,
         moe_k=moe_k,

@@ -122,6 +122,10 @@ def main() -> None:
                          rec.samples_per_sec)
             if ckpt is not None and (step % args.ckpt_every == 0 or step == args.steps):
                 ckpt.save(step, state)
+            if ckpt is not None:
+                # Complete any deferred multi-process commit at the step
+                # boundary (collectives on this main thread); no-op otherwise.
+                ckpt.finalize()
     finally:
         # Flush an in-flight trace even on a crash — the traced steps are
         # exactly the ones worth inspecting afterwards.

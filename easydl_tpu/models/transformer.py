@@ -40,11 +40,13 @@ def _dense(
     use_bias=True,
     init_scale=1.0,
     axis=-1,
+    dtype=None,
 ):
     return nn.DenseGeneral(
         features,
         axis=axis,
         use_bias=use_bias,
+        dtype=dtype,  # compute dtype; params stay f32 (param_dtype default)
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.normal(stddev=0.02 * init_scale), kernel_axes
         ),
@@ -55,9 +57,12 @@ def _dense(
     )
 
 
-def _layernorm(name):
+def _layernorm(name, dtype=None):
+    # LayerNorm statistics always accumulate in f32 (flax does this when
+    # dtype is low-precision); only the output is cast to ``dtype``.
     return nn.LayerNorm(
         use_bias=True,
+        dtype=dtype,
         scale_init=nn.with_logical_partitioning(
             nn.initializers.ones_init(), ("embed",)
         ),
@@ -84,6 +89,10 @@ class TransformerConfig:
     #: (jax dots_saveable policy — ~8% faster on TPU when HBM allows).
     remat_policy: str = "full"
     attention_impl: str = "auto"
+    #: compute/activation dtype ("float32" | "bfloat16"). Params stay f32;
+    #: matmuls and activations run in this dtype (bf16 halves HBM traffic —
+    #: the usual TPU bottleneck) and the loss upcasts logits to f32.
+    dtype: str = "float32"
     #: sequence-parallel attention override: a ``(q, k, v) -> out`` callable
     #: (e.g. from :func:`easydl_tpu.ops.sequence_parallel.make_sp_attention`)
     #: replacing the local attention — ring/Ulysses over the mesh's sp axis.
@@ -134,13 +143,17 @@ class Block(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         # NB: ``deterministic`` is positional — nn.scan drops kwargs.
         cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
-        h = _layernorm("ln_attn")(x)
+        h = _layernorm("ln_attn", dtype=dt)(x)
         qkv_shape = (cfg.n_heads, cfg.head_dim)
-        q = _dense(qkv_shape, ("embed", "heads", "kv"), ("heads", "kv"), name="q")(h)
-        k = _dense(qkv_shape, ("embed", "heads", "kv"), ("heads", "kv"), name="k")(h)
-        v = _dense(qkv_shape, ("embed", "heads", "kv"), ("heads", "kv"), name="v")(h)
+        q = _dense(qkv_shape, ("embed", "heads", "kv"), ("heads", "kv"),
+                   name="q", dtype=dt)(h)
+        k = _dense(qkv_shape, ("embed", "heads", "kv"), ("heads", "kv"),
+                   name="k", dtype=dt)(h)
+        v = _dense(qkv_shape, ("embed", "heads", "kv"), ("heads", "kv"),
+                   name="v", dtype=dt)(h)
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
@@ -157,12 +170,13 @@ class Block(nn.Module):
             name="out",
             init_scale=(2 * cfg.n_layers) ** -0.5,  # GPT-2 residual scaling
             axis=(-2, -1),
+            dtype=dt,
         )(attn)
         if cfg.dropout and not deterministic:
             attn = nn.Dropout(cfg.dropout, deterministic=False)(attn)
         x = x + attn
 
-        h = _layernorm("ln_mlp")(x)
+        h = _layernorm("ln_mlp", dtype=dt)(x)
         aux = jnp.zeros((), jnp.float32)
         if cfg.moe_experts:
             from easydl_tpu.ops.moe import MoeMlp
@@ -173,14 +187,17 @@ class Block(nn.Module):
                 k=cfg.moe_k,
                 capacity_factor=cfg.moe_capacity_factor,
                 out_init_scale=(2 * cfg.n_layers) ** -0.5,
+                dtype=cfg.dtype,
                 name="moe",
             )(h)
         else:
-            h = _dense(cfg.d_ff, ("embed", "mlp"), ("mlp",), name="up")(h)
+            h = _dense(cfg.d_ff, ("embed", "mlp"), ("mlp",), name="up",
+                       dtype=dt)(h)
             h = nn.gelu(h)
             h = _dense(
                 cfg.d_model, ("mlp", "embed"), ("embed",), name="down",
                 init_scale=(2 * cfg.n_layers) ** -0.5,
+                dtype=dt,
             )(h)
         if cfg.dropout and not deterministic:
             h = nn.Dropout(cfg.dropout, deterministic=False)(h)
@@ -196,9 +213,11 @@ class Transformer(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, deterministic: bool = True):
         cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
         tok_emb = nn.Embed(
             cfg.vocab,
             cfg.d_model,
+            dtype=dt,
             embedding_init=nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ("vocab", "embed")
             ),
@@ -212,7 +231,7 @@ class Transformer(nn.Module):
             (cfg.max_seq, cfg.d_model),
         )
         seq = tokens.shape[1]
-        x = tok_emb(tokens) + jnp.asarray(pos_emb)[None, :seq]
+        x = tok_emb(tokens) + jnp.asarray(pos_emb, dt)[None, :seq]
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         block_cls = Block
@@ -241,7 +260,7 @@ class Transformer(nn.Module):
         # for plain apply() calls.
         self.sow("intermediates", "moe_aux_loss", jnp.sum(layer_aux))
 
-        x = _layernorm("ln_f")(x)
+        x = _layernorm("ln_f", dtype=dt)(x)
         if cfg.tied_head:
             logits = tok_emb.attend(x)
         else:

@@ -31,16 +31,25 @@ def _reference_attention(
     fp32 softmax accumulation regardless of input dtype (bf16-safe).
     """
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    fully_masked = None
     if causal:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
         logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+        # Bottom-right alignment with s_q > s_k leaves the first s_q - s_k
+        # rows with no visible keys; the flash kernel outputs zeros for such
+        # rows (its normaliser clamps to ~0), so zero them here too instead
+        # of softmax's uniform mean of V — both paths must agree.
+        fully_masked = ~mask.any(axis=-1)  # [s_q]
     if segment_ids is not None:
         # segment_ids: [batch, seq] -> mask [batch, 1, q, k]
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
         logits = jnp.where(seg_mask[:, None], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if fully_masked is not None:
+        out = jnp.where(fully_masked[None, :, None, None], 0.0, out)
+    return out
 
 
 @functools.partial(

@@ -103,11 +103,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, n_k_live, body, (m0, l0, acc0))
+    # Rows that saw no unmasked key (bottom-right-aligned causal with
+    # s_q > s_k leaves the first s_q - s_k rows empty) still have m at the
+    # NEG_INF sentinel: their p would be exp(0)=1, silently averaging V.
+    # Define such rows as zero output, and poison their lse to +|NEG_INF| so
+    # the backward's exp(s - lse) underflows to exactly 0 (no grad leak).
+    dead = m <= NEG_INF * 0.5
     l = jnp.maximum(l, 1e-30)
-    o_ref[...] = (acc / l).reshape(o_ref.shape).astype(o_ref.dtype)
+    o = jnp.where(dead, 0.0, acc / l)
+    o_ref[...] = o.reshape(o_ref.shape).astype(o_ref.dtype)
     # lse is [1, block_q, 1]: trailing dims (block_q, 1) satisfy the TPU
     # (8, 128)-or-full tiling rule, unlike a bare (1, block_q) block.
-    lse_ref[...] = (m + jnp.log(l)).reshape(lse_ref.shape)
+    lse = jnp.where(dead, -NEG_INF, m + jnp.log(l))
+    lse_ref[...] = lse.reshape(lse_ref.shape)
 
 
 def _fwd(q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):

@@ -92,6 +92,9 @@ class MoeMlp(nn.Module):
     #: init scale for the down-projection — pass (2*n_layers)**-0.5 for
     #: GPT-2-style residual depth scaling (matches the dense path's "down")
     out_init_scale: float = 1.0
+    #: compute dtype for the expert matmuls (params stay f32; routing always
+    #: runs in f32). Matches the dense FFN path's dtype handling.
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x):
@@ -127,11 +130,13 @@ class MoeMlp(nn.Module):
 
         # dispatch: [g,s,E,C] x [g,s,d] -> [E, g, C, d] (GSPMD: all-to-all
         # from batch-sharded tokens to ep-sharded experts)
-        xd = jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), x)
+        dt = jnp.dtype(self.dtype)
+        x = x.astype(dt)
+        xd = jnp.einsum("gsec,gsd->egcd", dispatch.astype(dt), x)
         xd = nn.with_logical_constraint(xd, ("expert", "batch", None, "embed"))
-        h = jnp.einsum("egcd,edf->egcf", xd, jnp.asarray(w_in))
+        h = jnp.einsum("egcd,edf->egcf", xd, jnp.asarray(w_in, dt))
         h = nn.relu(h)
-        ye = jnp.einsum("egcf,efd->egcd", h, jnp.asarray(w_out))
+        ye = jnp.einsum("egcf,efd->egcd", h, jnp.asarray(w_out, dt))
         ye = nn.with_logical_constraint(ye, ("expert", "batch", None, "embed"))
-        y = jnp.einsum("egcd,gsec->gsd", ye, combine.astype(x.dtype))
+        y = jnp.einsum("egcd,gsec->gsd", ye, combine.astype(dt))
         return y, aux

@@ -29,6 +29,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.eds_size.restype = ctypes.c_int64
     lib.eds_export.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
     lib.eds_export.restype = ctypes.c_int64
+    lib.eds_export_snapshot.argtypes = [
+        ctypes.c_void_p, i64p, f32p, ctypes.c_int64, i64p,
+    ]
+    lib.eds_export_snapshot.restype = ctypes.c_int64
     lib.eds_import.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
 
 

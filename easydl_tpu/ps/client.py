@@ -19,6 +19,7 @@ its local batch shard.
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 from typing import Dict, List, Optional, Sequence
@@ -41,6 +42,9 @@ class _PsClientBase:
     """Routing + scatter/gather shared by both transports."""
 
     num_shards: int
+    # Guards lazy pool creation (class-level: trivially race-free; contended
+    # only during the one-time init).
+    _pool_lock = threading.Lock()
 
     # Subclasses implement the per-shard primitives.
     def _pull_shard(self, shard: int, table: str, ids: np.ndarray) -> np.ndarray:
@@ -56,14 +60,20 @@ class _PsClientBase:
     def _for_all(self, fn) -> list:
         # One persistent pool per client: _for_all runs twice per training
         # step (pull + push), so per-call pool setup/teardown would sit on
-        # the hot path.
+        # the hot path. The pipelined PsTrainer loop drives pull and push
+        # from different threads, so the lazy init must be locked — two
+        # racing creations would leak an un-shutdown executor.
         if self.num_shards == 1:
             return [fn(0)]
         pool = getattr(self, "_pool", None)
         if pool is None:
-            pool = self._pool = ThreadPoolExecutor(
-                max_workers=self.num_shards, thread_name_prefix="ps-client"
-            )
+            with _PsClientBase._pool_lock:
+                pool = getattr(self, "_pool", None)
+                if pool is None:
+                    pool = self._pool = ThreadPoolExecutor(
+                        max_workers=self.num_shards,
+                        thread_name_prefix="ps-client",
+                    )
         return list(pool.map(fn, range(self.num_shards)))
 
     # ------------------------------------------------------------------- api

@@ -26,6 +26,7 @@
 #include <cstdint>
 #include <cstring>
 #include <mutex>
+#include <shared_mutex>
 #include <unordered_map>
 #include <vector>
 
@@ -75,6 +76,7 @@ class EmbeddingStore {
 
   // out: [n, dim] row-major.
   void Pull(const int64_t* ids, int64_t n, float* out) {
+    std::shared_lock<std::shared_mutex> snap(SharedBarrier());
     for (int64_t i = 0; i < n; ++i) {
       Stripe& s = stripes_[stripe_of(ids[i])];
       std::lock_guard<std::mutex> lock(s.mu);
@@ -86,6 +88,7 @@ class EmbeddingStore {
   // grads: [n, dim] row-major; duplicate ids are accumulated before the
   // optimizer applies, and `scale` multiplies the accumulated gradient.
   void Push(const int64_t* ids, int64_t n, const float* grads, float scale) {
+    std::shared_lock<std::shared_mutex> snap(SharedBarrier());
     std::unordered_map<int64_t, size_t> first;
     first.reserve(static_cast<size_t>(n));
     std::vector<int64_t> uniq;
@@ -124,9 +127,18 @@ class EmbeddingStore {
   }
 
   // ids_out: [capacity]; rows_out: [capacity, row_width]. Returns rows
-  // written (<= capacity). Iteration order is unspecified but complete when
-  // capacity >= Size() and no concurrent writes happen.
+  // written (<= capacity). Takes the snapshot barrier exclusively, so the
+  // exported rows form a point-in-time snapshot even while workers keep
+  // pulling/pushing from other threads: no row in a single export straddles
+  // an optimizer step, and the export is complete whenever
+  // capacity >= Size() sampled under the same barrier (see SizeLocked use in
+  // eds_export_snapshot).
   int64_t Export(int64_t* ids_out, float* rows_out, int64_t capacity) {
+    ExclusiveBarrier snap(this);
+    return ExportLocked(ids_out, rows_out, capacity);
+  }
+
+  int64_t ExportLocked(int64_t* ids_out, float* rows_out, int64_t capacity) {
     int64_t w = 0;
     for (auto& s : stripes_) {
       std::lock_guard<std::mutex> lock(s.mu);
@@ -141,8 +153,25 @@ class EmbeddingStore {
     return w;
   }
 
+  // Consistent size+export in one critical section: writes at most
+  // `capacity` rows and stores the table's true size (sampled under the
+  // exclusive barrier) in *size_out, so the caller can detect truncation
+  // and retry with a larger buffer.
+  int64_t ExportSnapshot(int64_t* ids_out, float* rows_out, int64_t capacity,
+                         int64_t* size_out) {
+    ExclusiveBarrier snap(this);
+    int64_t total = 0;
+    for (auto& s : stripes_) {
+      std::lock_guard<std::mutex> lock(s.mu);
+      total += static_cast<int64_t>(s.index.size());
+    }
+    if (size_out != nullptr) *size_out = total;
+    return ExportLocked(ids_out, rows_out, capacity);
+  }
+
   // rows: [n, row_width]; inserts or overwrites.
   void Import(const int64_t* ids, const float* rows, int64_t n) {
+    std::shared_lock<std::shared_mutex> snap(SharedBarrier());
     for (int64_t i = 0; i < n; ++i) {
       Stripe& s = stripes_[stripe_of(ids[i])];
       std::lock_guard<std::mutex> lock(s.mu);
@@ -209,7 +238,36 @@ class EmbeddingStore {
   const int optimizer_;
   const float lr_;
   const float eps_;
+  // Snapshot barrier: mutators hold it shared, Export holds it exclusive so
+  // a checkpoint save mid-training sees a consistent point-in-time table.
+  // glibc's pthread rwlock is reader-preferring, so a bare unique_lock could
+  // starve forever under continuous pull/push traffic — the export_gate_
+  // mutex (held by the exporter, touched by every new reader) makes new
+  // readers BLOCK behind a pending exporter (writer preference) without
+  // busy-waiting.
+  std::shared_mutex& SharedBarrier() {
+    { std::lock_guard<std::mutex> gate(export_gate_); }
+    return snapshot_mu_;
+  }
+
+  class ExclusiveBarrier {
+   public:
+    explicit ExclusiveBarrier(EmbeddingStore* s) : s_(s) {
+      s_->export_gate_.lock();   // new readers block here
+      s_->snapshot_mu_.lock();   // existing readers drain
+    }
+    ~ExclusiveBarrier() {
+      s_->snapshot_mu_.unlock();
+      s_->export_gate_.unlock();
+    }
+
+   private:
+    EmbeddingStore* s_;
+  };
+
   const int row_width_;
+  std::shared_mutex snapshot_mu_;
+  std::mutex export_gate_;
   Stripe stripes_[kNumStripes];
 };
 
@@ -242,6 +300,12 @@ int64_t eds_size(void* h) { return static_cast<EmbeddingStore*>(h)->Size(); }
 int64_t eds_export(void* h, int64_t* ids_out, float* rows_out,
                    int64_t capacity) {
   return static_cast<EmbeddingStore*>(h)->Export(ids_out, rows_out, capacity);
+}
+
+int64_t eds_export_snapshot(void* h, int64_t* ids_out, float* rows_out,
+                            int64_t capacity, int64_t* size_out) {
+  return static_cast<EmbeddingStore*>(h)->ExportSnapshot(ids_out, rows_out,
+                                                         capacity, size_out);
 }
 
 void eds_import(void* h, const int64_t* ids, const float* rows, int64_t n) {

@@ -200,10 +200,19 @@ class PsShard:
         return pb.PullResponse(values=values.tobytes(), dim=t.dim)
 
     def Push(self, req: pb.PushRequest, ctx) -> pb.Ack:
+        # scale is a proto3 double: an unset field is indistinguishable from
+        # an explicit 0.0, and 0.0 would silently no-op every update. It is
+        # never a meaningful value, so reject it instead of applying it.
+        if req.scale == 0.0:
+            return pb.Ack(
+                ok=False,
+                message="PushRequest.scale must be set and non-zero "
+                        "(0.0 would silently discard the update)",
+            )
         t = self.table(req.table)
         ids = np.asarray(req.ids, np.int64)
         grads = np.frombuffer(req.grads, np.float32).reshape(len(ids), t.dim)
-        t.push(ids, grads, scale=req.scale)  # scale is required on the wire
+        t.push(ids, grads, scale=req.scale)
         return pb.Ack(ok=True)
 
     def Save(self, req: pb.PsSaveRequest, ctx) -> pb.Ack:

@@ -176,11 +176,22 @@ class _NativeStore:
         return self._lib.eds_size(self._h)
 
     def export_rows(self) -> Tuple[np.ndarray, np.ndarray]:
-        n = self.size()
-        ids = np.zeros(n, np.int64)
-        rows = np.zeros((n, self.spec.row_width), np.float32)
-        written = self._lib.eds_export(self._h, self._i64p(ids), self._f32p(rows), n)
-        return ids[:written], rows[:written]
+        # eds_export_snapshot sizes and exports under one exclusive barrier,
+        # so the result is a consistent point-in-time snapshot even while
+        # workers keep pushing; retry only when rows materialised between our
+        # capacity estimate and the barrier acquisition (rare).
+        n = max(self.size(), 1)
+        while True:
+            ids = np.zeros(n, np.int64)
+            rows = np.zeros((n, self.spec.row_width), np.float32)
+            true_size = np.zeros(1, np.int64)
+            written = self._lib.eds_export_snapshot(
+                self._h, self._i64p(ids), self._f32p(rows), n,
+                self._i64p(true_size),
+            )
+            if true_size[0] <= n:
+                return ids[:written], rows[:written]
+            n = int(true_size[0])
 
     def import_rows(self, ids: np.ndarray, rows: np.ndarray) -> None:
         ids = np.ascontiguousarray(ids, np.int64)

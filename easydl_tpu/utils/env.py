@@ -1,0 +1,27 @@
+"""Process-environment recipes shared across subprocess launchers."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Mapping, Optional
+
+
+def cpu_subprocess_env(
+    n_devices: int, base: Optional[Mapping[str, str]] = None
+) -> Dict[str, str]:
+    """Environment for a subprocess that must initialise JAX on a forced
+    ``n_devices``-device CPU platform.
+
+    Neutralises the image's TPU tunnel plugin (PALLAS_AXON_POOL_IPS) so the
+    child cannot re-attach to the chip — the single authoritative copy of the
+    recipe used by the elastic agent's worker spawns and the driver's
+    ``dryrun_multichip`` bootstrap.
+    """
+    env = dict(base if base is not None else os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    )
+    return env

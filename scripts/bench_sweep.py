@@ -1,0 +1,73 @@
+"""Perf exploration sweep for the flagship bench config (run on real TPU).
+
+Times several (remat, batch, dtype, attention) variants in one process and
+prints a line per config — the evidence base for bench.py's chosen settings.
+Usage: python scripts/bench_sweep.py [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=15)
+    ap.add_argument("--seq", type=int, default=1024)
+    args = ap.parse_args()
+
+    import jax
+    import optax
+
+    from easydl_tpu.core.mesh import MeshSpec
+    from easydl_tpu.core.train_loop import TrainConfig, Trainer
+    from easydl_tpu.models.registry import get_model
+
+    n_chips = jax.device_count()
+    configs = [
+        # (label, model kwargs, global_batch)
+        ("f32 remat-dots b8", dict(remat=True, remat_policy="dots"), 8),
+        ("bf16 remat-dots b8", dict(remat=True, remat_policy="dots",
+                                    dtype="bfloat16"), 8),
+        ("bf16 no-remat b8", dict(dtype="bfloat16"), 8),
+        ("bf16 no-remat b16", dict(dtype="bfloat16"), 16),
+        ("bf16 remat-dots b16", dict(remat=True, remat_policy="dots",
+                                     dtype="bfloat16"), 16),
+        ("bf16 remat-dots b32", dict(remat=True, remat_policy="dots",
+                                     dtype="bfloat16"), 32),
+        ("bf16 no-remat b8 ref-attn", dict(dtype="bfloat16",
+                                           attention_impl="reference"), 8),
+    ]
+    for label, kwargs, per_chip_batch in configs:
+        global_batch = per_chip_batch * n_chips
+        try:
+            bundle = get_model("gpt", size="345m", seq_len=args.seq, **kwargs)
+            trainer = Trainer(
+                init_fn=bundle.init_fn,
+                loss_fn=bundle.loss_fn,
+                optimizer=optax.adamw(2e-4, weight_decay=0.01),
+                config=TrainConfig(global_batch=global_batch),
+                mesh_spec=MeshSpec(dp=n_chips),
+            )
+            state = trainer.init_state()
+            data = iter(bundle.make_data(global_batch))
+            for _ in range(2):
+                state, metrics = trainer.train_step(state, next(data))
+            float(jax.device_get(metrics["loss"]))
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, metrics = trainer.train_step(state, next(data))
+            float(jax.device_get(metrics["loss"]))
+            dt = time.perf_counter() - t0
+            sps = args.steps * global_batch / dt / n_chips
+            print(f"RESULT {label:28s} {sps:8.2f} samples/s/chip  "
+                  f"step {dt / args.steps * 1000:7.1f} ms", flush=True)
+            del state, trainer
+        except Exception as e:  # OOM etc: report and keep sweeping
+            print(f"RESULT {label:28s} FAILED: {type(e).__name__}: {e}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

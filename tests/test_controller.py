@@ -69,7 +69,14 @@ def test_native_python_parity_randomized():
         desired_lines = [f"J|{job}"]
         for role in ("worker", "parameter_server"):
             if rng.random() < 0.9:
-                desired_lines.append(f"R|{role}|{rng.randint(0,5)}|sig0")
+                # Mostly valid counts, sometimes malformed (empty, signed,
+                # spaced, junk) — both implementations must skip malformed
+                # R-lines identically instead of atoi-vs-int() diverging.
+                replicas = rng.choice(
+                    [str(rng.randint(0, 5))] * 4
+                    + ["", "-1", "+2", " 3", "2x", "x2", "99999999999"]
+                )
+                desired_lines.append(f"R|{role}|{replicas}|sig0")
         for name in list(names)[:2]:
             if rng.random() < 0.4:
                 desired_lines.append(f"U|{name}|sig9")
@@ -147,6 +154,63 @@ def test_failed_pod_recovered_with_fresh_name():
     ctl.reconcile_job("deepctr")
     workers = sorted(p.name for p in api.list_pods("deepctr") if p.role == "worker")
     assert workers == ["deepctr-worker-1", "deepctr-worker-2"]
+
+
+def test_malformed_replicas_freezes_role_instead_of_scaling_to_zero():
+    """A corrupt replicas field must leave the role untouched — neither
+    atoi's silent 0 nor the absent-role fallback may delete healthy pods."""
+    observed = (
+        "P|j-worker-0|worker|Running|sig0|\n"
+        "P|j-worker-1|worker|Running|sig0|\n"
+    )
+    for desired in ("J|j\nR|worker|2x|sig0\n", "J|j\nR|worker||sig0\n",
+                    "J|j\nR|worker| 2|sig0\n", "J|j\nR|worker|-1|sig0\n",
+                    # all-digits but >7 digits: would overflow atoi (UB) /
+                    # explode the Python levelling loop — frozen too
+                    "J|j\nR|worker|4294967294|sig0\n"):
+        native = reconcile_wire(desired, observed)
+        python = _py_reconcile(desired, observed)
+        assert native == python == "", (desired, native, python)
+
+
+def test_crash_loop_backs_off_but_first_failure_recovers_instantly():
+    """A single failure must be replaced in the same pass (recovery time is
+    a headline metric); repeated failures must NOT hot-respawn every pass —
+    the operator defers creates exponentially until a quiet window passes."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(
+        store, api,
+        restart_backoff_base=30.0,   # big, so the deferral is observable
+        restart_backoff_reset=0.2,   # small, so the test can see forgiveness
+    )
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(workers=1))
+    ctl.reconcile_job("deepctr")
+    api.tick()
+
+    # failure 1: replaced immediately, same pass
+    api.fail("deepctr-worker-0")
+    ctl.reconcile_job("deepctr")
+    names = [p.name for p in api.list_pods("deepctr") if p.role == "worker"]
+    assert names == ["deepctr-worker-1"]
+    api.tick()
+
+    # failure 2 (within the reset window): create deferred
+    api.fail("deepctr-worker-1")
+    ctl.reconcile_job("deepctr")
+    assert [p for p in api.list_pods("deepctr") if p.role == "worker"] == []
+    # ... and keeps deferring on hot re-reconciles
+    ctl.reconcile_job("deepctr")
+    assert [p for p in api.list_pods("deepctr") if p.role == "worker"] == []
+
+    # after a quiet window the role is forgiven: next failure is "first"
+    import time as _time
+
+    _time.sleep(0.25)
+    ctl._note_failure("deepctr", "worker")  # counts as fresh failure (count 1)
+    ctl.reconcile_job("deepctr")
+    workers = [p for p in api.list_pods("deepctr") if p.role == "worker"]
+    assert len(workers) == 1  # recovered instantly again after quiet window
 
 
 def test_replace_then_retire():

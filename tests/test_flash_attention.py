@@ -97,6 +97,55 @@ def test_causal_cross_length_bottom_right_aligned():
         )
 
 
+def test_causal_cross_length_sq_gt_sk_dead_rows():
+    """s_q > s_k bottom-right-aligned causal: the first s_q - s_k query rows
+    attend nothing. Both paths must define such rows as zero output with
+    zero gradient (not softmax's uniform mean of V) — and agree on the live
+    rows. Exercises dead rows both inside a mixed q-block (block 16 > 8
+    dead rows? no: 32 dead rows span blocks) and whole-dead q-blocks."""
+    key = jax.random.PRNGKey(6)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, h, d, s_q, s_k = 2, 2, 16, 64, 32
+    q = jax.random.normal(kq, (b, s_q, h, d))
+    k = jax.random.normal(kk, (b, s_k, h, d))
+    v = jax.random.normal(kv, (b, s_k, h, d))
+    scale = d**-0.5
+    n_dead = s_q - s_k
+    ref = _reference_attention(q, k, v, causal=True, scale=scale)
+    # block 16 divides both: dead rows cover 2 whole q-blocks; also run with
+    # block 32 so one q-block mixes dead and live rows.
+    for bq in (16, 32):
+        out = flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=16, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, :n_dead]), 0.0, err_msg=f"bq={bq} dead rows"
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+            err_msg=f"bq={bq}",
+        )
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=32, block_k=16,
+                            interpret=True)
+        return (o * jnp.cos(o)).sum()
+
+    def loss_ref(q, k, v):
+        o = _reference_attention(q, k, v, causal=True, scale=scale)
+        return (o * jnp.cos(o)).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_flash[0][:, :n_dead]), 0.0,
+                               err_msg="dead rows must not leak dq")
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
 def test_untileable_length_falls_back_to_reference():
     """Lengths with no usable block divisor (e.g. 72 with block 48 → none
     ≥128-aligned) must not assert — the wrapper falls back to the XLA path."""

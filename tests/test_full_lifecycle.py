@@ -23,13 +23,36 @@ from easydl_tpu.controller.__main__ import ingest
 from easydl_tpu.controller.process_pod_api import LocalProcessPodApi
 
 
+def dump_pod_logs(workdir: str, n: int = 40) -> str:
+    """Tails of EVERY pod log ever written (incl. pods already deleted) —
+    evaluated only at failure time, so the dump reflects the actual end
+    state rather than a snapshot taken before the wait began."""
+    log_dir = os.path.join(workdir, "pod-logs")
+    out = []
+    try:
+        names = sorted(os.listdir(log_dir))
+    except OSError:
+        return "(no pod-logs dir)"
+    for fname in names:
+        try:
+            with open(os.path.join(log_dir, fname)) as f:
+                tail = "".join(f.readlines()[-n:])
+        except OSError as e:
+            tail = f"(unreadable: {e})"
+        out.append(f"===== {fname} =====\n{tail}")
+    return "\n".join(out) or "(no pod logs)"
+
+
 def wait_for(cond, timeout, desc):
+    """desc may be a string or a zero-arg callable evaluated on timeout."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if cond():
             return
         time.sleep(0.3)
-    raise TimeoutError(f"timed out waiting for {desc}")
+    raise TimeoutError(
+        f"timed out waiting for {desc() if callable(desc) else desc}"
+    )
 
 
 def test_full_reference_lifecycle(tmp_path):
@@ -93,7 +116,8 @@ def test_full_reference_lifecycle(tmp_path):
         wait_for(
             lambda: len([p for p in api.list_pods(job_name)
                          if p.role == "worker"]) == 2,
-            120, f"2 worker pods (trainer log: {api.tail_log(job_name + '-trainer-0')})",
+            120,
+            lambda: f"2 worker pods; all pod logs:\n{dump_pod_logs(workdir)}",
         )
         assert os.path.exists(os.path.join(plan_dir, f"{job_name}-plan.yaml"))
 
@@ -105,9 +129,11 @@ def test_full_reference_lifecycle(tmp_path):
         wait_for(
             lambda: all_succeeded(),
             240,
-            "all pods Succeeded "
-            f"(phases: {[(p.name, p.phase) for p in api.list_pods(job_name)]}; "
-            f"trainer log: {api.tail_log(job_name + '-trainer-0')})",
+            lambda: (
+                "all pods Succeeded (phases: "
+                f"{[(p.name, p.phase) for p in api.list_pods(job_name)]}; "
+                f"all pod logs:\n{dump_pod_logs(workdir)})"
+            ),
         )
 
         # the run left real artifacts: checkpoints + the master's address file
