@@ -82,12 +82,24 @@ def main() -> None:
     ap = argparse.ArgumentParser(description="easydl_tpu elastic operator")
     ap.add_argument("--watch-dir", required=True,
                     help="directory of ElasticJob/JobResource YAMLs")
-    ap.add_argument("--pod-api", choices=["memory"], default="memory")
+    ap.add_argument("--pod-api", choices=["memory", "k8s"], default="memory",
+                    help="'k8s' reconciles real cluster pods over the k8s "
+                         "REST API (in-cluster auth, or --kube-url)")
+    ap.add_argument("--kube-url", default="",
+                    help="k8s API server base URL (empty = in-cluster "
+                         "service-account config)")
+    ap.add_argument("--namespace", default="",
+                    help="pod namespace (default: SA namespace or 'default')")
     ap.add_argument("--resync-s", type=float, default=2.0)
     args = ap.parse_args()
 
     store = CrStore()
-    pod_api = InMemoryPodApi()
+    if args.pod_api == "k8s":
+        from easydl_tpu.controller.kube_pod_api import KubePodApi
+
+        pod_api = KubePodApi(base_url=args.kube_url, namespace=args.namespace)
+    else:
+        pod_api = InMemoryPodApi()
     ctl = ElasticJobController(store, pod_api)
     ctl.start(resync_s=args.resync_s)
     log.info("operator watching %s (pod api: %s)", args.watch_dir, args.pod_api)
@@ -96,7 +108,8 @@ def main() -> None:
     try:
         while True:
             ingest(store, args.watch_dir, seen, pending)
-            pod_api.tick()
+            if args.pod_api == "memory":
+                pod_api.tick()  # the fake cluster needs a clock
             time.sleep(min(args.resync_s, 1.0))
     except KeyboardInterrupt:
         pass

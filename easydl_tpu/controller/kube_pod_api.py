@@ -1,0 +1,254 @@
+"""Kubernetes pod backend for the elastic operator.
+
+The reference IS a k8s operator ("a k8s controller to manage training Pods",
+/root/reference/README.md:12; CRDs watched via the API server,
+docs/design/elastic-training-operator.md:16-18,53-55). This backend
+implements :class:`~easydl_tpu.controller.pod_api.PodApi` against the k8s
+REST API so the same reconcile core that drives the in-memory fake and the
+local-process backend drives a real cluster.
+
+Implementation notes:
+- stdlib HTTP only (urllib): the image has no ``kubernetes`` client package,
+  and the pod API surface we need (POST/GET/DELETE on
+  ``/api/v1/namespaces/{ns}/pods``) is small enough that a generated client
+  buys nothing. In-cluster auth (service-account token + CA) is picked up
+  from the conventional mount path; tests point ``base_url`` at a local
+  fake API server over plain HTTP (tests/test_kube_pod_api.py).
+- pods carry labels ``easydl.org/job|role|replaces`` so ``list_pods`` is one
+  labelSelector GET and the reconcile core's replace-then-retire metadata
+  round-trips through the cluster.
+- ``TpuSpec`` maps to GKE TPU pod-slice scheduling: the
+  ``google.com/tpu`` resource limit plus the
+  ``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology`` node
+  selectors (the GKE-documented contract for TPU slices).
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from easydl_tpu.api.job_spec import ResourceSpec, TpuSpec
+from easydl_tpu.controller.pod_api import Pod, PodApi
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("controller", "kubepods")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+#: accelerator family -> GKE gke-tpu-accelerator node-selector value
+GKE_TPU_ACCELERATOR = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+LABEL_JOB = "easydl.org/job"
+LABEL_ROLE = "easydl.org/role"
+LABEL_REPLACES = "easydl.org/replaces"
+ANNOTATION_RESOURCE = "easydl.org/resource"
+
+
+def pod_to_manifest(pod: Pod, namespace: str) -> Dict[str, Any]:
+    """Our Pod record -> a k8s V1Pod manifest."""
+    requests: Dict[str, str] = {}
+    limits: Dict[str, str] = {}
+    if pod.resource.cpu:
+        requests["cpu"] = str(pod.resource.cpu)
+    if pod.resource.memory:
+        requests["memory"] = f"{pod.resource.memory}Mi"
+    if pod.resource.disk:
+        requests["ephemeral-storage"] = f"{pod.resource.disk}Mi"
+    if pod.resource.gpu:
+        limits["nvidia.com/gpu"] = str(pod.resource.gpu)
+    node_selector: Dict[str, str] = {}
+    tpu = pod.resource.tpu
+    if tpu is not None and tpu.chips:
+        # GKE TPU pod slice: chips-per-pod via the google.com/tpu limit;
+        # slice family/topology via node selectors.
+        limits["google.com/tpu"] = str(tpu.chips)
+        node_selector["cloud.google.com/gke-tpu-accelerator"] = (
+            GKE_TPU_ACCELERATOR.get(tpu.type, tpu.type)
+        )
+        if tpu.topology:
+            node_selector["cloud.google.com/gke-tpu-topology"] = tpu.topology
+    container: Dict[str, Any] = {
+        "name": pod.role.replace("_", "-"),
+        "image": pod.image or "python:3.11-slim",
+    }
+    if pod.command:
+        container["command"] = ["/bin/sh", "-c", pod.command]
+    if requests or limits:
+        container["resources"] = {}
+        if requests:
+            container["resources"]["requests"] = requests
+        if limits:
+            container["resources"]["limits"] = limits
+    manifest: Dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod.name,
+            "namespace": namespace,
+            "labels": {
+                LABEL_JOB: pod.job,
+                LABEL_ROLE: pod.role,
+                **({LABEL_REPLACES: pod.replaces} if pod.replaces else {}),
+            },
+            # Full resource doc as an annotation so list_pods can rebuild
+            # the exact ResourceSpec (and its signature) without lossy
+            # quantity parsing.
+            "annotations": {
+                ANNOTATION_RESOURCE: json.dumps(pod.resource.to_dict()),
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",  # the operator owns restarts
+            **({"nodeSelector": node_selector} if node_selector else {}),
+            "containers": [container],
+        },
+    }
+    return manifest
+
+
+def manifest_to_pod(doc: Dict[str, Any]) -> Pod:
+    meta = doc.get("metadata", {})
+    labels = meta.get("labels", {}) or {}
+    annotations = meta.get("annotations", {}) or {}
+    try:
+        resource = ResourceSpec.from_dict(
+            json.loads(annotations.get(ANNOTATION_RESOURCE, "{}"))
+        )
+    except (ValueError, TypeError):
+        resource = ResourceSpec()
+    status = doc.get("status", {}) or {}
+    phase = status.get("phase", "Pending")
+    # k8s keeps phase Running during graceful deletion; our reconcile core
+    # models that window as Terminating (replace-then-retire relies on it).
+    if meta.get("deletionTimestamp") and phase in ("Pending", "Running"):
+        phase = "Terminating"
+    spec = doc.get("spec", {}) or {}
+    containers = spec.get("containers") or [{}]
+    command = containers[0].get("command") or []
+    return Pod(
+        name=meta.get("name", ""),
+        job=labels.get(LABEL_JOB, ""),
+        role=labels.get(LABEL_ROLE, ""),
+        resource=resource,
+        phase=phase,
+        replaces=labels.get(LABEL_REPLACES, ""),
+        command=command[-1] if command else "",
+        image=containers[0].get("image", ""),
+    )
+
+
+class KubePodApi(PodApi):
+    """PodApi over the k8s REST API (stdlib HTTP; in-cluster or explicit)."""
+
+    def __init__(
+        self,
+        base_url: str = "",
+        namespace: str = "",
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        timeout: float = 10.0,
+    ):
+        if not base_url:
+            # In-cluster defaults (the conventional env + SA mount).
+            import os
+
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise ValueError(
+                    "base_url not given and KUBERNETES_SERVICE_HOST unset "
+                    "(not running in a cluster?)"
+                )
+            base_url = f"https://{host}:{port}"
+            if token is None:
+                try:
+                    with open(f"{SA_DIR}/token") as f:
+                        token = f.read().strip()
+                except OSError:
+                    token = None
+            if ca_file is None:
+                ca_file = f"{SA_DIR}/ca.crt"
+            if not namespace:
+                try:
+                    with open(f"{SA_DIR}/namespace") as f:
+                        namespace = f.read().strip()
+                except OSError:
+                    pass
+        self.base_url = base_url.rstrip("/")
+        self.namespace = namespace or "default"
+        self._token = token
+        self._timeout = timeout
+        self._ctx: Optional[ssl.SSLContext] = None
+        if self.base_url.startswith("https"):
+            self._ctx = ssl.create_default_context(
+                cafile=ca_file if ca_file else None
+            )
+
+    # ------------------------------------------------------------------ http
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self._timeout, context=self._ctx
+            ) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            raise KubeApiError(e.code, f"{method} {path}: {detail}") from e
+        return json.loads(payload) if payload else {}
+
+    # ---------------------------------------------------------------- PodApi
+    def create_pod(self, pod: Pod) -> None:
+        path = f"/api/v1/namespaces/{self.namespace}/pods"
+        try:
+            self._request("POST", path, pod_to_manifest(pod, self.namespace))
+        except KubeApiError as e:
+            if e.code == 409:  # AlreadyExists — reconcile is level-triggered
+                log.warning("pod %s already exists", pod.name)
+                return
+            raise
+        log.info("created pod %s (%s)", pod.name, pod.role)
+
+    def delete_pod(self, name: str) -> None:
+        path = f"/api/v1/namespaces/{self.namespace}/pods/{name}"
+        try:
+            self._request("DELETE", path)
+        except KubeApiError as e:
+            if e.code == 404:  # idempotent, like k8s delete of a gone pod
+                return
+            raise
+        log.info("deleted pod %s", name)
+
+    def list_pods(self, job: Optional[str] = None) -> List[Pod]:
+        selector = f"{LABEL_JOB}={job}" if job else LABEL_JOB
+        path = (
+            f"/api/v1/namespaces/{self.namespace}/pods"
+            f"?labelSelector={urllib.parse.quote(selector)}"
+        )
+        doc = self._request("GET", path)
+        pods = [manifest_to_pod(item) for item in doc.get("items", [])]
+        return sorted(pods, key=lambda p: p.name)
+
+
+class KubeApiError(RuntimeError):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"k8s API {code}: {message}")
+        self.code = code

@@ -40,6 +40,7 @@ class Agent:
         worker_argv: Optional[List[str]] = None,
         master_file: Optional[str] = None,
         master_refresh_s: float = 5.0,
+        warm_start: bool = False,
     ):
         self.agent_id = agent_id
         self.master_address = master_address
@@ -55,6 +56,14 @@ class Agent:
         # retry the dead address forever).
         self.master_file = master_file
         self.master_refresh_s = master_refresh_s
+        # Warm standby: keep one spare worker process with jax pre-imported;
+        # a RUN directive promotes it instantly instead of paying the full
+        # interpreter+jax start on the recovery path (RECOVERY.json shows
+        # cold start dominating generation-switch time). Costs one idle
+        # process worth of memory per agent — opt in.
+        self.warm_start = warm_start
+        self._warm: Optional[tuple] = None  # (proc, warm_file, log_file)
+        self._warm_count = 0
         self.worker_argv = worker_argv or [
             sys.executable, "-m", "easydl_tpu.elastic.worker"
         ]
@@ -182,6 +191,7 @@ class Agent:
                         fail_since = None
                 time.sleep(self.heartbeat_interval)
         self._terminate_worker(graceful=False)
+        self._kill_warm()
         if self._log_file is not None:
             self._log_file.close()
             self._log_file = None
@@ -252,35 +262,92 @@ class Agent:
             self._terminate_worker(graceful=True)
             self._state = "shutdown"
 
-    def _spawn(self, m: pb.Membership) -> None:
-        rank = list(m.hosts).index(self.agent_id)
+    def _worker_env(self) -> dict:
         env = os.environ.copy()
-        env.update(
-            {
-                "EASYDL_RANK": str(rank),
-                "EASYDL_WORLD": str(m.world_size),
-                "EASYDL_COORD": m.coordinator,
-                "EASYDL_GEN": str(m.generation),
-                "EASYDL_WORKDIR": self.workdir,
-                "EASYDL_METRICS": self.metrics_path,
-            }
-        )
         if self.platform == "cpu":
             from easydl_tpu.utils.env import cpu_subprocess_env
 
             env = cpu_subprocess_env(self.slots, base=env)
-        log_path = os.path.join(self.workdir, f"worker-{self.agent_id}.log")
-        if self._log_file is not None:
-            self._log_file.close()
-        self._log_file = open(log_path, "ab")
-        self._proc = subprocess.Popen(
-            self.worker_argv, env=env, stdout=self._log_file, stderr=self._log_file
+            # Many worker processes share this host's cores; per-process BLAS/
+            # OpenMP pools multiply the oversubscription (XLA:CPU has its own
+            # pool). Cap them unless the caller chose otherwise.
+            env.setdefault("OMP_NUM_THREADS", "1")
+            env.setdefault("OPENBLAS_NUM_THREADS", "1")
+        return env
+
+    def _spawn_warm(self) -> None:
+        """Start the next standby: jax imports now, membership comes later."""
+        self._warm_count += 1
+        warm_file = os.path.join(
+            self.workdir, f".warm-{self.agent_id}-{self._warm_count}.json"
         )
+        for path in (warm_file, warm_file + ".ready"):
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+        env = self._worker_env()
+        env["EASYDL_WARM_FILE"] = warm_file
+        log_file = open(
+            os.path.join(self.workdir, f"worker-{self.agent_id}.log"), "ab"
+        )
+        proc = subprocess.Popen(
+            self.worker_argv, env=env, stdout=log_file, stderr=log_file
+        )
+        self._warm = (proc, warm_file, log_file)
+        log.info("%s: warm standby spawned (pid %d)", self.agent_id, proc.pid)
+
+    def _kill_warm(self) -> None:
+        if self._warm is not None:
+            proc, _, log_file = self._warm
+            self._warm = None
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            log_file.close()
+
+    def _spawn(self, m: pb.Membership) -> None:
+        rank = list(m.hosts).index(self.agent_id)
+        payload = {
+            "EASYDL_RANK": str(rank),
+            "EASYDL_WORLD": str(m.world_size),
+            "EASYDL_COORD": m.coordinator,
+            "EASYDL_GEN": str(m.generation),
+            "EASYDL_WORKDIR": self.workdir,
+            "EASYDL_METRICS": self.metrics_path,
+        }
+        if self.warm_start and self._warm and self._warm[0].poll() is None:
+            proc, warm_file, log_file = self._warm
+            self._warm = None
+            tmp = warm_file + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, warm_file)
+            if self._log_file is not None:
+                self._log_file.close()
+            self._log_file = log_file
+            self._proc = proc
+            promoted = "promoted warm standby"
+        else:
+            env = self._worker_env()
+            env.update(payload)
+            log_path = os.path.join(self.workdir, f"worker-{self.agent_id}.log")
+            if self._log_file is not None:
+                self._log_file.close()
+            self._log_file = open(log_path, "ab")
+            self._proc = subprocess.Popen(
+                self.worker_argv, env=env,
+                stdout=self._log_file, stderr=self._log_file,
+            )
+            promoted = "spawned worker"
+        if self.warm_start:
+            self._spawn_warm()  # pre-warm the NEXT generation's worker
         self._applied_key = (m.generation, m.coordinator)
         self._state = "running"
         log.info(
-            "%s: spawned worker rank %d/%d gen %d (pid %d)",
-            self.agent_id, rank, m.world_size, m.generation, self._proc.pid,
+            "%s: %s rank %d/%d gen %d (pid %d)",
+            self.agent_id, promoted, rank, m.world_size, m.generation,
+            self._proc.pid,
         )
 
     def _terminate_worker(self, graceful: bool) -> None:
@@ -322,6 +389,9 @@ def main() -> None:  # pragma: no cover - CLI entry
     p.add_argument("--workdir", required=True)
     p.add_argument("--slots", type=int, default=1)
     p.add_argument("--platform", default="cpu")
+    p.add_argument("--warm-start", action="store_true",
+                   help="keep a jax-preimported standby worker per agent "
+                        "(faster recovery/reshape at one idle process cost)")
     p.add_argument(
         "--master-wait", type=float,
         default=float(os.environ.get("EASYDL_MASTER_WAIT_S", "600")),
@@ -364,6 +434,7 @@ def main() -> None:  # pragma: no cover - CLI entry
         slots=args.slots,
         platform=args.platform,
         master_file=args.master_file or None,
+        warm_start=args.warm_start,
     )
     signal.signal(signal.SIGTERM, lambda *_: agent.notify_preemption())
     agent.run()

@@ -182,8 +182,43 @@ def run_worker(env: Dict[str, str]) -> int:
     return 0
 
 
+def _warm_wait(warm_file: str) -> Dict[str, str]:
+    """Warm-standby mode: pre-import jax (the expensive part of worker
+    start), then block until the agent writes this generation's membership
+    into ``warm_file``. Cuts the generation-switch/recovery time by the full
+    import cost (the dominant term — see RECOVERY.json)."""
+    import jax  # noqa: F401  (the import IS the work)
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    # READY marker: lets the agent (and tests) see the standby is warm.
+    try:
+        with open(warm_file + ".ready", "w") as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        pass
+    while True:
+        if os.getppid() == 1:  # agent died; don't linger as an orphan
+            raise SystemExit(0)
+        try:
+            with open(warm_file) as f:
+                payload = json.load(f)
+            if payload:
+                return {k: str(v) for k, v in payload.items()}
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+
+
 def main() -> None:
-    sys.exit(run_worker(dict(os.environ)))
+    env = dict(os.environ)
+    warm_file = env.get("EASYDL_WARM_FILE")
+    if warm_file:
+        # Install the quiesce handler before the long import (same reason
+        # as run_worker's first line).
+        signal.signal(signal.SIGUSR1, _on_sigusr1)
+        env.update(_warm_wait(warm_file))
+    sys.exit(run_worker(env))
 
 
 if __name__ == "__main__":

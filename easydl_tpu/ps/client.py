@@ -29,8 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import io_callback
 
+import time
+
 from easydl_tpu.proto import easydl_pb2 as pb
-from easydl_tpu.ps.server import PS_SERVICE, PsShard, spec_to_proto
+from easydl_tpu.ps.server import DRAINING, PS_SERVICE, PsShard, spec_to_proto
 from easydl_tpu.ps.table import TableSpec, shard_of
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import RpcClient
@@ -157,11 +159,19 @@ class LocalPsClient(_PsClientBase):
 
 class ShardedPsClient(_PsClientBase):
     """gRPC PS cluster client. ``addresses[i]`` must be shard i of N —
-    routing is positional, the same order every worker must use."""
+    routing is positional, the same order every worker must use.
 
-    def __init__(self, addresses: Sequence[str], timeout: float = 60.0):
+    Vertical scaling: while a shard is migrating (replace-then-retire,
+    docs/design/elastic-training-operator.md:86-101) its pushes come back
+    with a retriable ``draining`` Ack; :meth:`_push_shard` retries — re-
+    reading the shard's client each attempt — until :meth:`reroute` points
+    it at the replacement, so no update is lost across the handoff."""
+
+    def __init__(self, addresses: Sequence[str], timeout: float = 60.0,
+                 drain_retry_s: float = 60.0):
         self.addresses = list(addresses)
         self.num_shards = len(self.addresses)
+        self.drain_retry_s = drain_retry_s
         self._clients = [
             RpcClient(PS_SERVICE, a, timeout=timeout) for a in self.addresses
         ]
@@ -182,13 +192,65 @@ class ShardedPsClient(_PsClientBase):
     def _push_shard(self, s, table, ids, grads, scale):
         if ids.size == 0:
             return
-        ack = self._clients[s].Push(
-            pb.PushRequest(
-                table=table, ids=ids.tolist(), grads=grads.tobytes(), scale=scale
-            )
+        req = pb.PushRequest(
+            table=table, ids=ids.tolist(), grads=grads.tobytes(), scale=scale
+        )
+        deadline = time.monotonic() + self.drain_retry_s
+        while True:
+            ack = self._clients[s].Push(req)  # re-read: reroute may swap it
+            if ack.ok:
+                return
+            if not ack.message.startswith(DRAINING):
+                raise RuntimeError(f"ps shard {s} push failed: {ack.message}")
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"ps shard {s} stayed draining past "
+                    f"{self.drain_retry_s}s; no reroute arrived"
+                )
+            time.sleep(0.05)
+
+    # ------------------------------------------------------------- migration
+    def reroute(self, shard: int, address: str) -> None:
+        """Point ``shard``'s traffic at a replacement server (handoff step
+        3). In-flight draining pushes pick up the new client on their next
+        retry."""
+        client = RpcClient(PS_SERVICE, address, timeout=60.0)
+        client.wait_ready(30.0)
+        old, self._clients[shard] = self._clients[shard], client
+        self.addresses[shard] = address
+        old.close()
+        log.info("ps shard %d rerouted to %s", shard, address)
+
+    def migrate_shard(self, shard: int, new_address: str, directory: str,
+                      step: int) -> None:
+        """The full vertical-scaling handoff for one live shard:
+
+        1. Drain the old pod (pushes gated + rows saved under ``directory``);
+        2. the replacement (already serving at ``new_address``) restores
+           that save;
+        3. reroute this client — retried pushes land on the replacement.
+
+        The operator created the replacement via ``resource_updation``
+        replace-then-retire; once this returns, the old pod is safe to
+        retire."""
+        ack = self._clients[shard].Drain(
+            pb.PsSaveRequest(directory=directory, step=step)
         )
         if not ack.ok:
-            raise RuntimeError(f"ps shard {s} push failed: {ack.message}")
+            raise RuntimeError(f"ps shard {shard} drain failed: {ack.message}")
+        repl = RpcClient(PS_SERVICE, new_address, timeout=60.0)
+        try:
+            repl.wait_ready(30.0)
+            rack = repl.Restore(
+                pb.PsRestoreRequest(directory=directory, step=step)
+            )
+            if not rack.ok:
+                raise RuntimeError(
+                    f"replacement restore failed: {rack.message}"
+                )
+        finally:
+            repl.close()
+        self.reroute(shard, new_address)
 
     def _create_shard(self, s, spec):
         ack = self._clients[s].CreateTable(spec_to_proto(spec))

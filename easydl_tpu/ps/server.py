@@ -42,8 +42,16 @@ PS_SERVICE = ServiceDef(
         "Save": (pb.PsSaveRequest, pb.Ack),
         "Restore": (pb.PsRestoreRequest, pb.Ack),
         "Stats": (pb.PsStatsRequest, pb.PsStatsResponse),
+        # Vertical-scaling handoff (resource_updation replace-then-retire on
+        # a PS pod): stop applying pushes, save this shard for its
+        # replacement. Reuses PsSaveRequest — drain IS a save plus a gate.
+        "Drain": (pb.PsSaveRequest, pb.Ack),
     },
 )
+
+#: Ack.message prefix that tells clients a push was NOT applied because the
+#: shard is migrating — retry (against the replacement once rerouted).
+DRAINING = "draining"
 
 
 def spec_to_proto(spec: TableSpec) -> pb.TableConfig:
@@ -86,6 +94,7 @@ class PsShard:
         self._tables: Dict[str, EmbeddingTable] = {}
         self._lock = threading.Lock()
         self._server = None
+        self._draining = False
 
     # ----------------------------------------------------------- table admin
     def create_table(self, spec: TableSpec) -> EmbeddingTable:
@@ -109,7 +118,12 @@ class PsShard:
         return t
 
     # ------------------------------------------------------------ checkpoint
-    def save(self, directory: str, step: int) -> None:
+    def save(self, directory: str, step: int,
+             marker_expected: int | None = None) -> None:
+        """``marker_expected`` overrides the completeness count written to
+        the done marker (default: the cluster's shard count). A migration
+        save (one shard alone in its own directory) passes 1 so the
+        replacement's restore sees it as complete."""
         d = os.path.join(directory, f"step_{step:010d}")
         os.makedirs(d, exist_ok=True)
         for name, t in list(self._tables.items()):
@@ -124,9 +138,23 @@ class PsShard:
         # done marker lets restorers skip torn saves; the content records the
         # shard count so completeness = all n markers present.
         with open(os.path.join(d, f".done-{self.shard_index}"), "w") as f:
-            f.write(str(self.num_shards))
+            f.write(str(marker_expected if marker_expected is not None
+                        else self.num_shards))
         log.info("ps shard %d saved %d tables at step %d", self.shard_index,
                  len(self._tables), step)
+
+    # ------------------------------------------------------------- migration
+    def drain(self, directory: str, step: int) -> None:
+        """Vertical-scaling handoff, old-pod side: gate pushes (clients get
+        a retriable ``draining`` Ack and re-apply on the replacement after
+        reroute — zero lost updates), then save this shard's rows alone
+        (marker_expected=1: the migration dir holds exactly one shard).
+        Pulls stay allowed: they're read-only up to the deterministic lazy
+        init, which the replacement reproduces bit-exactly for unseen ids
+        (reference semantics: docs/design/elastic-training-operator.md:86-101
+        targets PS pods specifically)."""
+        self._draining = True
+        self.save(directory, step, marker_expected=1)
 
     @staticmethod
     def saved_steps(directory: str):
@@ -200,6 +228,12 @@ class PsShard:
         return pb.PullResponse(values=values.tobytes(), dim=t.dim)
 
     def Push(self, req: pb.PushRequest, ctx) -> pb.Ack:
+        if self._draining:
+            return pb.Ack(
+                ok=False,
+                message=f"{DRAINING}: shard {self.shard_index} is migrating; "
+                        "retry after reroute",
+            )
         # scale is a proto3 double: an unset field is indistinguishable from
         # an explicit 0.0, and 0.0 would silently no-op every update. It is
         # never a meaningful value, so reject it instead of applying it.
@@ -228,6 +262,13 @@ class PsShard:
             step = self.restore(req.directory, req.step)
             return pb.Ack(ok=True, message=str(step))
         except (FileNotFoundError, ValueError) as e:
+            return pb.Ack(ok=False, message=str(e))
+
+    def Drain(self, req: pb.PsSaveRequest, ctx) -> pb.Ack:
+        try:
+            self.drain(req.directory, req.step)
+            return pb.Ack(ok=True)
+        except OSError as e:
             return pb.Ack(ok=False, message=str(e))
 
     def Stats(self, req: pb.PsStatsRequest, ctx) -> pb.PsStatsResponse:

@@ -1,0 +1,240 @@
+"""Measure the north-star elasticity metrics and write RECOVERY.json.
+
+BASELINE.json's north stars ("post-preemption recovery time", "8->32 chip
+scale-up with <5% throughput loss") exist in the reference only as promises
+(/root/reference/README.md:25-35); this script MEASURES them on the simulated
+distributed runtime (real master + agents + jax.distributed worker
+subprocesses on a CPU mesh — the same machinery that runs on TPU hosts, at
+2->4 proxy scale).
+
+Scenarios:
+1. preemption: SIGKILL one of two workers (no notice) mid-run; measure
+   kill -> first-post-restore-step wall time and steps of work lost.
+2. scale-up: apply a plan doubling the worker count mid-run; measure the
+   generation-switch stall (last step of gen N -> first step of gen N+1,
+   which includes quiesce, checkpoint, re-rendezvous, restore, recompile)
+   and the throughput loss over the transition window vs a static-world
+   extrapolation.
+
+Usage: python scripts/measure_recovery.py [--out RECOVERY.json]
+Must run where jax can use a CPU platform; spawns its own subprocess with
+the forced-CPU env (like dryrun_multichip) if the current backend is not.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def read_metrics(workdir: str, agent_id: str):
+    path = os.path.join(workdir, f"metrics-{agent_id}.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def wait_for(cond, timeout, desc):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+def preemption_scenario() -> dict:
+    from easydl_tpu.elastic.agent import Agent
+    from easydl_tpu.elastic.master import Master
+
+    wd = tempfile.mkdtemp(prefix="recovery-preempt-")
+    cfg = {
+        "model": "mlp",
+        "model_kwargs": {"input_shape": [8, 8, 1], "features": [32, 32]},
+        "global_batch": 32, "total_steps": 60, "ckpt_interval": 5,
+        "lr": 0.01, "seed": 0,
+    }
+    master = Master(job_name="recovery", workdir=wd, desired_workers=2,
+                    min_workers=1, heartbeat_timeout=1.5,
+                    worker_config=cfg).start()
+    a0 = Agent("a0", master.address, wd, slots=2).start()
+    a1 = Agent("a1", master.address, wd, slots=2).start()
+    try:
+        wait_for(
+            lambda: min(
+                master.status()["agents"].get("a0", {}).get("step", 0),
+                master.status()["agents"].get("a1", {}).get("step", 0),
+            ) >= 10,
+            180, "both workers past step 10",
+        )
+        gen_before = master.status()["generation"]
+        t_kill = time.time()
+        a1.kill_worker_hard()
+        a1.stop()
+        assert master.wait_done(timeout=300), master.status()
+        final_gen = master.status()["generation"]
+        m0 = read_metrics(wd, "a0")
+        pre = [r for r in m0 if r["generation"] <= gen_before and r["t"] < t_kill]
+        post = [r for r in m0 if r["generation"] == final_gen]
+        pre_last = max(r["step"] for r in pre)
+        first_post = min(post, key=lambda r: r["step"])
+        return {
+            "scenario": "preemption (SIGKILL worker, no notice)",
+            "world": "2 agents x 2 CPU devices",
+            "recovery_s": round(first_post["t"] - t_kill, 2),
+            "steps_lost": max(0, pre_last - (first_post["step"] - 1)),
+            "ckpt_interval": cfg["ckpt_interval"],
+            "detect_mechanism": "heartbeat timeout 1.5s + peer crash report",
+            "generations": final_gen,
+        }
+    finally:
+        a0.stop()
+        a1.stop()
+        master.stop()
+
+
+def scale_up_scenario(cache_dir: str) -> dict:
+    from easydl_tpu.api import ResourcePlan, RolePlan
+    from easydl_tpu.elastic.agent import Agent
+    from easydl_tpu.elastic.master import Master
+
+    # Shared persistent compilation cache across runs: the second run's
+    # generation switch should skip the XLA recompile entirely.
+    os.environ["EASYDL_COMPILE_CACHE"] = cache_dir
+    wd = tempfile.mkdtemp(prefix="recovery-scale-")
+    cfg = {
+        "model": "mlp",
+        "model_kwargs": {"input_shape": [8, 8, 1], "features": [32, 32]},
+        "global_batch": 64, "total_steps": 4000, "ckpt_interval": 100,
+        "sync_every": 5, "lr": 0.01, "seed": 0,
+    }
+    master = Master(job_name="scaleup", workdir=wd, desired_workers=2,
+                    min_workers=2, worker_config=cfg).start()
+    agents = [Agent(f"a{i}", master.address, wd, slots=1).start()
+              for i in range(4)]
+    try:
+        wait_for(
+            lambda: any(
+                a.get("step", 0) >= 40
+                for a in master.status()["agents"].values()
+            ),
+            240, "members past step 40 (warm steady state)",
+        )
+        gen1 = master.status()["generation"]
+        t_plan = time.time()
+        master.apply_plan(ResourcePlan(
+            job_name="scaleup", version=100,
+            roles={"worker": RolePlan(replicas=4)},
+        ))
+        def gen2_steps_recorded(n: int) -> bool:
+            recs = []
+            for i in range(4):
+                recs += read_metrics(wd, f"a{i}")
+            return len([r for r in recs if r["generation"] > gen1]) >= n
+
+        # Wait for actual post-reshape steps in the metrics (the rendezvous
+        # status carries step counts over from gen 1, so it can't tell us).
+        wait_for(lambda: gen2_steps_recorded(40), 300,
+                 "new generation writing step metrics")
+        merged = []
+        for i in range(4):
+            merged += read_metrics(wd, f"a{i}")
+        g1 = [r for r in merged if r["generation"] == gen1]
+        g2 = [r for r in merged if r["generation"] > gen1]
+        # Steady-state throughput before the plan: last 20 gen-1 steps,
+        # global samples/sec (records are per-rank; each rank's record
+        # reports the global samples/sec of its world).
+        g1_tail = sorted(g1, key=lambda r: r["step"])[-20:]
+        tput_before = sum(r["samples_per_sec"] for r in g1_tail) / len(g1_tail)
+        t_last_g1 = max(r["t"] for r in g1)
+        t_first_g2 = min(r["t"] for r in g2)
+        switch_s = t_first_g2 - t_last_g1
+        # Throughput-loss over the transition window [t_plan, t_plan + W]:
+        # achieved global samples vs a static-world extrapolation.
+        W = max(15.0, 2 * switch_s)
+        ranks_per_step = {}
+        for r in merged:
+            if t_plan <= r["t"] <= t_plan + W:
+                ranks_per_step.setdefault((r["generation"], r["step"]), 0)
+                ranks_per_step[(r["generation"], r["step"])] += 1
+        achieved_steps = len(ranks_per_step)
+        achieved_samples = achieved_steps * cfg["global_batch"]
+        static_samples = tput_before * W
+        loss_pct = (1.0 - achieved_samples / static_samples) * 100.0
+        g2_tail = sorted(g2, key=lambda r: r["step"])[-10:]
+        tput_after = (
+            sum(r["samples_per_sec"] for r in g2_tail) / len(g2_tail)
+            if g2_tail else 0.0
+        )
+        return {
+            "scenario": "scale-up 2->4 workers mid-run (proxy for 8->32 chips)",
+            "generation_switch_s": round(switch_s, 2),
+            "throughput_before_samples_per_s": round(tput_before, 1),
+            "throughput_after_samples_per_s": round(tput_after, 1),
+            "transition_window_s": round(W, 1),
+            "throughput_loss_pct_vs_static": round(loss_pct, 1),
+            "north_star": "<5% throughput loss vs static pod",
+            "compile_cache": "persistent jax_compilation_cache_dir enabled",
+        }
+    finally:
+        for a in agents:
+            a.stop()
+        master.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "RECOVERY.json"))
+    args = ap.parse_args()
+
+    if os.environ.get("EASYDL_RECOVERY_CHILD") != "1":
+        import jax
+
+        if jax.default_backend() != "cpu":
+            # Same self-bootstrap as dryrun_multichip: the elastic scenarios
+            # need a multi-device CPU platform, not the TPU tunnel.
+            import subprocess
+
+            from easydl_tpu.utils.env import cpu_subprocess_env
+
+            env = cpu_subprocess_env(8)
+            env["EASYDL_RECOVERY_CHILD"] = "1"
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--out", args.out],
+                env=env, cwd=REPO, timeout=1800,
+            )
+            raise SystemExit(proc.returncode)
+
+    cache_dir = tempfile.mkdtemp(prefix="recovery-jaxcache-")
+    scale_cold = scale_up_scenario(cache_dir)
+    scale_warm = scale_up_scenario(cache_dir)  # compile cache now populated
+    result = {
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "platform": "simulated-distributed CPU mesh (jax.distributed worker "
+                    "subprocesses; same code path as TPU hosts)",
+        "host_cores": os.cpu_count(),
+        "caveat": "multi-process scenarios oversubscribe this host's "
+                  f"{os.cpu_count()} core(s); absolute throughputs reflect "
+                  "CPU contention, not TPU behavior — the mechanism timings "
+                  "(detect, reshape, restore, compile-cache effect) are the "
+                  "meaningful signal",
+        "preemption": preemption_scenario(),
+        "scale_up_cold_cache": scale_cold,
+        "scale_up_warm_cache": scale_warm,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

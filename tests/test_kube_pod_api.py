@@ -1,0 +1,261 @@
+"""KubePodApi against a fake k8s API server (SURVEY.md §4 item 4; the
+reference's operator watches the real API server,
+docs/design/elastic-training-operator.md:53-55).
+
+The fake speaks the pod REST surface the backend uses (POST/GET/DELETE on
+/api/v1/namespaces/{ns}/pods with labelSelector) over localhost HTTP, so the
+full controller loop — CRD store -> reconcile core -> KubePodApi -> "cluster"
+— runs with a real HTTP boundary and k8s-shaped payloads.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from easydl_tpu.api.job_spec import JobSpec, ResourceSpec, RoleSpec, TpuSpec
+from easydl_tpu.api.resource_plan import ResourcePlan, ResourceUpdation, RolePlan
+from easydl_tpu.controller import CrStore, ElasticJobController
+from easydl_tpu.controller.kube_pod_api import (
+    KubeApiError,
+    KubePodApi,
+    manifest_to_pod,
+    pod_to_manifest,
+)
+from easydl_tpu.controller.pod_api import Pod
+
+
+class FakeKubeApiServer:
+    """In-memory pod store behind a real HTTP server (k8s pod API subset)."""
+
+    def __init__(self):
+        self.pods = {}  # name -> manifest dict
+        self.lock = threading.Lock()
+        self.auth_seen = []
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                store.auth_seen.append(self.headers.get("Authorization"))
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n))
+                name = doc["metadata"]["name"]
+                with store.lock:
+                    if name in store.pods:
+                        self._send(409, {"reason": "AlreadyExists"})
+                        return
+                    doc.setdefault("status", {})["phase"] = "Pending"
+                    store.pods[name] = doc
+                self._send(201, doc)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(parsed.query)
+                selector = q.get("labelSelector", [""])[0]
+                want = None
+                if "=" in selector:
+                    k, v = selector.split("=", 1)
+                    want = (k, v)
+                with store.lock:
+                    items = []
+                    for doc in store.pods.values():
+                        labels = doc["metadata"].get("labels", {})
+                        if want is None or labels.get(want[0]) == want[1]:
+                            items.append(doc)
+                self._send(200, {"kind": "PodList", "items": items})
+
+            def do_DELETE(self):
+                name = self.path.rsplit("/", 1)[-1]
+                with store.lock:
+                    if name not in store.pods:
+                        self._send(404, {"reason": "NotFound"})
+                        return
+                    doc = store.pods.pop(name)
+                self._send(200, doc)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    # test levers, mirroring InMemoryPodApi
+    def set_phase(self, name: str, phase: str) -> None:
+        with self.lock:
+            self.pods[name]["status"]["phase"] = phase
+
+    def tick(self) -> None:
+        with self.lock:
+            for doc in self.pods.values():
+                if doc["status"]["phase"] == "Pending":
+                    doc["status"]["phase"] = "Running"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture
+def fake_cluster():
+    srv = FakeKubeApiServer()
+    yield srv
+    srv.stop()
+
+
+def make_api(srv) -> KubePodApi:
+    return KubePodApi(base_url=srv.url, namespace="train", token="test-token")
+
+
+def test_manifest_round_trip_preserves_identity_and_resources():
+    pod = Pod(
+        name="j-worker-3", job="j", role="worker",
+        resource=ResourceSpec(cpu=4, memory=8192,
+                              tpu=TpuSpec(type="v5e", chips=4, topology="2x2")),
+        replaces="j-worker-1", command="python -m x", image="img:1",
+    )
+    doc = pod_to_manifest(pod, "train")
+    # GKE TPU pod-slice contract
+    c = doc["spec"]["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    sel = doc["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+    assert c["resources"]["requests"] == {"cpu": "4", "memory": "8192Mi"}
+    back = manifest_to_pod(doc)
+    assert (back.name, back.job, back.role, back.replaces) == (
+        "j-worker-3", "j", "worker", "j-worker-1")
+    assert back.resource.to_dict() == pod.resource.to_dict()
+    assert back.command == "python -m x" and back.image == "img:1"
+
+
+def test_terminating_mapped_from_deletion_timestamp():
+    pod = Pod(name="p", job="j", role="worker")
+    doc = pod_to_manifest(pod, "d")
+    doc["status"] = {"phase": "Running"}
+    doc["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    assert manifest_to_pod(doc).phase == "Terminating"
+
+
+def test_crud_against_fake_server(fake_cluster):
+    api = make_api(fake_cluster)
+    api.create_pod(Pod(name="j-worker-0", job="j", role="worker"))
+    api.create_pod(Pod(name="k-worker-0", job="k", role="worker"))
+    assert [p.name for p in api.list_pods("j")] == ["j-worker-0"]
+    assert len(api.list_pods()) == 2
+    # bearer token forwarded
+    assert fake_cluster.auth_seen[-1] == "Bearer test-token"
+    # create is idempotent on AlreadyExists (level-triggered reconcile)
+    api.create_pod(Pod(name="j-worker-0", job="j", role="worker"))
+    # delete is idempotent on NotFound
+    api.delete_pod("j-worker-0")
+    api.delete_pod("j-worker-0")
+    assert api.list_pods("j") == []
+
+
+def test_controller_reconciles_crds_through_kube_api(fake_cluster):
+    """The full reference flow against the k8s surface: submit ElasticJob ->
+    trainer pod only; apply JobResource -> role pods; resource_updation ->
+    replace-then-retire (docs/design/elastic-training-operator.md:47-55,
+    86-101)."""
+    api = make_api(fake_cluster)
+    store = CrStore()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(JobSpec(
+        name="deepctr", command="python -m easydl_tpu.models.run --model mlp",
+        roles={"worker": RoleSpec(), "parameter_server": RoleSpec()},
+    ))
+    ctl.step(timeout=1)
+    assert [p.name for p in api.list_pods("deepctr")] == ["deepctr-trainer-0"]
+
+    store.apply_plan(ResourcePlan(
+        job_name="deepctr", version=1,
+        roles={
+            "worker": RolePlan(replicas=2, resource=ResourceSpec(
+                tpu=TpuSpec(type="v5e", chips=4, topology="2x2"))),
+            "parameter_server": RolePlan(replicas=1,
+                                         resource=ResourceSpec(cpu=2)),
+        },
+    ))
+    ctl.step(timeout=1)
+    roles = sorted((p.role, p.name) for p in api.list_pods("deepctr"))
+    assert roles == [
+        ("parameter_server", "deepctr-parameter_server-0"),
+        ("trainer", "deepctr-trainer-0"),
+        ("worker", "deepctr-worker-0"),
+        ("worker", "deepctr-worker-1"),
+    ]
+    # the TPU request reached the "cluster" in GKE form
+    doc = fake_cluster.pods["deepctr-worker-0"]
+    assert doc["spec"]["containers"][0]["resources"]["limits"]["google.com/tpu"] == "4"
+
+    # vertical scaling: replace-then-retire for ps-0
+    fake_cluster.tick()  # everything Running
+    store.apply_plan(ResourcePlan(
+        job_name="deepctr", version=2,
+        roles={
+            "worker": RolePlan(replicas=2, resource=ResourceSpec(
+                tpu=TpuSpec(type="v5e", chips=4, topology="2x2"))),
+            "parameter_server": RolePlan(replicas=1,
+                                         resource=ResourceSpec(cpu=2)),
+        },
+        resource_updation=[ResourceUpdation(
+            name="deepctr-parameter_server-0",
+            resource=ResourceSpec(cpu=8, memory=8192),
+        )],
+    ))
+    ctl.step(timeout=1)
+    pods = {p.name: p for p in api.list_pods("deepctr")}
+    # replacement created first, old pod still present
+    assert "deepctr-parameter_server-1" in pods
+    assert pods["deepctr-parameter_server-1"].replaces == "deepctr-parameter_server-0"
+    assert "deepctr-parameter_server-0" in pods
+    # once the replacement runs, the old pod is retired
+    fake_cluster.set_phase("deepctr-parameter_server-1", "Running")
+    store.poke("deepctr")
+    ctl.step(timeout=1)
+    names = [p.name for p in api.list_pods("deepctr")]
+    assert "deepctr-parameter_server-0" not in names
+    assert "deepctr-parameter_server-1" in names
+
+
+def test_failed_pod_recovered_through_kube_api(fake_cluster):
+    api = make_api(fake_cluster)
+    store = CrStore()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(JobSpec(name="j", command="python -m easydl_tpu.models.run --model mlp"))
+    ctl.step(timeout=1)
+    store.apply_plan(ResourcePlan(
+        job_name="j", version=1, roles={"worker": RolePlan(replicas=1)}))
+    ctl.step(timeout=1)
+    fake_cluster.tick()
+    fake_cluster.set_phase("j-worker-0", "Failed")
+    ctl.reconcile_job("j")
+    names = [p.name for p in api.list_pods("j") if p.role == "worker"]
+    assert names == ["j-worker-1"]  # fresh name, failed pod deleted
+
+
+def test_http_error_surfaces(fake_cluster):
+    api = make_api(fake_cluster)
+    with pytest.raises(KubeApiError) as ei:
+        api._request("DELETE", "/api/v1/namespaces/train/pods/nope")
+    assert ei.value.code == 404

@@ -159,6 +159,69 @@ def test_grpc_ps_cluster(tmp_path):
     assert restored.total_rows("emb") == 200
 
 
+def test_live_shard_migration_zero_lost_rows(tmp_path):
+    """Vertical-scaling handoff (resource_updation replace-then-retire on a
+    PS pod, docs/design/elastic-training-operator.md:86-101): replace a LIVE
+    shard mid-training — drain gates pushes, the replacement restores the
+    drained save, the client reroutes, and gated pushes retry onto the
+    replacement. Zero lost updates: final rows must bit-match a cluster that
+    never migrated."""
+    import threading
+
+    shards = [PsShard(shard_index=i, num_shards=2) for i in range(2)]
+    servers = [s.serve() for s in shards]
+    replacement = PsShard(shard_index=1, num_shards=2)  # the "new pod"
+    repl_server = replacement.serve()
+    client = ShardedPsClient([sv.address for sv in servers])
+    reference = LocalPsClient(num_shards=2)
+    try:
+        client.create_table(spec())
+        reference.create_table(spec())
+        ids = np.arange(400)
+        g = np.full((400, 8), 1.0, np.float32)
+
+        # steady-state training before the migration
+        for _ in range(3):
+            client.push("emb", ids, g, scale=0.1)
+            reference.push("emb", ids, g, scale=0.1)
+
+        # a concurrent pusher keeps training DURING the migration
+        errors = []
+
+        def pusher():
+            try:
+                for _ in range(4):
+                    client.push("emb", ids, g, scale=0.1)
+            except Exception as e:  # surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=pusher)
+        t.start()
+        client.migrate_shard(
+            1, repl_server.address, str(tmp_path / "migrate-1"), step=3
+        )
+        t.join(60)
+        assert not t.is_alive() and not errors, errors
+        for _ in range(4):
+            reference.push("emb", ids, g, scale=0.1)
+
+        # post-migration training continues on the replacement
+        client.push("emb", ids, g, scale=0.1)
+        reference.push("emb", ids, g, scale=0.1)
+
+        np.testing.assert_allclose(
+            client.pull("emb", ids), reference.pull("emb", ids), rtol=1e-6
+        )
+        # old shard 1 is gated; the replacement serves its rows
+        assert shards[1]._draining
+        assert replacement.table("emb").rows == shards[1].table("emb").rows
+        client.close()
+    finally:
+        for sv in servers:
+            sv.stop()
+        repl_server.stop()
+
+
 def test_torn_save_is_invisible(tmp_path):
     """A save that only completed on some shards must not be restorable —
     otherwise the missing shard's ids silently re-init to fresh values."""
