@@ -286,19 +286,43 @@ class CheckpointManager:
         Multi-process contract: every process calls this at the same step
         boundary with the same ``block`` value. With ``block=False`` the
         commit happens only once ALL ranks' chunk IO has finished (agreed via
-        a tiny allgather, so no rank enters the barrier alone). Returns True
-        when nothing remains pending."""
+        a tiny allgather, so no rank enters the barrier alone). The allgather
+        carries a tri-state (pending / ready / failed), not just completion:
+        if any rank's chunk IO raised, EVERY rank drops the pending commit
+        and raises instead of entering the commit collectives — otherwise the
+        healthy ranks would hang in ``sync_global_devices`` waiting for the
+        failed rank, until external failure detection killed the job.
+        Returns True when nothing remains pending."""
         if self._pending_commit is None:
             return True
-        ready = block or self._thread is None or not self._thread.is_alive()
+        # Reap the IO thread if finished (or block for it): joining is safe
+        # here — the thread does local file IO only, no collectives.
+        if self._thread is not None and (block or not self._thread.is_alive()):
+            self._thread.join()
+            self._thread = None
+        io_done = self._thread is None
+        # 0 = chunk IO still running, 1 = ready to commit, 2 = IO failed.
+        local = 2 if (io_done and self._error is not None) else int(io_done)
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            ready = bool(
-                multihost_utils.process_allgather(
-                    np.asarray([1 if ready else 0], np.int32)
-                ).min()
+            states = multihost_utils.process_allgather(
+                np.asarray([local], np.int32)
             )
+            if int(states.max()) == 2:
+                self._pending_commit = None
+                if self._error is not None:
+                    err, self._error = self._error, None
+                    raise RuntimeError(
+                        f"async checkpoint save failed: {err!r}"
+                    ) from err
+                raise RuntimeError(
+                    "async checkpoint save failed on another process; "
+                    "commit dropped on all ranks"
+                )
+            ready = bool(states.min() == 1)
+        else:
+            ready = local >= 1  # single-process: wait() raises on failure
         if not ready:
             return False
         self.wait()

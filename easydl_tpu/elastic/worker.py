@@ -187,6 +187,13 @@ def _warm_wait(warm_file: str) -> Dict[str, str]:
     start), then block until the agent writes this generation's membership
     into ``warm_file``. Cuts the generation-switch/recovery time by the full
     import cost (the dominant term — see RECOVERY.json)."""
+    # Orphan detection: remember the agent's PID now, and exit when our
+    # parent changes (we get reparented to init/a subreaper when the agent
+    # dies). Comparing against literal 1 would be wrong in containers where
+    # the agent itself IS PID 1 — the standby would exit instantly and warm
+    # start would be silently disabled every generation.
+    parent_pid = os.getppid()
+
     import jax  # noqa: F401  (the import IS the work)
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -198,7 +205,7 @@ def _warm_wait(warm_file: str) -> Dict[str, str]:
     except OSError:
         pass
     while True:
-        if os.getppid() == 1:  # agent died; don't linger as an orphan
+        if os.getppid() != parent_pid:  # agent died; don't linger as orphan
             raise SystemExit(0)
         try:
             with open(warm_file) as f:

@@ -157,6 +157,25 @@ class LocalPsClient(_PsClientBase):
         return self.shards[s].Stats(pb.PsStatsRequest(), None)
 
 
+def _is_transport_error(e: BaseException) -> bool:
+    """True for failures that mean "the call never reached a live handler":
+    a channel closed under us (ValueError from grpc) or UNAVAILABLE /
+    CANCELLED / DEADLINE_EXCEEDED transport statuses. UNKNOWN is a
+    server-side handler exception — never retriable."""
+    import grpc
+
+    if isinstance(e, ValueError):  # "Cannot invoke RPC on closed channel!"
+        return True
+    if isinstance(e, grpc.RpcError):
+        code = e.code() if callable(getattr(e, "code", None)) else None
+        return code in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.CANCELLED,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+    return False
+
+
 class ShardedPsClient(_PsClientBase):
     """gRPC PS cluster client. ``addresses[i]`` must be shard i of N —
     routing is positional, the same order every worker must use.
@@ -197,7 +216,27 @@ class ShardedPsClient(_PsClientBase):
         )
         deadline = time.monotonic() + self.drain_retry_s
         while True:
-            ack = self._clients[s].Push(req)  # re-read: reroute may swap it
+            try:
+                ack = self._clients[s].Push(req)  # re-read: reroute may swap
+            except Exception as e:
+                # Transport failure mid-handoff: reroute() may close the old
+                # client while this retry loop holds it (the next iteration
+                # re-reads the swapped client), or the old pod may already be
+                # retired. ONLY those are retriable — a server-side handler
+                # error surfaces as RpcError(UNKNOWN) and must raise now with
+                # its real cause, not stall out the drain window. Re-applying
+                # on retry cannot double-count: during the handoff window the
+                # old shard is gated (DRAINING), so an interrupted call was
+                # never applied.
+                if not _is_transport_error(e):
+                    raise
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"ps shard {s} unreachable past "
+                        f"{self.drain_retry_s}s: {e}"
+                    ) from e
+                time.sleep(0.05)
+                continue
             if ack.ok:
                 return
             if not ack.message.startswith(DRAINING):

@@ -95,6 +95,14 @@ class PsShard:
         self._lock = threading.Lock()
         self._server = None
         self._draining = False
+        # Push/Drain coordination: the gRPC server handles requests on a
+        # thread pool, so a Push that passed the draining gate could still
+        # be applying while drain() exports the snapshot — the update would
+        # ack ok=True yet never reach the replacement. Pushes therefore
+        # register in _inflight_pushes under _drain_cv, and drain() waits
+        # for the count to hit zero after closing the gate, before saving.
+        self._drain_cv = threading.Condition()
+        self._inflight_pushes = 0
 
     # ----------------------------------------------------------- table admin
     def create_table(self, spec: TableSpec) -> EmbeddingTable:
@@ -153,7 +161,12 @@ class PsShard:
         init, which the replacement reproduces bit-exactly for unseen ids
         (reference semantics: docs/design/elastic-training-operator.md:86-101
         targets PS pods specifically)."""
-        self._draining = True
+        with self._drain_cv:
+            self._draining = True
+            # Wait out pushes that passed the gate before it closed; once
+            # zero, no new ones can start, so the snapshot is complete.
+            while self._inflight_pushes > 0:
+                self._drain_cv.wait(timeout=0.1)
         self.save(directory, step, marker_expected=1)
 
     @staticmethod
@@ -228,26 +241,36 @@ class PsShard:
         return pb.PullResponse(values=values.tobytes(), dim=t.dim)
 
     def Push(self, req: pb.PushRequest, ctx) -> pb.Ack:
-        if self._draining:
-            return pb.Ack(
-                ok=False,
-                message=f"{DRAINING}: shard {self.shard_index} is migrating; "
-                        "retry after reroute",
-            )
-        # scale is a proto3 double: an unset field is indistinguishable from
-        # an explicit 0.0, and 0.0 would silently no-op every update. It is
-        # never a meaningful value, so reject it instead of applying it.
-        if req.scale == 0.0:
-            return pb.Ack(
-                ok=False,
-                message="PushRequest.scale must be set and non-zero "
-                        "(0.0 would silently discard the update)",
-            )
-        t = self.table(req.table)
-        ids = np.asarray(req.ids, np.int64)
-        grads = np.frombuffer(req.grads, np.float32).reshape(len(ids), t.dim)
-        t.push(ids, grads, scale=req.scale)
-        return pb.Ack(ok=True)
+        with self._drain_cv:
+            if self._draining:
+                return pb.Ack(
+                    ok=False,
+                    message=f"{DRAINING}: shard {self.shard_index} is "
+                            "migrating; retry after reroute",
+                )
+            self._inflight_pushes += 1
+        try:
+            # scale is a proto3 double: an unset field is indistinguishable
+            # from an explicit 0.0, and 0.0 would silently no-op every
+            # update. It is never a meaningful value, so reject it instead
+            # of applying it.
+            if req.scale == 0.0:
+                return pb.Ack(
+                    ok=False,
+                    message="PushRequest.scale must be set and non-zero "
+                            "(0.0 would silently discard the update)",
+                )
+            t = self.table(req.table)
+            ids = np.asarray(req.ids, np.int64)
+            grads = np.frombuffer(req.grads, np.float32).reshape(
+                len(ids), t.dim)
+            t.push(ids, grads, scale=req.scale)
+            return pb.Ack(ok=True)
+        finally:
+            with self._drain_cv:
+                self._inflight_pushes -= 1
+                if self._inflight_pushes == 0:
+                    self._drain_cv.notify_all()
 
     def Save(self, req: pb.PsSaveRequest, ctx) -> pb.Ack:
         try:

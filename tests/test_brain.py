@@ -189,7 +189,7 @@ def test_brain_replans_from_metrics():
     assert plan.version == 2
 
 
-def test_master_polls_brain_and_applies_plan():
+def test_master_polls_brain_and_applies_plan(tmp_path):
     """Full loop: master polls a live Brain over gRPC and applies the replan
     to its rendezvous (docs/design/elastic-training-operator.md:110-114)."""
     from easydl_tpu.elastic.master import Master
@@ -202,7 +202,7 @@ def test_master_polls_brain_and_applies_plan():
                                     roles={"worker": RolePlan(replicas=2)}))
         master = Master(
             job_name="poll-job",
-            workdir="/tmp/easydl-test-poll",
+            workdir=str(tmp_path / "poll-master"),
             desired_workers=1,
             brain_address=brain.address,
             brain_poll_interval=0.1,

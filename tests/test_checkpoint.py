@@ -124,3 +124,47 @@ def test_restore_missing_leaf_fails(tmp_path, eight_devices):
     abstract, _, _ = t2._abstract_state()
     with pytest.raises((KeyError, ValueError)):
         mgr.restore(1, abstract, t2.state_shardings())
+
+
+def test_finalize_drops_commit_on_io_failure(tmp_path, eight_devices, monkeypatch):
+    """One rank's failed chunk IO must abort the deferred commit on every
+    rank (tri-state allgather), not leave healthy ranks hanging in the
+    commit barrier. Simulated multi-process: process_count patched to 2 and
+    the allgather faked so a synthetic rank 1 reports failure while the real
+    process (rank 0, healthy) would otherwise happily enter the barrier."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    t1, _ = make_trainer(MeshSpec(dp=8))
+    s1 = t1.init_state()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    other_rank_state = [2]  # 2 = failed (tri-state)
+    barriers = []
+    monkeypatch.setattr(
+        multihost_utils, "broadcast_one_to_all",
+        lambda x, is_source=None: np.asarray(x),
+    )
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda x: np.stack(
+            [np.asarray(x), np.full_like(np.asarray(x), other_rank_state[0])]
+        ),
+    )
+    monkeypatch.setattr(
+        multihost_utils, "sync_global_devices", lambda name: barriers.append(name)
+    )
+
+    mgr.save(7, s1)
+    assert mgr._pending_commit is not None
+    with pytest.raises(RuntimeError, match="failed on another process"):
+        mgr.finalize(block=True)
+    assert mgr._pending_commit is None  # dropped, not left to hang a barrier
+    assert mgr.steps() == []  # nothing committed
+    assert not barriers  # the commit collectives were never entered
+
+    # The manager recovers once the peer is healthy: later save commits.
+    other_rank_state[0] = 1
+    mgr.save(8, s1)
+    assert mgr.finalize(block=True)
+    assert mgr.steps() == [8]

@@ -394,3 +394,115 @@ def test_deepfm_ps_training_learns(tmp_path):
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0], f"no learning: {losses[0]:.3f} -> {losses[-1]:.3f}"
     assert client.total_rows("emb") > 0
+
+
+def test_drain_waits_for_inflight_push(tmp_path):
+    """Push/Drain race: a push that passed the draining gate but is still
+    applying when Drain arrives must land in the drained snapshot — the
+    server acked it ok=True, so losing it would break the zero-lost-updates
+    handoff contract."""
+    import threading
+    import time
+
+    from easydl_tpu.proto import easydl_pb2 as pb
+
+    shard = PsShard(shard_index=0, num_shards=1)
+    shard.create_table(spec())
+    ids = np.arange(50)
+    g = np.ones((50, 8), np.float32)
+    shard.Push(
+        pb.PushRequest(table="emb", ids=ids.tolist(), grads=g.tobytes(),
+                       scale=0.1),
+        None,
+    )
+
+    # Make the apply slow so Drain provably arrives mid-push.
+    t = shard.table("emb")
+    orig_push = t.push
+    started = threading.Event()
+
+    def slow_push(ids, grads, scale=1.0):
+        started.set()
+        time.sleep(0.4)
+        return orig_push(ids, grads, scale=scale)
+
+    t.push = slow_push
+    acks = []
+    th = threading.Thread(
+        target=lambda: acks.append(
+            shard.Push(
+                pb.PushRequest(table="emb", ids=ids.tolist(),
+                               grads=g.tobytes(), scale=0.1),
+                None,
+            )
+        )
+    )
+    th.start()
+    assert started.wait(5)
+    shard.drain(str(tmp_path), step=1)  # must block until the push applied
+    th.join(10)
+    assert acks and acks[0].ok
+
+    repl = PsShard(shard_index=0, num_shards=1)
+    repl.restore(str(tmp_path))
+    np.testing.assert_array_equal(
+        repl.table("emb").pull(ids), shard.table("emb").pull(ids)
+    )
+
+
+def test_push_survives_reroute_closing_old_transport(tmp_path):
+    """A draining push retry must treat transport failures as retriable:
+    reroute() closes the old RpcClient while the retry loop may be mid-Push
+    on it, and the old pod may already be gone — the push the handoff exists
+    to preserve has to ride that out and land on the replacement."""
+    import threading
+    import time
+
+    shards = [PsShard(shard_index=0, num_shards=1)]
+    server = shards[0].serve()
+    repl = PsShard(shard_index=0, num_shards=1)
+    repl_server = repl.serve()
+    client = ShardedPsClient([server.address], drain_retry_s=30.0)
+    try:
+        client.create_table(spec())
+        ids = np.arange(20)
+        g = np.ones((20, 8), np.float32)
+        client.push("emb", ids, g, scale=0.1)
+
+        # Gate the old shard and hand its rows to the replacement.
+        shards[0].drain(str(tmp_path / "mig"), step=0)
+        repl.restore(str(tmp_path / "mig"))
+
+        done, errors = [], []
+
+        def run():
+            try:
+                client.push("emb", ids, g, scale=0.1)
+                done.append(1)
+            except Exception as e:  # surfaced below
+                errors.append(e)
+
+        th = threading.Thread(target=run)
+        th.start()
+        time.sleep(0.2)  # let the push enter its DRAINING retry loop
+        # Simulate reroute's close racing the in-flight retry, with the old
+        # pod retired (server stopped) before the new address is swapped in.
+        old = client._clients[0]
+        old.close()
+        server.stop()
+        time.sleep(0.2)
+        client.reroute(0, repl_server.address)
+        th.join(30)
+        assert done and not errors, errors
+        # Both pushes (pre-drain on old, retried on replacement) applied.
+        expected_delta = 2 * 0.1 * 0.5  # 2 pushes x scale x sgd lr
+        base = PsShard(shard_index=0, num_shards=1)
+        base.create_table(spec())
+        fresh = base.table("emb").pull(ids)
+        np.testing.assert_allclose(
+            client.pull("emb", ids), fresh - expected_delta, rtol=1e-5
+        )
+        client.close()
+    finally:
+        server.stop()
+        repl_server.stop()
