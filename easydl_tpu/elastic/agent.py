@@ -22,6 +22,7 @@ from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import RpcClient
 
+from easydl_tpu.elastic import timeline
 from easydl_tpu.elastic.master import MASTER_SERVICE
 
 log = get_logger("elastic", "agent")
@@ -68,6 +69,11 @@ class Agent:
             sys.executable, "-m", "easydl_tpu.elastic.worker"
         ]
         self.metrics_path = os.path.join(workdir, f"metrics-{agent_id}.jsonl")
+        # Phase-boundary timeline shared with the worker (timeline.py):
+        # feeds the recovery decomposition in scripts/measure_recovery.py.
+        self.timeline_path = os.path.join(
+            workdir, f"timeline-{agent_id}.jsonl"
+        )
         self._proc: Optional[subprocess.Popen] = None
         self._log_file = None
         self._exit0_deadline: Optional[float] = None
@@ -151,6 +157,12 @@ class Agent:
     def run(self) -> None:
         self._client = RpcClient(MASTER_SERVICE, self.master_address, timeout=10.0)
         self._client.wait_ready(30.0)
+        if self.warm_start:
+            # Pre-warm before the first directive too: a standby agent that
+            # joins a scale-up must not cold-start its first worker — idle
+            # agents' jax import would otherwise gate the whole new
+            # generation's first step.
+            self._spawn_warm()
         directive = self._register()
         fail_since: Optional[float] = None
         while not self._stop.is_set():
@@ -216,6 +228,8 @@ class Agent:
             self._state = "done"
         elif code == 0 and self._quiesce_sent:
             self._state = "quiesced"
+            timeline.emit(self.timeline_path, "worker_exit",
+                          self._applied_key[0], code=code)
         elif code == 0 and not self._quiesce_sent:
             # Clean exit with no DONE marker *yet*: on multi-host jobs rank 0
             # (another host) may still be writing it. Reporting "idle" now
@@ -251,6 +265,8 @@ class Agent:
         elif kind == pb.DirectiveKind.QUIESCE:
             if self._proc and self._proc.poll() is None and not self._quiesce_sent:
                 log.info("%s: quiescing worker (SIGUSR1)", self.agent_id)
+                timeline.emit(self.timeline_path, "quiesce_sent",
+                              self._applied_key[0])
                 self._proc.send_signal(signal.SIGUSR1)
                 self._quiesce_sent = True
         elif kind == pb.DirectiveKind.KILL:
@@ -273,6 +289,7 @@ class Agent:
             # pool). Cap them unless the caller chose otherwise.
             env.setdefault("OMP_NUM_THREADS", "1")
             env.setdefault("OPENBLAS_NUM_THREADS", "1")
+        env["EASYDL_TIMELINE"] = self.timeline_path
         return env
 
     def _spawn_warm(self) -> None:
@@ -315,8 +332,14 @@ class Agent:
             "EASYDL_GEN": str(m.generation),
             "EASYDL_WORKDIR": self.workdir,
             "EASYDL_METRICS": self.metrics_path,
+            "EASYDL_TIMELINE": self.timeline_path,
         }
-        if self.warm_start and self._warm and self._warm[0].poll() is None:
+        warm_hit = bool(
+            self.warm_start and self._warm and self._warm[0].poll() is None
+        )
+        timeline.emit(self.timeline_path, "spawn", m.generation,
+                      mode="warm" if warm_hit else "cold")
+        if warm_hit:
             proc, warm_file, log_file = self._warm
             self._warm = None
             tmp = warm_file + ".tmp"

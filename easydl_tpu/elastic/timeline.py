@@ -1,0 +1,65 @@
+"""Per-host phase timeline for recovery/reshape decomposition.
+
+The reference promises fast elastic recovery (README.md:25-35) without a
+mechanism; our generation switch has seven distinct phases (quiesce consensus,
+drain checkpoint, re-rendezvous, process spawn, runtime imports, distributed
+init, restore, first-step compile) and optimizing the wrong one is easy —
+round 2's compile cache bought ~10s of a ~60s stall because process start,
+not recompile, dominated. Every worker/agent appends one JSON line per phase
+boundary to ``timeline-<agent>.jsonl`` in the job workdir; the master's
+``events.jsonl`` carries the plan/phase transitions. ``scripts/
+measure_recovery.py`` folds both into the per-phase breakdown in
+RECOVERY.json.
+
+Records: ``{"t": <unix time>, "phase": str, "gen": int, ...}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List
+
+
+def emit(path: str | None, phase: str, generation: int, **data: Any) -> None:
+    """Append one phase boundary; never raises (timing is best-effort and
+    must not take down a worker)."""
+    if not path:
+        return
+    rec = {"t": time.time(), "phase": phase, "gen": int(generation), **data}
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+def read(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn concurrent append
+    except OSError:
+        pass
+    return out
+
+
+def read_all(workdir: str) -> List[Dict[str, Any]]:
+    """All agents' timelines in one list (unsorted; callers filter by gen)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        names = os.listdir(workdir)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("timeline-") and name.endswith(".jsonl"):
+            for rec in read(os.path.join(workdir, name)):
+                rec["source"] = name[len("timeline-"):-len(".jsonl")]
+                out.append(rec)
+    return out

@@ -42,6 +42,14 @@ def run_worker(env: Dict[str, str]) -> int:
     generation = int(env["EASYDL_GEN"])
     workdir = env["EASYDL_WORKDIR"]
     metrics_path = env["EASYDL_METRICS"]
+    tl_path = env.get("EASYDL_TIMELINE")
+
+    from easydl_tpu.elastic import timeline
+
+    # Phase boundaries for the recovery decomposition (timeline.py): for a
+    # warm-promoted standby this "start" is the promote instant, so the
+    # imports phase collapses to ~0 — exactly the saving warm start buys.
+    timeline.emit(tl_path, "worker_main_start", generation, rank=rank)
 
     with open(os.path.join(workdir, "job.json")) as f:
         cfg: Dict[str, Any] = json.load(f)
@@ -65,12 +73,14 @@ def run_worker(env: Dict[str, str]) -> int:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # older jax without these knobs: cache is best-effort
         pass
+    timeline.emit(tl_path, "jax_imported", generation, rank=rank)
     if world > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=world,
             process_id=rank,
         )
+    timeline.emit(tl_path, "dist_init_done", generation, rank=rank)
     from jax.experimental import multihost_utils
 
     import optax
@@ -120,6 +130,8 @@ def run_worker(env: Dict[str, str]) -> int:
         state = trainer.init_state()
         start_step = 0
         log.info("gen %d: fresh init, world=%d (%d devices)", generation, world, devices)
+    timeline.emit(tl_path, "restored", generation, rank=rank, step=start_step)
+    first_step_emitted = False
 
     total_steps = int(cfg.get("total_steps", 100))
     ckpt_interval = int(cfg.get("ckpt_interval", 20))
@@ -156,8 +168,10 @@ def run_worker(env: Dict[str, str]) -> int:
                 want_quiesce = False
         if want_quiesce:
             log.info("gen %d: quiescing at step %d", generation, step)
+            timeline.emit(tl_path, "quiesce_ckpt_begin", generation, step=step)
             ckpt.save(step, state)  # no-op if this step is already committed
             ckpt.wait()  # commit must land before this process exits
+            timeline.emit(tl_path, "quiesce_exit", generation, step=step)
             return 0
 
         t0 = time.perf_counter()
@@ -166,6 +180,11 @@ def run_worker(env: Dict[str, str]) -> int:
         dt = time.perf_counter() - t0
         step += 1
         append_metrics(step, loss, dt)
+        if not first_step_emitted:
+            # restored -> here = jit compile (or cache hit) + one step.
+            timeline.emit(tl_path, "first_step_done", generation,
+                          rank=rank, step=step, step_time_s=round(dt, 3))
+            first_step_emitted = True
 
         if ckpt_interval > 0 and step % ckpt_interval == 0 and step < total_steps:
             ckpt.save(step, state)
@@ -204,6 +223,9 @@ def _warm_wait(warm_file: str) -> Dict[str, str]:
             f.write(str(os.getpid()))
     except OSError:
         pass
+    from easydl_tpu.elastic import timeline
+
+    timeline.emit(os.environ.get("EASYDL_TIMELINE"), "standby_warm_ready", -1)
     while True:
         if os.getppid() != parent_pid:  # agent died; don't linger as orphan
             raise SystemExit(0)

@@ -5,16 +5,21 @@ scale-up with <5% throughput loss") exist in the reference only as promises
 (/root/reference/README.md:25-35); this script MEASURES them on the simulated
 distributed runtime (real master + agents + jax.distributed worker
 subprocesses on a CPU mesh — the same machinery that runs on TPU hosts, at
-2->4 proxy scale).
+2->4 proxy scale) and DECOMPOSES the generation-switch stall into its phases
+(quiesce signal, drain checkpoint, exit detect, re-rendezvous, process start,
+runtime imports, distributed init, restore, first-step compile) from the
+per-host timelines (easydl_tpu/elastic/timeline.py), so each round attacks
+the dominant term instead of guessing.
 
 Scenarios:
 1. preemption: SIGKILL one of two workers (no notice) mid-run; measure
    kill -> first-post-restore-step wall time and steps of work lost.
-2. scale-up: apply a plan doubling the worker count mid-run; measure the
-   generation-switch stall (last step of gen N -> first step of gen N+1,
-   which includes quiesce, checkpoint, re-rendezvous, restore, recompile)
-   and the throughput loss over the transition window vs a static-world
-   extrapolation.
+2. scale-up (x3 variants): apply a plan doubling the worker count mid-run;
+   measure the generation-switch stall and throughput loss over the
+   transition window vs a static-world extrapolation:
+     a. cold compile cache, cold worker start;
+     b. warm compile cache, cold worker start;
+     c. warm compile cache + warm standby workers (jax pre-imported).
 
 Usage: python scripts/measure_recovery.py [--out RECOVERY.json]
 Must run where jax can use a CPU platform; spawns its own subprocess with
@@ -50,7 +55,70 @@ def wait_for(cond, timeout, desc):
     raise TimeoutError(f"timed out waiting for {desc}")
 
 
-def preemption_scenario() -> dict:
+def _phase_chain(recs, chain, t0):
+    """Fold raw timeline records into consecutive phase durations.
+
+    ``chain`` is [(phase_label, event_phase, gen, pick)] where pick is
+    ``max`` (slowest host gates the collective) or ``min`` (first record).
+    Durations are between consecutive *present* boundaries starting at t0;
+    a missing event yields None for its phase, charging its time to the
+    next present one (stated rather than hidden).
+    """
+    out = {}
+    prev = t0
+    for label, phase, gen, pick in chain:
+        ts = [r["t"] for r in recs if r["phase"] == phase and r["gen"] == gen]
+        if not ts:
+            out[label] = None
+            continue
+        t = pick(ts)
+        out[label] = round(t - prev, 2)
+        prev = t
+    out["total_s"] = round(prev - t0, 2)
+    return out
+
+
+def decompose_switch(workdir: str, gen_from: int, gen_to: int, t0: float):
+    from easydl_tpu.elastic import timeline
+
+    recs = timeline.read_all(workdir)
+    chain = [
+        ("quiesce_signal_s",        "quiesce_sent",       gen_from, max),
+        ("drain_to_step_boundary_s", "quiesce_ckpt_begin", gen_from, max),
+        ("drain_checkpoint_s",      "quiesce_exit",       gen_from, max),
+        ("exit_detect_s",           "worker_exit",        gen_from, max),
+        ("rendezvous_respawn_s",    "spawn",              gen_to,   max),
+        ("process_start_s",         "worker_main_start",  gen_to,   max),
+        ("runtime_imports_s",       "jax_imported",       gen_to,   max),
+        ("dist_init_s",             "dist_init_done",     gen_to,   max),
+        ("restore_s",               "restored",           gen_to,   max),
+        ("first_step_compile_s",    "first_step_done",    gen_to,   max),
+    ]
+    phases = _phase_chain(recs, chain, t0)
+    modes = sorted(
+        {r.get("mode", "?") for r in recs
+         if r["phase"] == "spawn" and r["gen"] == gen_to}
+    )
+    phases["spawn_modes"] = modes
+    return phases
+
+
+def decompose_recovery(workdir: str, gen_to: int, t_kill: float):
+    from easydl_tpu.elastic import timeline
+
+    recs = timeline.read_all(workdir)
+    chain = [
+        ("detect_and_rendezvous_s", "spawn",             gen_to, max),
+        ("process_start_s",         "worker_main_start", gen_to, max),
+        ("runtime_imports_s",       "jax_imported",      gen_to, max),
+        ("dist_init_s",             "dist_init_done",    gen_to, max),
+        ("restore_s",               "restored",          gen_to, max),
+        ("first_step_compile_s",    "first_step_done",   gen_to, max),
+    ]
+    return _phase_chain(recs, chain, t_kill)
+
+
+def preemption_scenario(warm_start: bool) -> dict:
     from easydl_tpu.elastic.agent import Agent
     from easydl_tpu.elastic.master import Master
 
@@ -64,8 +132,8 @@ def preemption_scenario() -> dict:
     master = Master(job_name="recovery", workdir=wd, desired_workers=2,
                     min_workers=1, heartbeat_timeout=1.5,
                     worker_config=cfg).start()
-    a0 = Agent("a0", master.address, wd, slots=2).start()
-    a1 = Agent("a1", master.address, wd, slots=2).start()
+    a0 = Agent("a0", master.address, wd, slots=2, warm_start=warm_start).start()
+    a1 = Agent("a1", master.address, wd, slots=2, warm_start=warm_start).start()
     try:
         wait_for(
             lambda: min(
@@ -88,11 +156,13 @@ def preemption_scenario() -> dict:
         return {
             "scenario": "preemption (SIGKILL worker, no notice)",
             "world": "2 agents x 2 CPU devices",
+            "warm_standby": warm_start,
             "recovery_s": round(first_post["t"] - t_kill, 2),
             "steps_lost": max(0, pre_last - (first_post["step"] - 1)),
             "ckpt_interval": cfg["ckpt_interval"],
             "detect_mechanism": "heartbeat timeout 1.5s + peer crash report",
             "generations": final_gen,
+            "phases": decompose_recovery(wd, final_gen, t_kill),
         }
     finally:
         a0.stop()
@@ -100,7 +170,7 @@ def preemption_scenario() -> dict:
         master.stop()
 
 
-def scale_up_scenario(cache_dir: str) -> dict:
+def scale_up_scenario(cache_dir: str, warm_start: bool) -> dict:
     from easydl_tpu.api import ResourcePlan, RolePlan
     from easydl_tpu.elastic.agent import Agent
     from easydl_tpu.elastic.master import Master
@@ -117,8 +187,11 @@ def scale_up_scenario(cache_dir: str) -> dict:
     }
     master = Master(job_name="scaleup", workdir=wd, desired_workers=2,
                     min_workers=2, worker_config=cfg).start()
-    agents = [Agent(f"a{i}", master.address, wd, slots=1).start()
-              for i in range(4)]
+    agents = [
+        Agent(f"a{i}", master.address, wd, slots=1,
+              warm_start=warm_start).start()
+        for i in range(4)
+    ]
     try:
         wait_for(
             lambda: any(
@@ -127,12 +200,24 @@ def scale_up_scenario(cache_dir: str) -> dict:
             ),
             240, "members past step 40 (warm steady state)",
         )
+        if warm_start:
+            # The point of the warm variant is measuring promote-vs-cold:
+            # don't fire the plan until standbys finished importing jax.
+            wait_for(
+                lambda: all(
+                    os.path.exists(os.path.join(wd, f)) for f in (
+                        f".warm-a{i}-1.json.ready" for i in range(4)
+                    )
+                ),
+                240, "all warm standbys ready",
+            )
         gen1 = master.status()["generation"]
         t_plan = time.time()
         master.apply_plan(ResourcePlan(
             job_name="scaleup", version=100,
             roles={"worker": RolePlan(replicas=4)},
         ))
+
         def gen2_steps_recorded(n: int) -> bool:
             recs = []
             for i in range(4):
@@ -148,6 +233,7 @@ def scale_up_scenario(cache_dir: str) -> dict:
             merged += read_metrics(wd, f"a{i}")
         g1 = [r for r in merged if r["generation"] == gen1]
         g2 = [r for r in merged if r["generation"] > gen1]
+        gen2 = min(r["generation"] for r in g2)
         # Steady-state throughput before the plan: last 20 gen-1 steps,
         # global samples/sec (records are per-rank; each rank's record
         # reports the global samples/sec of its world).
@@ -175,6 +261,7 @@ def scale_up_scenario(cache_dir: str) -> dict:
         )
         return {
             "scenario": "scale-up 2->4 workers mid-run (proxy for 8->32 chips)",
+            "warm_standby": warm_start,
             "generation_switch_s": round(switch_s, 2),
             "throughput_before_samples_per_s": round(tput_before, 1),
             "throughput_after_samples_per_s": round(tput_after, 1),
@@ -182,6 +269,7 @@ def scale_up_scenario(cache_dir: str) -> dict:
             "throughput_loss_pct_vs_static": round(loss_pct, 1),
             "north_star": "<5% throughput loss vs static pod",
             "compile_cache": "persistent jax_compilation_cache_dir enabled",
+            "phases": decompose_switch(wd, gen1, gen2, t_plan),
         }
     finally:
         for a in agents:
@@ -209,13 +297,14 @@ def main() -> None:
             env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--out", args.out],
-                env=env, cwd=REPO, timeout=1800,
+                env=env, cwd=REPO, timeout=3600,
             )
             raise SystemExit(proc.returncode)
 
     cache_dir = tempfile.mkdtemp(prefix="recovery-jaxcache-")
-    scale_cold = scale_up_scenario(cache_dir)
-    scale_warm = scale_up_scenario(cache_dir)  # compile cache now populated
+    scale_cold = scale_up_scenario(cache_dir, warm_start=False)
+    scale_warm_cache = scale_up_scenario(cache_dir, warm_start=False)
+    scale_warm_full = scale_up_scenario(cache_dir, warm_start=True)
     result = {
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "platform": "simulated-distributed CPU mesh (jax.distributed worker "
@@ -224,11 +313,12 @@ def main() -> None:
         "caveat": "multi-process scenarios oversubscribe this host's "
                   f"{os.cpu_count()} core(s); absolute throughputs reflect "
                   "CPU contention, not TPU behavior — the mechanism timings "
-                  "(detect, reshape, restore, compile-cache effect) are the "
+                  "(per-phase decomposition, warm-vs-cold deltas) are the "
                   "meaningful signal",
-        "preemption": preemption_scenario(),
+        "preemption": preemption_scenario(warm_start=True),
         "scale_up_cold_cache": scale_cold,
-        "scale_up_warm_cache": scale_warm,
+        "scale_up_warm_cache": scale_warm_cache,
+        "scale_up_warm_cache_warm_standby": scale_warm_full,
     }
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
