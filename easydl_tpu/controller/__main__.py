@@ -1,11 +1,17 @@
 """``python -m easydl_tpu.controller`` — run the elastic operator.
 
-Standalone mode: watches a directory for ElasticJob / JobResource YAML
-documents (the k8s-API-server stand-in; drop or update files to drive the
-job) and reconciles against the selected pod backend. ``--pod-api memory``
-logs decisions against the in-memory fake — useful to validate manifests and
-plans without a cluster; a real k8s PodApi plugs in behind the same
-interface (easydl_tpu/controller/pod_api.py).
+Two CR sources select where ElasticJob / JobResource documents come from:
+
+- ``--watch-dir DIR`` (standalone): watch a directory of YAML documents —
+  drop or update files to drive the job. Useful without a cluster.
+- ``--cr-source k8s`` (in-cluster): LIST/WATCH the CRs on the Kubernetes
+  API server (easydl_tpu/controller/kube_cr_source.py) — the reference's
+  deployment shape (docs/design/elastic-training-operator.md:16-18,53-55),
+  where ``kubectl apply`` of an ElasticJob is the only user action.
+
+Either way the same reconcile loop runs against the selected pod backend:
+``--pod-api memory`` logs decisions against the in-memory fake; ``k8s``
+drives real cluster pods over the REST API.
 """
 
 from __future__ import annotations
@@ -80,8 +86,12 @@ def ingest(store: CrStore, path: str, seen: dict, pending: set) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser(description="easydl_tpu elastic operator")
-    ap.add_argument("--watch-dir", required=True,
-                    help="directory of ElasticJob/JobResource YAMLs")
+    ap.add_argument("--cr-source", choices=["dir", "k8s"], default="dir",
+                    help="'dir' ingests CR YAMLs from --watch-dir; 'k8s' "
+                         "LIST/WATCHes them on the API server")
+    ap.add_argument("--watch-dir", default="",
+                    help="directory of ElasticJob/JobResource YAMLs "
+                         "(required with --cr-source dir)")
     ap.add_argument("--pod-api", choices=["memory", "k8s"], default="memory",
                     help="'k8s' reconciles real cluster pods over the k8s "
                          "REST API (in-cluster auth, or --kube-url)")
@@ -92,28 +102,48 @@ def main() -> None:
                     help="pod namespace (default: SA namespace or 'default')")
     ap.add_argument("--resync-s", type=float, default=2.0)
     args = ap.parse_args()
+    if args.cr_source == "dir" and not args.watch_dir:
+        ap.error("--watch-dir is required with --cr-source dir")
 
     store = CrStore()
+    kube_client = None
+    if args.pod_api == "k8s" or args.cr_source == "k8s":
+        from easydl_tpu.controller.kube_http import KubeClient
+
+        kube_client = KubeClient(base_url=args.kube_url,
+                                 namespace=args.namespace)
     if args.pod_api == "k8s":
         from easydl_tpu.controller.kube_pod_api import KubePodApi
 
-        pod_api = KubePodApi(base_url=args.kube_url, namespace=args.namespace)
+        pod_api = KubePodApi(client=kube_client)
     else:
         pod_api = InMemoryPodApi()
     ctl = ElasticJobController(store, pod_api)
     ctl.start(resync_s=args.resync_s)
-    log.info("operator watching %s (pod api: %s)", args.watch_dir, args.pod_api)
+    cr_source = None
+    if args.cr_source == "k8s":
+        from easydl_tpu.controller.kube_cr_source import KubeCrSource
+
+        cr_source = KubeCrSource(store, kube_client).start()
+        log.info("operator watching CRs on %s (pod api: %s)",
+                 kube_client.base_url, args.pod_api)
+    else:
+        log.info("operator watching %s (pod api: %s)",
+                 args.watch_dir, args.pod_api)
     seen: dict = {}
     pending: set = set()
     try:
         while True:
-            ingest(store, args.watch_dir, seen, pending)
+            if args.cr_source == "dir":
+                ingest(store, args.watch_dir, seen, pending)
             if args.pod_api == "memory":
                 pod_api.tick()  # the fake cluster needs a clock
             time.sleep(min(args.resync_s, 1.0))
     except KeyboardInterrupt:
         pass
     finally:
+        if cr_source is not None:
+            cr_source.stop()
         ctl.stop()
 
 

@@ -26,19 +26,20 @@ Implementation notes:
 from __future__ import annotations
 
 import json
-import ssl
-import urllib.error
 import urllib.parse
-import urllib.request
 from typing import Any, Dict, List, Optional
 
 from easydl_tpu.api.job_spec import ResourceSpec, TpuSpec
+from easydl_tpu.controller.kube_http import SA_DIR, KubeApiError, KubeClient
 from easydl_tpu.controller.pod_api import Pod, PodApi
 from easydl_tpu.utils.logging import get_logger
 
 log = get_logger("controller", "kubepods")
 
-SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+__all__ = [
+    "KubePodApi", "KubeApiError", "pod_to_manifest", "manifest_to_pod",
+    "SA_DIR", "GKE_TPU_ACCELERATOR",
+]
 
 #: accelerator family -> GKE gke-tpu-accelerator node-selector value
 GKE_TPU_ACCELERATOR = {
@@ -157,63 +158,19 @@ class KubePodApi(PodApi):
         token: Optional[str] = None,
         ca_file: Optional[str] = None,
         timeout: float = 10.0,
+        client: Optional[KubeClient] = None,
     ):
-        if not base_url:
-            # In-cluster defaults (the conventional env + SA mount).
-            import os
-
-            host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
-            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-            if not host:
-                raise ValueError(
-                    "base_url not given and KUBERNETES_SERVICE_HOST unset "
-                    "(not running in a cluster?)"
-                )
-            base_url = f"https://{host}:{port}"
-            if token is None:
-                try:
-                    with open(f"{SA_DIR}/token") as f:
-                        token = f.read().strip()
-                except OSError:
-                    token = None
-            if ca_file is None:
-                ca_file = f"{SA_DIR}/ca.crt"
-            if not namespace:
-                try:
-                    with open(f"{SA_DIR}/namespace") as f:
-                        namespace = f.read().strip()
-                except OSError:
-                    pass
-        self.base_url = base_url.rstrip("/")
-        self.namespace = namespace or "default"
-        self._token = token
-        self._timeout = timeout
-        self._ctx: Optional[ssl.SSLContext] = None
-        if self.base_url.startswith("https"):
-            self._ctx = ssl.create_default_context(
-                cafile=ca_file if ca_file else None
-            )
+        self._client = client or KubeClient(
+            base_url=base_url, namespace=namespace, token=token,
+            ca_file=ca_file, timeout=timeout,
+        )
+        self.base_url = self._client.base_url
+        self.namespace = self._client.namespace
 
     # ------------------------------------------------------------------ http
     def _request(self, method: str, path: str,
                  body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        url = self.base_url + path
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", "application/json")
-        if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
-        try:
-            with urllib.request.urlopen(
-                req, timeout=self._timeout, context=self._ctx
-            ) as resp:
-                payload = resp.read()
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:500]
-            raise KubeApiError(e.code, f"{method} {path}: {detail}") from e
-        return json.loads(payload) if payload else {}
+        return self._client.request(method, path, body)
 
     # ---------------------------------------------------------------- PodApi
     def create_pod(self, pod: Pod) -> None:
@@ -246,9 +203,3 @@ class KubePodApi(PodApi):
         doc = self._request("GET", path)
         pods = [manifest_to_pod(item) for item in doc.get("items", [])]
         return sorted(pods, key=lambda p: p.name)
-
-
-class KubeApiError(RuntimeError):
-    def __init__(self, code: int, message: str):
-        super().__init__(f"k8s API {code}: {message}")
-        self.code = code

@@ -1,0 +1,285 @@
+"""A fake Kubernetes API server for controller tests.
+
+Speaks the exact surface the operator uses, over real localhost HTTP:
+
+- pods: POST/GET(labelSelector)/DELETE on ``/api/v1/namespaces/{ns}/pods``
+  (kube_pod_api.py);
+- custom resources: CRUD + LIST + WATCH on
+  ``/apis/elastic.easydl.org/v1alpha1/namespaces/{ns}/{elasticjobs,
+  jobresources}`` (kube_cr_source.py), with per-write resourceVersions, the
+  chunked line-delimited watch stream, watch ``timeoutSeconds``, and
+  history compaction that produces the 410-Gone / ERROR-event resync path.
+
+Shared by test_kube_pod_api.py and test_kube_cr_source.py so the full
+controller loop — CRs in via the API server, pods out via the API server —
+runs against one consistent "cluster".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+CR_PREFIX = "/apis/elastic.easydl.org/v1alpha1/namespaces/"
+CR_PLURALS = ("elasticjobs", "jobresources")
+
+
+class FakeKubeApiServer:
+    """In-memory pod + CR store behind a real HTTP server."""
+
+    def __init__(self, max_watch_s: float = 10.0):
+        self.pods = {}  # name -> manifest dict
+        self.crs = {p: {} for p in CR_PLURALS}  # plural -> name -> doc
+        self.events = {p: [] for p in CR_PLURALS}  # plural -> [(rv, type, doc)]
+        self.rv = 0
+        self.compacted_below = 0  # watch rvs older than this get 410
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.auth_seen = []
+        self.watch_connects = {p: 0 for p in CR_PLURALS}
+        self.max_watch_s = max_watch_s
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            # ------------------------------------------------------ CR verbs
+            def _cr_parts(self):
+                # /apis/G/V/namespaces/{ns}/{plural}[/{name}]
+                rest = self.path[len(CR_PREFIX):]
+                parsed = urllib.parse.urlparse(rest)
+                parts = parsed.path.strip("/").split("/")
+                q = urllib.parse.parse_qs(parsed.query)
+                plural = parts[1] if len(parts) > 1 else ""
+                name = parts[2] if len(parts) > 2 else ""
+                return plural, name, q
+
+            def _cr_write(self, etype):
+                plural, name, _ = self._cr_parts()
+                if plural not in CR_PLURALS:
+                    self._send(404, {"reason": "NotFound"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n))
+                doc_name = doc.get("metadata", {}).get("name", name)
+                with store.cond:
+                    exists = doc_name in store.crs[plural]
+                    if etype == "ADDED" and exists:
+                        self._send(409, {"reason": "AlreadyExists"})
+                        return
+                    if etype == "MODIFIED" and not exists:
+                        self._send(404, {"reason": "NotFound"})
+                        return
+                    store.rv += 1
+                    doc.setdefault("metadata", {})["resourceVersion"] = str(
+                        store.rv
+                    )
+                    store.crs[plural][doc_name] = doc
+                    store.events[plural].append((store.rv, etype, doc))
+                    store.cond.notify_all()
+                self._send(201 if etype == "ADDED" else 200, doc)
+
+            def _cr_delete(self):
+                plural, name, _ = self._cr_parts()
+                with store.cond:
+                    doc = store.crs.get(plural, {}).pop(name, None)
+                    if doc is None:
+                        self._send(404, {"reason": "NotFound"})
+                        return
+                    store.rv += 1
+                    doc = dict(doc)
+                    doc.setdefault("metadata", {})["resourceVersion"] = str(
+                        store.rv
+                    )
+                    store.events[plural].append((store.rv, "DELETED", doc))
+                    store.cond.notify_all()
+                self._send(200, doc)
+
+            def _cr_get(self):
+                plural, name, q = self._cr_parts()
+                if plural not in CR_PLURALS:
+                    self._send(404, {"reason": "NotFound"})
+                    return
+                if q.get("watch", ["false"])[0] == "true":
+                    self._cr_watch(plural, q)
+                    return
+                with store.lock:
+                    if name:
+                        doc = store.crs[plural].get(name)
+                        if doc is None:
+                            self._send(404, {"reason": "NotFound"})
+                        else:
+                            self._send(200, doc)
+                        return
+                    items = sorted(
+                        store.crs[plural].values(),
+                        key=lambda d: d["metadata"]["name"],
+                    )
+                    rv = store.rv
+                self._send(200, {
+                    "kind": "List", "items": items,
+                    "metadata": {"resourceVersion": str(rv)},
+                })
+
+            def _cr_watch(self, plural, q):
+                rv_from = int(q.get("resourceVersion", ["0"])[0])
+                timeout_s = min(
+                    float(q.get("timeoutSeconds", ["10"])[0]),
+                    store.max_watch_s,
+                )
+                with store.lock:
+                    store.watch_connects[plural] += 1
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                # no Content-Length: body ends when the connection closes
+                self.end_headers()
+
+                def emit(etype, obj):
+                    line = json.dumps({"type": etype, "object": obj}) + "\n"
+                    self.wfile.write(line.encode())
+                    self.wfile.flush()
+
+                if rv_from and rv_from < store.compacted_below:
+                    # Expired rv: the ERROR-event form of 410 Gone.
+                    emit("ERROR", {
+                        "kind": "Status", "code": 410, "reason": "Expired",
+                    })
+                    return
+                deadline = time.monotonic() + timeout_s
+                last = rv_from
+                try:
+                    while time.monotonic() < deadline:
+                        with store.cond:
+                            evs = [e for e in store.events[plural]
+                                   if e[0] > last]
+                            if not evs:
+                                # clamp: a negative acquire timeout means
+                                # "infinite" to threading, not "immediate"
+                                store.cond.wait(timeout=max(0.0, min(
+                                    0.2, deadline - time.monotonic())))
+                                continue
+                        for rv, etype, doc in evs:
+                            emit(etype, doc)
+                            last = rv
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-stream
+
+            # ----------------------------------------------------- pod verbs
+            def do_POST(self):
+                store.auth_seen.append(self.headers.get("Authorization"))
+                if self.path.startswith(CR_PREFIX):
+                    self._cr_write("ADDED")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n))
+                name = doc["metadata"]["name"]
+                with store.lock:
+                    if name in store.pods:
+                        self._send(409, {"reason": "AlreadyExists"})
+                        return
+                    doc.setdefault("status", {})["phase"] = "Pending"
+                    store.pods[name] = doc
+                self._send(201, doc)
+
+            def do_PUT(self):
+                if self.path.startswith(CR_PREFIX):
+                    self._cr_write("MODIFIED")
+                    return
+                self._send(405, {"reason": "MethodNotAllowed"})
+
+            def do_GET(self):
+                if self.path.startswith(CR_PREFIX):
+                    self._cr_get()
+                    return
+                parsed = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(parsed.query)
+                selector = q.get("labelSelector", [""])[0]
+                want = None
+                if "=" in selector:
+                    k, v = selector.split("=", 1)
+                    want = (k, v)
+                with store.lock:
+                    items = []
+                    for doc in store.pods.values():
+                        labels = doc["metadata"].get("labels", {})
+                        if want is None or labels.get(want[0]) == want[1]:
+                            items.append(doc)
+                self._send(200, {"kind": "PodList", "items": items})
+
+            def do_DELETE(self):
+                if self.path.startswith(CR_PREFIX):
+                    self._cr_delete()
+                    return
+                name = self.path.rsplit("/", 1)[-1]
+                with store.lock:
+                    if name not in store.pods:
+                        self._send(404, {"reason": "NotFound"})
+                        return
+                    doc = store.pods.pop(name)
+                self._send(200, doc)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    # ---------------------------------------------------- test levers: pods
+    def set_phase(self, name: str, phase: str) -> None:
+        with self.lock:
+            self.pods[name]["status"]["phase"] = phase
+
+    def tick(self) -> None:
+        with self.lock:
+            for doc in self.pods.values():
+                if doc["status"]["phase"] == "Pending":
+                    doc["status"]["phase"] = "Running"
+
+    # ----------------------------------------------------- test levers: CRs
+    def put_cr(self, plural: str, doc: dict) -> None:
+        """Create-or-update a CR as kubectl apply would."""
+        name = doc["metadata"]["name"]
+        with self.cond:
+            etype = "MODIFIED" if name in self.crs[plural] else "ADDED"
+            self.rv += 1
+            doc = dict(doc)
+            doc.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            self.crs[plural][name] = doc
+            self.events[plural].append((self.rv, etype, doc))
+            self.cond.notify_all()
+
+    def delete_cr(self, plural: str, name: str) -> None:
+        with self.cond:
+            doc = self.crs[plural].pop(name)
+            self.rv += 1
+            self.events[plural].append((self.rv, "DELETED", doc))
+            self.cond.notify_all()
+
+    def compact(self) -> None:
+        """Drop watch history: older-rv watches now get an ERROR/410."""
+        with self.cond:
+            self.compacted_below = self.rv + 1
+            for p in CR_PLURALS:
+                self.events[p].clear()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

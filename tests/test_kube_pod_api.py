@@ -10,12 +10,8 @@ full controller loop — CRD store -> reconcile core -> KubePodApi -> "cluster"
 
 from __future__ import annotations
 
-import json
-import threading
-import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
 import pytest
+from fake_kube import FakeKubeApiServer
 
 from easydl_tpu.api.job_spec import JobSpec, ResourceSpec, RoleSpec, TpuSpec
 from easydl_tpu.api.resource_plan import ResourcePlan, ResourceUpdation, RolePlan
@@ -27,92 +23,6 @@ from easydl_tpu.controller.kube_pod_api import (
     pod_to_manifest,
 )
 from easydl_tpu.controller.pod_api import Pod
-
-
-class FakeKubeApiServer:
-    """In-memory pod store behind a real HTTP server (k8s pod API subset)."""
-
-    def __init__(self):
-        self.pods = {}  # name -> manifest dict
-        self.lock = threading.Lock()
-        self.auth_seen = []
-        store = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
-
-            def _send(self, code, doc):
-                body = json.dumps(doc).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_POST(self):
-                store.auth_seen.append(self.headers.get("Authorization"))
-                n = int(self.headers.get("Content-Length", 0))
-                doc = json.loads(self.rfile.read(n))
-                name = doc["metadata"]["name"]
-                with store.lock:
-                    if name in store.pods:
-                        self._send(409, {"reason": "AlreadyExists"})
-                        return
-                    doc.setdefault("status", {})["phase"] = "Pending"
-                    store.pods[name] = doc
-                self._send(201, doc)
-
-            def do_GET(self):
-                parsed = urllib.parse.urlparse(self.path)
-                q = urllib.parse.parse_qs(parsed.query)
-                selector = q.get("labelSelector", [""])[0]
-                want = None
-                if "=" in selector:
-                    k, v = selector.split("=", 1)
-                    want = (k, v)
-                with store.lock:
-                    items = []
-                    for doc in store.pods.values():
-                        labels = doc["metadata"].get("labels", {})
-                        if want is None or labels.get(want[0]) == want[1]:
-                            items.append(doc)
-                self._send(200, {"kind": "PodList", "items": items})
-
-            def do_DELETE(self):
-                name = self.path.rsplit("/", 1)[-1]
-                with store.lock:
-                    if name not in store.pods:
-                        self._send(404, {"reason": "NotFound"})
-                        return
-                    doc = store.pods.pop(name)
-                self._send(200, doc)
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
-        )
-        self._thread.start()
-
-    @property
-    def url(self) -> str:
-        host, port = self._httpd.server_address
-        return f"http://{host}:{port}"
-
-    # test levers, mirroring InMemoryPodApi
-    def set_phase(self, name: str, phase: str) -> None:
-        with self.lock:
-            self.pods[name]["status"]["phase"] = phase
-
-    def tick(self) -> None:
-        with self.lock:
-            for doc in self.pods.values():
-                if doc["status"]["phase"] == "Pending":
-                    doc["status"]["phase"] = "Running"
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
 
 
 @pytest.fixture
