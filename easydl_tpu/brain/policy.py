@@ -277,6 +277,52 @@ class Autoscaler:
 
         return cur
 
+    # ------------------------------------------------------------- durability
+    def to_state(self) -> Dict[str, object]:
+        """JSON-serializable snapshot of everything :meth:`restore_state`
+        needs to continue deciding as if the process never died: the per-size
+        windows, the bad-size memory, the pending marginal audit, and the
+        cooldown *as elapsed time* (the raw ``_last_decision_t`` is a
+        monotonic-clock reading, meaningless in a new process)."""
+        if self._last_decision_t > -1e17:
+            cooldown_elapsed = min(
+                max(self._clock() - self._last_decision_t, 0.0),
+                self.config.cooldown_s,
+            )
+        else:
+            cooldown_elapsed = None  # never decided: no cooldown in force
+        return {
+            "per_size": {
+                str(s): [round(x, 4) for x in st.samples]
+                for s, st in self._per_size.items()
+            },
+            "bad_sizes": sorted(self._bad_sizes),
+            "best_per_chip": self._best_per_chip,
+            "last_size": self._last_size,
+            "pending_check": (
+                list(self._pending_check) if self._pending_check else None
+            ),
+            "cooldown_elapsed_s": cooldown_elapsed,
+        }
+
+    def restore_state(self, doc: Dict[str, object]) -> None:
+        self._per_size = {}
+        for s, vals in (doc.get("per_size") or {}).items():
+            stats = _SizeStats()
+            for v in vals:
+                stats.add(float(v), self.config.window)
+            self._per_size[int(s)] = stats
+        self._bad_sizes = set(doc.get("bad_sizes") or [])
+        self._best_per_chip = float(doc.get("best_per_chip") or 0.0)
+        self._last_size = int(doc.get("last_size") or 0)
+        pending = doc.get("pending_check")
+        self._pending_check = tuple(pending) if pending else None
+        elapsed = doc.get("cooldown_elapsed_s")
+        if elapsed is None:
+            self._last_decision_t = -1e18
+        else:
+            self._last_decision_t = self._clock() - float(elapsed)
+
     # ------------------------------------------------------------------ status
     def status(self) -> Dict[str, object]:
         return {

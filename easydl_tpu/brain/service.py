@@ -10,6 +10,8 @@ GetPlan, and the runtime-performance input the reference implies
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Dict, Optional
@@ -41,19 +43,84 @@ class _JobState:
 
 
 class Brain:
-    """In-memory Brain: per-job autoscaler + latest plan, served over gRPC.
+    """Per-job autoscaler + latest plan, served over gRPC.
 
     Also usable fully in-process (no server) via :meth:`startup_plan_for`,
     :meth:`observe`, :meth:`current_plan` — the simulated-distributed tests
     and the benchmarks drive it both ways.
+
+    The reference makes Brain a long-lived service (README.md:13); pods get
+    replaced. With ``state_dir`` set, per-job state (latest plan incl. its
+    version, autoscaler windows/bad-sizes/cooldown) persists across restarts
+    — without it, a restarted Brain would restart plan versions at 1, the
+    master's stale-version gate (elastic/master.py) would reject every
+    replan, and autoscaling would silently stop for the rest of the job.
     """
 
-    def __init__(self, config: Optional[AutoscalerConfig] = None, clock=time.monotonic):
+    def __init__(self, config: Optional[AutoscalerConfig] = None,
+                 clock=time.monotonic, state_dir: Optional[str] = None):
         self._config = config or AutoscalerConfig()
         self._clock = clock
         self._jobs: Dict[str, _JobState] = {}
         self._lock = threading.Lock()
         self._server = None
+        self._state_dir = state_dir
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+            self._load_all()
+
+    # ------------------------------------------------------------- durability
+    def _job_path(self, name: str) -> str:
+        # Well-behaved job names are CRD metadata.names (DNS-1123), but the
+        # name arrives over the wire from any gRPC client — sanitize so a
+        # crafted name ('../../x') cannot write outside state_dir.
+        safe = "".join(
+            c if (c.isalnum() or c in "-._") else "_" for c in name
+        ) or "_"
+        return os.path.join(self._state_dir, f"brain-{safe}.json")
+
+    def _persist(self, name: str) -> None:
+        """Write one job's state; called with the lock held."""
+        if not self._state_dir:
+            return
+        st = self._jobs[name]
+        doc = {
+            "job": name,
+            "plan": st.plan.to_crd() if st.plan is not None else None,
+            "autoscaler": st.autoscaler.to_state(),
+        }
+        tmp = self._job_path(name) + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self._job_path(name))
+        except OSError as e:
+            log.warning("brain state persist failed for %r: %s", name, e)
+
+    def _load_all(self) -> None:
+        for fname in sorted(os.listdir(self._state_dir)):
+            if not (fname.startswith("brain-") and fname.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self._state_dir, fname)) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                log.warning("unreadable brain state %s: %s", fname, e)
+                continue
+            name = doc.get("job") or fname[len("brain-"):-len(".json")]
+            st = _JobState(Autoscaler(self._config, clock=self._clock))
+            if doc.get("plan") is not None:
+                try:
+                    st.plan = ResourcePlan.from_crd(doc["plan"])
+                except Exception as e:
+                    log.warning("bad persisted plan for %r: %s", name, e)
+            st.autoscaler.restore_state(doc.get("autoscaler") or {})
+            self._jobs[name] = st
+            log.info(
+                "restored brain state for %r: plan v%d, %d sizes observed",
+                name, st.plan.version if st.plan else 0,
+                len(doc.get("autoscaler", {}).get("per_size", {})),
+            )
 
     # ------------------------------------------------------------------ core
     def _job(self, name: str) -> _JobState:
@@ -73,32 +140,48 @@ class Brain:
                     features.job_name,
                     {r: rp.replicas for r, rp in st.plan.roles.items()},
                 )
+                self._persist(features.job_name)
             return st.plan
 
     def observe(self, m: pb.StepMetrics) -> None:
         with self._lock:
-            st = self._job(m.job_name)
-            st.autoscaler.observe(m)
-            st.last_metrics_t = self._clock()
-            if st.plan is None or m.world_size <= 0:
-                return
-            # The autoscaler reasons in CHIPS (StepMetrics.world_size — the
-            # "8→32 chips" north star); the plan is in WORKER replicas.
-            # Convert via the observed chips-per-worker ratio.
-            cur_workers = st.plan.replicas("worker")
-            if cur_workers <= 0:
-                return
-            chips_per_worker = max(1, round(m.world_size / cur_workers))
-            target_chips = st.autoscaler.decide(int(m.world_size))
-            target_workers = max(1, target_chips // chips_per_worker)
-            new = replan(st.plan, target_workers)
-            if new is not None:
-                log.info(
-                    "re-plan for %r: workers %d→%d (%d→%d chips, v%d)",
-                    m.job_name, cur_workers, target_workers,
-                    m.world_size, target_chips, new.version,
-                )
-                st.plan = new
+            try:
+                self._observe_locked(m)
+            finally:
+                # Persist after every observation, not just replans: the
+                # windows and cooldown are what a replacement Brain needs to
+                # keep *deciding* correctly, not merely serve the old plan.
+                self._persist(m.job_name)
+
+    def _observe_locked(self, m: pb.StepMetrics) -> None:
+        st = self._job(m.job_name)
+        st.autoscaler.observe(m)
+        st.last_metrics_t = self._clock()
+        if st.plan is None or m.world_size <= 0:
+            return
+        # The autoscaler reasons in CHIPS (StepMetrics.world_size — the
+        # "8→32 chips" north star); the plan is in WORKER replicas.
+        # Convert via the observed chips-per-worker ratio.
+        cur_workers = st.plan.replicas("worker")
+        if cur_workers <= 0:
+            return
+        chips_per_worker = max(1, round(m.world_size / cur_workers))
+        target_chips = st.autoscaler.decide(int(m.world_size))
+        if target_chips == int(m.world_size):
+            # Hold at the observed size. This is NOT a replan target: while a
+            # previous plan is still actuating (cluster at 8, plan at 16),
+            # writing "stay at 8" back into the plan would silently revert
+            # the pending scale-up every cooldown tick.
+            return
+        target_workers = max(1, target_chips // chips_per_worker)
+        new = replan(st.plan, target_workers)
+        if new is not None:
+            log.info(
+                "re-plan for %r: workers %d→%d (%d→%d chips, v%d)",
+                m.job_name, cur_workers, target_workers,
+                m.world_size, target_chips, new.version,
+            )
+            st.plan = new
 
     def current_plan(self, job_name: str, newer_than: int = 0) -> Optional[ResourcePlan]:
         with self._lock:
@@ -112,6 +195,7 @@ class Brain:
         docs/design/elastic-training-operator.md:50-55)."""
         with self._lock:
             self._job(plan.job_name).plan = plan
+            self._persist(plan.job_name)
 
     # ------------------------------------------------------------------ rpc
     def GetStartupPlan(self, req: pb.JobFeatures, ctx) -> pb.PlanResponse:
@@ -161,8 +245,15 @@ def main() -> None:  # pragma: no cover - CLI entry
     p = argparse.ArgumentParser(description="easydl_tpu Brain service")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--max-workers", type=int, default=32)
+    p.add_argument("--state-dir", default="",
+                   help="persist per-job plan/autoscaler state here so a "
+                        "replaced Brain pod resumes instead of resetting "
+                        "plan versions")
     args = p.parse_args()
-    brain = Brain(AutoscalerConfig(max_workers=args.max_workers)).start(args.port)
+    brain = Brain(
+        AutoscalerConfig(max_workers=args.max_workers),
+        state_dir=args.state_dir or None,
+    ).start(args.port)
     print(json.dumps({"address": brain.address}), flush=True)
     try:
         while True:

@@ -15,7 +15,7 @@ import queue
 import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from easydl_tpu.api.resource_plan import ResourcePlan
 from easydl_tpu.elastic.membership import Directive, JobPhase, Rendezvous
@@ -137,7 +137,11 @@ class Master:
                 "restored master state: plan v%d, generation %d, %d events",
                 self.plan_version, self.rendezvous.generation, len(self.events),
             )
-        self._last_metrics: Dict[str, pb.StepMetrics] = {}
+        #: agent -> (generation at receipt, StepMetrics)
+        self._last_metrics: Dict[str, Tuple[int, pb.StepMetrics]] = {}
+        # dedupe: one Brain report per (generation, step)
+        self._last_reported_gen = -1
+        self._last_reported_step = -1
         self._metrics_q: "queue.Queue" = queue.Queue(maxsize=4)
         self._reporter_thread: Optional[threading.Thread] = None
         if worker_config is not None:
@@ -242,8 +246,16 @@ class Master:
         from easydl_tpu.brain.service import BRAIN_SERVICE  # local import: optional dep
 
         client = RpcClient(BRAIN_SERVICE, self.brain_address)
+        built_for = self.brain_address
         while not self._stop.is_set():
             try:
+                # A replaced Brain pod can come back at a new address
+                # (brain_address is updated by whoever tracks the pod);
+                # rebuild the client instead of polling a dead endpoint.
+                if self.brain_address != built_for:
+                    client.close()
+                    client = RpcClient(BRAIN_SERVICE, self.brain_address)
+                    built_for = self.brain_address
                 resp = client.GetPlan(
                     pb.PlanRequest(job_name=self.job_name, current_version=self.plan_version)
                 )
@@ -257,32 +269,96 @@ class Master:
 
     # ------------------------------------------------------------------ misc
     def _record_metrics(self, agent_id: str, m: pb.StepMetrics) -> None:
-        self._last_metrics[agent_id] = m
-        if self.brain_address and agent_id == (self.rendezvous.members[0] if self.rendezvous.members else None):
-            # Latest-wins queue drained by one reporter thread: a slow Brain
-            # drops stale samples instead of piling up threads/connections.
+        # Keyed by the generation at receipt: aggregation must only mix
+        # records from the CURRENT world — a hung member's stale record
+        # (old world_size, old step) would otherwise poison the aggregate
+        # (pin world_size after a scale-down, suppress the step gate).
+        gen = self.rendezvous.generation
+        self._last_metrics[agent_id] = (gen, m)
+        if not self.brain_address:
+            return
+        agg = self._aggregate_metrics()
+        if agg is None:
+            return
+        # One aggregate per training step, not one per member heartbeat: the
+        # members' reports for a step are near-identical (each carries the
+        # global rate), and forwarding all of them would hand the autoscaler
+        # world_size duplicated samples per step — its min_samples gate
+        # would fill from one step's data. The gate resets per generation:
+        # a restore can legitimately replay earlier step numbers.
+        if gen == self._last_reported_gen and agg.step <= self._last_reported_step:
+            return
+        self._last_reported_gen = gen
+        self._last_reported_step = agg.step
+        # Latest-wins queue drained by one reporter thread: a slow Brain
+        # drops stale samples instead of piling up threads/connections.
+        try:
+            self._metrics_q.put_nowait(agg)
+        except queue.Full:
             try:
-                self._metrics_q.put_nowait(m)
+                self._metrics_q.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self._metrics_q.put_nowait(agg)
             except queue.Full:
-                try:
-                    self._metrics_q.get_nowait()
-                except queue.Empty:
-                    pass
-                try:
-                    self._metrics_q.put_nowait(m)
-                except queue.Full:
-                    pass
+                pass
+
+    def _aggregate_metrics(self) -> Optional[pb.StepMetrics]:
+        """Median of the live members' latest reports.
+
+        Every rank reports the *global* samples/sec of its world, so the
+        members' values agree in steady state — but forwarding one fixed
+        member's stream (the r2 design) blinds the autoscaler whenever that
+        member hangs or lags. The median over current members tolerates
+        stragglers and silent ranks alike; world_size is taken as the max
+        (a lagging rank may still be reporting the previous world).
+        """
+        members = set(self.rendezvous.members)
+        if not members:
+            return None
+        gen = self.rendezvous.generation
+        recent = [
+            m for k, (g, m) in self._last_metrics.items()
+            if k in members and g == gen
+        ]
+        if not recent:
+            return None
+        # The member with the median rate supplies the whole record, so the
+        # reported (rate, step_time, loss) triple is one coherent
+        # observation — not a mix of a fresh rate with a straggler's
+        # hours-old loss.
+        by_rate = sorted(recent, key=lambda v: v.samples_per_sec)
+        median = by_rate[len(by_rate) // 2]
+        agg = pb.StepMetrics(
+            job_name=self.job_name,
+            step=max(v.step for v in recent),
+            step_time_s=median.step_time_s,
+            samples_per_sec=median.samples_per_sec,
+            world_size=max(v.world_size for v in recent),
+            loss=median.loss,
+        )
+        return agg
 
     def _reporter_loop(self) -> None:
         from easydl_tpu.brain.service import BRAIN_SERVICE
 
         client = RpcClient(BRAIN_SERVICE, self.brain_address, timeout=5.0)
+        built_for = self.brain_address
         while not self._stop.is_set():
             try:
                 m = self._metrics_q.get(timeout=0.5)
             except queue.Empty:
                 continue
             try:
+                # Follow a replaced Brain to its new address (same contract
+                # as _brain_loop) — otherwise the replacement never receives
+                # a single observation and autoscaling silently stops.
+                if self.brain_address != built_for:
+                    client.close()
+                    client = RpcClient(BRAIN_SERVICE, self.brain_address,
+                                       timeout=5.0)
+                    built_for = self.brain_address
                 m.job_name = self.job_name
                 client.ReportMetrics(m)
             except Exception as e:
@@ -319,7 +395,7 @@ class Master:
                     "samples_per_sec": round(m.samples_per_sec, 2),
                     "loss": round(m.loss, 4),
                 }
-                for aid, m in self._last_metrics.items()
+                for aid, (_, m) in self._last_metrics.items()
             }
         s["plan_version"] = self.plan_version
         s["job"] = self.job_name
