@@ -294,6 +294,19 @@ class Agent:
 
     def _spawn_warm(self) -> None:
         """Start the next standby: jax imports now, membership comes later."""
+        if self._warm is not None:
+            # Replacing a dead/unused standby: close its log fd (the tuple
+            # is about to be overwritten — one leaked fd per generation
+            # otherwise) and make sure the process is gone.
+            proc, _, log_file = self._warm
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            try:
+                log_file.close()
+            except OSError:
+                pass
+            self._warm = None
         self._warm_count += 1
         warm_file = os.path.join(
             self.workdir, f".warm-{self.agent_id}-{self._warm_count}.json"
@@ -460,7 +473,18 @@ def main() -> None:  # pragma: no cover - CLI entry
         warm_start=args.warm_start,
     )
     signal.signal(signal.SIGTERM, lambda *_: agent.notify_preemption())
-    agent.run()
+    # Two preemption channels: SIGTERM (k8s eviction) above, and the GCE
+    # metadata server's maintenance/preempted notice (Cloud TPU VMs get this
+    # earlier than the SIGTERM) — auto-enabled only when a metadata server
+    # actually answers.
+    from easydl_tpu.elastic.gce_metadata import maybe_start_watcher
+
+    watcher = maybe_start_watcher(lambda reason: agent.notify_preemption())
+    try:
+        agent.run()
+    finally:
+        if watcher is not None:
+            watcher.stop()
 
 
 if __name__ == "__main__":

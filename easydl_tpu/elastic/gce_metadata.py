@@ -1,0 +1,154 @@
+"""GCE metadata-server maintenance/preemption watcher.
+
+SIGTERM covers k8s eviction, but Cloud TPU VMs get an *earlier* warning
+through the instance metadata server: the ``maintenance-event`` value flips
+from ``NONE`` before the host is migrated/terminated, and preemptible/spot
+VMs flip ``preempted`` to ``TRUE`` at the start of the ~30s grace window.
+The reference's elasticity story leans on reacting to exactly this class of
+notice (SURVEY.md §5.3/§7.3; /root/reference/README.md:25-29); watching the
+metadata server converts "the host vanished mid-step" (restore from last
+checkpoint, lose the window) into "drain at the next step boundary" (lose
+nothing).
+
+Protocol: hanging GET with ``?wait_for_change=true&timeout_sec=N`` and the
+mandatory ``Metadata-Flavor: Google`` header — the server long-polls and
+responds when the value changes (or the timeout elapses, returning the
+current value; we re-poll). stdlib-only, one daemon thread, fires the
+callback once. Tests point ``base_url`` at a local fake metadata server
+(tests/test_gce_metadata.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("elastic", "gce")
+
+DEFAULT_BASE_URL = "http://metadata.google.internal"
+_MAINT_PATH = (
+    "/computeMetadata/v1/instance/maintenance-event"
+    "?wait_for_change=true&timeout_sec={timeout}"
+)
+_PREEMPT_PATH = (
+    "/computeMetadata/v1/instance/preempted"
+    "?wait_for_change=true&timeout_sec={timeout}"
+)
+#: maintenance-event values that mean nothing is happening; the watcher
+#: fires on anything NOT in this tuple (MIGRATE/TERMINATE_ON_HOST_MAINTENANCE)
+_BENIGN = ("", "NONE")
+
+
+class GceMaintenanceWatcher:
+    """Fires ``on_notice(reason)`` once when the metadata server announces a
+    maintenance event or preemption.
+
+    ``available()`` probes for a metadata server first so non-GCE
+    deployments (tests, on-prem, other clouds) skip the watcher entirely
+    rather than log connection errors forever.
+    """
+
+    def __init__(
+        self,
+        on_notice: Callable[[str], None],
+        base_url: str = DEFAULT_BASE_URL,
+        wait_timeout_s: int = 60,
+        retry_s: float = 5.0,
+    ):
+        self.on_notice = on_notice
+        self.base_url = base_url.rstrip("/")
+        self.wait_timeout_s = wait_timeout_s
+        self.retry_s = retry_s
+        self._stop = threading.Event()
+        self._fired = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------------ http
+    def _get(self, path: str, timeout: float) -> str:
+        req = urllib.request.Request(
+            self.base_url + path, headers={"Metadata-Flavor": "Google"}
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode(errors="replace").strip()
+
+    def available(self, probe_timeout: float = 1.0) -> bool:
+        """True when a metadata server answers (i.e. we're on GCE)."""
+        try:
+            self._get("/computeMetadata/v1/instance/", probe_timeout)
+            return True
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    # ------------------------------------------------------------------ loops
+    def _fire(self, reason: str) -> None:
+        if not self._fired.is_set():
+            self._fired.set()
+            log.warning("GCE notice: %s — signalling preemption", reason)
+            try:
+                self.on_notice(reason)
+            except Exception:
+                log.exception("preemption callback failed")
+
+    def _watch(self, path_tpl: str, is_notice: Callable[[str], bool],
+               label: str) -> None:
+        path = path_tpl.format(timeout=self.wait_timeout_s)
+        while not (self._stop.is_set() or self._fired.is_set()):
+            try:
+                value = self._get(path, self.wait_timeout_s + 15.0)
+            except (urllib.error.URLError, OSError) as e:
+                # metadata server unreachable: back off and retry — the VM
+                # may be under the very disruption we're watching for
+                log.debug("%s poll failed: %s", label, e)
+                self._stop.wait(self.retry_s)
+                continue
+            if is_notice(value):
+                self._fire(f"{label}={value}")
+                return
+            # benign value (NONE / FALSE): the hanging GET timed out or the
+            # event cleared; immediately re-poll
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "GceMaintenanceWatcher":
+        for path_tpl, is_notice, label in (
+            (_MAINT_PATH, lambda v: v.upper() not in _BENIGN,
+             "maintenance-event"),
+            (_PREEMPT_PATH, lambda v: v.upper() == "TRUE", "preempted"),
+        ):
+            t = threading.Thread(
+                target=self._watch, args=(path_tpl, is_notice, label),
+                daemon=True, name=f"gce-{label}",
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    @property
+    def fired(self) -> bool:
+        return self._fired.is_set()
+
+
+def maybe_start_watcher(
+    on_notice: Callable[[str], None],
+    base_url: Optional[str] = None,
+) -> Optional[GceMaintenanceWatcher]:
+    """Start a watcher if a metadata server is reachable; None otherwise.
+
+    ``base_url`` override (or the EASYDL_GCE_METADATA_URL env var) exists
+    for tests and for metadata proxies.
+    """
+    import os
+
+    url = base_url or os.environ.get("EASYDL_GCE_METADATA_URL") \
+        or DEFAULT_BASE_URL
+    w = GceMaintenanceWatcher(on_notice, base_url=url)
+    if not w.available():
+        log.info("no GCE metadata server at %s; watcher disabled", url)
+        return None
+    return w.start()
