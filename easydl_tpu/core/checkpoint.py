@@ -18,19 +18,22 @@ Mechanics:
 - **save**: every process writes the chunks for its addressable, replica-0
   shards (`jax.Array.addressable_shards`), so write bandwidth scales with
   hosts and nothing is gathered. Host copies are snapshotted synchronously
-  (donation-safe), file IO runs on a background thread.
+  (donation-safe), chunk IO runs on a background thread.
 - **restore**: ``jax.make_array_from_callback`` asks for exactly the slices
   the *new* sharding places on local devices; the reader assembles them from
-  whichever chunks overlap (memory-mapped), so an 8→32 or 32→8 reshard reads
-  only what each host needs.
+  whichever chunks overlap, so an 8→32 or 32→8 reshard reads only what each
+  host needs (memory-mapped on POSIX).
+- **storage**: chunk IO is pluggable (core/storage.py). POSIX backends
+  commit by renaming per-process tmp dirs into the step dir (atomic rename);
+  object stores (``gs://``) write chunks directly to their final keys —
+  atomic puts — and commit is marker-after-all-puts, ordered by a collective
+  barrier. The ``directory`` argument is a URL; plain paths mean POSIX.
 """
 
 from __future__ import annotations
 
 import json
-import os
 import re
-import shutil
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -38,6 +41,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from easydl_tpu.core.storage import CheckpointStorage, get_storage
 from easydl_tpu.utils.logging import get_logger
 
 log = get_logger("core", "checkpoint")
@@ -78,20 +82,36 @@ def _parse_chunk_name(name: str) -> Optional[List[Tuple[int, int]]]:
 class _LeafReader:
     """Assembles arbitrary slices of one leaf from its saved chunks."""
 
-    def __init__(self, leaf_dir: str, shape: Tuple[int, ...], dtype: np.dtype):
+    def __init__(self, storage: CheckpointStorage, leaf_dir: str,
+                 shape: Tuple[int, ...], dtype: np.dtype):
+        self.storage = storage
         self.shape = shape
         self.dtype = dtype
         self._chunks: List[Tuple[List[Tuple[int, int]], str]] = []
-        for name in os.listdir(leaf_dir):
+        # make_array_from_callback calls read() once per local device; on
+        # object stores each uncached load_array is a full HTTP download, so
+        # overlapping device slices would re-fetch the same chunk per device.
+        # The reader lives only for one leaf's restore — the cache is small
+        # and short-lived. (POSIX load_array returns an mmap: caching it
+        # just keeps the fd.)
+        self._loaded: Dict[str, np.ndarray] = {}
+        for name in storage.listdir(leaf_dir):
             bounds = _parse_chunk_name(name)
             if bounds is not None:
-                self._chunks.append((bounds, os.path.join(leaf_dir, name)))
+                self._chunks.append((bounds, f"{leaf_dir}/{name}"))
         if not self._chunks:
             raise FileNotFoundError(f"no chunks in {leaf_dir}")
 
+    def _load(self, path: str) -> np.ndarray:
+        arr = self._loaded.get(path)
+        if arr is None:
+            arr = self.storage.load_array(path)
+            self._loaded[path] = arr
+        return arr
+
     def read(self, index: Tuple[slice, ...]) -> np.ndarray:
         if not self.shape:
-            return np.load(self._chunks[0][1])
+            return self._load(self._chunks[0][1])
         want = [
             (0 if sl.start is None else sl.start, dim if sl.stop is None else sl.stop)
             for sl, dim in zip(index, self.shape)
@@ -106,7 +126,7 @@ class _LeafReader:
             ]
             if any(a >= b for a, b in inter):
                 continue
-            data = np.load(path, mmap_mode="r")
+            data = self._load(path)
             src = tuple(
                 slice(a - ca, b - ca) for (a, b), (ca, cb) in zip(inter, bounds)
             )
@@ -124,21 +144,27 @@ class _LeafReader:
 
 
 class CheckpointManager:
-    """Save/restore sharded pytrees, keeping the last ``keep`` committed steps."""
+    """Save/restore sharded pytrees, keeping the last ``keep`` committed steps.
 
-    def __init__(self, directory: str, keep: int = 3, async_save: bool = True):
+    ``directory`` is a URL: a plain path (or ``file://``) selects the POSIX
+    backend; ``gs://bucket/prefix`` the object-store backend.
+    """
+
+    def __init__(self, directory: str, keep: int = 3, async_save: bool = True,
+                 storage: Optional[CheckpointStorage] = None):
         self.directory = directory
         self.keep = keep
         self.async_save = async_save
+        self.storage = storage if storage is not None else get_storage(directory)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         # Multi-process async saves split in two: chunk IO runs on a
-        # background thread (local files only, no collectives), while the
-        # commit — whose barriers are collectives and must run on the main
-        # thread — is deferred until :meth:`finalize` (or :meth:`wait`) is
-        # called from the training loop at a later step boundary.
+        # background thread (no collectives), while the commit — whose
+        # barriers are collectives and must run on the main thread — is
+        # deferred until :meth:`finalize` (or :meth:`wait`) is called from
+        # the training loop at a later step boundary.
         self._pending_commit = None
-        os.makedirs(directory, exist_ok=True)
+        self.storage.makedirs("")
 
     # ------------------------------------------------------------------ save
     def save(self, step: int, state: Any, metadata: Optional[Dict[str, Any]] = None) -> None:
@@ -148,11 +174,12 @@ class CheckpointManager:
         multi-process runs an async save defers its commit barrier: call
         :meth:`finalize` each step (all ranks together) to complete it."""
         self.wait()
+        storage = self.storage
         multiproc = jax.process_count() > 1
         # Skip if already committed (e.g. quiesce landing on a periodic-save
-        # step). The decision must be COLLECTIVE: with per-process FS views
-        # (GCS/NFS lag) some ranks could skip while others enter the save's
-        # barriers and hang — so process 0's verdict is broadcast to all.
+        # step). The decision must be COLLECTIVE: with per-process storage
+        # views (GCS/NFS lag) some ranks could skip while others enter the
+        # save's barriers and hang — so process 0's verdict is broadcast.
         skip = step in self.steps()
         if multiproc:
             from jax.experimental import multihost_utils
@@ -183,18 +210,31 @@ class CheckpointManager:
                 )
 
         t0 = time.perf_counter()
-        step_dir = os.path.join(self.directory, f"step_{step:08d}")
-        tmp_dir = step_dir + f".tmp.{jax.process_index()}"
+        step_dir = f"step_{step:08d}"
+        # POSIX: stage in a per-process tmp dir, commit by rename.
+        # Object store: write straight to the final keys (puts are atomic and
+        # restore gates on the marker) — but then debris from an aborted save
+        # at this step must be cleared BEFORE any rank writes, not at commit.
+        direct = not storage.atomic_rename
+        write_dir = step_dir if direct else step_dir + f".tmp.{jax.process_index()}"
+        if direct:
+            if jax.process_index() == 0 and self._uncommitted_debris(step_dir):
+                log.warning("clearing aborted save at %s", step_dir)
+                storage.delete_tree(step_dir)
+            if multiproc:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"easydl_ckpt_clean_{step}")
 
         def write_chunks():
-            # LOCAL file IO only — safe on a background thread.
-            # Our own tmp dir may hold chunks from a save that crashed mid-way
-            # (possibly under a different sharding); the commit loop moves
-            # every file in it, so start from a clean slate. Per-process dir —
-            # a local decision, no barrier needed.
-            if os.path.exists(tmp_dir):
-                shutil.rmtree(tmp_dir, ignore_errors=True)
-            os.makedirs(tmp_dir, exist_ok=True)
+            # Chunk IO only (no collectives) — safe on a background thread.
+            if not direct:
+                # Our own tmp dir may hold chunks from a save that crashed
+                # mid-way (possibly under a different sharding); the commit
+                # loop moves every file in it, so start from a clean slate.
+                # Per-process dir — a local decision, no barrier needed.
+                storage.delete_tree(write_dir)
+                storage.makedirs(write_dir)
             manifest = {
                 "step": step,
                 "metadata": metadata or {},
@@ -204,59 +244,63 @@ class CheckpointManager:
                 ],
             }
             for i, key, shape, dtype, chunks in snapshot:
-                leaf_dir = os.path.join(tmp_dir, f"leaf_{i:05d}")
-                os.makedirs(leaf_dir, exist_ok=True)
+                leaf_dir = f"{write_dir}/leaf_{i:05d}"
+                storage.makedirs(leaf_dir)
                 for index, data in chunks:
-                    np.save(os.path.join(leaf_dir, _chunk_name(index, shape)), data)
+                    storage.save_array(
+                        f"{leaf_dir}/{_chunk_name(index, shape)}", data
+                    )
             if jax.process_index() == 0:
-                with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
-                    json.dump(manifest, f)
+                storage.write_bytes(
+                    f"{write_dir}/manifest.json", json.dumps(manifest).encode()
+                )
 
         def commit():
             # Contains the collective barriers — must run on the MAIN thread
             # in multi-process runs (via finalize()/wait() or the sync path).
-            # A step_dir without COMMITTED is debris from an aborted save (we
-            # may be retraining through the same step after a restore): clear
-            # it so stale chunks can't mix into — or block — this commit.
-            # Process 0 decides and clears; the barrier is UNCONDITIONAL in
-            # multi-process runs so every rank enters the same collectives
-            # regardless of its local FS view.
-            if jax.process_index() == 0 and (
-                os.path.exists(step_dir)
-                and not os.path.exists(os.path.join(step_dir, _COMMITTED))
-            ):
-                log.warning("clearing aborted save at %s", step_dir)
-                shutil.rmtree(step_dir, ignore_errors=True)
-            if multiproc:
-                from jax.experimental import multihost_utils
+            if not direct:
+                # A step_dir without COMMITTED is debris from an aborted save
+                # (we may be retraining through the same step after a
+                # restore): clear it so stale chunks can't mix into — or
+                # block — this commit. Process 0 decides and clears; the
+                # barrier is UNCONDITIONAL in multi-process runs so every
+                # rank enters the same collectives regardless of its local
+                # FS view.
+                if jax.process_index() == 0 and self._uncommitted_debris(step_dir):
+                    log.warning("clearing aborted save at %s", step_dir)
+                    storage.delete_tree(step_dir)
+                if multiproc:
+                    from jax.experimental import multihost_utils
 
-                multihost_utils.sync_global_devices(f"easydl_ckpt_clean_{step}")
-            # Single-host commit: rename tmp → final, then COMMITTED marker.
-            # (Multi-host: every process renames its own tmp dir contents in;
-            # process 0 writes the marker after a barrier — see note below.)
-            if jax.process_count() == 1:
-                os.replace(tmp_dir, step_dir)
-            else:
-                os.makedirs(step_dir, exist_ok=True)
-                for name in os.listdir(tmp_dir):
-                    src, dst = os.path.join(tmp_dir, name), os.path.join(step_dir, name)
-                    if os.path.isdir(src):
-                        os.makedirs(dst, exist_ok=True)
-                        for chunk in os.listdir(src):
-                            os.replace(os.path.join(src, chunk), os.path.join(dst, chunk))
-                    else:
-                        os.replace(src, dst)
-                shutil.rmtree(tmp_dir, ignore_errors=True)
+                    multihost_utils.sync_global_devices(
+                        f"easydl_ckpt_clean_{step}"
+                    )
+                # Single-host commit: rename tmp → final. Multi-host: every
+                # process renames its own tmp dir contents in.
+                if jax.process_count() == 1:
+                    storage.rename(write_dir, step_dir)
+                else:
+                    storage.makedirs(step_dir)
+                    for name in storage.listdir(write_dir):
+                        src, dst = f"{write_dir}/{name}", f"{step_dir}/{name}"
+                        if storage.isdir(src):
+                            storage.makedirs(dst)
+                            for chunk in storage.listdir(src):
+                                storage.rename(f"{src}/{chunk}", f"{dst}/{chunk}")
+                        else:
+                            storage.rename(src, dst)
+                    storage.delete_tree(write_dir)
             if multiproc:
-                # Every process has renamed its chunks in; only then may the
-                # marker appear (restore treats COMMITTED as "all shards on disk").
+                # Every process has written/renamed its chunks in; only then
+                # may the marker appear (restore treats COMMITTED as "all
+                # shards present").
                 from jax.experimental import multihost_utils
 
                 multihost_utils.sync_global_devices(f"easydl_ckpt_{step}")
             if jax.process_index() == 0:
-                with open(os.path.join(step_dir, _COMMITTED), "w") as f:
-                    f.write(str(step))
-            log.info("saved step %d in %.2fs -> %s", step, time.perf_counter() - t0, step_dir)
+                storage.write_bytes(f"{step_dir}/{_COMMITTED}", str(step).encode())
+            log.info("saved step %d in %.2fs -> %s/%s",
+                     step, time.perf_counter() - t0, self.directory, step_dir)
             self._gc()
 
         if self.async_save:
@@ -279,6 +323,12 @@ class CheckpointManager:
             write_chunks()
             commit()
 
+    def _uncommitted_debris(self, step_dir: str) -> bool:
+        return (
+            bool(self.storage.listdir(step_dir))
+            and not self.storage.exists(f"{step_dir}/{_COMMITTED}")
+        )
+
     def finalize(self, block: bool = False) -> bool:
         """Complete a pending deferred commit, running its collective
         barriers on the caller's (main) thread.
@@ -296,7 +346,7 @@ class CheckpointManager:
         if self._pending_commit is None:
             return True
         # Reap the IO thread if finished (or block for it): joining is safe
-        # here — the thread does local file IO only, no collectives.
+        # here — the thread does chunk IO only, no collectives.
         if self._thread is not None and (block or not self._thread.is_alive()):
             self._thread.join()
             self._thread = None
@@ -344,13 +394,9 @@ class CheckpointManager:
     # ---------------------------------------------------------------- restore
     def steps(self) -> List[int]:
         out = []
-        try:
-            names = os.listdir(self.directory)
-        except FileNotFoundError:
-            return []
-        for name in names:
+        for name in self.storage.listdir(""):
             m = _STEP_RE.match(name)
-            if m and os.path.exists(os.path.join(self.directory, name, _COMMITTED)):
+            if m and self.storage.exists(f"{name}/{_COMMITTED}"):
                 out.append(int(m.group(1)))
         return sorted(out)
 
@@ -359,8 +405,9 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def metadata(self, step: int) -> Dict[str, Any]:
-        with open(os.path.join(self.directory, f"step_{step:08d}", "manifest.json")) as f:
-            return json.load(f)
+        return json.loads(
+            self.storage.read_bytes(f"step_{step:08d}/manifest.json")
+        )
 
     def restore(
         self,
@@ -371,7 +418,7 @@ class CheckpointManager:
         """Rebuild ``abstract_state``'s tree with arrays sharded per
         ``shardings`` — which may describe a completely different mesh than
         the one that saved. Leaf matching is by tree-path key."""
-        step_dir = os.path.join(self.directory, f"step_{step:08d}")
+        step_dir = f"step_{step:08d}"
         manifest = self.metadata(step)
         by_key = {leaf["key"]: leaf for leaf in manifest["leaves"]}
 
@@ -396,7 +443,8 @@ class CheckpointManager:
                 )
             dtype = np.dtype(rec["dtype"])
             reader = _LeafReader(
-                os.path.join(step_dir, f"leaf_{rec['index']:05d}"), saved_shape, dtype
+                self.storage, f"{step_dir}/leaf_{rec['index']:05d}",
+                saved_shape, dtype,
             )
             arr = jax.make_array_from_callback(
                 want_shape, sharding_, lambda idx, r=reader: r.read(idx)
@@ -412,6 +460,8 @@ class CheckpointManager:
             return
         steps = self.steps()
         for old in steps[: -self.keep] if self.keep > 0 else []:
-            shutil.rmtree(
-                os.path.join(self.directory, f"step_{old:08d}"), ignore_errors=True
-            )
+            step_dir = f"step_{old:08d}"
+            # Marker first: a half-deleted step must read as uncommitted,
+            # not as a committed step with missing chunks.
+            self.storage.delete_tree(f"{step_dir}/{_COMMITTED}")
+            self.storage.delete_tree(step_dir)

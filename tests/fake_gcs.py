@@ -1,0 +1,144 @@
+"""A fake GCS JSON-API server: the object-store surface GcsStorage uses.
+
+Endpoints (the storage.googleapis.com JSON API subset):
+- POST /upload/storage/v1/b/{bucket}/o?uploadType=media&name=K  — media put
+- GET  /storage/v1/b/{bucket}/o/{K}?alt=media                   — media get
+- GET  /storage/v1/b/{bucket}/o/{K}                             — stat
+- GET  /storage/v1/b/{bucket}/o?prefix=&delimiter=&pageToken=   — list
+- DELETE /storage/v1/b/{bucket}/o/{K}
+
+Flat key namespace (real object-store semantics: no directories, no rename),
+pagination via ``page_size`` to exercise the client's paging loop.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeGcsServer:
+    def __init__(self, page_size: int = 1000):
+        self.objects = {}  # (bucket, key) -> bytes
+        self.lock = threading.Lock()
+        self.page_size = page_size
+        self.requests = []  # (method, path) log
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body=b"", ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _parts(self):
+                parsed = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(parsed.query)
+                segs = parsed.path.strip("/").split("/")
+                return parsed.path, segs, q
+
+            def do_POST(self):
+                path, segs, q = self._parts()
+                store.requests.append(("POST", self.path))
+                # /upload/storage/v1/b/{bucket}/o
+                if segs[:3] == ["upload", "storage", "v1"] and segs[3] == "b":
+                    bucket = segs[4]
+                    name = q.get("name", [""])[0]
+                    n = int(self.headers.get("Content-Length", 0))
+                    data = self.rfile.read(n)
+                    with store.lock:
+                        store.objects[(bucket, name)] = data
+                    self._send(200, json.dumps(
+                        {"name": name, "size": str(len(data))}
+                    ).encode())
+                    return
+                self._send(404)
+
+            def do_GET(self):
+                path, segs, q = self._parts()
+                store.requests.append(("GET", self.path))
+                # /storage/v1/b/{bucket}/o[/{key}]
+                if segs[:2] != ["storage", "v1"] or segs[2] != "b":
+                    self._send(404)
+                    return
+                bucket = segs[3]
+                if len(segs) == 5 and segs[4] == "o":
+                    self._list(bucket, q)
+                    return
+                key = urllib.parse.unquote(segs[5])
+                with store.lock:
+                    data = store.objects.get((bucket, key))
+                if data is None:
+                    self._send(404, b'{"error": {"code": 404}}')
+                elif q.get("alt", [""])[0] == "media":
+                    self._send(200, data, "application/octet-stream")
+                else:
+                    self._send(200, json.dumps(
+                        {"name": key, "size": str(len(data))}
+                    ).encode())
+
+            def _list(self, bucket, q):
+                prefix = q.get("prefix", [""])[0]
+                delimiter = q.get("delimiter", [""])[0]
+                page = int(q.get("pageToken", ["0"])[0] or 0)
+                with store.lock:
+                    keys = sorted(
+                        k for b, k in store.objects if b == bucket
+                        and k.startswith(prefix)
+                    )
+                items, prefixes = [], set()
+                for k in keys:
+                    rest = k[len(prefix):]
+                    if delimiter and delimiter in rest:
+                        prefixes.add(
+                            prefix + rest.split(delimiter, 1)[0] + delimiter
+                        )
+                    else:
+                        items.append(k)
+                # paginate the flat item list (prefixes ride every page for
+                # simplicity — the client de-dups via set semantics)
+                start = page * store.page_size
+                chunk = items[start:start + store.page_size]
+                doc = {
+                    "items": [{"name": k} for k in chunk],
+                    "prefixes": sorted(prefixes),
+                }
+                if start + store.page_size < len(items):
+                    doc["nextPageToken"] = str(page + 1)
+                self._send(200, json.dumps(doc).encode())
+
+            def do_DELETE(self):
+                path, segs, q = self._parts()
+                store.requests.append(("DELETE", self.path))
+                bucket = segs[3]
+                key = urllib.parse.unquote(segs[5])
+                with store.lock:
+                    existed = store.objects.pop((bucket, key), None)
+                if existed is None:
+                    self._send(404, b'{"error": {"code": 404}}')
+                else:
+                    self._send(204)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def keys(self, bucket="b"):
+        with self.lock:
+            return sorted(k for bk, k in self.objects if bk == bucket)
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

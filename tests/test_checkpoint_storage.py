@@ -1,0 +1,205 @@
+"""Pluggable checkpoint chunk IO (SURVEY §5.4; VERDICT r2 missing item 4):
+the same CheckpointManager protocol against both backends — POSIX
+(tmp-dir + atomic rename) and object store (direct puts + marker-after-all-
+puts, no rename anywhere), the latter against a fake GCS JSON-API server."""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+import pytest
+from fake_gcs import FakeGcsServer
+
+from easydl_tpu.core import MeshSpec, Trainer, TrainConfig, build_mesh
+from easydl_tpu.core.checkpoint import CheckpointManager
+from easydl_tpu.core.storage import (
+    GcsStorage,
+    PosixStorage,
+    get_storage,
+)
+from easydl_tpu.models import get_model
+
+
+@pytest.fixture
+def gcs():
+    srv = FakeGcsServer(page_size=3)  # tiny pages: exercise the paging loop
+    yield srv
+    srv.stop()
+
+
+def backends(tmp_path, gcs):
+    return {
+        "posix": PosixStorage(str(tmp_path / "posix")),
+        "gcs": GcsStorage("b", "ckpt", base_url=gcs.url),
+    }
+
+
+# ------------------------------------------------------------------- storage
+
+def test_storage_semantics_both_backends(tmp_path, gcs):
+    for name, st in backends(tmp_path, gcs).items():
+        st.makedirs("")
+        st.write_bytes("a/x.bin", b"hello")
+        st.write_bytes("a/b/y.bin", b"world")
+        assert st.read_bytes("a/x.bin") == b"hello", name
+        assert st.exists("a/x.bin"), name
+        assert st.exists("a"), name
+        assert not st.exists("a/z.bin"), name
+        assert st.listdir("a") == ["b", "x.bin"], name
+        assert st.listdir("nope") == [], name
+        arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+        st.save_array("a/arr.npy", arr)
+        np.testing.assert_array_equal(np.asarray(st.load_array("a/arr.npy")),
+                                      arr)
+        # delete a single file, then a whole tree
+        st.delete_tree("a/x.bin")
+        assert not st.exists("a/x.bin"), name
+        st.delete_tree("a")
+        assert st.listdir("a") == [], name
+
+
+def test_gcs_listdir_paginates(gcs):
+    st = GcsStorage("b", "p", base_url=gcs.url)
+    names = [f"f{i:02d}.bin" for i in range(10)]  # > page_size=3
+    for n in names:
+        st.write_bytes(f"d/{n}", b"x")
+    assert st.listdir("d") == names
+
+
+def test_get_storage_registry(tmp_path, gcs, monkeypatch):
+    assert isinstance(get_storage(str(tmp_path)), PosixStorage)
+    assert isinstance(get_storage(f"file://{tmp_path}"), PosixStorage)
+    monkeypatch.setenv("EASYDL_GCS_ENDPOINT", gcs.url)
+    st = get_storage("gs://bucket/some/prefix")
+    assert isinstance(st, GcsStorage)
+    assert st.bucket == "bucket" and st.prefix == "some/prefix"
+    assert st.base_url == gcs.url
+
+
+# -------------------------------------------------------------- checkpointing
+
+def make_trainer(spec):
+    bundle = get_model("mlp", input_shape=(8, 8, 1), features=(32, 32))
+    return (
+        Trainer(
+            init_fn=bundle.init_fn,
+            loss_fn=bundle.loss_fn,
+            optimizer=optax.adam(1e-2),
+            config=TrainConfig(global_batch=32),
+            mesh=build_mesh(spec),
+        ),
+        bundle,
+    )
+
+
+def test_save_restore_reshard_on_object_store(gcs, eight_devices, monkeypatch):
+    """The headline path on the no-rename backend: save on dp=8, restore on
+    fsdp=4×tp=2, training continues."""
+    monkeypatch.setenv("EASYDL_GCS_ENDPOINT", gcs.url)
+    t1, bundle = make_trainer(MeshSpec(dp=8))
+    s1 = t1.init_state()
+    batch = next(iter(bundle.make_data(32, seed=7)))
+    s1, _ = t1.train_step(s1, batch)
+
+    mgr = CheckpointManager("gs://b/jobs/j1/ckpt", async_save=False)
+    mgr.save(1, s1)
+    assert mgr.latest_step() == 1
+    # no rename ever happened: chunks live at their final keys, and nothing
+    # tmp-ish exists on the server
+    assert not [k for k in gcs.keys() if ".tmp" in k]
+    assert "jobs/j1/ckpt/step_00000001/COMMITTED" in gcs.keys()
+
+    t2, _ = make_trainer(MeshSpec(fsdp=4, tp=2))
+    abstract, _, _ = t2._abstract_state()
+    s2 = mgr.restore(1, abstract, t2.state_shardings())
+    import jax
+
+    from easydl_tpu.core.sharding import unbox
+
+    for a, b in zip(jax.tree.leaves(unbox(s1.params)),
+                    jax.tree.leaves(unbox(s2.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    s2, m2 = t2.train_step(s2, batch)
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_async_save_retention_on_object_store(gcs, eight_devices, monkeypatch):
+    monkeypatch.setenv("EASYDL_GCS_ENDPOINT", gcs.url)
+    t1, _ = make_trainer(MeshSpec(dp=8))
+    s1 = t1.init_state()
+    mgr = CheckpointManager("gs://b/r/ckpt", keep=2, async_save=True)
+    for step in (1, 2, 3):
+        mgr.save(step, s1)
+    mgr.wait()
+    assert mgr.steps() == [2, 3]
+    # gc removed step 1 entirely, marker included
+    assert not [k for k in gcs.keys() if "step_00000001" in k]
+
+
+def test_uncommitted_debris_cleared_on_object_store(gcs, eight_devices,
+                                                    monkeypatch):
+    """An aborted save leaves chunks at final keys with no marker; the next
+    save of the same step must clear them BEFORE writing (stale differently-
+    sharded chunks may not be overwritten by name)."""
+    monkeypatch.setenv("EASYDL_GCS_ENDPOINT", gcs.url)
+    st = GcsStorage("b", "d/ckpt", base_url=gcs.url)
+    st.write_bytes("step_00000002/leaf_00000/stale-0-7.npy", b"junk")
+    t1, _ = make_trainer(MeshSpec(dp=8))
+    s1 = t1.init_state()
+    mgr = CheckpointManager("gs://b/d/ckpt", async_save=False)
+    assert mgr.steps() == []  # no marker -> invisible
+    mgr.save(2, s1)
+    assert mgr.steps() == [2]
+    assert not [k for k in gcs.keys() if "stale" in k]
+
+
+def test_multiprocess_deferred_commit_on_object_store(
+    gcs, eight_devices, monkeypatch
+):
+    """Simulated 2-process run on the no-rename backend: chunk IO goes
+    straight to final keys, the marker appears only after the post-IO
+    barrier, and a failed peer aborts the commit on every rank (tri-state),
+    mirroring tests/test_checkpoint.py::test_finalize_drops_commit."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setenv("EASYDL_GCS_ENDPOINT", gcs.url)
+    t1, _ = make_trainer(MeshSpec(dp=8))
+    s1 = t1.init_state()
+    mgr = CheckpointManager("gs://b/mp/ckpt", async_save=True)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    other_rank_state = [2]  # tri-state: peer failed
+    barriers = []
+    monkeypatch.setattr(
+        multihost_utils, "broadcast_one_to_all",
+        lambda x, is_source=None: np.asarray(x),
+    )
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda x: np.stack(
+            [np.asarray(x), np.full_like(np.asarray(x), other_rank_state[0])]
+        ),
+    )
+    monkeypatch.setattr(
+        multihost_utils, "sync_global_devices",
+        lambda name: barriers.append(name),
+    )
+
+    mgr.save(7, s1)
+    assert mgr._pending_commit is not None
+    with pytest.raises(RuntimeError, match="failed on another process"):
+        mgr.finalize(block=True)
+    assert mgr._pending_commit is None
+    assert mgr.steps() == []  # chunks may exist, but no marker -> invisible
+    # only the pre-write clean barrier ran; the commit barrier never did
+    assert all("clean" in b for b in barriers)
+
+    # healthy peer: commit completes, marker after the commit barrier
+    other_rank_state[0] = 1
+    barriers.clear()
+    mgr.save(8, s1)
+    assert mgr.finalize(block=True)
+    assert mgr.steps() == [8]
+    assert any(b == "easydl_ckpt_8" for b in barriers)
+    assert "mp/ckpt/step_00000008/COMMITTED" in gcs.keys()
