@@ -55,18 +55,24 @@ def main() -> None:
     platform = jax.default_backend()
     n_chips = jax.device_count()
     if platform == "tpu":
-        # Config from scripts/bench_sweep.py evidence (v5e, r2):
-        #   f32 dots b8        27.6 samples/s/chip
-        #   bf16 dots b8       37.9  (bf16 activations: the big lever)
-        #   bf16 dots b64/a8   39.9  (accumulation amortises optimizer+dispatch)
-        #   bf16 dots b128/a16 40.1
-        # microbatch >8/chip OOMs at compile (f32 logits buffer); flash
-        # blocks 512/512 beat 256/1024 variants.
+        # Config from scripts/bench_sweep.py evidence (v5e):
+        #   r2: f32 dots b8 27.6 | bf16 dots b8 37.9 | b64/a8 39.9
+        #   r3 (re-measured): plain b64/a8 39.85 | plain b128/a16 40.13 |
+        #       plain b256/a32 40.26
+        #   r3 fused chunked LM loss (ops/fused_xent.py): removes the
+        #       [B,S,V] f32 logits buffer, so microbatch >8 now COMPILES —
+        #       but measured SLOWER here (fused b64/a8 38.2, fused mb16
+        #       37.3): the per-chunk remat recompute costs ~4% and v5e gains
+        #       nothing from mb16 at this size. It stays opt-in for
+        #       long-context/large-vocab regimes where the logits buffer
+        #       binds. no-remat variants are untestable on this tunnel
+        #       (remote_compile helper 500s).
         size, seq_len, steps = "345m", 1024, 15
-        grad_accum = 8
-        global_batch = 64 * n_chips
+        grad_accum = 16
+        global_batch = 128 * n_chips
         bundle = get_model("gpt", size=size, seq_len=seq_len, remat=True,
-                           remat_policy="dots", dtype="bfloat16")
+                           remat_policy="dots", dtype="bfloat16",
+                           fused_loss=False)
     else:  # CPU smoke mode: tiny model, same code path
         size, seq_len, global_batch, steps = "test", 128, 8, 5
         grad_accum = 1

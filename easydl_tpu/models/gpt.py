@@ -55,6 +55,8 @@ def make_gpt(
     moe_k: int = 2,
     moe_aux_weight: float = 0.01,
     moe_capacity_factor: float = 1.25,
+    fused_loss: bool = False,
+    loss_chunk: int = 128,
 ) -> ModelBundle:
     n_layers, d_model, n_heads = SIZES[size]
     cfg = TransformerConfig(
@@ -82,28 +84,62 @@ def make_gpt(
         tokens = jnp.zeros((1, seq_len), jnp.int32)
         return model.init(rng, tokens)["params"]
 
+    def _lm_loss_from(params, batch, mutable=False):
+        """LM loss via the fused chunked head (default) or full logits.
+
+        The fused path asks the stack for hidden states and applies the tied
+        head chunk-by-chunk (ops/fused_xent.py) — the full [B,S,V] f32
+        logits buffer never exists, which is what caps the microbatch (and
+        MFU) on the logits path (bench.py r2 evidence).
+        """
+        mut = None
+        if fused_loss and cfg.tied_head:
+            from easydl_tpu.ops.fused_xent import fused_softmax_xent
+
+            out = model.apply(
+                {"params": params}, batch["inputs"], return_hidden=True,
+                **({"mutable": ["intermediates"]} if mutable else {}),
+            )
+            hidden = out[0] if mutable else out
+            mut = out[1] if mutable else None
+            head = params["tok_emb"]["embedding"]
+            if hasattr(head, "unbox"):  # boxed (LogicallyPartitioned) params
+                head = head.unbox()
+            # Cast the stored-f32 param to the compute dtype — exactly what
+            # tok_emb.attend's dtype promotion does on the logits path. A
+            # bf16×f32 dot_general promotes to an f32 matmul, which would
+            # take the [B,chunk,V] matmul off the bf16 MXU path.
+            head = jnp.asarray(head, dtype=hidden.dtype)
+            loss, _ = fused_softmax_xent(
+                hidden, head, batch["targets"], chunk_size=loss_chunk
+            )
+        else:
+            out = model.apply(
+                {"params": params}, batch["inputs"],
+                **({"mutable": ["intermediates"]} if mutable else {}),
+            )
+            logits = out[0] if mutable else out
+            mut = out[1] if mutable else None
+            loss, _ = lm_loss(logits, batch["targets"])
+        return loss, mut
+
     def loss_fn(params, batch, rng):
         if moe_experts:
-            logits, mut = model.apply(
-                {"params": params}, batch["inputs"], mutable=["intermediates"]
-            )
+            loss, mut = _lm_loss_from(params, batch, mutable=True)
             aux = jnp.sum(
                 jnp.asarray(mut["intermediates"]["moe_aux_loss"][0])
             )
-            loss, _ = lm_loss(logits, batch["targets"])
             return loss + moe_aux_weight * aux, {
                 "perplexity": jnp.exp(loss),
                 "moe_balance": aux / max(n_layers, 1),
             }
-        logits = model.apply({"params": params}, batch["inputs"])
-        loss, _ = lm_loss(logits, batch["targets"])
+        loss, _ = _lm_loss_from(params, batch)
         return loss, {"perplexity": jnp.exp(loss)}
 
     def eval_fn(params, batch, rng):
         # Pure LM loss — no balance regularizer, so eval is comparable
         # across dense/MoE configs and aux weights.
-        logits = model.apply({"params": params}, batch["inputs"])
-        loss, _ = lm_loss(logits, batch["targets"])
+        loss, _ = _lm_loss_from(params, batch)
         return loss, {"perplexity": jnp.exp(loss)}
 
     def make_data(global_batch: int, seed: int = 0):

@@ -206,12 +206,20 @@ class Block(nn.Module):
 
 
 class Transformer(nn.Module):
-    """Token-in, logits-out decoder/encoder stack."""
+    """Token-in, logits-out decoder/encoder stack.
+
+    ``return_hidden=True`` skips the head matmul and yields the post-LN
+    hidden states ``[B, S, D]`` instead of logits — the input contract of
+    the chunked fused LM loss (ops/fused_xent.py), which applies the (tied)
+    head chunk-by-chunk so the full ``[B, S, V]`` f32 logits tensor never
+    exists.
+    """
 
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, deterministic: bool = True):
+    def __call__(self, tokens, *, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
         tok_emb = nn.Embed(
@@ -261,6 +269,8 @@ class Transformer(nn.Module):
         self.sow("intermediates", "moe_aux_loss", jnp.sum(layer_aux))
 
         x = _layernorm("ln_f", dtype=dt)(x)
+        if return_hidden:
+            return x
         if cfg.tied_head:
             logits = tok_emb.attend(x)
         else:

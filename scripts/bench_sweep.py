@@ -25,21 +25,33 @@ def main() -> None:
     from easydl_tpu.models.registry import get_model
 
     n_chips = jax.device_count()
+    bf16_dots = dict(remat=True, remat_policy="dots", dtype="bfloat16")
+    # r2 sweep (kept for the record): f32 b8 27.6 / bf16 b8 37.9 / bf16
+    # no-remat and mb>8 OOMed on the f32 logits buffer; b64/a8 39.9,
+    # b128/a16 40.1. r3 removes the logits buffer (fused chunked LM loss),
+    # so this sweep explores the unlocked microbatch/chunk frontier.
     configs = [
-        # (label, model kwargs, global_batch)
-        ("f32 remat-dots b8", dict(remat=True, remat_policy="dots"), 8),
-        ("bf16 remat-dots b8", dict(remat=True, remat_policy="dots",
-                                    dtype="bfloat16"), 8),
-        ("bf16 no-remat b8", dict(dtype="bfloat16"), 8),
-        ("bf16 no-remat b16", dict(dtype="bfloat16"), 16),
-        ("bf16 remat-dots b16", dict(remat=True, remat_policy="dots",
-                                     dtype="bfloat16"), 16),
-        ("bf16 remat-dots b32", dict(remat=True, remat_policy="dots",
-                                     dtype="bfloat16"), 32),
-        ("bf16 no-remat b8 ref-attn", dict(dtype="bfloat16",
-                                           attention_impl="reference"), 8),
+        # (label, model kwargs, per-chip batch, grad_accum)
+        ("plain  b64/a8  mb8 (r2 best)",
+         dict(fused_loss=False, **bf16_dots), 64, 8),
+        ("fused c128 b64/a8  mb8",
+         dict(fused_loss=True, loss_chunk=128, **bf16_dots), 64, 8),
+        ("fused c128 b128/a16 mb8",
+         dict(fused_loss=True, loss_chunk=128, **bf16_dots), 128, 16),
+        ("fused c128 b128/a8  mb16",
+         dict(fused_loss=True, loss_chunk=128, **bf16_dots), 128, 8),
+        ("fused c256 b128/a8  mb16",
+         dict(fused_loss=True, loss_chunk=256, **bf16_dots), 128, 8),
+        ("fused c512 b128/a8  mb16",
+         dict(fused_loss=True, loss_chunk=512, **bf16_dots), 128, 8),
+        ("fused c128 b256/a8  mb32",
+         dict(fused_loss=True, loss_chunk=128, **bf16_dots), 256, 8),
+        ("fused c128 b128/a4  mb32",
+         dict(fused_loss=True, loss_chunk=128, **bf16_dots), 128, 4),
+        ("fused c128 no-remat b128/a8 mb16",
+         dict(fused_loss=True, loss_chunk=128, dtype="bfloat16"), 128, 8),
     ]
-    for label, kwargs, per_chip_batch in configs:
+    for label, kwargs, per_chip_batch, grad_accum in configs:
         global_batch = per_chip_batch * n_chips
         try:
             bundle = get_model("gpt", size="345m", seq_len=args.seq, **kwargs)
@@ -47,7 +59,8 @@ def main() -> None:
                 init_fn=bundle.init_fn,
                 loss_fn=bundle.loss_fn,
                 optimizer=optax.adamw(2e-4, weight_decay=0.01),
-                config=TrainConfig(global_batch=global_batch),
+                config=TrainConfig(global_batch=global_batch,
+                                   grad_accum=grad_accum),
                 mesh_spec=MeshSpec(dp=n_chips),
             )
             state = trainer.init_state()
