@@ -81,9 +81,33 @@ def pod_to_manifest(pod: Pod, namespace: str) -> Dict[str, Any]:
     container: Dict[str, Any] = {
         "name": pod.role.replace("_", "-"),
         "image": pod.image or "python:3.11-slim",
+        # identity env, mirroring the process backend's EASYDL_POD_* exports
+        "env": [
+            {"name": "EASYDL_POD_NAME", "value": pod.name},
+            {"name": "EASYDL_POD_ROLE", "value": pod.role},
+            {"name": "EASYDL_JOB", "value": pod.job},
+            {"name": "EASYDL_REPLACES", "value": pod.replaces or ""},
+        ],
     }
     if pod.command:
-        container["command"] = ["/bin/sh", "-c", pod.command]
+        cmd = pod.command
+        for token, value in (("{name}", pod.name), ("{role}", pod.role),
+                             ("{job}", pod.job)):
+            cmd = cmd.replace(token, value)
+        if "{ready_file}" in cmd:
+            # Readiness-gated command (the process backend's {ready_file}
+            # convention): emit a real readinessProbe so replace-then-retire
+            # orders the old pod's retirement after the handoff on k8s too —
+            # without a probe, kubelet reports Ready at container start and
+            # the drain window would race the retirement.
+            ready_path = "/tmp/easydl-ready"
+            cmd = cmd.replace("{ready_file}", ready_path)
+            container["readinessProbe"] = {
+                "exec": {"command": ["cat", ready_path]},
+                "initialDelaySeconds": 1,
+                "periodSeconds": 2,
+            }
+        container["command"] = ["/bin/sh", "-c", cmd]
     if requests or limits:
         container["resources"] = {}
         if requests:
@@ -133,6 +157,14 @@ def manifest_to_pod(doc: Dict[str, Any]) -> Pod:
     # models that window as Terminating (replace-then-retire relies on it).
     if meta.get("deletionTimestamp") and phase in ("Pending", "Running"):
         phase = "Terminating"
+    # Running-but-not-Ready reads as Pending: replace-then-retire must not
+    # retire the old pod while its replacement's readiness probe (e.g. the
+    # PS handoff) is still failing.
+    if phase == "Running":
+        for cond in status.get("conditions") or []:
+            if cond.get("type") == "Ready" and cond.get("status") != "True":
+                phase = "Pending"
+                break
     spec = doc.get("spec", {}) or {}
     containers = spec.get("containers") or [{}]
     command = containers[0].get("command") or []

@@ -11,7 +11,12 @@ trainer process → Brain → JobResource → worker processes.
 Phases map to process state: Pending until first :meth:`poll` sees the
 process alive, Running while it lives, Succeeded/Failed by exit code,
 deletion is SIGTERM → (grace) → SIGKILL. Command templates may reference
-``{name} {role} {job} {workdir}``.
+``{name} {role} {job} {workdir}`` and ``{ready_file}`` — a command that
+uses the latter opts into readiness gating (the k8s readiness-probe
+equivalent): the pod stays Pending until the process touches that file.
+Replace-then-retire keys on the replacement reaching Running, so a pod
+whose startup includes a data handoff (PS drain/restore) uses the ready
+file to order its predecessor's retirement strictly after the handoff.
 """
 
 from __future__ import annotations
@@ -31,10 +36,12 @@ log = get_logger("controller", "procpods")
 
 
 class _Proc:
-    def __init__(self, pod: Pod, proc: subprocess.Popen, log_path: str):
+    def __init__(self, pod: Pod, proc: subprocess.Popen, log_path: str,
+                 ready_file: Optional[str] = None):
         self.pod = pod
         self.proc = proc
         self.log_path = log_path
+        self.ready_file = ready_file
         self.term_sent_at: Optional[float] = None
 
 
@@ -59,9 +66,19 @@ class LocalProcessPodApi(PodApi):
             # literal braces in commands, e.g. JSON model-args); quote the
             # workdir so paths with spaces survive shlex.split.
             cmd = pod.command
+            ready_file: Optional[str] = None
+            if "{ready_file}" in cmd:
+                ready_file = os.path.join(
+                    self.workdir, f".ready-{pod.name}"
+                )
+                try:  # names are never reused, but be safe on reruns
+                    os.remove(ready_file)
+                except FileNotFoundError:
+                    pass
             for token, value in (
                 ("{name}", pod.name), ("{role}", pod.role), ("{job}", pod.job),
                 ("{workdir}", shlex.quote(self.workdir)),
+                ("{ready_file}", shlex.quote(ready_file or "")),
             ):
                 cmd = cmd.replace(token, value)
             log_path = os.path.join(self.workdir, "pod-logs", f"{pod.name}.log")
@@ -72,6 +89,7 @@ class LocalProcessPodApi(PodApi):
                 EASYDL_POD_ROLE=pod.role,
                 EASYDL_JOB=pod.job,
                 EASYDL_WORKDIR=self.workdir,
+                EASYDL_REPLACES=pod.replaces or "",
             )
             with open(log_path, "ab") as logf:
                 proc = subprocess.Popen(
@@ -79,7 +97,7 @@ class LocalProcessPodApi(PodApi):
                     stdout=logf, stderr=subprocess.STDOUT,
                     env=env, start_new_session=True,  # own pgid: clean kill
                 )
-            self._procs[pod.name] = _Proc(pod, proc, log_path)
+            self._procs[pod.name] = _Proc(pod, proc, log_path, ready_file)
             log.info("launched pod %s (%s): pid=%d", pod.name, pod.role, proc.pid)
 
     def delete_pod(self, name: str) -> None:
@@ -123,7 +141,10 @@ class LocalProcessPodApi(PodApi):
                             except ProcessLookupError:
                                 pass
                     elif e.pod.phase == "Pending":
-                        e.pod.phase = "Running"
+                        # readiness-gated pods stay Pending until their
+                        # ready file appears (startup handoff complete)
+                        if e.ready_file is None or os.path.exists(e.ready_file):
+                            e.pod.phase = "Running"
                 elif e.term_sent_at is not None:
                     del self._procs[name]  # deletion completed
                 else:

@@ -1,0 +1,142 @@
+"""``python -m easydl_tpu.ps`` — the parameter-server pod entrypoint.
+
+This is what the operator actually launches for the ``parameter_server``
+role, and the piece that turns the operator's generic replace-then-retire
+into the reference's zero-lost-updates vertical scaling
+(docs/design/elastic-training-operator.md:86-101):
+
+- **fresh pod** (initial creation): shard index = the trailing index of the
+  pod name (``job-parameter_server-3`` → shard 3), serve, publish to the
+  registry, then touch the ready file.
+- **replacement pod** (``resource_updation`` → the operator created it with
+  ``replaces=<old>``): inherit the OLD pod's shard index from the registry,
+  then run the handoff — Drain the old pod (its pushes gate + rows save),
+  Restore those rows here, publish (clients reroute on their next retried
+  push), and only THEN touch the ready file. The operator retires the old
+  pod when the replacement looks Running-and-ready, so retirement is
+  ordered strictly after the handoff — the window in which an acked update
+  could be lost never exists.
+
+The pod name / replaces / workdir arrive via argv or the EASYDL_POD_*
+environment the pod backend exports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+from easydl_tpu.ps import registry
+from easydl_tpu.ps.server import PS_SERVICE, PsShard
+from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.rpc import RpcClient
+
+log = get_logger("ps", "main")
+
+
+def shard_index_from_name(name: str) -> int:
+    tail = name.rsplit("-", 1)[-1]
+    if not tail.isdigit():
+        raise SystemExit(
+            f"cannot derive shard index from pod name {name!r}; "
+            "pass --shard-index"
+        )
+    return int(tail)
+
+
+def wait_registry_entry(workdir: str, pod: str, wait_s: float = 60.0) -> dict:
+    deadline = time.monotonic() + wait_s
+    doc = registry.entry_for_pod(workdir, pod)
+    while doc is None and time.monotonic() < deadline:
+        time.sleep(0.2)
+        doc = registry.entry_for_pod(workdir, pod)
+    if doc is None:
+        raise SystemExit(
+            f"replaces={pod!r} but it never published to the registry"
+        )
+    return doc
+
+
+def run_handoff(old: dict, workdir: str, shard: PsShard) -> None:
+    """Drain the predecessor into a handoff dir, restore its rows here."""
+    old_pod = old["pod"]
+    handoff_dir = os.path.join(workdir, "ps-handoff", old_pod)
+    client = RpcClient(PS_SERVICE, old["address"], timeout=120.0)
+    try:
+        from easydl_tpu.proto import easydl_pb2 as pb
+
+        ack = client.Drain(pb.PsSaveRequest(directory=handoff_dir, step=0))
+        if not ack.ok:
+            raise SystemExit(f"drain of {old_pod} failed: {ack.message}")
+    finally:
+        client.close()
+    shard.restore(handoff_dir, step=0)
+    log.info("handoff from %s complete: shard %d restored from %s",
+             old_pod, shard.shard_index, handoff_dir)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="easydl_tpu PS pod")
+    ap.add_argument("--name", default=os.environ.get("EASYDL_POD_NAME", ""))
+    ap.add_argument("--workdir", default=os.environ.get("EASYDL_WORKDIR", ""))
+    ap.add_argument("--num-shards", type=int, required=True)
+    ap.add_argument("--shard-index", type=int, default=-1,
+                    help="default: trailing index of the pod name (fresh "
+                         "pods) or inherited from the replaced pod")
+    ap.add_argument("--replaces",
+                    default=os.environ.get("EASYDL_REPLACES", ""))
+    ap.add_argument("--ready-file", default="",
+                    help="touched once serving (and any handoff) is "
+                         "complete — the pod backend's readiness gate")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    if not args.name or not args.workdir:
+        ap.error("--name and --workdir (or EASYDL_POD_NAME/EASYDL_WORKDIR) "
+                 "are required")
+
+    old = None
+    if args.replaces:
+        # The shard identity is inherited from the pod being replaced — the
+        # operator names replacements with a fresh trailing index, so the
+        # name is NOT the shard.
+        old = wait_registry_entry(args.workdir, args.replaces)
+        index, num_shards = int(old["shard"]), int(old["num_shards"])
+    else:
+        index = (args.shard_index if args.shard_index >= 0
+                 else shard_index_from_name(args.name))
+        num_shards = args.num_shards
+    shard = PsShard(shard_index=index, num_shards=num_shards)
+    server = shard.serve(port=args.port)
+    log.info("ps pod %s serving shard %d/%d on %s",
+             args.name, shard.shard_index, num_shards, server.address)
+
+    if old is not None:
+        run_handoff(old, args.workdir, shard)
+
+    registry.publish(args.workdir, args.name, shard.shard_index,
+                     num_shards, server.address)
+    if args.ready_file:
+        with open(args.ready_file, "w") as f:
+            f.write(server.address)
+
+    stop = {"flag": False}
+
+    def on_term(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    log.info("ps pod %s exiting", args.name)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -187,13 +187,56 @@ class ShardedPsClient(_PsClientBase):
     it at the replacement, so no update is lost across the handoff."""
 
     def __init__(self, addresses: Sequence[str], timeout: float = 60.0,
-                 drain_retry_s: float = 60.0):
+                 drain_retry_s: float = 60.0,
+                 registry_workdir: Optional[str] = None):
         self.addresses = list(addresses)
         self.num_shards = len(self.addresses)
         self.drain_retry_s = drain_retry_s
+        # With a registry (ps/registry.py), a gated/unreachable shard is
+        # re-resolved from the latest publications mid-retry — the client
+        # follows operator-driven replacements without anyone calling
+        # reroute() explicitly.
+        self.registry_workdir = registry_workdir
+        self._registry_checked_at = 0.0
         self._clients = [
             RpcClient(PS_SERVICE, a, timeout=timeout) for a in self.addresses
         ]
+
+    @classmethod
+    def from_registry(cls, workdir: str, num_shards: int,
+                      wait_s: float = 60.0, **kwargs) -> "ShardedPsClient":
+        """Resolve shard addresses from the pod registry (operator-managed
+        PS clusters publish there; see easydl_tpu/ps/__main__.py)."""
+        from easydl_tpu.ps import registry
+
+        addrs = registry.addresses(workdir, num_shards, timeout=wait_s)
+        return cls(addrs, registry_workdir=workdir, **kwargs)
+
+    def _maybe_reroute_from_registry(self, shard: int) -> bool:
+        if not self.registry_workdir:
+            return False
+        # Throttle: the retry loops call this every ~50ms for the whole
+        # drain window; scanning/parsing the registry dir (often network FS)
+        # that often is pure waste — publications are seconds apart.
+        now = time.monotonic()
+        if now - self._registry_checked_at < 0.5:
+            return False
+        self._registry_checked_at = now
+        from easydl_tpu.ps import registry
+
+        entry = registry.shard_map(self.registry_workdir).get(shard)
+        if entry and entry["address"] != self.addresses[shard]:
+            try:
+                self.reroute(shard, entry["address"])
+            except Exception as e:
+                # The published replacement may itself be gone (double
+                # preemption): treat as "no reroute yet" and keep retrying
+                # the drain window — a newer publication will arrive.
+                log.warning("reroute of shard %d to %s failed: %s",
+                            shard, entry["address"], e)
+                return False
+            return True
+        return False
 
     def close(self) -> None:
         pool = getattr(self, "_pool", None)
@@ -235,6 +278,7 @@ class ShardedPsClient(_PsClientBase):
                         f"ps shard {s} unreachable past "
                         f"{self.drain_retry_s}s: {e}"
                     ) from e
+                self._maybe_reroute_from_registry(s)
                 time.sleep(0.05)
                 continue
             if ack.ok:
@@ -246,6 +290,7 @@ class ShardedPsClient(_PsClientBase):
                     f"ps shard {s} stayed draining past "
                     f"{self.drain_retry_s}s; no reroute arrived"
                 )
+            self._maybe_reroute_from_registry(s)
             time.sleep(0.05)
 
     # ------------------------------------------------------------- migration
@@ -254,7 +299,11 @@ class ShardedPsClient(_PsClientBase):
         3). In-flight draining pushes pick up the new client on their next
         retry."""
         client = RpcClient(PS_SERVICE, address, timeout=60.0)
-        client.wait_ready(30.0)
+        try:
+            client.wait_ready(30.0)
+        except Exception:
+            client.close()  # don't leak the channel on a dead replacement
+            raise
         old, self._clients[shard] = self._clients[shard], client
         self.addresses[shard] = address
         old.close()

@@ -1,0 +1,101 @@
+"""Shard-address registry for PS pods (file-based service discovery).
+
+The operator creates/retires PS pods by *name* (replace-then-retire,
+docs/design/elastic-training-operator.md:86-101) and knows nothing about
+shards; clients route by *shard index*. This registry is the join between
+the two worlds: every PS pod publishes one JSON file
+``<workdir>/ps/ps-<pod>.json`` with its shard index, address and a
+publish timestamp. Readers resolve "who serves shard i" as the LATEST
+publication for that shard — a replacement pod publishes only after it has
+drained its predecessor and restored the rows, so the newest entry is by
+construction the authoritative one.
+
+Atomic single-file writes (tmp + rename) on a shared workdir; no locks, no
+coordination — the same pattern as the master-address file the agents
+already follow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+REG_DIR = "ps"
+
+
+def _dir(workdir: str) -> str:
+    return os.path.join(workdir, REG_DIR)
+
+
+def publish(workdir: str, pod: str, shard: int, num_shards: int,
+            address: str) -> str:
+    """Publish/overwrite this pod's registry entry; returns the file path."""
+    os.makedirs(_dir(workdir), exist_ok=True)
+    path = os.path.join(_dir(workdir), f"ps-{pod}.json")
+    doc = {
+        "pod": pod,
+        "shard": int(shard),
+        "num_shards": int(num_shards),
+        "address": address,
+        "published_at": time.time(),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def entries(workdir: str) -> Dict[str, dict]:
+    """All registry entries keyed by pod name (unreadable files skipped)."""
+    out: Dict[str, dict] = {}
+    try:
+        names = os.listdir(_dir(workdir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("ps-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(_dir(workdir), name)) as f:
+                doc = json.load(f)
+            out[doc["pod"]] = doc
+        except (OSError, ValueError, KeyError):
+            continue  # torn write in progress; next read sees it
+    return out
+
+
+def entry_for_pod(workdir: str, pod: str) -> Optional[dict]:
+    return entries(workdir).get(pod)
+
+
+def shard_map(workdir: str) -> Dict[int, dict]:
+    """shard index -> latest entry (the authoritative server for the shard)."""
+    latest: Dict[int, dict] = {}
+    for doc in entries(workdir).values():
+        s = int(doc["shard"])
+        if s not in latest or doc["published_at"] > latest[s]["published_at"]:
+            latest[s] = doc
+    return latest
+
+
+def addresses(workdir: str, num_shards: int,
+              timeout: float = 0.0) -> Tuple[str, ...]:
+    """Shard-ordered address tuple; with ``timeout`` waits for completeness.
+
+    Raises TimeoutError when shards are still missing after the wait — a
+    cluster that never fully published is a deployment error, not a routing
+    table."""
+    deadline = time.monotonic() + timeout
+    while True:
+        m = shard_map(workdir)
+        if all(s in m for s in range(num_shards)):
+            return tuple(m[s]["address"] for s in range(num_shards))
+        if time.monotonic() >= deadline:
+            missing = [s for s in range(num_shards) if s not in m]
+            raise TimeoutError(
+                f"ps registry incomplete: shards {missing} unpublished"
+            )
+        time.sleep(0.1)
