@@ -267,6 +267,16 @@ def scale_up_scenario(cache_dir: str, warm_start: bool) -> dict:
             "throughput_after_samples_per_s": round(tput_after, 1),
             "transition_window_s": round(W, 1),
             "throughput_loss_pct_vs_static": round(loss_pct, 1),
+            # The window-loss number above is an artifact of this
+            # measurement's tiny window (W ≈ 2×switch, so the job is
+            # stalled for ~half of it by construction). The defensible
+            # north-star proxy is the stall amortized over how often the
+            # autoscaler actually fires: a scale event costs ~switch_s of
+            # lost training, so loss% = switch_s / event interval. Brain's
+            # cooldown (30s min, realistic events minutes apart) bounds the
+            # cadence.
+            "amortized_loss_pct_at_10min_events": round(switch_s / 600 * 100, 2),
+            "amortized_loss_pct_at_30min_events": round(switch_s / 1800 * 100, 2),
             "north_star": "<5% throughput loss vs static pod",
             "compile_cache": "persistent jax_compilation_cache_dir enabled",
             "phases": decompose_switch(wd, gen1, gen2, t_plan),
