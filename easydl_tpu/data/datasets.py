@@ -1,0 +1,205 @@
+"""Memory-mapped file-backed datasets, rank-sharded and checkpointable.
+
+Layout conventions:
+
+- **token shards**: a directory of ``tokens-*.npy`` 1-D integer arrays (any
+  integer dtype) — the output of ``python -m easydl_tpu.data.encode``. The
+  dataset concatenates them logically, cuts non-overlapping ``seq_len+1``
+  windows, shuffles window order with an epoch-seeded permutation, and
+  yields ``{"inputs", "targets"}`` batches like the synthetic LM stream.
+- **array images**: ``images.npy`` ``[N, ...]`` plus ``labels.npy`` ``[N]``
+  in one directory (the MNIST/ImageNet-after-preprocessing shape).
+
+Sharding: rank ``r`` of ``world`` takes every ``world``-th window/example —
+disjoint and exhaustive, so data-parallel processes never duplicate or skip
+data. ``state()``/``restore_state()`` expose the (epoch, cursor) pair the
+checkpoint layer persists so a restored job resumes mid-epoch instead of
+replaying (SURVEY §5.4: resume covers the input pipeline too).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+def write_token_shards(ids, out_dir: str, shard_size: int = 1 << 24,
+                       dtype=np.uint16) -> List[str]:
+    """Write a token id stream into ``tokens-*.npy`` shards; returns paths.
+
+    dtype uint16 halves disk/IO for vocabs < 65536 (the common case)."""
+    arr = np.asarray(ids)
+    if arr.size and arr.max() >= np.iinfo(dtype).max:
+        dtype = np.uint32
+    arr = arr.astype(dtype)
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for i, start in enumerate(range(0, max(arr.size, 1), shard_size)):
+        path = os.path.join(out_dir, f"tokens-{i:05d}.npy")
+        np.save(path, arr[start:start + shard_size])
+        paths.append(path)
+    return paths
+
+
+class TokenFileDataset:
+    """Fixed-length LM windows over memory-mapped token shard files."""
+
+    def __init__(self, data_dir: str, batch_size: int, seq_len: int,
+                 rank: int = 0, world: int = 1, seed: int = 0,
+                 loop: bool = True):
+        self.paths = sorted(glob.glob(os.path.join(data_dir, "tokens-*.npy")))
+        if not self.paths:
+            raise FileNotFoundError(f"no tokens-*.npy under {data_dir}")
+        self._shards = [np.load(p, mmap_mode="r") for p in self.paths]
+        if any(s.ndim != 1 for s in self._shards):
+            raise ValueError("token shards must be 1-D id arrays")
+        self.batch_size = batch_size
+        #: kept for ShardedLoader's divisibility check (single-process mode
+        #: feeds the global batch, so global == local there)
+        self.global_batch = batch_size * world if world > 1 else batch_size
+        self.seq_len = seq_len
+        self.rank = rank
+        self.world = world
+        self.seed = seed
+        self.loop = loop
+        self._sizes = np.array([s.size for s in self._shards])
+        self._offsets = np.concatenate([[0], np.cumsum(self._sizes)])
+        self.total_tokens = int(self._offsets[-1])
+        window = seq_len + 1  # inputs + shifted targets
+        self.num_windows = self.total_tokens // window
+        mine = self.num_windows // world  # windows this rank owns per epoch
+        self.batches_per_epoch = mine // batch_size
+        if self.batches_per_epoch == 0:
+            raise ValueError(
+                f"{self.total_tokens} tokens is not enough for one "
+                f"batch of {batch_size}x{window} on {world} ranks"
+            )
+        self.epoch = 0
+        self.cursor = 0  # batches consumed within the current epoch
+
+    # ------------------------------------------------------------------ state
+    def state(self) -> Dict[str, int]:
+        # world/batch recorded so a resume onto a RESHAPED job (elastic
+        # scale event between save and restore) can preserve the global
+        # position instead of misreading a per-rank cursor
+        return {"epoch": self.epoch, "cursor": self.cursor,
+                "world": self.world, "batch": self.batch_size}
+
+    def restore_state(self, state: Dict[str, int]) -> None:
+        self.epoch = int(state.get("epoch", 0))
+        cursor = int(state.get("cursor", 0))
+        world = int(state.get("world", self.world))
+        batch = int(state.get("batch", self.batch_size))
+        if (world, batch) != (self.world, self.batch_size):
+            consumed = cursor * world * batch  # global windows this epoch
+            cursor = consumed // (self.world * self.batch_size)
+        self.cursor = min(cursor, self.batches_per_epoch)
+
+    # ------------------------------------------------------------------- read
+    def _window(self, index: int) -> np.ndarray:
+        window = self.seq_len + 1
+        start = index * window
+        shard = int(np.searchsorted(self._offsets, start, side="right") - 1)
+        local = start - int(self._offsets[shard])
+        out = np.empty((window,), np.int64)
+        filled = 0
+        while filled < window:
+            src = self._shards[shard]
+            take = min(window - filled, src.size - local)
+            out[filled:filled + take] = src[local:local + take]
+            filled += take
+            shard += 1
+            local = 0
+        return out
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng((self.seed, epoch))
+        return rng.permutation(self.num_windows)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            order = self._epoch_order(self.epoch)
+            mine = order[self.rank::self.world]
+            while self.cursor < self.batches_per_epoch:
+                lo = self.cursor * self.batch_size
+                idx = mine[lo:lo + self.batch_size]
+                batch = np.stack([self._window(int(i)) for i in idx])
+                self.cursor += 1
+                yield {
+                    "inputs": batch[:, :-1].astype(np.int32),
+                    "targets": batch[:, 1:].astype(np.int32),
+                }
+            self.epoch += 1
+            self.cursor = 0
+            if not self.loop:
+                return
+
+
+class ArrayImageDataset:
+    """images.npy/labels.npy pairs — the classification-config file format."""
+
+    def __init__(self, data_dir: str, batch_size: int, rank: int = 0,
+                 world: int = 1, seed: int = 0, loop: bool = True,
+                 normalize: bool = True):
+        self.images = np.load(os.path.join(data_dir, "images.npy"),
+                              mmap_mode="r")
+        self.labels = np.load(os.path.join(data_dir, "labels.npy"),
+                              mmap_mode="r")
+        if len(self.images) != len(self.labels):
+            raise ValueError(
+                f"images ({len(self.images)}) / labels ({len(self.labels)}) "
+                "length mismatch"
+            )
+        self.batch_size = batch_size
+        self.global_batch = batch_size * world if world > 1 else batch_size
+        self.rank = rank
+        self.world = world
+        self.seed = seed
+        self.loop = loop
+        self.normalize = normalize
+        mine = len(self.images) // world
+        self.batches_per_epoch = mine // batch_size
+        if self.batches_per_epoch == 0:
+            raise ValueError(
+                f"{len(self.images)} examples can't fill one batch of "
+                f"{batch_size} on {world} ranks"
+            )
+        self.epoch = 0
+        self.cursor = 0
+
+    def state(self) -> Dict[str, int]:
+        return {"epoch": self.epoch, "cursor": self.cursor,
+                "world": self.world, "batch": self.batch_size}
+
+    def restore_state(self, state: Dict[str, int]) -> None:
+        self.epoch = int(state.get("epoch", 0))
+        cursor = int(state.get("cursor", 0))
+        world = int(state.get("world", self.world))
+        batch = int(state.get("batch", self.batch_size))
+        if (world, batch) != (self.world, self.batch_size):
+            consumed = cursor * world * batch
+            cursor = consumed // (self.world * self.batch_size)
+        self.cursor = min(cursor, self.batches_per_epoch)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            order = rng.permutation(len(self.images))[self.rank::self.world]
+            while self.cursor < self.batches_per_epoch:
+                lo = self.cursor * self.batch_size
+                idx = np.sort(order[lo:lo + self.batch_size])  # mmap-friendly
+                images = np.asarray(self.images[idx], np.float32)
+                if self.normalize:
+                    images = images / 255.0
+                self.cursor += 1
+                yield {
+                    "image": images,
+                    "label": np.asarray(self.labels[idx], np.int32),
+                }
+            self.epoch += 1
+            self.cursor = 0
+            if not self.loop:
+                return

@@ -1,0 +1,70 @@
+"""``python -m easydl_tpu.data.encode`` — text corpus → token shards.
+
+Two modes:
+- ``--train-tokenizer``: fit a byte-level BPE on the input text files and
+  save the vocabulary JSON;
+- default: load the tokenizer, encode every input file (document-separated
+  by <eos>), and write ``tokens-*.npy`` shards that
+  :class:`~easydl_tpu.data.datasets.TokenFileDataset` consumes.
+
+Hermetic by design: no downloads, any UTF-8 text works (the byte alphabet
+covers everything).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+
+import numpy as np
+
+from easydl_tpu.data.datasets import write_token_shards
+from easydl_tpu.data.tokenizer import ByteBpeTokenizer
+
+
+def iter_texts(patterns):
+    for pattern in patterns:
+        for path in sorted(glob.glob(pattern)):
+            with open(path, encoding="utf-8", errors="replace") as f:
+                yield f.read()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="corpus -> token shards")
+    ap.add_argument("inputs", nargs="+", help="text files / globs")
+    ap.add_argument("--tokenizer", required=True,
+                    help="tokenizer JSON (output of --train-tokenizer, "
+                         "input otherwise)")
+    ap.add_argument("--train-tokenizer", action="store_true")
+    ap.add_argument("--vocab-size", type=int, default=8192)
+    ap.add_argument("--out", default="",
+                    help="token shard output dir (encode mode)")
+    ap.add_argument("--shard-size", type=int, default=1 << 24)
+    args = ap.parse_args()
+
+    if args.train_tokenizer:
+        tok = ByteBpeTokenizer.train(iter_texts(args.inputs),
+                                     vocab_size=args.vocab_size)
+        os.makedirs(os.path.dirname(os.path.abspath(args.tokenizer)),
+                    exist_ok=True)
+        tok.save(args.tokenizer)
+        print(f"trained tokenizer: vocab={tok.vocab_size} -> {args.tokenizer}")
+        return
+
+    if not args.out:
+        ap.error("--out is required when encoding")
+    tok = ByteBpeTokenizer.load(args.tokenizer)
+    ids: list = []
+    n_docs = 0
+    for text in iter_texts(args.inputs):
+        ids.extend(tok.encode(text, append_eos=True))
+        n_docs += 1
+    paths = write_token_shards(np.asarray(ids), args.out,
+                               shard_size=args.shard_size)
+    print(f"encoded {n_docs} docs -> {len(ids)} tokens in "
+          f"{len(paths)} shard(s) under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+"""Byte-level BPE tokenizer — trainable, hermetic, dependency-free.
+
+The reference leaves tokenization unspecified; LM configs need *some* path
+from text files to token ids that works with zero downloads (the deployment
+image cannot fetch pretrained vocab files). This is the standard byte-level
+BPE construction (GPT-2 style, simplified):
+
+- base alphabet = the 256 bytes, so ANY input encodes losslessly;
+- pre-tokenization splits on whitespace, attaching the leading space to the
+  following word (the ``Ġ``-marker trick, here kept as the raw space byte),
+  so merges never cross word boundaries and encoding is parallel-friendly;
+- training greedily merges the most frequent adjacent symbol pair until
+  ``vocab_size`` is reached; encoding applies merges by rank.
+
+Vocabularies serialize to a single JSON file. Special tokens occupy ids
+after the byte alphabet and are never produced by merges.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+#: ids 0..255 are the raw bytes
+N_BYTES = 256
+
+
+class ByteBpeTokenizer:
+    def __init__(self, merges: Sequence[Tuple[int, int]] = (),
+                 specials: Sequence[str] = ("<pad>", "<eos>")):
+        self.specials = list(specials)
+        #: special name -> id (after bytes, before merge tokens)
+        self.special_ids: Dict[str, int] = {
+            s: N_BYTES + i for i, s in enumerate(self.specials)
+        }
+        self._merge_base = N_BYTES + len(self.specials)
+        self.merges: List[Tuple[int, int]] = [tuple(m) for m in merges]
+        #: (a, b) -> merged token id
+        self._ranks: Dict[Tuple[int, int], int] = {
+            tuple(pair): self._merge_base + i
+            for i, pair in enumerate(self.merges)
+        }
+
+    # ------------------------------------------------------------------ props
+    @property
+    def vocab_size(self) -> int:
+        return self._merge_base + len(self.merges)
+
+    @property
+    def eos_id(self) -> int:
+        return self.special_ids["<eos>"]
+
+    @property
+    def pad_id(self) -> int:
+        return self.special_ids["<pad>"]
+
+    # ------------------------------------------------------------------ train
+    @classmethod
+    def train(cls, texts: Iterable[str], vocab_size: int,
+              specials: Sequence[str] = ("<pad>", "<eos>")) -> "ByteBpeTokenizer":
+        """Greedy BPE over whitespace-pre-tokenized words."""
+        tok = cls(specials=specials)
+        if vocab_size < tok._merge_base:
+            raise ValueError(
+                f"vocab_size {vocab_size} < byte alphabet + specials "
+                f"({tok._merge_base})"
+            )
+        # word (as byte tuple) -> count
+        word_counts: Counter = Counter()
+        for text in texts:
+            for word in _pre_tokenize(text):
+                word_counts[tuple(word.encode("utf-8"))] += 1
+        words = [list(w) for w in word_counts]
+        counts = [word_counts[tuple(w)] for w in word_counts]
+
+        merges: List[Tuple[int, int]] = []
+        next_id = tok._merge_base
+        while next_id < vocab_size:
+            pair_counts: Counter = Counter()
+            for w, c in zip(words, counts):
+                for a, b in zip(w, w[1:]):
+                    pair_counts[(a, b)] += c
+            if not pair_counts:
+                break
+            (a, b), top = pair_counts.most_common(1)[0]
+            if top < 2:
+                break  # nothing left worth merging
+            merges.append((a, b))
+            for w in words:
+                _apply_merge(w, a, b, next_id)
+            next_id += 1
+        return cls(merges=merges, specials=specials)
+
+    # ----------------------------------------------------------------- encode
+    def encode(self, text: str, append_eos: bool = False) -> List[int]:
+        out: List[int] = []
+        for word in _pre_tokenize(text):
+            symbols = list(word.encode("utf-8"))
+            # lowest-rank merge first — the order they were learned
+            while len(symbols) > 1:
+                best = None
+                best_rank = None
+                for i, pair in enumerate(zip(symbols, symbols[1:])):
+                    rank = self._ranks.get(pair)
+                    if rank is not None and (best_rank is None or rank < best_rank):
+                        best, best_rank = i, rank
+                if best is None:
+                    break
+                symbols[best:best + 2] = [best_rank]
+            out.extend(symbols)
+        if append_eos:
+            out.append(self.eos_id)
+        return out
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytearray()
+        for tid in ids:
+            data.extend(self._expand(int(tid)))
+        return data.decode("utf-8", errors="replace")
+
+    def _expand(self, tid: int) -> bytes:
+        if tid < N_BYTES:
+            return bytes([tid])
+        if tid < self._merge_base:
+            return b""  # specials render as nothing
+        a, b = self.merges[tid - self._merge_base]
+        return self._expand(a) + self._expand(b)
+
+    # ------------------------------------------------------------------- io
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {"specials": self.specials,
+                 "merges": [list(m) for m in self.merges]},
+                f,
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "ByteBpeTokenizer":
+        with open(path) as f:
+            doc = json.load(f)
+        return cls(merges=[tuple(m) for m in doc["merges"]],
+                   specials=doc["specials"])
+
+
+def _pre_tokenize(text: str) -> List[str]:
+    """Whitespace split keeping the separating space attached to the next
+    word, so 'a b' -> ['a', ' b'] and decode is exact."""
+    out: List[str] = []
+    word = ""
+    for ch in text:
+        if ch.isspace():
+            if word and not word.isspace():
+                out.append(word)
+                word = ch
+            else:
+                word += ch
+        else:
+            if word.isspace() and len(word) > 1:
+                # multiple spaces: keep all but the last as their own token
+                out.append(word[:-1])
+                word = word[-1]
+            word += ch
+    if word:
+        out.append(word)
+    return out
+
+
+def _apply_merge(symbols: List[int], a: int, b: int, merged: int) -> None:
+    i = 0
+    while i < len(symbols) - 1:
+        if symbols[i] == a and symbols[i + 1] == b:
+            symbols[i:i + 2] = [merged]
+        else:
+            i += 1

@@ -172,6 +172,12 @@ def main() -> None:
         cfg.update(model=ns.model, model_kwargs=kwargs,
                    global_batch=ns.batch, total_steps=ns.steps,
                    ckpt_interval=ns.ckpt_every, lr=ns.lr)
+        if getattr(ns, "data_dir", ""):
+            # file-backed data must survive into the elastic workers, not
+            # silently fall back to the synthetic stream
+            cfg["data_dir"] = ns.data_dir
+            if ns.seq_len:
+                cfg["seq_len"] = ns.seq_len
     if args.total_steps:
         cfg["total_steps"] = args.total_steps
 

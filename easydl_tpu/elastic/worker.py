@@ -137,7 +137,43 @@ def run_worker(env: Dict[str, str]) -> int:
     ckpt_interval = int(cfg.get("ckpt_interval", 20))
     sync_every = int(cfg.get("sync_every", 1))
     per_process_batch = global_batch // max(world, 1)
-    data = iter(bundle.make_data(per_process_batch, seed=int(cfg.get("seed", 0)) + rank))
+    data_source = None
+    if cfg.get("data_dir"):
+        from easydl_tpu.data import ArrayImageDataset, TokenFileDataset
+
+        data_dir = cfg["data_dir"]
+        if os.path.exists(os.path.join(data_dir, "images.npy")):
+            data_source = ArrayImageDataset(
+                data_dir, batch_size=per_process_batch, rank=rank, world=world
+            )
+        else:
+            seq_len = int(cfg.get("seq_len", 0)) or getattr(
+                bundle.make_data(1), "seq_len", 0
+            )
+            data_source = TokenFileDataset(
+                data_dir, batch_size=per_process_batch, seq_len=seq_len,
+                rank=rank, world=world,
+            )
+        if latest >= 0:
+            # resume the data cursor with the model; the state is
+            # world/batch-tagged so a reshaped generation rescales it
+            data_state = ckpt.metadata(latest).get("metadata", {}).get(
+                "data_state"
+            )
+            if data_state:
+                data_source.restore_state(data_state)
+        log.info("gen %d: file data %s (%d batches/epoch, rank %d/%d)",
+                 generation, data_dir, data_source.batches_per_epoch,
+                 rank, world)
+        data = iter(data_source)
+    else:
+        data = iter(bundle.make_data(per_process_batch, seed=int(cfg.get("seed", 0)) + rank))
+
+    def _data_meta():
+        # the data cursor rides the checkpoint so a restore resumes the
+        # stream instead of replaying the epoch (None for synthetic)
+        return ({"data_state": data_source.state()}
+                if data_source is not None else None)
 
     def append_metrics(step: int, loss: float, dt: float) -> None:
         rec = {
@@ -169,7 +205,7 @@ def run_worker(env: Dict[str, str]) -> int:
         if want_quiesce:
             log.info("gen %d: quiescing at step %d", generation, step)
             timeline.emit(tl_path, "quiesce_ckpt_begin", generation, step=step)
-            ckpt.save(step, state)  # no-op if this step is already committed
+            ckpt.save(step, state, metadata=_data_meta())  # no-op if already committed
             ckpt.wait()  # commit must land before this process exits
             timeline.emit(tl_path, "quiesce_exit", generation, step=step)
             return 0
@@ -187,12 +223,12 @@ def run_worker(env: Dict[str, str]) -> int:
             first_step_emitted = True
 
         if ckpt_interval > 0 and step % ckpt_interval == 0 and step < total_steps:
-            ckpt.save(step, state)
+            ckpt.save(step, state, metadata=_data_meta())
         # Complete any deferred multi-process commit once every rank's chunk
         # IO is done (collective agreement; barriers on this main thread).
         ckpt.finalize()
 
-    ckpt.save(total_steps, state)
+    ckpt.save(total_steps, state, metadata=_data_meta())
     ckpt.wait()
     if rank == 0:
         with open(os.path.join(workdir, "DONE"), "w") as f:

@@ -36,7 +36,40 @@ def build_parser() -> argparse.ArgumentParser:
                     help="k=v forwarded to the model factory (repeatable)")
     ap.add_argument("--profile-dir", default="",
                     help="capture an XLA trace of 3 steady-state steps here")
+    ap.add_argument("--data-dir", default="",
+                    help="file-backed data: a dir of tokens-*.npy shards "
+                         "(LM models) or images.npy/labels.npy "
+                         "(classification). Default: the model bundle's "
+                         "synthetic stream")
+    ap.add_argument("--seq-len", type=int, default=0,
+                    help="sequence length for --data-dir token shards "
+                         "(default: the model's seq_len model-arg or 128)")
     return ap
+
+
+def file_data(args, bundle, rank: int = 0, world: int = 1,
+              batch: int = 0, seed_offset: int = 0):
+    """--data-dir -> a dataset matching the model's input contract.
+
+    seq_len comes from the bundle's own data stream (the model's actual
+    config) unless --seq-len overrides it — a hardcoded fallback would
+    silently train a long-context model on short windows."""
+    import os
+
+    from easydl_tpu.data import ArrayImageDataset, TokenFileDataset
+
+    batch = batch or args.batch
+    if os.path.exists(os.path.join(args.data_dir, "images.npy")):
+        return ArrayImageDataset(args.data_dir, batch_size=batch,
+                                 rank=rank, world=world, seed=seed_offset)
+    seq_len = args.seq_len or getattr(bundle.make_data(1), "seq_len", 0)
+    if not seq_len:
+        raise SystemExit(
+            f"cannot infer seq_len for model {bundle.name!r}; pass --seq-len"
+        )
+    return TokenFileDataset(args.data_dir, batch_size=batch,
+                            seq_len=seq_len, rank=rank, world=world,
+                            seed=seed_offset)
 
 
 def main() -> None:
@@ -79,10 +112,13 @@ def main() -> None:
             ap.error("--role evaluator requires --ckpt-dir")
         from easydl_tpu.core.evaluator import Evaluator
 
-        ev = Evaluator(
-            trainer, ckpt, iter(bundle.make_data(args.batch, seed=1)),
-            eval_fn=bundle.eval_fn,
-        )
+        if args.data_dir:
+            # seed_offset=1: a different shuffle order than training, so the
+            # evaluator doesn't walk the identical batch sequence
+            eval_data = iter(file_data(args, bundle, seed_offset=1))
+        else:
+            eval_data = iter(bundle.make_data(args.batch, seed=1))
+        ev = Evaluator(trainer, ckpt, eval_data, eval_fn=bundle.eval_fn)
         ev.run(poll_interval_s=2.0, max_evals=args.eval_polls or None)
         return
 
@@ -90,7 +126,22 @@ def main() -> None:
     if ckpt is not None and ckpt.latest_step() is not None:
         state = trainer.restore_from(ckpt)
         log.info("resumed from step %d", state.int_step)
-    data = iter(bundle.make_data(args.batch, seed=0))
+    source = None
+    if args.data_dir:
+        source = file_data(args, bundle)
+        if ckpt is not None and state.int_step > 0:
+            # resume the data cursor alongside the model: without this a
+            # restored run replays epoch 0 from the start
+            data_state = ckpt.metadata(state.int_step).get(
+                "metadata", {}).get("data_state")
+            if data_state:
+                source.restore_state(data_state)
+                log.info("data cursor resumed: %s", data_state)
+        log.info("file-backed data: %s (%d batches/epoch)",
+                 args.data_dir, source.batches_per_epoch)
+        data = iter(source)
+    else:
+        data = iter(bundle.make_data(args.batch, seed=0))
     recorder = MetricsRecorder(args.batch, world_size=dp)
     profiler = None
     if args.profile_dir:
@@ -121,7 +172,9 @@ def main() -> None:
                 log.info("step %d loss %.4f (%.1f samples/s)", step, rec.loss,
                          rec.samples_per_sec)
             if ckpt is not None and (step % args.ckpt_every == 0 or step == args.steps):
-                ckpt.save(step, state)
+                ckpt.save(step, state, metadata=(
+                    {"data_state": source.state()} if source is not None
+                    else None))
             if ckpt is not None:
                 # Complete any deferred multi-process commit at the step
                 # boundary (collectives on this main thread); no-op otherwise.
