@@ -45,11 +45,18 @@ def write_token_shards(ids, out_dir: str, shard_size: int = 1 << 24,
 
 
 class TokenFileDataset:
-    """Fixed-length LM windows over memory-mapped token shard files."""
+    """Fixed-length LM windows over memory-mapped token shard files.
+
+    ``val_fraction`` carves a deterministic held-out split at window
+    granularity (a multiplicative hash of the window index, independent of
+    epoch/world/seed): trainers read ``split="train"``, the evaluator reads
+    ``split="val"`` of the same directory, and the two never overlap.
+    """
 
     def __init__(self, data_dir: str, batch_size: int, seq_len: int,
                  rank: int = 0, world: int = 1, seed: int = 0,
-                 loop: bool = True):
+                 loop: bool = True, split: str = "train",
+                 val_fraction: float = 0.0):
         self.paths = sorted(glob.glob(os.path.join(data_dir, "tokens-*.npy")))
         if not self.paths:
             raise FileNotFoundError(f"no tokens-*.npy under {data_dir}")
@@ -70,12 +77,26 @@ class TokenFileDataset:
         self.total_tokens = int(self._offsets[-1])
         window = seq_len + 1  # inputs + shifted targets
         self.num_windows = self.total_tokens // window
-        mine = self.num_windows // world  # windows this rank owns per epoch
+        if split not in ("train", "val"):
+            raise ValueError(f"split must be 'train' or 'val', got {split!r}")
+        if split == "val" and not val_fraction:
+            raise ValueError("split='val' requires val_fraction > 0")
+        if val_fraction:
+            # Knuth multiplicative hash -> uniform in [0, 1); stable across
+            # runs so the holdout never leaks into training
+            u = (np.arange(self.num_windows, dtype=np.uint64)
+                 * np.uint64(2654435761) % np.uint64(1 << 32)) / float(1 << 32)
+            mask = u < val_fraction
+            self._windows = np.flatnonzero(mask if split == "val" else ~mask)
+        else:
+            self._windows = np.arange(self.num_windows)
+        mine = len(self._windows) // world  # windows this rank owns per epoch
         self.batches_per_epoch = mine // batch_size
         if self.batches_per_epoch == 0:
             raise ValueError(
                 f"{self.total_tokens} tokens is not enough for one "
-                f"batch of {batch_size}x{window} on {world} ranks"
+                f"batch of {batch_size}x{window} on {world} ranks "
+                f"(split={split!r})"
             )
         self.epoch = 0
         self.cursor = 0  # batches consumed within the current epoch
@@ -117,7 +138,7 @@ class TokenFileDataset:
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         rng = np.random.default_rng((self.seed, epoch))
-        return rng.permutation(self.num_windows)
+        return self._windows[rng.permutation(len(self._windows))]
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         while True:
@@ -143,7 +164,8 @@ class ArrayImageDataset:
 
     def __init__(self, data_dir: str, batch_size: int, rank: int = 0,
                  world: int = 1, seed: int = 0, loop: bool = True,
-                 normalize: bool = True):
+                 normalize: bool = True, split: str = "train",
+                 val_fraction: float = 0.0):
         self.images = np.load(os.path.join(data_dir, "images.npy"),
                               mmap_mode="r")
         self.labels = np.load(os.path.join(data_dir, "labels.npy"),
@@ -160,12 +182,26 @@ class ArrayImageDataset:
         self.seed = seed
         self.loop = loop
         self.normalize = normalize
-        mine = len(self.images) // world
+        if split not in ("train", "val"):
+            raise ValueError(f"split must be 'train' or 'val', got {split!r}")
+        if split == "val" and not val_fraction:
+            raise ValueError("split='val' requires val_fraction > 0")
+        n = len(self.images)
+        if val_fraction:
+            # same stable hash-split as TokenFileDataset: seed-independent,
+            # so the holdout never leaks into training
+            u = (np.arange(n, dtype=np.uint64)
+                 * np.uint64(2654435761) % np.uint64(1 << 32)) / float(1 << 32)
+            mask = u < val_fraction
+            self._examples = np.flatnonzero(mask if split == "val" else ~mask)
+        else:
+            self._examples = np.arange(n)
+        mine = len(self._examples) // world
         self.batches_per_epoch = mine // batch_size
         if self.batches_per_epoch == 0:
             raise ValueError(
-                f"{len(self.images)} examples can't fill one batch of "
-                f"{batch_size} on {world} ranks"
+                f"{n} examples can't fill one batch of "
+                f"{batch_size} on {world} ranks (split={split!r})"
             )
         self.epoch = 0
         self.cursor = 0
@@ -187,7 +223,9 @@ class ArrayImageDataset:
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         while True:
             rng = np.random.default_rng((self.seed, self.epoch))
-            order = rng.permutation(len(self.images))[self.rank::self.world]
+            order = self._examples[
+                rng.permutation(len(self._examples))
+            ][self.rank::self.world]
             while self.cursor < self.batches_per_epoch:
                 lo = self.cursor * self.batch_size
                 idx = np.sort(order[lo:lo + self.batch_size])  # mmap-friendly

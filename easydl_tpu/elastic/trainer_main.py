@@ -178,6 +178,10 @@ def main() -> None:
             cfg["data_dir"] = ns.data_dir
             if ns.seq_len:
                 cfg["seq_len"] = ns.seq_len
+            if getattr(ns, "val_fraction", 0.0):
+                # the holdout must be carved out of TRAINING too, or the
+                # evaluator's "val" loss is contaminated by trained windows
+                cfg["val_fraction"] = ns.val_fraction
     if args.total_steps:
         cfg["total_steps"] = args.total_steps
 

@@ -142,9 +142,14 @@ def run_worker(env: Dict[str, str]) -> int:
         from easydl_tpu.data import ArrayImageDataset, TokenFileDataset
 
         data_dir = cfg["data_dir"]
+        # val_fraction carves the evaluator's holdout out of training here
+        # too — otherwise elastic trainers would see 100% of the windows and
+        # contaminate the "held-out" eval loss
+        val_fraction = float(cfg.get("val_fraction", 0.0))
         if os.path.exists(os.path.join(data_dir, "images.npy")):
             data_source = ArrayImageDataset(
-                data_dir, batch_size=per_process_batch, rank=rank, world=world
+                data_dir, batch_size=per_process_batch, rank=rank,
+                world=world, split="train", val_fraction=val_fraction,
             )
         else:
             seq_len = int(cfg.get("seq_len", 0)) or getattr(
@@ -152,7 +157,8 @@ def run_worker(env: Dict[str, str]) -> int:
             )
             data_source = TokenFileDataset(
                 data_dir, batch_size=per_process_batch, seq_len=seq_len,
-                rank=rank, world=world,
+                rank=rank, world=world, split="train",
+                val_fraction=val_fraction,
             )
         if latest >= 0:
             # resume the data cursor with the model; the state is

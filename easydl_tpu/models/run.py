@@ -44,11 +44,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--seq-len", type=int, default=0,
                     help="sequence length for --data-dir token shards "
                          "(default: the model's seq_len model-arg or 128)")
+    ap.add_argument("--val-fraction", type=float, default=0.0,
+                    help="deterministic held-out fraction of --data-dir "
+                         "token windows; trainers read the rest, the "
+                         "evaluator reads the holdout")
     return ap
 
 
 def file_data(args, bundle, rank: int = 0, world: int = 1,
-              batch: int = 0, seed_offset: int = 0):
+              batch: int = 0, seed_offset: int = 0, split: str = "train"):
     """--data-dir -> a dataset matching the model's input contract.
 
     seq_len comes from the bundle's own data stream (the model's actual
@@ -61,7 +65,9 @@ def file_data(args, bundle, rank: int = 0, world: int = 1,
     batch = batch or args.batch
     if os.path.exists(os.path.join(args.data_dir, "images.npy")):
         return ArrayImageDataset(args.data_dir, batch_size=batch,
-                                 rank=rank, world=world, seed=seed_offset)
+                                 rank=rank, world=world, seed=seed_offset,
+                                 split=split,
+                                 val_fraction=args.val_fraction)
     seq_len = args.seq_len or getattr(bundle.make_data(1), "seq_len", 0)
     if not seq_len:
         raise SystemExit(
@@ -69,7 +75,8 @@ def file_data(args, bundle, rank: int = 0, world: int = 1,
         )
     return TokenFileDataset(args.data_dir, batch_size=batch,
                             seq_len=seq_len, rank=rank, world=world,
-                            seed=seed_offset)
+                            seed=seed_offset, split=split,
+                            val_fraction=args.val_fraction)
 
 
 def main() -> None:
@@ -113,9 +120,11 @@ def main() -> None:
         from easydl_tpu.core.evaluator import Evaluator
 
         if args.data_dir:
-            # seed_offset=1: a different shuffle order than training, so the
-            # evaluator doesn't walk the identical batch sequence
-            eval_data = iter(file_data(args, bundle, seed_offset=1))
+            # --val-fraction: a real held-out split; otherwise fall back to
+            # a different shuffle order than training (seed_offset=1)
+            split = "val" if args.val_fraction else "train"
+            eval_data = iter(file_data(args, bundle, seed_offset=1,
+                                       split=split))
         else:
             eval_data = iter(bundle.make_data(args.batch, seed=1))
         ev = Evaluator(trainer, ckpt, eval_data, eval_fn=bundle.eval_fn)
