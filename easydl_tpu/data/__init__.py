@@ -7,6 +7,10 @@ external downloads, a corpus encoder CLI, and memory-mapped datasets that
 shard by data-parallel rank and checkpoint their cursor.
 """
 
+from easydl_tpu.data.clicks import (  # noqa: F401
+    ClickLogDataset,
+    encode_click_tsv,
+)
 from easydl_tpu.data.datasets import (  # noqa: F401
     ArrayImageDataset,
     TokenFileDataset,

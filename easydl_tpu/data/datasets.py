@@ -26,6 +26,46 @@ from typing import Dict, Iterator, List, Optional
 import numpy as np
 
 
+def hash_split(n: int, split: str, val_fraction: float) -> np.ndarray:
+    """Deterministic train/val assignment over ``n`` items.
+
+    Knuth multiplicative hash → uniform in [0, 1); independent of seed,
+    epoch, and world, so the holdout can never leak into training. One
+    implementation shared by every file dataset."""
+    if split not in ("train", "val"):
+        raise ValueError(f"split must be 'train' or 'val', got {split!r}")
+    if split == "val" and not val_fraction:
+        raise ValueError("split='val' requires val_fraction > 0")
+    if not val_fraction:
+        return np.arange(n)
+    u = (np.arange(n, dtype=np.uint64)
+         * np.uint64(2654435761) % np.uint64(1 << 32)) / float(1 << 32)
+    mask = u < val_fraction
+    return np.flatnonzero(mask if split == "val" else ~mask)
+
+
+class CursorStateMixin:
+    """The (epoch, cursor) checkpoint contract shared by the file datasets.
+
+    The state is world/batch-tagged: restoring onto a RESHAPED job (elastic
+    scale event between save and resume) rescales the per-rank cursor to the
+    same global position."""
+
+    def state(self) -> Dict[str, int]:
+        return {"epoch": self.epoch, "cursor": self.cursor,
+                "world": self.world, "batch": self.batch_size}
+
+    def restore_state(self, state: Dict[str, int]) -> None:
+        self.epoch = int(state.get("epoch", 0))
+        cursor = int(state.get("cursor", 0))
+        world = int(state.get("world", self.world))
+        batch = int(state.get("batch", self.batch_size))
+        if (world, batch) != (self.world, self.batch_size):
+            consumed = cursor * world * batch  # global items this epoch
+            cursor = consumed // (self.world * self.batch_size)
+        self.cursor = min(cursor, self.batches_per_epoch)
+
+
 def write_token_shards(ids, out_dir: str, shard_size: int = 1 << 24,
                        dtype=np.uint16) -> List[str]:
     """Write a token id stream into ``tokens-*.npy`` shards; returns paths.
@@ -44,13 +84,13 @@ def write_token_shards(ids, out_dir: str, shard_size: int = 1 << 24,
     return paths
 
 
-class TokenFileDataset:
+class TokenFileDataset(CursorStateMixin):
     """Fixed-length LM windows over memory-mapped token shard files.
 
     ``val_fraction`` carves a deterministic held-out split at window
-    granularity (a multiplicative hash of the window index, independent of
-    epoch/world/seed): trainers read ``split="train"``, the evaluator reads
-    ``split="val"`` of the same directory, and the two never overlap.
+    granularity (:func:`hash_split`): trainers read ``split="train"``, the
+    evaluator reads ``split="val"`` of the same directory, and the two
+    never overlap.
     """
 
     def __init__(self, data_dir: str, batch_size: int, seq_len: int,
@@ -77,19 +117,7 @@ class TokenFileDataset:
         self.total_tokens = int(self._offsets[-1])
         window = seq_len + 1  # inputs + shifted targets
         self.num_windows = self.total_tokens // window
-        if split not in ("train", "val"):
-            raise ValueError(f"split must be 'train' or 'val', got {split!r}")
-        if split == "val" and not val_fraction:
-            raise ValueError("split='val' requires val_fraction > 0")
-        if val_fraction:
-            # Knuth multiplicative hash -> uniform in [0, 1); stable across
-            # runs so the holdout never leaks into training
-            u = (np.arange(self.num_windows, dtype=np.uint64)
-                 * np.uint64(2654435761) % np.uint64(1 << 32)) / float(1 << 32)
-            mask = u < val_fraction
-            self._windows = np.flatnonzero(mask if split == "val" else ~mask)
-        else:
-            self._windows = np.arange(self.num_windows)
+        self._windows = hash_split(self.num_windows, split, val_fraction)
         mine = len(self._windows) // world  # windows this rank owns per epoch
         self.batches_per_epoch = mine // batch_size
         if self.batches_per_epoch == 0:
@@ -100,24 +128,6 @@ class TokenFileDataset:
             )
         self.epoch = 0
         self.cursor = 0  # batches consumed within the current epoch
-
-    # ------------------------------------------------------------------ state
-    def state(self) -> Dict[str, int]:
-        # world/batch recorded so a resume onto a RESHAPED job (elastic
-        # scale event between save and restore) can preserve the global
-        # position instead of misreading a per-rank cursor
-        return {"epoch": self.epoch, "cursor": self.cursor,
-                "world": self.world, "batch": self.batch_size}
-
-    def restore_state(self, state: Dict[str, int]) -> None:
-        self.epoch = int(state.get("epoch", 0))
-        cursor = int(state.get("cursor", 0))
-        world = int(state.get("world", self.world))
-        batch = int(state.get("batch", self.batch_size))
-        if (world, batch) != (self.world, self.batch_size):
-            consumed = cursor * world * batch  # global windows this epoch
-            cursor = consumed // (self.world * self.batch_size)
-        self.cursor = min(cursor, self.batches_per_epoch)
 
     # ------------------------------------------------------------------- read
     def _window(self, index: int) -> np.ndarray:
@@ -159,7 +169,7 @@ class TokenFileDataset:
                 return
 
 
-class ArrayImageDataset:
+class ArrayImageDataset(CursorStateMixin):
     """images.npy/labels.npy pairs — the classification-config file format."""
 
     def __init__(self, data_dir: str, batch_size: int, rank: int = 0,
@@ -182,20 +192,8 @@ class ArrayImageDataset:
         self.seed = seed
         self.loop = loop
         self.normalize = normalize
-        if split not in ("train", "val"):
-            raise ValueError(f"split must be 'train' or 'val', got {split!r}")
-        if split == "val" and not val_fraction:
-            raise ValueError("split='val' requires val_fraction > 0")
         n = len(self.images)
-        if val_fraction:
-            # same stable hash-split as TokenFileDataset: seed-independent,
-            # so the holdout never leaks into training
-            u = (np.arange(n, dtype=np.uint64)
-                 * np.uint64(2654435761) % np.uint64(1 << 32)) / float(1 << 32)
-            mask = u < val_fraction
-            self._examples = np.flatnonzero(mask if split == "val" else ~mask)
-        else:
-            self._examples = np.arange(n)
+        self._examples = hash_split(n, split, val_fraction)
         mine = len(self._examples) // world
         self.batches_per_epoch = mine // batch_size
         if self.batches_per_epoch == 0:
@@ -205,20 +203,6 @@ class ArrayImageDataset:
             )
         self.epoch = 0
         self.cursor = 0
-
-    def state(self) -> Dict[str, int]:
-        return {"epoch": self.epoch, "cursor": self.cursor,
-                "world": self.world, "batch": self.batch_size}
-
-    def restore_state(self, state: Dict[str, int]) -> None:
-        self.epoch = int(state.get("epoch", 0))
-        cursor = int(state.get("cursor", 0))
-        world = int(state.get("world", self.world))
-        batch = int(state.get("batch", self.batch_size))
-        if (world, batch) != (self.world, self.batch_size):
-            consumed = cursor * world * batch
-            cursor = consumed // (self.world * self.batch_size)
-        self.cursor = min(cursor, self.batches_per_epoch)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         while True:

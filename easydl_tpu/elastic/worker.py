@@ -139,7 +139,11 @@ def run_worker(env: Dict[str, str]) -> int:
     per_process_batch = global_batch // max(world, 1)
     data_source = None
     if cfg.get("data_dir"):
-        from easydl_tpu.data import ArrayImageDataset, TokenFileDataset
+        from easydl_tpu.data import (
+            ArrayImageDataset,
+            ClickLogDataset,
+            TokenFileDataset,
+        )
 
         data_dir = cfg["data_dir"]
         # val_fraction carves the evaluator's holdout out of training here
@@ -148,6 +152,11 @@ def run_worker(env: Dict[str, str]) -> int:
         val_fraction = float(cfg.get("val_fraction", 0.0))
         if os.path.exists(os.path.join(data_dir, "images.npy")):
             data_source = ArrayImageDataset(
+                data_dir, batch_size=per_process_batch, rank=rank,
+                world=world, split="train", val_fraction=val_fraction,
+            )
+        elif os.path.exists(os.path.join(data_dir, "sparse.npy")):
+            data_source = ClickLogDataset(
                 data_dir, batch_size=per_process_batch, rank=rank,
                 world=world, split="train", val_fraction=val_fraction,
             )

@@ -97,7 +97,11 @@ class DeviceEmbedding(nn.Module):
             ),
             (self.vocab, self.dim),
         )
-        return jnp.asarray(table)[ids]
+        # Hash-space ids (file click logs hash categoricals over int64; the
+        # PS tier shards the same way) must fold into the table — JAX clamps
+        # out-of-bounds gathers, which would silently map nearly every real
+        # id to the last row and destroy the categorical signal.
+        return jnp.asarray(table)[ids % self.vocab]
 
 
 @register_model("deepfm")

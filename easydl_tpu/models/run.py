@@ -60,7 +60,11 @@ def file_data(args, bundle, rank: int = 0, world: int = 1,
     silently train a long-context model on short windows."""
     import os
 
-    from easydl_tpu.data import ArrayImageDataset, TokenFileDataset
+    from easydl_tpu.data import (
+        ArrayImageDataset,
+        ClickLogDataset,
+        TokenFileDataset,
+    )
 
     batch = batch or args.batch
     if os.path.exists(os.path.join(args.data_dir, "images.npy")):
@@ -68,6 +72,11 @@ def file_data(args, bundle, rank: int = 0, world: int = 1,
                                  rank=rank, world=world, seed=seed_offset,
                                  split=split,
                                  val_fraction=args.val_fraction)
+    if os.path.exists(os.path.join(args.data_dir, "sparse.npy")):
+        return ClickLogDataset(args.data_dir, batch_size=batch,
+                               rank=rank, world=world, seed=seed_offset,
+                               split=split,
+                               val_fraction=args.val_fraction)
     seq_len = args.seq_len or getattr(bundle.make_data(1), "seq_len", 0)
     if not seq_len:
         raise SystemExit(

@@ -218,3 +218,61 @@ def test_token_dataset_val_split_disjoint_and_stable(tmp_path):
     with pytest.raises(ValueError):
         TokenFileDataset(str(tmp_path), batch_size=4, seq_len=31,
                          split="val")  # val requires a fraction
+
+
+# ------------------------------------------------------------- click logs
+
+def _write_click_tsv(path, n=64, num_dense=13, num_sparse=26):
+    rng = np.random.RandomState(5)
+    with open(path, "w") as f:
+        for i in range(n):
+            dense = [str(rng.randint(0, 100)) if rng.rand() > 0.1 else ""
+                     for _ in range(num_dense)]
+            cats = ["%08x" % rng.randint(0, 1 << 30) if rng.rand() > 0.1
+                    else "" for _ in range(num_sparse)]
+            f.write("\t".join([str(i % 2)] + dense + cats) + "\n")
+
+
+def test_click_tsv_encode_and_dataset(tmp_path):
+    from easydl_tpu.data import ClickLogDataset, encode_click_tsv
+
+    tsv = tmp_path / "clicks.tsv"
+    _write_click_tsv(str(tsv))
+    n = encode_click_tsv([str(tsv)], str(tmp_path / "enc"))
+    assert n == 64
+    ds = ClickLogDataset(str(tmp_path / "enc"), batch_size=8, loop=False)
+    total = 0
+    for batch in ds:
+        assert batch["sparse_ids"].shape == (8, 26)
+        assert batch["sparse_ids"].dtype == np.int64
+        assert batch["dense"].shape == (8, 13)
+        assert (batch["dense"] >= 0).all()  # log1p of clamped counts
+        assert set(np.unique(batch["label"])) <= {0.0, 1.0}
+        total += 8
+    assert total == 64
+    # missing/malformed tokens mapped deterministically: re-encode matches
+    encode_click_tsv([str(tsv)], str(tmp_path / "enc2"))
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "enc" / "sparse.npy"),
+        np.load(tmp_path / "enc2" / "sparse.npy"))
+
+
+def test_click_dataset_trains_deepfm_through_runner(tmp_path, eight_devices):
+    from easydl_tpu.data import encode_click_tsv
+
+    tsv = tmp_path / "clicks.tsv"
+    _write_click_tsv(str(tsv), n=128)
+    encode_click_tsv([str(tsv)], str(tmp_path / "enc"))
+
+    from easydl_tpu.models.run import main as run_main
+
+    argv = sys.argv
+    sys.argv = [
+        "run", "--model", "deepfm", "--steps", "3", "--batch", "16",
+        "--data-dir", str(tmp_path / "enc"),
+        "--model-arg", "vocab=1024", "--model-arg", "dim=4",
+    ]
+    try:
+        run_main()
+    finally:
+        sys.argv = argv
