@@ -1,0 +1,96 @@
+#!/usr/bin/env bash
+# Bootstrap an easydl_tpu worker agent on a Cloud TPU VM host.
+#
+# The TPU-native realisation of the reference's anticipated shell tooling
+# (SURVEY.md §2.1 item 6): run once per TPU VM worker (e.g. via
+# `gcloud compute tpus tpu-vm ssh --worker=all --command="$(cat this)"`,
+# or as a startup-script). It installs the framework, derives a stable
+# agent id from the TPU worker metadata, waits for the job master's
+# address file on the shared workdir, and supervises the per-host agent.
+#
+# Required environment (export or edit below):
+#   EASYDL_WORKDIR   shared job directory (NFS/GCS-fuse mount)
+# Optional:
+#   EASYDL_REPO      package source (default: this repo checked out beside
+#                    the script)
+#   EASYDL_AGENT_ID  override the derived agent id
+#   EASYDL_SLOTS     worker slots per host (default 1)
+#   EASYDL_WARM      1 = keep a warm standby worker (default 1)
+
+set -euo pipefail
+
+WORKDIR="${EASYDL_WORKDIR:?set EASYDL_WORKDIR to the shared job directory}"
+REPO="${EASYDL_REPO:-$(cd "$(dirname "$0")/.." && pwd)}"
+SLOTS="${EASYDL_SLOTS:-1}"
+WARM="${EASYDL_WARM:-1}"
+
+log() { echo "[easydl-bootstrap] $*" >&2; }
+
+# ---------------------------------------------------------------- identity
+# TPU VM workers learn their index from the metadata server; fall back to
+# the hostname for non-GCE test runs.
+metadata() {
+  # bounded: on non-GCE hosts the endpoint may blackhole rather than refuse
+  curl -sf --connect-timeout 2 --max-time 4 -H "Metadata-Flavor: Google" \
+    "http://metadata.google.internal/computeMetadata/v1/$1" 2>/dev/null || true
+}
+
+if [ -z "${EASYDL_AGENT_ID:-}" ]; then
+  worker_id="$(metadata instance/attributes/agent-worker-number)"
+  if [ -z "$worker_id" ]; then
+    worker_id="$(hostname)"
+  fi
+  EASYDL_AGENT_ID="agent-${worker_id}"
+fi
+log "agent id: ${EASYDL_AGENT_ID}"
+
+# ----------------------------------------------------------------- install
+if ! python3 -c "import easydl_tpu" 2>/dev/null; then
+  if [ ! -f "${REPO}/pyproject.toml" ]; then
+    # $0-based derivation fails when the script is PIPED to a shell
+    # (gcloud ... --command="$(cat this)"): there is no script path then.
+    log "ERROR: easydl_tpu not importable and ${REPO} is not a checkout;"
+    log "       export EASYDL_REPO=/path/to/easydl_tpu and re-run"
+    exit 2
+  fi
+  log "installing easydl_tpu from ${REPO}"
+  # with dependencies: a fresh VM image may lack jax/flax/grpcio/etc., and
+  # an agent missing any of them would just crash-loop
+  python3 -m pip install -q -e "${REPO}"
+fi
+
+# ------------------------------------------------------------------- agent
+# The master (trainer pod) publishes its address into the shared workdir;
+# the agent's --master-file path waits for it and re-reads it when the
+# trainer pod is replaced. The agent itself supervises the worker process
+# across membership generations; this loop only restarts the agent if IT
+# dies (host-level supervision).
+mkdir -p "${WORKDIR}"
+ARGS=(
+  -m easydl_tpu.elastic.agent
+  --id "${EASYDL_AGENT_ID}"
+  --master-file "${WORKDIR}/master.json"
+  --workdir "${WORKDIR}"
+  --slots "${SLOTS}"
+  --platform tpu
+)
+if [ "${WARM}" = "1" ]; then
+  ARGS+=(--warm-start)
+fi
+
+backoff=1
+while :; do
+  log "starting agent (slots=${SLOTS}, warm=${WARM})"
+  set +e
+  python3 "${ARGS[@]}"
+  rc=$?
+  set -e
+  if [ "$rc" -eq 0 ]; then
+    log "agent exited cleanly (job done)"
+    exit 0
+  fi
+  log "agent exited rc=${rc}; restarting in ${backoff}s"
+  sleep "${backoff}"
+  backoff=$((backoff * 2))
+  if [ "$backoff" -gt 60 ]; then backoff=60; fi
+done
