@@ -53,9 +53,19 @@ if ! python3 -c "import easydl_tpu" 2>/dev/null; then
     log "       export EASYDL_REPO=/path/to/easydl_tpu and re-run"
     exit 2
   fi
+  # On a TPU VM the plain `jax` dependency resolves to the CPU wheel —
+  # workers would silently train on host CPU. Install the TPU extra (with
+  # the libtpu index) first when the metadata server says this host has an
+  # accelerator.
+  if [ -n "$(metadata instance/attributes/accelerator-type)" ] \
+     && ! python3 -c "import jax" 2>/dev/null; then
+    log "installing jax[tpu] (TPU VM detected)"
+    python3 -m pip install -q "jax[tpu]" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+  fi
   log "installing easydl_tpu from ${REPO}"
-  # with dependencies: a fresh VM image may lack jax/flax/grpcio/etc., and
-  # an agent missing any of them would just crash-loop
+  # with dependencies: a fresh VM image may lack flax/grpcio/etc., and an
+  # agent missing any of them would just crash-loop
   python3 -m pip install -q -e "${REPO}"
 fi
 
@@ -81,6 +91,7 @@ fi
 backoff=1
 while :; do
   log "starting agent (slots=${SLOTS}, warm=${WARM})"
+  started=$(date +%s)
   set +e
   python3 "${ARGS[@]}"
   rc=$?
@@ -88,6 +99,12 @@ while :; do
   if [ "$rc" -eq 0 ]; then
     log "agent exited cleanly (job done)"
     exit 0
+  fi
+  # A long healthy run forgives earlier crashes: without this, one crash
+  # after days of uptime would still wait the max accumulated backoff —
+  # avoidable recovery latency in a framework measured on exactly that.
+  if [ $(( $(date +%s) - started )) -gt 60 ]; then
+    backoff=1
   fi
   log "agent exited rc=${rc}; restarting in ${backoff}s"
   sleep "${backoff}"
