@@ -199,6 +199,11 @@ class KubeCrSource:
                 self._stop.wait(self._backoff)
             except OSError as e:
                 log.error("watch %s connection error: %s", plural, e)
+                # Full resync after a connection failure: an API server that
+                # restarted may have a DIFFERENT resourceVersion history
+                # (etcd restore), and a watch resumed from our stale rv
+                # could silently miss events without ever getting a 410.
+                rv = None
                 self._stop.wait(self._backoff)
 
     # ------------------------------------------------------------- lifecycle

@@ -45,6 +45,17 @@ fi
 log "agent id: ${EASYDL_AGENT_ID}"
 
 # ----------------------------------------------------------------- install
+# On a TPU VM the plain `jax` dependency resolves to the CPU wheel — workers
+# would silently train on host CPU. The guard tests for a TPU-FUNCTIONAL
+# install (libtpu present), not mere importability: a leftover CPU wheel
+# must be upgraded, and this applies even when easydl_tpu itself is already
+# installed.
+if [ -n "$(metadata instance/attributes/accelerator-type)" ] \
+   && ! python3 -c "import libtpu" 2>/dev/null; then
+  log "installing jax[tpu] (TPU VM detected, no libtpu present)"
+  python3 -m pip install -q "jax[tpu]" \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+fi
 if ! python3 -c "import easydl_tpu" 2>/dev/null; then
   if [ ! -f "${REPO}/pyproject.toml" ]; then
     # $0-based derivation fails when the script is PIPED to a shell
@@ -52,16 +63,6 @@ if ! python3 -c "import easydl_tpu" 2>/dev/null; then
     log "ERROR: easydl_tpu not importable and ${REPO} is not a checkout;"
     log "       export EASYDL_REPO=/path/to/easydl_tpu and re-run"
     exit 2
-  fi
-  # On a TPU VM the plain `jax` dependency resolves to the CPU wheel —
-  # workers would silently train on host CPU. Install the TPU extra (with
-  # the libtpu index) first when the metadata server says this host has an
-  # accelerator.
-  if [ -n "$(metadata instance/attributes/accelerator-type)" ] \
-     && ! python3 -c "import jax" 2>/dev/null; then
-    log "installing jax[tpu] (TPU VM detected)"
-    python3 -m pip install -q "jax[tpu]" \
-      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
   fi
   log "installing easydl_tpu from ${REPO}"
   # with dependencies: a fresh VM image may lack flax/grpcio/etc., and an

@@ -30,7 +30,7 @@ CR_PLURALS = ("elasticjobs", "jobresources")
 class FakeKubeApiServer:
     """In-memory pod + CR store behind a real HTTP server."""
 
-    def __init__(self, max_watch_s: float = 10.0):
+    def __init__(self, max_watch_s: float = 10.0, port: int = 0):
         self.pods = {}  # name -> manifest dict
         self.crs = {p: {} for p in CR_PLURALS}  # plural -> name -> doc
         self.events = {p: [] for p in CR_PLURALS}  # plural -> [(rv, type, doc)]
@@ -230,7 +230,9 @@ class FakeKubeApiServer:
                     doc = store.pods.pop(name)
                 self._send(200, doc)
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        # explicit port supports "API server restarts at the same address"
+        # tests (allow_reuse_address lets a successor rebind immediately)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True

@@ -225,3 +225,39 @@ def test_full_lifecycle_through_api_server(srv):
     finally:
         src.stop()
         ctl.stop()
+
+
+def test_watch_survives_api_server_restart():
+    """An API-server outage (rolling restart: connection refused for a
+    while, then back at the same address with fresh state) must not kill
+    the watch loops — they back off, re-LIST, and converge on the restarted
+    server's state, including CRs created while the operator was blind."""
+    srv = FakeKubeApiServer(max_watch_s=1.0)
+    port = srv._httpd.server_address[1]
+    store = CrStore()
+    src = KubeCrSource(store, client(srv), watch_timeout_s=1.0,
+                       retry_backoff_s=0.2).start()
+    try:
+        srv.put_cr(JOB_PLURAL, job_crd("pre"))
+        srv.put_cr(JOB_PLURAL, job_crd("pre2"))  # old-server rv reaches 2
+        wait_for(lambda: store.job("pre2") is not None, desc="pre-outage jobs")
+        srv.stop()  # outage begins: every request now connection-refused
+        time.sleep(1.0)
+        # Server comes back at the SAME address (k8s service VIP) with
+        # restored state plus a job created while we were down. Its rv
+        # counter restarts, so the restarted max rv EQUALS our last-seen rv
+        # — a watch resumed from the stale rv would deliver nothing and
+        # never 410; only the forced post-outage re-LIST can converge.
+        srv2 = FakeKubeApiServer(max_watch_s=1.0, port=port)
+        try:
+            srv2.put_cr(JOB_PLURAL, job_crd("pre"))
+            srv2.put_cr(JOB_PLURAL, job_crd("during-outage"))
+            wait_for(lambda: store.job("during-outage") is not None,
+                     timeout=15, desc="job created during outage")
+            # the re-LIST is a full resync: it picked up during-outage AND
+            # mirrored pre2's absence (deleted while we were blind)
+            assert store.jobs() == ["during-outage", "pre"]
+        finally:
+            srv2.stop()
+    finally:
+        src.stop()
