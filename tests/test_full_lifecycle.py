@@ -106,10 +106,12 @@ def test_full_reference_lifecycle(tmp_path):
         store.submit_job(job)
         pump_thread.start()
 
-        # steps 2-3: trainer pod only
+        # steps 2-3: trainer pod only. Generous timeout: on an oversubscribed
+        # 1-core host the trainer's jax import alone can take >30s, and this
+        # wait also absorbs the operator's first reconcile pass.
         wait_for(
             lambda: [p.role for p in api.list_pods(job_name)] == ["trainer"],
-            30, "trainer pod launched first (and alone)",
+            90, "trainer pod launched first (and alone)",
         )
 
         # steps 4-6: trainer applies the plan; operator launches workers
