@@ -276,3 +276,24 @@ def test_click_dataset_trains_deepfm_through_runner(tmp_path, eight_devices):
         run_main()
     finally:
         sys.argv = argv
+
+
+def test_bert_trains_from_token_shards(tmp_path, eight_devices):
+    """BERT's masked-LM loss reads only batch['inputs'] (masking happens
+    inside the jitted loss), so the same token shards feed it unchanged —
+    every LM family consumes the one file format."""
+    write_token_shards(np.arange(4096) % 300, str(tmp_path))
+
+    from easydl_tpu.models.run import main as run_main
+
+    argv = sys.argv
+    sys.argv = [
+        "run", "--model", "bert", "--steps", "3", "--batch", "8",
+        "--data-dir", str(tmp_path), "--seq-len", "32",
+        "--model-arg", "size=test", "--model-arg", "seq_len=32",
+        "--model-arg", "vocab=384",
+    ]
+    try:
+        run_main()
+    finally:
+        sys.argv = argv
