@@ -95,19 +95,67 @@ def run_worker(env: Dict[str, str]) -> int:
     devices = jax.device_count()
     mesh_axes = dict(cfg.get("mesh", {}))
     mesh = build_mesh(MeshSpec.from_world(devices, **mesh_axes))
-    bundle = get_model(cfg["model"], **cfg.get("model_kwargs", {}))
+    model_kwargs = dict(cfg.get("model_kwargs", {}))
+    bundle = get_model(cfg["model"], **model_kwargs)
     global_batch = int(cfg.get("global_batch", 32))
-    trainer = Trainer(
-        init_fn=bundle.init_fn,
-        loss_fn=bundle.loss_fn,
-        optimizer=optax.adam(float(cfg.get("lr", 1e-3))),
-        config=TrainConfig(
-            global_batch=global_batch,
-            grad_accum=int(cfg.get("grad_accum", 1)),
-            seed=int(cfg.get("seed", 0)),
-        ),
-        mesh=mesh,
+    train_config = TrainConfig(
+        global_batch=global_batch,
+        grad_accum=int(cfg.get("grad_accum", 1)),
+        seed=int(cfg.get("seed", 0)),
     )
+    ps_mode = model_kwargs.get("embedding") == "ps"
+    if ps_mode:
+        # Config-5 deployment shape under the elastic runtime: dense model on
+        # the mesh, sparse tables on the PS pods the operator launched.
+        # Shards are discovered through the registry (the pods publish their
+        # shard index/address there); the PS tier holds its rows across
+        # worker generations, so elastic worker scaling never touches it.
+        from easydl_tpu.ps import registry as ps_registry
+        from easydl_tpu.ps.client import ShardedPsClient
+        from easydl_tpu.ps.table import TableSpec
+        from easydl_tpu.ps.trainer import PsTrainer
+
+        if "dim" not in model_kwargs:
+            # The PS table's dim must equal the dense tower's embedding dim;
+            # deriving it from a model-default would silently diverge if the
+            # default ever changed — demand it explicitly.
+            raise RuntimeError(
+                "embedding='ps' requires model_kwargs['dim'] so the PS "
+                "table matches the model's embedding dim"
+            )
+        try:
+            num_shards, addrs = ps_registry.discover(workdir, timeout=120)
+        except TimeoutError as e:
+            raise RuntimeError(
+                f"embedding='ps' but the PS registry under {workdir}/ps "
+                f"never completed — is the parameter_server role running? "
+                f"({e})"
+            ) from e
+        ps_client = ShardedPsClient(addrs, registry_workdir=workdir)
+        trainer = PsTrainer(
+            init_fn=bundle.init_fn,
+            loss_fn=bundle.loss_fn,
+            optimizer=optax.adam(float(cfg.get("lr", 1e-3))),
+            config=train_config,
+            client=ps_client,
+            table=TableSpec(
+                name=str(cfg.get("ps_table", "emb")),
+                dim=int(model_kwargs["dim"]),
+                optimizer=str(cfg.get("ps_optimizer", "adagrad")),
+                lr=float(cfg.get("ps_lr", cfg.get("lr", 1e-3))),
+            ),
+            mesh=mesh,
+        )
+        log.info("gen %d: PS mode — %d shard(s) via registry", generation,
+                 num_shards)
+    else:
+        trainer = Trainer(
+            init_fn=bundle.init_fn,
+            loss_fn=bundle.loss_fn,
+            optimizer=optax.adam(float(cfg.get("lr", 1e-3))),
+            config=train_config,
+            mesh=mesh,
+        )
     # Async saves overlap chunk IO with training; the commit barrier runs on
     # this (main) thread via ckpt.finalize() at step boundaries below.
     ckpt = CheckpointManager(os.path.join(workdir, "ckpt"), keep=3, async_save=True)
@@ -121,9 +169,36 @@ def run_worker(env: Dict[str, str]) -> int:
         )
     ) if world > 1 else (-1 if local_latest is None else local_latest)
 
+    ps_ckpt_dir = os.path.join(workdir, "ps-ckpt")
+
+    def ps_save(step: int) -> None:
+        """Snapshot the PS tier at the same step as a dense save (rank 0
+        triggers; the shards write server-side). Called BEFORE the dense
+        save, so any dense-committed step has a sparse counterpart — restore
+        then rolls BOTH back to the same boundary, and replayed pushes can't
+        double-count into optimizer accumulators."""
+        if ps_mode and rank == 0:
+            try:
+                trainer.client.save(ps_ckpt_dir, step)
+            except Exception as e:  # PS save failure must not kill training
+                log.warning("ps snapshot at step %d failed: %s", step, e)
+
     if latest >= 0:
         state = trainer.restore_from(ckpt, latest)
         start_step = latest
+        if ps_mode and rank == 0:
+            try:
+                trainer.client.restore(ps_ckpt_dir, step=latest)
+                log.info("gen %d: ps tier restored to step %d", generation,
+                         latest)
+            except FileNotFoundError:
+                log.warning(
+                    "gen %d: no ps snapshot for step %d — sparse rows keep "
+                    "their live (post-checkpoint) values", generation, latest,
+                )
+        if ps_mode and world > 1:
+            # every rank must observe the restored rows before training
+            multihost_utils.sync_global_devices(f"ps_restore_{generation}")
         log.info("gen %d: restored step %d onto world=%d (%d devices)",
                  generation, latest, world, devices)
     else:
@@ -220,6 +295,7 @@ def run_worker(env: Dict[str, str]) -> int:
         if want_quiesce:
             log.info("gen %d: quiescing at step %d", generation, step)
             timeline.emit(tl_path, "quiesce_ckpt_begin", generation, step=step)
+            ps_save(step)
             ckpt.save(step, state, metadata=_data_meta())  # no-op if already committed
             ckpt.wait()  # commit must land before this process exits
             timeline.emit(tl_path, "quiesce_exit", generation, step=step)
@@ -238,11 +314,13 @@ def run_worker(env: Dict[str, str]) -> int:
             first_step_emitted = True
 
         if ckpt_interval > 0 and step % ckpt_interval == 0 and step < total_steps:
+            ps_save(step)
             ckpt.save(step, state, metadata=_data_meta())
         # Complete any deferred multi-process commit once every rank's chunk
         # IO is done (collective agreement; barriers on this main thread).
         ckpt.finalize()
 
+    ps_save(total_steps)
     ckpt.save(total_steps, state, metadata=_data_meta())
     ckpt.wait()
     if rank == 0:

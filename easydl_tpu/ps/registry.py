@@ -81,6 +81,26 @@ def shard_map(workdir: str) -> Dict[int, dict]:
     return latest
 
 
+def discover(workdir: str, timeout: float = 120.0) -> Tuple[int, Tuple[str, ...]]:
+    """Learn the cluster shape from the registry itself: wait (one deadline)
+    until some pod has published — its entry carries ``num_shards`` — and
+    every shard of that count is present. Returns (num_shards, addresses)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        ents = entries(workdir)
+        if ents:
+            n = max(int(d["num_shards"]) for d in ents.values())
+            m = shard_map(workdir)
+            if all(s in m for s in range(n)):
+                return n, tuple(m[s]["address"] for s in range(n))
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"ps registry under {workdir} incomplete after {timeout:.0f}s"
+                f" ({len(ents)} publication(s))"
+            )
+        time.sleep(0.1)
+
+
 def addresses(workdir: str, num_shards: int,
               timeout: float = 0.0) -> Tuple[str, ...]:
     """Shard-ordered address tuple; with ``timeout`` waits for completeness.

@@ -133,6 +133,20 @@ class PsTrainer(Trainer):
             donate_argnums=(0,) if self.config.donate_state else (),
         )
 
+    @staticmethod
+    def _local_rows(arr: jax.Array) -> np.ndarray:
+        """This process's rows of a batch-sharded global array, in local
+        order. device_get on the global array would fail under multi-process
+        JAX (non-addressable shards); each process pushes exactly the
+        gradient rows for the ids IT pulled — the multi-host PS contract."""
+        if jax.process_count() == 1:
+            return np.asarray(jax.device_get(arr))
+        shards = sorted(
+            arr.addressable_shards,
+            key=lambda s: (s.index[0].start or 0) if s.index else 0,
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
     def train_step(self, state: TrainState, host_batch: Any):
         ids = np.asarray(host_batch[self.ids_key])
         emb = self.client.pull(self.table.name, ids)
@@ -141,7 +155,7 @@ class PsTrainer(Trainer):
             state, self.shard_batch(emb), self.shard_batch(batch)
         )
         self.client.push(
-            self.table.name, ids, np.asarray(jax.device_get(gemb)), self.push_scale
+            self.table.name, ids, self._local_rows(gemb), self.push_scale
         )
         return state, metrics
 
@@ -174,8 +188,8 @@ class PsTrainer(Trainer):
                     state, self.shard_batch(emb), self.shard_batch(rest)
                 )
                 self.client.push(
-                    self.table.name, ids,
-                    np.asarray(jax.device_get(gemb)), self.push_scale,
+                    self.table.name, ids, self._local_rows(gemb),
+                    self.push_scale,
                 )
                 if on_metrics is not None:
                     on_metrics(metrics)

@@ -173,3 +173,67 @@ def test_preemption_kill_recovery(workdir):
         a0.stop()
         a1.stop()
         master.stop()
+
+
+def test_elastic_worker_with_ps_embedding(workdir):
+    """Config 5 under the FULL elastic runtime, multi-process: two elastic
+    workers (world 2) discover the operator-launched PS pods through the
+    registry and train the dense model on the mesh (worker.py PS mode),
+    each rank pushing only its own gradient rows. Paired dense+sparse
+    checkpoints land (ps-ckpt/ matches the dense steps); the PS tier's
+    rows live outside the worker lifecycle."""
+    import subprocess
+    import sys as _sys
+
+    from easydl_tpu.ps.client import ShardedPsClient
+    from easydl_tpu.ps.server import PsShard
+
+    ps_pods = []
+    master = None
+    agents = []
+    try:
+        for i in range(2):
+            ps_pods.append(subprocess.Popen(
+                [_sys.executable, "-m", "easydl_tpu.ps",
+                 "--name", f"eps-{i}", "--workdir", workdir,
+                 "--num-shards", "2", "--shard-index", str(i)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+            ))
+        cfg = {
+            "model": "widedeep",
+            "model_kwargs": {"embedding": "ps", "vocab": 2000, "dim": 8,
+                             "hidden": [32], "num_sparse": 5, "num_dense": 4},
+            "global_batch": 32, "total_steps": 10, "ckpt_interval": 5,
+            "lr": 3e-3, "seed": 0,
+        }
+        master = Master(job_name="cfg5-elastic", workdir=workdir,
+                        desired_workers=2, min_workers=2,
+                        worker_config=cfg).start()
+        agents = [Agent(f"a{i}", master.address, workdir, slots=2).start()
+                  for i in range(2)]
+        assert master.wait_done(timeout=300), master.status()
+        m0 = read_metrics(workdir, "a0")
+        assert m0 and m0[-1]["step"] == cfg["total_steps"]
+        assert m0[-1]["world_size"] == 4  # 2 procs x 2 devices
+        # the embedding rows landed on the REAL PS shards
+        client = ShardedPsClient.from_registry(workdir, 2, wait_s=10)
+        try:
+            assert client.total_rows("emb") > 0
+        finally:
+            client.close()
+        # sparse snapshots paired with the dense checkpoint steps
+        ps_steps = PsShard.saved_steps(os.path.join(workdir, "ps-ckpt"))
+        assert cfg["total_steps"] in ps_steps, ps_steps
+    finally:
+        for a in agents:
+            a.stop()
+        if master is not None:
+            master.stop()
+        for p in ps_pods:
+            p.terminate()
+        for p in ps_pods:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
