@@ -122,8 +122,12 @@ def main() -> None:
     ctl.start(resync_s=args.resync_s)
     cr_source = None
     if args.cr_source == "k8s":
-        from easydl_tpu.controller.kube_cr_source import KubeCrSource
+        from easydl_tpu.controller.kube_cr_source import (
+            KubeCrSource,
+            make_status_writer,
+        )
 
+        store.add_status_sink(make_status_writer(kube_client))
         cr_source = KubeCrSource(store, kube_client).start()
         log.info("operator watching CRs on %s (pod api: %s)",
                  kube_client.base_url, args.pod_api)

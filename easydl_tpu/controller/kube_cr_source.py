@@ -36,7 +36,11 @@ from typing import Any, Callable, Dict, Optional
 from easydl_tpu.api.job_spec import API_GROUP, JobSpec, SpecError
 from easydl_tpu.api.resource_plan import ResourcePlan
 from easydl_tpu.controller.kube_http import KubeApiError, KubeClient
-from easydl_tpu.controller.operator import CrStore, StalePlanError
+from easydl_tpu.controller.operator import (
+    TERMINAL_PHASES,
+    CrStore,
+    StalePlanError,
+)
 from easydl_tpu.utils.logging import get_logger
 
 log = get_logger("controller", "crwatch")
@@ -44,6 +48,22 @@ log = get_logger("controller", "crwatch")
 API_PREFIX = f"/apis/{API_GROUP}/v1alpha1"
 JOB_PLURAL = "elasticjobs"
 PLAN_PLURAL = "jobresources"
+
+
+def make_status_writer(client: KubeClient) -> Callable[[str, Dict[str, Any]], None]:
+    """CrStore status sink that writes ``ElasticJob.status`` back to the API
+    server — a merge-PATCH on the ``/status`` subresource, so ``kubectl get
+    elasticjobs`` shows the job phase (printer columns in
+    manifests/crds/elasticjob.yaml). Raises on failure so CrStore marks the
+    status dirty and the next reconcile pass retries the write."""
+
+    def write(job_name: str, status: Dict[str, Any]) -> None:
+        path = (f"{API_PREFIX}/namespaces/{client.namespace}/"
+                f"{JOB_PLURAL}/{job_name}/status")
+        client.request("PATCH", path, {"status": status},
+                       content_type="application/merge-patch+json")
+
+    return write
 
 
 class KubeCrSource:
@@ -90,6 +110,15 @@ class KubeCrSource:
             # ElasticJob spec edits don't re-submit (the job identity is the
             # spec); a MODIFIED event still pokes a reconcile pass.
             self.store.poke(job.name)
+        # Re-learn a previously written TERMINAL status — a restarted
+        # operator must keep a finished job finished even if its pods were
+        # GC'd. Only terminal phases are re-learned: ingesting live-phase
+        # statuses would replay our own write-back MODIFIED events into the
+        # store out of order and re-PATCH them in a feedback loop, while a
+        # live phase is recomputed by the next reconcile pass anyway.
+        st = doc.get("status")
+        if isinstance(st, dict) and st.get("phase") in TERMINAL_PHASES:
+            self.store.set_status(job.name, st)
         self._retry_pending(job.name)
 
     def _ingest_plan(self, doc: Dict[str, Any], event: str) -> None:

@@ -79,21 +79,24 @@ class KubeClient:
             )
 
     def _make_request(self, method: str, path: str,
-                      body: Optional[Dict[str, Any]]) -> urllib.request.Request:
+                      body: Optional[Dict[str, Any]],
+                      content_type: str = "application/json",
+                      ) -> urllib.request.Request:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method
         )
         req.add_header("Accept", "application/json")
         if data is not None:
-            req.add_header("Content-Type", "application/json")
+            req.add_header("Content-Type", content_type)
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
         return req
 
     def request(self, method: str, path: str,
-                body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        req = self._make_request(method, path, body)
+                body: Optional[Dict[str, Any]] = None,
+                content_type: str = "application/json") -> Dict[str, Any]:
+        req = self._make_request(method, path, body, content_type)
         try:
             with urllib.request.urlopen(
                 req, timeout=self._timeout, context=self._ctx

@@ -95,7 +95,9 @@ class Reconciler {
       auto it = by_name.find(u.first);
       if (it == by_name.end()) continue;  // already retired
       const Pod* old = it->second;
-      if (old->phase == "Terminating") continue;
+      // Succeeded pods completed their work: resizing one is meaningless and
+      // replacing it would re-run finished work (the completion loop).
+      if (old->phase == "Terminating" || old->phase == "Succeeded") continue;
       auto rit = replacement_of.find(u.first);
       if (rit != replacement_of.end()) {
         if (rit->second->phase == "Running") {
@@ -122,6 +124,18 @@ class Reconciler {
       const std::string& role = r.first;
       int want = r.second.first;
       const std::string& sig = r.second.second;
+      // Succeeded pods fill their slot permanently (k8s Job semantics): a
+      // pod only exits 0 when its work is complete, so the slot is not
+      // refilled and the pod is never scale_down'd. Identical in the
+      // Python twin — pinned by the parity fuzzer.
+      int done = 0;
+      for (const auto& p : pods_) {
+        if (p.role == role && !gone.count(p.name) && p.phase == "Succeeded") {
+          ++done;
+        }
+      }
+      int need = want - done;
+      if (need < 0) need = 0;
       // Active = serving pods of the role: Pending/Running, not deleted this
       // pass, and not an in-flight replacement (its old pod holds the slot).
       // The exclusion requires the old pod to still be SERVING — once it is
@@ -141,14 +155,14 @@ class Reconciler {
         active.push_back(&p);
       }
       int have = static_cast<int>(active.size());
-      for (int i = have; i < want; ++i) {
+      for (int i = have; i < need; ++i) {
         ops << "CREATE|" << NextName(role) << "|" << role << "|" << sig
             << "|\n";
       }
-      if (have > want) {
+      if (have > need) {
         std::sort(active.begin(), active.end(),
                   [](const Pod* a, const Pod* b) { return a->index > b->index; });
-        for (int i = 0; i < have - want; ++i) {
+        for (int i = 0; i < have - need; ++i) {
           ops << "DELETE|" << active[i]->name << "|scale_down\n";
           gone.insert(active[i]->name);
         }

@@ -24,7 +24,7 @@ import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from easydl_tpu.api.job_spec import JobSpec, ResourceSpec
 from easydl_tpu.api.resource_plan import ResourcePlan
@@ -43,6 +43,15 @@ class StalePlanError(ValueError):
     """A plan write with version <= the currently applied one."""
 
 
+#: ElasticJob phases a job can never leave (k8s Job semantics). The trainer
+#: pod is the in-job authority on completion — it exits 0 once the master
+#: reports the job done — so the operator latches the job terminal on trainer
+#: exit and stops reconciling pods into existence
+#: (docs/design/elastic-training-operator.md:47-55: the operator owns the pod
+#: lifecycle, which includes ENDING it; README.md:12).
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+
 class CrStore:
     """In-memory custom-resource store with a watch queue — the event bus the
     reference routes all control flow through."""
@@ -50,6 +59,9 @@ class CrStore:
     def __init__(self):
         self._jobs: Dict[str, JobSpec] = {}
         self._plans: Dict[str, ResourcePlan] = {}
+        self._statuses: Dict[str, dict] = {}
+        self._status_dirty: Set[str] = set()  # sink write failed; retry
+        self._status_sinks: List[Callable[[str, dict], None]] = []
         self._lock = threading.Lock()
         self._events: "queue.Queue[tuple]" = queue.Queue()
 
@@ -65,6 +77,8 @@ class CrStore:
         with self._lock:
             self._jobs.pop(name, None)
             self._plans.pop(name, None)
+            self._statuses.pop(name, None)
+            self._status_dirty.discard(name)
         self._events.put(("job_deleted", name))
 
     def apply_plan(self, plan: ResourcePlan) -> None:
@@ -81,6 +95,49 @@ class CrStore:
                 )
             self._plans[plan.job_name] = plan
         self._events.put(("plan_applied", plan.job_name))
+
+    def set_status(self, job_name: str, status: Optional[dict]) -> bool:
+        """Record ElasticJob.status. Terminal phases latch: once a job is
+        Succeeded/Failed, a later write can never move it back to a live
+        phase (or flip it to the other terminal one) — only refresh details
+        under the same phase (e.g. role counts after completion GC). Returns
+        True when the stored status changed; registered sinks (the k8s
+        status write-back) fire on change, and a sink failure marks the
+        status dirty so the next identical write retries the sink."""
+        if not status:
+            return False
+        with self._lock:
+            cur = self._statuses.get(job_name)
+            if (cur is not None and cur.get("phase") in TERMINAL_PHASES
+                    and status.get("phase") != cur.get("phase")):
+                return False
+            changed = cur != status
+            if not changed and job_name not in self._status_dirty:
+                return False
+            self._statuses[job_name] = dict(status)
+            self._status_dirty.discard(job_name)
+            sinks = list(self._status_sinks)
+        ok = True
+        for fn in sinks:
+            try:
+                fn(job_name, dict(status))
+            except Exception:
+                ok = False
+                log.exception("status sink failed for %s", job_name)
+        if not ok:
+            with self._lock:
+                self._status_dirty.add(job_name)
+        return changed
+
+    def job_status(self, job_name: str) -> Optional[dict]:
+        with self._lock:
+            s = self._statuses.get(job_name)
+            return dict(s) if s is not None else None
+
+    def add_status_sink(self, fn: Callable[[str, dict], None]) -> None:
+        """fn(job_name, status) is called on every status change — the k8s
+        deployment hooks the API-server write-back here."""
+        self._status_sinks.append(fn)
 
     def job(self, name: str) -> Optional[JobSpec]:
         with self._lock:
@@ -111,6 +168,7 @@ class JobStatus:
     trainer_created: bool = False
     pods: Dict[str, int] = field(default_factory=dict)  # role -> live count
     last_ops: List[str] = field(default_factory=list)
+    phase: str = ""  # Pending | Running | Succeeded | Failed
 
 
 class ElasticJobController:
@@ -121,10 +179,19 @@ class ElasticJobController:
                  force_python_core: bool = False,
                  restart_backoff_base: float = 0.5,
                  restart_backoff_max: float = 30.0,
-                 restart_backoff_reset: float = 60.0):
+                 restart_backoff_reset: float = 60.0,
+                 trainer_backoff_limit: Optional[int] = None,
+                 gc_on_completion: bool = True):
         self.store = store
         self.pods = pod_api
         self._force_py = force_python_core
+        # k8s Job backoffLimit analogue: None = restart the trainer forever
+        # (reference elasticity semantics); an int latches the job Failed
+        # after that many CONSECUTIVE trainer failures.
+        self._trainer_backoff_limit = trainer_backoff_limit
+        # Terminal jobs GC their still-live pods (PS/evaluator pods never
+        # exit on their own); terminal-phase pods are retained for logs.
+        self._gc_on_completion = gc_on_completion
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._drift_warned: set = set()  # (job, pod, sig) already reported
@@ -181,15 +248,69 @@ class ElasticJobController:
             }
             return status
 
+        # Terminal latch: the trainer exits 0 exactly when the master reports
+        # the job complete, so a Succeeded trainer pod ends the job — for
+        # good. A previously latched status (in-memory, or re-learned from
+        # ElasticJob.status after an operator restart) keeps the latch even
+        # if the trainer pod record is later GC'd externally.
+        prior = self.store.job_status(job_name) or {}
+        phase = prior.get("phase", "")
+        message = ""
+        if phase not in TERMINAL_PHASES:
+            if any(p.role == "trainer" and p.phase == "Succeeded"
+                   for p in observed):
+                phase = "Succeeded"
+                message = "trainer completed"
+
         # Figure step 3: trainer pod first, before any plan exists. The
         # trainer is operator-owned: a Failed trainer is retired and replaced
         # under a fresh name (names are never reused), independent of any plan.
         trainer_pods = [p for p in observed if p.role == "trainer"]
-        for p in trainer_pods:
-            if p.phase == "Failed":
-                self.pods.delete_pod(p.name)
-                status.last_ops.append(f"DELETE {p.name} (failed)")
-                self._note_failure(job_name, "trainer")
+        if phase not in TERMINAL_PHASES:
+            for p in trainer_pods:
+                if p.phase == "Failed":
+                    self.pods.delete_pod(p.name)
+                    status.last_ops.append(f"DELETE {p.name} (failed)")
+                    self._note_failure(job_name, "trainer")
+            # The deletions above may not be reflected in `observed` (it
+            # predates them when the recreate is backoff-deferred); strip the
+            # handled Failed trainers so the plan reconcile below doesn't
+            # re-DELETE them and double-count the failure toward the limit.
+            observed = [
+                p for p in observed
+                if not (p.role == "trainer" and p.phase == "Failed")
+            ]
+            limit = self._trainer_backoff_limit
+            if limit is not None:
+                fails = self._backoff.get((job_name, "trainer"), (0, 0, 0))[0]
+                if fails > limit:
+                    phase = "Failed"
+                    message = (f"trainer exceeded restart limit "
+                               f"({fails} consecutive failures > {limit})")
+
+        if phase in TERMINAL_PHASES:
+            # The job is over: create nothing, level nothing. Still-live pods
+            # will never finish on their own (a parameter server serves until
+            # told to stop) — GC them; terminal pods are retained for logs.
+            gc_deleted = False
+            if self._gc_on_completion:
+                for p in observed:
+                    if p.phase in ("Pending", "Running"):
+                        self.pods.delete_pod(p.name)
+                        gc_deleted = True
+                        status.last_ops.append(
+                            f"DELETE {p.name} (job {phase.lower()})"
+                        )
+            self._write_status(
+                job_name, phase, message,
+                self.pods.list_pods(job_name) if gc_deleted else observed,
+            )
+            status.phase = phase
+            if status.last_ops:
+                log.info("reconciled %s (%s): %s", job_name, phase,
+                         "; ".join(status.last_ops))
+            return status
+
         if self._create_deferred(job_name, "trainer"):
             pass  # crash-looping trainer: let the backoff window elapse
         elif not any(p.phase in ("Pending", "Running") for p in trainer_pods):
@@ -248,12 +369,46 @@ class ElasticJobController:
                 status.last_ops.append(f"{op.verb} {op.name}"
                                        + (f" ({op.reason})" if op.reason else ""))
 
-        for p in self.pods.list_pods(job_name):
+        final = self.pods.list_pods(job_name)
+        for p in final:
             if p.phase in ("Pending", "Running"):
                 status.pods[p.role] = status.pods.get(p.role, 0) + 1
+        status.phase = (
+            "Running"
+            if any(p.role == "trainer" and p.phase == "Running" for p in final)
+            else "Pending"
+        )
+        self._write_status(job_name, status.phase, "", final)
         if status.last_ops:
             log.info("reconciled %s: %s", job_name, "; ".join(status.last_ops))
         return status
+
+    def _write_status(self, job_name: str, phase: str, message: str,
+                      pods: List[Pod]) -> None:
+        """Build the ElasticJob.status document from the caller's pod list
+        and store it (CrStore latches terminal phases and fans out to sinks —
+        the k8s deployment PATCHes the /status subresource from there)."""
+        roles: Dict[str, Dict[str, int]] = {}
+        for p in pods:
+            rc = roles.setdefault(
+                p.role, {"active": 0, "succeeded": 0, "failed": 0}
+            )
+            if p.phase in ("Pending", "Running"):
+                rc["active"] += 1
+            elif p.phase == "Succeeded":
+                rc["succeeded"] += 1
+            elif p.phase == "Failed":
+                rc["failed"] += 1
+        doc: dict = {"phase": phase, "roles": roles}
+        prior = self.store.job_status(job_name) or {}
+        msg = message or prior.get("message", "")
+        if msg:
+            doc["message"] = msg
+        if phase in TERMINAL_PHASES:
+            doc["completionTime"] = prior.get("completionTime") or time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            )
+        self.store.set_status(job_name, doc)
 
     def _warn_resource_drift(self, job_name: str, plan: ResourcePlan,
                              observed) -> None:

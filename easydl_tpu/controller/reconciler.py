@@ -157,7 +157,9 @@ def _py_reconcile(desired: str, observed: str) -> str:
 
     for name, sig in updations:
         old = by_name.get(name)
-        if old is None or old["phase"] == "Terminating":
+        # Succeeded pods completed their work: resizing one is meaningless
+        # and replacing it would re-run finished work (the completion loop).
+        if old is None or old["phase"] in ("Terminating", "Succeeded"):
             continue
         rep = replacement_of.get(name)
         if rep is not None:
@@ -183,16 +185,27 @@ def _py_reconcile(desired: str, observed: str) -> str:
 
     for role in sorted(roles):  # C++ core iterates a std::map: sorted
         want, sig = roles[role]
+        # Succeeded pods fill their slot permanently (k8s Job semantics): a
+        # worker only exits 0 when its work is COMPLETE, so the slot must not
+        # be refilled — recreating it re-runs "job done" forever (the round-3
+        # completion loop). Succeeded pods are retained, never scale_down'd;
+        # any job-end GC is an explicit operator action, not a levelling one.
+        done = sum(
+            1 for p in pods
+            if p["role"] == role and p["name"] not in gone
+            and p["phase"] == "Succeeded"
+        )
+        need = max(0, want - done)
         active = [
             p for p in pods
             if p["role"] == role and p["name"] not in gone
             and p["phase"] in ("Pending", "Running")
             and not replacement_in_flight(p)
         ]
-        for _ in range(max(0, want - len(active))):
+        for _ in range(max(0, need - len(active))):
             ops.append(f"CREATE|{next_name(role)}|{role}|{sig}|")
-        if len(active) > want:
-            for p in sorted(active, key=lambda p: -p["index"])[: len(active) - want]:
+        if len(active) > need:
+            for p in sorted(active, key=lambda p: -p["index"])[: len(active) - need]:
                 ops.append(f"DELETE|{p['name']}|scale_down")
                 gone.add(p["name"])
     return "".join(op + "\n" for op in ops)

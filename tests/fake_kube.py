@@ -199,6 +199,38 @@ class FakeKubeApiServer:
                     return
                 self._send(405, {"reason": "MethodNotAllowed"})
 
+            def do_PATCH(self):
+                # merge-PATCH on the /status subresource (the operator's
+                # ElasticJob.status write-back).
+                if not self.path.startswith(CR_PREFIX):
+                    self._send(405, {"reason": "MethodNotAllowed"})
+                    return
+                rest = self.path[len(CR_PREFIX):]
+                parts = urllib.parse.urlparse(rest).path.strip("/").split("/")
+                plural = parts[1] if len(parts) > 1 else ""
+                name = parts[2] if len(parts) > 2 else ""
+                sub = parts[3] if len(parts) > 3 else ""
+                if plural not in CR_PLURALS or sub != "status":
+                    self._send(404, {"reason": "NotFound"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                patch = json.loads(self.rfile.read(n))
+                with store.cond:
+                    doc = store.crs[plural].get(name)
+                    if doc is None:
+                        self._send(404, {"reason": "NotFound"})
+                        return
+                    doc = dict(doc)
+                    doc["status"] = patch.get("status", {})
+                    store.rv += 1
+                    doc.setdefault("metadata", {})["resourceVersion"] = str(
+                        store.rv
+                    )
+                    store.crs[plural][name] = doc
+                    store.events[plural].append((store.rv, "MODIFIED", doc))
+                    store.cond.notify_all()
+                self._send(200, doc)
+
             def do_GET(self):
                 if self.path.startswith(CR_PREFIX):
                     self._cr_get()

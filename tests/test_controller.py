@@ -50,7 +50,7 @@ def test_native_python_parity_randomized():
     """The C++ core and its Python twin must make identical decisions on
     randomized cluster states."""
     rng = random.Random(0)
-    phases = ["Pending", "Running", "Failed", "Terminating"]
+    phases = ["Pending", "Running", "Succeeded", "Failed", "Terminating"]
     for trial in range(200):
         job = "j"
         n_pods = rng.randint(0, 8)
@@ -391,3 +391,212 @@ def test_background_controller_converges():
             raise AssertionError("controller did not recover failed worker")
     finally:
         ctl.stop()
+
+
+# ------------------------------------------------------- terminal job state
+
+
+def test_succeeded_worker_slot_not_refilled():
+    """A pod that exits 0 completed its work (k8s Job semantics): the slot
+    is filled forever — recreating it would re-run 'job done' in a loop
+    (the round-3 completion-loop defect)."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(ps=0, workers=2))
+    ctl.reconcile_job("deepctr")
+    api.tick()
+    api.set_phase("deepctr-worker-0", "Succeeded")
+    ctl.reconcile_job("deepctr")
+    workers = [p for p in api.list_pods("deepctr") if p.role == "worker"]
+    # no replacement created; the Succeeded record is retained, not deleted
+    assert sorted(p.name for p in workers) == [
+        "deepctr-worker-0", "deepctr-worker-1"
+    ]
+    assert api.get_pod("deepctr-worker-0").phase == "Succeeded"
+    # but a FAILED pod is still replaced (elasticity is untouched)
+    api.fail("deepctr-worker-1")
+    ctl.reconcile_job("deepctr")
+    live = [p for p in api.list_pods("deepctr")
+            if p.role == "worker" and p.phase in ("Pending", "Running")]
+    assert [p.name for p in live] == ["deepctr-worker-2"]
+
+
+def test_trainer_success_latches_job_terminal():
+    """Trainer pod Succeeded = job complete: no trainer recreation, no
+    levelling, still-live service pods GC'd, status written — and the state
+    is STABLE across arbitrarily many reconcile passes."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(ps=1, workers=2))
+    ctl.reconcile_job("deepctr")
+    api.tick()
+    # workers finish, then the trainer exits 0
+    api.set_phase("deepctr-worker-0", "Succeeded")
+    api.set_phase("deepctr-worker-1", "Succeeded")
+    api.set_phase("deepctr-trainer-0", "Succeeded")
+    st = ctl.reconcile_job("deepctr")
+    assert st.phase == "Succeeded"
+    # the PS pod never exits on its own: completion GC deletes it
+    assert api.get_pod("deepctr-parameter_server-0") is None
+    names = {p.name for p in api.list_pods("deepctr")}
+    # two more passes create/delete NOTHING (the round-3 loop is gone)
+    for _ in range(3):
+        st = ctl.reconcile_job("deepctr")
+        assert st.phase == "Succeeded"
+        assert not any(op.startswith(("CREATE", "DELETE"))
+                       for op in st.last_ops), st.last_ops
+    assert {p.name for p in api.list_pods("deepctr")} == names
+    status = store.job_status("deepctr")
+    assert status["phase"] == "Succeeded"
+    assert status["completionTime"]
+    assert status["roles"]["worker"]["succeeded"] == 2
+    # a newer plan cannot resurrect a finished job
+    store.apply_plan(make_plan(ps=2, workers=4, version=2))
+    ctl.reconcile_job("deepctr")
+    assert {p.name for p in api.list_pods("deepctr")} == names
+
+
+def test_terminal_latch_survives_operator_restart():
+    """The latch lives in ElasticJob.status, not operator memory: a fresh
+    controller fed the stored status keeps a finished job finished even if
+    the trainer pod record was GC'd externally."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(ps=0, workers=1))
+    ctl.reconcile_job("deepctr")
+    api.tick()
+    api.set_phase("deepctr-worker-0", "Succeeded")
+    api.set_phase("deepctr-trainer-0", "Succeeded")
+    ctl.reconcile_job("deepctr")
+    saved_status = store.job_status("deepctr")
+    assert saved_status["phase"] == "Succeeded"
+    # "restart": new store + controller; pods GC'd externally; only the
+    # ElasticJob spec + status survive (as they would on the API server)
+    store2, api2 = CrStore(), InMemoryPodApi()
+    store2.submit_job(make_job())
+    store2.set_status("deepctr", saved_status)
+    ctl2 = ElasticJobController(store2, api2)
+    st = ctl2.reconcile_job("deepctr")
+    assert st.phase == "Succeeded"
+    assert api2.list_pods("deepctr") == []  # nothing recreated
+
+
+def test_status_terminal_phase_cannot_unlatch():
+    store = CrStore()
+    store.submit_job(make_job())
+    assert store.set_status("deepctr", {"phase": "Succeeded", "roles": {}})
+    assert not store.set_status("deepctr", {"phase": "Running", "roles": {}})
+    assert not store.set_status("deepctr", {"phase": "Failed", "roles": {}})
+    assert store.job_status("deepctr")["phase"] == "Succeeded"
+    # same-phase refresh (counts after GC) is allowed
+    assert store.set_status(
+        "deepctr", {"phase": "Succeeded", "roles": {"worker": {"active": 0}}}
+    )
+
+
+def test_trainer_backoff_limit_fails_job():
+    """k8s Job backoffLimit analogue: a crash-looping trainer eventually
+    latches the job Failed instead of restarting forever."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(
+        store, api, trainer_backoff_limit=2,
+        restart_backoff_base=0.0, restart_backoff_max=0.0,
+    )
+    store.submit_job(make_job())
+    for _ in range(4):
+        ctl.reconcile_job("deepctr")
+        api.tick()
+        trainers = [p for p in api.list_pods("deepctr")
+                    if p.role == "trainer" and p.phase == "Running"]
+        if not trainers:
+            break
+        api.fail(trainers[0].name)
+    st = ctl.reconcile_job("deepctr")
+    assert st.phase == "Failed"
+    assert store.job_status("deepctr")["phase"] == "Failed"
+    assert "restart limit" in store.job_status("deepctr")["message"]
+    # stable: no new trainer appears on later passes
+    ctl.reconcile_job("deepctr")
+    assert not any(p.phase in ("Pending", "Running")
+                   for p in api.list_pods("deepctr"))
+
+
+def test_running_status_reported():
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    ctl.reconcile_job("deepctr")
+    assert store.job_status("deepctr")["phase"] == "Pending"
+    api.tick()
+    store.apply_plan(make_plan(ps=1, workers=2))
+    ctl.reconcile_job("deepctr")
+    status = store.job_status("deepctr")
+    assert status["phase"] == "Running"
+    assert status["roles"]["worker"]["active"] == 2
+
+
+def test_updation_on_succeeded_pod_is_inert():
+    """A resource_updation targeting a Succeeded pod must neither replace it
+    (re-running finished work) nor churn create/delete cycles."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(ps=0, workers=1))
+    ctl.reconcile_job("deepctr")
+    api.tick()
+    api.set_phase("deepctr-worker-0", "Succeeded")
+    from easydl_tpu.api.resource_plan import ResourceUpdation as RU
+    store.apply_plan(make_plan(
+        ps=0, workers=1, version=2,
+        updations=[RU(name="deepctr-worker-0", resource=ResourceSpec(cpu=16))],
+    ))
+    for _ in range(3):
+        st = ctl.reconcile_job("deepctr")
+        assert not any(op.startswith(("CREATE deepctr-worker",
+                                      "DELETE deepctr-worker"))
+                       for op in st.last_ops), st.last_ops
+    assert api.get_pod("deepctr-worker-0").phase == "Succeeded"
+
+
+def test_trainer_backoff_limit_counts_real_failures():
+    """With real (nonzero) backoff, each trainer crash counts exactly once
+    toward the limit — the deferred-recreate path must not double-count via
+    the plan reconcile seeing the stale Failed pod."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(
+        store, api, trainer_backoff_limit=4,
+        restart_backoff_base=0.05, restart_backoff_max=0.05,
+    )
+    store.submit_job(make_job())
+    store.apply_plan(make_plan(ps=0, workers=1))
+    fails = 0
+    import time as _t
+    deadline = _t.monotonic() + 20
+    while fails < 4 and _t.monotonic() < deadline:
+        ctl.reconcile_job("deepctr")
+        api.tick()
+        live = [p for p in api.list_pods("deepctr")
+                if p.role == "trainer" and p.phase == "Running"]
+        if live:
+            api.fail(live[0].name)
+            fails += 1
+            # extra reconcile passes while the recreate is deferred: these
+            # see the stale state and must NOT inflate the failure count
+            ctl.reconcile_job("deepctr")
+            ctl.reconcile_job("deepctr")
+            _t.sleep(0.06)
+    assert fails == 4
+    st = ctl.reconcile_job("deepctr")
+    # exactly at the limit: not exceeded yet, job still live
+    assert st.phase != "Failed", store.job_status("deepctr")
+    # the 5th consecutive failure crosses the limit
+    api.tick()
+    live = [p for p in api.list_pods("deepctr")
+            if p.role == "trainer" and p.phase == "Running"]
+    assert live
+    api.fail(live[0].name)
+    st = ctl.reconcile_job("deepctr")
+    assert st.phase == "Failed"

@@ -138,6 +138,24 @@ def test_full_reference_lifecycle(tmp_path):
             ),
         )
 
+        # the terminal state is LATCHED and STABLE: the operator reports the
+        # job Succeeded and — across several further reconcile ticks — must
+        # not recreate the trainer or re-level workers (the round-3
+        # completion-loop defect: every past green run of the old assertion
+        # was winning a poll race against the next reconcile pass).
+        wait_for(
+            lambda: (store.job_status(job_name) or {}).get("phase")
+            == "Succeeded",
+            15, lambda: f"job status Succeeded (now: {store.job_status(job_name)})",
+        )
+        names_at_end = {p.name for p in api.list_pods(job_name)}
+        time.sleep(2.0)  # ≥4 reconcile ticks at the pump's 0.5s cadence
+        assert {p.name for p in api.list_pods(job_name)} == names_at_end, (
+            "operator kept reconciling a finished job"
+        )
+        assert all(p.phase == "Succeeded" for p in api.list_pods(job_name))
+        assert store.job_status(job_name)["phase"] == "Succeeded"
+
         # the run left real artifacts: checkpoints + the master's address file
         ckpt_dir = os.path.join(workdir, "ckpt")
         ckpts = [d for d in os.listdir(ckpt_dir) if d.startswith("step_")]
