@@ -10,6 +10,7 @@ GetPlan, and the runtime-performance input the reference implies
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -40,6 +41,8 @@ class _JobState:
         self.autoscaler = autoscaler
         self.plan: Optional[ResourcePlan] = None
         self.last_metrics_t: float = 0.0
+        self.last_persist_t: float = float("-inf")
+        self.dirty: bool = False  # window state newer than the state file
 
 
 class Brain:
@@ -58,9 +61,16 @@ class Brain:
     """
 
     def __init__(self, config: Optional[AutoscalerConfig] = None,
-                 clock=time.monotonic, state_dir: Optional[str] = None):
+                 clock=time.monotonic, state_dir: Optional[str] = None,
+                 persist_window_s: float = 2.0):
         self._config = config or AutoscalerConfig()
         self._clock = clock
+        # Metric observations mutate only the autoscaler windows; fsyncing
+        # the whole job state on EVERY StepMetrics is an fsync-per-step
+        # hotspot at high report rates. Windows persist at most once per
+        # persist_window_s; anything that changes the PLAN persists
+        # immediately (that's what a replacement Brain cannot re-derive).
+        self._persist_window_s = persist_window_s
         self._jobs: Dict[str, _JobState] = {}
         self._lock = threading.Lock()
         self._server = None
@@ -73,11 +83,16 @@ class Brain:
     def _job_path(self, name: str) -> str:
         # Well-behaved job names are CRD metadata.names (DNS-1123), but the
         # name arrives over the wire from any gRPC client — sanitize so a
-        # crafted name ('../../x') cannot write outside state_dir.
+        # crafted name ('../../x') cannot write outside state_dir, and
+        # append a short hash of the RAW name so two jobs whose names
+        # sanitize identically ('a/b' vs 'a_b') cannot overwrite each
+        # other's state. (_load_all keys restores on the doc's "job" field,
+        # not the filename, so the scheme can evolve safely.)
         safe = "".join(
             c if (c.isalnum() or c in "-._") else "_" for c in name
         ) or "_"
-        return os.path.join(self._state_dir, f"brain-{safe}.json")
+        digest = hashlib.sha1(name.encode()).hexdigest()[:8]
+        return os.path.join(self._state_dir, f"brain-{safe}-{digest}.json")
 
     def _persist(self, name: str) -> None:
         """Write one job's state; called with the lock held."""
@@ -95,9 +110,21 @@ class Brain:
                 json.dump(doc, f)
             os.replace(tmp, self._job_path(name))
         except OSError as e:
+            # Leave the job dirty: the next observe (or stop()'s flush)
+            # retries instead of treating the failed write as persisted.
             log.warning("brain state persist failed for %r: %s", name, e)
+        else:
+            st.last_persist_t = self._clock()
+            st.dirty = False
 
     def _load_all(self) -> None:
+        # Collect one doc per job first: a state_dir written by the
+        # pre-digest filename scheme may hold BOTH brain-j.json (stale) and
+        # brain-j-<digest>.json (current) for the same job — the canonical
+        # (digest) file always wins, and legacy files are migrated forward
+        # so the shadowing cannot recur.
+        chosen: Dict[str, tuple] = {}  # job -> (fname, doc)
+        files_of: Dict[str, list] = {}  # job -> every file claiming it
         for fname in sorted(os.listdir(self._state_dir)):
             if not (fname.startswith("brain-") and fname.endswith(".json")):
                 continue
@@ -108,6 +135,11 @@ class Brain:
                 log.warning("unreadable brain state %s: %s", fname, e)
                 continue
             name = doc.get("job") or fname[len("brain-"):-len(".json")]
+            files_of.setdefault(name, []).append(fname)
+            canonical = os.path.basename(self._job_path(name))
+            if name not in chosen or fname == canonical:
+                chosen[name] = (fname, doc)
+        for name, (fname, doc) in chosen.items():
             st = _JobState(Autoscaler(self._config, clock=self._clock))
             if doc.get("plan") is not None:
                 try:
@@ -121,6 +153,15 @@ class Brain:
                 name, st.plan.version if st.plan else 0,
                 len(doc.get("autoscaler", {}).get("per_size", {})),
             )
+            canonical = os.path.basename(self._job_path(name))
+            if fname != canonical:
+                self._persist(name)  # migrate to the canonical name
+            for legacy in files_of[name]:
+                if legacy != canonical:
+                    try:
+                        os.remove(os.path.join(self._state_dir, legacy))
+                    except OSError:
+                        pass
 
     # ------------------------------------------------------------------ core
     def _job(self, name: str) -> _JobState:
@@ -145,13 +186,21 @@ class Brain:
 
     def observe(self, m: pb.StepMetrics) -> None:
         with self._lock:
+            st = self._job(m.job_name)
+            version_before = st.plan.version if st.plan else 0
             try:
                 self._observe_locked(m)
             finally:
-                # Persist after every observation, not just replans: the
-                # windows and cooldown are what a replacement Brain needs to
-                # keep *deciding* correctly, not merely serve the old plan.
-                self._persist(m.job_name)
+                # A plan change persists immediately (a replacement Brain
+                # must never regress plan versions); window/cooldown state is
+                # throttled to one write per persist_window_s — it only needs
+                # to be RECENT for a replacement to keep deciding well.
+                version_after = st.plan.version if st.plan else 0
+                st.dirty = True
+                if (version_after != version_before
+                        or self._clock() - st.last_persist_t
+                        >= self._persist_window_s):
+                    self._persist(m.job_name)
 
     def _observe_locked(self, m: pb.StepMetrics) -> None:
         st = self._job(m.job_name)
@@ -225,6 +274,11 @@ class Brain:
     def stop(self) -> None:
         if self._server:
             self._server.stop()
+        # Flush throttled window state so a clean shutdown loses nothing.
+        with self._lock:
+            for name, st in self._jobs.items():
+                if st.dirty:
+                    self._persist(name)
 
     def status(self) -> Dict[str, object]:
         with self._lock:

@@ -100,6 +100,14 @@ def main() -> None:
                          "service-account config)")
     ap.add_argument("--namespace", default="",
                     help="pod namespace (default: SA namespace or 'default')")
+    ap.add_argument("--pod-workdir", default="",
+                    help="in-container shared-workdir mount path substituted "
+                         "into {workdir} command tokens (k8s pod api; "
+                         "default /workdir)")
+    ap.add_argument("--workdir-volume", default="",
+                    help="JSON k8s volume source mounted at the pod workdir, "
+                         'e.g. \'{"persistentVolumeClaim": {"claimName": '
+                         '"train-shared"}}\'')
     ap.add_argument("--resync-s", type=float, default=2.0)
     args = ap.parse_args()
     if args.cr_source == "dir" and not args.watch_dir:
@@ -113,9 +121,19 @@ def main() -> None:
         kube_client = KubeClient(base_url=args.kube_url,
                                  namespace=args.namespace)
     if args.pod_api == "k8s":
-        from easydl_tpu.controller.kube_pod_api import KubePodApi
+        import json
 
-        pod_api = KubePodApi(client=kube_client)
+        from easydl_tpu.controller.kube_pod_api import (
+            DEFAULT_WORKDIR,
+            KubePodApi,
+        )
+
+        pod_api = KubePodApi(
+            client=kube_client,
+            workdir=args.pod_workdir or DEFAULT_WORKDIR,
+            workdir_volume=(json.loads(args.workdir_volume)
+                            if args.workdir_volume else None),
+        )
     else:
         pod_api = InMemoryPodApi()
     ctl = ElasticJobController(store, pod_api)

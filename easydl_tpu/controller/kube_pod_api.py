@@ -55,8 +55,25 @@ LABEL_REPLACES = "easydl.org/replaces"
 ANNOTATION_RESOURCE = "easydl.org/resource"
 
 
-def pod_to_manifest(pod: Pod, namespace: str) -> Dict[str, Any]:
-    """Our Pod record -> a k8s V1Pod manifest."""
+#: default in-container mount point of the job's shared volume — the k8s
+#: equivalent of the process backend's workdir (master.json, the PS
+#: registry, checkpoints all live here; pods must see one shared path).
+DEFAULT_WORKDIR = "/workdir"
+
+
+def pod_to_manifest(pod: Pod, namespace: str,
+                    workdir: str = DEFAULT_WORKDIR,
+                    workdir_volume: Optional[Dict[str, Any]] = None,
+                    ) -> Dict[str, Any]:
+    """Our Pod record -> a k8s V1Pod manifest.
+
+    ``workdir`` is substituted into ``{workdir}`` command tokens and exported
+    as EASYDL_WORKDIR (parity with the process backend — the PS registry,
+    master.json and checkpoints all live under it). ``workdir_volume`` is an
+    optional k8s volume SOURCE (e.g. ``{"persistentVolumeClaim":
+    {"claimName": "train-shared"}}`` or ``{"nfs": {...}}``) mounted at that
+    path in every pod; without a shared volume the pods see different
+    filesystems and the file-based rendezvous cannot work."""
     requests: Dict[str, str] = {}
     limits: Dict[str, str] = {}
     if pod.resource.cpu:
@@ -87,12 +104,13 @@ def pod_to_manifest(pod: Pod, namespace: str) -> Dict[str, Any]:
             {"name": "EASYDL_POD_ROLE", "value": pod.role},
             {"name": "EASYDL_JOB", "value": pod.job},
             {"name": "EASYDL_REPLACES", "value": pod.replaces or ""},
+            {"name": "EASYDL_WORKDIR", "value": workdir},
         ],
     }
     if pod.command:
         cmd = pod.command
         for token, value in (("{name}", pod.name), ("{role}", pod.role),
-                             ("{job}", pod.job)):
+                             ("{job}", pod.job), ("{workdir}", workdir)):
             cmd = cmd.replace(token, value)
         if "{ready_file}" in cmd:
             # Readiness-gated command (the process backend's {ready_file}
@@ -138,6 +156,18 @@ def pod_to_manifest(pod: Pod, namespace: str) -> Dict[str, Any]:
             "containers": [container],
         },
     }
+    if workdir_volume is not None:
+        container.setdefault("volumeMounts", []).append(
+            {"name": "easydl-workdir", "mountPath": workdir}
+        )
+        if "name" in workdir_volume:
+            # A full k8s volume (not a bare source) was pasted: its own name
+            # would desync from the volumeMount's — ours wins.
+            log.warning("workdir_volume 'name' %r ignored (mount uses "
+                        "'easydl-workdir')", workdir_volume["name"])
+        manifest["spec"]["volumes"] = [
+            {**workdir_volume, "name": "easydl-workdir"}
+        ]
     return manifest
 
 
@@ -191,6 +221,8 @@ class KubePodApi(PodApi):
         ca_file: Optional[str] = None,
         timeout: float = 10.0,
         client: Optional[KubeClient] = None,
+        workdir: str = DEFAULT_WORKDIR,
+        workdir_volume: Optional[Dict[str, Any]] = None,
     ):
         self._client = client or KubeClient(
             base_url=base_url, namespace=namespace, token=token,
@@ -198,6 +230,8 @@ class KubePodApi(PodApi):
         )
         self.base_url = self._client.base_url
         self.namespace = self._client.namespace
+        self.workdir = workdir
+        self.workdir_volume = workdir_volume
 
     # ------------------------------------------------------------------ http
     def _request(self, method: str, path: str,
@@ -206,9 +240,25 @@ class KubePodApi(PodApi):
 
     # ---------------------------------------------------------------- PodApi
     def create_pod(self, pod: Pod) -> None:
+        manifest = pod_to_manifest(pod, self.namespace, workdir=self.workdir,
+                                   workdir_volume=self.workdir_volume)
+        # A known template token surviving substitution would reach the
+        # container as a literal brace string and crash-loop the pod with a
+        # baffling error; fail loudly here instead. ({ready_file} is
+        # substituted by the readiness-probe block; arbitrary braces — JSON
+        # model args — are legitimate and pass through.)
+        cmd = manifest["spec"]["containers"][0].get("command")
+        if cmd:
+            leftover = [t for t in ("{name}", "{role}", "{job}", "{workdir}",
+                                    "{ready_file}") if t in cmd[-1]]
+            if leftover:
+                raise ValueError(
+                    f"pod {pod.name!r}: unsubstituted command tokens "
+                    f"{leftover} in {cmd[-1]!r}"
+                )
         path = f"/api/v1/namespaces/{self.namespace}/pods"
         try:
-            self._request("POST", path, pod_to_manifest(pod, self.namespace))
+            self._request("POST", path, manifest)
         except KubeApiError as e:
             if e.code == 409:  # AlreadyExists — reconcile is level-triggered
                 log.warning("pod %s already exists", pod.name)

@@ -27,6 +27,9 @@ from typing import Dict, Iterator, List
 import numpy as np
 
 from easydl_tpu.data.datasets import CursorStateMixin, hash_split
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("data", "clicks")
 
 _FNV_OFFSET = 14695981039346656037
 _FNV_PRIME = 1099511628211
@@ -80,10 +83,15 @@ def encode_click_tsv(paths: List[str], out_dir: str, num_dense: int = 13,
             fill = 0
 
     width = 1 + num_dense + num_sparse
+    skipped = 0
     for path in paths:
         with open(path, encoding="utf-8", errors="replace") as f:
             for line in f:
-                parts = line.rstrip("\n").split("\t")
+                line = line.rstrip()
+                if not line:
+                    skipped += 1  # blank/whitespace line, not a zero example
+                    continue
+                parts = line.split("\t")
                 if len(parts) < width:
                     parts += [""] * (width - len(parts))
                 try:
@@ -98,6 +106,8 @@ def encode_click_tsv(paths: List[str], out_dir: str, num_dense: int = 13,
                 if fill == chunk_rows:
                     flush()
     flush()
+    if skipped:
+        log.warning("encode_click_tsv: skipped %d blank line(s)", skipped)
     os.makedirs(out_dir, exist_ok=True)
     n = int(sum(len(c) for c in label_chunks))
     empty = (np.zeros((0,), np.float32), np.zeros((0, num_dense), np.float32),

@@ -5,9 +5,14 @@ role, and the piece that turns the operator's generic replace-then-retire
 into the reference's zero-lost-updates vertical scaling
 (docs/design/elastic-training-operator.md:86-101):
 
-- **fresh pod** (initial creation): shard index = the trailing index of the
-  pod name (``job-parameter_server-3`` → shard 3), serve, publish to the
-  registry, then touch the ready file.
+- **fresh pod** (initial creation): the trailing index of the pod name
+  (``job-parameter_server-3`` → shard 3) is a HINT, checked against the
+  registry: if some shard's latest publication is dead (its pod crashed and
+  the reconciler levelled THIS pod in under a fresh name with no
+  ``replaces``), the fresh pod adopts that orphaned shard instead —
+  claiming it via an O_EXCL file so concurrent rescues can't collide — and
+  restores its rows from the last complete ``ps-ckpt`` save. Then serve,
+  publish to the registry, touch the ready file.
 - **replacement pod** (``resource_updation`` → the operator created it with
   ``replaces=<old>``): inherit the OLD pod's shard index from the registry,
   then run the handoff — Drain the old pod (its pushes gate + rows save),
@@ -24,10 +29,12 @@ environment the pod backend exports.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import sys
 import time
+from typing import Optional, Tuple
 
 from easydl_tpu.ps import registry
 from easydl_tpu.ps.server import PS_SERVICE, PsShard
@@ -37,14 +44,97 @@ from easydl_tpu.utils.rpc import RpcClient
 log = get_logger("ps", "main")
 
 
-def shard_index_from_name(name: str) -> int:
+def shard_index_from_name(name: str) -> Optional[int]:
     tail = name.rsplit("-", 1)[-1]
-    if not tail.isdigit():
+    return int(tail) if tail.isdigit() else None
+
+
+def probe_alive(address: str, timeout: float = 2.0) -> bool:
+    """Is a PS actually serving at this registry address? Registry entries
+    outlive their pods (a crashed shard's file stays on disk), so liveness
+    is decided by the socket, not the file."""
+    from easydl_tpu.proto import easydl_pb2 as pb
+
+    client = RpcClient(PS_SERVICE, address, timeout=timeout)
+    try:
+        client.Stats(pb.PsStatsRequest())
+        return True
+    except Exception:
+        return False
+    finally:
+        client.close()
+
+
+def claim_orphan_shard(workdir: str, pod: str, orphans,
+                       stale_s: float = 30.0) -> Tuple[Optional[int],
+                                                       Optional[str]]:
+    """Claim one orphaned shard via an O_EXCL claim file so two concurrent
+    failure replacements can't adopt the same shard. A claim older than
+    ``stale_s`` whose shard is still unserved is presumed abandoned (the
+    claimant crashed mid-rescue) and stolen; the original claimant notices
+    at publish time (claim ownership is re-checked) and exits."""
+    claim_dir = os.path.join(workdir, registry.REG_DIR)
+    os.makedirs(claim_dir, exist_ok=True)
+    doc = json.dumps({"pod": pod, "t": time.time()})
+    for s in orphans:
+        path = os.path.join(claim_dir, f"claim-shard-{s}.json")
+        try:
+            with open(path, "x") as f:
+                f.write(doc)
+            return s, path
+        except FileExistsError:
+            try:
+                with open(path) as f:
+                    age = time.time() - float(json.load(f).get("t", 0))
+            except (OSError, ValueError):
+                age = stale_s + 1  # torn claim: treat as stale
+            if age > stale_s:
+                tmp = f"{path}.steal-{pod}"
+                with open(tmp, "w") as f:
+                    f.write(doc)
+                os.replace(tmp, path)
+                return s, path
+    return None, None
+
+
+def resolve_fresh_shard(workdir: str, pod: str,
+                        num_shards: int) -> Tuple[int, bool, Optional[str]]:
+    """Decide which shard a fresh (non-replacement) PS pod serves.
+
+    The pod name's trailing index is only a HINT: the reconciler replaces a
+    Failed pod via replica levelling under a fresh name with no ``replaces``
+    (reconciler.py), so ``job-parameter_server-2`` may well be the rescue of
+    crashed shard 0. The registry decides: a shard whose latest publication
+    no longer answers is orphaned, and an orphan outranks the name. Returns
+    (shard index, rescued — a dead prior publication exists, claim path)."""
+    smap = registry.shard_map(workdir)
+    live, dead = set(), set()
+    for s, doc in smap.items():
+        if 0 <= s < num_shards:
+            (live if probe_alive(doc["address"]) else dead).add(s)
+    name_idx = shard_index_from_name(pod)
+    if (name_idx is not None and 0 <= name_idx < num_shards
+            and name_idx not in live and not dead - {name_idx}):
+        # The normal initial-creation path (and in-place restart): the name
+        # is a valid unserved shard and no OTHER shard needs rescue.
+        return name_idx, name_idx in dead, None
+    orphans = [s for s in range(num_shards) if s not in live]
+    # Prefer the name's own shard when it is among the orphans (less churn).
+    orphans.sort(key=lambda s: (s != name_idx, s))
+    if not orphans:
         raise SystemExit(
-            f"cannot derive shard index from pod name {name!r}; "
-            "pass --shard-index"
+            f"pod {pod!r}: every shard 0..{num_shards - 1} is already "
+            "served; nothing to do (scale-down should delete this pod)"
         )
-    return int(tail)
+    s, claim = claim_orphan_shard(workdir, pod, orphans)
+    if s is None:
+        raise SystemExit(
+            f"pod {pod!r}: shards {orphans} unserved but all freshly "
+            "claimed by other pods"
+        )
+    log.info("pod %s adopting orphaned shard %d (name suggested %s)",
+             pod, s, name_idx)
+    return s, s in dead, claim
 
 
 def wait_registry_entry(workdir: str, pod: str, wait_s: float = 60.0) -> dict:
@@ -98,6 +188,7 @@ def main() -> None:
                  "are required")
 
     old = None
+    rescued, claim_path = False, None
     if args.replaces:
         # The shard identity is inherited from the pod being replaced — the
         # operator names replacements with a fresh trailing index, so the
@@ -105,9 +196,13 @@ def main() -> None:
         old = wait_registry_entry(args.workdir, args.replaces)
         index, num_shards = int(old["shard"]), int(old["num_shards"])
     else:
-        index = (args.shard_index if args.shard_index >= 0
-                 else shard_index_from_name(args.name))
         num_shards = args.num_shards
+        if args.shard_index >= 0:
+            index = args.shard_index
+        else:
+            index, rescued, claim_path = resolve_fresh_shard(
+                args.workdir, args.name, num_shards
+            )
     shard = PsShard(shard_index=index, num_shards=num_shards)
     server = shard.serve(port=args.port)
     log.info("ps pod %s serving shard %d/%d on %s",
@@ -115,7 +210,33 @@ def main() -> None:
 
     if old is not None:
         run_handoff(old, args.workdir, shard)
+    elif rescued:
+        # Failure rescue: the shard's previous server died without a drain,
+        # so recover its rows from the last complete PS checkpoint (workers
+        # save the PS tier alongside dense checkpoints; restore() keeps only
+        # this shard's ids). Updates since that checkpoint are lost — same
+        # bound as the dense state after a crash.
+        ckpt_dir = os.path.join(args.workdir, "ps-ckpt")
+        try:
+            step = shard.restore(ckpt_dir)
+            log.info("rescued shard %d from %s at step %d",
+                     index, ckpt_dir, step)
+        except FileNotFoundError:
+            log.warning("no complete PS checkpoint under %s; rescued shard "
+                        "%d starts empty", ckpt_dir, index)
 
+    if claim_path is not None:
+        # A stale-claim thief may have taken the shard while we restored;
+        # the registry must not see two publications racing for it.
+        try:
+            with open(claim_path) as f:
+                owner = json.load(f).get("pod")
+        except (OSError, ValueError):
+            owner = None
+        if owner != args.name:
+            raise SystemExit(
+                f"claim on shard {index} taken over by {owner!r}; exiting"
+            )
     registry.publish(args.workdir, args.name, shard.shard_index,
                      num_shards, server.address)
     if args.ready_file:

@@ -396,3 +396,91 @@ def test_metrics_aggregation_survives_silent_rank0(tmp_path):
     master.rendezvous.members = ["a1", "a2"]
     agg = master._aggregate_metrics()
     assert agg.samples_per_sec >= 300.0
+
+
+def test_state_files_distinct_for_colliding_names(tmp_path):
+    """Advisor r3 low: 'a/b' and 'a_b' sanitize identically — their state
+    files must still be distinct or they overwrite each other."""
+    sd = str(tmp_path / "bs")
+    clock = FakeClock()
+    brain = Brain(AutoscalerConfig(), clock=clock, state_dir=sd)
+    brain.set_plan(ResourcePlan(job_name="a/b", version=5,
+                                roles={"worker": RolePlan(replicas=4)}))
+    brain.set_plan(ResourcePlan(job_name="a_b", version=9,
+                                roles={"worker": RolePlan(replicas=2)}))
+    import os
+    assert len([f for f in os.listdir(sd) if f.endswith(".json")]) == 2
+    brain2 = Brain(AutoscalerConfig(), clock=clock, state_dir=sd)
+    assert brain2.current_plan("a/b", 0).version == 5
+    assert brain2.current_plan("a_b", 0).version == 9
+
+
+def test_persist_throttled_but_plan_changes_immediate(tmp_path):
+    """Window-state persists are throttled (no fsync per StepMetrics); plan
+    changes persist immediately; stop() flushes the throttled state."""
+    import os
+    sd = str(tmp_path / "bs")
+    clock = FakeClock()
+    cfg = AutoscalerConfig(cooldown_s=10, min_samples=3, max_workers=32)
+    brain = Brain(cfg, clock=clock, state_dir=sd, persist_window_s=2.0)
+    brain.set_plan(ResourcePlan(job_name="j", version=1,
+                                roles={"worker": RolePlan(replicas=8)}))
+    path = brain._job_path("j")
+    writes = [os.path.getmtime(path)]
+
+    def mtime_changed():
+        m = os.path.getmtime(path)
+        changed = m != writes[-1]
+        if changed:
+            writes.append(m)
+        return changed
+
+    # rapid-fire metrics within the window: no write per observation
+    clock.advance(0.01)
+    brain.observe(metrics(8, 800.0, step=0))
+    clock.advance(0.01)
+    brain.observe(metrics(8, 800.0, step=1))
+    assert not mtime_changed()
+    # enough samples + cooldown: a replan fires -> persisted IMMEDIATELY
+    # even though the window has not elapsed
+    clock.advance(10.5)
+    brain.observe(metrics(8, 800.0, step=2))
+    clock.advance(0.01)
+    brain.observe(metrics(8, 800.0, step=3))
+    assert mtime_changed()
+    with open(path) as f:
+        import json as _json
+        assert _json.load(f)["plan"]["metadata"]["version"] == 2
+    # dirty window state flushed on clean stop
+    clock.advance(0.01)
+    brain.observe(metrics(16, 1550.0, step=4))
+    pre = os.path.getmtime(path)
+    brain.stop()
+    assert os.path.getmtime(path) != pre or not brain._jobs["j"].dirty
+
+
+def test_legacy_state_file_migrated_not_shadowing(tmp_path):
+    """A pre-digest-scheme brain-j.json must not overwrite the canonical
+    digest file's fresher state on restart; it is migrated then removed."""
+    import json as _json
+    import os
+    sd = str(tmp_path / "bs")
+    os.makedirs(sd)
+    clock = FakeClock()
+    brain = Brain(AutoscalerConfig(), clock=clock, state_dir=sd)
+    brain.set_plan(ResourcePlan(job_name="j", version=9,
+                                roles={"worker": RolePlan(replicas=4)}))
+    # simulate the legacy file left behind by the old filename scheme
+    stale = {"job": "j",
+             "plan": ResourcePlan(job_name="j", version=2,
+                                  roles={"worker": RolePlan(replicas=8)}
+                                  ).to_crd(),
+             "autoscaler": {}}
+    with open(os.path.join(sd, "brain-j.json"), "w") as f:
+        _json.dump(stale, f)
+    brain2 = Brain(AutoscalerConfig(), clock=clock, state_dir=sd)
+    assert brain2.current_plan("j", 0).version == 9  # fresh state wins
+    assert not os.path.exists(os.path.join(sd, "brain-j.json"))  # migrated
+    # and a third restart still sees v9
+    brain3 = Brain(AutoscalerConfig(), clock=clock, state_dir=sd)
+    assert brain3.current_plan("j", 0).version == 9

@@ -169,3 +169,60 @@ def test_http_error_surfaces(fake_cluster):
     with pytest.raises(KubeApiError) as ei:
         api._request("DELETE", "/api/v1/namespaces/train/pods/nope")
     assert ei.value.code == 404
+
+
+def test_workdir_substitution_and_volume():
+    """Advisor r3 medium: the documented PS command template (`--workdir
+    {workdir}`) must reach the container substituted — with EASYDL_WORKDIR
+    exported and the shared volume mounted at that path."""
+    pod = Pod(
+        name="j-parameter_server-0", job="j", role="parameter_server",
+        command=("python -m easydl_tpu.ps --name {name} --workdir {workdir} "
+                 "--num-shards 2 --ready-file {ready_file}"),
+    )
+    doc = pod_to_manifest(
+        pod, "train", workdir="/mnt/shared",
+        workdir_volume={"persistentVolumeClaim": {"claimName": "train-pvc"}},
+    )
+    c = doc["spec"]["containers"][0]
+    sh_cmd = c["command"][-1]
+    assert "--workdir /mnt/shared" in sh_cmd
+    assert "{" not in sh_cmd.replace("{workdir}", "")  # no leftover tokens
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["EASYDL_WORKDIR"] == "/mnt/shared"
+    assert c["volumeMounts"] == [
+        {"name": "easydl-workdir", "mountPath": "/mnt/shared"}
+    ]
+    assert doc["spec"]["volumes"][0]["persistentVolumeClaim"] == {
+        "claimName": "train-pvc"
+    }
+    # the readiness probe still rides the substituted ready file
+    assert c["readinessProbe"]["exec"]["command"][1] in sh_cmd
+
+
+def test_create_pod_rejects_unsubstituted_tokens(fake_cluster):
+    api = make_api(fake_cluster)
+    # a token the backend does not know cannot be silently shipped
+    import easydl_tpu.controller.kube_pod_api as kpa
+
+    pod = Pod(name="j-w-0", job="j", role="worker",
+              command="run --x {workdir}")
+    # sanity: with substitution this is fine
+    api.create_pod(pod)
+    assert fake_cluster.pods["j-w-0"]
+    # simulate a future template token that substitution misses
+    orig = kpa.pod_to_manifest
+
+    def broken(pod, ns, **kw):
+        doc = orig(pod, ns, **kw)
+        doc["spec"]["containers"][0]["command"][-1] = "run --x {workdir}"
+        return doc
+
+    kpa_patch = kpa.pod_to_manifest
+    kpa.pod_to_manifest = broken
+    try:
+        with pytest.raises(ValueError, match="unsubstituted"):
+            api.create_pod(Pod(name="j-w-1", job="j", role="worker",
+                               command="run --x {workdir}"))
+    finally:
+        kpa.pod_to_manifest = kpa_patch

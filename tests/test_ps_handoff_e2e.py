@@ -271,3 +271,80 @@ def test_ps_trainer_against_real_ps_pods(tmp_path, eight_devices):
                 p.wait()
         for logf in logs:
             logf.close()
+
+
+def test_crashed_ps_shard_rescued_by_fresh_replacement(tmp_path):
+    """Advisor r3 medium: a Failed PS pod is replaced via replica levelling
+    under a FRESH name with no `replaces` — the replacement must adopt the
+    crashed pod's shard (not trust its own name's trailing index) and
+    restore that shard's rows from the last ps-ckpt save."""
+    workdir = str(tmp_path)
+    store = CrStore()
+    pods = LocalProcessPodApi(workdir)
+    ctl = ElasticJobController(store, pods)
+    ctl.start(resync_s=0.3)
+    client = None
+    try:
+        store.submit_job(JobSpec(
+            name="rj",
+            command="python -m easydl_tpu.models.run --model mlp",
+            roles={
+                "trainer": RoleSpec(command="sleep 600"),
+                "parameter_server": RoleSpec(command=PS_CMD),
+            },
+        ))
+        store.apply_plan(ResourcePlan(
+            job_name="rj", version=1,
+            roles={"parameter_server": RolePlan(
+                replicas=2, resource=ResourceSpec(cpu=1))},
+        ))
+        registry.addresses(workdir, 2, timeout=60)
+        client = ShardedPsClient.from_registry(workdir, 2)
+        client.create_table(spec())
+        ids = np.arange(200)
+        g = np.full((200, 8), 1.0, np.float32)
+        client.push("emb", ids, g, scale=0.1)
+        expected = client.pull("emb", ids)
+        # checkpoint the PS tier (what workers do every ckpt interval)
+        client.save(os.path.join(workdir, "ps-ckpt"), step=7)
+
+        # SIGKILL shard 0's pod: exits nonzero -> Failed -> reconciler
+        # levels a replacement under a fresh name, replaces=""
+        victim = "rj-parameter_server-0"
+        shard0_addr = registry.entry_for_pod(workdir, victim)["address"]
+        entry = pods._procs[victim]
+        entry.proc.kill()
+        wait_for(
+            lambda: any(
+                p.name == "rj-parameter_server-2"
+                and p.phase in ("Pending", "Running")
+                for p in pods.list_pods("rj")
+            ),
+            60, "fresh-named replacement created",
+        )
+        # the replacement adopts SHARD 0 (not shard 2) and re-publishes it
+        wait_for(
+            lambda: registry.shard_map(workdir).get(0, {}).get("address")
+            not in (None, shard0_addr),
+            60, "replacement published shard 0 under a new address",
+        )
+        smap = registry.shard_map(workdir)
+        assert smap[0]["pod"] == "rj-parameter_server-2", smap
+        assert 2 not in smap  # it did NOT serve a bogus shard 2
+        # registry remains complete: clients can discover both shards
+        n, addrs = registry.discover(workdir, timeout=30)
+        assert n == 2 and len(set(addrs)) == 2
+
+        # and the rescued shard serves the CHECKPOINTED rows, not an empty
+        # table (pull through a fresh client to pick up the new address)
+        client.close()
+        client = ShardedPsClient.from_registry(workdir, 2)
+        client.create_table(spec())
+        np.testing.assert_allclose(
+            client.pull("emb", ids), expected, rtol=1e-6
+        )
+    finally:
+        if client is not None:
+            client.close()
+        ctl.stop()
+        pods.shutdown()
