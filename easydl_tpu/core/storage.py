@@ -29,6 +29,8 @@ exercised hermetically.
 
 from __future__ import annotations
 
+import base64
+import hashlib
 import io
 import json
 import os
@@ -36,7 +38,7 @@ import shutil
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -228,6 +230,11 @@ class GcsStorage(CheckpointStorage):
 
     def _request(self, method: str, url: str, data: Optional[bytes] = None,
                  headers: Optional[dict] = None) -> bytes:
+        return self._request_full(method, url, data, headers)[0]
+
+    def _request_full(self, method: str, url: str,
+                      data: Optional[bytes] = None,
+                      headers: Optional[dict] = None) -> Tuple[bytes, dict]:
         # All our operations are idempotent (media PUT to a fixed key, GET,
         # DELETE), so bounded exponential-backoff retry on transient errors
         # is safe — without it, one sporadic 503 among the hundreds of chunk
@@ -241,7 +248,7 @@ class GcsStorage(CheckpointStorage):
                 req.add_header(k, v)
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    return resp.read()
+                    return resp.read(), dict(resp.headers)
             except urllib.error.HTTPError as e:
                 if e.code not in self._RETRY_STATUSES or attempt == self._RETRIES:
                     raise
@@ -257,22 +264,74 @@ class GcsStorage(CheckpointStorage):
             delay = min(delay * 2, 8.0)
         raise AssertionError("unreachable")
 
+    @staticmethod
+    def _md5_b64(data: bytes) -> str:
+        return base64.b64encode(hashlib.md5(data).digest()).decode("ascii")
+
+    @staticmethod
+    def _remote_md5(resource: dict, headers: dict) -> Optional[str]:
+        """md5Hash from an object resource or an ``x-goog-hash`` header.
+
+        Composite objects carry only crc32c; verification is then skipped
+        (we never compose, so in practice every object we wrote has md5)."""
+        md5 = resource.get("md5Hash")
+        if md5:
+            return md5
+        for part in headers.get("X-Goog-Hash", headers.get("x-goog-hash",
+                                                           "")).split(","):
+            part = part.strip()
+            if part.startswith("md5="):
+                return part[len("md5="):]
+        return None
+
     def write_bytes(self, path: str, data: bytes) -> None:
+        # End-to-end integrity: compare the object resource's md5Hash (GCS
+        # computes it over the bytes it durably stored) with ours and re-put
+        # on mismatch — a truncated/corrupted upload must not become the
+        # checkpoint bytes a later restore trusts.
         name = urllib.parse.quote(self._key(path), safe="")
         url = (f"{self.base_url}/upload/storage/v1/b/{self.bucket}/o"
                f"?uploadType=media&name={name}")
-        self._request("POST", url, data=data,
-                      headers={"Content-Type": "application/octet-stream"})
+        want = self._md5_b64(data)
+        for attempt in range(self._RETRIES + 1):
+            body, _ = self._request_full(
+                "POST", url, data=data,
+                headers={"Content-Type": "application/octet-stream"})
+            try:
+                got = self._remote_md5(json.loads(body), {})
+            except ValueError:
+                got = None
+            if got is None or got == want:
+                return
+            if attempt < self._RETRIES:
+                log.warning("GCS put %s: md5 mismatch (stored %s != local "
+                            "%s); re-uploading (%d/%d)", name, got, want,
+                            attempt + 1, self._RETRIES)
+        raise IOError(
+            f"gs://{self.bucket}/{self._key(path)}: upload md5 mismatch "
+            f"after {self._RETRIES + 1} attempts")
 
     def read_bytes(self, path: str) -> bytes:
         name = urllib.parse.quote(self._key(path), safe="")
         url = f"{self.base_url}/storage/v1/b/{self.bucket}/o/{name}?alt=media"
-        try:
-            return self._request("GET", url)
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                raise FileNotFoundError(f"gs://{self.bucket}/{self._key(path)}") from e
-            raise
+        for attempt in range(self._RETRIES + 1):
+            try:
+                body, headers = self._request_full("GET", url)
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    raise FileNotFoundError(
+                        f"gs://{self.bucket}/{self._key(path)}") from e
+                raise
+            want = self._remote_md5({}, headers)
+            if want is None or want == self._md5_b64(body):
+                return body
+            if attempt < self._RETRIES:
+                log.warning("GCS get %s: md5 mismatch (header %s); "
+                            "re-reading (%d/%d)", name, want, attempt + 1,
+                            self._RETRIES)
+        raise IOError(
+            f"gs://{self.bucket}/{self._key(path)}: download md5 mismatch "
+            f"after {self._RETRIES + 1} attempts")
 
     def exists(self, path: str) -> bool:
         if self._exists_object(self._key(path)):

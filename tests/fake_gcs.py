@@ -13,10 +13,16 @@ pagination via ``page_size`` to exercise the client's paging loop.
 
 from __future__ import annotations
 
+import base64
+import hashlib
 import json
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _md5_b64(data: bytes) -> str:
+    return base64.b64encode(hashlib.md5(data).digest()).decode("ascii")
 
 
 class FakeGcsServer:
@@ -25,16 +31,25 @@ class FakeGcsServer:
         self.lock = threading.Lock()
         self.page_size = page_size
         self.requests = []  # (method, path) log
+        # keys whose NEXT upload is truncated in storage (simulating a
+        # corrupted PUT: the md5Hash in the response reflects the stored,
+        # i.e. wrong, bytes) / whose NEXT media read serves flipped bytes
+        # under the true object's x-goog-hash. One-shot: each trigger pops.
+        self.corrupt_next_write = set()
+        self.corrupt_next_read = set()
         store = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
 
-            def _send(self, code, body=b"", ctype="application/json"):
+            def _send(self, code, body=b"", ctype="application/json",
+                      extra=None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -54,9 +69,13 @@ class FakeGcsServer:
                     n = int(self.headers.get("Content-Length", 0))
                     data = self.rfile.read(n)
                     with store.lock:
+                        if name in store.corrupt_next_write:
+                            store.corrupt_next_write.discard(name)
+                            data = data[:-1]  # truncated PUT
                         store.objects[(bucket, name)] = data
                     self._send(200, json.dumps(
-                        {"name": name, "size": str(len(data))}
+                        {"name": name, "size": str(len(data)),
+                         "md5Hash": _md5_b64(data)}
                     ).encode())
                     return
                 self._send(404)
@@ -78,10 +97,19 @@ class FakeGcsServer:
                 if data is None:
                     self._send(404, b'{"error": {"code": 404}}')
                 elif q.get("alt", [""])[0] == "media":
-                    self._send(200, data, "application/octet-stream")
+                    true_hash = _md5_b64(data)
+                    with store.lock:
+                        if key in store.corrupt_next_read:
+                            store.corrupt_next_read.discard(key)
+                            data = bytes([data[0] ^ 0xFF]) + data[1:] \
+                                if data else b"\x00"
+                    self._send(200, data, "application/octet-stream",
+                               extra={"x-goog-hash":
+                                      f"crc32c=AAAAAA==,md5={true_hash}"})
                 else:
                     self._send(200, json.dumps(
-                        {"name": key, "size": str(len(data))}
+                        {"name": key, "size": str(len(data)),
+                         "md5Hash": _md5_b64(data)}
                     ).encode())
 
             def _list(self, bucket, q):
