@@ -16,4 +16,9 @@ from easydl_tpu.data.datasets import (  # noqa: F401
     TokenFileDataset,
     write_token_shards,
 )
+from easydl_tpu.data.images import (  # noqa: F401
+    convert_mnist,
+    import_image_folder,
+    read_idx,
+)
 from easydl_tpu.data.tokenizer import ByteBpeTokenizer  # noqa: F401
