@@ -36,11 +36,13 @@ import json
 import re
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from easydl_tpu.core.chunk_cache import ChunkCache
 from easydl_tpu.core.storage import CheckpointStorage, get_storage
 from easydl_tpu.utils.logging import get_logger
 
@@ -80,14 +82,24 @@ def _parse_chunk_name(name: str) -> Optional[List[Tuple[int, int]]]:
 
 
 class _LeafReader:
-    """Assembles arbitrary slices of one leaf from its saved chunks."""
+    """Assembles arbitrary slices of one leaf from its saved chunks.
+
+    With a host-local :class:`ChunkCache` and this save's token, chunk loads
+    try tmpfs first — the survivor fast path: a rank whose host wrote a
+    chunk reads it back from memory; only chunks other hosts wrote (i.e.
+    slices that actually moved in a reshard) hit shared storage."""
 
     def __init__(self, storage: CheckpointStorage, leaf_dir: str,
-                 shape: Tuple[int, ...], dtype: np.dtype):
+                 shape: Tuple[int, ...], dtype: np.dtype,
+                 cache: Optional[ChunkCache] = None, cache_token: str = "",
+                 cache_rel: str = ""):
         self.storage = storage
         self.shape = shape
         self.dtype = dtype
-        self._chunks: List[Tuple[List[Tuple[int, int]], str]] = []
+        self._cache = cache
+        self._cache_token = cache_token
+        self._cache_rel = cache_rel
+        self._chunks: List[Tuple[List[Tuple[int, int]], str, str]] = []
         # make_array_from_callback calls read() once per local device; on
         # object stores each uncached load_array is a full HTTP download, so
         # overlapping device slices would re-fetch the same chunk per device.
@@ -95,30 +107,48 @@ class _LeafReader:
         # and short-lived. (POSIX load_array returns an mmap: caching it
         # just keeps the fd.)
         self._loaded: Dict[str, np.ndarray] = {}
-        for name in storage.listdir(leaf_dir):
+        # Chunk inventory is the union of storage and cache listings: after
+        # a same-host restart the cache alone can carry the whole leaf, and
+        # the token gate (manifest-recorded) makes cached names as
+        # authoritative as stored ones.
+        names = set(storage.listdir(leaf_dir))
+        if cache is not None:
+            names.update(
+                n for n in cache.listdir(cache_token, cache_rel)
+                if not n.endswith(".tmp"))
+        for name in sorted(names):
             bounds = _parse_chunk_name(name)
             if bounds is not None:
-                self._chunks.append((bounds, f"{leaf_dir}/{name}"))
+                self._chunks.append((bounds, f"{leaf_dir}/{name}", name))
         if not self._chunks:
             raise FileNotFoundError(f"no chunks in {leaf_dir}")
 
-    def _load(self, path: str) -> np.ndarray:
+    def _load(self, path: str, name: str) -> np.ndarray:
         arr = self._loaded.get(path)
         if arr is None:
-            arr = self.storage.load_array(path)
+            if self._cache is not None:
+                arr = self._cache.load(self._cache_token,
+                                       f"{self._cache_rel}/{name}")
+            if arr is None:
+                arr = self.storage.load_array(path)
             self._loaded[path] = arr
         return arr
 
     def read(self, index: Tuple[slice, ...]) -> np.ndarray:
         if not self.shape:
-            return self._load(self._chunks[0][1])
+            return self._load(*self._chunks[0][1:])
         want = [
             (0 if sl.start is None else sl.start, dim if sl.stop is None else sl.stop)
             for sl, dim in zip(index, self.shape)
         ]
+        for bounds, path, name in self._chunks:
+            if bounds == want:
+                # exact-chunk hit (the same-sharding restore): hand the
+                # mmap/array straight through — no assembly copy
+                return self._load(path, name)
         out = np.empty([b - a for a, b in want], dtype=self.dtype)
         filled = 0
-        for bounds, path in self._chunks:
+        for bounds, path, name in self._chunks:
             # overlap of chunk bounds with wanted region
             inter = [
                 (max(a, ca), min(b, cb))
@@ -126,7 +156,7 @@ class _LeafReader:
             ]
             if any(a >= b for a, b in inter):
                 continue
-            data = self._load(path)
+            data = self._load(path, name)
             src = tuple(
                 slice(a - ca, b - ca) for (a, b), (ca, cb) in zip(inter, bounds)
             )
@@ -156,6 +186,10 @@ class CheckpointManager:
         self.keep = keep
         self.async_save = async_save
         self.storage = storage if storage is not None else get_storage(directory)
+        #: host-local tmpfs cache (core/chunk_cache.py): same-host restores
+        #: read back this host's own chunk writes from memory instead of
+        #: shared storage — the generation-switch restore fast path
+        self.cache = ChunkCache.for_directory(directory)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         # Multi-process async saves split in two: chunk IO runs on a
@@ -190,6 +224,18 @@ class CheckpointManager:
         if skip:
             log.info("step %d already checkpointed; skipping", step)
             return
+        # Per-save cache token: leading step number keeps token dirs
+        # sortable for GC; the uuid suffix makes chunks from an aborted save
+        # of the SAME step unservable (different token). Rank 0's token is
+        # broadcast so every rank caches under the name the manifest records.
+        cache_token = f"{step:08d}-{uuid.uuid4().hex[:12]}"
+        if multiproc:
+            from jax.experimental import multihost_utils
+
+            raw = np.frombuffer(cache_token.encode().ljust(32), np.uint8)
+            cache_token = bytes(
+                np.asarray(multihost_utils.broadcast_one_to_all(raw))
+            ).decode().strip()
         leaves = jax.tree_util.tree_flatten_with_path(state)[0]
         snapshot = []  # (leaf_idx, keystr, global_shape, dtype, [(bounds, np.ndarray)])
         for i, (path, leaf) in enumerate(leaves):
@@ -238,6 +284,7 @@ class CheckpointManager:
             manifest = {
                 "step": step,
                 "metadata": metadata or {},
+                "cache_token": cache_token,
                 "leaves": [
                     {"index": i, "key": key, "shape": list(shape), "dtype": str(dtype)}
                     for i, key, shape, dtype, _ in snapshot
@@ -247,9 +294,11 @@ class CheckpointManager:
                 leaf_dir = f"{write_dir}/leaf_{i:05d}"
                 storage.makedirs(leaf_dir)
                 for index, data in chunks:
-                    storage.save_array(
-                        f"{leaf_dir}/{_chunk_name(index, shape)}", data
-                    )
+                    name = _chunk_name(index, shape)
+                    storage.save_array(f"{leaf_dir}/{name}", data)
+                    if self.cache is not None:
+                        self.cache.put(cache_token, f"leaf_{i:05d}/{name}",
+                                       data)
             if jax.process_index() == 0:
                 storage.write_bytes(
                     f"{write_dir}/manifest.json", json.dumps(manifest).encode()
@@ -302,6 +351,8 @@ class CheckpointManager:
             log.info("saved step %d in %.2fs -> %s/%s",
                      step, time.perf_counter() - t0, self.directory, step_dir)
             self._gc()
+            if self.cache is not None:
+                self.cache.gc()
 
         if self.async_save:
             def run_io():
@@ -445,6 +496,9 @@ class CheckpointManager:
             reader = _LeafReader(
                 self.storage, f"{step_dir}/leaf_{rec['index']:05d}",
                 saved_shape, dtype,
+                cache=self.cache,
+                cache_token=manifest.get("cache_token", ""),
+                cache_rel=f"leaf_{rec['index']:05d}",
             )
             arr = jax.make_array_from_callback(
                 want_shape, sharding_, lambda idx, r=reader: r.read(idx)

@@ -65,6 +65,7 @@ class Agent:
         self.warm_start = warm_start
         self._warm: Optional[tuple] = None  # (proc, warm_file, log_file)
         self._warm_count = 0
+        self._warm_due = False  # re-arm standby after worker's first step
         self.worker_argv = worker_argv or [
             sys.executable, "-m", "easydl_tpu.elastic.worker"
         ]
@@ -172,6 +173,14 @@ class Agent:
                 break
             time.sleep(self.heartbeat_interval)
             metrics = self._read_metrics()
+            if (self._warm_due
+                    and int(metrics.get("generation", -1))
+                    == self._applied_key[0]):
+                # the promoted worker is past restore+compile (it recorded a
+                # step this generation): NOW pre-warm the next standby,
+                # off the critical window
+                self._warm_due = False
+                self._spawn_warm()
             try:
                 directive = self._client.Heartbeat(
                     pb.HeartbeatRequest(
@@ -376,8 +385,14 @@ class Agent:
                 stdout=self._log_file, stderr=self._log_file,
             )
             promoted = "spawned worker"
-        if self.warm_start:
-            self._spawn_warm()  # pre-warm the NEXT generation's worker
+        # Re-arming the NEXT generation's standby is DEFERRED to the
+        # heartbeat loop, after this worker records its first post-restore
+        # step: spawning it here put the standby's jax import (the single
+        # most expensive phase on a loaded host) squarely inside the new
+        # generation's restore + first-step-compile window — measured to
+        # cost warm standby its entire win (RECOVERY.json r3: warm 18.45s
+        # vs cold 17.82s).
+        self._warm_due = self.warm_start
         self._applied_key = (m.generation, m.coordinator)
         self._state = "running"
         log.info(

@@ -25,3 +25,104 @@ def cpu_subprocess_env(
         + f" --xla_force_host_platform_device_count={n_devices}"
     )
     return env
+
+
+def join_rank_processes(procs, timeout: float = 900.0, poll_s: float = 0.25):
+    """Join coordinated rank subprocesses (stdout/stderr PIPEd), fail-fast.
+
+    A crashed rank leaves its peers blocked in a collective; waiting out the
+    full timeout hides the root cause for minutes and then discards the
+    failing rank's stderr. Poll instead: the moment any rank exits non-zero
+    (or the deadline passes) kill the stragglers, then harvest every rank's
+    output. Pipes are drained CONCURRENTLY by reader threads — draining
+    only after exit would deadlock any child whose chatter exceeds the OS
+    pipe buffer (it blocks in write(), never exits, and a passing run turns
+    into a full-timeout kill). Returns ``[(returncode, stdout, stderr)]``
+    in rank order — killed stragglers report negative returncodes; the
+    caller should report the *non-signal* failures first.
+    """
+    import threading
+    import time
+
+    def drain(stream, sink):
+        if stream is None:
+            return
+        while True:  # empty-chunk EOF test works for text AND binary pipes
+            chunk = stream.read(8192)
+            if not chunk:
+                return
+            sink.append(chunk)
+
+    buffers = []
+    readers = []
+    for p in procs:
+        out_buf, err_buf = [], []
+        buffers.append((out_buf, err_buf))
+        for stream, sink in ((p.stdout, out_buf), (p.stderr, err_buf)):
+            t = threading.Thread(target=drain, args=(stream, sink),
+                                 daemon=True)
+            t.start()
+            readers.append(t)
+
+    deadline = time.monotonic() + timeout
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            if all(c is not None for c in codes):
+                break
+            if any(c not in (None, 0) for c in codes):
+                break  # a rank failed: don't wait for the blocked peers
+            if time.monotonic() > deadline:
+                break
+            time.sleep(poll_s)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p in procs:
+        p.wait()
+    for t in readers:
+        t.join(timeout=10.0)
+    def joined(buf):
+        return (b"" if buf and isinstance(buf[0], bytes) else "").join(buf)
+
+    return [
+        (p.returncode, joined(out_buf), joined(err_buf))
+        for p, (out_buf, err_buf) in zip(procs, buffers)
+    ]
+
+
+def run_cpu_rank_fleet(argvs, n_local_devices: int, timeout: float = 900.0,
+                       cwd=None):
+    """Spawn one forced-CPU jax subprocess per argv (a coordinated rank
+    fleet), join with fail-fast, and surface failures.
+
+    The single authoritative copy of the spawn/report idiom shared by
+    ``dryrun_multichip``'s multi-process leg and the measurement scripts:
+    per-rank ``cpu_subprocess_env`` + repo PYTHONPATH, concurrent pipe
+    drains via :func:`join_rank_processes`, stdouts replayed in rank order,
+    and failures reported with *real* (non-signal) exits first — a killed
+    straggler's -9 must not mask the rank whose stderr holds the root
+    cause. Raises RuntimeError naming the failing rank; returns the list
+    of rank stdouts on success."""
+    import os
+    import subprocess
+    import sys
+
+    root = cwd or os.getcwd()
+    procs = []
+    for argv in argvs:
+        env = cpu_subprocess_env(n_local_devices)
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            argv, env=env, cwd=root,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = join_rank_processes(procs, timeout=timeout)
+    for rc, out, err in results:
+        sys.stdout.write(out)
+    for rank, (rc, out, err) in sorted(
+            enumerate(results), key=lambda kv: kv[1][0] >= 0, reverse=True):
+        if rc != 0:
+            sys.stderr.write(err)
+            raise RuntimeError(f"rank {rank} failed rc={rc}")
+    return [out for _, out, _ in results]

@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""Measure the quiesce-consensus allgather cost (VERDICT r3 weak 4 / next 9).
+
+The elastic worker reaches a step-boundary quiesce consensus via a tiny
+``process_allgather`` (easydl_tpu/elastic/worker.py). This script records
+what one such call costs at world N (default 4) on this host: it spawns N
+single-device CPU jax processes joined by ``jax.distributed.initialize``
+(the same transport a real multi-host job uses, minus the network), warms
+up, then times many back-to-back allgathers of the worker's exact 2-float
+payload.
+
+Output (rank 0): one JSON line with per-call latency stats and the implied
+per-step overhead fraction for representative step times at the legacy
+every-step cadence vs the auto cadence (sync_target_s=1.0), which the
+worker now uses by default.
+
+Usage: python scripts/measure_consensus.py [--world 4] [--iters 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def child(rank: int, world: int, coord: str, iters: int) -> None:
+    import numpy as np
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=world, process_id=rank)
+    payload = np.asarray([0.0, 0.005], np.float64)  # the worker's payload
+    for _ in range(20):  # warmup (first call compiles/establishes channels)
+        multihost_utils.process_allgather(payload)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        multihost_utils.process_allgather(payload)
+        times.append(time.perf_counter() - t0)
+    if rank == 0:
+        import numpy as np  # noqa: F811
+
+        arr = np.asarray(times)
+        med = float(np.median(arr))
+        from easydl_tpu.elastic.worker import consensus_interval
+
+        overhead = {}
+        for step_ms in (5, 50, 3200):
+            dt = step_ms / 1000.0
+            every = med / (dt + med)  # legacy sync_every=1
+            k = consensus_interval(1.0, dt)
+            auto = (med / k) / (dt + med / k)
+            overhead[f"step_{step_ms}ms"] = {
+                "every_step_pct": round(100 * every, 3),
+                "auto_interval_steps": k,
+                "auto_pct": round(100 * auto, 4),
+            }
+        print(json.dumps({
+            "world": world,
+            "iters": iters,
+            "allgather_median_us": round(med * 1e6, 1),
+            "allgather_p95_us": round(float(np.percentile(arr, 95)) * 1e6, 1),
+            "allgather_mean_us": round(float(arr.mean()) * 1e6, 1),
+            "overhead": overhead,
+        }))
+    jax.distributed.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=300)
+    ap.add_argument("--child", type=int, default=-1, help=argparse.SUPPRESS)
+    ap.add_argument("--coord", default="", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child >= 0:
+        child(args.child, args.world, args.coord, args.iters)
+        return
+
+    sys.path.insert(0, REPO)
+    from easydl_tpu.utils.env import run_cpu_rank_fleet
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    run_cpu_rank_fleet(
+        [[sys.executable, os.path.abspath(__file__),
+          "--world", str(args.world), "--iters", str(args.iters),
+          "--child", str(rank), "--coord", f"127.0.0.1:{port}"]
+         for rank in range(args.world)],
+        n_local_devices=1, timeout=600, cwd=REPO,
+    )
+
+
+if __name__ == "__main__":
+    main()

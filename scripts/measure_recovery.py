@@ -36,6 +36,10 @@ import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# `python scripts/measure_recovery.py` puts scripts/ (not the repo) on
+# sys.path; the bootstrap imports easydl_tpu before any subprocess env is set
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 
 def read_metrics(workdir: str, agent_id: str):
@@ -91,7 +95,9 @@ def decompose_switch(workdir: str, gen_from: int, gen_to: int, t0: float):
         ("process_start_s",         "worker_main_start",  gen_to,   max),
         ("runtime_imports_s",       "jax_imported",       gen_to,   max),
         ("dist_init_s",             "dist_init_done",     gen_to,   max),
-        ("restore_s",               "restored",           gen_to,   max),
+        ("trainer_build_s",         "trainer_built",      gen_to,   max),
+        ("restore_agree_s",         "restore_agreed",     gen_to,   max),
+        ("restore_read_s",          "restored",           gen_to,   max),
         ("first_step_compile_s",    "first_step_done",    gen_to,   max),
     ]
     phases = _phase_chain(recs, chain, t0)
@@ -112,7 +118,9 @@ def decompose_recovery(workdir: str, gen_to: int, t_kill: float):
         ("process_start_s",         "worker_main_start", gen_to, max),
         ("runtime_imports_s",       "jax_imported",      gen_to, max),
         ("dist_init_s",             "dist_init_done",    gen_to, max),
-        ("restore_s",               "restored",          gen_to, max),
+        ("trainer_build_s",         "trainer_built",     gen_to, max),
+        ("restore_agree_s",         "restore_agreed",    gen_to, max),
+        ("restore_read_s",          "restored",          gen_to, max),
         ("first_step_compile_s",    "first_step_done",   gen_to, max),
     ]
     return _phase_chain(recs, chain, t_kill)

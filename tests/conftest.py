@@ -18,6 +18,16 @@ os.environ["XLA_FLAGS"] = (
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_ENABLE_X64"] = "0"
 
+# Route the host-local chunk cache (core/chunk_cache.py) into a per-session
+# tmp dir instead of /dev/shm: the cache stays exercised by every checkpoint
+# test (including subprocess workers, which inherit the env), while repeated
+# suite runs can't accumulate tmpfs debris. Tests that need it off/elsewhere
+# monkeypatch over this.
+import tempfile  # noqa: E402
+
+_cache_root = tempfile.mkdtemp(prefix="easydl-test-chunk-cache-")
+os.environ.setdefault("EASYDL_CHUNK_CACHE", _cache_root)
+
 # The image's sitecustomize registers the axon TPU plugin and pins
 # jax_platforms="axon,cpu" via jax.config — env vars alone don't win. Re-pin
 # to cpu before any backend initialises.
