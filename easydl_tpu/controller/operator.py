@@ -181,7 +181,8 @@ class ElasticJobController:
                  restart_backoff_max: float = 30.0,
                  restart_backoff_reset: float = 60.0,
                  trainer_backoff_limit: Optional[int] = None,
-                 gc_on_completion: bool = True):
+                 gc_on_completion: bool = True,
+                 evaluator_gc_grace_s: float = 300.0):
         self.store = store
         self.pods = pod_api
         self._force_py = force_python_core
@@ -189,9 +190,22 @@ class ElasticJobController:
         # (reference elasticity semantics); an int latches the job Failed
         # after that many CONSECUTIVE trainer failures.
         self._trainer_backoff_limit = trainer_backoff_limit
-        # Terminal jobs GC their still-live pods (PS/evaluator pods never
-        # exit on their own); terminal-phase pods are retained for logs.
+        # Terminal jobs GC their still-live pods (a PS pod never exits on
+        # its own); terminal-phase pods are retained for logs. The evaluator
+        # DOES exit on its own — once it has evaluated the final committed
+        # checkpoint after the DONE marker — so it gets a grace window
+        # before GC: killing it at the latch instant would lose the
+        # final-step evaluation it exists to produce. The window is sized
+        # generously (a final large-checkpoint restore + eval can take
+        # minutes): the only cost of a long grace is that a WEDGED
+        # evaluator lingers that long on an already-finished job before
+        # being reaped. (The operator deliberately cannot observe
+        # eval.jsonl/DONE — workdir internals belong to the job, not the
+        # control plane — so a timer, not a completion signal, is the
+        # boundary-respecting mechanism.)
         self._gc_on_completion = gc_on_completion
+        self._evaluator_gc_grace_s = evaluator_gc_grace_s
+        self._terminal_since: Dict[str, float] = {}  # job -> latch monotonic
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._drift_warned: set = set()  # (job, pod, sig) already reported
@@ -246,6 +260,7 @@ class ElasticJobController:
             self._backoff = {
                 k: v for k, v in self._backoff.items() if k[0] != job_name
             }
+            self._terminal_since.pop(job_name, None)
             return status
 
         # Terminal latch: the trainer exits 0 exactly when the master reports
@@ -292,10 +307,17 @@ class ElasticJobController:
             # The job is over: create nothing, level nothing. Still-live pods
             # will never finish on their own (a parameter server serves until
             # told to stop) — GC them; terminal pods are retained for logs.
+            # Exception: a Running evaluator is finishing its final-step
+            # evaluation and exits 0 by itself — give it a grace window.
             gc_deleted = False
+            now = time.monotonic()
+            latch_t = self._terminal_since.setdefault(job_name, now)
             if self._gc_on_completion:
                 for p in observed:
                     if p.phase in ("Pending", "Running"):
+                        if (p.role == "evaluator"
+                                and now - latch_t < self._evaluator_gc_grace_s):
+                            continue
                         self.pods.delete_pod(p.name)
                         gc_deleted = True
                         status.last_ops.append(

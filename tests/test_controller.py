@@ -600,3 +600,52 @@ def test_trainer_backoff_limit_counts_real_failures():
     api.fail(live[0].name)
     st = ctl.reconcile_job("deepctr")
     assert st.phase == "Failed"
+
+
+def test_terminal_gc_grants_evaluator_grace():
+    """At the terminal latch a Running evaluator is mid-final-eval and exits
+    0 on its own; GC must wait out a grace window for it (killing it there
+    would lose the final-step evaluation), while the PS is GC'd at once."""
+    import time
+
+    def eval_job():
+        return JobSpec(
+            name="deepctr", image="easydl:iris",
+            command="python -m model_zoo.iris",
+            roles={"worker": RoleSpec(), "parameter_server": RoleSpec(),
+                   "evaluator": RoleSpec()},
+        )
+
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api, evaluator_gc_grace_s=0.4)
+    store.submit_job(eval_job())
+    plan = make_plan(ps=1, workers=1)
+    plan.roles["evaluator"] = RolePlan(1, ResourceSpec(cpu=4, memory=4096))
+    store.apply_plan(plan)
+    ctl.reconcile_job("deepctr")
+    api.tick()
+    api.set_phase("deepctr-worker-0", "Succeeded")
+    api.set_phase("deepctr-trainer-0", "Succeeded")
+    st = ctl.reconcile_job("deepctr")
+    assert st.phase == "Succeeded"
+    # PS gone immediately; evaluator still running inside the grace window
+    assert api.get_pod("deepctr-parameter_server-0") is None
+    assert api.get_pod("deepctr-evaluator-0").phase == "Running"
+    # it finishes by itself -> retained as Succeeded, never deleted
+    api.set_phase("deepctr-evaluator-0", "Succeeded")
+    ctl.reconcile_job("deepctr")
+    assert api.get_pod("deepctr-evaluator-0").phase == "Succeeded"
+    # a WEDGED evaluator is reaped once the grace expires
+    store2, api2 = CrStore(), InMemoryPodApi()
+    ctl2 = ElasticJobController(store2, api2, evaluator_gc_grace_s=0.1)
+    store2.submit_job(eval_job())
+    store2.apply_plan(plan)
+    ctl2.reconcile_job("deepctr")
+    api2.tick()
+    api2.set_phase("deepctr-worker-0", "Succeeded")
+    api2.set_phase("deepctr-trainer-0", "Succeeded")
+    ctl2.reconcile_job("deepctr")
+    assert api2.get_pod("deepctr-evaluator-0").phase == "Running"
+    time.sleep(0.15)
+    ctl2.reconcile_job("deepctr")
+    assert api2.get_pod("deepctr-evaluator-0") is None

@@ -122,3 +122,48 @@ def test_profiling_trace_capture(tmp_path):
     traces = glob.glob(prof + "/**/*.trace.json.gz", recursive=True) + \
         glob.glob(prof + "/**/*.xplane.pb", recursive=True)
     assert traces, f"no trace files under {prof}: {r.stderr[-500:]}"
+
+
+def test_evaluator_main_pod_entrypoint(tmp_path, eight_devices):
+    """The evaluator POD path (easydl_tpu/elastic/evaluator_main.py): given
+    a workdir the trainer/workers populated (job.json, ckpt/, DONE), the
+    subprocess evaluates the latest checkpoint, appends eval.jsonl, and
+    exits 0 on its own (the lifecycle test covers it under the operator)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    cfg = {"model": "mlp", "model_kwargs": {"features": [32, 32]},
+           "global_batch": 16, "lr": 1e-2, "seed": 0}
+    (workdir / "job.json").write_text(json.dumps(cfg))
+
+    bundle = get_model("mlp", features=(32, 32))
+    trainer = Trainer(
+        init_fn=bundle.init_fn,
+        loss_fn=bundle.loss_fn,
+        optimizer=optax.adam(1e-2),
+        config=TrainConfig(global_batch=16),
+        mesh_spec=MeshSpec(dp=8),
+    )
+    state = trainer.init_state()
+    batch = next(iter(bundle.make_data(16, seed=0)))
+    for _ in range(2):
+        state, _ = trainer.train_step(state, batch)
+    mgr = CheckpointManager(str(workdir / "ckpt"), async_save=False)
+    mgr.save(2, state)
+    (workdir / "DONE").write_text("2")
+
+    res = subprocess.run(
+        [sys.executable, "-m", "easydl_tpu.elastic.evaluator_main",
+         "--workdir", str(workdir), "--batches-per-eval", "2",
+         "--poll-interval", "0.2"],
+        capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    lines = (workdir / "eval.jsonl").read_text().strip().splitlines()
+    evals = [json.loads(ln) for ln in lines]
+    assert len(evals) == 1
+    assert evals[0]["step"] == 2.0
+    assert "loss" in evals[0] and evals[0]["loss"] == evals[0]["loss"]

@@ -80,6 +80,13 @@ def test_full_reference_lifecycle(tmp_path):
                 "--master-file {workdir}/master.json --workdir {workdir} "
                 "--slots 1 --platform cpu"
             )),
+            # third pod role (docs/design/elastic-training-operator.md:43-44):
+            # declaring it makes Brain's plan include evaluator: 1, and the
+            # operator launches a REAL checkpoint-following evaluator pod
+            "evaluator": RoleSpec(command=(
+                "python -m easydl_tpu.elastic.evaluator_main "
+                "--workdir {workdir} --batches-per-eval 2"
+            )),
         },
     )
     with open(tmp_path / "job.yaml", "w") as f:
@@ -114,7 +121,8 @@ def test_full_reference_lifecycle(tmp_path):
             90, "trainer pod launched first (and alone)",
         )
 
-        # steps 4-6: trainer applies the plan; operator launches workers
+        # steps 4-6: trainer applies the plan; operator launches workers AND
+        # the evaluator (the plan's third role)
         wait_for(
             lambda: len([p for p in api.list_pods(job_name)
                          if p.role == "worker"]) == 2,
@@ -122,6 +130,12 @@ def test_full_reference_lifecycle(tmp_path):
             lambda: f"2 worker pods; all pod logs:\n{dump_pod_logs(workdir)}",
         )
         assert os.path.exists(os.path.join(plan_dir, f"{job_name}-plan.yaml"))
+        wait_for(
+            lambda: len([p for p in api.list_pods(job_name)
+                         if p.role == "evaluator"]) == 1,
+            60,
+            lambda: f"1 evaluator pod; all pod logs:\n{dump_pod_logs(workdir)}",
+        )
 
         # training runs to completion: every pod exits Succeeded
         def all_succeeded():
@@ -162,9 +176,21 @@ def test_full_reference_lifecycle(tmp_path):
         assert ckpts, f"no checkpoints in {ckpt_dir}"
         assert os.path.exists(os.path.join(workdir, "master.json"))
 
+        # the evaluator followed the run: its metrics file exists and covers
+        # the final checkpointed step
+        import json
+
+        eval_path = os.path.join(workdir, "eval.jsonl")
+        assert os.path.exists(eval_path), (
+            f"no eval.jsonl; pod logs:\n{dump_pod_logs(workdir)}"
+        )
+        with open(eval_path) as f:
+            evals = [json.loads(line) for line in f if line.strip()]
+        assert evals and all("loss" in e and "step" in e for e in evals)
+        assert max(e["step"] for e in evals) == 8.0
+
         # the workers trained the JOB'S command, not defaults: the trainer
         # derived the worker config from ElasticJob spec.command
-        import json
 
         with open(os.path.join(workdir, "job.json")) as f:
             cfg = json.load(f)
