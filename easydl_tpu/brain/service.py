@@ -156,6 +156,16 @@ class Brain:
             canonical = os.path.basename(self._job_path(name))
             if fname != canonical:
                 self._persist(name)  # migrate to the canonical name
+            if not os.path.exists(os.path.join(self._state_dir, canonical)):
+                # The migration persist failed (full/read-only disk —
+                # _persist only logs): the legacy file is the ONLY durable
+                # copy of this job's plan state. Removing it now would lose
+                # it if we crash before a later persist succeeds.
+                log.warning(
+                    "keeping legacy state file(s) for %r: canonical %s "
+                    "missing after migration", name, canonical,
+                )
+                continue
             for legacy in files_of[name]:
                 if legacy != canonical:
                     try:

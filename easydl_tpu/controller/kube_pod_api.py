@@ -109,6 +109,17 @@ def pod_to_manifest(pod: Pod, namespace: str,
     }
     if pod.command:
         cmd = pod.command
+        if "{workdir}" in cmd and workdir_volume is None:
+            # Without a shared volume, {workdir} resolves to a path on each
+            # container's OWN filesystem — master.json, the PS registry, and
+            # ready files would never be visible across pods and every
+            # discover()/rendezvous would hang until timeout with no hint.
+            # Warn at create time, where the misconfiguration is actionable.
+            log.warning(
+                "pod %s: command uses {workdir} but no --workdir-volume is "
+                "configured — %s will be container-local and cross-pod "
+                "file rendezvous will hang", pod.name, workdir,
+            )
         for token, value in (("{name}", pod.name), ("{role}", pod.role),
                              ("{job}", pod.job), ("{workdir}", workdir)):
             cmd = cmd.replace(token, value)

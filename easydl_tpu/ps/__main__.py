@@ -49,20 +49,65 @@ def shard_index_from_name(name: str) -> Optional[int]:
     return int(tail) if tail.isdigit() else None
 
 
-def probe_alive(address: str, timeout: float = 2.0) -> bool:
+def probe_alive(address: str, timeout: float = 5.0, attempts: int = 2) -> bool:
     """Is a PS actually serving at this registry address? Registry entries
     outlive their pods (a crashed shard's file stays on disk), so liveness
-    is decided by the socket, not the file."""
+    is decided by the socket, not the file.
+
+    Retried: declaring a LIVE shard dead is far worse than a slow rescue —
+    a rescue pod would hijack the healthy shard and re-publish it with
+    stale checkpoint rows. One slow Stats reply (load, GC pause) must not
+    read as death."""
     from easydl_tpu.proto import easydl_pb2 as pb
 
-    client = RpcClient(PS_SERVICE, address, timeout=timeout)
+    for attempt in range(attempts):
+        client = RpcClient(PS_SERVICE, address, timeout=timeout)
+        try:
+            client.Stats(pb.PsStatsRequest())
+            return True
+        except Exception:
+            if attempt + 1 < attempts:
+                time.sleep(0.5)
+        finally:
+            client.close()
+    return False
+
+
+def _locked_claim(path: str, mutate) -> dict:
+    """Read-check-write a claim file atomically under an exclusive flock.
+
+    ``mutate(doc) -> new_doc | None`` runs with the lock held; None leaves
+    the file unchanged. The file's inode is stable (in-place truncate+write,
+    never os.replace), so the flock actually serializes every writer —
+    a rename-based update would silently drop the lock's protection.
+    Returns the doc now in the file. A missing file returns {}."""
+    import fcntl
+
     try:
-        client.Stats(pb.PsStatsRequest())
-        return True
-    except Exception:
-        return False
-    finally:
-        client.close()
+        with open(path, "r+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                try:
+                    doc = json.load(f)
+                except ValueError:
+                    doc = {}  # torn write from a crashed claimant
+                new = mutate(doc)
+                if new is not None:
+                    f.seek(0)
+                    f.truncate()
+                    json.dump(new, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                return new if new is not None else doc
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+    except FileNotFoundError:
+        return {}
+
+
+def claim_owner(path: str) -> Optional[str]:
+    """Current claim owner, read under the same lock writers hold."""
+    return _locked_claim(path, lambda doc: None).get("pod")
 
 
 def claim_orphan_shard(workdir: str, pod: str, orphans,
@@ -71,30 +116,59 @@ def claim_orphan_shard(workdir: str, pod: str, orphans,
     """Claim one orphaned shard via an O_EXCL claim file so two concurrent
     failure replacements can't adopt the same shard. A claim older than
     ``stale_s`` whose shard is still unserved is presumed abandoned (the
-    claimant crashed mid-rescue) and stolen; the original claimant notices
-    at publish time (claim ownership is re-checked) and exits."""
+    claimant crashed mid-rescue) and stolen — the age re-check and the
+    overwrite happen atomically under the claim flock, so two stealers
+    can't both win and a resumed claimant can't clobber the steal. The
+    original claimant notices at publish time (ownership re-checked) and
+    exits."""
     claim_dir = os.path.join(workdir, registry.REG_DIR)
     os.makedirs(claim_dir, exist_ok=True)
-    doc = json.dumps({"pod": pod, "t": time.time()})
     for s in orphans:
         path = os.path.join(claim_dir, f"claim-shard-{s}.json")
+        created = False
         try:
-            with open(path, "x") as f:
-                f.write(doc)
-            return s, path
+            # O_EXCL decides who the CREATOR is, but the content is written
+            # under the flock like every other mutation — an unlocked
+            # initial write could interleave with (and tear) a concurrent
+            # steal that read the still-empty file as a stale claim.
+            os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            created = True
         except FileExistsError:
-            try:
-                with open(path) as f:
-                    age = time.time() - float(json.load(f).get("t", 0))
-            except (OSError, ValueError):
-                age = stale_s + 1  # torn claim: treat as stale
+            pass
+
+        def take(doc, creator=created):
+            if not doc and creator:
+                return {"pod": pod, "t": time.time()}  # our fresh file
+            age = (time.time() - float(doc.get("t", 0))
+                   if doc else stale_s + 1)
             if age > stale_s:
-                tmp = f"{path}.steal-{pod}"
-                with open(tmp, "w") as f:
-                    f.write(doc)
-                os.replace(tmp, path)
-                return s, path
+                return {"pod": pod, "t": time.time()}  # stale: steal
+            return None
+
+        if _locked_claim(path, take).get("pod") == pod:
+            return s, path
     return None, None
+
+
+def claim_heartbeat(claim_path: str, pod: str, stop, interval: float) -> None:
+    """Refresh our claim's timestamp while the restore runs, so an ACTIVE
+    claimant can never look stale: a steal then only happens to a claimant
+    genuinely wedged for longer than ``stale_s``. The ownership check and
+    the timestamp write are one atomic operation under the claim flock —
+    a resumed-from-wedge heartbeat that already lost the claim observes
+    that INSIDE the lock and stands down, rather than resurrecting its
+    ownership over a legitimate steal (the round-4 review's interleaving)."""
+    while not stop.wait(interval):
+        def refresh(doc):
+            if doc.get("pod") != pod:
+                return None  # lost the claim; publish-time check handles it
+            return {"pod": pod, "t": time.time()}
+
+        try:
+            if _locked_claim(claim_path, refresh).get("pod") != pod:
+                return
+        except OSError:
+            pass
 
 
 def resolve_fresh_shard(workdir: str, pod: str,
@@ -114,10 +188,14 @@ def resolve_fresh_shard(workdir: str, pod: str,
             (live if probe_alive(doc["address"]) else dead).add(s)
     name_idx = shard_index_from_name(pod)
     if (name_idx is not None and 0 <= name_idx < num_shards
-            and name_idx not in live and not dead - {name_idx}):
-        # The normal initial-creation path (and in-place restart): the name
-        # is a valid unserved shard and no OTHER shard needs rescue.
-        return name_idx, name_idx in dead, None
+            and name_idx not in live and name_idx not in dead and not dead):
+        # The normal initial-creation path: the name is a valid
+        # never-published shard and nothing needs rescue. ANY rescue —
+        # including the in-place restart of our own named shard — must go
+        # through the claim below: a same-name restart and a levelled-in
+        # fresh pod can race for the same dead shard, and without a claim
+        # both would restore and publish it (round-4 review).
+        return name_idx, False, None
     orphans = [s for s in range(num_shards) if s not in live]
     # Prefer the name's own shard when it is among the orphans (less churn).
     orphans.sort(key=lambda s: (s != name_idx, s))
@@ -208,6 +286,16 @@ def main() -> None:
     log.info("ps pod %s serving shard %d/%d on %s",
              args.name, shard.shard_index, num_shards, server.address)
 
+    hb_stop = hb_thread = None
+    if claim_path is not None:
+        import threading
+
+        hb_stop = threading.Event()
+        hb_thread = threading.Thread(
+            target=claim_heartbeat, args=(claim_path, args.name, hb_stop, 10.0),
+            daemon=True)
+        hb_thread.start()
+
     if old is not None:
         run_handoff(old, args.workdir, shard)
     elif rescued:
@@ -224,21 +312,43 @@ def main() -> None:
         except FileNotFoundError:
             log.warning("no complete PS checkpoint under %s; rescued shard "
                         "%d starts empty", ckpt_dir, index)
+        # Last line of defense against hijacking a live shard: the restore
+        # took time — if the shard's prior publication answers NOW, the
+        # "dead" verdict was a slow probe, not a death. Stand down.
+        prior = registry.shard_map(args.workdir).get(index)
+        if prior is not None and probe_alive(prior["address"]):
+            server.stop()
+            raise SystemExit(
+                f"shard {index}'s prior server {prior['pod']!r} answers "
+                "again — it was slow, not dead; standing down"
+            )
 
+    if hb_stop is not None:
+        hb_stop.set()
+        hb_thread.join(timeout=1.0)
     if claim_path is not None:
         # A stale-claim thief may have taken the shard while we restored;
         # the registry must not see two publications racing for it.
-        try:
-            with open(claim_path) as f:
-                owner = json.load(f).get("pod")
-        except (OSError, ValueError):
-            owner = None
+        owner = claim_owner(claim_path)
         if owner != args.name:
+            server.stop()
             raise SystemExit(
                 f"claim on shard {index} taken over by {owner!r}; exiting"
             )
     registry.publish(args.workdir, args.name, shard.shard_index,
                      num_shards, server.address)
+    if claim_path is not None:
+        # Close the remaining check-then-publish window: if ownership moved
+        # between the check above and our publish, bow out LOUDLY (stop
+        # serving, exit non-zero) — a bounded, visible failure instead of a
+        # silent split-brain with pushes split across two servers.
+        owner = claim_owner(claim_path)
+        if owner != args.name:
+            server.stop()
+            raise SystemExit(
+                f"claim on shard {index} lost to {owner!r} at publish; "
+                "exiting"
+            )
     if args.ready_file:
         with open(args.ready_file, "w") as f:
             f.write(server.address)

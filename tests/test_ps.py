@@ -506,3 +506,57 @@ def test_push_survives_reroute_closing_old_transport(tmp_path):
     finally:
         server.stop()
         repl_server.stop()
+
+
+def test_claim_lock_protocol(tmp_path):
+    """The rescue-claim file protocol (ps/__main__.py): O_EXCL creation,
+    atomic flock-serialized steal of stale claims only, and a heartbeat
+    that stands down (never resurrects ownership) after a steal — the
+    round-4 review's split-brain interleavings."""
+    import threading
+    import time as _time
+
+    from easydl_tpu.ps.__main__ import (
+        _locked_claim,
+        claim_heartbeat,
+        claim_orphan_shard,
+        claim_owner,
+    )
+
+    wd = str(tmp_path)
+    s, path = claim_orphan_shard(wd, "podA", [0])
+    assert s == 0 and claim_owner(path) == "podA"
+    # a FRESH claim cannot be stolen
+    s2, _ = claim_orphan_shard(wd, "podB", [0])
+    assert s2 is None
+    # a STALE claim is stolen (age re-checked under the lock)
+    _locked_claim(path, lambda d: {"pod": "podA", "t": _time.time() - 60})
+    s3, p3 = claim_orphan_shard(wd, "podB", [0], stale_s=30)
+    assert s3 == 0 and p3 == path and claim_owner(path) == "podB"
+    # podA's resumed heartbeat must observe the steal and stand down
+    stop = threading.Event()
+    t = threading.Thread(target=claim_heartbeat,
+                         args=(path, "podA", stop, 0.01), daemon=True)
+    t.start()
+    t.join(timeout=5)
+    assert not t.is_alive(), "heartbeat kept running after losing the claim"
+    assert claim_owner(path) == "podB"
+    stop.set()
+
+
+def test_rescue_requires_claim_even_for_own_name(tmp_path):
+    """An in-place same-name restart whose shard is DEAD must go through the
+    claim (a levelled-in fresh pod can race it for the same shard); only a
+    never-published shard skips it."""
+    from easydl_tpu.ps import registry as reg
+    from easydl_tpu.ps.__main__ import claim_owner, resolve_fresh_shard
+
+    wd = str(tmp_path)
+    # never-published: name path, no claim
+    idx, rescued, claim = resolve_fresh_shard(wd, "j-parameter_server-0", 2)
+    assert (idx, rescued, claim) == (0, False, None)
+    # a dead publication for shard 0 (nothing listens on the port)
+    reg.publish(wd, "j-parameter_server-0", 0, 2, "127.0.0.1:1")
+    idx, rescued, claim = resolve_fresh_shard(wd, "j-parameter_server-0", 2)
+    assert idx == 0 and rescued and claim is not None
+    assert claim_owner(claim) == "j-parameter_server-0"
