@@ -66,6 +66,12 @@ class TrainState(struct.PyTreeNode):
 class TrainConfig:
     global_batch: int = 32
     grad_accum: int = 1
+    #: lax.scan unroll for the accumulation loop. The profiler trace
+    #: (scripts/bench_profile.py → PROFILE.json) showed the scan carry's
+    #: gradient adds as dynamic-update-slice fusions costing ~16% of the
+    #: step at accum 32; unrolling lets XLA fuse the carry update across
+    #: ``accum_unroll`` microbatches, cutting that HBM write traffic.
+    accum_unroll: int = 1
     compute_dtype: Any = jnp.bfloat16
     seed: int = 0
     rules: Sequence[Tuple[str, Any]] = field(default_factory=lambda: shd.DEFAULT_RULES)
@@ -206,7 +212,8 @@ class Trainer:
             )
             rest = jax.tree.map(lambda x: x[1:], microbatches)
             (loss_sum, aux_sum, grad_sum), _ = jax.lax.scan(
-                body, (loss0, aux0, grads0), (rest, jnp.arange(1, accum))
+                body, (loss0, aux0, grads0), (rest, jnp.arange(1, accum)),
+                unroll=max(self.config.accum_unroll, 1),
             )
             scale = 1.0 / accum
             return (
