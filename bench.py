@@ -58,7 +58,8 @@ def main() -> None:
         # Config from scripts/bench_sweep.py evidence (v5e):
         #   r2: f32 dots b8 27.6 | bf16 dots b8 37.9 | b64/a8 39.9
         #   r3 (re-measured): plain b64/a8 39.85 | plain b128/a16 40.13 |
-        #       plain b256/a32 40.26
+        #       plain b256/a32 40.26  <- adopted in r4 (the bench previously
+        #       pinned b128/a16 and left its own best on the table)
         #   r3 fused chunked LM loss (ops/fused_xent.py): removes the
         #       [B,S,V] f32 logits buffer, so microbatch >8 now COMPILES —
         #       but measured SLOWER here (fused b64/a8 38.2, fused mb16
@@ -69,9 +70,14 @@ def main() -> None:
         #       (remote_compile helper 500s). Flash blocks re-confirmed in
         #       the full model at this config: 512/512 39.88 > 1024/1024
         #       38.94 > 256/512 38.87 > 512/1024 38.29 — the default holds.
+        #   r4 attribution (scripts/bench_profile.py -> PROFILE.json, this
+        #       config): flash attention kernels ~30% of device time, the
+        #       accumulation scan carry's dynamic-update-slice fusions ~16%,
+        #       reduction fusions ~13% — the carry cost is the lever
+        #       TrainConfig.accum_unroll targets.
         size, seq_len, steps = "345m", 1024, 15
-        grad_accum = 16
-        global_batch = 128 * n_chips
+        grad_accum = 32
+        global_batch = 256 * n_chips
         bundle = get_model("gpt", size=size, seq_len=seq_len, remat=True,
                            remat_policy="dots", dtype="bfloat16",
                            fused_loss=False)

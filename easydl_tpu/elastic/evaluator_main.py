@@ -62,10 +62,11 @@ def main() -> None:
         # instead of evaluating a model with missing parameters.
         raise SystemExit("evaluator does not support embedding='ps' jobs")
 
-    import jax
+    import jax  # noqa: F401  (backend init order matters)
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    from easydl_tpu.utils.env import pin_cpu_platform_if_requested
+
+    pin_cpu_platform_if_requested()
 
     import optax
 

@@ -80,8 +80,9 @@ def run_worker(env: Dict[str, str]) -> int:
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    from easydl_tpu.utils.env import pin_cpu_platform_if_requested
+
+    pin_cpu_platform_if_requested()
     # Persistent compilation cache shared across generations: every
     # membership change rebuilds the trainer and re-jits, and without this
     # the recompile dominates recovery time (SURVEY.md §7 hard part 1).
@@ -393,8 +394,9 @@ def _warm_wait(warm_file: str) -> Dict[str, str]:
 
     import jax  # noqa: F401  (the import IS the work)
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    from easydl_tpu.utils.env import pin_cpu_platform_if_requested
+
+    pin_cpu_platform_if_requested()
     # READY marker: lets the agent (and tests) see the standby is warm.
     try:
         with open(warm_file + ".ready", "w") as f:

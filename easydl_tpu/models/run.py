@@ -93,6 +93,11 @@ def main() -> None:
     args = ap.parse_args()
 
     import jax
+
+    from easydl_tpu.utils.env import pin_cpu_platform_if_requested
+
+    pin_cpu_platform_if_requested()
+
     import optax
 
     from easydl_tpu.core.checkpoint import CheckpointManager

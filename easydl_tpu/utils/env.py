@@ -126,3 +126,19 @@ def run_cpu_rank_fleet(argvs, n_local_devices: int, timeout: float = 900.0,
             sys.stderr.write(err)
             raise RuntimeError(f"rank {rank} failed rc={rc}")
     return [out for _, out, _ in results]
+
+def pin_cpu_platform_if_requested() -> None:
+    """Honor ``JAX_PLATFORMS=cpu`` even where a sitecustomize pins an
+    accelerator plugin via jax.config (which outranks env vars).
+
+    The in-process half of the forced-CPU recipe — the single copy every
+    entrypoint (zoo runner, elastic worker, warm standby, evaluator pod)
+    calls right after importing jax. Without it, a CPU-deployed process
+    attaches to the accelerator plugin and hangs or fails whenever that
+    backend is unreachable."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

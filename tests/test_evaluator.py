@@ -97,12 +97,12 @@ def test_model_zoo_runner_cli(tmp_path):
 
 
 def _cpu_env():
-    import os
+    # the canonical forced-CPU recipe (also neutralises the TPU tunnel
+    # plugin — without that these subprocesses attach to the accelerator
+    # and hang whenever the tunnel is down)
+    from easydl_tpu.utils.env import cpu_subprocess_env
 
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-    return env
+    return cpu_subprocess_env(8)
 
 
 def test_profiling_trace_capture(tmp_path):
