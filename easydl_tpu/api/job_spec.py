@@ -183,9 +183,22 @@ class JobSpec:
         if self.accelerator is not None:
             self.accelerator.validate()
 
+    #: command a bare ``evaluator: {}`` role runs. Falling back to
+    #: ``spec.command`` (the TRAINING entry) would make the evaluator pod
+    #: train instead of evaluate; the checkpoint-following evaluator
+    #: entrypoint is the correct role default
+    #: (docs/design/elastic-training-operator.md:43-44: side evaluation).
+    DEFAULT_EVALUATOR_COMMAND = (
+        "python -m easydl_tpu.elastic.evaluator_main --workdir {workdir}"
+    )
+
     def role_command(self, role: str) -> str:
         r = self.roles.get(role)
-        return (r.command if r and r.command else self.command)
+        if r and r.command:
+            return r.command
+        if role == "evaluator":
+            return self.DEFAULT_EVALUATOR_COMMAND
+        return self.command
 
     def role_image(self, role: str) -> str:
         r = self.roles.get(role)

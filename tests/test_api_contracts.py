@@ -128,3 +128,19 @@ def test_vertical_merge():
     upd = ResourceSpec(cpu=8)
     merged = upd.merged_over(base)
     assert merged.cpu == 8 and merged.memory == 4096
+
+
+def test_evaluator_role_default_command():
+    """A bare `evaluator: {}` role must run the checkpoint-following
+    evaluator entrypoint, NOT inherit the training command (which would
+    make the evaluator pod train)."""
+    from easydl_tpu.api.job_spec import JobSpec, RoleSpec
+
+    job = JobSpec(name="j", command="python -m easydl_tpu.models.run --model mlp",
+                  roles={"evaluator": RoleSpec(), "worker": RoleSpec()})
+    assert "evaluator_main" in job.role_command("evaluator")
+    assert job.role_command("worker") == job.command  # workers still inherit
+    # an explicit evaluator command still wins
+    job2 = JobSpec(name="j", command="c",
+                   roles={"evaluator": RoleSpec(command="custom eval")})
+    assert job2.role_command("evaluator") == "custom eval"
