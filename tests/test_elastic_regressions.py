@@ -206,3 +206,40 @@ def test_consensus_interval_schedule():
     for dt in (0.004, 0.2, 7.0):
         ks = {consensus_interval(1.0, dt) for _ in range(4)}
         assert len(ks) == 1 and min(ks) >= 1
+
+
+def test_join_rank_processes_fail_fast_and_drain():
+    """The rank-fleet join (utils/env.py): a crashed rank must not wait out
+    the full timeout (its peers are killed promptly), pipes are drained
+    concurrently (output bigger than the OS pipe buffer can't deadlock),
+    and the real failure's stderr survives."""
+    import subprocess
+    import sys
+    import time
+
+    from easydl_tpu.utils.env import join_rank_processes
+
+    # rank 0 blocks "in a collective"; rank 1 crashes fast with stderr
+    procs = [
+        subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True),
+        subprocess.Popen([sys.executable, "-c",
+                          "import sys; sys.stderr.write('root cause here'); "
+                          "sys.exit(3)"],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True),
+    ]
+    t0 = time.monotonic()
+    results = join_rank_processes(procs, timeout=30, poll_s=0.05)
+    assert time.monotonic() - t0 < 10, "fail-fast didn't"
+    assert results[0][0] < 0          # straggler killed (signal)
+    assert results[1][0] == 3
+    assert "root cause here" in results[1][2]
+
+    # > pipe-buffer output drains without deadlock
+    big = subprocess.Popen(
+        [sys.executable, "-c", "import sys; sys.stdout.write('x' * 300000)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    (rc, out, err), = join_rank_processes([big], timeout=30)
+    assert rc == 0 and len(out) == 300000
