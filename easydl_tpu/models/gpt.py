@@ -57,6 +57,8 @@ def make_gpt(
     moe_capacity_factor: float = 1.25,
     fused_loss: bool = False,
     loss_chunk: int = 128,
+    pipeline_fn=None,
+    pipeline_stages: int = 0,
 ) -> ModelBundle:
     n_layers, d_model, n_heads = SIZES[size]
     cfg = TransformerConfig(
@@ -77,6 +79,8 @@ def make_gpt(
         moe_experts=moe_experts,
         moe_k=moe_k,
         moe_capacity_factor=moe_capacity_factor,
+        pipeline_fn=pipeline_fn,
+        pipeline_stages=pipeline_stages,
     )
     model = Transformer(cfg)
 

@@ -99,6 +99,14 @@ class TransformerConfig:
     attention_fn: Optional[Callable] = None
     #: tie the LM head to the token embedding (GPT-2 does)
     tied_head: bool = True
+    #: pipeline parallelism over the mesh's ``pp`` axis: ``pipeline_fn``
+    #: (from :func:`easydl_tpu.ops.pipeline.make_pipeline`, closing over the
+    #: mesh like ``attention_fn`` does) runs the block stack as a GPipe
+    #: fill-drain schedule; ``pipeline_stages`` is the pp size (must divide
+    #: ``n_layers``). Params stay the same stacked [n_layers, ...] layout —
+    #: the stage split is purely a ``layers → pp`` sharding rule.
+    pipeline_fn: Optional[Callable] = None
+    pipeline_stages: int = 0
     #: mixture-of-experts: replace each block's FFN with ``moe_experts``
     #: expert FFNs routed top-``moe_k`` (0 = dense). Experts shard over the
     #: mesh's ``ep`` axis (easydl_tpu/ops/moe.py).
@@ -255,14 +263,53 @@ class Transformer(nn.Module):
             )
             block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
         # One traced block, scanned over a stacked 'layers' param axis.
-        x, layer_aux = nn.scan(
-            block_cls,
+        scan_kwargs = dict(
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
             in_axes=(nn.broadcast,),
-            length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
-        )(cfg, name="blocks")(x, deterministic)
+        )
+        scanned = nn.scan(block_cls, length=cfg.n_layers,
+                          **scan_kwargs)(cfg, name="blocks")
+        if cfg.pipeline_fn is None or self.is_initializing():
+            # plain (or init) path: params are created here with the
+            # stacked [n_layers, ...] layout the pipeline also expects
+            x, layer_aux = scanned(x, deterministic)
+        else:
+            if cfg.moe_experts:
+                raise NotImplementedError("MoE inside the pipeline")
+            if cfg.n_layers % cfg.pipeline_stages:
+                raise ValueError(
+                    f"n_layers={cfg.n_layers} not divisible by "
+                    f"pipeline_stages={cfg.pipeline_stages}"
+                )
+            fn_stages = getattr(cfg.pipeline_fn, "stages", None)
+            if fn_stages is not None and fn_stages != cfg.pipeline_stages:
+                # A mismatch would otherwise surface as an opaque scan
+                # axis-size error deep inside shard_map tracing.
+                raise ValueError(
+                    f"pipeline_stages={cfg.pipeline_stages} != the "
+                    f"pipeline_fn's mesh pp size {fn_stages}"
+                )
+            # Apply the SAME stacked params through the GPipe schedule: a
+            # standalone scan of length n_layers/pp has an identical param
+            # tree structure, so each stage applies its [L/pp, ...] slice.
+            chunk = nn.scan(
+                block_cls, length=cfg.n_layers // cfg.pipeline_stages,
+                **scan_kwargs,
+            )(cfg)
+            stacked = nn.meta.unbox(self.variables["params"]["blocks"])
+
+            def apply_stage(stage_params, h):
+                y, _ = chunk.apply({"params": stage_params}, h, deterministic)
+                return y
+
+            # block_remat tells the pipeline whether the blocks already
+            # carry nn.remat (then its own stage checkpoint would double
+            # the backward recompute)
+            x = cfg.pipeline_fn(apply_stage, stacked, x,
+                                block_remat=cfg.remat)
+            layer_aux = jnp.zeros((cfg.n_layers,), jnp.float32)
         # Per-layer MoE load-balance losses (zeros for dense blocks); read
         # back by MoE loss fns via mutable=["intermediates"] — a no-op sow
         # for plain apply() calls.

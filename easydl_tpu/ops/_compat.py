@@ -1,0 +1,12 @@
+"""Version-compat shims shared by the ops modules.
+
+One copy of each try/except import dance: when the jax minimum moves, this
+is the only file to touch.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.8
+    from jax import shard_map  # noqa: F401
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # noqa: F401

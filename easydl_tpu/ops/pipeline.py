@@ -1,0 +1,167 @@
+"""Pipeline parallelism: a GPipe fill–drain schedule over the ``pp`` axis.
+
+The reference has no pipeline parallelism (SURVEY §2.2 — the ``pp`` mesh
+axis was reserved with no dedicated schedule); this module supplies the
+schedule, TPU-first: the transformer's blocks are ALREADY an ``nn.scan``
+over a stacked ``layers`` parameter axis, so stage sharding is just mapping
+``layers → pp`` in the rule table — each pp rank then physically holds its
+``n_layers/pp`` consecutive layers, and :func:`pipeline_blocks` runs the
+classic GPipe schedule inside one ``shard_map``:
+
+- the stage-local activation hops to the next stage over ``ppermute``
+  (neighbour ICI traffic — exactly what pipeline parallelism exists to
+  exploit);
+- ``lax.scan`` over ``M + pp - 1`` ticks (static trip count: XLA-friendly
+  control flow); the first ``pp-1`` and last ``pp-1`` ticks are the usual
+  GPipe bubble;
+- microbatching splits only the *forward pathway* inside the pipeline;
+  loss/optimizer see the reassembled full batch, so training math is
+  identical to the unpipelined model (the parity test asserts this).
+
+Embedding, final LN, head and loss stay OUTSIDE the shard_map under plain
+GSPMD; the pipeline output is replicated over ``pp`` via a masked psum of
+the last stage's result.
+
+Scope (v1): stage-local weights are unsharded inside the pipeline (no
+tp/fsdp of a stage's own matrices — :func:`pipeline_rules` maps the weight
+axes to None); dropout-free paths; dense FFNs (no MoE inside the
+pipeline).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from easydl_tpu.ops._compat import shard_map
+
+
+def pipeline_rules(base) -> tuple:
+    """Rule table for a pipelined model: stage-shard the stacked ``layers``
+    axis over ``pp``; un-shard the weight/activation feature axes (the
+    stage-local weights live whole on their stage in v1)."""
+    drop = {"embed", "mlp", "heads", "kv", "qkv", "vocab", "seq"}
+    out = []
+    for name, target in base:
+        if name == "layers":
+            out.append((name, "pp"))
+        elif name in drop:
+            out.append((name, None))
+        else:
+            out.append((name, target))
+    return tuple(out)
+
+
+def make_pipeline(mesh: Mesh, microbatches: int,
+                  remat: Optional[bool] = None) -> Callable:
+    """Build the ``pipeline_fn`` a :class:`TransformerConfig` carries
+    (mirroring the ``attention_fn`` pattern): closes over the mesh so the
+    model stays mesh-agnostic.
+
+    Returns ``fn(apply_stage, stage_params, x, block_remat=False) -> y``
+    where ``stage_params`` is the stacked ``[n_layers, ...]`` block tree
+    (sharded ``layers → pp``) and ``x`` is the embedded activation
+    ``[B, S, D]``. ``fn.stages`` carries the mesh's pp size so the model
+    can validate its ``pipeline_stages`` against it.
+
+    ``remat`` default (None) is automatic: the stage apply is wrapped in
+    ``jax.checkpoint`` only when the caller says the blocks are NOT already
+    remat-wrapped (``block_remat``) — stacking both would recompute the
+    whole stage forward twice in the backward pass.
+    """
+    pp = mesh.shape["pp"]
+    if pp < 2:
+        raise ValueError(f"pipeline needs a pp axis of ≥2 (mesh has {pp})")
+
+    def fn(apply_stage: Callable, stage_params: Any, x: jax.Array,
+           block_remat: bool = False):
+        outer_remat = remat if remat is not None else not block_remat
+        return pipeline_blocks(mesh, apply_stage, stage_params, x,
+                               microbatches=microbatches, remat=outer_remat)
+
+    fn.stages = pp
+    return fn
+
+
+def pipeline_blocks(mesh: Mesh, apply_stage: Callable, stage_params: Any,
+                    x: jax.Array, microbatches: int,
+                    remat: bool = True) -> jax.Array:
+    """Run ``apply_stage`` as a ``pp``-stage GPipe pipeline over ``x``.
+
+    ``apply_stage(local_params, h) -> h`` applies one stage's layer chunk
+    (the caller builds it from an ``nn.scan`` of length ``n_layers/pp``).
+    ``stage_params`` leaves carry the stacked layer axis first and must be
+    sharded over ``pp`` on that axis; ``x`` is batch-sharded over
+    ``(dp, fsdp)`` and replicated over ``pp``.
+    """
+    pp = mesh.shape["pp"]
+    batch_spec = P(("dp", "fsdp"))
+    param_spec = jax.tree.map(lambda _: P("pp"), stage_params)
+    stage_apply = jax.checkpoint(apply_stage) if remat else apply_stage
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(param_spec, batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    )
+    def run(p_local, x_local):
+        import flax.linen as nn
+
+        stage = jax.lax.axis_index("pp")
+        batch = x_local.shape[0]
+        if batch % microbatches:
+            raise ValueError(
+                f"per-shard batch {batch} not divisible by "
+                f"microbatches={microbatches}"
+            )
+        mb = batch // microbatches
+        xs = x_local.reshape((microbatches, mb) + x_local.shape[1:])
+        ticks = microbatches + pp - 1
+
+        def tick(carry, t):
+            buf, out = carry
+            # stage 0 ingests microbatch t (clamped past the drain phase);
+            # later stages consume what the previous tick handed them
+            mb_in = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, microbatches - 1), 0, keepdims=False
+            )
+            inp = jnp.where(stage == 0, mb_in, buf)
+            with nn.logical_axis_rules(()):
+                # inside shard_map the model's logical constraints must be
+                # no-ops (there is no GSPMD context here); empty rules make
+                # with_logical_constraint the identity
+                y = stage_apply(p_local, inp)
+            # hand the activation to the next stage (ring: the wrap-around
+            # edge feeds stage 0, which ignores it — it reads xs instead)
+            nxt = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % pp) for i in range(pp)]
+            )
+            # the last stage emits microbatch t-(pp-1) once it's real
+            oidx = t - (pp - 1)
+            valid = (stage == pp - 1) & (oidx >= 0)
+            out = jnp.where(
+                valid,
+                jax.lax.dynamic_update_index_in_dim(
+                    out, y, jnp.clip(oidx, 0, microbatches - 1), 0
+                ),
+                out,
+            )
+            return (nxt, out), None
+
+        (_, outs), _ = jax.lax.scan(
+            tick, (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
+            jnp.arange(ticks),
+        )
+        y = outs.reshape(x_local.shape)
+        # replicate the last stage's assembled output to every pp rank so
+        # the head/loss outside the shard_map see one consistent value
+        return jax.lax.psum(
+            jnp.where(stage == pp - 1, y, jnp.zeros_like(y)), "pp"
+        )
+
+    return run(stage_params, x)

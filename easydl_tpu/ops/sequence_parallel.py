@@ -30,10 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from easydl_tpu.ops._compat import shard_map
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
