@@ -50,8 +50,22 @@ def main() -> None:
          dict(fused_loss=True, loss_chunk=128, **bf16_dots), 128, 4),
         ("fused c128 no-remat b128/a8 mb16",
          dict(fused_loss=True, loss_chunk=128, dtype="bfloat16"), 128, 8),
+        # r4: PROFILE.json attributes ~16% of device time to the accum scan
+        # carry's dynamic-update-slice fusions at a32 — lax.scan unroll
+        # (TrainConfig.accum_unroll) lets XLA fuse the carry update across
+        # microbatches. UNMEASURED on TPU so far (the tunnel was down all
+        # of r4's remaining window); this is the first lever to sweep next.
+        ("plain  b256/a32 u1 (r4 bench)",
+         dict(fused_loss=False, **bf16_dots), 256, 32, 1),
+        ("plain  b256/a32 u2",
+         dict(fused_loss=False, **bf16_dots), 256, 32, 2),
+        ("plain  b256/a32 u4",
+         dict(fused_loss=False, **bf16_dots), 256, 32, 4),
+        ("plain  b256/a32 u8",
+         dict(fused_loss=False, **bf16_dots), 256, 32, 8),
     ]
-    for label, kwargs, per_chip_batch, grad_accum in configs:
+    for label, kwargs, per_chip_batch, grad_accum, *rest in configs:
+        accum_unroll = rest[0] if rest else 1
         global_batch = per_chip_batch * n_chips
         try:
             bundle = get_model("gpt", size="345m", seq_len=args.seq, **kwargs)
@@ -60,7 +74,8 @@ def main() -> None:
                 loss_fn=bundle.loss_fn,
                 optimizer=optax.adamw(2e-4, weight_decay=0.01),
                 config=TrainConfig(global_batch=global_batch,
-                                   grad_accum=grad_accum),
+                                   grad_accum=grad_accum,
+                                   accum_unroll=accum_unroll),
                 mesh_spec=MeshSpec(dp=n_chips),
             )
             state = trainer.init_state()
