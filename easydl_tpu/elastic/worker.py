@@ -121,14 +121,30 @@ def run_worker(env: Dict[str, str]) -> int:
     mesh_axes = dict(cfg.get("mesh", {}))
     mesh = build_mesh(MeshSpec.from_world(devices, **mesh_axes))
     model_kwargs = dict(cfg.get("model_kwargs", {}))
+    ps_mode = model_kwargs.get("embedding") == "ps"
+    if ps_mode and mesh.shape.get("pp", 1) > 1:
+        # a pp axis would silently waste a pp-fold share of devices on
+        # replicated dense compute (the PS trainer never pipelines)
+        raise RuntimeError("mesh pp axis is not supported with "
+                           "embedding='ps' jobs")
+    # A pp axis in the job's mesh config turns on the GPipe schedule:
+    # pipeline_fn closes over the (per-generation) mesh, so it cannot ride
+    # the serialized job config — it is reconstructed here, like the mesh
+    # itself, on every generation. (No-op on pp-less meshes.)
+    from easydl_tpu.ops.pipeline import apply_pipeline_config
+
+    model_kwargs, rules = apply_pipeline_config(
+        cfg["model"], model_kwargs, mesh,
+        microbatches=int(cfg.get("pp_microbatches", 2)),
+    )
     bundle = get_model(cfg["model"], **model_kwargs)
     global_batch = int(cfg.get("global_batch", 32))
     train_config = TrainConfig(
         global_batch=global_batch,
         grad_accum=int(cfg.get("grad_accum", 1)),
         seed=int(cfg.get("seed", 0)),
+        rules=rules,
     )
-    ps_mode = model_kwargs.get("embedding") == "ps"
     if ps_mode:
         # Config-5 deployment shape under the elastic runtime: dense model on
         # the mesh, sparse tables on the PS pods the operator launched.

@@ -39,6 +39,8 @@ def make_bert(
     remat_policy: str = "full",
     attention_impl: str = "auto",
     attention_fn=None,
+    pipeline_fn=None,
+    pipeline_stages: int = 0,
 ) -> ModelBundle:
     n_layers, d_model, n_heads = SIZES[size]
     cfg = TransformerConfig(
@@ -54,6 +56,8 @@ def make_bert(
         attention_impl=attention_impl,
         attention_fn=attention_fn,
         tied_head=True,
+        pipeline_fn=pipeline_fn,
+        pipeline_stages=pipeline_stages,
     )
     model = Transformer(cfg)
 

@@ -31,6 +31,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--ckpt-every", type=int, default=50)
     ap.add_argument("--dp", type=int, default=0, help="data-parallel size (0 = all devices)")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline stages (GPipe over the pp mesh axis; "
+                         "transformer models only)")
+    ap.add_argument("--pp-microbatches", type=int, default=2)
     ap.add_argument("--eval-polls", type=int, default=0, help="evaluator: stop after N evals (0 = forever)")
     ap.add_argument("--model-arg", action="append", default=[],
                     help="k=v forwarded to the model factory (repeatable)")
@@ -116,15 +120,28 @@ def main() -> None:
             kwargs[k] = json.loads(v)
         except json.JSONDecodeError:
             kwargs[k] = v
+
+    from easydl_tpu.core.mesh import build_mesh
+    from easydl_tpu.ops.pipeline import apply_pipeline_config
+
+    pp = max(args.pp, 1)
+    n_dev = jax.device_count()
+    if pp > 1 and (n_dev < pp or n_dev % pp):
+        # fail here with the cause, not later with an empty/truncated mesh
+        ap.error(f"--pp {pp} needs a device count divisible by it "
+                 f"(have {n_dev})")
+    dp = args.dp or (n_dev // pp)
+    mesh = build_mesh(MeshSpec(dp=dp, pp=pp))
+    kwargs, rules = apply_pipeline_config(
+        args.model, kwargs, mesh, microbatches=args.pp_microbatches)
     bundle = get_model(args.model, **kwargs)
 
-    dp = args.dp or jax.device_count()
     trainer = Trainer(
         init_fn=bundle.init_fn,
         loss_fn=bundle.loss_fn,
         optimizer=optax.adamw(args.lr),
-        config=TrainConfig(global_batch=args.batch),
-        mesh_spec=MeshSpec(dp=dp),
+        config=TrainConfig(global_batch=args.batch, rules=rules),
+        mesh=mesh,
     )
     ckpt = CheckpointManager(args.ckpt_dir) if args.ckpt_dir else None
 

@@ -56,6 +56,42 @@ def pipeline_rules(base) -> tuple:
     return tuple(out)
 
 
+#: model families whose factories accept the pipeline (they share the
+#: nn.scan transformer stack). gpt_moe is excluded: MoE inside the
+#: pipeline is a NotImplementedError in the model.
+PIPELINE_CAPABLE = ("gpt", "bert")
+
+
+def apply_pipeline_config(model: str, model_kwargs: dict, mesh: Mesh,
+                          microbatches: int = 2):
+    """Entry-point helper: when ``mesh`` has a real ``pp`` axis, extend the
+    model kwargs with the pipeline (``pipeline_fn`` closes over the mesh,
+    so it can't travel through a serialized job config — the zoo runner and
+    the elastic worker both call this after building their mesh).
+
+    No-op (returning the kwargs and the default rules unchanged) when the
+    mesh has no pp axis. A pp axis with a model family that can't pipeline
+    raises a one-line config error — the alternative is an unexplained
+    ``TypeError`` from the model factory deep in a worker crash-loop.
+
+    Returns ``(model_kwargs, rules)`` — the rule table switches to
+    :func:`pipeline_rules` so the stacked layer params stage-shard."""
+    from easydl_tpu.core.sharding import DEFAULT_RULES
+
+    pp = mesh.shape.get("pp", 1)
+    if pp < 2:
+        return model_kwargs, DEFAULT_RULES
+    if model not in PIPELINE_CAPABLE:
+        raise ValueError(
+            f"mesh has pp={pp} but model {model!r} does not support "
+            f"pipeline parallelism (capable: {', '.join(PIPELINE_CAPABLE)})"
+        )
+    out = dict(model_kwargs)
+    out.setdefault("pipeline_fn", make_pipeline(mesh, microbatches))
+    out.setdefault("pipeline_stages", pp)
+    return out, pipeline_rules(DEFAULT_RULES)
+
+
 def make_pipeline(mesh: Mesh, microbatches: int,
                   remat: Optional[bool] = None) -> Callable:
     """Build the ``pipeline_fn`` a :class:`TransformerConfig` carries

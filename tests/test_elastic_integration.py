@@ -237,3 +237,31 @@ def test_elastic_worker_with_ps_embedding(workdir):
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
+
+
+def test_elastic_worker_with_pipeline_mesh(workdir):
+    """A pp axis in the job's mesh config turns on the GPipe schedule
+    inside the elastic worker (the pipeline_fn is rebuilt per generation,
+    like the mesh): one agent, 4 devices, pp=2 x dp=2, trains to DONE."""
+    cfg = {
+        "model": "gpt",
+        "model_kwargs": {"size": "test", "seq_len": 32, "vocab": 256},
+        "mesh": {"pp": 2},
+        "pp_microbatches": 2,
+        "global_batch": 8,
+        "total_steps": 6,
+        "ckpt_interval": 3,
+        "lr": 1e-3,
+        "seed": 0,
+    }
+    master = Master(job_name="pp-job", workdir=workdir, desired_workers=1,
+                    min_workers=1, worker_config=cfg).start()
+    agent = Agent("a0", master.address, workdir, slots=4).start()
+    try:
+        assert master.wait_done(timeout=240), f"no finish: {master.status()}"
+        m0 = read_metrics(workdir, "a0")
+        assert m0 and m0[-1]["step"] == 6
+        assert all(r["loss"] == r["loss"] for r in m0)  # finite
+    finally:
+        agent.stop()
+        master.stop()

@@ -115,3 +115,21 @@ def test_pipeline_stage_mismatch_fails_loudly(eight_devices):
     with mesh, pytest.raises(ValueError, match="pp size"):
         jax.jit(lambda p: piped.loss_fn(p, batch, jax.random.PRNGKey(0)))(
             params)
+
+
+def test_apply_pipeline_config_gates(eight_devices):
+    """The entry-point helper: no-op without a pp axis; loud one-line error
+    for pipeline-incapable models; kwargs+rules for capable ones."""
+    from easydl_tpu.core.sharding import DEFAULT_RULES
+    from easydl_tpu.ops.pipeline import apply_pipeline_config
+
+    flat = build_mesh(MeshSpec(dp=8))
+    kw, rules = apply_pipeline_config("mlp", {"features": [8]}, flat)
+    assert kw == {"features": [8]} and rules == DEFAULT_RULES
+
+    pp_mesh = build_mesh(MeshSpec(dp=4, pp=2))
+    with pytest.raises(ValueError, match="does not support pipeline"):
+        apply_pipeline_config("mlp", {}, pp_mesh)
+    kw, rules = apply_pipeline_config("bert", {"size": "test"}, pp_mesh)
+    assert kw["pipeline_stages"] == 2 and callable(kw["pipeline_fn"])
+    assert dict(rules)["layers"] == "pp"
