@@ -413,6 +413,19 @@ def _warm_wait(warm_file: str) -> Dict[str, str]:
     from easydl_tpu.utils.env import pin_cpu_platform_if_requested
 
     pin_cpu_platform_if_requested()
+    # Pre-import the rest of the training stack too: the RECOVERY.json
+    # decomposition shows a multi-second "trainer build" phase after
+    # promotion that is mostly first-touch module imports (optax, the
+    # Trainer, the model registry, checkpointing) — none of which depend
+    # on the new generation's world size. No jax backend init happens
+    # here (module import alone doesn't initialise a backend).
+    try:
+        import optax  # noqa: F401
+        from easydl_tpu.core import checkpoint  # noqa: F401
+        from easydl_tpu.core import train_loop  # noqa: F401
+        from easydl_tpu.models import registry  # noqa: F401
+    except Exception:  # pragma: no cover - pre-warm is best-effort
+        pass
     # READY marker: lets the agent (and tests) see the standby is warm.
     try:
         with open(warm_file + ".ready", "w") as f:

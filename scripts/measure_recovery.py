@@ -338,6 +338,16 @@ def main() -> None:
         "scale_up_warm_cache": scale_warm_cache,
         "scale_up_warm_cache_warm_standby": scale_warm_full,
     }
+    # Merge, don't clobber: other measurement scripts (measure_longwindow)
+    # own their own top-level sections of the same file.
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+            for key, val in prior.items():
+                result.setdefault(key, val)
+        except (OSError, ValueError):
+            pass
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")
