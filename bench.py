@@ -5,12 +5,32 @@ accelerator — samples/sec/chip, the BASELINE.json headline metric. The
 reference publishes no numbers (``"published": {}``), so ``vs_baseline``
 reports against this framework's own recorded best (bench_baseline.json, if
 present) and 1.0 otherwise.
+
+Parent/child split (round-5 hardening): the attached TPU arrives over a
+tunnel that can *hang* inside the first JAX API call rather than error —
+round 4's bench died exactly there (``jax.default_backend()`` with no
+bound, BENCH_r04.json rc=1). So the default entry is a pure-stdlib
+orchestrator that never touches a JAX API in-process:
+
+1. probe the backend in a timeout-bounded subprocess, with backed-off
+   retries (~6 min worst case — easydl_tpu/utils/probe.py);
+2. run the measurement as ``bench.py --child`` under a wall-clock bound;
+3. on persistent tunnel failure, fall back to a forced-CPU smoke child
+   (same code path, tiny model) and say so in the JSON — the driver
+   artifact parses either way, and the failure cause is named instead of
+   lost.
+
+Every knob is env-overridable (EASYDL_BENCH_PROBE_ATTEMPTS,
+_PROBE_TIMEOUT_S, _PROBE_BACKOFF_S, _CHILD_TIMEOUT_S) so tests can
+simulate a hanging backend hermetically.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 # Peak dense bf16 FLOP/s per chip by device kind (public Cloud TPU specs).
@@ -42,10 +62,12 @@ def model_flops_per_token(n_params: int, n_layers: int, d_model: int,
     return 6.0 * n_params + 12.0 * n_layers * d_model * seq_len
 
 
-def main() -> None:
+def _measure() -> dict:
+    """Child-mode measurement: imports jax, runs the real train loop, and
+    returns the result record. Only ever runs in a subprocess whose wall
+    clock the parent bounds."""
     import jax
 
-    # Keep the TPU runtime quiet and deterministic for timing.
     import optax
 
     from easydl_tpu.core.mesh import MeshSpec
@@ -86,11 +108,13 @@ def main() -> None:
         grad_accum = 1
         bundle = get_model("gpt", size=size, seq_len=seq_len, vocab=512)
 
+    accum_unroll = int(os.environ.get("EASYDL_BENCH_ACCUM_UNROLL", "1"))
     trainer = Trainer(
         init_fn=bundle.init_fn,
         loss_fn=bundle.loss_fn,
         optimizer=optax.adamw(2e-4, weight_decay=0.01),
-        config=TrainConfig(global_batch=global_batch, grad_accum=grad_accum),
+        config=TrainConfig(global_batch=global_batch, grad_accum=grad_accum,
+                           accum_unroll=accum_unroll),
         mesh_spec=MeshSpec(dp=n_chips),
     )
     state = trainer.init_state()
@@ -136,23 +160,95 @@ def main() -> None:
         except (OSError, ValueError):
             pass
 
-    print(
-        json.dumps(
-            {
-                "metric": f"gpt-{size} seq{seq_len} samples/sec/chip ({platform}, {n_chips} chip)",
-                "value": round(per_chip, 3),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(vs_baseline, 3),
-                "tokens_per_sec": round(tokens_per_sec, 1),
-                "step_time_s": round(dt / steps, 4),
-                "mfu": round(mfu, 4),
-                "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
-                "peak_tflops_per_chip": round(peak / 1e12, 1),
-                "device_kind": jax.devices()[0].device_kind,
-            }
+    return {
+        "metric": f"gpt-{size} seq{seq_len} samples/sec/chip ({platform}, {n_chips} chip)",
+        "value": round(per_chip, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "step_time_s": round(dt / steps, 4),
+        "mfu": round(mfu, 4),
+        "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
+        "peak_tflops_per_chip": round(peak / 1e12, 1),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+def _run_child(env: dict, timeout_s: float):
+    """Run ``bench.py --child`` bounded by ``timeout_s``.
+
+    Returns ``(record_or_None, failure_reason_or_None)``.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
         )
-    )
+    except subprocess.TimeoutExpired:
+        return None, f"bench child hit the {timeout_s:.0f}s wall-clock bound"
+    if proc.returncode != 0:
+        tail = proc.stderr.strip().splitlines()[-3:]
+        return None, (f"bench child rc={proc.returncode}: "
+                      + " | ".join(tail)[-400:])
+    from easydl_tpu.utils.probe import last_json_line
+
+    record = last_json_line(proc.stdout, "value")
+    if record is None:
+        return None, "bench child produced no JSON result line"
+    return record, None
+
+
+def main() -> None:
+    # Pure stdlib + probe helpers; no JAX API call ever happens in this
+    # process (sitecustomize may have *imported* jax — harmless; backends
+    # initialise lazily, and only subprocesses trigger that).
+    from easydl_tpu.utils.env import cpu_subprocess_env
+    from easydl_tpu.utils.probe import (env_float, env_int,
+                                        probe_backend_with_retry)
+
+    attempts = env_int("EASYDL_BENCH_PROBE_ATTEMPTS", 4)
+    probe_timeout = env_float("EASYDL_BENCH_PROBE_TIMEOUT_S", 45.0)
+    backoff = env_float("EASYDL_BENCH_PROBE_BACKOFF_S", 60.0)
+    child_timeout = env_float("EASYDL_BENCH_CHILD_TIMEOUT_S", 1800.0)
+
+    notes = []
+    info, history = probe_backend_with_retry(
+        attempts=attempts, timeout_s=probe_timeout, backoff_s=backoff)
+    if info is not None:
+        record, why = _run_child(dict(os.environ), child_timeout)
+        if record is not None:
+            print(json.dumps(record))
+            return
+        notes.append(why)
+    else:
+        notes.append("backend unreachable: " + "; ".join(history))
+
+    # Forced-CPU smoke fallback: same measurement path, tunnel neutralised.
+    env = cpu_subprocess_env(1)
+    record, why = _run_child(env, env_float("EASYDL_BENCH_CPU_TIMEOUT_S",
+                                            900.0))
+    if record is not None:
+        record["note"] = "; ".join(notes) + "; CPU smoke fallback"
+        print(json.dumps(record))
+        return
+    notes.append(why)
+
+    # Last resort: still one parseable JSON line, with the cause named.
+    print(json.dumps({
+        "metric": "gpt-345m seq1024 samples/sec/chip (backend unreachable)",
+        "value": 0.0,
+        "unit": "samples/sec/chip",
+        "vs_baseline": 0.0,
+        "error": "; ".join(n for n in notes if n),
+    }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        print(json.dumps(_measure()))
+    else:
+        main()

@@ -1,0 +1,131 @@
+"""Bounded, out-of-process JAX backend probing.
+
+The attached TPU arrives via a tunnel that has two distinct failure modes:
+it can *error* ("Unable to initialize backend") or it can *hang* — accept
+the connection and never return from ``jax.devices()``. An in-process
+probe that catches only ``RuntimeError`` survives the first mode and is
+killed by the driver's outer timeout on the second, losing the round's
+evidence artifacts with it (the round-4 failure: both ``BENCH_r04.json``
+and ``MULTICHIP_r04.json`` red for exactly this reason).
+
+The rule these helpers enforce: **evidence entrypoints never initialise
+JAX in their own process.** The backend is probed in a subprocess bounded
+by a wall-clock timeout; a hang becomes a kill + a structured "unreachable"
+answer instead of a lost artifact. (Reference anchor: the capability the
+design premises everything on is monitoring a training job,
+/root/reference/README.md:21-23 — the measurement pipeline must survive
+its own environment.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+# One python -c line: prints a single JSON object describing the default
+# backend. Runs under the ambient environment, so on the build image it
+# attaches to whatever the sitecustomize pins (the TPU tunnel) — which is
+# the point: the *subprocess* takes the hang risk, not the caller.
+_PROBE_SNIPPET = (
+    "import json, jax; d = jax.devices(); "
+    "print(json.dumps({'platform': jax.default_backend(), "
+    "'n_devices': jax.device_count(), "
+    "'device_kind': d[0].device_kind}))"
+)
+
+
+def last_json_line(stdout: str, required_key: str) -> Optional[Dict[str, object]]:
+    """Last JSON-object line of a child's stdout carrying ``required_key``,
+    or None. The one scan both the probe and the bench orchestrator use to
+    pick a child's result out of whatever logging surrounds it."""
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                record = json.loads(line)
+            except ValueError:
+                return None
+            if isinstance(record, dict) and required_key in record:
+                return record
+    return None
+
+
+def probe_backend(
+    timeout_s: float = 45.0,
+    env: Optional[Dict[str, str]] = None,
+    python: Optional[str] = None,
+) -> Optional[Dict[str, object]]:
+    """Probe the default JAX backend in a subprocess, bounded by wall clock.
+
+    Returns ``{"platform", "n_devices", "device_kind"}`` on success, else
+    ``None`` (timeout, crash, or unparseable output). Never imports jax in
+    the calling process.
+    """
+    try:
+        proc = subprocess.run(
+            [python or sys.executable, "-c", _PROBE_SNIPPET],
+            env=dict(env) if env is not None else None,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    except OSError:
+        return None
+    if proc.returncode != 0:
+        return None
+    return last_json_line(proc.stdout, "platform")
+
+
+def probe_backend_with_retry(
+    attempts: int = 4,
+    timeout_s: float = 45.0,
+    backoff_s: float = 60.0,
+    env: Optional[Dict[str, str]] = None,
+    python: Optional[str] = None,
+) -> Tuple[Optional[Dict[str, object]], List[str]]:
+    """Retry :func:`probe_backend` with a fixed backoff between attempts.
+
+    Defaults bound the whole thing at ~4×45s + 3×60s ≈ 6 minutes — long
+    enough to ride out a transient tunnel blip, short enough that the
+    driver's artifact timeout is never the thing that fires. Returns
+    ``(info_or_None, history)`` where history is one human-readable line
+    per attempt, for the structured failure JSON.
+    """
+    history: List[str] = []
+    info = None
+    for attempt in range(max(1, attempts)):
+        t0 = time.monotonic()
+        info = probe_backend(timeout_s=timeout_s, env=env, python=python)
+        dt = time.monotonic() - t0
+        if info is not None:
+            history.append(
+                f"attempt {attempt + 1}: ok in {dt:.1f}s "
+                f"({info.get('platform')}, {info.get('n_devices')} dev)"
+            )
+            return info, history
+        history.append(f"attempt {attempt + 1}: unreachable after {dt:.1f}s")
+        if attempt + 1 < attempts:
+            time.sleep(backoff_s)
+    return None, history
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env-var override with a default (shared by the evidence
+    entrypoints' tunable probe/timeout knobs)."""
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default

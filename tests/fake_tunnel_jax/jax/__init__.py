@@ -1,0 +1,37 @@
+"""A stand-in ``jax`` that simulates the TPU tunnel's HANG failure mode.
+
+The attached-TPU tunnel on the build image has two observed failure modes:
+erroring ("Unable to initialize backend") and *hanging* — a process that
+imports jax (or makes its first backend call) simply never returns. The
+second mode is the one that killed round 4's driver artifacts, and it
+cannot be simulated by raising an exception — it has to actually block.
+
+Placed first on a subprocess's PYTHONPATH, this package:
+
+- **blocks forever on import** when the process is NOT pinned to CPU —
+  exactly what a half-dead tunnel does to any process that attaches; and
+- **transparently defers to the real jax** when ``JAX_PLATFORMS=cpu``,
+  using the documented replace-self-in-``sys.modules`` idiom — so
+  forced-CPU children (the path the evidence entrypoints must take)
+  work normally.
+
+Used by tests/test_driver_entrypoints.py to prove that ``bench.py`` and
+``__graft_entry__.dryrun_multichip`` produce their artifacts even when the
+ambient backend hangs.
+"""
+
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    _pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path = [p for p in sys.path if os.path.abspath(p) != _pkg_root]
+    del sys.modules["jax"]
+    import jax as _real_jax  # resolves to the real package now
+
+    sys.modules["jax"] = _real_jax
+else:
+    import time
+
+    while True:  # the tunnel's hang mode: block, don't raise
+        time.sleep(3600)
