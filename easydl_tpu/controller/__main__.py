@@ -167,6 +167,11 @@ def main() -> None:
         if cr_source is not None:
             cr_source.stop()
         ctl.stop()
+        # Drain the async status-sink queue before exiting: the final
+        # /status PATCH (often the terminal-phase latch) must not die with
+        # the daemon dispatch thread.
+        store.flush_status()
+        store.close()
 
 
 if __name__ == "__main__":

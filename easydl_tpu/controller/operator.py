@@ -64,6 +64,16 @@ class CrStore:
         self._status_sinks: List[Callable[[str, dict], None]] = []
         self._lock = threading.Lock()
         self._events: "queue.Queue[tuple]" = queue.Queue()
+        # Status sinks (the k8s /status PATCH) run on a dedicated dispatch
+        # thread, NOT inline in set_status: set_status is called from the
+        # reconcile loop, and a slow API server must stall the write-back,
+        # never reconciliation itself. Pending writes coalesce per job —
+        # only the latest status document is worth PATCHing.
+        self._sink_cond = threading.Condition(self._lock)
+        self._sink_pending: Dict[str, dict] = {}
+        self._sink_inflight = 0
+        self._sink_thread: Optional[threading.Thread] = None
+        self._closed = False
 
     def submit_job(self, job: JobSpec) -> None:
         job.validate()
@@ -79,6 +89,10 @@ class CrStore:
             self._plans.pop(name, None)
             self._statuses.pop(name, None)
             self._status_dirty.discard(name)
+            self._sink_pending.pop(name, None)
+            # Wake flush_status waiters: the pending set may just have
+            # drained to empty.
+            self._sink_cond.notify_all()
         self._events.put(("job_deleted", name))
 
     def apply_plan(self, plan: ResourcePlan) -> None:
@@ -102,8 +116,11 @@ class CrStore:
         phase (or flip it to the other terminal one) — only refresh details
         under the same phase (e.g. role counts after completion GC). Returns
         True when the stored status changed; registered sinks (the k8s
-        status write-back) fire on change, and a sink failure marks the
-        status dirty so the next identical write retries the sink."""
+        status write-back) fire on change — asynchronously, on the sink
+        dispatch thread, so a slow API server can't stall the reconcile
+        loop — and a sink failure marks the status dirty so the next
+        identical write retries the sink (the operator's periodic resync
+        re-issues statuses, so retry happens within one resync period)."""
         if not status:
             return False
         with self._lock:
@@ -116,18 +133,59 @@ class CrStore:
                 return False
             self._statuses[job_name] = dict(status)
             self._status_dirty.discard(job_name)
-            sinks = list(self._status_sinks)
-        ok = True
-        for fn in sinks:
-            try:
-                fn(job_name, dict(status))
-            except Exception:
-                ok = False
-                log.exception("status sink failed for %s", job_name)
-        if not ok:
-            with self._lock:
-                self._status_dirty.add(job_name)
+            if self._status_sinks:
+                self._sink_pending[job_name] = dict(status)
+                self._sink_cond.notify_all()
         return changed
+
+    def _sink_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._sink_pending and not self._closed:
+                    self._sink_cond.wait()
+                if self._closed and not self._sink_pending:
+                    return
+                job_name = next(iter(self._sink_pending))
+                status = self._sink_pending.pop(job_name)
+                sinks = list(self._status_sinks)
+                self._sink_inflight += 1
+            ok = True
+            for fn in sinks:
+                try:
+                    fn(job_name, dict(status))
+                except Exception:
+                    ok = False
+                    log.exception("status sink failed for %s", job_name)
+            with self._lock:
+                # Re-mark dirty only while the job still exists: a sink
+                # failing against a just-deleted job (404 on the deleted CR)
+                # must not leak a permanent dirty entry.
+                if not ok and job_name in self._statuses:
+                    self._status_dirty.add(job_name)
+                self._sink_inflight -= 1
+                self._sink_cond.notify_all()
+
+    def flush_status(self, timeout: float = 10.0) -> bool:
+        """Block until every pending status write has been dispatched (or
+        ``timeout`` elapses). Returns True when drained — tests and orderly
+        shutdown use this; the reconcile loop never needs to."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._sink_pending or self._sink_inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._sink_cond.wait(timeout=remaining)
+        return True
+
+    def close(self) -> None:
+        """Stop the sink dispatcher after draining pending writes."""
+        with self._lock:
+            self._closed = True
+            self._sink_cond.notify_all()
+        t = self._sink_thread
+        if t is not None:
+            t.join(timeout=10.0)
 
     def job_status(self, job_name: str) -> Optional[dict]:
         with self._lock:
@@ -136,8 +194,16 @@ class CrStore:
 
     def add_status_sink(self, fn: Callable[[str, dict], None]) -> None:
         """fn(job_name, status) is called on every status change — the k8s
-        deployment hooks the API-server write-back here."""
-        self._status_sinks.append(fn)
+        deployment hooks the API-server write-back here. Calls happen on
+        the sink dispatch thread (started lazily on the first sink), never
+        inline in set_status."""
+        with self._lock:
+            self._status_sinks.append(fn)
+            if self._sink_thread is None:
+                self._sink_thread = threading.Thread(
+                    target=self._sink_loop, daemon=True, name="status-sinks"
+                )
+                self._sink_thread.start()
 
     def job(self, name: str) -> Optional[JobSpec]:
         with self._lock:

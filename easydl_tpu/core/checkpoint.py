@@ -188,8 +188,10 @@ class CheckpointManager:
         self.storage = storage if storage is not None else get_storage(directory)
         #: host-local tmpfs cache (core/chunk_cache.py): same-host restores
         #: read back this host's own chunk writes from memory instead of
-        #: shared storage — the generation-switch restore fast path
-        self.cache = ChunkCache.for_directory(directory)
+        #: shared storage — the generation-switch restore fast path. Cache
+        #: retention tracks checkpoint retention: every restorable step
+        #: should be cache-servable, not just the newest two.
+        self.cache = ChunkCache.for_directory(directory, keep=keep)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         # Multi-process async saves split in two: chunk IO runs on a

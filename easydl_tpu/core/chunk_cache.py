@@ -50,13 +50,17 @@ class ChunkCache:
         self._broken = False
 
     @classmethod
-    def for_directory(cls, directory: str) -> Optional["ChunkCache"]:
+    def for_directory(cls, directory: str,
+                      keep: int = 2) -> Optional["ChunkCache"]:
         """Default cache for a checkpoint directory, or None.
 
         ``EASYDL_CHUNK_CACHE`` = ``0``/``off`` disables, a path overrides
         the root; default root is ``/dev/shm`` (RAM-backed on Linux) when
         writable, else no cache. The root is scoped by a hash of the
-        checkpoint URL so concurrent jobs/tests GC independently."""
+        checkpoint URL so concurrent jobs/tests GC independently. ``keep``
+        should match the CheckpointManager's retention — a cache that keeps
+        fewer tokens than the manager keeps checkpoints silently defeats
+        the fast path for the older restorable steps."""
         env = os.environ.get("EASYDL_CHUNK_CACHE", "")
         if env.lower() in _DISABLED:
             return None
@@ -64,7 +68,7 @@ class ChunkCache:
         if not env and not os.access("/dev/shm", os.W_OK):
             return None
         scope = hashlib.sha1(directory.encode()).hexdigest()[:16]
-        return cls(os.path.join(base, scope))
+        return cls(os.path.join(base, scope), keep=keep)
 
     # ------------------------------------------------------------------ write
     def put(self, token: str, rel: str, arr: np.ndarray) -> None:
@@ -102,10 +106,24 @@ class ChunkCache:
             return []
 
     # --------------------------------------------------------------------- gc
+    @staticmethod
+    def _token_step(token: str) -> int:
+        """Leading step number of a save token (``{step:08d}-{uuid}``), or
+        -1 for anything unparseable (sorts first → GC'd first)."""
+        head = token.split("-", 1)[0]
+        return int(head) if head.isdigit() else -1
+
     def gc(self) -> None:
-        """Keep the newest ``keep`` token dirs (token names sort by step)."""
+        """Keep the ``keep`` token dirs with the highest step numbers.
+
+        Sorted NUMERICALLY by the token's leading step, never
+        lexicographically: the zero-padding makes the two agree today, but
+        a lexicographic sort would silently evict the newest save the day
+        a token format changes (or a run passes 10^8 steps) — the newest
+        cache entry is exactly the one the next restore needs."""
         try:
-            tokens = sorted(os.listdir(self.root))
+            tokens = sorted(os.listdir(self.root),
+                            key=lambda t: (self._token_step(t), t))
         except OSError:
             return
         for stale in tokens[: -self.keep] if self.keep > 0 else []:

@@ -248,7 +248,15 @@ class GcsStorage(CheckpointStorage):
                 req.add_header(k, v)
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    return resp.read(), dict(resp.headers)
+                    # GCS may legally send crc32c and md5 as TWO separate
+                    # x-goog-hash headers; dict(resp.headers) would keep only
+                    # the last one and silently drop the md5 (verification
+                    # then skips). Join duplicates comma-separated — the
+                    # format _remote_md5 already parses.
+                    hdrs: dict = {}
+                    for k in resp.headers.keys():
+                        hdrs[k] = ", ".join(resp.headers.get_all(k) or [])
+                    return resp.read(), hdrs
             except urllib.error.HTTPError as e:
                 if e.code not in self._RETRY_STATUSES or attempt == self._RETRIES:
                     raise

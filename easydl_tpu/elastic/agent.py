@@ -173,12 +173,7 @@ class Agent:
                 break
             time.sleep(self.heartbeat_interval)
             metrics = self._read_metrics()
-            if (self._warm_due
-                    and int(metrics.get("generation", -1))
-                    == self._applied_key[0]):
-                # the promoted worker is past restore+compile (it recorded a
-                # step this generation): NOW pre-warm the next standby,
-                # off the critical window
+            if self._warm_rearm_ready(metrics):
                 self._warm_due = False
                 self._spawn_warm()
             try:
@@ -300,6 +295,22 @@ class Agent:
             env.setdefault("OPENBLAS_NUM_THREADS", "1")
         env["EASYDL_TIMELINE"] = self.timeline_path
         return env
+
+    def _warm_rearm_ready(self, metrics: dict) -> bool:
+        """Should the deferred standby re-arm fire now?
+
+        Normal path: the promoted worker is past restore+compile (it
+        recorded a step in the applied generation) — pre-warm the next
+        standby off the critical window. Fallback path: the worker left
+        "running" (crashed or exited) BEFORE its first step — waiting for
+        a step that will never come would leave every subsequent promotion
+        fully cold, exactly the unhealthy-job case where recovery latency
+        matters most, so re-arm on worker exit too."""
+        if not self._warm_due:
+            return False
+        if int(metrics.get("generation", -1)) == self._applied_key[0]:
+            return True
+        return self._state != "running"
 
     def _spawn_warm(self) -> None:
         """Start the next standby: jax imports now, membership comes later."""

@@ -278,6 +278,18 @@ class Transformer(nn.Module):
         else:
             if cfg.moe_experts:
                 raise NotImplementedError("MoE inside the pipeline")
+            if cfg.dropout and not deterministic:
+                # The stage apply below passes no rngs, so a non-
+                # deterministic dropout>0 apply would otherwise die with an
+                # opaque flax missing-'dropout'-rng error deep inside
+                # shard_map tracing. v1 pipeline scope is dropout-free at
+                # train time — say so. (Deterministic applies — eval,
+                # embedding extraction — need no rng and stay allowed.)
+                raise NotImplementedError(
+                    f"dropout={cfg.dropout} with pipeline_fn: the pipeline "
+                    "path applies stages without rngs (v1 trains "
+                    "dropout-free; deterministic applies are fine)"
+                )
             if cfg.n_layers % cfg.pipeline_stages:
                 raise ValueError(
                     f"n_layers={cfg.n_layers} not divisible by "

@@ -49,7 +49,11 @@ class FakeGcsServer:
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 for k, v in (extra or {}).items():
-                    self.send_header(k, v)
+                    # A list value emits one header line per element — GCS
+                    # may legally send crc32c and md5 as TWO separate
+                    # x-goog-hash headers, and the client must not drop one.
+                    for item in (v if isinstance(v, list) else [v]):
+                        self.send_header(k, item)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -103,9 +107,15 @@ class FakeGcsServer:
                             store.corrupt_next_read.discard(key)
                             data = bytes([data[0] ^ 0xFF]) + data[1:] \
                                 if data else b"\x00"
+                    # Two separate x-goog-hash headers (legal per GCS docs),
+                    # md5 FIRST so that a client collapsing duplicates via
+                    # dict(resp.headers) (last wins) would drop the md5 and
+                    # silently skip verification — making the corrupt-read
+                    # test fail loudly on that regression.
                     self._send(200, data, "application/octet-stream",
                                extra={"x-goog-hash":
-                                      f"crc32c=AAAAAA==,md5={true_hash}"})
+                                      [f"md5={true_hash}",
+                                       "crc32c=AAAAAA=="]})
                 else:
                     self._send(200, json.dumps(
                         {"name": key, "size": str(len(data)),

@@ -263,3 +263,28 @@ def test_chunk_cache_gc_keeps_newest_tokens(tmp_path, monkeypatch):
     cache.gc()
     left = sorted(os.listdir(tmp_path / "c"))
     assert left == ["00000002-aaaabbbbcccc", "00000003-aaaabbbbcccc"]
+
+
+def test_chunk_cache_gc_orders_by_step_not_lexicographically(tmp_path):
+    """Double-digit steps + an unpadded token: GC must sort by the numeric
+    step (a lexicographic sort would rank '10' < '9' and evict the newest
+    save — exactly the cache entry the next restore needs)."""
+    from easydl_tpu.core.chunk_cache import ChunkCache
+
+    cache = ChunkCache(str(tmp_path / "c"), keep=2)
+    for token in ("00000002-aa", "00000009-aa", "00000010-aa", "00000011-aa",
+                  "8-unpadded-aa", "junktoken"):
+        cache.put(token, "leaf_00000/scalar.npy", np.asarray(1))
+    cache.gc()
+    left = sorted(os.listdir(tmp_path / "c"))
+    assert left == ["00000010-aa", "00000011-aa"]
+
+
+def test_chunk_cache_keep_tracks_manager_keep(tmp_path, monkeypatch):
+    """Cache retention follows CheckpointManager retention: with keep=3
+    checkpoints, the oldest restorable step must still be cache-servable
+    (a keep=2 cache silently defeated the fast path for it)."""
+    monkeypatch.setenv("EASYDL_CHUNK_CACHE", str(tmp_path / "cache"))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=3, async_save=False)
+    assert mgr.cache is not None
+    assert mgr.cache.keep == 3

@@ -243,3 +243,26 @@ def test_join_rank_processes_fail_fast_and_drain():
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     (rc, out, err), = join_rank_processes([big], timeout=30)
     assert rc == 0 and len(out) == 300000
+
+
+def test_warm_rearm_fallback_on_worker_exit(tmp_path):
+    """Advisor r4 low #3: the deferred standby re-arm must not wait forever
+    for a first step that never comes. Normal path re-arms on the first
+    recorded step of the applied generation; fallback re-arms when the
+    worker leaves "running" (crash/exit) before that — otherwise every
+    subsequent promotion of a crash-looping job is fully cold."""
+    from easydl_tpu.elastic.agent import Agent
+
+    a = Agent("a0", "127.0.0.1:1", str(tmp_path), warm_start=True)
+    a._applied_key = (3, "c")
+    a._state = "running"
+    a._warm_due = False
+    assert not a._warm_rearm_ready({"generation": 3})  # not due -> never
+    a._warm_due = True
+    # worker running, step still from the OLD generation -> keep waiting
+    assert not a._warm_rearm_ready({"generation": 2})
+    # normal path: a step recorded in the applied generation
+    assert a._warm_rearm_ready({"generation": 3})
+    # fallback: the worker exited before its first step
+    a._state = "failed"
+    assert a._warm_rearm_ready({"generation": 2})

@@ -278,6 +278,7 @@ def test_status_writeback_and_relearn(srv):
     status = {"phase": "Succeeded", "roles": {"worker": {"succeeded": 2}},
               "completionTime": "2026-07-30T00:00:00Z"}
     assert store.set_status("j1", status)
+    assert store.flush_status()  # sinks run on the dispatch thread
     # landed on the API server
     doc = srv.crs[JOB_PLURAL]["j1"]
     assert doc["status"]["phase"] == "Succeeded"
@@ -312,7 +313,9 @@ def test_status_writeback_retries_after_sink_failure(srv):
     ))
     status = {"phase": "Running", "roles": {}}
     store2.set_status("j1", status)  # sink fails internally (logged)
+    assert store2.flush_status()  # failure lands async → dirty mark
     # repair: swap in the live sink; identical write must re-fire it
     store2._status_sinks[:] = [make_status_writer(srv_client)]
     store2.set_status("j1", dict(status))
+    assert store2.flush_status()
     assert srv.crs[JOB_PLURAL]["j1"]["status"]["phase"] == "Running"
