@@ -1,0 +1,293 @@
+// Brain decision core: startup sizing + the damped autoscale step.
+//
+// The native service core the reference anticipated for Brain (SURVEY.md
+// §2.1 item 2 — the Go Brain implied by .pre-commit-config.yaml:42-49,
+// rebuilt here in C++ per the environment's native-equivalence rule).
+// Pure decision functions over a line-oriented wire format; no threads, no
+// IO, no globals — the service layer (brain/service.py) owns state and
+// clocks, exactly as the operator's reconciler core owns no pod state.
+//
+// Parity contract: easydl_tpu/brain/policy.py holds the Python twin of
+// both functions; tests/test_brain.py pins the two together on randomized
+// states. Any semantic change must land in both.
+//
+// Wire formats (all lines '|'-separated, '\n'-terminated):
+//
+// edb_startup(features) -> plan line
+//   in : F|family|model_params|uses_ps|uses_evaluator|acc_type|acc_chips
+//        (family pre-lowercased by the caller; '|'/newline sanitized)
+//   out: P|workers|chips|ps|evaluator|tpu_type
+//
+// edb_decide(state) -> decision line
+//   in : C|min_w|max_w|min_samples|cooldown_s|scaleup_floor|marginal_floor
+//            |scaledown_ratio|growth
+//        T|now|last_decision_t|current_workers
+//        B|best_per_chip
+//        X|size                  (repeated; remembered-bad sizes)
+//        K|from|to               (optional; pending marginal audit)
+//        S|size|v1,v2,...        (repeated; per-size sample windows)
+//   out: D|target|decided|bad_size|clear_pending|pend_from|pend_to
+//        (decided/clear_pending 0|1; bad_size/pend_* -1 when unset)
+//
+// Doubles cross the wire as shortest-round-trip decimal (Python repr);
+// strtod parses them back to the identical double, so averages and
+// threshold comparisons are bit-identical with the Python twin.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <map>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::vector<std::string> split(const std::string& line, char sep) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (char c : line) {
+    if (c == sep) {
+      out.push_back(cur);
+      cur.clear();
+    } else {
+      cur.push_back(c);
+    }
+  }
+  out.push_back(cur);
+  return out;
+}
+
+double to_f(const std::string& s) { return std::strtod(s.c_str(), nullptr); }
+
+int64_t to_i(const std::string& s) {
+  return std::strtoll(s.c_str(), nullptr, 10);
+}
+
+char* dup_result(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out != nullptr) std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+// ------------------------------------------------------------- startup plan
+
+struct FamilyDefault {
+  const char* family;
+  int workers, chips, ps;
+};
+
+// Mirrors policy.py _FAMILY_DEFAULTS (sized for the five BASELINE configs).
+constexpr FamilyDefault kFamilies[] = {
+    {"mlp", 2, 0, 1},    {"resnet", 8, 1, 0},  {"bert", 8, 1, 0},
+    {"gpt", 8, 1, 0},    {"deepfm", 4, 1, 2},  {"widedeep", 4, 1, 2},
+};
+
+// Mirrors policy.py _PARAMS_TO_MIN_WORKERS (first match wins).
+constexpr struct { int64_t threshold; int min_workers; } kParamTiers[] = {
+    {5000000000LL, 32}, {1000000000LL, 16}, {200000000LL, 8},
+};
+
+std::string startup(const std::string& text) {
+  // Single F-line expected; anything else yields an empty result (the
+  // caller treats that as "core unavailable" and uses the twin).
+  for (const auto& line : split(text, '\n')) {
+    auto f = split(line, '|');
+    if (f.empty() || f[0] != "F" || f.size() < 7) continue;
+    const std::string& family = f[1];
+    int64_t params = to_i(f[2]);
+    bool uses_ps = f[3] == "1";
+    bool uses_eval = f[4] == "1";
+    std::string tpu_type = f[5].empty() ? "v5e" : f[5];
+    int acc_chips = static_cast<int>(to_i(f[6]));
+
+    int workers = 2, chips = 1, ps = 0;  // policy.py _DEFAULT
+    for (const auto& fam : kFamilies) {
+      if (family == fam.family) {
+        workers = fam.workers;
+        chips = fam.chips;
+        ps = fam.ps;
+        break;
+      }
+    }
+    if (uses_ps && ps == 0) ps = 1;
+    if (!uses_ps) ps = 0;
+    for (const auto& tier : kParamTiers) {
+      if (params >= tier.threshold) {
+        workers = std::max(workers, tier.min_workers);
+        break;
+      }
+    }
+    if (acc_chips > 0) chips = std::max(chips, acc_chips);
+
+    std::ostringstream out;
+    out << "P|" << workers << "|" << chips << "|" << ps << "|"
+        << (uses_eval ? 1 : 0) << "|" << tpu_type << "\n";
+    return out.str();
+  }
+  return "";
+}
+
+// ---------------------------------------------------------- autoscale step
+
+struct DecideState {
+  int min_workers = 1, max_workers = 32, min_samples = 5, growth = 2;
+  double cooldown_s = 30.0, scaleup_floor = 0.80, marginal_floor = 0.60,
+         scaledown_ratio = 0.35;
+  double now = 0.0, last_t = -1e18, best_per_chip = 0.0;
+  int current = 1;
+  std::set<int> bad_sizes;
+  bool has_pending = false;
+  int pend_from = -1, pend_to = -1;
+  std::map<int, std::vector<double>> per_size;
+};
+
+double throughput(const std::vector<double>& samples) {
+  // Left-fold from 0.0 in window order: bit-identical to Python's
+  // sum(deque)/len(deque).
+  double acc = 0.0;
+  for (double v : samples) acc += v;
+  return samples.empty() ? 0.0 : acc / static_cast<double>(samples.size());
+}
+
+// policy.py Autoscaler._efficiency: NaN encodes None.
+double efficiency(const DecideState& st, int size) {
+  const double kNone = std::numeric_limits<double>::quiet_NaN();
+  auto it = st.per_size.find(size);
+  if (it == st.per_size.end() ||
+      static_cast<int>(it->second.size()) < st.min_samples)
+    return kNone;
+  double best_pc = 0.0;
+  bool any = false;
+  for (const auto& kv : st.per_size) {
+    if (kv.first >= size ||
+        static_cast<int>(kv.second.size()) < st.min_samples)
+      continue;
+    double pc = throughput(kv.second) / static_cast<double>(kv.first);
+    if (!any || pc > best_pc) best_pc = pc;
+    any = true;
+  }
+  if (!any || best_pc <= 0.0) return kNone;
+  return throughput(it->second) /
+         (static_cast<double>(size) * best_pc);
+}
+
+std::string decide(const std::string& text) {
+  DecideState st;
+  for (const auto& line : split(text, '\n')) {
+    auto f = split(line, '|');
+    if (f.empty() || f[0].empty()) continue;
+    if (f[0] == "C" && f.size() >= 9) {
+      st.min_workers = static_cast<int>(to_i(f[1]));
+      st.max_workers = static_cast<int>(to_i(f[2]));
+      st.min_samples = static_cast<int>(to_i(f[3]));
+      st.cooldown_s = to_f(f[4]);
+      st.scaleup_floor = to_f(f[5]);
+      st.marginal_floor = to_f(f[6]);
+      st.scaledown_ratio = to_f(f[7]);
+      st.growth = static_cast<int>(to_i(f[8]));
+    } else if (f[0] == "T" && f.size() >= 4) {
+      st.now = to_f(f[1]);
+      st.last_t = to_f(f[2]);
+      st.current = std::max(static_cast<int>(to_i(f[3])), 1);
+    } else if (f[0] == "B" && f.size() >= 2) {
+      st.best_per_chip = to_f(f[1]);
+    } else if (f[0] == "X" && f.size() >= 2) {
+      st.bad_sizes.insert(static_cast<int>(to_i(f[1])));
+    } else if (f[0] == "K" && f.size() >= 3) {
+      st.has_pending = true;
+      st.pend_from = static_cast<int>(to_i(f[1]));
+      st.pend_to = static_cast<int>(to_i(f[2]));
+    } else if (f[0] == "S" && f.size() >= 3) {
+      std::vector<double> vals;
+      for (const auto& v : split(f[2], ','))
+        if (!v.empty()) vals.push_back(to_f(v));
+      st.per_size[static_cast<int>(to_i(f[1]))] = std::move(vals);
+    }
+  }
+
+  int target = st.current, bad = -1, new_pf = -1, new_pt = -1;
+  bool decided = false, clear_pending = false;
+  const int cur = st.current;
+
+  std::ostringstream out;
+  auto emit = [&]() {
+    out << "D|" << target << "|" << (decided ? 1 : 0) << "|" << bad << "|"
+        << (clear_pending ? 1 : 0) << "|" << new_pf << "|" << new_pt << "\n";
+    return out.str();
+  };
+
+  auto cur_it = st.per_size.find(cur);
+  if (cur_it == st.per_size.end() ||
+      static_cast<int>(cur_it->second.size()) < st.min_samples)
+    return emit();
+  if (st.now - st.last_t < st.cooldown_s) return emit();
+
+  // 1. Marginal-efficiency audit of the last scale-up.
+  if (st.has_pending && st.pend_to == cur) {
+    double eff = efficiency(st, cur);
+    if (!std::isnan(eff)) {
+      clear_pending = true;
+      if (eff < st.marginal_floor) {
+        bad = st.pend_to;
+        decided = true;
+        target = st.pend_from;
+        return emit();
+      }
+    }
+  }
+
+  // 2. Scale down if far off the best per-chip rate ever seen.
+  double per_chip = throughput(cur_it->second) / static_cast<double>(cur);
+  if (cur > st.min_workers && st.best_per_chip > 0.0 &&
+      per_chip < st.scaledown_ratio * st.best_per_chip) {
+    int down = std::max(st.min_workers, cur / st.growth);
+    if (down != cur) {
+      decided = true;
+      target = down;
+      return emit();
+    }
+  }
+
+  // 3. Scale up while efficient.
+  int up = std::min(cur * st.growth, st.max_workers);
+  if (up > cur && st.bad_sizes.find(up) == st.bad_sizes.end()) {
+    double eff = efficiency(st, cur);
+    if (std::isnan(eff)) {
+      bool smaller = false;
+      for (const auto& kv : st.per_size)
+        if (kv.first < cur) smaller = true;
+      if (!smaller && per_chip >= st.scaleup_floor * st.best_per_chip)
+        eff = 1.0;
+    }
+    if (!std::isnan(eff) && eff >= st.scaleup_floor) {
+      decided = true;
+      new_pf = cur;
+      new_pt = up;
+      target = up;
+      return emit();
+    }
+  }
+  return emit();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returned buffers are malloc'd; free with edb_free.
+char* edb_startup(const char* features) {
+  return dup_result(startup(features ? features : ""));
+}
+
+char* edb_decide(const char* state) {
+  return dup_result(decide(state ? state : ""));
+}
+
+void edb_free(char* ptr) { std::free(ptr); }
+
+}  // extern "C"

@@ -1,0 +1,85 @@
+// Sanitizer stress driver for the Brain decision core (brain_core.cc).
+//
+// The core is stateless by design — the service layer owns all state — so
+// the property under test is exactly that: N threads hammering edb_startup
+// and edb_decide with randomized, adversarial wire inputs must produce no
+// data races (TSan), no leaks/overflows (ASan), and no UB (UBSan). Built
+// and run by scripts/sanitize_native.sh next to the other cores'
+// stress drivers (SURVEY.md §5.2).
+
+#include "brain_core.cc"  // NOLINT(build/include)
+
+#include <cassert>
+#include <cstdio>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+std::string random_features(std::mt19937* rng) {
+  static const char* kFam[] = {"mlp", "gpt", "deepfm", "", "junk",
+                               "widedeep"};
+  std::uniform_int_distribution<int> fam(0, 5), chips(0, 8), b(0, 1);
+  std::uniform_int_distribution<int64_t> params(0, 6000000000LL);
+  std::string s = "F|";
+  s += kFam[fam(*rng)];
+  s += "|" + std::to_string(params(*rng));
+  s += "|" + std::to_string(b(*rng));
+  s += "|" + std::to_string(b(*rng));
+  s += "|v5e|" + std::to_string(chips(*rng)) + "\n";
+  return s;
+}
+
+std::string random_state(std::mt19937* rng) {
+  std::uniform_int_distribution<int> sz(1, 32), n(0, 10), b(0, 1);
+  std::uniform_real_distribution<double> v(0.0, 100.0);
+  std::string s = "C|1|32|2|10.0|0.8|0.6|0.35|2\n";
+  s += "T|" + std::to_string(v(*rng)) + "|0.0|" + std::to_string(sz(*rng)) +
+       "\n";
+  s += "B|" + std::to_string(v(*rng)) + "\n";
+  if (b(*rng)) s += "X|" + std::to_string(sz(*rng)) + "\n";
+  if (b(*rng))
+    s += "K|" + std::to_string(sz(*rng)) + "|" + std::to_string(sz(*rng)) +
+         "\n";
+  for (int i = n(*rng); i > 0; --i) {
+    s += "S|" + std::to_string(sz(*rng)) + "|";
+    int k = n(*rng);
+    for (int j = 0; j < k; ++j) {
+      if (j) s += ",";
+      s += std::to_string(v(*rng));
+    }
+    s += "\n";
+  }
+  // Occasionally feed garbage: truncated lines, empty fields, non-numerics.
+  if (b(*rng)) s += "S|x|,,\nT|\n|||\nQ|?\n";
+  return s;
+}
+
+void worker(unsigned seed) {
+  std::mt19937 rng(seed);
+  for (int i = 0; i < 2000; ++i) {
+    char* a = edb_startup(random_features(&rng).c_str());
+    assert(a != nullptr && a[0] == 'P');
+    edb_free(a);
+    char* d = edb_decide(random_state(&rng).c_str());
+    assert(d != nullptr && d[0] == 'D');
+    edb_free(d);
+  }
+  // Null + empty inputs must be safe too.
+  char* e = edb_decide(nullptr);
+  edb_free(e);
+  e = edb_startup("");
+  edb_free(e);
+}
+
+}  // namespace
+
+int main() {
+  std::vector<std::thread> threads;
+  for (unsigned i = 0; i < 8; ++i) threads.emplace_back(worker, 1000u + i);
+  for (auto& t : threads) t.join();
+  std::printf("brain core stress: OK\n");
+  return 0;
+}

@@ -16,6 +16,8 @@ answer.
 
 from __future__ import annotations
 
+import ctypes
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -25,8 +27,51 @@ from easydl_tpu.api.job_spec import ResourceSpec, TpuSpec
 from easydl_tpu.api.resource_plan import ResourcePlan, RolePlan
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.native import load_native
 
 log = get_logger("brain", "policy")
+
+# The native Brain core (SURVEY §2.1 item 2): startup sizing + the damped
+# autoscale step as C functions over a line wire format. Python twins below
+# are pinned to it by randomized parity tests (tests/test_brain.py) — the
+# same architecture as the operator's reconciler core.
+_SOURCE = os.path.join(os.path.dirname(__file__), "native", "brain_core.cc")
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    for fn in (lib.edb_startup, lib.edb_decide):
+        fn.argtypes = [ctypes.c_char_p]
+        fn.restype = ctypes.c_void_p  # manual free via edb_free
+    lib.edb_free.argtypes = [ctypes.c_void_p]
+
+
+def _native_call(fn_name: str, text: str) -> Optional[str]:
+    lib = load_native(_SOURCE, _bind)
+    if lib is None:
+        return None
+    ptr = getattr(lib, fn_name)(text.encode())
+    if not ptr:
+        return None
+    try:
+        return ctypes.string_at(ptr).decode()
+    finally:
+        lib.edb_free(ptr)
+
+
+#: Every character Python's str.splitlines treats as a terminator, plus the
+#: field separator. The C++ core splits on '\n' only, so ANY terminator the
+#: twin's splitlines honors must be sanitized or the two would desync (e.g.
+#: '\r' from a CRLF-edited job spec).
+_WIRE_UNSAFE = "|\n\r\v\f\x1c\x1d\x1e\x85\u2028\u2029"
+
+
+def _wire_str(s: str) -> str:
+    """Field sanitizer: the wire is line/pipe delimited."""
+    out = s or ""
+    for ch in _WIRE_UNSAFE:
+        if ch in out:
+            out = out.replace(ch, "_")
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -54,28 +99,71 @@ _PARAMS_TO_MIN_WORKERS = (
 )
 
 
-def startup_plan(features: pb.JobFeatures, version: int = 1) -> ResourcePlan:
+def encode_features(features: pb.JobFeatures) -> str:
+    """Wire-encode JobFeatures for the startup-sizing core. The family is
+    pre-lowercased here so core and twin match on identical bytes."""
+    return (
+        f"F|{_wire_str(features.model_family).lower()}"
+        f"|{int(features.model_params)}"
+        f"|{1 if features.uses_ps else 0}"
+        f"|{1 if features.uses_evaluator else 0}"
+        f"|{_wire_str(features.accelerator.type)}"
+        f"|{int(features.accelerator.chips)}\n"
+    )
+
+
+def _py_startup_sizing(wire: str) -> str:
+    """Python twin of the native ``edb_startup`` (same wire in/out)."""
+    for line in wire.splitlines():
+        f = line.split("|")
+        if not f or f[0] != "F" or len(f) < 7:
+            continue
+        family, params = f[1], int(f[2])
+        uses_ps, uses_eval = f[3] == "1", f[4] == "1"
+        tpu_type = f[5] or "v5e"
+        acc_chips = int(f[6])
+        workers, chips, ps = _FAMILY_DEFAULTS.get(family, _DEFAULT)
+        if uses_ps and ps == 0:
+            ps = 1
+        if not uses_ps:
+            ps = 0
+        for threshold, min_workers in _PARAMS_TO_MIN_WORKERS:
+            if params >= threshold:
+                workers = max(workers, min_workers)
+                break
+        if acc_chips:
+            chips = max(chips, acc_chips)
+        return f"P|{workers}|{chips}|{ps}|{1 if uses_eval else 0}|{tpu_type}\n"
+    return ""
+
+
+def startup_sizing_wire(wire: str, force_python: bool = False) -> str:
+    """Run the startup sizing through the native core (Python twin when no
+    toolchain / forced)."""
+    if not force_python:
+        out = _native_call("edb_startup", wire)
+        if out:
+            return out
+    return _py_startup_sizing(wire)
+
+
+def startup_plan(features: pb.JobFeatures, version: int = 1,
+                 force_python: bool = False) -> ResourcePlan:
     """First resource plan from extracted job features.
 
     Mirrors the trainer flow the reference specifies: "extracts features from
     the job, and queries the startup resources from EasyDL Brain"
-    (docs/design/elastic-training-operator.md:106-107).
+    (docs/design/elastic-training-operator.md:106-107). Sizing numbers come
+    from the native core (brain_core.cc) with the Python twin as fallback;
+    this function materialises them into a ResourcePlan.
     """
-    family = (features.model_family or "").lower()
-    workers, chips, ps = _FAMILY_DEFAULTS.get(family, _DEFAULT)
-    if features.uses_ps and ps == 0:
-        ps = 1
-    if not features.uses_ps:
-        ps = 0
-    for threshold, min_workers in _PARAMS_TO_MIN_WORKERS:
-        if features.model_params >= threshold:
-            workers = max(workers, min_workers)
-            break
-
-    tpu_type = features.accelerator.type or "v5e"
-    # accelerator.chips is the user's per-worker chip request; honor it.
-    if features.accelerator.chips:
-        chips = max(chips, features.accelerator.chips)
+    out = startup_sizing_wire(encode_features(features),
+                              force_python=force_python)
+    fields = (out.strip().split("|") + [""] * 6)[:6]
+    if fields[0] != "P":
+        raise ValueError(f"bad sizing result {out!r}")
+    workers, chips, ps = int(fields[1]), int(fields[2]), int(fields[3])
+    tpu_type = fields[5] or "v5e"
 
     roles = {
         "worker": RolePlan(
@@ -172,9 +260,11 @@ class Autoscaler:
         self,
         config: Optional[AutoscalerConfig] = None,
         clock: Callable[[], float] = time.monotonic,
+        force_python: bool = False,
     ):
         self.config = config or AutoscalerConfig()
         self._clock = clock
+        self._force_py = force_python
         self._per_size: Dict[int, _SizeStats] = {}
         self._last_decision_t: float = -1e18
         self._last_size: int = 0
@@ -197,85 +287,64 @@ class Autoscaler:
             self._best_per_chip = max(self._best_per_chip, stats.throughput / size)
 
     # ---------------------------------------------------------------- decision
-    def _efficiency(self, size: int) -> Optional[float]:
-        """Throughput(size) / (size × best per-chip throughput at any smaller
-        size). 1.0 = perfectly linear vs the best small-size baseline."""
-        stats = self._per_size.get(size)
-        if not stats or stats.count < self.config.min_samples:
-            return None
-        base = [
-            (s, st.throughput / s)
-            for s, st in self._per_size.items()
-            if s < size and st.count >= self.config.min_samples
+    def encode_state(self, current_workers: int, now: float) -> str:
+        """Wire-encode the full decision input for the native core (and its
+        Python twin). Floats as ``repr`` — shortest round-trip decimal, so
+        C++ strtod reconstructs the identical double."""
+        cfg = self.config
+        lines = [
+            f"C|{cfg.min_workers}|{cfg.max_workers}|{cfg.min_samples}"
+            f"|{cfg.cooldown_s!r}|{cfg.scaleup_efficiency_floor!r}"
+            f"|{cfg.marginal_efficiency_floor!r}"
+            f"|{cfg.scaledown_throughput_ratio!r}|{cfg.growth}",
+            f"T|{now!r}|{self._last_decision_t!r}|{max(current_workers, 1)}",
+            f"B|{self._best_per_chip!r}",
         ]
-        if not base:
-            return None
-        best_per_chip = max(per_chip for _, per_chip in base)
-        if best_per_chip <= 0:
-            return None
-        return stats.throughput / (size * best_per_chip)
+        for s in sorted(self._bad_sizes):
+            lines.append(f"X|{s}")
+        if self._pending_check:
+            lines.append(f"K|{self._pending_check[0]}|{self._pending_check[1]}")
+        for s, st in sorted(self._per_size.items()):
+            lines.append(f"S|{s}|" + ",".join(repr(float(v)) for v in st.samples))
+        return "\n".join(lines) + "\n"
 
     def decide(self, current_workers: int) -> int:
-        """Target worker count (== current to hold steady)."""
-        cfg = self.config
+        """Target worker count (== current to hold steady).
+
+        The decision itself runs in the native core (brain_core.cc), with
+        :func:`_py_decide_wire` as the toolchain-free twin; this method
+        owns state: it encodes the snapshot, applies the returned effects
+        (cooldown stamp, bad-size memory, pending audit), and logs."""
         now = self._clock()
         cur = max(current_workers, 1)
-        stats = self._per_size.get(cur)
-        if not stats or stats.count < cfg.min_samples:
-            return cur
-        if now - self._last_decision_t < cfg.cooldown_s:
-            return cur
-
-        # 1. Marginal-efficiency audit of the last scale-up.
-        if self._pending_check and self._pending_check[1] == cur:
-            frm, to = self._pending_check
-            eff = self._efficiency(to)
-            if eff is not None:
-                self._pending_check = None
-                if eff < cfg.marginal_efficiency_floor:
-                    log.warning(
-                        "scale-up %d→%d inefficient (eff=%.2f < %.2f); reverting",
-                        frm, to, eff, cfg.marginal_efficiency_floor,
-                    )
-                    self._bad_sizes.add(to)
-                    self._last_decision_t = now
-                    return frm
-
-        # 2. Scale down if we're far off the best per-chip rate ever seen.
-        per_chip = stats.throughput / cur
-        best_per_chip = self._best_per_chip
-        if (
-            cur > cfg.min_workers
-            and best_per_chip > 0
-            and per_chip < cfg.scaledown_throughput_ratio * best_per_chip
-        ):
-            target = max(cfg.min_workers, cur // cfg.growth)
-            if target != cur:
-                log.info(
-                    "scaling down %d→%d (per-chip %.1f « best %.1f)",
-                    cur, target, per_chip, best_per_chip,
-                )
-                self._last_decision_t = now
-                return target
-
-        # 3. Scale up while efficient.
-        target = min(cur * cfg.growth, cfg.max_workers)
-        if target > cur and target not in self._bad_sizes:
-            eff = self._efficiency(cur)
-            # At the smallest measured size there is no baseline: treat as
-            # efficient (the north-star run must leave 8 chips somehow) —
-            # provided the current rate is healthy vs the best ever seen.
-            if eff is None:
-                smaller = [s for s in self._per_size if s < cur]
-                if not smaller and per_chip >= cfg.scaleup_efficiency_floor * best_per_chip:
-                    eff = 1.0
-            if eff is not None and eff >= cfg.scaleup_efficiency_floor:
-                log.info("scaling up %d→%d (eff=%.2f)", cur, target, eff)
-                self._last_decision_t = now
-                self._pending_check = (cur, target)
-                return target
-
-        return cur
+        wire = self.encode_state(cur, now)
+        out = None
+        if not self._force_py:
+            out = _native_call("edb_decide", wire)
+        if not out:
+            out = _py_decide_wire(wire)
+        f = (out.strip().split("|") + ["-1"] * 7)[:7]
+        if f[0] != "D":
+            raise ValueError(f"bad decision result {out!r}")
+        target, decided = int(f[1]), f[2] == "1"
+        bad, clear_pending = int(f[3]), f[4] == "1"
+        pend_from, pend_to = int(f[5]), int(f[6])
+        if clear_pending:
+            self._pending_check = None
+        if bad >= 0:
+            self._bad_sizes.add(bad)
+            log.warning(
+                "scale-up %d→%d inefficient; reverting and remembering %d "
+                "as bad", target, bad, bad,
+            )
+        if pend_from >= 0:
+            self._pending_check = (pend_from, pend_to)
+        if decided:
+            self._last_decision_t = now
+            if bad < 0 and target != cur:
+                log.info("scaling %s %d→%d",
+                         "up" if target > cur else "down", cur, target)
+        return target
 
     # ------------------------------------------------------------- durability
     def to_state(self) -> Dict[str, object]:
@@ -333,6 +402,110 @@ class Autoscaler:
             "bad_sizes": sorted(self._bad_sizes),
             "last_size": self._last_size,
         }
+
+
+# ------------------------------------------------------------- decision twin
+
+
+def _py_decide_wire(text: str) -> str:
+    """Python twin of the native ``edb_decide``: same wire in, same wire
+    out, bit-identical arithmetic (both sides left-fold the same decimal
+    literals as IEEE doubles). Pinned to the core by the randomized parity
+    test in tests/test_brain.py."""
+    cfg = {"min_w": 1, "max_w": 32, "min_samples": 5, "growth": 2,
+           "cooldown": 30.0, "up_floor": 0.80, "marg_floor": 0.60,
+           "down_ratio": 0.35}
+    now, last_t, cur = 0.0, -1e18, 1
+    best_per_chip = 0.0
+    bad_sizes: set = set()
+    pending: Optional[Tuple[int, int]] = None
+    per_size: Dict[int, List[float]] = {}
+    for line in text.splitlines():
+        f = line.split("|")
+        if not f or not f[0]:
+            continue
+        if f[0] == "C" and len(f) >= 9:
+            cfg = {"min_w": int(f[1]), "max_w": int(f[2]),
+                   "min_samples": int(f[3]), "cooldown": float(f[4]),
+                   "up_floor": float(f[5]), "marg_floor": float(f[6]),
+                   "down_ratio": float(f[7]), "growth": int(f[8])}
+        elif f[0] == "T" and len(f) >= 4:
+            now, last_t, cur = float(f[1]), float(f[2]), max(int(f[3]), 1)
+        elif f[0] == "B" and len(f) >= 2:
+            best_per_chip = float(f[1])
+        elif f[0] == "X" and len(f) >= 2:
+            bad_sizes.add(int(f[1]))
+        elif f[0] == "K" and len(f) >= 3:
+            pending = (int(f[1]), int(f[2]))
+        elif f[0] == "S" and len(f) >= 3:
+            per_size[int(f[1])] = [float(v) for v in f[2].split(",") if v]
+
+    def throughput(samples: List[float]) -> float:
+        return sum(samples, 0.0) / len(samples) if samples else 0.0
+
+    def efficiency(size: int) -> Optional[float]:
+        samples = per_size.get(size)
+        if samples is None or len(samples) < cfg["min_samples"]:
+            return None
+        base = [
+            throughput(vals) / s
+            for s, vals in per_size.items()
+            if s < size and len(vals) >= cfg["min_samples"]
+        ]
+        if not base:
+            return None
+        best_pc = max(base)
+        if best_pc <= 0:
+            return None
+        return throughput(samples) / (size * best_pc)
+
+    target, decided, bad, clear_pending = cur, False, -1, False
+    pend_from = pend_to = -1
+
+    def emit() -> str:
+        return (f"D|{target}|{1 if decided else 0}|{bad}"
+                f"|{1 if clear_pending else 0}|{pend_from}|{pend_to}\n")
+
+    samples = per_size.get(cur)
+    if samples is None or len(samples) < cfg["min_samples"]:
+        return emit()
+    if now - last_t < cfg["cooldown"]:
+        return emit()
+
+    # 1. Marginal-efficiency audit of the last scale-up.
+    if pending and pending[1] == cur:
+        eff = efficiency(cur)
+        if eff is not None:
+            clear_pending = True
+            if eff < cfg["marg_floor"]:
+                bad, decided, target = pending[1], True, pending[0]
+                return emit()
+
+    # 2. Scale down if far off the best per-chip rate ever seen.
+    per_chip = throughput(samples) / cur
+    if (cur > cfg["min_w"] and best_per_chip > 0
+            and per_chip < cfg["down_ratio"] * best_per_chip):
+        down = max(cfg["min_w"], cur // cfg["growth"])
+        if down != cur:
+            decided, target = True, down
+            return emit()
+
+    # 3. Scale up while efficient.
+    up = min(cur * cfg["growth"], cfg["max_w"])
+    if up > cur and up not in bad_sizes:
+        eff = efficiency(cur)
+        if eff is None:
+            # At the smallest measured size there is no baseline: treat as
+            # efficient (the north-star run must leave 8 chips somehow) —
+            # provided the current rate is healthy vs the best ever seen.
+            smaller = [s for s in per_size if s < cur]
+            if not smaller and per_chip >= cfg["up_floor"] * best_per_chip:
+                eff = 1.0
+        if eff is not None and eff >= cfg["up_floor"]:
+            decided, target = True, up
+            pend_from, pend_to = cur, up
+            return emit()
+    return emit()
 
 
 # ---------------------------------------------------------------------------

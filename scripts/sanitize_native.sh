@@ -19,6 +19,11 @@ build() {  # $1 sanitizer flag, $2 tag
     easydl_tpu/controller/native/reconciler_stress.cc -lpthread
   "$out/edr_stress"
   echo "reconciler core: $tag clean"
+  g++ -O1 -g -std=c++17 -fsanitize="$flag" -fno-omit-frame-pointer -Wall \
+    -o "$out/edb_stress" \
+    easydl_tpu/brain/native/brain_stress.cc -lpthread
+  "$out/edb_stress"
+  echo "brain core: $tag clean"
   rm -rf "$out"
 }
 [[ "$mode" == "tsan" || "$mode" == "all" ]] && build thread tsan

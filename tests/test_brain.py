@@ -484,3 +484,92 @@ def test_legacy_state_file_migrated_not_shadowing(tmp_path):
     # and a third restart still sees v9
     brain3 = Brain(AutoscalerConfig(), clock=clock, state_dir=sd)
     assert brain3.current_plan("j", 0).version == 9
+
+
+# ---------------------------------------------------------- native core parity
+
+
+def test_native_python_startup_parity_randomized():
+    """The C++ startup-sizing core and its Python twin must agree on
+    randomized feature vectors (SURVEY §2.1 item 2: Brain's native core)."""
+    import random
+
+    from easydl_tpu.brain.policy import (_py_startup_sizing, encode_features,
+                                         startup_sizing_wire)
+    from easydl_tpu.brain.policy import _native_call
+
+    if _native_call("edb_startup", "F|mlp|0|0|0||0\n") is None:
+        import pytest
+        pytest.skip("no native toolchain")
+
+    rng = random.Random(7)
+    families = ["mlp", "resnet", "bert", "gpt", "deepfm", "widedeep",
+                "unknown", "", "GPT", "Weird|Family\nName"]
+    for trial in range(300):
+        f = pb.JobFeatures(
+            job_name="j",
+            model_family=rng.choice(families),
+            model_params=rng.choice(
+                [0, 10_000, 250_000_000, 1_500_000_000, 6_000_000_000]),
+            uses_ps=rng.random() < 0.5,
+            uses_evaluator=rng.random() < 0.5,
+        )
+        f.accelerator.type = rng.choice(["", "v5e", "v4", "v5p"])
+        f.accelerator.chips = rng.choice([0, 1, 4, 8])
+        wire = encode_features(f)
+        native = startup_sizing_wire(wire)
+        python = _py_startup_sizing(wire)
+        assert native == python, (
+            f"trial {trial}: startup divergence\nwire: {wire!r}\n"
+            f"native: {native!r}\npython: {python!r}"
+        )
+
+
+def test_native_python_decide_parity_randomized():
+    """Two Autoscalers — one on the C++ core, one forced to the Python twin
+    — fed identical randomized metric streams and clocks must make
+    identical decisions at every step AND end with identical durable
+    state."""
+    import random
+
+    from easydl_tpu.brain.policy import _native_call
+
+    if _native_call("edb_decide", "T|0.0|0.0|1\n") is None:
+        import pytest
+        pytest.skip("no native toolchain")
+
+    rng = random.Random(11)
+    for trial in range(40):
+        cfg = AutoscalerConfig(
+            min_workers=rng.choice([1, 2]),
+            max_workers=rng.choice([8, 16, 32]),
+            min_samples=rng.choice([1, 2, 3]),
+            cooldown_s=rng.choice([0.0, 5.0, 30.0]),
+            scaleup_efficiency_floor=rng.choice([0.5, 0.8, 0.95]),
+            marginal_efficiency_floor=rng.choice([0.3, 0.6, 0.9]),
+            scaledown_throughput_ratio=rng.choice([0.2, 0.35, 0.6]),
+            growth=rng.choice([2, 4]),
+            window=rng.choice([4, 8, 20]),
+        )
+        clock_a, clock_b = FakeClock(), FakeClock()
+        a = Autoscaler(cfg, clock=clock_a)               # native core
+        b = Autoscaler(cfg, clock=clock_b, force_python=True)  # twin
+        cur_a = cur_b = rng.choice([1, 2, 4, 8])
+        for step in range(60):
+            world = rng.choice([1, 2, 4, 8, 16, 32])
+            sps = rng.uniform(0.1, 50.0) * world
+            m = metrics(world, sps, step=step)
+            a.observe(m)
+            b.observe(m)
+            dt = rng.choice([0.0, 1.0, 10.0, 60.0])
+            clock_a.advance(dt)
+            clock_b.advance(dt)
+            if rng.random() < 0.5:
+                ta = a.decide(cur_a)
+                tb = b.decide(cur_b)
+                assert ta == tb, (
+                    f"trial {trial} step {step}: native {ta} != twin {tb}\n"
+                    f"state:\n{a.encode_state(cur_a, clock_a.t)}"
+                )
+                cur_a, cur_b = ta, tb
+        assert a.to_state() == b.to_state(), f"trial {trial}: durable drift"
