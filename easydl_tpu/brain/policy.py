@@ -277,8 +277,14 @@ class Autoscaler:
 
     # ------------------------------------------------------------------ intake
     def observe(self, m: pb.StepMetrics) -> None:
+        import math
+
         size = max(int(m.world_size), 1)
-        if m.samples_per_sec <= 0:
+        # Reject non-finite rates at the source: a NaN admitted into a
+        # window would make "efficiency" NaN, where the native core's
+        # NaN-encodes-None convention and the twin's is-not-None check
+        # would legitimately diverge (review r5 finding #1).
+        if not math.isfinite(m.samples_per_sec) or m.samples_per_sec <= 0:
             return
         stats = self._per_size.setdefault(size, _SizeStats())
         stats.add(m.samples_per_sec, self.config.window)
