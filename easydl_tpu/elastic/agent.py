@@ -66,6 +66,14 @@ class Agent:
         self._warm: Optional[tuple] = None  # (proc, warm_file, log_file)
         self._warm_count = 0
         self._warm_due = False  # re-arm standby after worker's first step
+        # Preflight: the tentative NEXT generation's worker, spawned on the
+        # master's prepare hint. It dist-joins the next coordinator, builds
+        # the trainer, and compiles the step while the CURRENT worker keeps
+        # training; the matching RUN then just writes its go-file.
+        # (proc, go_file, (generation, coordinator), log_file)
+        self._preflight: Optional[tuple] = None
+        self._preflight_count = 0
+        self._preflight_failed_sig: Optional[tuple] = None
         self.worker_argv = worker_argv or [
             sys.executable, "-m", "easydl_tpu.elastic.worker"
         ]
@@ -93,7 +101,14 @@ class Agent:
         return self
 
     def stop(self) -> None:
+        """Signal the loop to exit and WAIT for its cleanup: the loop's
+        tail kills the worker, the warm standby, and the preflight. A
+        fire-and-forget stop let the owning process exit first, leaking
+        running workers that trained forever against abandoned workdirs."""
         self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=20.0)
 
     def join(self, timeout: float = 30.0) -> None:
         if self._thread:
@@ -167,11 +182,22 @@ class Agent:
         directive = self._register()
         fail_since: Optional[float] = None
         while not self._stop.is_set():
+            state_before = self._state
             self._apply(directive)
             self._refresh_state()
             if self._state == "shutdown":
                 break
-            time.sleep(self.heartbeat_interval)
+            # Event-driven cadence: each hop of a generation switch (worker
+            # died → master KILLs the peer → peer reports idle → RUN) used
+            # to cost one full heartbeat sleep; across the 4-hop ladder
+            # that was the bulk of detect_and_rendezvous time. A non-noop
+            # directive or a local state change fast-follows with an
+            # immediate heartbeat instead (tiny sleep to bound any cycle).
+            interesting = (
+                directive.kind != pb.DirectiveKind.NOOP
+                or self._state != state_before
+            )
+            time.sleep(0.02 if interesting else self.heartbeat_interval)
             metrics = self._read_metrics()
             if self._warm_rearm_ready(metrics):
                 self._warm_due = False
@@ -182,6 +208,7 @@ class Agent:
                         agent_id=self.agent_id,
                         generation=self._applied_key[0],
                         state=self._state,
+                        prepared=self._preflight_ready(),
                         step=int(metrics.get("step", 0)),
                         metrics=pb.StepMetrics(
                             step=int(metrics.get("step", 0)),
@@ -208,6 +235,7 @@ class Agent:
                 time.sleep(self.heartbeat_interval)
         self._terminate_worker(graceful=False)
         self._kill_warm()
+        self._kill_preflight()
         if self._log_file is not None:
             self._log_file.close()
             self._log_file = None
@@ -256,6 +284,7 @@ class Agent:
 
     def _apply(self, directive: pb.Directive) -> None:
         kind = directive.kind
+        self._maybe_preflight(directive)
         if kind == pb.DirectiveKind.RUN:
             m = directive.membership
             # Spawn at most once per formed generation: if our worker exited,
@@ -296,6 +325,109 @@ class Agent:
         env["EASYDL_TIMELINE"] = self.timeline_path
         return env
 
+    def _maybe_preflight(self, directive: pb.Directive) -> None:
+        """React to the master's prepare hint (piggybacked on directives).
+
+        Spawns (or retargets) the preflight worker for the announced next
+        generation; tears a stale one down when the hint is gone and no
+        switch is in flight (a RUN consumes or kills it itself)."""
+        prep = directive.prepare
+        if not prep.world_size or self.agent_id not in prep.hosts:
+            if (self._preflight is not None
+                    and directive.kind == pb.DirectiveKind.NOOP
+                    and not prep.world_size):
+                # Prepare withdrawn (target changed / we were dropped):
+                # a lingering preflight holds a rank on a dead coordinator.
+                self._kill_preflight()
+            return
+        sig = (prep.generation, prep.coordinator)
+        if self._preflight_failed_sig == sig:
+            return  # this preflight crashed once; don't crash-loop it
+        if self._preflight is not None:
+            if self._preflight[2] == sig:
+                if self._preflight[0].poll() is None:
+                    return  # already preflighting this generation
+                # Crashed (compile error, OOM): remember and fall back to
+                # the cold path rather than respawning every heartbeat.
+                log.warning("%s: preflight for gen %d exited rc=%s; "
+                            "falling back to cold switch", self.agent_id,
+                            sig[0], self._preflight[0].poll())
+                self._preflight_failed_sig = sig
+                self._kill_preflight()
+                return
+            self._kill_preflight()
+        rank = list(prep.hosts).index(self.agent_id)
+        self._preflight_count += 1
+        go_file = os.path.join(
+            self.workdir,
+            f".go-{self.agent_id}-{prep.generation}-{self._preflight_count}.json",
+        )
+        proc, log_file = self._spawn_gated_worker(
+            {
+                "EASYDL_RANK": str(rank),
+                "EASYDL_WORLD": str(prep.world_size),
+                "EASYDL_COORD": prep.coordinator,
+                "EASYDL_GEN": str(prep.generation),
+                "EASYDL_WORKDIR": self.workdir,
+                "EASYDL_METRICS": self.metrics_path,
+                "EASYDL_GO_FILE": go_file,
+            },
+            gate_file=go_file,
+        )
+        self._preflight = (proc, go_file, sig, log_file)
+        log.info("%s: preflight spawned for gen %d rank %d/%d (pid %d)",
+                 self.agent_id, prep.generation, rank, prep.world_size,
+                 proc.pid)
+
+    def _preflight_ready(self) -> str:
+        """Coordinator of the ready preflight ("" when none) — reported in
+        heartbeats so the master knows when to start the drain."""
+        if self._preflight is None:
+            return ""
+        proc, go_file, sig, _ = self._preflight
+        if proc.poll() is not None:
+            return ""
+        return sig[1] if os.path.exists(go_file + ".ready") else ""
+
+    def _kill_preflight(self) -> None:
+        if self._preflight is not None:
+            proc, _, sig, log_file = self._preflight
+            self._preflight = None
+            self._reap_worker(proc, log_file)
+            log.info("%s: preflight for gen %d discarded", self.agent_id,
+                     sig[0])
+
+    # One copy of the gated-worker subprocess lifecycle (warm standby AND
+    # preflight use it: fresh gate files, append-mode shared log, killed
+    # with its log fd closed — the leaked-fd-per-generation fix lives here
+    # once, not in three hand-copies).
+    def _spawn_gated_worker(self, env_extra: Dict[str, str],
+                            gate_file: str):
+        for path in (gate_file, gate_file + ".ready"):
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+        env = self._worker_env()
+        env.update(env_extra)
+        log_file = open(
+            os.path.join(self.workdir, f"worker-{self.agent_id}.log"), "ab"
+        )
+        proc = subprocess.Popen(
+            self.worker_argv, env=env, stdout=log_file, stderr=log_file
+        )
+        return proc, log_file
+
+    @staticmethod
+    def _reap_worker(proc, log_file) -> None:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        try:
+            log_file.close()
+        except OSError:
+            pass
+
     def _warm_rearm_ready(self, metrics: dict) -> bool:
         """Should the deferred standby re-arm fire now?
 
@@ -314,35 +446,13 @@ class Agent:
 
     def _spawn_warm(self) -> None:
         """Start the next standby: jax imports now, membership comes later."""
-        if self._warm is not None:
-            # Replacing a dead/unused standby: close its log fd (the tuple
-            # is about to be overwritten — one leaked fd per generation
-            # otherwise) and make sure the process is gone.
-            proc, _, log_file = self._warm
-            if proc.poll() is None:
-                proc.kill()
-                proc.wait()
-            try:
-                log_file.close()
-            except OSError:
-                pass
-            self._warm = None
+        self._kill_warm()  # replace any dead/unused standby (and its fd)
         self._warm_count += 1
         warm_file = os.path.join(
             self.workdir, f".warm-{self.agent_id}-{self._warm_count}.json"
         )
-        for path in (warm_file, warm_file + ".ready"):
-            try:
-                os.remove(path)
-            except FileNotFoundError:
-                pass
-        env = self._worker_env()
-        env["EASYDL_WARM_FILE"] = warm_file
-        log_file = open(
-            os.path.join(self.workdir, f"worker-{self.agent_id}.log"), "ab"
-        )
-        proc = subprocess.Popen(
-            self.worker_argv, env=env, stdout=log_file, stderr=log_file
+        proc, log_file = self._spawn_gated_worker(
+            {"EASYDL_WARM_FILE": warm_file}, gate_file=warm_file
         )
         self._warm = (proc, warm_file, log_file)
         log.info("%s: warm standby spawned (pid %d)", self.agent_id, proc.pid)
@@ -351,10 +461,7 @@ class Agent:
         if self._warm is not None:
             proc, _, log_file = self._warm
             self._warm = None
-            if proc.poll() is None:
-                proc.kill()
-                proc.wait()
-            log_file.close()
+            self._reap_worker(proc, log_file)
 
     def _spawn(self, m: pb.Membership) -> None:
         rank = list(m.hosts).index(self.agent_id)
@@ -367,12 +474,39 @@ class Agent:
             "EASYDL_METRICS": self.metrics_path,
             "EASYDL_TIMELINE": self.timeline_path,
         }
+        preflight_hit = False
+        if self._preflight is not None:
+            proc, go_file, sig, log_file = self._preflight
+            if sig == (m.generation, m.coordinator) and proc.poll() is None:
+                preflight_hit = True
+            else:
+                # Formed generation differs from the prepared one (aborted
+                # prepare, fresh coordinator): this preflight can never be
+                # promoted — its group is dead.
+                self._kill_preflight()
         warm_hit = bool(
-            self.warm_start and self._warm and self._warm[0].poll() is None
+            not preflight_hit
+            and self.warm_start and self._warm and self._warm[0].poll() is None
         )
-        timeline.emit(self.timeline_path, "spawn", m.generation,
-                      mode="warm" if warm_hit else "cold")
-        if warm_hit:
+        timeline.emit(
+            self.timeline_path, "spawn", m.generation,
+            mode="preflight" if preflight_hit
+            else ("warm" if warm_hit else "cold"),
+        )
+        if preflight_hit:
+            proc, go_file, sig, log_file = self._preflight
+            self._preflight = None
+            tmp = go_file + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"generation": m.generation,
+                           "coordinator": m.coordinator}, f)
+            os.replace(tmp, go_file)
+            if self._log_file is not None:
+                self._log_file.close()
+            self._log_file = log_file
+            self._proc = proc
+            promoted = "promoted preflight (pre-compiled)"
+        elif warm_hit:
             proc, warm_file, log_file = self._warm
             self._warm = None
             tmp = warm_file + ".tmp"

@@ -75,6 +75,7 @@ class _Servicer:
                 req.state,
                 step=req.step,
                 preempting=bool(req.preemption_notice),
+                prepared=req.prepared,
             )
             if req.metrics.step_time_s > 0:
                 self._m._record_metrics(req.agent_id, req.metrics)
@@ -96,6 +97,9 @@ class Master:
         brain_address: Optional[str] = None,
         brain_poll_interval: float = 2.0,
         port: int = 0,
+        prepare_timeout_s: float = 60.0,
+        prepare_min_uptime_s: float = 20.0,
+        standing_preflight: bool = False,
     ):
         self.job_name = job_name
         self.workdir = workdir
@@ -119,6 +123,9 @@ class Master:
             heartbeat_timeout=heartbeat_timeout,
             port_alloc=free_port,
             start_generation=int(persisted.get("generation", 0)),
+            prepare_timeout_s=prepare_timeout_s,
+            prepare_min_uptime_s=prepare_min_uptime_s,
+            standing_preflight=standing_preflight,
         )
         self._lock = threading.RLock()
         self._server = None
@@ -382,6 +389,11 @@ class Master:
             out.membership.world_size = d.world_size
             out.membership.hosts.extend(d.hosts)
             out.membership.coordinator = d.coordinator
+        if d.prepare_world:
+            out.prepare.generation = d.prepare_generation
+            out.prepare.world_size = d.prepare_world
+            out.prepare.hosts.extend(d.prepare_hosts)
+            out.prepare.coordinator = d.prepare_coordinator
         return out
 
     # ------------------------------------------------------------------ status

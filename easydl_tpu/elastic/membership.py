@@ -32,6 +32,7 @@ log = get_logger("elastic", "rendezvous")
 class JobPhase(Enum):
     INIT = "init"        # waiting for the first agents
     STABLE = "stable"    # a generation is running
+    PREPARING = "preparing"  # next generation preflighting; current trains on
     DRAINING = "draining"  # stopping members before reshaping
     DONE = "done"
 
@@ -54,6 +55,8 @@ class AgentView:
     step: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
     preempting: bool = False
+    #: coordinator of the preflight this agent reports ready ("" = none)
+    prepared: str = ""
 
 
 @dataclass
@@ -63,6 +66,33 @@ class Directive:
     world_size: int = 0
     hosts: Tuple[str, ...] = ()
     coordinator: str = ""
+    # Piggybacked prepare hint (tentative NEXT generation) — see
+    # :class:`PrepareState`. world_size 0 = no prepare in force.
+    prepare_generation: int = 0
+    prepare_world: int = 0
+    prepare_hosts: Tuple[str, ...] = ()
+    prepare_coordinator: str = ""
+
+
+@dataclass
+class PrepareState:
+    """A tentative next generation being preflighted.
+
+    On a PLANNED reshape the master pre-forms the next generation —
+    membership in rank order and a fresh coordinator — and announces it
+    while the current generation keeps training. Target agents spawn
+    preflight workers that dist-join this coordinator, build the trainer,
+    and compile the train step; the drain starts once every target member
+    reports ``prepared == coordinator`` (or the window times out). The
+    expensive phases of a generation switch (process start, imports,
+    dist init, trainer build, first-step compile — RECOVERY.json's
+    dominant terms) thus overlap training instead of stalling it.
+    """
+
+    generation: int
+    members: Tuple[str, ...]
+    coordinator: str
+    deadline: float
 
 
 class Rendezvous:
@@ -79,6 +109,10 @@ class Rendezvous:
         min_workers: int = 1,
         port_alloc: Optional[Callable[[], int]] = None,
         start_generation: int = 0,
+        prepare_timeout_s: float = 60.0,
+        prepare_min_uptime_s: float = 20.0,
+        standing_preflight: bool = False,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.desired_workers = desired_workers
         self.min_workers = min_workers
@@ -93,6 +127,26 @@ class Rendezvous:
         self.members: List[str] = []
         self._drain_planned = True
         self._coordinator = ""
+        #: planned reshapes preflight the next generation for up to this
+        #: long before draining (0 disables preflight entirely)
+        self.prepare_timeout_s = prepare_timeout_s
+        #: a generation younger than this drains immediately instead of
+        #: preflighting: seconds after forming there is almost no running
+        #: throughput to protect, and the preflight's compile contention
+        #: would only delay the reshape (the startup world-1 → world-N ramp
+        #: is the canonical case)
+        self.prepare_min_uptime_s = prepare_min_uptime_s
+        #: keep a pre-formed next generation armed even in steady state so
+        #: UNPLANNED kills can adopt it. Opt-in: each armed preflight costs
+        #: one extra worker process per host plus a compile after every
+        #: formation — free on real multi-core TPU hosts, but measured to
+        #: rob a 1-core simulation box of training throughput. Planned
+        #: reshapes preflight regardless (the compile overlaps training
+        #: and the drain gates on readiness).
+        self.standing_preflight = standing_preflight
+        self._clock = clock
+        self._formed_at = float("-inf")
+        self.prepare: Optional[PrepareState] = None
 
     # ------------------------------------------------------------------ events
     def register(self, agent_id: str, host: str, slots: int, preempting: bool = False) -> Directive:
@@ -118,6 +172,7 @@ class Rendezvous:
         state: str,
         step: int = 0,
         preempting: bool = False,
+        prepared: str = "",
     ) -> Directive:
         a = self.agents.get(agent_id)
         if a is None:
@@ -127,6 +182,7 @@ class Rendezvous:
         a.last_heartbeat = time.monotonic()
         a.generation = generation
         a.step = max(a.step, step)
+        a.prepared = prepared
         if preempting and not a.preempting:
             log.warning("agent %s reports preemption notice", agent_id)
             a.preempting = True
@@ -235,14 +291,126 @@ class Rendezvous:
 
         if self.phase in (JobPhase.INIT, JobPhase.STABLE):
             need, planned = self._want_reshape()
-            if need:
-                self._drain_planned = planned
-                if self.members:
-                    log.info("reshaping (%s): draining %d members",
-                             "planned" if planned else "UNPLANNED", len(self.members))
-                    self.phase = JobPhase.DRAINING
-                else:
-                    self._form_generation()
+            if not need:
+                # STANDING PREFLIGHT: even with nothing to reshape, keep the
+                # next generation pre-formed — same members, fresh
+                # coordinator — so an UNPLANNED kill can adopt a group that
+                # already dist-joined and compiled. This is what turns
+                # preemption recovery from process-start+compile into
+                # restore+execute; with the persistent compile cache the
+                # standing preflight's own compile is a cache hit (same
+                # world shape), so its steady-state cost is one idle
+                # process per host.
+                if (
+                    self.phase == JobPhase.STABLE
+                    and self.standing_preflight
+                    and self.prepare is None
+                    and self.prepare_timeout_s > 0
+                    and self._clock() - self._formed_at
+                    >= self.prepare_min_uptime_s
+                    and self.members
+                    and all(
+                        a.state == AgentState.RUNNING
+                        and a.generation == self.generation
+                        for a in self._member_views()
+                    )
+                ):
+                    target = tuple(self._target())
+                    if target and all(m in self.agents for m in target):
+                        self.prepare = PrepareState(
+                            generation=self.generation + 1,
+                            members=target,
+                            coordinator=(
+                                f"{self.agents[target[0]].host}:"
+                                f"{self._port_alloc()}"
+                            ),
+                            deadline=float("inf"),  # standing: gates nothing
+                        )
+                        log.info(
+                            "standing preflight armed for generation %d "
+                            "(members=%s, coordinator=%s)",
+                            self.prepare.generation, target,
+                            self.prepare.coordinator,
+                        )
+                return
+            self._drain_planned = planned
+            target = tuple(self._target())
+            if not self.members:
+                self._form_generation()
+            elif (
+                planned and self.prepare_timeout_s > 0
+                and self._clock() - self._formed_at
+                >= self.prepare_min_uptime_s
+                # A target below min_workers would be rejected at form
+                # time anyway — and an EMPTY one (whole-pool preemption
+                # notice, no standbys) must drain immediately so the
+                # quiesce checkpoint lands before the VMs disappear, not
+                # after a pointless prepare window.
+                and len(target) >= max(self.min_workers, 1)
+            ):
+                # Planned reshape: preflight the next generation before
+                # draining — the current one keeps training meanwhile.
+                self.prepare = PrepareState(
+                    generation=self.generation + 1,
+                    members=target,
+                    coordinator=(
+                        f"{self.agents[target[0]].host}:"
+                        f"{self._port_alloc()}"
+                    ),
+                    deadline=self._clock() + self.prepare_timeout_s,
+                )
+                self.phase = JobPhase.PREPARING
+                log.info(
+                    "preparing generation %d: target=%s coordinator=%s "
+                    "(window %.0fs)", self.prepare.generation, target,
+                    self.prepare.coordinator, self.prepare_timeout_s,
+                )
+            else:
+                log.info("reshaping (%s): draining %d members",
+                         "planned" if planned else "UNPLANNED",
+                         len(self.members))
+                self.phase = JobPhase.DRAINING
+            return
+
+        if self.phase == JobPhase.PREPARING:
+            # A member dying mid-prepare turns this into an unplanned
+            # reshape: drop the preflight (survivors will be killed, the
+            # half-formed preflight group dies on RUN mismatch) and drain
+            # by force.
+            if any(
+                a.state == AgentState.LOST or
+                (a.state == AgentState.IDLE and a.generation == self.generation)
+                for a in self._member_views()
+            ):
+                log.warning("member died mid-prepare; dropping preflight, "
+                            "escalating to KILL drain")
+                self.prepare = None
+                self._drain_planned = False
+                self.phase = JobPhase.DRAINING
+                return
+            # The target moved (plan changed again, a standby died/joined):
+            # drop this preflight and re-decide from STABLE.
+            assert self.prepare is not None
+            if tuple(self._target()) != self.prepare.members:
+                log.info("prepare target changed; dropping preflight")
+                self.prepare = None
+                self.phase = JobPhase.STABLE
+                return
+            ready = all(
+                self.agents[m].prepared == self.prepare.coordinator
+                for m in self.prepare.members
+                if m in self.agents
+            )
+            if ready or self._clock() > self.prepare.deadline:
+                if not ready:
+                    log.warning(
+                        "prepare window expired (%.0fs); draining anyway",
+                        self.prepare_timeout_s,
+                    )
+                log.info("reshaping (planned%s): draining %d members",
+                         ", preflight ready" if ready else "",
+                         len(self.members))
+                self.phase = JobPhase.DRAINING
             return
 
         if self.phase == JobPhase.DRAINING:
@@ -270,18 +438,50 @@ class Rendezvous:
                         len(target), self.min_workers)
             self.members = []
             self.phase = JobPhase.INIT
+            self.prepare = None
             return
         self.generation += 1
         self.members = [a.agent_id for a in target]
-        port = self._port_alloc()
-        self._coordinator = f"{target[0].host}:{port}"
+        # Reuse the preflighted coordinator ONLY when the formed generation
+        # is exactly the prepared one — same number, same members in the
+        # same rank order — and every member's preflight reported ready
+        # (a half-formed preflight group holds ranks on its coordinator; a
+        # fresh port is the only safe way to mix in cold workers).
+        prep = self.prepare
+        if (
+            prep is not None
+            and prep.generation == self.generation
+            and tuple(self.members) == prep.members
+            and all(
+                self.agents[m].prepared == prep.coordinator
+                for m in self.members
+            )
+        ):
+            self._coordinator = prep.coordinator
+            log.info("generation %d adopts preflight coordinator %s",
+                     self.generation, prep.coordinator)
+        else:
+            port = self._port_alloc()
+            self._coordinator = f"{target[0].host}:{port}"
+        self.prepare = None
         self.phase = JobPhase.STABLE
+        self._formed_at = self._clock()
         log.info(
             "generation %d: world=%d members=%s coordinator=%s",
             self.generation, len(self.members), self.members, self._coordinator,
         )
 
     # -------------------------------------------------------------- directives
+    def _attach_prepare(self, d: Directive, agent_id: str) -> Directive:
+        """Piggyback the preflight hint for agents in the prepare target."""
+        prep = self.prepare
+        if prep is not None and agent_id in prep.members:
+            d.prepare_generation = prep.generation
+            d.prepare_world = len(prep.members)
+            d.prepare_hosts = prep.members
+            d.prepare_coordinator = prep.coordinator
+        return d
+
     def directive_for(self, agent_id: str) -> Directive:
         a = self.agents.get(agent_id)
         if a is None:
@@ -301,8 +501,13 @@ class Rendezvous:
             return Directive(kind="kill")
         if self.phase == JobPhase.DRAINING:
             if agent_id in self.members and a.state == AgentState.RUNNING:
-                return Directive(kind="quiesce" if self._drain_planned else "kill")
-            return Directive(kind="noop")
+                return self._attach_prepare(
+                    Directive(
+                        kind="quiesce" if self._drain_planned else "kill"
+                    ),
+                    agent_id,
+                )
+            return self._attach_prepare(Directive(kind="noop"), agent_id)
         if self.phase == JobPhase.STABLE and agent_id in self.members:
             if a.generation != self.generation or a.state in (
                 AgentState.IDLE, AgentState.QUIESCED
@@ -314,8 +519,9 @@ class Rendezvous:
                     hosts=tuple(self.members),
                     coordinator=self._coordinator,
                 )
-            return Directive(kind="noop")
-        return Directive(kind="noop")
+            # Steady state: the standing-preflight hint rides the noop.
+            return self._attach_prepare(Directive(kind="noop"), agent_id)
+        return self._attach_prepare(Directive(kind="noop"), agent_id)
 
     # ------------------------------------------------------------------ status
     def status(self) -> Dict:
@@ -324,6 +530,15 @@ class Rendezvous:
             "generation": self.generation,
             "members": list(self.members),
             "desired_workers": self.desired_workers,
+            "prepare": (
+                {
+                    "generation": self.prepare.generation,
+                    "members": list(self.prepare.members),
+                    "coordinator": self.prepare.coordinator,
+                }
+                if self.prepare is not None
+                else None
+            ),
             "agents": {
                 a.agent_id: {
                     "state": a.state.value,

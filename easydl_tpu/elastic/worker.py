@@ -60,6 +60,12 @@ def run_worker(env: Dict[str, str]) -> int:
     # jax import / distributed init must set the flag, not kill the process
     # (default SIGUSR1 disposition is terminate).
     signal.signal(signal.SIGUSR1, _on_sigusr1)
+    # Orphan-defense baseline, captured BEFORE the slow startup (jax
+    # import, dist init, compile): an agent death during that window —
+    # the most likely moment for a harness kill — already reparents this
+    # process, and a baseline captured later would equal the reaper's pid
+    # and never fire.
+    parent_pid = os.getppid()
     rank = int(env["EASYDL_RANK"])
     world = int(env["EASYDL_WORLD"])
     coordinator = env["EASYDL_COORD"]
@@ -202,6 +208,50 @@ def run_worker(env: Dict[str, str]) -> int:
     # build, the restore-step collective, the actual chunk read) — the
     # decomposition names the binding term (VERDICT r3 weak 2/3 method).
     timeline.emit(tl_path, "trainer_built", generation, rank=rank)
+
+    go_file = env.get("EASYDL_GO_FILE")
+    if go_file:
+        # PREFLIGHT MODE: this process was spawned for a generation that
+        # does not exist yet (the master's prepare hint) while the current
+        # one still trains. Compile the train step NOW — one dummy step on
+        # an init state, discarded — so the entire process-start → compile
+        # pipeline overlaps live training, then hold at the gate for the
+        # agent's go/abort verdict. The real switch will only pay quiesce +
+        # restore + an already-compiled step.
+        if not ps_mode:
+            # (PS mode stops at the trainer build: a dummy PsTrainer step
+            # would push real gradients into the live embedding tier.)
+            warm_state = trainer.init_state()
+            warm_batch = next(iter(bundle.make_data(
+                global_batch // max(world, 1), seed=0)))
+            warm_state, warm_metrics = trainer.train_step(warm_state,
+                                                          warm_batch)
+            float(jax.device_get(warm_metrics["loss"]))  # force execution
+            del warm_state
+        timeline.emit(tl_path, "preflight_ready", generation, rank=rank)
+        try:
+            with open(go_file + ".ready", "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass
+        go = None
+        while go is None:
+            if os.getppid() != parent_pid:  # agent died: don't linger
+                raise SystemExit(0)
+            try:
+                with open(go_file) as f:
+                    go = json.load(f) or None
+            except (OSError, ValueError):
+                go = None
+            if go is None:
+                time.sleep(0.05)
+        if (int(go.get("generation", -1)) != generation
+                or go.get("coordinator") != coordinator):
+            log.info("gen %d: preflight aborted (formed %s@%s)", generation,
+                     go.get("generation"), go.get("coordinator"))
+            return 3
+        timeline.emit(tl_path, "preflight_go", generation, rank=rank)
+
     # Async saves overlap chunk IO with training; the commit barrier runs on
     # this (main) thread via ckpt.finalize() at step boundaries below.
     ckpt = CheckpointManager(os.path.join(workdir, "ckpt"), keep=3, async_save=True)
@@ -257,7 +307,19 @@ def run_worker(env: Dict[str, str]) -> int:
     first_step_emitted = False
 
     total_steps = int(cfg.get("total_steps", 100))
-    ckpt_interval = int(cfg.get("ckpt_interval", 20))
+    # ckpt_interval: a positive int pins the classic every-N-steps schedule;
+    # 0/"auto" bounds WORK-AT-RISK by wall clock instead — the interval is
+    # derived from the agreed step time so that at most ~ckpt_target_s of
+    # training is lost to an unplanned kill (the north-star cadence's
+    # dominant avoidable cost once the switch itself is fast). Derivation
+    # uses the same reduced step time as the consensus schedule, so every
+    # rank computes the identical save step and the collective save can
+    # never split the group.
+    ckpt_raw = cfg.get("ckpt_interval", 20)
+    ckpt_interval = 0 if str(ckpt_raw) == "auto" else int(ckpt_raw)
+    ckpt_target_s = float(cfg.get("ckpt_target_s", 5.0))
+    next_ckpt = start_step + 1
+    agreed_dt = 0.0
     # 0/"auto" (the default): scale the consensus cadence with measured step
     # time; a positive int pins a fixed modulo schedule (tests use this).
     sync_raw = cfg.get("sync_every", 0)
@@ -332,8 +394,18 @@ def run_worker(env: Dict[str, str]) -> int:
         with open(metrics_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
 
+    # Orphan self-defense: a worker whose agent died must NOT keep training
+    # forever against an abandoned workdir (observed: runaway workers from a
+    # killed harness burning the host for hours and poisoning every
+    # subsequent measurement). getppid flips when the parent dies (reparent
+    # to init/subreaper, vs the entry-time baseline); one syscall per step
+    # is free.
     step = start_step
     while step < total_steps:
+        if os.getppid() != parent_pid:
+            log.warning("gen %d: agent (parent) died; worker exiting at "
+                        "step %d", generation, step)
+            return 4
         # Quiesce consensus at the step boundary. Multi-process workers may
         # only act on the *agreed* flag (acting on the local flag alone would
         # leave peers hanging in the next collective).
@@ -350,9 +422,10 @@ def run_worker(env: Dict[str, str]) -> int:
                                np.float64)
                 )).reshape(world, 2)
                 want_quiesce = bool(flags[:, 0].sum() > 0)
+                agreed_dt = float(flags[:, 1].max())
                 if sync_every <= 0:
                     next_sync = step + consensus_interval(
-                        sync_target_s, float(flags[:, 1].max()))
+                        sync_target_s, agreed_dt)
             else:
                 want_quiesce = False
         if want_quiesce:
@@ -379,7 +452,21 @@ def run_worker(env: Dict[str, str]) -> int:
                           rank=rank, step=step, step_time_s=round(dt, 3))
             first_step_emitted = True
 
-        if ckpt_interval > 0 and step % ckpt_interval == 0 and step < total_steps:
+        if ckpt_interval > 0:
+            save_due = step % ckpt_interval == 0
+        else:
+            # Auto cadence: next_ckpt advances only at a save, computed
+            # from values every rank shares (same agreed_dt from the same
+            # consensus allgather, same step) — so save_due is identical
+            # across ranks without any extra collective. Single-process
+            # runs substitute the local EMA (nothing to agree with).
+            if world == 1:
+                agreed_dt = ema_dt
+            save_due = step >= next_ckpt
+            if save_due:
+                next_ckpt = step + consensus_interval(
+                    ckpt_target_s, agreed_dt, max_interval=100_000)
+        if save_due and step < total_steps:
             ps_save(step)
             ckpt.save(step, state, metadata=_data_meta())
         # Complete any deferred multi-process commit once every rank's chunk

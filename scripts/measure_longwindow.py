@@ -73,13 +73,20 @@ def run_window(window_s, event_every, cache_dir):
     cfg = {
         "model": "mlp",
         "model_kwargs": {"input_shape": [8, 8, 1], "features": [32, 32]},
-        "global_batch": 64, "total_steps": 10_000_000, "ckpt_interval": 50,
+        "global_batch": 64, "total_steps": 10_000_000,
+        # Auto cadence: bound work-at-risk by wall clock (~2s) instead of a
+        # fixed step count — with the switch itself fast, replayed steps
+        # between the last save and the kill are the avoidable loss.
+        "ckpt_interval": "auto", "ckpt_target_s": 2.0,
         "lr": 0.01, "seed": 0,
     }
     master = Master(job_name="lw", workdir=wd, desired_workers=2,
                     min_workers=1, heartbeat_timeout=1.5,
                     worker_config=cfg).start()
-    agents = [Agent(f"a{i}", master.address, wd, slots=2).start()
+    # warm_start: the production recovery posture (the preemption scenario
+    # measures with it; the long window should exercise the same machinery)
+    agents = [Agent(f"a{i}", master.address, wd, slots=2,
+                    warm_start=True).start()
               for i in range(2)]
     events = 0
     try:

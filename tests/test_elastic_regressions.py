@@ -19,7 +19,10 @@ ports = itertools.count(9500)
 
 
 def test_member_death_mid_planned_drain_escalates_to_kill():
-    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports))
+    # prepare disabled: this test drives the direct-drain path (still the
+    # fallback when preflight is off/expired)
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=0.0)
     for a in ("a0", "a1"):
         rdv.register(a, "h", 2)
     for a in ("a0", "a1"):
@@ -219,16 +222,21 @@ def test_join_rank_processes_fail_fast_and_drain():
 
     from easydl_tpu.utils.env import join_rank_processes
 
+    # Neutralise the image's sitecustomize (it imports jax against the TPU
+    # tunnel, costing ~8s of interpreter startup per child when the tunnel
+    # is half-dead) — this test times the JOIN mechanics, not python boot.
+    child_env = dict(os.environ, PALLAS_AXON_POOL_IPS="")
+
     # rank 0 blocks "in a collective"; rank 1 crashes fast with stderr
     procs = [
         subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"],
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         text=True),
+                         text=True, env=child_env),
         subprocess.Popen([sys.executable, "-c",
                           "import sys; sys.stderr.write('root cause here'); "
                           "sys.exit(3)"],
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         text=True),
+                         text=True, env=child_env),
     ]
     t0 = time.monotonic()
     results = join_rank_processes(procs, timeout=30, poll_s=0.05)
@@ -240,7 +248,8 @@ def test_join_rank_processes_fail_fast_and_drain():
     # > pipe-buffer output drains without deadlock
     big = subprocess.Popen(
         [sys.executable, "-c", "import sys; sys.stdout.write('x' * 300000)"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=child_env)
     (rc, out, err), = join_rank_processes([big], timeout=30)
     assert rc == 0 and len(out) == 300000
 

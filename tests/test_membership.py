@@ -8,8 +8,14 @@ from easydl_tpu.elastic.membership import AgentState, JobPhase, Rendezvous
 ports = itertools.count(9000)
 
 
-def mk(desired=2, **kw):
-    return Rendezvous(desired_workers=desired, port_alloc=lambda: next(ports), **kw)
+def mk(desired=2, prepare=0.0, standing=False, **kw):
+    """Legacy-path rendezvous by default (prepare_timeout_s=0 disables the
+    preflight machinery — still the fallback when preflights crash or time
+    out, so it stays under test); pass ``prepare>0`` for preflight tests
+    and ``standing=True`` for the steady-state armed variant."""
+    return Rendezvous(desired_workers=desired, port_alloc=lambda: next(ports),
+                      prepare_timeout_s=prepare, prepare_min_uptime_s=0.0,
+                      standing_preflight=standing, **kw)
 
 
 def start_gen(rdv, agents):
@@ -139,3 +145,152 @@ def test_generation_run_directive_idempotent():
     assert rdv.directive_for("a0").kind == "noop"
     status = rdv.status()
     assert status["phase"] == "stable" and len(status["members"]) == 2
+
+
+# ------------------------------------------------------------- preflight FSM
+
+
+def start_stable(rdv, agents):
+    """Form one generation containing ALL of ``agents`` (the rendezvous
+    must be built with min_workers=len(agents) so registration can't form
+    a smaller world first), walk them to RUNNING, and settle — the
+    standing preflight (if enabled) arms on the settling tick."""
+    gen = start_gen(rdv, agents)
+    assert set(rdv.members) == set(agents)
+    rdv.tick()
+    return gen
+
+
+def test_planned_reshape_preflights_then_drains():
+    """Planned path: PREPARING announces the tentative next generation; the
+    drain waits for every target member's prepared report; the formed
+    generation adopts the preflighted coordinator."""
+    rdv = mk(desired=2, prepare=60.0, min_workers=2)
+    gen = start_stable(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)
+    rdv.set_desired_workers(3)
+    assert rdv.phase == JobPhase.PREPARING
+    prep = rdv.prepare
+    assert prep is not None and prep.generation == gen + 1
+    assert prep.members == ("a0", "a1", "a2")
+    # members keep training: noop with the prepare hint piggybacked
+    d = rdv.heartbeat("a0", gen, "running")
+    assert d.kind == "noop" and d.prepare_coordinator == prep.coordinator
+    assert d.prepare_hosts == prep.members and d.prepare_world == 3
+    # nothing drains until everyone is ready
+    rdv.heartbeat("a0", gen, "running", prepared=prep.coordinator)
+    rdv.heartbeat("a1", gen, "running", prepared=prep.coordinator)
+    assert rdv.phase == JobPhase.PREPARING
+    rdv.heartbeat("a2", -1, "idle", prepared=prep.coordinator)
+    assert rdv.phase == JobPhase.DRAINING
+    # graceful quiesce of the old generation; hint still attached
+    d = rdv.directive_for("a0")
+    assert d.kind == "quiesce" and d.prepare_coordinator == prep.coordinator
+    rdv.heartbeat("a0", gen, "quiesced", prepared=prep.coordinator)
+    rdv.heartbeat("a1", gen, "quiesced", prepared=prep.coordinator)
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == gen + 1
+    d = rdv.directive_for("a2")
+    assert d.kind == "run" and d.world_size == 3
+    assert d.coordinator == prep.coordinator  # preflight group adopted
+    assert rdv.prepare is None
+
+
+def test_prepare_window_timeout_falls_back_to_fresh_coordinator():
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=5.0, prepare_min_uptime_s=0.0,
+                     clock=lambda: clock["t"], min_workers=2)
+    gen = start_gen(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)
+    rdv.set_desired_workers(3)
+    assert rdv.phase == JobPhase.PREPARING
+    prep_coord = rdv.prepare.coordinator
+    clock["t"] = 10.0  # window expires; nobody reported prepared
+    rdv.tick()
+    assert rdv.phase == JobPhase.DRAINING
+    for a in ("a0", "a1"):
+        if rdv.directive_for(a).kind == "quiesce":
+            rdv.heartbeat(a, gen, "quiesced")
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == gen + 1
+    d = rdv.directive_for(rdv.members[0])
+    assert d.kind == "run" and d.coordinator != prep_coord
+
+
+def test_prepare_aborts_when_member_dies():
+    rdv = mk(desired=2, prepare=60.0, min_workers=2)
+    gen = start_stable(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)
+    rdv.set_desired_workers(3)
+    assert rdv.phase == JobPhase.PREPARING
+    prep_coord = rdv.prepare.coordinator
+    # a1's worker crashes mid-prepare: unplanned escalation, preflight dropped
+    rdv.heartbeat("a1", gen, "idle")
+    assert rdv.phase == JobPhase.DRAINING
+    assert rdv.prepare is None
+    assert rdv.directive_for("a0").kind == "kill"
+    rdv.heartbeat("a0", gen, "idle")
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == gen + 1
+    d = rdv.directive_for(rdv.members[0])
+    assert d.coordinator != prep_coord
+
+
+def test_prepare_retargets_when_plan_changes_again():
+    rdv = mk(desired=2, prepare=60.0, min_workers=2)
+    start_stable(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)
+    rdv.register("a3", "h3", 2)
+    rdv.set_desired_workers(3)
+    assert rdv.phase == JobPhase.PREPARING
+    first = rdv.prepare
+    rdv.set_desired_workers(4)
+    rdv.tick()
+    assert rdv.phase == JobPhase.PREPARING
+    assert rdv.prepare is not None
+    assert rdv.prepare.members == ("a0", "a1", "a2", "a3")
+    assert rdv.prepare.coordinator != first.coordinator
+
+
+def test_standing_preflight_adopted_on_unplanned_loss():
+    """The unplanned path's fast lane (opt-in): in steady state the next
+    generation is pre-formed; a worker crash adopts it wholesale — same
+    members, the already-joined coordinator."""
+    rdv = mk(desired=2, prepare=60.0, standing=True, min_workers=2)
+    gen = start_stable(rdv, ["a0", "a1"])
+    prep = rdv.prepare
+    assert prep is not None and prep.generation == gen + 1  # standing
+    assert prep.members == ("a0", "a1")
+    # steady-state noops carry the hint; agents report ready
+    d = rdv.heartbeat("a0", gen, "running", prepared=prep.coordinator)
+    assert d.kind == "noop" and d.prepare_coordinator == prep.coordinator
+    rdv.heartbeat("a1", gen, "running", prepared=prep.coordinator)
+    # a0's worker dies (agent alive): unplanned reshape
+    rdv.heartbeat("a0", gen, "idle", prepared=prep.coordinator)
+    assert rdv.phase == JobPhase.DRAINING
+    assert rdv.prepare is prep  # standing preflight KEPT for adoption
+    assert rdv.directive_for("a1").kind == "kill"
+    rdv.heartbeat("a1", gen, "idle", prepared=prep.coordinator)
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == gen + 1
+    for a in ("a0", "a1"):
+        d = rdv.directive_for(a)
+        assert d.kind == "run" and d.coordinator == prep.coordinator
+    # once both run at the new generation, the NEXT standing preflight arms
+    rdv.heartbeat("a0", gen + 1, "running")
+    rdv.heartbeat("a1", gen + 1, "running")
+    rdv.tick()
+    assert rdv.prepare is not None
+    assert rdv.prepare.generation == gen + 2
+    assert rdv.prepare.coordinator != prep.coordinator
+
+
+def test_standing_preflight_not_adopted_without_all_ready():
+    rdv = mk(desired=2, prepare=60.0, standing=True, min_workers=2)
+    gen = start_stable(rdv, ["a0", "a1"])
+    prep = rdv.prepare
+    # only a0 ever reports ready
+    rdv.heartbeat("a0", gen, "running", prepared=prep.coordinator)
+    rdv.heartbeat("a1", gen, "idle")  # crash, a1 never prepared
+    assert rdv.phase == JobPhase.DRAINING
+    rdv.heartbeat("a0", gen, "idle", prepared=prep.coordinator)
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == gen + 1
+    d = rdv.directive_for("a0")
+    assert d.kind == "run" and d.coordinator != prep.coordinator
