@@ -28,69 +28,20 @@ import os
 import sys
 import tempfile
 import time
-from collections import defaultdict
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
-def categorize(name: str) -> str:
-    n = name.lower()
-    if "flash" in n or "custom-call" in n or "custom_call" in n:
-        return "flash_attention_custom_call"
-    if "all-reduce" in n or "all-gather" in n or "reduce-scatter" in n \
-            or "collective" in n or "ppermute" in n or "all-to-all" in n:
-        return "collectives"
-    if n.startswith(("dot", "convolution")) or "gemm" in n or "einsum" in n:
-        return "matmul"
-    if "fusion" in n:
-        # XLA fuses elementwise chains into the producing/consuming op;
-        # matmul-rooted fusions usually keep 'dot' in the name
-        return "matmul_fusion" if "dot" in n else "other_fusion"
-    if "infeed" in n or "outfeed" in n or "copy" in n or "transpose" in n:
-        return "data_movement"
-    if "scan" in n or "while" in n:
-        return "control_flow"
-    return "other"
-
-
-def parse_trace(logdir: str):
+def load_trace(logdir: str) -> dict:
     paths = glob.glob(os.path.join(
         logdir, "plugins", "profile", "*", "*.trace.json.gz"))
     if not paths:
         raise FileNotFoundError(f"no trace under {logdir}")
     path = max(paths, key=os.path.getmtime)
     with gzip.open(path, "rt") as f:
-        doc = json.load(f)
-    events = doc.get("traceEvents", [])
-    # Find the TPU device lanes: process names like '/device:TPU:0' or
-    # 'TPU:0'; XLA op events live on threads under those pids.
-    device_pids = set()
-    pid_names = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            label = e.get("args", {}).get("name", "")
-            pid_names[e.get("pid")] = label
-            if "TPU" in label.upper() or "/device" in label.lower():
-                device_pids.add(e.get("pid"))
-    if not device_pids:  # CPU fallback: everything is one lane
-        device_pids = set(pid_names)
-    per_op = defaultdict(float)
-    lane_busy = defaultdict(float)  # (pid, tid) -> busy us
-    lane_span = {}
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
-            continue
-        dur = float(e.get("dur", 0.0))
-        name = e.get("name", "?")
-        per_op[name] += dur
-        key = (e["pid"], e.get("tid"))
-        lane_busy[key] += dur
-        t0, t1 = float(e.get("ts", 0.0)), float(e.get("ts", 0.0)) + dur
-        lo, hi = lane_span.get(key, (t0, t1))
-        lane_span[key] = (min(lo, t0), max(hi, t1))
-    return per_op, lane_busy, lane_span, pid_names
+        return json.load(f)
 
 
 def main() -> None:
@@ -143,39 +94,31 @@ def main() -> None:
         float(jax.device_get(metrics["loss"]))
     wall = time.perf_counter() - t0
 
-    per_op, lane_busy, lane_span, pid_names = parse_trace(logdir)
-    cats = defaultdict(float)
-    for name, dur in per_op.items():
-        cats[categorize(name)] += dur
-    total_op_us = sum(per_op.values())
-    busiest = max(lane_busy.items(), key=lambda kv: kv[1]) if lane_busy else None
-    span_us = 0.0
-    if busiest:
-        lo, hi = lane_span[busiest[0]]
-        span_us = hi - lo
-    top = sorted(per_op.items(), key=lambda kv: -kv[1])[: args.top]
+    from easydl_tpu.utils.profiling import attribute_trace
+
+    attribution = attribute_trace(load_trace(logdir), top=args.top)
+    busy_us = attribution.get("lane_busy_us", 0.0)
     report = {
         "config": f"gpt-{size} seq{seq_len} b{global_batch}/a{grad_accum} "
                   f"({platform}, {n_chips} chip)",
         "profiled_steps": args.steps,
         "wall_s": round(wall, 3),
         "wall_per_step_s": round(wall / args.steps, 4),
-        "device_op_time_per_step_s": round(total_op_us / 1e6 / args.steps, 4),
-        "busiest_lane_busy_per_step_s": (
-            round(busiest[1] / 1e6 / args.steps, 4) if busiest else None),
-        "busiest_lane_span_per_step_s": round(span_us / 1e6 / args.steps, 4),
-        "busiest_lane_gap_pct": (
-            round(100 * (1 - busiest[1] / span_us), 2)
-            if busiest and span_us else None),
+        # The busiest device lane's covered time is the honest per-step
+        # device cost (trace collection inflates WALL time ~4x over the
+        # tunnel; the lane union does not lie — see PARITY determinism
+        # notes). Categories are SELF times on that lane and sum to it by
+        # construction; the invariants block would flag any regression.
+        "device_busy_per_step_s": round(busy_us / 1e6 / args.steps, 4),
         "category_us_per_step": {
             k: round(v / args.steps, 1)
-            for k, v in sorted(cats.items(), key=lambda kv: -kv[1])
+            for k, v in attribution.get("category_self_us", {}).items()
         },
         "top_ops_us_per_step": [
-            {"op": name[:120], "us": round(dur / args.steps, 1),
-             "pct_of_op_time": round(100 * dur / total_op_us, 2)}
-            for name, dur in top
+            {**o, "us": round(o["us"] / args.steps, 1)}
+            for o in attribution.get("top_ops_self_us", [])
         ],
+        "attribution": attribution,
         "trace_logdir": logdir,
     }
     with open(args.out, "w") as f:

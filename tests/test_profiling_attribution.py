@@ -1,0 +1,124 @@
+"""Trace attribution (utils/profiling.attribute_trace) against synthetic
+Chrome traces — the round-4 PROFILE.json was internally incoherent because
+the parser was only ever exercised on real traces it misread: umbrella
+events double-counted (device_op_time > wall), while-bodies opaque (flash
+kernels attributed ~0), and nested durations summed into a 'busy' that
+exceeded the lane span (gap −184%). These tests pin the failure modes."""
+
+from __future__ import annotations
+
+from easydl_tpu.utils.profiling import (_self_times, _union_us,
+                                        attribute_trace, categorize_op)
+
+
+def ev(pid, tid, name, ts, dur, args=None):
+    e = {"ph": "X", "pid": pid, "tid": tid, "name": name, "ts": ts,
+         "dur": dur}
+    if args:
+        e["args"] = args
+    return e
+
+
+def meta(pid, name, tid=None, thread=None):
+    if tid is None:
+        return {"ph": "M", "pid": pid, "name": "process_name",
+                "args": {"name": name}}
+    return {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": thread}}
+
+
+def device_doc(events):
+    return {"traceEvents": [
+        meta(1, "/device:TPU:0"),
+        meta(1, None, tid=10, thread="XLA Ops"),
+        meta(1, None, tid=11, thread="Steps"),
+        *events,
+    ]}
+
+
+def test_self_time_subtracts_nested_children():
+    # while [0, 100) containing two fusions [10,40) and [50,90):
+    # while self = 100 - 30 - 40 = 30
+    selfs = dict(
+        (n, s) for n, s, _, _ in _self_times([
+            {"name": "while.1", "ts": 0.0, "dur": 100.0, "args": None},
+            {"name": "fusion.1", "ts": 10.0, "dur": 30.0, "args": None},
+            {"name": "fusion.2", "ts": 50.0, "dur": 40.0, "args": None},
+        ])
+    )
+    assert selfs["while.1"] == 30.0
+    assert selfs["fusion.1"] == 30.0 and selfs["fusion.2"] == 40.0
+
+
+def test_union_does_not_double_count_nesting():
+    assert _union_us([
+        {"name": "a", "ts": 0.0, "dur": 100.0},
+        {"name": "b", "ts": 10.0, "dur": 30.0},
+        {"name": "c", "ts": 150.0, "dur": 50.0},
+    ]) == 150.0
+
+
+def test_attribution_invariants_with_umbrella_and_while():
+    """The r4 trace shape in miniature: a jit umbrella spanning everything,
+    a while loop with the real kernels inside, a bare 'Steps' lane row that
+    must not be the lane picked."""
+    doc = device_doc([
+        # Steps lane: one umbrella row spanning everything (double-count bait)
+        ev(1, 11, "jit_train_step", 0.0, 1000.0),
+        # Ops lane: jit wrapper -> while -> kernels
+        ev(1, 10, "jit_train_step", 0.0, 1000.0),
+        ev(1, 10, "while.2", 50.0, 900.0),
+        ev(1, 10, "custom-call.flash_fwd", 100.0, 300.0),
+        ev(1, 10, "fusion.dot.3", 450.0, 200.0),
+        ev(1, 10, "fusion.dynamic-update-slice.4", 700.0, 100.0),
+    ])
+    rep = attribute_trace(doc)
+    cats = rep["category_self_us"]
+    # the kernels inside the while ARE visible (the r4 bug: ~0)
+    assert cats["flash_attention"] == 300.0
+    assert cats["matmul_fusion"] == 200.0
+    assert cats["dus_carry"] == 100.0
+    # while self-time (900 - 600) is control flow, not hidden
+    assert cats["control_flow"] == 300.0
+    # jit umbrella self-time is named as unattributed, never op work
+    assert cats["unattributed_parent"] == 100.0
+    # invariants hold: categories sum == busy, gap in range
+    inv = rep["invariants"]
+    assert inv["categories_cover_busy"], rep
+    assert inv["gap_pct_in_range"], rep
+    assert rep["lane_busy_us"] == 1000.0
+    assert 0.0 <= rep["lane_gap_pct"] <= 100.0
+
+
+def test_ops_lane_preferred_over_busier_umbrella_lane():
+    doc = device_doc([
+        ev(1, 11, "jit_train_step", 0.0, 5000.0),  # Steps lane, "busier"
+        ev(1, 10, "fusion.dot.1", 0.0, 400.0),
+        ev(1, 10, "custom-call.9", 500.0, 100.0),
+    ])
+    rep = attribute_trace(doc)
+    assert "XLA Ops" in rep["lane"]
+    assert rep["lane_busy_us"] == 500.0
+    assert rep["category_self_us"]["matmul_fusion"] == 400.0
+    assert rep["category_self_us"]["custom_call"] == 100.0
+    # gap: span 600, busy 500
+    assert abs(rep["lane_gap_pct"] - 100.0 * (1 - 500.0 / 600.0)) < 0.1
+
+
+def test_hlo_category_arg_wins_over_name():
+    assert categorize_op("fusion.77", {"hlo_category": "convolution"}) \
+        == "matmul"
+    assert categorize_op("weird.op", {"category": "all-reduce"}) \
+        == "collectives"
+    assert categorize_op("fusion.reduce.5", None) == "reduction_fusion"
+
+
+def test_flat_trace_without_metadata_still_attributes():
+    doc = {"traceEvents": [
+        ev(7, 1, "fusion.dot.1", 0.0, 10.0),
+        ev(7, 1, "copy.2", 20.0, 5.0),
+    ]}
+    rep = attribute_trace(doc)
+    assert rep["category_self_us"]["matmul_fusion"] == 10.0
+    assert rep["category_self_us"]["data_movement"] == 5.0
+    assert rep["invariants"]["categories_cover_busy"]
