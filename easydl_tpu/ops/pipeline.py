@@ -26,6 +26,25 @@ Scope (v1): stage-local weights are unsharded inside the pipeline (no
 tp/fsdp of a stage's own matrices — :func:`pipeline_rules` maps the weight
 axes to None); dropout-free paths; dense FFNs (no MoE inside the
 pipeline).
+
+On 1F1B (why there is no ``schedule="1f1b"`` flag): under jax autodiff
+the user writes only the FORWARD schedule; the backward is the transpose
+XLA derives — for this scan-over-ticks + ppermute formulation that
+transpose is itself a reverse-order pipeline, i.e. the backward is
+already pipelined. Non-interleaved 1F1B has the SAME bubble fraction as
+GPipe, ``(pp-1)/(m+pp-1)`` (see :func:`bubble_fraction`); what it buys in
+a hand-scheduled framework is peak activation memory O(pp) instead of
+O(m), and here ``jax.checkpoint`` around the stage apply already bounds
+the stored state to the per-tick boundary activations. The variant that
+genuinely cuts the bubble — the circular/interleaved schedule (v chunks
+per rank, bubble ``(pp-1)/(v·m+pp-1)``) — needs chunk c resident on rank
+``c mod pp``, i.e. a STRIDED layer placement; with the stacked
+``[n_layers, ...]`` parameter layout this round's checkpoints use, that
+means either relaying out saved states or an every-step weight all-to-all
+inside the pipeline. Deliberately deferred rather than shipped as a flag
+whose measured effect would be nil (the honest lever exposed instead:
+raise ``microbatches`` — the bubble amortizes as 1/m, and the parity
+tests hold at any m).
 """
 
 from __future__ import annotations
@@ -38,6 +57,21 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from easydl_tpu.ops._compat import shard_map
+
+
+def pipeline_ticks(microbatches: int, pp: int) -> int:
+    """Static trip count of the schedule's scan: ``m`` work ticks plus the
+    ``pp-1`` fill/drain ticks (the GPipe bubble)."""
+    return microbatches + pp - 1
+
+
+def bubble_fraction(microbatches: int, pp: int) -> float:
+    """Idle fraction of the fill–drain schedule: ``(pp-1)/(m+pp-1)``.
+
+    The knob that shrinks it is ``microbatches`` (1/m amortization); a
+    non-interleaved 1F1B reordering would NOT change this number (see the
+    module docstring)."""
+    return (pp - 1) / pipeline_ticks(microbatches, pp)
 
 
 def pipeline_rules(base) -> tuple:

@@ -97,9 +97,15 @@ class StepProfiler:
 def categorize_op(name: str, args: Optional[dict] = None) -> str:
     """Category for one DEVICE op event.
 
-    Prefers the profiler's own hlo category when the event carries one;
-    falls back to name heuristics. Umbrella/jit wrappers are the caller's
-    job to exclude (they are not leaf ops)."""
+    The specific name signal wins over the profiler's generic hlo
+    category: flash-attention kernels ARE custom calls and the profiler
+    tags them so — letting a 'custom' category preempt the name check
+    would re-create the r4 symptom (flash attributed ~0, lumped into
+    custom_call). Generic categories then refine whatever the name
+    doesn't pin down."""
+    n = name.lower()
+    if "flash" in n:
+        return "flash_attention"
     if args:
         for key in ("hlo_category", "category"):
             cat = str(args.get(key, "")).lower()
@@ -111,9 +117,6 @@ def categorize_op(name: str, args: Optional[dict] = None) -> str:
                 if "all-reduce" in cat or "all-gather" in cat \
                         or "collective" in cat or "reduce-scatter" in cat:
                     return "collectives"
-    n = name.lower()
-    if "flash" in n:
-        return "flash_attention"
     if "custom-call" in n or "custom_call" in n:
         return "custom_call"
     if ("all-reduce" in n or "all-gather" in n or "reduce-scatter" in n

@@ -121,8 +121,19 @@ def main() -> None:
         "attribution": attribution,
         "trace_logdir": logdir,
     }
+    # Merge, don't clobber: other sections of the same file (pipeline
+    # numbers, superseded-history notes) belong to other writers — update
+    # the loaded document with this report's keys, preserving the rest.
+    doc = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+    doc.update(report)
     with open(args.out, "w") as f:
-        json.dump(report, f, indent=2)
+        json.dump(doc, f, indent=2)
         f.write("\n")
     print(json.dumps(report, indent=2))
 

@@ -122,3 +122,12 @@ def test_flat_trace_without_metadata_still_attributes():
     assert rep["category_self_us"]["matmul_fusion"] == 10.0
     assert rep["category_self_us"]["data_movement"] == 5.0
     assert rep["invariants"]["categories_cover_busy"]
+
+
+def test_flash_name_wins_over_generic_custom_category():
+    """Flash kernels ARE custom calls and real TPU traces tag them so; the
+    name signal must win or flash reads ~0 again (the r4 symptom)."""
+    assert categorize_op("custom-call.flash_fwd",
+                         {"hlo_category": "custom-call"}) == "flash_attention"
+    assert categorize_op("fusion.flash_bwd.3",
+                         {"category": "custom"}) == "flash_attention"
