@@ -14,12 +14,15 @@ the dominant term instead of guessing.
 Scenarios:
 1. preemption: SIGKILL one of two workers (no notice) mid-run; measure
    kill -> first-post-restore-step wall time and steps of work lost.
-2. scale-up (x3 variants): apply a plan doubling the worker count mid-run;
+2. scale-up (x4 variants): apply a plan doubling the worker count mid-run;
    measure the generation-switch stall and throughput loss over the
    transition window vs a static-world extrapolation:
      a. cold compile cache, cold worker start;
      b. warm compile cache, cold worker start;
-     c. warm compile cache + warm standby workers (jax pre-imported).
+     c. warm compile cache + warm standby workers (jax pre-imported);
+     d. preflight: the next generation dist-joins, builds, and compiles
+        WHILE generation 1 trains; the switch itself only pays
+        quiesce + promote + restore + an already-compiled step.
 
 Usage: python scripts/measure_recovery.py [--out RECOVERY.json]
 Must run where jax can use a CPU platform; spawns its own subprocess with
@@ -70,15 +73,26 @@ def _phase_chain(recs, chain, t0):
     """
     out = {}
     prev = t0
+    inversions = []
     for label, phase, gen, pick in chain:
         ts = [r["t"] for r in recs if r["phase"] == phase and r["gen"] == gen]
         if not ts:
             out[label] = None
             continue
         t = pick(ts)
-        out[label] = round(t - prev, 2)
-        prev = t
+        delta = t - prev
+        if delta < 0:
+            # Adjacent boundaries are per-event maxima across hosts whose
+            # events are not globally ordered; a small inversion is clock/
+            # ordering noise. Clamp to 0 and SAY so — a negative phase bar
+            # in the artifact would be incoherent (the r4 lesson).
+            inversions.append({label: round(delta, 3)})
+            delta = 0.0
+        out[label] = round(delta, 2)
+        prev = max(prev, t)
     out["total_s"] = round(prev - t0, 2)
+    if inversions:
+        out["clamped_inversions"] = inversions
     return out
 
 
@@ -86,6 +100,50 @@ def decompose_switch(workdir: str, gen_from: int, gen_to: int, t0: float):
     from easydl_tpu.elastic import timeline
 
     recs = timeline.read_all(workdir)
+    modes = sorted(
+        {r.get("mode", "?") for r in recs
+         if r["phase"] == "spawn" and r["gen"] == gen_to}
+    )
+    if modes == ["preflight"]:
+        # ALL promotions were preflight: the overlapped decomposition is
+        # well-defined. A mixed preflight/cold switch (a crashed preflight
+        # fell back to cold) uses the standard chain — the cold rank's
+        # post-gate build is the real critical path there.
+        # Preflighted switch: the new generation's process start, imports,
+        # dist init, trainer build AND step compile all happened while the
+        # old generation was still training (between the plan and the
+        # drain-gate release). Folding those events into a post-quiesce
+        # chain would produce negative phases — decompose them as the
+        # OVERLAPPED window instead, and time the switch itself from the
+        # moment the last preflight reported ready (when the master
+        # released the drain).
+        ready_ts = [r["t"] for r in recs
+                    if r["phase"] == "preflight_ready" and r["gen"] == gen_to]
+        gate_open = max(ready_ts) if ready_ts else t0
+        chain = [
+            ("quiesce_signal_s",        "quiesce_sent",       gen_from, max),
+            ("drain_to_step_boundary_s", "quiesce_ckpt_begin", gen_from, max),
+            ("drain_checkpoint_s",      "quiesce_exit",       gen_from, max),
+            ("exit_detect_s",           "worker_exit",        gen_from, max),
+            ("promote_s",               "spawn",              gen_to,   max),
+            ("preflight_go_s",          "preflight_go",       gen_to,   max),
+            ("restore_agree_s",         "restore_agreed",     gen_to,   max),
+            ("restore_read_s",          "restored",           gen_to,   max),
+            ("first_step_s",            "first_step_done",    gen_to,   max),
+        ]
+        phases = _phase_chain(recs, chain, gate_open)
+        phases["prepare_overlap_s"] = round(gate_open - t0, 2)
+        overlapped = _phase_chain(recs, [
+            ("process_start_s",   "worker_main_start", gen_to, max),
+            ("runtime_imports_s", "jax_imported",      gen_to, max),
+            ("dist_init_s",       "dist_init_done",    gen_to, max),
+            ("trainer_build_s",   "trainer_built",     gen_to, max),
+            ("step_compile_s",    "preflight_ready",   gen_to, max),
+        ], t0)
+        overlapped.pop("total_s", None)
+        phases["overlapped_during_training"] = overlapped
+        phases["spawn_modes"] = modes
+        return phases
     chain = [
         ("quiesce_signal_s",        "quiesce_sent",       gen_from, max),
         ("drain_to_step_boundary_s", "quiesce_ckpt_begin", gen_from, max),
@@ -101,10 +159,6 @@ def decompose_switch(workdir: str, gen_from: int, gen_to: int, t0: float):
         ("first_step_compile_s",    "first_step_done",    gen_to,   max),
     ]
     phases = _phase_chain(recs, chain, t0)
-    modes = sorted(
-        {r.get("mode", "?") for r in recs
-         if r["phase"] == "spawn" and r["gen"] == gen_to}
-    )
     phases["spawn_modes"] = modes
     return phases
 
@@ -178,7 +232,8 @@ def preemption_scenario(warm_start: bool) -> dict:
         master.stop()
 
 
-def scale_up_scenario(cache_dir: str, warm_start: bool) -> dict:
+def scale_up_scenario(cache_dir: str, warm_start: bool,
+                      preflight: bool = False) -> dict:
     from easydl_tpu.api import ResourcePlan, RolePlan
     from easydl_tpu.elastic.agent import Agent
     from easydl_tpu.elastic.master import Master
@@ -193,8 +248,13 @@ def scale_up_scenario(cache_dir: str, warm_start: bool) -> dict:
         "global_batch": 64, "total_steps": 4000, "ckpt_interval": 100,
         "sync_every": 5, "lr": 0.01, "seed": 0,
     }
+    # preflight=True removes the uptime gate so the plan (applied shortly
+    # after steady state) triggers the PREPARING path: the next generation
+    # dist-joins and compiles while generation 1 keeps training.
     master = Master(job_name="scaleup", workdir=wd, desired_workers=2,
-                    min_workers=2, worker_config=cfg).start()
+                    min_workers=2, worker_config=cfg,
+                    prepare_timeout_s=240.0 if preflight else 0.0,
+                    prepare_min_uptime_s=0.0).start()
     agents = [
         Agent(f"a{i}", master.address, wd, slots=1,
               warm_start=warm_start).start()
@@ -250,9 +310,12 @@ def scale_up_scenario(cache_dir: str, warm_start: bool) -> dict:
         t_last_g1 = max(r["t"] for r in g1)
         t_first_g2 = min(r["t"] for r in g2)
         switch_s = t_first_g2 - t_last_g1
-        # Throughput-loss over the transition window [t_plan, t_plan + W]:
-        # achieved global samples vs a static-world extrapolation.
-        W = max(15.0, 2 * switch_s)
+        # Throughput-loss over the whole transition [t_plan .. first new-
+        # generation step + tail]: covers the prepare window (preflighted
+        # switches keep training through it — any compile contention shows
+        # up here honestly) AND the switch stall itself, vs a static-world
+        # extrapolation.
+        W = (t_first_g2 - t_plan) + 15.0
         ranks_per_step = {}
         for r in merged:
             if t_plan <= r["t"] <= t_plan + W:
@@ -270,6 +333,7 @@ def scale_up_scenario(cache_dir: str, warm_start: bool) -> dict:
         return {
             "scenario": "scale-up 2->4 workers mid-run (proxy for 8->32 chips)",
             "warm_standby": warm_start,
+            "preflight": preflight,
             "generation_switch_s": round(switch_s, 2),
             "throughput_before_samples_per_s": round(tput_before, 1),
             "throughput_after_samples_per_s": round(tput_after, 1),
@@ -323,6 +387,8 @@ def main() -> None:
     scale_cold = scale_up_scenario(cache_dir, warm_start=False)
     scale_warm_cache = scale_up_scenario(cache_dir, warm_start=False)
     scale_warm_full = scale_up_scenario(cache_dir, warm_start=True)
+    scale_preflight = scale_up_scenario(cache_dir, warm_start=False,
+                                        preflight=True)
     result = {
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "platform": "simulated-distributed CPU mesh (jax.distributed worker "
@@ -337,6 +403,7 @@ def main() -> None:
         "scale_up_cold_cache": scale_cold,
         "scale_up_warm_cache": scale_warm_cache,
         "scale_up_warm_cache_warm_standby": scale_warm_full,
+        "scale_up_preflight": scale_preflight,
     }
     # Merge, don't clobber: other measurement scripts (measure_longwindow)
     # own their own top-level sections of the same file.

@@ -28,12 +28,33 @@ import tempfile  # noqa: E402
 _cache_root = tempfile.mkdtemp(prefix="easydl-test-chunk-cache-")
 os.environ.setdefault("EASYDL_CHUNK_CACHE", _cache_root)
 
+# One persistent compile cache for the WHOLE suite — the in-process tests
+# AND every worker subprocess they spawn (workers read EASYDL_COMPILE_CACHE;
+# easydl_tpu/elastic/worker.py) — kept across runs: the suite's wall time
+# is dominated by shard_map/jit compiles that are identical run-to-run, and
+# CI's doubled determinism run was paying them twice. Override with
+# EASYDL_TEST_JAX_CACHE (e.g. a CI cache mount); "off" disables.
+_cache_cfg = os.environ.get("EASYDL_TEST_JAX_CACHE", "")
+if _cache_cfg.lower() != "off":
+    _jax_cache = _cache_cfg or os.path.join(
+        tempfile.gettempdir(), "easydl-test-jax-cache"
+    )
+    os.makedirs(_jax_cache, exist_ok=True)
+    os.environ.setdefault("EASYDL_COMPILE_CACHE", _jax_cache)
+
 # The image's sitecustomize registers the axon TPU plugin and pins
 # jax_platforms="axon,cpu" via jax.config — env vars alone don't win. Re-pin
 # to cpu before any backend initialises.
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+if _cache_cfg.lower() != "off":
+    try:
+        jax.config.update("jax_compilation_cache_dir", _jax_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # older jax: cache is best-effort
+        pass
 
 import pytest  # noqa: E402
 
