@@ -92,11 +92,14 @@ def _measure() -> dict:
         #       (remote_compile helper 500s). Flash blocks re-confirmed in
         #       the full model at this config: 512/512 39.88 > 1024/1024
         #       38.94 > 256/512 38.87 > 512/1024 38.29 — the default holds.
-        #   r4 attribution (scripts/bench_profile.py -> PROFILE.json, this
-        #       config): flash attention kernels ~30% of device time, the
-        #       accumulation scan carry's dynamic-update-slice fusions ~16%,
-        #       reduction fusions ~13% — the carry cost is the lever
-        #       TrainConfig.accum_unroll targets.
+        #   r4 attribution: RETRACTED — the parser those numbers came from
+        #       double-counted umbrella events and couldn't see through
+        #       while bodies (PROFILE.json r4_attribution_superseded). The
+        #       rewritten attribution (utils/profiling.attribute_trace,
+        #       invariant-checked) re-records on the next reachable-TPU
+        #       session; until then the only trusted per-op statement is
+        #       "unmeasured". accum_unroll stays a hypothesis, swept via
+        #       EASYDL_BENCH_ACCUM_UNROLL when the chip is back.
         size, seq_len, steps = "345m", 1024, 15
         grad_accum = 32
         global_batch = 256 * n_chips
