@@ -351,3 +351,83 @@ def test_preflight_scale_up_adopts_precompiled_generation(workdir):
         for a in agents:
             a.stop()
         master.stop()
+
+
+def test_preflight_crash_falls_back_to_plain_drain(workdir):
+    """Every preflight failure path must degrade to the ordinary switch:
+    here every preflight worker crashes on arrival (a compile-OOM stand-
+    in), agents remember the failed signature instead of crash-looping,
+    the prepare window expires, and the reshape completes through the
+    plain drain with cold/warm spawns."""
+    import sys as _sys
+
+    # Wrapper worker: dies immediately in preflight mode, real otherwise.
+    crasher = os.path.join(workdir, "crashy_worker.py")
+    with open(crasher, "w") as f:
+        f.write(
+            "import os, sys\n"
+            "if os.environ.get('EASYDL_GO_FILE'):\n"
+            "    sys.exit(9)\n"
+            "from easydl_tpu.elastic.worker import main\n"
+            "main()\n"
+        )
+    cfg = dict(JOB_CFG, total_steps=100_000, ckpt_interval=25, sync_every=5)
+    master = Master(
+        job_name="preflight-crash",
+        workdir=workdir,
+        desired_workers=1,
+        min_workers=1,
+        worker_config=cfg,
+        prepare_timeout_s=6.0,
+        prepare_min_uptime_s=0.0,
+    ).start()
+    agents = [
+        Agent(f"a{i}", master.address, workdir,
+              worker_argv=[_sys.executable, crasher], slots=2).start()
+        for i in range(2)
+    ]
+    try:
+        wait_for(
+            lambda: master.status()["members"]
+            and any(master.status()["agents"][m]["step"] >= 3
+                    for m in master.status()["members"]),
+            desc="member worker to reach step 3",
+        )
+        from easydl_tpu.api import ResourcePlan, RolePlan
+
+        master.apply_plan(ResourcePlan(
+            job_name="preflight-crash", version=1,
+            roles={"worker": RolePlan(replicas=2)},
+        ))
+        wait_for(lambda: master.status()["generation"] >= 2, timeout=180,
+                 desc="reshape to complete despite crashed preflights")
+        wait_for(
+            lambda: any(
+                r["generation"] >= 2
+                for r in read_metrics(workdir, "a0")
+                + read_metrics(workdir, "a1")
+            ),
+            timeout=120, desc="new generation training",
+        )
+        # The switch happened WITHOUT preflight promotion...
+        for aid in ("a0", "a1"):
+            with open(os.path.join(workdir, f"timeline-{aid}.jsonl")) as f:
+                modes = [
+                    json.loads(line).get("mode") for line in f
+                    if '"spawn"' in line
+                ]
+            assert "preflight" not in modes, modes
+        # ...and nobody crash-looped: the failed signature is remembered
+        # and the preflight for it was spawned once, not once per
+        # heartbeat. (Asserted on the agents' own counters — the crashing
+        # preflight never writes any on-disk marker to count.)
+        for a in agents:
+            assert a._preflight_failed_sig is not None
+            assert a._preflight_count <= 2, a._preflight_count
+        m = read_metrics(workdir, "a0") + read_metrics(workdir, "a1")
+        gen_new = [r for r in m if r["generation"] >= 2]
+        assert gen_new and all(r["world_size"] == 4 for r in gen_new)
+    finally:
+        for a in agents:
+            a.stop()
+        master.stop()
