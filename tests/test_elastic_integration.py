@@ -278,8 +278,6 @@ def test_preflight_scale_up_adopts_precompiled_generation(workdir):
     NEXT coordinator and compile; the drain waits for their readiness; and
     the switch promotes them (timeline spawn mode == "preflight") instead
     of cold-starting anything."""
-    import json as _json
-
     cfg = dict(JOB_CFG, total_steps=100_000, ckpt_interval=25, sync_every=5)
     master = Master(
         job_name="preflight-up",
@@ -319,14 +317,14 @@ def test_preflight_scale_up_adopts_precompiled_generation(workdir):
         )
         # Both agents promoted their PREFLIGHT workers — the dist-joined,
         # pre-compiled next generation — not warm/cold spawns.
+        from easydl_tpu.elastic import timeline
+
         for aid in ("a0", "a1"):
-            spawns = []
-            with open(os.path.join(workdir, f"timeline-{aid}.jsonl")) as f:
-                for line in f:
-                    rec = _json.loads(line)
-                    if (rec.get("phase") == "spawn"
-                            and rec.get("gen") == final_gen):
-                        spawns.append(rec)
+            spawns = [
+                r for r in timeline.read(
+                    os.path.join(workdir, f"timeline-{aid}.jsonl"))
+                if r.get("phase") == "spawn" and r.get("gen") == final_gen
+            ]
             assert spawns, f"no spawn event for {aid} at gen {final_gen}"
             assert spawns[-1]["mode"] == "preflight", spawns
         # Work continuity: the new generation resumed from the quiesce
@@ -410,12 +408,15 @@ def test_preflight_crash_falls_back_to_plain_drain(workdir):
             timeout=120, desc="new generation training",
         )
         # The switch happened WITHOUT preflight promotion...
+        from easydl_tpu.elastic import timeline
+
         for aid in ("a0", "a1"):
-            with open(os.path.join(workdir, f"timeline-{aid}.jsonl")) as f:
-                modes = [
-                    json.loads(line).get("mode") for line in f
-                    if '"spawn"' in line
-                ]
+            modes = [
+                r.get("mode")
+                for r in timeline.read(
+                    os.path.join(workdir, f"timeline-{aid}.jsonl"))
+                if r.get("phase") == "spawn"
+            ]
             assert "preflight" not in modes, modes
         # ...and nobody crash-looped: the failed signature is remembered
         # and the preflight for it was spawned once, not once per
@@ -427,6 +428,74 @@ def test_preflight_crash_falls_back_to_plain_drain(workdir):
         m = read_metrics(workdir, "a0") + read_metrics(workdir, "a1")
         gen_new = [r for r in m if r["generation"] >= 2]
         assert gen_new and all(r["world_size"] == 4 for r in gen_new)
+    finally:
+        for a in agents:
+            a.stop()
+        master.stop()
+
+
+def test_standing_preflight_adopts_on_unplanned_kill(workdir):
+    """Opt-in standing preflight, end to end: in steady state the master
+    keeps the next generation pre-formed (same members, fresh
+    coordinator); agents hold dist-joined, pre-compiled preflight workers
+    at the gate. A SIGKILL preemption must then promote THEM — timeline
+    spawn mode 'preflight' on the post-kill generation."""
+    cfg = dict(JOB_CFG, total_steps=100_000, ckpt_interval=10, sync_every=5)
+    master = Master(
+        job_name="standing",
+        workdir=workdir,
+        desired_workers=2,
+        min_workers=2,
+        heartbeat_timeout=2.0,
+        worker_config=cfg,
+        prepare_timeout_s=300.0,
+        prepare_min_uptime_s=0.0,
+        standing_preflight=True,
+    ).start()
+    agents = [
+        Agent(f"a{i}", master.address, workdir, slots=2).start()
+        for i in range(2)
+    ]
+    try:
+        # Steady state with the standing preflight armed AND ready: both
+        # agents must report the prepared coordinator before the kill.
+        def standing_ready():
+            st = master.status()
+            prep = st.get("prepare")
+            if not prep or st["phase"] != "stable":
+                return False
+            views = master.rendezvous.agents
+            return all(
+                views[m].prepared == prep["coordinator"]
+                for m in prep["members"]
+            )
+
+        wait_for(standing_ready, timeout=240,
+                 desc="standing preflight compiled and gated")
+        gen1 = master.status()["generation"]
+
+        agents[1].kill_worker_hard()
+        wait_for(lambda: master.status()["generation"] > gen1, timeout=120,
+                 desc="post-kill generation")
+        gen2 = master.status()["generation"]
+        wait_for(
+            lambda: any(
+                r["generation"] >= gen2
+                for r in read_metrics(workdir, "a0")
+                + read_metrics(workdir, "a1")
+            ),
+            timeout=120, desc="adopted generation training",
+        )
+        from easydl_tpu.elastic import timeline
+
+        for aid in ("a0", "a1"):
+            spawns = [
+                r["mode"]
+                for r in timeline.read(
+                    os.path.join(workdir, f"timeline-{aid}.jsonl"))
+                if r.get("phase") == "spawn" and r.get("gen") == gen2
+            ]
+            assert spawns and spawns[-1] == "preflight", (aid, spawns)
     finally:
         for a in agents:
             a.stop()
