@@ -99,6 +99,7 @@ class Master:
         port: int = 0,
         prepare_timeout_s: float = 60.0,
         prepare_min_uptime_s: float = 20.0,
+        preempt_prepare_timeout_s: float = 20.0,
         standing_preflight: bool = False,
     ):
         self.job_name = job_name
@@ -125,6 +126,7 @@ class Master:
             start_generation=int(persisted.get("generation", 0)),
             prepare_timeout_s=prepare_timeout_s,
             prepare_min_uptime_s=prepare_min_uptime_s,
+            preempt_prepare_timeout_s=preempt_prepare_timeout_s,
             standing_preflight=standing_preflight,
         )
         self._lock = threading.RLock()

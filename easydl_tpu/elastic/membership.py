@@ -93,6 +93,8 @@ class PrepareState:
     members: Tuple[str, ...]
     coordinator: str
     deadline: float
+    #: the wall-clock budget the deadline was derived from (for diagnostics)
+    window_s: float = 0.0
 
 
 class Rendezvous:
@@ -111,6 +113,7 @@ class Rendezvous:
         start_generation: int = 0,
         prepare_timeout_s: float = 60.0,
         prepare_min_uptime_s: float = 20.0,
+        preempt_prepare_timeout_s: float = 20.0,
         standing_preflight: bool = False,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -136,6 +139,12 @@ class Rendezvous:
         #: would only delay the reshape (the startup world-1 → world-N ramp
         #: is the canonical case)
         self.prepare_min_uptime_s = prepare_min_uptime_s
+        #: a reshape triggered by a preemption NOTICE races the VM's death:
+        #: the drain checkpoint must land before the host disappears, so
+        #: the prepare window shrinks to this (typical cloud notices are
+        #: 30-120s; 20s of preflight + a few seconds of drain fits with
+        #: margin, and an unready preflight just means a fresh coordinator)
+        self.preempt_prepare_timeout_s = preempt_prepare_timeout_s
         #: keep a pre-formed next generation armed even in steady state so
         #: UNPLANNED kills can adopt it. Opt-in: each armed preflight costs
         #: one extra worker process per host plus a compile after every
@@ -349,7 +358,15 @@ class Rendezvous:
                 and len(target) >= max(self.min_workers, 1)
             ):
                 # Planned reshape: preflight the next generation before
-                # draining — the current one keeps training meanwhile.
+                # draining — the current one keeps training meanwhile. A
+                # preemption-notice-driven reshape gets the SHORT window:
+                # the priority is landing the drain checkpoint before the
+                # noticed host disappears, not a fully-warmed switch.
+                window = (
+                    self.preempt_prepare_timeout_s
+                    if any(a.preempting for a in self._member_views())
+                    else self.prepare_timeout_s
+                )
                 self.prepare = PrepareState(
                     generation=self.generation + 1,
                     members=target,
@@ -357,13 +374,14 @@ class Rendezvous:
                         f"{self.agents[target[0]].host}:"
                         f"{self._port_alloc()}"
                     ),
-                    deadline=self._clock() + self.prepare_timeout_s,
+                    deadline=self._clock() + window,
+                    window_s=window,
                 )
                 self.phase = JobPhase.PREPARING
                 log.info(
                     "preparing generation %d: target=%s coordinator=%s "
                     "(window %.0fs)", self.prepare.generation, target,
-                    self.prepare.coordinator, self.prepare_timeout_s,
+                    self.prepare.coordinator, window,
                 )
             else:
                 log.info("reshaping (%s): draining %d members",
@@ -373,29 +391,52 @@ class Rendezvous:
             return
 
         if self.phase == JobPhase.PREPARING:
-            # A member dying mid-prepare turns this into an unplanned
-            # reshape: drop the preflight (survivors will be killed, the
-            # half-formed preflight group dies on RUN mismatch) and drain
-            # by force.
-            if any(
-                a.state == AgentState.LOST or
+            assert self.prepare is not None
+            # A member dying mid-prepare turns this into an unplanned KILL
+            # drain. The preflight is only DROPPED when the dead member was
+            # part of the prepared group (its preflight can never report
+            # ready); a death among the hosts being REPLACED — the exact
+            # race the preemption path exists for — keeps the survivor
+            # preflight, and form-time adoption stays best-effort.
+            dead = {
+                a.agent_id for a in self._member_views()
+                if a.state == AgentState.LOST or
                 (a.state == AgentState.IDLE and a.generation == self.generation)
-                for a in self._member_views()
-            ):
-                log.warning("member died mid-prepare; dropping preflight, "
-                            "escalating to KILL drain")
-                self.prepare = None
+            }
+            if dead:
+                if dead & set(self.prepare.members):
+                    log.warning("prepared member %s died mid-prepare; "
+                                "dropping preflight, escalating to KILL "
+                                "drain", sorted(dead))
+                    self.prepare = None
+                else:
+                    log.warning("member %s died mid-prepare (not in the "
+                                "prepared group); escalating to KILL drain, "
+                                "keeping the survivor preflight",
+                                sorted(dead))
                 self._drain_planned = False
                 self.phase = JobPhase.DRAINING
                 return
             # The target moved (plan changed again, a standby died/joined):
             # drop this preflight and re-decide from STABLE.
-            assert self.prepare is not None
             if tuple(self._target()) != self.prepare.members:
                 log.info("prepare target changed; dropping preflight")
                 self.prepare = None
                 self.phase = JobPhase.STABLE
                 return
+            # A preemption notice arriving MID-prepare must tighten a long
+            # window in place: the drain checkpoint needs the noticed host
+            # alive, so it cannot wait out a leisurely compile budget.
+            if any(a.preempting for a in self._member_views()):
+                tight = self._clock() + self.preempt_prepare_timeout_s
+                if tight < self.prepare.deadline:
+                    log.info(
+                        "preemption notice during prepare; window %.0fs -> "
+                        "%.0fs", self.prepare.window_s,
+                        self.preempt_prepare_timeout_s,
+                    )
+                    self.prepare.deadline = tight
+                    self.prepare.window_s = self.preempt_prepare_timeout_s
             ready = all(
                 self.agents[m].prepared == self.prepare.coordinator
                 for m in self.prepare.members
@@ -405,7 +446,7 @@ class Rendezvous:
                 if not ready:
                     log.warning(
                         "prepare window expired (%.0fs); draining anyway",
-                        self.prepare_timeout_s,
+                        self.prepare.window_s,
                     )
                 log.info("reshaping (planned%s): draining %d members",
                          ", preflight ready" if ready else "",

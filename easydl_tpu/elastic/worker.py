@@ -429,6 +429,14 @@ def run_worker(env: Dict[str, str]) -> int:
             else:
                 want_quiesce = False
         if want_quiesce:
+            # From here on a LATE SIGUSR1 must be inert: the consensus can
+            # quiesce this rank off a PEER's flag before its own agent's
+            # signal arrives, and a signal landing during interpreter
+            # teardown kills the process with -SIGUSR1 — which the agent
+            # then reports as a crash and the master escalates into a
+            # spurious KILL drain (observed live; the checkpoint had
+            # landed, so only the reporting was wrong).
+            signal.signal(signal.SIGUSR1, signal.SIG_IGN)
             log.info("gen %d: quiescing at step %d", generation, step)
             timeline.emit(tl_path, "quiesce_ckpt_begin", generation, step=step)
             ps_save(step)
@@ -473,6 +481,10 @@ def run_worker(env: Dict[str, str]) -> int:
         # IO is done (collective agreement; barriers on this main thread).
         ckpt.finalize()
 
+    # Same late-signal shield for the completion path: a quiesce landing
+    # between the final save and process exit must not turn a finished
+    # worker into a reported crash.
+    signal.signal(signal.SIGUSR1, signal.SIG_IGN)
     ps_save(total_steps)
     ckpt.save(total_steps, state, metadata=_data_meta())
     ckpt.wait()

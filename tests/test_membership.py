@@ -294,3 +294,96 @@ def test_standing_preflight_not_adopted_without_all_ready():
     assert rdv.phase == JobPhase.STABLE and rdv.generation == gen + 1
     d = rdv.directive_for("a0")
     assert d.kind == "run" and d.coordinator != prep.coordinator
+
+
+def test_preemption_notice_preflights_with_short_window():
+    """A notice-driven reshape preflights the survivor generation but on
+    the SHORT window (the drain checkpoint must land before the noticed
+    host dies); a ready preflight is adopted, and the preempting host is
+    excluded from the target so its preflight is never waited on."""
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=60.0, preempt_prepare_timeout_s=5.0,
+                     prepare_min_uptime_s=0.0, min_workers=2,
+                     clock=lambda: clock["t"])
+    gen = start_gen(rdv, ["a0", "a1"])
+    assert set(rdv.members) == {"a0", "a1"}
+    rdv.register("a2", "h2", 2)  # standby replacement
+    rdv.heartbeat("a1", gen, "running", preempting=True)
+    assert rdv.phase == JobPhase.PREPARING
+    prep = rdv.prepare
+    assert set(prep.members) == {"a0", "a2"}  # preempting a1 excluded
+    assert prep.deadline == 5.0  # the SHORT window, not 60s
+    # survivors report ready -> drain + adopt before the host dies
+    rdv.heartbeat("a0", gen, "running", prepared=prep.coordinator)
+    rdv.heartbeat("a2", -1, "idle", prepared=prep.coordinator)
+    assert rdv.phase == JobPhase.DRAINING
+    rdv.heartbeat("a0", gen, "quiesced", prepared=prep.coordinator)
+    rdv.heartbeat("a1", gen, "quiesced")
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == gen + 1
+    assert set(rdv.members) == {"a0", "a2"}
+    d = rdv.directive_for("a0")
+    assert d.kind == "run" and d.coordinator == prep.coordinator
+
+
+def test_preemption_notice_short_window_expiry_still_drains_in_time():
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=600.0, preempt_prepare_timeout_s=5.0,
+                     prepare_min_uptime_s=0.0, min_workers=2,
+                     clock=lambda: clock["t"])
+    gen = start_gen(rdv, ["a0", "a1"])
+    assert set(rdv.members) == {"a0", "a1"}
+    rdv.register("a2", "h2", 2)
+    rdv.heartbeat("a1", gen, "running", preempting=True)
+    assert rdv.phase == JobPhase.PREPARING
+    clock["t"] = 6.0  # nobody compiled in time; the 600s default must NOT gate
+    rdv.tick()
+    assert rdv.phase == JobPhase.DRAINING
+
+
+def test_member_death_outside_prepared_group_keeps_preflight():
+    """The race the preemption path exists for: the host being REPLACED
+    dies before the drain completes. The survivor preflight (which never
+    included it) must be kept through the KILL escalation and adopted."""
+    rdv2 = mk(desired=2, prepare=60.0, min_workers=2)
+    gen2 = start_gen(rdv2, ["a0", "a1"])
+    assert set(rdv2.members) == {"a0", "a1"}
+    rdv2.register("a2", "h2", 2)
+    rdv2.heartbeat("a1", gen2, "running", preempting=True)
+    prep2 = rdv2.prepare
+    assert set(prep2.members) == {"a0", "a2"}
+    # a1's VM dies before anyone reports ready
+    rdv2.heartbeat("a1", gen2, "idle")
+    assert rdv2.phase == JobPhase.DRAINING
+    assert rdv2.prepare is prep2  # survivor preflight KEPT
+    # preflights report ready while the KILL drain completes (agents
+    # heartbeat continuously; the standby's report lands before the
+    # survivor's final idle forms the generation)
+    rdv2.heartbeat("a2", -1, "idle", prepared=prep2.coordinator)
+    rdv2.heartbeat("a0", gen2, "idle", prepared=prep2.coordinator)
+    assert rdv2.phase == JobPhase.STABLE and rdv2.generation == gen2 + 1
+    d = rdv2.directive_for("a0")
+    assert d.kind == "run" and d.coordinator == prep2.coordinator
+
+
+def test_notice_mid_prepare_tightens_window():
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=600.0, preempt_prepare_timeout_s=15.0,
+                     prepare_min_uptime_s=0.0, min_workers=2,
+                     clock=lambda: clock["t"])
+    gen = start_gen(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)
+    rdv.set_desired_workers(3)  # ordinary planned reshape: long window
+    assert rdv.phase == JobPhase.PREPARING
+    assert rdv.prepare.window_s == 600.0
+    # a notice lands mid-prepare: the deadline must tighten in place
+    clock["t"] = 10.0
+    rdv.heartbeat("a1", gen, "running", preempting=True)
+    rdv.tick()
+    if rdv.phase == JobPhase.PREPARING:
+        assert rdv.prepare.deadline <= 25.0
+    clock["t"] = 30.0  # past the tightened deadline, far before 600
+    rdv.tick()
+    assert rdv.phase == JobPhase.DRAINING
