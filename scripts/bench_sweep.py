@@ -50,11 +50,13 @@ def main() -> None:
          dict(fused_loss=True, loss_chunk=128, **bf16_dots), 128, 4),
         ("fused c128 no-remat b128/a8 mb16",
          dict(fused_loss=True, loss_chunk=128, dtype="bfloat16"), 128, 8),
-        # r4: PROFILE.json attributes ~16% of device time to the accum scan
-        # carry's dynamic-update-slice fusions at a32 — lax.scan unroll
-        # (TrainConfig.accum_unroll) lets XLA fuse the carry update across
-        # microbatches. UNMEASURED on TPU so far (the tunnel was down all
-        # of r4's remaining window); this is the first lever to sweep next.
+        # accum_unroll hypothesis: lax.scan unroll lets XLA fuse the
+        # accumulation carry update across microbatches. (The r4 trace
+        # numbers once cited here are RETRACTED — that parser was
+        # incoherent; see PROFILE.json r4_attribution_superseded. The
+        # rewritten invariant-checked attribution re-records first.)
+        # UNMEASURED on TPU so far (tunnel down through r4 and r5);
+        # still the first lever to sweep on a live chip.
         ("plain  b256/a32 u1 (r4 bench)",
          dict(fused_loss=False, **bf16_dots), 256, 32, 1),
         ("plain  b256/a32 u2",
