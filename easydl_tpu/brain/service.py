@@ -20,6 +20,7 @@ from typing import Dict, Optional
 from easydl_tpu.api.resource_plan import ResourcePlan
 from easydl_tpu.brain.convert import plan_from_proto, plan_to_proto
 from easydl_tpu.brain.policy import Autoscaler, AutoscalerConfig, replan, startup_plan
+from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import ServiceDef, serve
@@ -74,6 +75,26 @@ class Brain:
         self._jobs: Dict[str, _JobState] = {}
         self._lock = threading.Lock()
         self._server = None
+        # Telemetry: plan-request traffic and replan activity per job — the
+        # "is autoscaling actually happening" signals. RPC latencies come
+        # free from utils/rpc.py.
+        reg = get_registry()
+        self._exporter = None
+        self._m_plan_requests = reg.counter(
+            "easydl_brain_plan_requests_total", "GetPlan polls, by job and "
+            "whether a newer plan was returned.", ("job", "has_plan"))
+        self._m_reports = reg.counter(
+            "easydl_brain_metric_reports_total", "StepMetrics observations "
+            "ingested.", ("job",))
+        self._m_replans = reg.counter(
+            "easydl_brain_replans_total", "Plan-version bumps decided by the "
+            "autoscaler.", ("job",))
+        self._m_plan_version = reg.gauge(
+            "easydl_brain_plan_version", "Latest plan version per job.",
+            ("job",))
+        self._m_plan_workers = reg.gauge(
+            "easydl_brain_plan_workers", "Worker replicas in the latest "
+            "plan.", ("job",))
         self._state_dir = state_dir
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)
@@ -195,6 +216,7 @@ class Brain:
             return st.plan
 
     def observe(self, m: pb.StepMetrics) -> None:
+        self._m_reports.inc(job=m.job_name)
         with self._lock:
             st = self._job(m.job_name)
             version_before = st.plan.version if st.plan else 0
@@ -207,6 +229,12 @@ class Brain:
                 # to be RECENT for a replacement to keep deciding well.
                 version_after = st.plan.version if st.plan else 0
                 st.dirty = True
+                if version_after != version_before:
+                    self._m_replans.inc(job=m.job_name)
+                if st.plan is not None:
+                    self._m_plan_version.set(st.plan.version, job=m.job_name)
+                    self._m_plan_workers.set(st.plan.replicas("worker"),
+                                             job=m.job_name)
                 if (version_after != version_before
                         or self._clock() - st.last_persist_t
                         >= self._persist_window_s):
@@ -263,6 +291,8 @@ class Brain:
 
     def GetPlan(self, req: pb.PlanRequest, ctx) -> pb.PlanResponse:
         plan = self.current_plan(req.job_name, newer_than=req.current_version)
+        self._m_plan_requests.inc(
+            job=req.job_name, has_plan=str(plan is not None).lower())
         if plan is None:
             return pb.PlanResponse(has_plan=False)
         return pb.PlanResponse(has_plan=True, plan=plan_to_proto(plan))
@@ -272,8 +302,12 @@ class Brain:
         return pb.Ack(ok=True)
 
     # ------------------------------------------------------------------ server
-    def start(self, port: int = 0) -> "Brain":
+    def start(self, port: int = 0, obs_workdir: Optional[str] = None) -> "Brain":
         self._server = serve(BRAIN_SERVICE, self, port=port)
+        self._exporter = start_exporter(
+            "brain", workdir=obs_workdir or self._state_dir,
+            health_fn=lambda: {"jobs": len(self._jobs)},
+        )
         log.info("brain serving on %s", self.address)
         return self
 
@@ -284,6 +318,9 @@ class Brain:
     def stop(self) -> None:
         if self._server:
             self._server.stop()
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
         # Flush throttled window state so a clean shutdown loses nothing.
         with self._lock:
             for name, st in self._jobs.items():

@@ -137,7 +137,12 @@ def main() -> None:
     else:
         pod_api = InMemoryPodApi()
     ctl = ElasticJobController(store, pod_api)
-    ctl.start(resync_s=args.resync_s)
+    # Standalone mode: publish the controller's metrics address under the
+    # watch dir (the operator-known location; ingest skips non-YAML
+    # entries). In-cluster there is no shared dir — pin the port with
+    # EASYDL_METRICS_PORT_CONTROLLER instead (docs/operations.md §4).
+    ctl.start(resync_s=args.resync_s,
+              obs_workdir=args.watch_dir or None)
     cr_source = None
     if args.cr_source == "k8s":
         from easydl_tpu.controller.kube_cr_source import (

@@ -34,6 +34,7 @@ from easydl_tpu.controller.reconciler import (
     reconcile,
     resource_sig,
 )
+from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.utils.logging import get_logger
 
 log = get_logger("controller", "operator")
@@ -284,6 +285,23 @@ class ElasticJobController:
         self._bo_reset = restart_backoff_reset
         # (job, role) -> (consecutive failures, last failure t, next create t)
         self._backoff: Dict[Tuple[str, str], Tuple[int, float, float]] = {}
+        # Telemetry: reconcile-loop health — pass counts/durations and the
+        # pod-op mix. A stalled or thrashing reconciler shows up here long
+        # before pods visibly misbehave.
+        reg = get_registry()
+        self._exporter = None
+        self._m_reconciles = reg.counter(
+            "easydl_controller_reconcile_total", "Reconcile passes, by job.",
+            ("job",))
+        self._m_reconcile_seconds = reg.histogram(
+            "easydl_controller_reconcile_seconds", "Wall time of one "
+            "reconcile pass.", ("job",),
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5))
+        self._m_pod_ops = reg.counter(
+            "easydl_controller_pod_ops_total", "Pod operations issued, by "
+            "verb.", ("job", "verb"))
+        self._m_jobs = reg.gauge(
+            "easydl_controller_jobs", "Jobs currently in the store.")
 
     # -------------------------------------------------------------- backoff
     def _note_failure(self, job: str, role: str) -> None:
@@ -312,6 +330,19 @@ class ElasticJobController:
     # ------------------------------------------------------------- reconcile
     def reconcile_job(self, job_name: str) -> JobStatus:
         """One level-triggered pass for one job; idempotent."""
+        t0 = time.perf_counter()
+        status = self._reconcile_job(job_name)
+        self._m_reconciles.inc(job=job_name)
+        self._m_reconcile_seconds.observe(time.perf_counter() - t0,
+                                          job=job_name)
+        for op in status.last_ops:
+            verb = op.split(" ", 1)[0]
+            if verb in ("CREATE", "DELETE"):
+                self._m_pod_ops.inc(job=job_name, verb=verb)
+        self._m_jobs.set(len(self.store.jobs()))
+        return status
+
+    def _reconcile_job(self, job_name: str) -> JobStatus:
         status = JobStatus(job=job_name)
         job = self.store.job(job_name)
         observed = self.pods.list_pods(job_name)
@@ -532,7 +563,13 @@ class ElasticJobController:
         return {j: self.reconcile_job(j) for j in self.store.jobs()}
 
     # ------------------------------------------------------------ background
-    def start(self, resync_s: float = 2.0) -> None:
+    def start(self, resync_s: float = 2.0,
+              obs_workdir: Optional[str] = None) -> None:
+        self._exporter = start_exporter(
+            "controller", workdir=obs_workdir,
+            health_fn=lambda: {"jobs": len(self.store.jobs())},
+        )
+
         def loop():
             while not self._stop.is_set():
                 ev = self.store.next_event(timeout=resync_s)
@@ -556,3 +593,6 @@ class ElasticJobController:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None

@@ -9,6 +9,7 @@ hook, SURVEY.md §5.3) and restarts the worker across membership generations.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import signal
@@ -16,8 +17,9 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
+from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import RpcClient
@@ -26,6 +28,27 @@ from easydl_tpu.elastic import timeline
 from easydl_tpu.elastic.master import MASTER_SERVICE
 
 log = get_logger("elastic", "agent")
+
+
+def heartbeat_delay(prev_kind: int, kind: int, state_changed: bool,
+                    heartbeat_interval: float) -> float:
+    """Sleep before the next heartbeat — the event-driven cadence contract.
+
+    Fast-follow (0.02 s) ONLY on a directive-kind or local-state change:
+    those are the hops of a generation-switch ladder, where one full
+    heartbeat sleep per hop used to dominate detect_and_rendezvous time. A
+    REPEATED non-noop directive (e.g. holding QUIESCE for a whole
+    multi-second drain while the worker walks to its step boundary) gets a
+    modest 0.2 s floor instead — the pre-fix behavior applied the 0.02 s
+    floor to the entire window, ~50 heartbeats/s per agent against the
+    master (ADVICE round 5). Steady-state NOOP keeps the configured
+    interval. Pure, so the storm fix is unit-testable; its live effect is
+    visible in the easydl_agent_heartbeat_rate_per_s gauge."""
+    if kind != prev_kind or state_changed:
+        return 0.02
+    if kind != pb.DirectiveKind.NOOP:
+        return min(heartbeat_interval, 0.2)
+    return heartbeat_interval
 
 
 class Agent:
@@ -93,6 +116,54 @@ class Agent:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._client: Optional[RpcClient] = None
+        # Telemetry: heartbeat cadence (the fast-follow fix below is only
+        # trustworthy if its effect is visible in /metrics), worker train
+        # stats bridged from the metrics JSONL, and per-phase switch
+        # durations bridged from timeline.emit (one instrumentation point
+        # feeds both the JSONL decomposition and the gauges).
+        reg = get_registry()
+        self._exporter = None
+        self._hb_total = reg.counter(
+            "easydl_agent_heartbeats_total", "Heartbeats sent to the master.",
+            ("agent",))
+        self._hb_rate = reg.gauge(
+            "easydl_agent_heartbeat_rate_per_s", "Observed heartbeat rate "
+            "over the recent window.", ("agent",))
+        self._m_generation = reg.gauge(
+            "easydl_agent_generation", "Generation of the last applied RUN.",
+            ("agent",))
+        self._m_worker_rate = reg.gauge(
+            "easydl_agent_worker_samples_per_sec", "Worker-reported global "
+            "training throughput (from the metrics JSONL).", ("agent",))
+        self._m_worker_step = reg.gauge(
+            "easydl_agent_worker_step", "Worker-reported training step.",
+            ("agent",))
+        self._m_worker_loss = reg.gauge(
+            "easydl_agent_worker_loss", "Worker-reported training loss.",
+            ("agent",))
+        self._m_worker_step_time = reg.gauge(
+            "easydl_agent_worker_step_time_seconds", "Worker-reported step "
+            "wall time.", ("agent",))
+        self._m_phase_seconds = reg.gauge(
+            "easydl_agent_phase_seconds", "Time from the previous timeline "
+            "phase boundary to this one (generation-switch decomposition).",
+            ("agent", "phase"))
+        self._m_phase_total = reg.counter(
+            "easydl_agent_phase_events_total", "Timeline phase boundaries "
+            "emitted in-process.", ("agent", "phase"))
+        self._hb_times: Deque[float] = collections.deque(maxlen=20)
+        self._tl_last: Optional[tuple] = None  # (phase, monotonic t)
+
+    #: The agent-side legs of a generation switch whose durations are
+    #: meaningful: duration is recorded only for these (previous → current)
+    #: boundary pairs. Any other boundary OPENS a measurement window
+    #: without recording — attributing the preceding gap (which may be the
+    #: whole inter-switch training interval) to a leg would contradict the
+    #: JSONL decomposition these gauges mirror.
+    _PHASE_LEGS = {
+        ("quiesce_sent", "worker_exit"),  # drain: signal → clean exit
+        ("worker_exit", "spawn"),         # re-rendezvous → next spawn
+    }
 
     # ------------------------------------------------------------------ control
     def start(self) -> "Agent":
@@ -170,9 +241,57 @@ class Agent:
                         self.agent_id, new_addr, e)
             return None
 
+    def _on_timeline_emit(self, path: str, rec: Dict[str, Any]) -> None:
+        """timeline.emit bridge: the same boundary that lands in the JSONL
+        updates the phase gauges — durations are measured between
+        consecutive in-process boundaries (quiesce_sent → worker_exit →
+        spawn), i.e. the agent-side legs of a generation switch."""
+        if path != self.timeline_path:
+            return
+        phase = str(rec.get("phase", ""))
+        now = time.monotonic()
+        if (self._tl_last is not None
+                and (self._tl_last[0], phase) in self._PHASE_LEGS):
+            self._m_phase_seconds.set(now - self._tl_last[1],
+                                      agent=self.agent_id, phase=phase)
+        self._tl_last = (phase, now)
+        self._m_phase_total.inc(agent=self.agent_id, phase=phase)
+
     def run(self) -> None:
         self._client = RpcClient(MASTER_SERVICE, self.master_address, timeout=10.0)
         self._client.wait_ready(30.0)
+        self._exporter = start_exporter(
+            f"agent-{self.agent_id}", workdir=self.workdir,
+            health_fn=lambda: {
+                "agent": self.agent_id,
+                "state": self._state,
+                "generation": self._applied_key[0],
+            },
+        )
+        timeline.add_listener(self._on_timeline_emit)
+        try:
+            self._run_loop()
+        finally:
+            # Teardown runs even when the loop body raises (spawn exec
+            # failure, register error): a dead agent must not leave its
+            # module-global timeline listener installed (a same-path
+            # replacement would double-count phases) or its obs publication
+            # advertising a zombie exporter.
+            self._terminate_worker(graceful=False)
+            self._kill_warm()
+            self._kill_preflight()
+            timeline.remove_listener(self._on_timeline_emit)
+            if self._exporter is not None:
+                self._exporter.stop()
+                self._exporter = None
+            if self._log_file is not None:
+                self._log_file.close()
+                self._log_file = None
+            if self._client:
+                self._client.close()
+            log.info("%s: agent exited", self.agent_id)
+
+    def _run_loop(self) -> None:
         if self.warm_start:
             # Pre-warm before the first directive too: a standby agent that
             # joins a scale-up must not cold-start its first worker — idle
@@ -181,6 +300,7 @@ class Agent:
             self._spawn_warm()
         directive = self._register()
         fail_since: Optional[float] = None
+        last_kind = pb.DirectiveKind.NOOP
         while not self._stop.is_set():
             state_before = self._state
             self._apply(directive)
@@ -190,14 +310,19 @@ class Agent:
             # Event-driven cadence: each hop of a generation switch (worker
             # died → master KILLs the peer → peer reports idle → RUN) used
             # to cost one full heartbeat sleep; across the 4-hop ladder
-            # that was the bulk of detect_and_rendezvous time. A non-noop
-            # directive or a local state change fast-follows with an
-            # immediate heartbeat instead (tiny sleep to bound any cycle).
-            interesting = (
-                directive.kind != pb.DirectiveKind.NOOP
-                or self._state != state_before
-            )
-            time.sleep(0.02 if interesting else self.heartbeat_interval)
+            # that was the bulk of detect_and_rendezvous time. Fast-follow
+            # (tiny sleep to bound any cycle) only on directive-kind or
+            # local-state CHANGES: a member holding the same QUIESCE for a
+            # whole multi-second drain window used to hit the 0.02 s floor
+            # every iteration — ~50 heartbeats/s per agent against the
+            # master (ADVICE round 5). A repeated non-noop directive now
+            # heartbeats at a modest floor instead, so the drain stays
+            # responsive without the storm.
+            delay = heartbeat_delay(last_kind, directive.kind,
+                                    self._state != state_before,
+                                    self.heartbeat_interval)
+            last_kind = directive.kind
+            time.sleep(delay)
             metrics = self._read_metrics()
             if self._warm_rearm_ready(metrics):
                 self._warm_due = False
@@ -223,6 +348,7 @@ class Agent:
                     )
                 )
                 fail_since = None
+                self._note_heartbeat(metrics)
             except Exception as e:
                 log.warning("%s: heartbeat failed: %s", self.agent_id, e)
                 now = time.monotonic()
@@ -233,15 +359,33 @@ class Agent:
                         directive = refreshed
                         fail_since = None
                 time.sleep(self.heartbeat_interval)
-        self._terminate_worker(graceful=False)
-        self._kill_warm()
-        self._kill_preflight()
-        if self._log_file is not None:
-            self._log_file.close()
-            self._log_file = None
-        if self._client:
-            self._client.close()
-        log.info("%s: agent exited", self.agent_id)
+
+    def _note_heartbeat(self, metrics: Dict[str, Any]) -> None:
+        """Update cadence + bridged worker gauges after a delivered
+        heartbeat (best-effort: gauges must never take the loop down)."""
+        try:
+            now = time.monotonic()
+            self._hb_times.append(now)
+            self._hb_total.inc(agent=self.agent_id)
+            if len(self._hb_times) >= 2:
+                span = self._hb_times[-1] - self._hb_times[0]
+                if span > 0:
+                    self._hb_rate.set((len(self._hb_times) - 1) / span,
+                                      agent=self.agent_id)
+            self._m_generation.set(self._applied_key[0], agent=self.agent_id)
+            if metrics:
+                self._m_worker_step.set(float(metrics.get("step", 0)),
+                                        agent=self.agent_id)
+                self._m_worker_rate.set(
+                    float(metrics.get("samples_per_sec", 0.0)),
+                    agent=self.agent_id)
+                self._m_worker_loss.set(float(metrics.get("loss", 0.0)),
+                                        agent=self.agent_id)
+                self._m_worker_step_time.set(
+                    float(metrics.get("step_time_s", 0.0)),
+                    agent=self.agent_id)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------ state
     def _refresh_state(self) -> None:

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from easydl_tpu.api.resource_plan import ResourcePlan
 from easydl_tpu.elastic.membership import Directive, JobPhase, Rendezvous
+from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import RpcClient, ServiceDef, serve
@@ -57,6 +58,7 @@ class _Servicer:
             d = self._m.rendezvous.register(
                 req.agent_id, req.host, req.slots, bool(req.preemption_notice)
             )
+            self._m._count_directive(req.agent_id, d.kind)
             return self._m._to_proto(d)
 
     def Heartbeat(self, req: pb.HeartbeatRequest, ctx) -> pb.Directive:
@@ -79,6 +81,7 @@ class _Servicer:
             )
             if req.metrics.step_time_s > 0:
                 self._m._record_metrics(req.agent_id, req.metrics)
+            self._m._count_directive(req.agent_id, d.kind)
             return self._m._to_proto(d)
 
 
@@ -148,11 +151,49 @@ class Master:
             )
         #: agent -> (generation at receipt, StepMetrics)
         self._last_metrics: Dict[str, Tuple[int, pb.StepMetrics]] = {}
+        #: agent -> last directive kind sent (directive-transition counting)
+        self._last_directive_kind: Dict[str, str] = {}
+        self._last_gauge_t = float("-inf")  # brainless train-gauge throttle
         # dedupe: one Brain report per (generation, step)
         self._last_reported_gen = -1
         self._last_reported_step = -1
         self._metrics_q: "queue.Queue" = queue.Queue(maxsize=4)
         self._reporter_thread: Optional[threading.Thread] = None
+        # Telemetry: the master is the control-plane authority, so its
+        # /metrics carries the fleet-level signals the Brain (and any
+        # operator dashboard) needs — generation, membership, directive mix,
+        # time spent per rendezvous phase, and the aggregated train rate.
+        reg = get_registry()
+        self._exporter = None
+        self._m_generation = reg.gauge(
+            "easydl_master_generation", "Current membership generation.",
+            ("job",))
+        self._m_members = reg.gauge(
+            "easydl_master_membership_size", "Live members in the current "
+            "generation.", ("job",))
+        self._m_desired = reg.gauge(
+            "easydl_master_desired_workers", "Plan-desired worker count.",
+            ("job",))
+        self._m_plan_version = reg.gauge(
+            "easydl_master_plan_version", "Version of the applied resource "
+            "plan.", ("job",))
+        self._m_directives = reg.counter(
+            "easydl_master_directives_total", "Directives issued to agents, "
+            "by kind.", ("job", "kind"))
+        self._m_phase_seconds = reg.histogram(
+            "easydl_master_phase_seconds", "Time spent in each rendezvous "
+            "phase before transitioning out of it (drain/re-rendezvous "
+            "durations).", ("job", "phase"),
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300))
+        self._m_train_rate = reg.gauge(
+            "easydl_master_train_samples_per_sec", "Aggregated (median over "
+            "members) global training throughput.", ("job",))
+        self._m_train_step = reg.gauge(
+            "easydl_master_train_step", "Latest aggregated training step.",
+            ("job",))
+        self._m_train_loss = reg.gauge(
+            "easydl_master_train_loss", "Latest aggregated training loss.",
+            ("job",))
         if worker_config is not None:
             with open(os.path.join(workdir, "job.json"), "w") as f:
                 json.dump(worker_config, f)
@@ -204,6 +245,14 @@ class Master:
 
     def start(self) -> "Master":
         self._server = serve(MASTER_SERVICE, _Servicer(self), port=self._port)
+        self._exporter = start_exporter(
+            "master", workdir=self.workdir,
+            health_fn=lambda: {
+                "job": self.job_name,
+                "phase": self.rendezvous.phase.value,
+                "generation": self.rendezvous.generation,
+            },
+        )
         self._tick_thread = threading.Thread(target=self._tick_loop, daemon=True)
         self._tick_thread.start()
         if self.brain_address:
@@ -218,9 +267,13 @@ class Master:
         self._stop.set()
         if self._server:
             self._server.stop()
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
 
     def _tick_loop(self) -> None:
         last_phase = None
+        phase_since = time.monotonic()
         while not self._stop.is_set():
             with self._lock:
                 self.rendezvous.tick()
@@ -228,7 +281,22 @@ class Master:
                 if phase != last_phase:
                     self._event("phase", phase=phase.value,
                                 generation=self.rendezvous.generation)
+                    now = time.monotonic()
+                    if last_phase is not None:
+                        # Phase dwell time: "draining" observations are the
+                        # drain durations, "init" the first rendezvous, etc.
+                        self._m_phase_seconds.observe(
+                            now - phase_since, job=self.job_name,
+                            phase=last_phase.value)
+                    phase_since = now
                     last_phase = phase
+                self._m_generation.set(self.rendezvous.generation,
+                                       job=self.job_name)
+                self._m_members.set(len(self.rendezvous.members),
+                                    job=self.job_name)
+                self._m_desired.set(self.rendezvous.desired_workers,
+                                    job=self.job_name)
+                self._m_plan_version.set(self.plan_version, job=self.job_name)
             self._stop.wait(0.2)
 
     # ------------------------------------------------------------------ plans
@@ -284,9 +352,25 @@ class Master:
         # (pin world_size after a scale-down, suppress the step gate).
         gen = self.rendezvous.generation
         self._last_metrics[agent_id] = (gen, m)
+        # Without a Brain the aggregate exists only to feed three gauges —
+        # don't pay the O(members log members) median under the master lock
+        # on EVERY heartbeat of a brainless fleet; once a second is plenty
+        # for a scrape.
+        if not self.brain_address:
+            now = time.monotonic()
+            if now - self._last_gauge_t < 1.0:
+                return
+            self._last_gauge_t = now
+        agg = self._aggregate_metrics()
+        if agg is not None:
+            # The merged fleet view exposes the same aggregate the Brain
+            # receives — an operator's scrape and the autoscaler's input
+            # can never silently disagree.
+            self._m_train_rate.set(agg.samples_per_sec, job=self.job_name)
+            self._m_train_step.set(agg.step, job=self.job_name)
+            self._m_train_loss.set(agg.loss, job=self.job_name)
         if not self.brain_address:
             return
-        agg = self._aggregate_metrics()
         if agg is None:
             return
         # One aggregate per training step, not one per member heartbeat: the
@@ -383,6 +467,16 @@ class Master:
         except OSError as e:
             log.warning("event append failed: %s", e)
         self._persist_state()
+
+    def _count_directive(self, agent_id: str, kind: str) -> None:
+        """Count directive TRANSITIONS per agent, not responses: a held
+        QUIESCE re-sent on every drain heartbeat (or steady-state NOOP at
+        the full heartbeat rate) is one directive, and the counter's
+        promise is 'directives issued' — the mix must read one long drain
+        as one drain, not fifty. Called with the master lock held."""
+        if self._last_directive_kind.get(agent_id) != kind:
+            self._last_directive_kind[agent_id] = kind
+            self._m_directives.inc(job=self.job_name, kind=kind)
 
     def _to_proto(self, d: Directive) -> pb.Directive:
         out = pb.Directive(kind=_KIND_TO_PROTO[d.kind])

@@ -18,8 +18,31 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Callable, Dict, List
+
+# In-process listeners: fn(path, record) called on every emit. One
+# instrumentation point feeds both the JSONL decomposition AND live gauges —
+# the agent bridges its phase boundaries into /metrics by registering here
+# (easydl_tpu/elastic/agent.py), so the two views can never drift apart.
+# Listeners fire only in the emitting process; a worker subprocess' emits
+# reach other processes through the JSONL file, as before.
+_listeners: List[Callable[[str, Dict[str, Any]], None]] = []
+_listeners_lock = threading.Lock()
+
+
+def add_listener(fn: Callable[[str, Dict[str, Any]], None]) -> None:
+    with _listeners_lock:
+        _listeners.append(fn)
+
+
+def remove_listener(fn: Callable[[str, Dict[str, Any]], None]) -> None:
+    with _listeners_lock:
+        try:
+            _listeners.remove(fn)
+        except ValueError:
+            pass
 
 
 def emit(path: str | None, phase: str, generation: int, **data: Any) -> None:
@@ -28,6 +51,13 @@ def emit(path: str | None, phase: str, generation: int, **data: Any) -> None:
     if not path:
         return
     rec = {"t": time.time(), "phase": phase, "gen": int(generation), **data}
+    with _listeners_lock:
+        listeners = list(_listeners)
+    for fn in listeners:
+        try:
+            fn(path, rec)
+        except Exception:
+            pass  # same contract as the file write: never raises
     try:
         with open(path, "a") as f:
             f.write(json.dumps(rec) + "\n")

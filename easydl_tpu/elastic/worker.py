@@ -55,6 +55,36 @@ def consensus_interval(target_s: float, step_time_s: float,
     return max(1, min(max_interval, int(target_s / step_time_s)))
 
 
+def periodic_ckpt_due(ckpt_interval: int, step: int, next_ckpt: int,
+                      target_s: float, agreed_dt: float) -> tuple:
+    """Is a periodic checkpoint due at ``step``? → ``(due, next_ckpt)``.
+
+    The single copy of the cadence contract (documented in
+    docs/operations.md):
+
+    - ``ckpt_interval < 0`` — periodic checkpoints DISABLED (quiesce and
+      final saves still happen). This restores the pre-auto-cadence way to
+      turn the schedule off, which the auto default had silently removed
+      (ADVICE round 5): any non-positive value used to enable auto with no
+      opt-out left.
+    - ``ckpt_interval > 0`` — the classic every-N-steps modulo schedule.
+    - ``ckpt_interval == 0`` (``"auto"``) — wall-clock cadence: the next
+      save step derives from the consensus-agreed step time, so every rank
+      computes the same schedule.
+
+    Pure and deterministic so ranks can never disagree (and tests can
+    enumerate it)."""
+    if ckpt_interval < 0:
+        return False, next_ckpt
+    if ckpt_interval > 0:
+        return step % ckpt_interval == 0, next_ckpt
+    due = step >= next_ckpt
+    if due:
+        next_ckpt = step + consensus_interval(
+            target_s, agreed_dt, max_interval=100_000)
+    return due, next_ckpt
+
+
 def run_worker(env: Dict[str, str]) -> int:
     # Install the quiesce handler FIRST: a SIGUSR1 arriving during the long
     # jax import / distributed init must set the flag, not kill the process
@@ -314,7 +344,8 @@ def run_worker(env: Dict[str, str]) -> int:
     # dominant avoidable cost once the switch itself is fast). Derivation
     # uses the same reduced step time as the consensus schedule, so every
     # rank computes the identical save step and the collective save can
-    # never split the group.
+    # never split the group. Negative DISABLES periodic saves (quiesce and
+    # final saves still happen) — full contract in periodic_ckpt_due.
     ckpt_raw = cfg.get("ckpt_interval", 20)
     ckpt_interval = 0 if str(ckpt_raw) == "auto" else int(ckpt_raw)
     ckpt_target_s = float(cfg.get("ckpt_target_s", 5.0))
@@ -460,20 +491,15 @@ def run_worker(env: Dict[str, str]) -> int:
                           rank=rank, step=step, step_time_s=round(dt, 3))
             first_step_emitted = True
 
-        if ckpt_interval > 0:
-            save_due = step % ckpt_interval == 0
-        else:
-            # Auto cadence: next_ckpt advances only at a save, computed
-            # from values every rank shares (same agreed_dt from the same
-            # consensus allgather, same step) — so save_due is identical
-            # across ranks without any extra collective. Single-process
-            # runs substitute the local EMA (nothing to agree with).
-            if world == 1:
-                agreed_dt = ema_dt
-            save_due = step >= next_ckpt
-            if save_due:
-                next_ckpt = step + consensus_interval(
-                    ckpt_target_s, agreed_dt, max_interval=100_000)
+        # Auto cadence computes next_ckpt from values every rank shares
+        # (same agreed_dt from the same consensus allgather, same step) —
+        # so save_due is identical across ranks without any extra
+        # collective. Single-process runs substitute the local EMA
+        # (nothing to agree with).
+        if ckpt_interval == 0 and world == 1:
+            agreed_dt = ema_dt
+        save_due, next_ckpt = periodic_ckpt_due(
+            ckpt_interval, step, next_ckpt, ckpt_target_s, agreed_dt)
         if save_due and step < total_steps:
             ps_save(step)
             ckpt.save(step, state, metadata=_data_meta())

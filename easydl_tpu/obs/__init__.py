@@ -1,0 +1,27 @@
+"""Unified telemetry layer: metrics registry + per-service exporters.
+
+The cross-cutting observability subsystem every service records into:
+
+- :mod:`easydl_tpu.obs.registry` — dependency-free Counter/Gauge/Histogram
+  with labels, Prometheus text exposition, registration-time name lint;
+- :mod:`easydl_tpu.obs.exporter` — stdlib ``/metrics`` + ``/healthz`` HTTP
+  exporter thread, address published into the job workdir for discovery;
+- :mod:`easydl_tpu.obs.scrape` — fetch/parse/merge for
+  ``scripts/obs_scrape.py`` and programmatic consumers.
+"""
+
+from easydl_tpu.obs.exporter import (  # noqa: F401
+    MetricsExporter,
+    OBS_DIR,
+    start_exporter,
+)
+from easydl_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    DEFAULT_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    validate_label_name,
+    validate_metric_name,
+)

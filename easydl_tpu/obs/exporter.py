@@ -1,0 +1,198 @@
+"""Per-service HTTP exporter: ``/metrics`` + ``/healthz`` on a stdlib server.
+
+One background thread per process (ThreadingHTTPServer, daemon workers)
+serving the process' metrics registry in Prometheus text format and a JSON
+health document. Port selection: explicit arg > ``EASYDL_METRICS_PORT_<
+COMPONENT>`` > ``EASYDL_METRICS_PORT`` > 0 (pick a free port). ``off``/``-1``
+disables the exporter entirely (utils/env.py owns the parsing).
+
+Discovery: with ``workdir`` set the exporter publishes its address to
+``<workdir>/obs/<component>.json`` (atomic rename, same idiom as
+master.json) so ``scripts/obs_scrape.py`` can find every service of a job
+without any service registry — the shared workdir IS the registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from easydl_tpu.obs.registry import MetricsRegistry, get_registry
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("obs", "exporter")
+
+#: Subdirectory of a job workdir where exporters publish their addresses.
+OBS_DIR = "obs"
+
+CONTENT_TYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """A running exporter; ``.port``/``.address`` to reach it, ``.stop()``
+    to shut it down (and retract the workdir publication)."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        component: str = "easydl",
+        port: int = 0,
+        workdir: Optional[str] = None,
+        health_fn: Optional[Callable[[], Dict[str, object]]] = None,
+        host: str = "",
+    ):
+        self.registry = registry if registry is not None else get_registry()
+        self.component = component
+        self.health_fn = health_fn
+        self._published: Optional[str] = None
+        self._t0 = time.time()
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = exporter.registry.render().encode()
+                    self._reply(200, CONTENT_TYPE_METRICS, body)
+                elif path == "/healthz":
+                    doc: Dict[str, object] = {
+                        "ok": True,
+                        "component": exporter.component,
+                        "uptime_s": round(time.time() - exporter._t0, 3),
+                    }
+                    if exporter.health_fn is not None:
+                        try:
+                            doc.update(exporter.health_fn())
+                        except Exception as e:
+                            doc["ok"] = False
+                            doc["error"] = repr(e)
+                    code = 200 if doc.get("ok") else 503
+                    self._reply(code, "application/json",
+                                json.dumps(doc).encode())
+                else:
+                    self._reply(404, "text/plain", b"not found\n")
+
+            def _reply(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # scrapes are chatty
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"metrics-exporter-{component}",
+            daemon=True,
+        )
+        self._thread.start()
+        if workdir:
+            self._publish(workdir)
+
+    @property
+    def address(self) -> str:
+        """The address published for discovery. The server binds all
+        interfaces, but "localhost" is only reachable from this host — on a
+        multi-host job (shared-workdir deployments) set
+        ``EASYDL_METRICS_HOST`` to this host's reachable name/IP (the pod
+        backend's pod IP, a node hostname) so cross-host scrapes work."""
+        host = os.environ.get("EASYDL_METRICS_HOST", "").strip() or "localhost"
+        return f"{host}:{self.port}"
+
+    def _publish(self, workdir: str) -> None:
+        try:
+            d = os.path.join(workdir, OBS_DIR)
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"{self.component}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "component": self.component,
+                        "address": self.address,
+                        "pid": os.getpid(),
+                        # Which in-process registry this exporter serves:
+                        # scrape-merge sums additive series across DISTINCT
+                        # (pid, registry) sources, so two exporters sharing
+                        # one registry (master + in-process agent) don't
+                        # double-count while two registries in one process
+                        # still sum.
+                        "registry": id(self.registry),
+                        "t": time.time(),
+                    },
+                    f,
+                )
+            os.replace(tmp, path)
+            self._published = path
+        except OSError as e:  # discovery is best-effort, serving is not
+            log.warning("obs publication failed for %s: %s",
+                        self.component, e)
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        if self._published:
+            # Retract only OUR publication: an exiting old process must not
+            # delete the fresh file a same-component replacement already
+            # wrote (publication happens once at startup — the replacement
+            # would stay undiscoverable for the rest of the job).
+            try:
+                with open(self._published) as f:
+                    mine = json.load(f).get("pid") == os.getpid()
+            except (OSError, ValueError):
+                mine = False
+            if mine:
+                try:
+                    os.remove(self._published)
+                except OSError:
+                    pass
+            self._published = None
+
+
+def start_exporter(
+    component: str,
+    registry: Optional[MetricsRegistry] = None,
+    port: Optional[int] = None,
+    workdir: Optional[str] = None,
+    health_fn: Optional[Callable[[], Dict[str, object]]] = None,
+) -> Optional[MetricsExporter]:
+    """Start the service's exporter, or return None when disabled.
+
+    ``port=None`` resolves through the environment (see
+    :func:`easydl_tpu.utils.env.obs_port_from_env`); services pass their
+    component name so one deployment can pin per-role ports
+    (``EASYDL_METRICS_PORT_MASTER=9100``) while tests let every exporter
+    pick a free port. Never raises: a service must come up even when its
+    metrics port is taken — observability is a window, not a load-bearing
+    wall."""
+    if port is None:
+        from easydl_tpu.utils.env import obs_port_from_env
+
+        port = obs_port_from_env(component)
+        if port is None:
+            return None
+    try:
+        exp = MetricsExporter(
+            registry=registry, component=component, port=port,
+            workdir=workdir, health_fn=health_fn,
+        )
+    except Exception as e:  # bind failures AND surprises (OverflowError on
+        # an out-of-range port, resolver errors): same contract either way.
+        log.warning("metrics exporter for %s failed to start on port %s: %s",
+                    component, port, e)
+        return None
+    log.info("metrics exporter for %s on :%d%s", component, exp.port,
+             f" (published under {workdir}/{OBS_DIR})" if workdir else "")
+    return exp

@@ -1,0 +1,357 @@
+"""Dependency-free Prometheus-style metrics registry.
+
+The paper promises performance monitoring that drives Brain's re-plans
+(README.md:21-23) but specifies no pipeline, and a production fleet is
+inoperable blind — so every long-running service (master, agent, PS shard,
+Brain, controller) records into one of these registries and exposes it over
+``/metrics`` (easydl_tpu/obs/exporter.py). No prometheus_client dependency:
+the container must not need a pip install, and the subset we use (Counter,
+Gauge, Histogram with labels, text exposition format 0.0.4) is small.
+
+Naming scheme (enforced at REGISTRATION time, not scrape time — a bad name
+must fail where the developer wrote it): ``easydl_<component>_<name>``,
+Prometheus grammar ``[a-zA-Z_:][a-zA-Z0-9_:]*`` for metric names and
+``[a-zA-Z_][a-zA-Z0-9_]*`` for label names.
+
+Thread safety: one lock per family guards child creation and value updates;
+``render()`` takes each family's lock briefly while snapshotting. Counters
+and histograms are monotonically cumulative (rates are the scraper's job).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+
+#: Default latency buckets (seconds) — Prometheus' classic spread, fine for
+#: everything from a localhost heartbeat (~1 ms) to a slow drain (~10 s).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def validate_metric_name(name: str) -> str:
+    """The registration-time metric-name lint: returns the name or raises.
+
+    Rejecting at registration means a typo'd dash or leading digit fails in
+    the unit tests of the component that introduced it, not in whatever
+    scrapes the fleet at 3am."""
+    if not isinstance(name, str) or not _METRIC_NAME_RE.match(name):
+        raise ValueError(
+            f"invalid Prometheus metric name {name!r} "
+            "(must match [a-zA-Z_:][a-zA-Z0-9_:]*)"
+        )
+    return name
+
+
+def validate_label_name(name: str) -> str:
+    if (not isinstance(name, str) or not _LABEL_NAME_RE.match(name)
+            or name.startswith("__")):
+        raise ValueError(
+            f"invalid Prometheus label name {name!r} "
+            "(must match [a-zA-Z_][a-zA-Z0-9_]*, not start with __)"
+        )
+    return name
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_text(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label_value(str(v))}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+class _Family:
+    """Common machinery: declared label names, children keyed by the label
+    value tuple, one lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        self.name = validate_metric_name(name)
+        self.help = help
+        self.labelnames = tuple(validate_label_name(n) for n in labelnames)
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} requires labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def _child(self, labels: Dict[str, str]):
+        key = self._key(labels)
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self._children[key] = self._new_child()
+            return c
+
+    def _new_child(self):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def signature(self) -> Tuple:
+        """Identity for conflict detection on re-registration. Histogram
+        extends this with its buckets — two shapes of the "same" histogram
+        must conflict loudly, not silently share the first one's buckets."""
+        return (self.kind, self.name, self.labelnames)
+
+    # ------------------------------------------------------------- exposition
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            lines.extend(self._render_child(key, child))
+        return lines
+
+    def _render_child(self, key, child) -> List[str]:  # pragma: no cover
+        raise NotImplementedError
+
+    def samples(self) -> Dict[str, float]:
+        """Flat {'name{k="v"}': value} view for in-process assertions.
+
+        Labels are serialized in sorted-key order — the same normalisation
+        obs.scrape.parse_text applies — so a series has ONE canonical key
+        whether it was read in-process or over HTTP."""
+        out: Dict[str, float] = {}
+        for line in self.render():
+            if line.startswith("#"):
+                continue
+            name, _, value = line.rpartition(" ")
+            if "{" in name:
+                base, _, inner = name.partition("{")
+                pairs = sorted(inner.rstrip("}").split(","))
+                name = base + "{" + ",".join(pairs) + "}"
+            out[name] = float(value)
+        return out
+
+
+class _CounterChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        child = self._child(labels)
+        with self._lock:
+            child.value += amount
+
+    def value(self, **labels: str) -> float:
+        child = self._child(labels)
+        with self._lock:
+            return child.value
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def _render_child(self, key, child) -> List[str]:
+        return [
+            f"{self.name}{_labels_text(self.labelnames, key)} "
+            f"{_format_value(child.value)}"
+        ]
+
+
+class _GaugeChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        child = self._child(labels)
+        with self._lock:
+            child.value = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        child = self._child(labels)
+        with self._lock:
+            child.value += amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        child = self._child(labels)
+        with self._lock:
+            return child.value
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def _render_child(self, key, child) -> List[str]:
+        return [
+            f"{self.name}{_labels_text(self.labelnames, key)} "
+            f"{_format_value(child.value)}"
+        ]
+
+
+class _HistogramChild:
+    __slots__ = ("bucket_counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.bucket_counts = [0] * n_buckets  # per-bucket, cumulated on render
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = (),
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError("histogram needs at least one bucket")
+        if bs[-1] != math.inf:
+            bs.append(math.inf)
+        self.buckets: Tuple[float, ...] = tuple(bs)
+
+    def signature(self) -> Tuple:
+        return (self.kind, self.name, self.labelnames, self.buckets)
+
+    def observe(self, value: float, **labels: str) -> None:
+        child = self._child(labels)
+        v = float(value)
+        with self._lock:
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    child.bucket_counts[i] += 1
+                    break
+            child.sum += v
+            child.count += 1
+
+    def count(self, **labels: str) -> int:
+        child = self._child(labels)
+        with self._lock:
+            return child.count
+
+    def _new_child(self):
+        return _HistogramChild(len(self.buckets))
+
+    def _render_child(self, key, child) -> List[str]:
+        lines: List[str] = []
+        cumulative = 0
+        for b, n in zip(self.buckets, child.bucket_counts):
+            cumulative += n
+            names = self.labelnames + ("le",)
+            values = key + (_format_value(b),)
+            lines.append(
+                f"{self.name}_bucket{_labels_text(names, values)} {cumulative}"
+            )
+        lt = _labels_text(self.labelnames, key)
+        lines.append(f"{self.name}_sum{lt} {_format_value(child.sum)}")
+        lines.append(f"{self.name}_count{lt} {child.count}")
+        return lines
+
+
+class MetricsRegistry:
+    """A named set of metric families with idempotent registration.
+
+    Re-registering the same (kind, name, labelnames) returns the existing
+    family — services and libraries can each declare the metrics they touch
+    without coordinating module import order — while a CONFLICTING
+    re-registration (same name, different type or labels) raises, because
+    silently merging two shapes corrupts the exposition."""
+
+    def __init__(self):
+        self._families: Dict[str, _Family] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, family: _Family) -> _Family:
+        with self._lock:
+            existing = self._families.get(family.name)
+            if existing is not None:
+                if existing.signature() != family.signature():
+                    raise ValueError(
+                        f"metric {family.name!r} already registered as "
+                        f"{existing.signature()}, conflicting with "
+                        f"{family.signature()}"
+                    )
+                return existing
+            self._families[family.name] = family
+            return family
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._register(Counter(name, help, labelnames))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge(name, help, labelnames))  # type: ignore[return-value]
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help, labelnames, buckets))  # type: ignore[return-value]
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            families = sorted(self._families.values(), key=lambda f: f.name)
+        lines: List[str] = []
+        for f in families:
+            lines.extend(f.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def samples(self) -> Dict[str, float]:
+        """Flat snapshot across every family (tests, status endpoints)."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            families = list(self._families.values())
+        for f in families:
+            out.update(f.samples())
+        return out
+
+
+#: The process-wide default registry. Services share it so one exporter per
+#: process shows everything that process touches (its RPC client calls, its
+#: own service metrics, the train-loop bridge).
+_default = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default

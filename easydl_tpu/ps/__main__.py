@@ -282,7 +282,7 @@ def main() -> None:
                 args.workdir, args.name, num_shards
             )
     shard = PsShard(shard_index=index, num_shards=num_shards)
-    server = shard.serve(port=args.port)
+    server = shard.serve(port=args.port, obs_workdir=args.workdir)
     log.info("ps pod %s serving shard %d/%d on %s",
              args.name, shard.shard_index, num_shards, server.address)
 
@@ -364,7 +364,7 @@ def main() -> None:
             time.sleep(0.2)
     except KeyboardInterrupt:
         pass
-    server.stop()
+    shard.stop()  # gRPC server + metrics exporter (retracts the obs file)
     log.info("ps pod %s exiting", args.name)
     sys.exit(0)
 

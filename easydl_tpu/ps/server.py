@@ -26,6 +26,7 @@ from typing import Dict
 
 import numpy as np
 
+from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.ps.table import EmbeddingTable, TableSpec, shard_of
 from easydl_tpu.utils.logging import get_logger
@@ -103,6 +104,26 @@ class PsShard:
         # for the count to hit zero after closing the gate, before saving.
         self._drain_cv = threading.Condition()
         self._inflight_pushes = 0
+        # Telemetry: push/pull RPS come from the pull/push counters (the
+        # generic RPC latency histograms live in utils/rpc.py); table sizes
+        # are shard-local gauges so a fleet scrape shows row distribution
+        # across shards directly.
+        reg = get_registry()
+        self._exporter = None
+        shard_l = str(shard_index)
+        self._m_rows = reg.gauge(
+            "easydl_ps_table_rows", "Materialised rows per table on this "
+            "shard.", ("shard", "table"))
+        self._m_pulls = reg.counter(
+            "easydl_ps_pull_ids_total", "Embedding ids served by Pull.",
+            ("shard", "table"))
+        self._m_pushes = reg.counter(
+            "easydl_ps_push_ids_total", "Embedding ids updated by Push.",
+            ("shard", "table"))
+        self._m_push_rejected = reg.counter(
+            "easydl_ps_push_rejected_total", "Pushes rejected (draining "
+            "gate or invalid scale).", ("shard",))
+        self._shard_label = shard_l
 
     # ----------------------------------------------------------- table admin
     def create_table(self, spec: TableSpec) -> EmbeddingTable:
@@ -238,11 +259,14 @@ class PsShard:
         t = self.table(req.table)
         ids = np.asarray(req.ids, np.int64)
         values = t.pull(ids)
+        self._m_pulls.inc(len(ids), shard=self._shard_label, table=req.table)
+        self._m_rows.set(t.rows, shard=self._shard_label, table=req.table)
         return pb.PullResponse(values=values.tobytes(), dim=t.dim)
 
     def Push(self, req: pb.PushRequest, ctx) -> pb.Ack:
         with self._drain_cv:
             if self._draining:
+                self._m_push_rejected.inc(shard=self._shard_label)
                 return pb.Ack(
                     ok=False,
                     message=f"{DRAINING}: shard {self.shard_index} is "
@@ -255,6 +279,7 @@ class PsShard:
             # update. It is never a meaningful value, so reject it instead
             # of applying it.
             if req.scale == 0.0:
+                self._m_push_rejected.inc(shard=self._shard_label)
                 return pb.Ack(
                     ok=False,
                     message="PushRequest.scale must be set and non-zero "
@@ -265,6 +290,9 @@ class PsShard:
             grads = np.frombuffer(req.grads, np.float32).reshape(
                 len(ids), t.dim)
             t.push(ids, grads, scale=req.scale)
+            self._m_pushes.inc(len(ids), shard=self._shard_label,
+                               table=req.table)
+            self._m_rows.set(t.rows, shard=self._shard_label, table=req.table)
             return pb.Ack(ok=True)
         finally:
             with self._drain_cv:
@@ -304,8 +332,20 @@ class PsShard:
         return resp
 
     # ----------------------------------------------------------------- serve
-    def serve(self, port: int = 0):
+    def serve(self, port: int = 0, obs_workdir: str | None = None):
+        """Start the gRPC server (and, when ``obs_workdir`` names the job
+        workdir, a discoverable /metrics + /healthz exporter for this
+        shard)."""
         self._server = serve(PS_SERVICE, self, port=port)
+        self._exporter = start_exporter(
+            f"ps-{self.shard_index}", workdir=obs_workdir,
+            health_fn=lambda: {
+                "shard": self.shard_index,
+                "num_shards": self.num_shards,
+                "tables": len(self._tables),
+                "draining": self._draining,
+            },
+        )
         log.info("ps shard %d/%d serving on :%d", self.shard_index,
                  self.num_shards, self._server.port)
         return self._server
@@ -314,6 +354,9 @@ class PsShard:
         if self._server is not None:
             self._server.stop()
             self._server = None
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
 
 
 def _spec_json(spec: TableSpec) -> str:

@@ -6,6 +6,34 @@ import os
 from typing import Dict, Mapping, Optional
 
 
+def obs_port_from_env(component: str, default: int = 0):
+    """Resolve a service's metrics-exporter port from the environment.
+
+    Precedence: ``EASYDL_METRICS_PORT_<COMPONENT>`` (component upper-cased,
+    non-alnum → ``_``) > ``EASYDL_METRICS_PORT`` > ``default`` (0 = pick a
+    free port). ``off``/``disabled``/negative disables the exporter —
+    returns None. Unparseable values fall back to the default rather than
+    killing the service: observability must never be load-bearing."""
+    key = "EASYDL_METRICS_PORT_" + "".join(
+        c if c.isalnum() else "_" for c in component
+    ).upper()
+    raw = os.environ.get(key) or os.environ.get("EASYDL_METRICS_PORT")
+    if raw is None:
+        return default
+    raw = raw.strip().lower()
+    if raw in ("off", "disabled", "none", "false"):
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return default
+    if port < 0:
+        return None
+    if port > 65535:  # a typo'd port must not take the service down
+        return default
+    return port
+
+
 def cpu_subprocess_env(
     n_devices: int, base: Optional[Mapping[str, str]] = None
 ) -> Dict[str, str]:

@@ -21,11 +21,14 @@ Usage::
 from __future__ import annotations
 
 import threading
+import time
 from concurrent import futures
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import grpc
+
+from easydl_tpu.obs import get_registry
 
 
 @dataclass(frozen=True)
@@ -34,6 +37,62 @@ class ServiceDef:
 
     name: str
     methods: Dict[str, Tuple[Any, Any]]
+
+
+# --------------------------------------------------------------- telemetry
+# Every RPC in the system flows through this module (servers via
+# _handlers_for, clients via RpcClient), so instrumenting here makes the
+# whole control plane's request counts / error counts / latency histograms
+# appear in each process' /metrics with zero per-service work. Interceptor
+# shape: the handler/stub callable is wrapped, not the grpc channel — this
+# codebase builds its own method tables, so the wrap IS the interceptor.
+_RPC_LABELS = ("service", "method")
+_rpc_metrics_cache: Dict[str, tuple] = {}
+
+
+def _rpc_metrics(side: str):
+    cached = _rpc_metrics_cache.get(side)
+    if cached is not None:
+        return cached
+    reg = get_registry()
+    _rpc_metrics_cache[side] = metrics = (
+        reg.counter(
+            f"easydl_rpc_{side}_requests_total",
+            f"RPCs handled ({side} side), by service/method.",
+            _RPC_LABELS,
+        ),
+        reg.counter(
+            f"easydl_rpc_{side}_errors_total",
+            f"RPCs that raised ({side} side), by service/method.",
+            _RPC_LABELS,
+        ),
+        reg.histogram(
+            f"easydl_rpc_{side}_latency_seconds",
+            f"RPC wall-clock latency ({side} side), by service/method.",
+            _RPC_LABELS,
+        ),
+    )
+    return metrics
+
+
+def _instrument(fn: Callable, side: str, service: str,
+                method: str) -> Callable:
+    requests, errors, latency = _rpc_metrics(side)
+
+    def wrapped(*args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        except Exception:
+            errors.inc(service=service, method=method)
+            raise
+        finally:
+            requests.inc(service=service, method=method)
+            latency.observe(
+                time.perf_counter() - t0, service=service, method=method
+            )
+
+    return wrapped
 
 
 class Server:
@@ -57,7 +116,7 @@ class Server:
 def _handlers_for(service: ServiceDef, impl: Any) -> grpc.GenericRpcHandler:
     table = {}
     for method, (req_cls, resp_cls) in service.methods.items():
-        fn = getattr(impl, method)
+        fn = _instrument(getattr(impl, method), "server", service.name, method)
         table[method] = grpc.unary_unary_rpc_method_handler(
             fn,
             request_deserializer=req_cls.FromString,
@@ -119,7 +178,7 @@ class RpcClient:
         def invoke(request, timeout_s: Optional[float] = None):
             return call(request, timeout=timeout_s or timeout)
 
-        return invoke
+        return _instrument(invoke, "client", self._service.name, method)
 
     def wait_ready(self, timeout: float = 10.0) -> None:
         grpc.channel_ready_future(self._channel).result(timeout=timeout)

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Poll every service's /metrics in a job and fold them into one snapshot.
+
+Discovery reads the address files the exporters publish under
+``<workdir>/obs/`` (the shared job workdir is the inventory — the same place
+master.json and the PS registry live), so against a fake-kube or local job::
+
+    python scripts/obs_scrape.py --workdir /tmp/job1
+
+prints one merged console snapshot: master generation/phase gauges, agent
+heartbeat cadence, PS table sizes, RPC latency histograms, train-loop
+throughput. Additional (or non-workdir) endpoints via ``--target``::
+
+    python scripts/obs_scrape.py --target master=localhost:9100 \
+        --target brain=10.0.0.7:9102 --json
+
+``--json`` emits the full machine-readable document
+(``{"services": {...}, "merged": {series: value}}``); ``--grep`` filters the
+console view; ``--watch N`` re-scrapes every N seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from easydl_tpu.obs.scrape import format_console, merge_snapshot  # noqa: E402
+
+
+def _parse_target(spec: str):
+    if "=" in spec:
+        component, address = spec.split("=", 1)
+    else:
+        component, address = spec, spec
+    return component.strip(), address.strip()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="merge every easydl service's /metrics into one snapshot"
+    )
+    ap.add_argument("--workdir", default="",
+                    help="job workdir; scrapes every exporter published "
+                         "under <workdir>/obs/")
+    ap.add_argument("--target", action="append", default=[],
+                    metavar="[NAME=]HOST:PORT",
+                    help="extra endpoint to scrape (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged snapshot as JSON")
+    ap.add_argument("--grep", default="",
+                    help="regex filter for the console metric listing")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="re-scrape every N seconds (0 = once)")
+    args = ap.parse_args()
+    if not args.workdir and not args.target:
+        ap.error("need --workdir and/or --target")
+    targets = dict(_parse_target(t) for t in args.target)
+
+    while True:
+        snap = merge_snapshot(workdir=args.workdir or None, targets=targets,
+                              timeout=args.timeout)
+        if args.json:
+            print(json.dumps(snap, indent=2, sort_keys=True))
+        else:
+            print(format_console(snap, pattern=args.grep or None))
+        if not args.watch:
+            break
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            break
+        print()
+    services = snap["services"]
+    if not services:
+        print("no targets found (is the job running? does <workdir>/obs/ "
+              "exist?)", file=sys.stderr)
+        return 1
+    return 0 if any(d.get("ok") for d in services.values()) else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,379 @@
+"""Unit tests for the telemetry layer (easydl_tpu/obs/).
+
+Registry semantics (labels, buckets, concurrency, name lint), the text
+exposition format pinned by a golden test, the HTTP exporter round trip
+(the tier-1 smoke: boot on port 0, scrape it), the RPC instrumentation in
+utils/rpc.py, the scrape/merge tooling, and the two cadence contracts that
+ride along this PR (heartbeat fast-follow, ckpt_interval disable value).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from easydl_tpu.obs import (
+    MetricsExporter,
+    MetricsRegistry,
+    start_exporter,
+    validate_label_name,
+    validate_metric_name,
+)
+from easydl_tpu.obs.scrape import discover, merge_snapshot, parse_text, scrape_target
+
+
+# --------------------------------------------------------------- name lint
+@pytest.mark.parametrize("name", [
+    "easydl_master_generation", "rpc:latency_seconds", "_private", "a1_b2",
+])
+def test_valid_metric_names(name):
+    assert validate_metric_name(name) == name
+
+
+@pytest.mark.parametrize("name", [
+    "easydl-master-generation",  # dashes
+    "1easydl_total",             # leading digit
+    "easydl total",              # space
+    "", None, "easydl{x}",
+])
+def test_invalid_metric_names_fail_at_registration(name):
+    with pytest.raises(ValueError):
+        validate_metric_name(name)
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter(name, "bad")
+
+
+@pytest.mark.parametrize("name", ["le_x", "job", "_a"])
+def test_valid_label_names(name):
+    assert validate_label_name(name) == name
+
+
+@pytest.mark.parametrize("name", ["__reserved", "a-b", "1a", ""])
+def test_invalid_label_names(name):
+    with pytest.raises(ValueError):
+        validate_label_name(name)
+    with pytest.raises(ValueError):
+        MetricsRegistry().gauge("easydl_g", "bad", (name,))
+
+
+# ---------------------------------------------------------------- registry
+def test_counter_labels_and_values():
+    reg = MetricsRegistry()
+    c = reg.counter("easydl_req_total", "reqs", ("svc",))
+    c.inc(svc="a")
+    c.inc(2.5, svc="a")
+    c.inc(svc="b")
+    assert c.value(svc="a") == 3.5
+    assert c.value(svc="b") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1, svc="a")  # counters are monotone
+    with pytest.raises(ValueError):
+        c.inc(other="a")  # undeclared label name
+    with pytest.raises(ValueError):
+        c.inc()  # missing label
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("easydl_g", "g")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 3
+
+
+def test_registration_idempotent_but_conflicts_raise():
+    reg = MetricsRegistry()
+    c1 = reg.counter("easydl_x_total", "x", ("a",))
+    c2 = reg.counter("easydl_x_total", "x", ("a",))
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        reg.gauge("easydl_x_total", "now a gauge")  # type conflict
+    with pytest.raises(ValueError):
+        reg.counter("easydl_x_total", "x", ("a", "b"))  # label conflict
+    h1 = reg.histogram("easydl_h_seconds", "h", buckets=(1, 5))
+    assert reg.histogram("easydl_h_seconds", "h", buckets=(1, 5)) is h1
+    with pytest.raises(ValueError):
+        # same name, different buckets: must conflict loudly, not silently
+        # keep the first shape (import order would decide the winner)
+        reg.histogram("easydl_h_seconds", "h", buckets=(0.1, 1))
+
+
+def test_histogram_bucket_semantics():
+    reg = MetricsRegistry()
+    h = reg.histogram("easydl_lat_seconds", "lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    s = h.samples()
+    assert s['easydl_lat_seconds_bucket{le="0.1"}'] == 1
+    assert s['easydl_lat_seconds_bucket{le="1"}'] == 3  # cumulative
+    assert s['easydl_lat_seconds_bucket{le="10"}'] == 4
+    assert s['easydl_lat_seconds_bucket{le="+Inf"}'] == 5
+    assert s["easydl_lat_seconds_count"] == 5
+    assert s["easydl_lat_seconds_sum"] == pytest.approx(56.05)
+
+
+def test_concurrent_increments_do_not_lose_updates():
+    reg = MetricsRegistry()
+    c = reg.counter("easydl_n_total", "n", ("who",))
+    h = reg.histogram("easydl_h_seconds", "h", buckets=(1,))
+    n, per = 8, 500
+
+    def worker(i):
+        for _ in range(per):
+            c.inc(who=str(i % 2))
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(who="0") + c.value(who="1") == n * per
+    assert h.samples()["easydl_h_seconds_count"] == n * per
+
+
+def test_exposition_golden():
+    """Pin the text format: HELP/TYPE headers, sorted families, label
+    escaping, histogram suffixes."""
+    reg = MetricsRegistry()
+    g = reg.gauge("easydl_b_gauge", "a gauge")
+    c = reg.counter("easydl_a_total", "a counter", ("svc",))
+    c.inc(3, svc='x"y\n')
+    g.set(1.5)
+    assert reg.render() == (
+        "# HELP easydl_a_total a counter\n"
+        "# TYPE easydl_a_total counter\n"
+        'easydl_a_total{svc="x\\"y\\n"} 3\n'
+        "# HELP easydl_b_gauge a gauge\n"
+        "# TYPE easydl_b_gauge gauge\n"
+        "easydl_b_gauge 1.5\n"
+    )
+
+
+# ---------------------------------------------------------------- exporter
+def test_exporter_round_trip_and_healthz(tmp_path):
+    """The tier-1 smoke test: boot an exporter on port 0, scrape it over
+    real HTTP, check /metrics, /healthz, 404, and workdir publication."""
+    reg = MetricsRegistry()
+    reg.gauge("easydl_up", "up").set(1)
+    exp = start_exporter("smoke", registry=reg, port=0,
+                         workdir=str(tmp_path),
+                         health_fn=lambda: {"generation": 7})
+    try:
+        assert exp.port > 0
+        body = urllib.request.urlopen(
+            f"http://{exp.address}/metrics", timeout=5).read().decode()
+        assert "easydl_up 1" in body
+        health = json.loads(urllib.request.urlopen(
+            f"http://{exp.address}/healthz", timeout=5).read())
+        assert health["ok"] is True
+        assert health["component"] == "smoke"
+        assert health["generation"] == 7
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{exp.address}/nope", timeout=5)
+        # discovery file published, readable, and retracted on stop
+        assert discover(str(tmp_path)) == {"smoke": exp.address}
+    finally:
+        exp.stop()
+    assert discover(str(tmp_path)) == {}
+
+
+def test_exporter_env_port_resolution(monkeypatch):
+    from easydl_tpu.utils.env import obs_port_from_env
+
+    monkeypatch.delenv("EASYDL_METRICS_PORT", raising=False)
+    assert obs_port_from_env("master") == 0
+    monkeypatch.setenv("EASYDL_METRICS_PORT", "9100")
+    assert obs_port_from_env("master") == 9100
+    monkeypatch.setenv("EASYDL_METRICS_PORT_MASTER", "9200")
+    assert obs_port_from_env("master") == 9200  # specific wins
+    assert obs_port_from_env("agent-a0", ) == 9100
+    monkeypatch.setenv("EASYDL_METRICS_PORT_AGENT_A0", "off")
+    assert obs_port_from_env("agent-a0") is None  # disabled
+    monkeypatch.setenv("EASYDL_METRICS_PORT", "-1")
+    assert obs_port_from_env("brain") is None
+    # disabled port -> start_exporter declines to start
+    assert start_exporter("brain") is None
+    # a typo'd out-of-range port degrades to the default, and even a bad
+    # explicit port must not raise out of start_exporter (the "metrics are
+    # never load-bearing" contract)
+    monkeypatch.setenv("EASYDL_METRICS_PORT", "70000")
+    assert obs_port_from_env("brain") == 0
+    assert start_exporter("bad-port", registry=MetricsRegistry(),
+                          port=70000) is None
+
+
+def test_advertised_host_override(monkeypatch):
+    reg = MetricsRegistry()
+    exp = MetricsExporter(registry=reg, component="multi")
+    try:
+        assert exp.address == f"localhost:{exp.port}"
+        monkeypatch.setenv("EASYDL_METRICS_HOST", "10.1.2.3")
+        assert exp.address == f"10.1.2.3:{exp.port}"
+    finally:
+        monkeypatch.delenv("EASYDL_METRICS_HOST", raising=False)
+        exp.stop()
+
+
+def test_unhealthy_health_fn_returns_503():
+    reg = MetricsRegistry()
+    exp = MetricsExporter(registry=reg, component="sick",
+                          health_fn=lambda: {"ok": False, "reason": "drain"})
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{exp.address}/healthz", timeout=5)
+        assert ei.value.code == 503
+    finally:
+        exp.stop()
+
+
+# ----------------------------------------------------------- rpc telemetry
+def test_rpc_interceptors_record_counts_errors_latency():
+    """Calls through utils/rpc.py fakes must land in the default registry:
+    per-method request counts, error counts, and latency histograms, on
+    BOTH the server and client side."""
+    from easydl_tpu.obs import get_registry
+    from easydl_tpu.proto import easydl_pb2 as pb
+    from easydl_tpu.utils.rpc import RpcClient, ServiceDef, serve
+
+    svc = ServiceDef("easydl.test.ObsEcho", {
+        "Report": (pb.StepMetrics, pb.Ack),
+    })
+
+    class Impl:
+        def Report(self, req, ctx):
+            if req.step == 13:
+                raise RuntimeError("unlucky")
+            return pb.Ack(ok=True)
+
+    def sample(key):
+        return get_registry().samples().get(key, 0.0)
+
+    labels = '{method="Report",service="easydl.test.ObsEcho"}'
+    before = {
+        side: (
+            sample(f"easydl_rpc_{side}_requests_total{labels}"),
+            sample(f"easydl_rpc_{side}_errors_total{labels}"),
+            sample(f"easydl_rpc_{side}_latency_seconds_count{labels}"),
+        )
+        for side in ("server", "client")
+    }
+    server = serve(svc, Impl())
+    client = RpcClient(svc, server.address)
+    try:
+        client.wait_ready()
+        for step in (1, 2):
+            assert client.Report(pb.StepMetrics(step=step)).ok
+        with pytest.raises(Exception):
+            client.Report(pb.StepMetrics(step=13))
+    finally:
+        client.close()
+        server.stop()
+    for side in ("server", "client"):
+        req0, err0, lat0 = before[side]
+        assert sample(
+            f"easydl_rpc_{side}_requests_total{labels}") == req0 + 3, side
+        assert sample(
+            f"easydl_rpc_{side}_errors_total{labels}") == err0 + 1, side
+        assert sample(
+            f"easydl_rpc_{side}_latency_seconds_count{labels}") == lat0 + 3, side
+        # latency sum is positive and sane (sub-minute for localhost calls)
+        assert 0 < sample(
+            f"easydl_rpc_{side}_latency_seconds_sum{labels}") < 60
+
+
+# ------------------------------------------------------------ scrape/merge
+def test_parse_text_normalises_label_order():
+    text = (
+        'a_total{b="2",a="1"} 3\n'
+        "# HELP x y\n"
+        "bad line\n"
+        "naked 1.5\n"
+    )
+    assert parse_text(text) == {'a_total{a="1",b="2"}': 3.0, "naked": 1.5}
+
+
+def test_merge_snapshot_across_services(tmp_path):
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.gauge("easydl_one", "1").set(1)
+    r2.gauge("easydl_two", "2").set(2)
+    # identical series across processes: additive kinds sum (fleet totals
+    # stay correct), gauges keep one value
+    r1.counter("easydl_req_total", "r").inc(3)
+    r2.counter("easydl_req_total", "r").inc(4)
+    r1.gauge("easydl_train_step", "s").set(10)
+    r2.gauge("easydl_train_step", "s").set(12)
+    e1 = start_exporter("svc-one", registry=r1, port=0, workdir=str(tmp_path))
+    e2 = start_exporter("svc-two", registry=r2, port=0, workdir=str(tmp_path))
+    try:
+        snap = merge_snapshot(workdir=str(tmp_path))
+        assert set(snap["services"]) == {"svc-one", "svc-two"}
+        assert all(d["ok"] for d in snap["services"].values())
+        assert snap["merged"]["easydl_one"] == 1.0
+        assert snap["merged"]["easydl_two"] == 2.0
+        assert snap["merged"]["easydl_req_total"] == 7.0  # summed
+        assert snap["merged"]["easydl_train_step"] in (10.0, 12.0)
+        # per-service views stay exact
+        assert snap["services"]["svc-one"]["metrics"]["easydl_req_total"] == 3.0
+    finally:
+        e1.stop()
+        e2.stop()
+    # dead targets are data points, not scrape failures
+    doc = scrape_target(e1.address, timeout=1.0)
+    assert doc["ok"] is False and "error" in doc
+
+
+def test_merge_does_not_double_count_cohosted_exporters(tmp_path):
+    """Two exporters in ONE process serving the SAME registry (a local job
+    with master + agent in-process) must contribute each additive series
+    once, not once per exporter — publications carry the pid."""
+    reg = MetricsRegistry()
+    reg.counter("easydl_shared_total", "s").inc(5)
+    e1 = start_exporter("co-one", registry=reg, port=0, workdir=str(tmp_path))
+    e2 = start_exporter("co-two", registry=reg, port=0, workdir=str(tmp_path))
+    try:
+        snap = merge_snapshot(workdir=str(tmp_path))
+        assert set(snap["services"]) == {"co-one", "co-two"}
+        assert snap["merged"]["easydl_shared_total"] == 5.0  # not 10
+    finally:
+        e1.stop()
+        e2.stop()
+
+
+# ------------------------------------------------------- cadence contracts
+def test_heartbeat_fast_follow_only_on_changes():
+    from easydl_tpu.elastic.agent import heartbeat_delay
+    from easydl_tpu.proto import easydl_pb2 as pb
+
+    NOOP, QUIESCE, RUN = (pb.DirectiveKind.NOOP, pb.DirectiveKind.QUIESCE,
+                          pb.DirectiveKind.RUN)
+    hb = 0.3
+    # transitions fast-follow
+    assert heartbeat_delay(NOOP, QUIESCE, False, hb) == 0.02
+    assert heartbeat_delay(QUIESCE, RUN, False, hb) == 0.02
+    assert heartbeat_delay(NOOP, NOOP, True, hb) == 0.02  # state change
+    # a HELD non-noop directive must NOT storm: modest floor, not 0.02
+    assert heartbeat_delay(QUIESCE, QUIESCE, False, hb) == 0.2
+    assert heartbeat_delay(QUIESCE, QUIESCE, False, 0.1) == 0.1
+    # steady-state noop keeps the configured interval
+    assert heartbeat_delay(NOOP, NOOP, False, hb) == hb
+
+
+def test_ckpt_interval_disable_and_schedules():
+    from easydl_tpu.elastic.worker import periodic_ckpt_due
+
+    # negative disables periodic saves entirely (the restored opt-out)
+    for step in range(1, 200):
+        due, nxt = periodic_ckpt_due(-1, step, 1, 5.0, 0.1)
+        assert due is False and nxt == 1
+    # positive pins the modulo schedule
+    assert periodic_ckpt_due(4, 8, 99, 5.0, 0.1)[0] is True
+    assert periodic_ckpt_due(4, 9, 99, 5.0, 0.1)[0] is False
+    # 0 = auto: wall-clock target; identical inputs -> identical schedule
+    due, nxt = periodic_ckpt_due(0, 10, 10, 5.0, 0.5)
+    assert due is True and nxt == 20  # 5s target / 0.5s steps = 10 steps
+    assert periodic_ckpt_due(0, 11, nxt, 5.0, 0.5) == (False, 20)

@@ -1,0 +1,123 @@
+"""Acceptance test for the unified telemetry layer (ISSUE 1): a live local
+job — real gRPC master, real agent thread, real worker subprocess — exposes
+discoverable /metrics + /healthz per service, and one merged
+scripts/obs_scrape.py snapshot shows the RPC latency histograms, the
+master's generation gauge, and the train-loop throughput gauges together.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from easydl_tpu.elastic.agent import Agent
+from easydl_tpu.elastic.master import Master
+from easydl_tpu.obs.scrape import discover, merge_snapshot, scrape_target
+
+JOB = "obs-e2e"
+CFG = {
+    "model": "mlp",
+    "model_kwargs": {"input_shape": [8, 8, 1], "features": [32, 32]},
+    "global_batch": 32,
+    # Long enough that the job is still LIVE while we scrape (the agent
+    # retracts its obs publication when it shuts down after DONE).
+    "total_steps": 100_000,
+    "ckpt_interval": 10,
+    "lr": 0.01,
+    "seed": 0,
+}
+
+
+def wait_for(cond, timeout=180.0, interval=0.2, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+@pytest.fixture
+def workdir(tmp_path):
+    return str(tmp_path)
+
+
+def test_merged_snapshot_from_live_job(workdir):
+    master = Master(
+        job_name=JOB, workdir=workdir, desired_workers=1, min_workers=1,
+        worker_config=CFG,
+    ).start()
+    agent = Agent("a0", master.address, workdir, slots=2).start()
+    try:
+        # Scrape the job LIVE (the operator's situation): wait until the
+        # worker is training and both services published their exporter
+        # addresses into the shared workdir — the scrape inventory needs
+        # no service registry.
+        wait_for(
+            lambda: master.status()["agents"].get("a0", {}).get("step", 0) >= 2,
+            desc="worker training",
+        )
+        wait_for(
+            lambda: {"master", "agent-a0"} <= set(discover(workdir)),
+            timeout=30, desc="obs publications",
+        )
+        # The agent bridges the worker's metrics JSONL into gauges on its
+        # next heartbeat; wait until the throughput gauge landed.
+        def agent_bridged():
+            m = merge_snapshot(workdir=workdir)["merged"]
+            return m.get(
+                'easydl_agent_worker_samples_per_sec{agent="a0"}', 0.0) > 0
+        wait_for(agent_bridged, timeout=30, desc="bridged worker gauges")
+
+        snap = merge_snapshot(workdir=workdir)
+        assert all(d["ok"] for d in snap["services"].values()), snap["services"]
+        merged = snap["merged"]
+
+        # 1) at least one RPC latency histogram, with real observations —
+        #    the master's server side of the heartbeat stream.
+        hb = 'easydl_rpc_server_latency_seconds_count{method="Heartbeat",service="easydl.Master"}'
+        assert merged.get(hb, 0) > 0, sorted(
+            k for k in merged if "latency" in k)
+        assert any("easydl_rpc_server_latency_seconds_bucket" in k
+                   for k in merged)
+
+        # 2) the master's generation gauge (one formed generation).
+        assert merged[f'easydl_master_generation{{job="{JOB}"}}'] >= 1
+
+        # 3) train-loop throughput gauges: the aggregate the master derived
+        #    from heartbeats AND the agent's bridge of the worker JSONL.
+        assert merged[f'easydl_master_train_samples_per_sec{{job="{JOB}"}}'] > 0
+        assert merged[f'easydl_master_train_step{{job="{JOB}"}}'] > 0
+        assert merged['easydl_agent_worker_samples_per_sec{agent="a0"}'] > 0
+
+        # heartbeat cadence is exported (the storm fix is observable): the
+        # steady-state rate must be far below the 50/s pre-fix storm.
+        rate = merged['easydl_agent_heartbeat_rate_per_s{agent="a0"}']
+        assert 0 < rate < 25, rate
+        assert merged['easydl_agent_heartbeats_total{agent="a0"}'] > 0
+
+        # /healthz per service carries component state.
+        health = scrape_target(discover(workdir)["master"])["health"]
+        assert health["ok"] and health["job"] == JOB
+
+        # The CLI produces the same merged document (fake-kube/local job →
+        # one JSON snapshot), and the console path renders.
+        proc = subprocess.run(
+            [sys.executable, os.path.join("scripts", "obs_scrape.py"),
+             "--workdir", workdir, "--json"],
+            capture_output=True, text=True, timeout=60,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["merged"][f'easydl_master_generation{{job="{JOB}"}}'] >= 1
+        assert any("easydl_rpc_server_latency_seconds" in k
+                   for k in doc["merged"])
+    finally:
+        agent.stop()
+        master.stop()
+    # exporters shut down with their services: publications retracted.
+    assert discover(workdir) == {}
