@@ -1,0 +1,52 @@
+"""Chaos: deterministic fault injection + recovery-invariant checking.
+
+The elasticity claims (survives preemption, auto-recovers, bounded lost
+work) are verified continuously by seed-deterministic drills instead of a
+one-off measurement:
+
+- :mod:`easydl_tpu.chaos.spec` — declarative scenarios compiled by a seeded
+  PRNG into byte-identical fault timelines;
+- :mod:`easydl_tpu.chaos.injectors` — env-gated hooks in the RPC layer,
+  agent, worker, and storage (all inert unless ``EASYDL_CHAOS_SPEC`` is
+  set);
+- :mod:`easydl_tpu.chaos.invariants` — post-run assertions over the job's
+  artifacts (target step reached, generation monotonic, bounded lost work,
+  membership convergence, no directive ping-pong);
+- :mod:`easydl_tpu.chaos.harness` — runs a scenario on the simulated
+  distributed runtime (``scripts/chaos_run.py`` is the CLI).
+
+This module stays import-light: services import it for the two functions
+below without pulling grpc/jax-adjacent machinery.
+"""
+
+from __future__ import annotations
+
+import os
+
+from easydl_tpu.chaos.spec import (  # noqa: F401 (public API)
+    ChaosSpec,
+    FaultSpec,
+    compile_schedule,
+    schedule_bytes,
+)
+
+ENV_VAR = "EASYDL_CHAOS_SPEC"
+
+
+def chaos_enabled() -> bool:
+    """The one cheap flag check every hook point gates on."""
+    return bool(os.environ.get(ENV_VAR))
+
+
+def banner(component: str) -> None:
+    """Loud one-liner each long-running service logs at startup when fault
+    injection is armed — an operator must never discover a chaos drill from
+    the failures themselves."""
+    if chaos_enabled():
+        from easydl_tpu.utils.logging import get_logger
+
+        get_logger("chaos", component).warning(
+            "CHAOS FAULT INJECTION ARMED in %s (EASYDL_CHAOS_SPEC=%s) — "
+            "this process may be injected with failures",
+            component, os.environ.get(ENV_VAR),
+        )

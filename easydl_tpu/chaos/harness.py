@@ -1,0 +1,636 @@
+"""ChaosHarness: run one scenario end-to-end on the simulated-distributed
+runtime and return an invariant verdict.
+
+Same machinery as scripts/measure_recovery.py — a real gRPC Master,
+in-process Agents, real jax.distributed worker subprocesses on the forced
+CPU mesh, optional real PS pods launched through the controller's
+:class:`LocalProcessPodApi` — plus:
+
+1. the compiled fault schedule written to ``<workdir>/chaos-plan.json`` and
+   armed via ``EASYDL_CHAOS_SPEC`` *before* any service starts (worker and
+   PS subprocesses inherit the env);
+2. ``t0`` stamped into the plan file once the job reaches steady state —
+   inline injectors in every process pick it up on their next gate call;
+3. process-class events (SIGKILL/SIGSTOP worker, agent stop, PS-pod crash +
+   rescue, checkpoint corruption) executed by the harness at their
+   scheduled offsets through the agent / controller process APIs;
+4. the invariant checker (chaos/invariants.py) run over the artifacts, and
+   the verdict returned as one JSON-serializable document.
+
+Scenario catalog at the bottom: the five canonical drills the acceptance
+criteria name, shared by tests/test_chaos_e2e.py and scripts/chaos_run.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+from easydl_tpu.chaos import injectors, invariants
+from easydl_tpu.chaos.spec import (
+    ChaosSpec, FaultSpec, compile_schedule, process_events,
+)
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("chaos", "harness")
+
+
+@dataclass
+class Scenario:
+    """One runnable drill: the job to run, the faults to inject, and the
+    invariants the recovered job must satisfy."""
+
+    chaos: ChaosSpec
+    job_cfg: Dict[str, Any]
+    expect: Dict[str, Any]
+    n_agents: int = 2
+    #: plan-desired worker count (default: n_agents). The drills run
+    #: member+standby topologies with desired_workers=1: this container's
+    #: jax build has no cross-PROCESS CPU collectives (multi-device worlds
+    #: via ``slots`` are fine), so every generation is one worker process —
+    #: the same recovery machinery, world-1 shaped.
+    desired_workers: Optional[int] = None
+    slots: int = 1
+    master_kwargs: Dict[str, Any] = field(default_factory=dict)
+    #: min step every member must reach before t0 is stamped
+    steady_step: int = 5
+    steady_timeout_s: float = 240.0
+    done_timeout_s: float = 300.0
+    ps_shards: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.chaos.name
+
+
+def _wait_for(cond: Callable[[], bool], timeout: float, desc: str,
+              interval: float = 0.2) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f"chaos harness: timed out waiting for {desc}")
+
+
+def _write_plan(path: str, schedule: Mapping[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(schedule, f, sort_keys=True)
+    os.replace(tmp, path)
+
+
+class ChaosHarness:
+    """Runs one :class:`Scenario`; single-use."""
+
+    def __init__(self, scenario: Scenario, workdir: Optional[str] = None):
+        self.scenario = scenario
+        self.workdir = workdir or tempfile.mkdtemp(
+            prefix=f"chaos-{scenario.name}-")
+        self.schedule = compile_schedule(scenario.chaos)
+        self._agents: Dict[str, Any] = {}
+        self._master = None
+        self._pod_api = None
+
+    # ------------------------------------------------------------- lifecycle
+    def run(self) -> Dict[str, Any]:
+        sc = self.scenario
+        plan_path = os.path.join(self.workdir, "chaos-plan.json")
+        _write_plan(plan_path, self.schedule)
+        env_before = os.environ.get(injectors.ENV_VAR)
+        os.environ[injectors.ENV_VAR] = plan_path
+        # Drills respawn workers constantly, and on this container's old
+        # kernel XLA:CPU segfaults deserializing a persistent-compile-cache
+        # entry another process wrote — run every drill with the cache off
+        # (each respawn pays a clean test-scale compile, ~1s).
+        cache_before = os.environ.get("EASYDL_COMPILE_CACHE")
+        os.environ["EASYDL_COMPILE_CACHE"] = "off"
+        t_start = time.monotonic()
+        status: Dict[str, Any] = {}
+        # The registry counter is process-cumulative; without a baseline a
+        # later scenario's faults_observed check could be satisfied by an
+        # EARLIER scenario's injections in the same process (chaos_run.py
+        # runs the whole catalog in one) — the verdict must carry only this
+        # run's deltas.
+        counts_before = injectors.injected_fault_counts()
+        try:
+            self._launch_ps()
+            self._launch_job()
+            self._wait_steady()
+            # Arm the timeline: every process (this one AND the worker/PS
+            # subprocesses, which stat the plan file on each gate call)
+            # sees the same t0.
+            t0 = time.time()
+            self.schedule = dict(self.schedule, t0=t0)
+            _write_plan(plan_path, self.schedule)
+            log.info("scenario %s armed at t0=%.3f (%d events)",
+                     sc.name, t0, len(self.schedule["events"]))
+            self._execute_process_events(t0)
+            self._wait_done()
+            status = self._master.status()
+            subprocess_counts = self._scrape_subprocess_faults()
+        finally:
+            self._teardown()
+            if env_before is None:
+                os.environ.pop(injectors.ENV_VAR, None)
+            else:
+                os.environ[injectors.ENV_VAR] = env_before
+            if cache_before is None:
+                os.environ.pop("EASYDL_COMPILE_CACHE", None)
+            else:
+                os.environ["EASYDL_COMPILE_CACHE"] = cache_before
+        fault_counts = {
+            kind: count - counts_before.get(kind, 0.0)
+            for kind, count in injectors.injected_fault_counts().items()
+            if count - counts_before.get(kind, 0.0) > 0
+        }
+        for kind, count in subprocess_counts.items():
+            fault_counts[kind] = fault_counts.get(kind, 0.0) + count
+        verdict = invariants.check_scenario(
+            self.workdir, sc.expect, status=status,
+            fault_counts=fault_counts,
+        )
+        _scenario_counter().inc(scenario=sc.name,
+                                result="pass" if verdict["passed"] else "fail")
+        return {
+            "scenario": sc.name,
+            "seed": sc.chaos.seed,
+            "notes": sc.chaos.notes,
+            "workdir": self.workdir,
+            "wall_s": round(time.monotonic() - t_start, 2),
+            "schedule": self.schedule,
+            "expect": dict(sc.expect),
+            "faults_injected": fault_counts,
+            "final_status": status,
+            "invariants": verdict,
+            "passed": verdict["passed"],
+        }
+
+    # --------------------------------------------------------------- helpers
+    def _launch_ps(self) -> None:
+        sc = self.scenario
+        if not sc.ps_shards:
+            return
+        from easydl_tpu.controller.pod_api import Pod
+        from easydl_tpu.controller.process_pod_api import LocalProcessPodApi
+        from easydl_tpu.ps import registry as ps_registry
+
+        self._pod_api = LocalProcessPodApi(self.workdir)
+        for i in range(sc.ps_shards):
+            self._pod_api.create_pod(Pod(
+                name=f"{sc.name}-ps-{i}", job=sc.name, role="parameter_server",
+                command=(
+                    f"{sys.executable} -m easydl_tpu.ps --name {sc.name}-ps-{i}"
+                    f" --workdir {self.workdir} --num-shards {sc.ps_shards}"
+                    f" --shard-index {i}"
+                ),
+            ))
+        ps_registry.addresses(self.workdir, sc.ps_shards, timeout=60.0)
+
+    def _launch_job(self) -> None:
+        from easydl_tpu.elastic.agent import Agent
+        from easydl_tpu.elastic.master import Master
+
+        sc = self.scenario
+        master_kwargs = dict(
+            desired_workers=sc.desired_workers or sc.n_agents,
+            min_workers=1, heartbeat_timeout=2.0, prepare_timeout_s=0.0,
+        )
+        master_kwargs.update(sc.master_kwargs)
+        self._master = Master(
+            job_name=sc.name, workdir=self.workdir,
+            worker_config=sc.job_cfg, **master_kwargs,
+        ).start()
+        for i in range(sc.n_agents):
+            aid = f"a{i}"
+            self._agents[aid] = Agent(
+                aid, self._master.address, self.workdir, slots=sc.slots,
+            ).start()
+            if i == 0:
+                # Stagger: a0 registers (and, with min_workers=1, becomes
+                # the member) before any standby shows up — scenarios can
+                # then target "the member" as a0 deterministically.
+                _wait_for(
+                    lambda: "a0" in self._master.status()["agents"],
+                    30.0, "a0 to register first",
+                )
+
+    def _wait_steady(self) -> None:
+        sc = self.scenario
+
+        def steady() -> bool:
+            st = self._master.status()
+            return bool(st["members"]) and all(
+                st["agents"].get(m, {}).get("step", 0) >= sc.steady_step
+                for m in st["members"]
+            )
+
+        _wait_for(steady, sc.steady_timeout_s,
+                  f"steady state (every member past step {sc.steady_step})")
+
+    def _wait_done(self) -> None:
+        sc = self.scenario
+        if not self._master.wait_done(timeout=sc.done_timeout_s):
+            log.warning("scenario %s: job not DONE after %.0fs: %s",
+                        sc.name, sc.done_timeout_s, self._master.status())
+
+    def _teardown(self) -> None:
+        for a in self._agents.values():
+            try:
+                a.stop()
+            except Exception:
+                pass
+        if self._master is not None:
+            self._master.stop()
+        if self._pod_api is not None:
+            self._pod_api.shutdown()
+
+    def _scrape_subprocess_faults(self) -> Dict[str, float]:
+        """Chaos counters injected in OTHER processes (PS pods export under
+        the workdir; their per-run registries are fresh, so cumulative ==
+        this scenario). The harness process' own exporters are excluded —
+        its counters are accounted as deltas against the pre-run baseline.
+        Worker subprocesses run no exporter, so worker-side inline faults
+        (straggler, ckpt_corrupt_write) are NOT visible here; scenarios
+        relying on them should not set ``min_faults`` on those kinds."""
+        from easydl_tpu.obs import scrape
+
+        out: Dict[str, float] = {}
+        try:
+            pid = os.getpid()
+            for component, doc in scrape.discover_docs(self.workdir).items():
+                if doc.get("pid") == pid:
+                    continue
+                target = scrape.scrape_target(str(doc.get("address", "")),
+                                              timeout=2.0)
+                if not target.get("ok"):
+                    continue
+                for kind, count in injectors.parse_fault_kind_counts(
+                        target["metrics"]).items():  # type: ignore[arg-type]
+                    out[kind] = out.get(kind, 0.0) + count
+        except Exception as e:  # counting is evidence, never a crash
+            log.warning("subprocess fault scrape failed: %s", e)
+        return out
+
+    # ------------------------------------------------------- process events
+    def _execute_process_events(self, t0: float) -> None:
+        for ev in process_events(self.schedule):
+            delay = (t0 + ev["start_s"]) - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                self._dispatch(ev)
+            except Exception as e:
+                # An undeliverable fault (target already dead) is evidence,
+                # not a harness crash — the invariants decide the verdict.
+                log.warning("event %s (%s) failed: %s", ev["id"],
+                            ev["kind"], e)
+
+    def _dispatch(self, ev: Mapping[str, Any]) -> None:
+        kind, target = ev["kind"], ev.get("target", {})
+        params = ev.get("params", {})
+        log.info("chaos event %s: %s target=%s", ev["id"], kind, target)
+        if kind == "worker_kill":
+            agent = self._agents[target["agent"]]
+            if agent.worker_pid is None:
+                # Counting a kill that hit nothing would let a drill "pass"
+                # without ever injecting its fault (job already done, or
+                # worker dead for another reason) — fail the event loudly
+                # and let the faults_observed invariant fail the verdict.
+                raise RuntimeError(
+                    f"worker_kill: no live worker on {target['agent']}")
+            agent.kill_worker_hard()
+            injectors.count_fault(kind)
+        elif kind == "worker_pause":
+            agent = self._agents[target["agent"]]
+            if agent.pause_worker():
+                injectors.count_fault(kind)
+                # resume on a timer, NOT an inline sleep: blocking the
+                # event-execution thread would shift every later scheduled
+                # event by the pause duration, silently violating the
+                # compiled timeline the subsystem promises
+                import threading
+
+                t = threading.Timer(float(params.get("duration_s", 1.0)),
+                                    agent.resume_worker)
+                t.daemon = True
+                t.start()
+        elif kind == "agent_stop":
+            self._agents[target["agent"]].stop()
+            injectors.count_fault(kind)
+        elif kind == "ps_kill":
+            self._ps_crash_and_rescue(int(target["shard"]),
+                                      float(params.get("respawn_after_s", 0.5)))
+        elif kind == "corrupt_latest_ckpt":
+            self._corrupt_latest_ckpt(str(params.get("mode", "truncate")))
+        else:
+            raise ValueError(f"unknown process event kind {kind!r}")
+
+    def _ps_crash_and_rescue(self, shard: int, respawn_after_s: float) -> None:
+        """SIGKILL the pod serving ``shard``, then level in a fresh rescue
+        pod (no --shard-index: it probes the registry, claims the orphan,
+        and restores from the last ps-ckpt — exactly the reconciler's
+        failure-replacement path)."""
+        from easydl_tpu.controller.pod_api import Pod
+
+        sc = self.scenario
+        name = f"{sc.name}-ps-{shard}"
+        entry = self._pod_api._procs.get(name)  # harness-only: raw handle
+        if entry is None or entry.proc.poll() is not None:
+            raise RuntimeError(f"ps pod {name} not running")
+        entry.proc.kill()
+        entry.proc.wait()
+        injectors.count_fault("ps_kill")
+        self._pod_api.poll()  # observe Failed
+        self._pod_api.delete_pod(name)
+        time.sleep(respawn_after_s)
+        self._pod_api.create_pod(Pod(
+            name=f"{sc.name}-ps-rescue-{shard}", job=sc.name,
+            role="parameter_server",
+            command=(
+                f"{sys.executable} -m easydl_tpu.ps"
+                f" --name {sc.name}-ps-rescue-{shard}"
+                f" --workdir {self.workdir} --num-shards {sc.ps_shards}"
+            ),
+        ))
+
+    def _corrupt_latest_ckpt(self, mode: str) -> None:
+        """Damage every chunk of the newest COMMITTED step — in shared
+        storage AND in the host-local chunk cache (the bytes are bad
+        everywhere; a pristine tmpfs copy must not mask the fault)."""
+        ckpt_dir = os.path.join(self.workdir, "ckpt")
+        steps = sorted(
+            n for n in (os.listdir(ckpt_dir) if os.path.isdir(ckpt_dir) else [])
+            if n.startswith("step_")
+            and os.path.exists(os.path.join(ckpt_dir, n, "COMMITTED"))
+        )
+        if not steps:
+            raise RuntimeError("corrupt_latest_ckpt: no committed step yet")
+        step_dir = os.path.join(ckpt_dir, steps[-1])
+        hit = 0
+        for root, _dirs, files in os.walk(step_dir):
+            for fn in files:
+                if fn.endswith(".npy"):
+                    if injectors.corrupt_file(os.path.join(root, fn),
+                                              mode=mode):
+                        hit += 1
+        # The cache token leads with the step number (chunk_cache.py).
+        from easydl_tpu.core.chunk_cache import ChunkCache
+
+        cache = ChunkCache.for_directory(ckpt_dir)
+        step_prefix = steps[-1][len("step_"):]
+        if cache is not None and os.path.isdir(cache.root):
+            for token in os.listdir(cache.root):
+                if not token.startswith(step_prefix):
+                    continue
+                for root, _dirs, files in os.walk(
+                        os.path.join(cache.root, token)):
+                    for fn in files:
+                        injectors.corrupt_file(os.path.join(root, fn),
+                                               mode=mode)
+        if hit == 0:
+            raise RuntimeError(f"no chunks corrupted under {step_dir}")
+        injectors.count_fault("corrupt_latest_ckpt")
+        log.info("corrupted %d chunks of %s (%s)", hit, step_dir, mode)
+
+
+_scenario_counter_cached = None
+
+
+def _scenario_counter():
+    global _scenario_counter_cached
+    if _scenario_counter_cached is None:
+        from easydl_tpu.obs import get_registry
+
+        _scenario_counter_cached = get_registry().counter(
+            "easydl_chaos_scenarios_run_total",
+            "Chaos scenarios executed, by scenario and verdict.",
+            ("scenario", "result"),
+        )
+    return _scenario_counter_cached
+
+
+# ---------------------------------------------------------------------------
+# Scenario catalog — the five canonical drills (acceptance criteria).
+# ---------------------------------------------------------------------------
+
+_MLP_CFG = {
+    "model": "mlp",
+    "model_kwargs": {"input_shape": [8, 8, 1], "features": [32, 32]},
+    "global_batch": 32,
+    "lr": 0.01,
+    "seed": 0,
+}
+
+
+def scenario_worker_kill(seed: int = 7) -> Scenario:
+    """SIGKILL the member's worker mid-run, no notice — the classic
+    preemption. Fast (the tier-1 drill): a standby agent is up, the master
+    detects the crash, reshapes once, and the job finishes with at most
+    ckpt_interval steps lost."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="worker_kill", seed=seed,
+            notes="SIGKILL the member (a0) worker just after steady state",
+            faults=(
+                FaultSpec(kind="worker_kill", at_s=0.3,
+                          target={"agent": "a0"}),
+            ),
+        ),
+        # Steps run at hundreds/s on CPU — the job must be big enough to
+        # still be mid-run when the kill fires (a done job makes the kill
+        # a no-op, which worker_kill dispatch + faults_observed then FAIL).
+        job_cfg=dict(_MLP_CFG, total_steps=3000, ckpt_interval=150),
+        n_agents=2, desired_workers=1, slots=1, steady_step=5,
+        master_kwargs={"min_workers": 1, "heartbeat_timeout": 2.0},
+        expect={
+            "target_step": 3000,
+            # One interval of work-at-risk plus the async save that may be
+            # mid-commit when the kill lands (docs/design/chaos.md) — the
+            # bound is 2×ckpt_interval, and the checker holds it exactly.
+            "max_steps_lost": 300,
+            "final_workers": 1,
+            "final_world_devices": 1,
+            "max_reshapes": 2,
+            "min_final_generation": 2,    # the kill really forced a reshape
+            "min_faults": 1,
+        },
+    )
+
+
+def scenario_heartbeat_loss(seed: int = 11) -> Scenario:
+    """Agent hang: the member's heartbeats are suppressed past the
+    eviction threshold — its worker keeps training (the zombie window) but
+    the master hears nothing, evicts it, and the standby takes over. When
+    the suppression lifts, the returning agent's stale worker must be
+    killed, not adopted."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="heartbeat_loss", seed=seed,
+            notes="suppress a0 heartbeats for 4.5s against a 2s timeout",
+            faults=(
+                FaultSpec(kind="heartbeat_suppress", at_s=0.0,
+                          duration_s=4.5, target={"agent": "a0"}),
+            ),
+        ),
+        # Big enough that the zombie (which trains at full speed through
+        # the whole suppression window) cannot finish the job before the
+        # standby takes over.
+        job_cfg=dict(_MLP_CFG, total_steps=6000, ckpt_interval=300),
+        n_agents=2, desired_workers=1, slots=1, steady_step=5,
+        master_kwargs={"min_workers": 1, "heartbeat_timeout": 2.0},
+        done_timeout_s=420.0,
+        expect={
+            "target_step": 6000,
+            "max_steps_lost": 600,        # 2×ckpt_interval (async commit)
+            "final_workers": 1,
+            "final_world_devices": 1,
+            "max_reshapes": 2,            # evict (+1 margin); NO flapping
+            "min_final_generation": 2,    # the eviction really reshaped
+            "min_faults": 3,              # several suppressed heartbeats
+        },
+    )
+
+
+def scenario_rpc_burst(seed: int = 13) -> Scenario:
+    """Network blip: every agent→master RPC is delayed then dropped for a
+    burst SHORTER than the eviction threshold. The retry/backoff path must
+    ride it out with ZERO reshapes — a spurious generation switch here is
+    the directive ping-pong this invariant exists to catch."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="rpc_burst", seed=seed,
+            notes="2.5s drop + 1s delay burst on client→Master RPCs, "
+                  "below the 6s eviction threshold",
+            faults=(
+                FaultSpec(kind="rpc_delay", at_s=0.0, duration_s=1.0,
+                          target={"side": "client",
+                                  "service": "easydl.Master"},
+                          params={"delay_s": 0.1}),
+                FaultSpec(kind="rpc_drop", at_s=1.0, duration_s=2.5,
+                          target={"side": "client",
+                                  "service": "easydl.Master"}),
+            ),
+        ),
+        job_cfg=dict(_MLP_CFG, total_steps=4000, ckpt_interval=200),
+        n_agents=1, slots=1, steady_step=5,
+        master_kwargs={"min_workers": 1, "heartbeat_timeout": 6.0},
+        expect={
+            "target_step": 4000,
+            "max_steps_lost": 0,          # nothing may die
+            "final_workers": 1,
+            "final_world_devices": 1,
+            "max_reshapes": 0,            # the whole point
+            "min_faults": 2,
+        },
+    )
+
+
+def scenario_ps_shard_crash(seed: int = 17) -> Scenario:
+    """PS-shard crash under a live config-5 job: SIGKILL shard 1's pod; a
+    rescue pod claims the orphan, restores the last sparse snapshot, and
+    republishes; the worker's pull/push retry + registry reroute ride the
+    outage without a single worker generation switch."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="ps_shard_crash", seed=seed,
+            notes="SIGKILL ps shard 1, rescue pod levels in after 0.5s",
+            faults=(
+                FaultSpec(kind="ps_kill", at_s=0.3, target={"shard": 1},
+                          params={"respawn_after_s": 0.5}),
+            ),
+        ),
+        job_cfg={
+            "model": "widedeep",
+            "model_kwargs": {"embedding": "ps", "vocab": 2000, "dim": 8,
+                             "hidden": [32], "num_sparse": 5,
+                             "num_dense": 4},
+            "global_batch": 32, "total_steps": 600, "ckpt_interval": 100,
+            "lr": 3e-3, "seed": 0,
+        },
+        # steady past the first dense+sparse snapshot (step 100), so the
+        # rescue pod has a real ps-ckpt to restore — the zero-snapshot
+        # "rescued shard starts empty" path is not what this drill pins.
+        n_agents=1, slots=2, steady_step=150, ps_shards=2,
+        master_kwargs={"min_workers": 1, "heartbeat_timeout": 30.0},
+        done_timeout_s=420.0,
+        expect={
+            "target_step": 600,
+            "final_workers": 1,
+            "final_world_devices": 2,
+            "max_reshapes": 0,            # survives in place, no reshape
+            "min_faults": 1,
+        },
+    )
+
+
+def scenario_ckpt_corrupt(seed: int = 23) -> Scenario:
+    """Corrupted latest checkpoint: truncate every chunk of the newest
+    committed step (storage AND chunk cache), then SIGKILL the worker. The
+    restore must detect the damage, quarantine the step, and fall back to
+    the previous committed one — paying at most one extra ckpt_interval."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="ckpt_corrupt", seed=seed,
+            notes="truncate newest committed ckpt, then SIGKILL the worker",
+            faults=(
+                FaultSpec(kind="corrupt_latest_ckpt", at_s=0.0,
+                          params={"mode": "truncate"}),
+                # kill 0.2s later — well inside the ~2s save cadence, so a
+                # FRESH commit cannot slip in between and mask the
+                # corruption before the restore sees it
+                FaultSpec(kind="worker_kill", at_s=0.2,
+                          target={"agent": "a0"}),
+            ),
+        ),
+        job_cfg=dict(_MLP_CFG, total_steps=4000, ckpt_interval=1000),
+        # steady past the SECOND commit (steps 1000 and 2000): the restore
+        # must have an older intact step to fall back to
+        n_agents=1, slots=1, steady_step=2100,
+        master_kwargs={"min_workers": 1, "heartbeat_timeout": 2.0},
+        steady_timeout_s=300.0,
+        expect={
+            "target_step": 4000,
+            "max_steps_lost": 3000,       # 3 × ckpt_interval: the fallback
+                                          # pays the quarantined interval on
+                                          # top of the async-commit window
+            "final_workers": 1,
+            "final_world_devices": 1,
+            "max_reshapes": 2,
+            "min_final_generation": 2,
+            "min_faults": 2,
+        },
+    )
+
+
+#: name → builder(seed) for scripts/chaos_run.py and the e2e tests.
+SCENARIOS: Dict[str, Callable[..., Scenario]] = {
+    "worker_kill": scenario_worker_kill,
+    "heartbeat_loss": scenario_heartbeat_loss,
+    "rpc_burst": scenario_rpc_burst,
+    "ps_shard_crash": scenario_ps_shard_crash,
+    "ckpt_corrupt": scenario_ckpt_corrupt,
+}
+
+#: the cheapest deterministic drill — what scripts/chaos_smoke.sh runs and
+#: what tier-1 exercises (the rest are @pytest.mark.slow/chaos).
+FAST_SCENARIO = "worker_kill"
+
+
+def run_scenario(name: str, seed: Optional[int] = None,
+                 workdir: Optional[str] = None,
+                 keep_workdir: bool = False) -> Dict[str, Any]:
+    builder = SCENARIOS[name]
+    scenario = builder(seed) if seed is not None else builder()
+    harness = ChaosHarness(scenario, workdir=workdir)
+    try:
+        return harness.run()
+    finally:
+        if not keep_workdir and workdir is None:
+            shutil.rmtree(harness.workdir, ignore_errors=True)

@@ -1,0 +1,288 @@
+"""Runtime half of the chaos subsystem: plan loading + hook-point gates.
+
+Hook points in production code (utils/rpc.py, elastic/agent.py,
+elastic/worker.py, core/storage.py) call into here ONLY after an
+``os.environ.get("EASYDL_CHAOS_SPEC")`` flag check — with the env var unset
+this module is never imported and the hot paths pay one dict lookup, nothing
+more (asserted by tests/test_chaos.py's inertness test).
+
+``EASYDL_CHAOS_SPEC`` names the compiled-schedule JSON the harness wrote
+(chaos/spec.py). The plan is cached per (path, mtime): the harness stamps
+``t0`` into the file once the job is steady, and every process — including
+worker subprocesses that inherited the env — picks the activation up on its
+next gate call. A plan whose ``t0`` is null is armed but inert.
+
+Every injected fault increments
+``easydl_chaos_faults_injected_total{kind=...}`` in the process-local obs
+registry, so injected faults are visible in merged scrapes and scenario
+verdicts can cross-check "the schedule said N faults" against "the fleet
+observed N faults".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Mapping, Optional
+
+import grpc
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("chaos", "injectors")
+
+ENV_VAR = "EASYDL_CHAOS_SPEC"
+
+
+class ChaosUnavailable(grpc.RpcError):
+    """Injected transport failure. Shaped like a real UNAVAILABLE RpcError
+    (``.code()`` answers) so retry layers classify it exactly as they would
+    a genuine connection loss — the point is to exercise THEIR paths."""
+
+    def __init__(self, detail: str):
+        super().__init__(detail)
+        self._detail = detail
+
+    def code(self) -> grpc.StatusCode:
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self) -> str:
+        return self._detail
+
+
+def _splitmix64(x: int) -> int:
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+class ChaosPlan:
+    """A loaded, parsed schedule. Matching is pure; the only state is the
+    per-event call counter feeding deterministic probability decisions."""
+
+    def __init__(self, doc: Mapping[str, Any]):
+        self.scenario = str(doc.get("scenario", ""))
+        self.seed = int(doc.get("seed", 0))
+        t0 = doc.get("t0")
+        self.t0: Optional[float] = float(t0) if t0 is not None else None
+        self.events: List[Dict[str, Any]] = list(doc.get("events", []))
+        self._by_kind: Dict[str, List[Dict[str, Any]]] = {}
+        for ev in self.events:
+            self._by_kind.setdefault(str(ev["kind"]), []).append(ev)
+        self._calls: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- matching
+    @staticmethod
+    def _match(target: Mapping[str, Any], attrs: Mapping[str, Any]) -> bool:
+        for key, want in target.items():
+            if want in ("*", None):
+                continue
+            if key == "path_contains":
+                if str(want) not in str(attrs.get("path", "")):
+                    return False
+                continue
+            if key not in attrs or str(attrs[key]) != str(want):
+                return False
+        return True
+
+    def _decide(self, ev: Mapping[str, Any]) -> bool:
+        p = float(ev.get("params", {}).get("p", 1.0))
+        if p >= 1.0:
+            return True
+        with self._lock:
+            n = self._calls.get(int(ev["id"]), 0)
+            self._calls[int(ev["id"])] = n + 1
+        # Deterministic given call ordering: no wall clock, no global RNG.
+        h = _splitmix64((self.seed << 20) ^ (int(ev["id"]) << 10) ^ n)
+        return (h / 2**64) < p
+
+    def active(self, kind: str, now: Optional[float] = None,
+               **attrs: Any) -> Optional[Dict[str, Any]]:
+        """The first event of ``kind`` whose window covers ``now`` and whose
+        target matches ``attrs`` (and whose probability draw fires)."""
+        if self.t0 is None:
+            return None
+        now = time.time() if now is None else now
+        for ev in self._by_kind.get(kind, ()):
+            if (self.t0 + ev["start_s"] <= now < self.t0 + ev["end_s"]
+                    and self._match(ev.get("target", {}), attrs)
+                    and self._decide(ev)):
+                return ev
+        return None
+
+
+# ------------------------------------------------------------- plan cache
+_cache_lock = threading.Lock()
+_cache: Dict[str, Any] = {"path": None, "mtime": None, "plan": None}
+
+
+def current_plan() -> Optional[ChaosPlan]:
+    """The active plan, reloaded when the spec file changes (the harness
+    stamps t0 in place). Unreadable/absent file → None: fault injection
+    must degrade to 'no faults', never take the host process down."""
+    path = os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return None
+    with _cache_lock:
+        if _cache["path"] == path and _cache["mtime"] == mtime:
+            return _cache["plan"]
+    try:
+        with open(path) as f:
+            plan = ChaosPlan(json.load(f))
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        log.warning("unreadable chaos spec %s: %s", path, e)
+        plan = None
+    with _cache_lock:
+        _cache.update(path=path, mtime=mtime, plan=plan)
+    return plan
+
+
+# ------------------------------------------------------------- obs counters
+_metrics_lock = threading.Lock()
+_fault_counter = None
+
+
+def count_fault(kind: str) -> None:
+    """Increment ``easydl_chaos_faults_injected_total{kind=...}``."""
+    global _fault_counter
+    with _metrics_lock:
+        if _fault_counter is None:
+            from easydl_tpu.obs import get_registry
+
+            _fault_counter = get_registry().counter(
+                "easydl_chaos_faults_injected_total",
+                "Chaos faults injected in this process, by kind.",
+                ("kind",),
+            )
+    _fault_counter.inc(kind=kind)
+
+
+FAULT_COUNTER_NAME = "easydl_chaos_faults_injected_total"
+
+
+def parse_fault_kind_counts(samples: Mapping[str, float]) -> Dict[str, float]:
+    """Fold flat ``{series: value}`` samples into ``{kind: count}`` for the
+    chaos fault counter — the ONE copy of the label parsing, shared by the
+    in-process reader below and the harness's subprocess scrape."""
+    out: Dict[str, float] = {}
+    for series, value in samples.items():
+        if series.startswith(FAULT_COUNTER_NAME + "{") and 'kind="' in series:
+            kind = series.split('kind="', 1)[1].split('"', 1)[0]
+            out[kind] = out.get(kind, 0.0) + float(value)
+    return out
+
+
+def injected_fault_counts() -> Dict[str, float]:
+    """{kind: count} from this process' registry (verdict cross-check)."""
+    from easydl_tpu.obs import get_registry
+
+    fam = get_registry().get(FAULT_COUNTER_NAME)
+    if fam is None:
+        return {}
+    return parse_fault_kind_counts(fam.samples())
+
+
+# ---------------------------------------------------------------- rpc hook
+def rpc_fault(side: str, service: str, method: str) -> None:
+    """Per-RPC gate (utils/rpc.py). Raises/sleeps per the plan:
+
+    - ``rpc_delay``: sleep ``params.delay_s`` before the call proceeds;
+    - ``rpc_drop``: raise :class:`ChaosUnavailable` (transport-class loss —
+      retriable by well-behaved clients);
+    - ``rpc_error``: raise RuntimeError (handler-class failure — must NOT
+      be retried as transient).
+    """
+    plan = current_plan()
+    if plan is None:
+        return
+    attrs = {"side": side, "service": service, "method": method}
+    ev = plan.active("rpc_delay", **attrs)
+    if ev is not None:
+        count_fault("rpc_delay")
+        time.sleep(float(ev.get("params", {}).get("delay_s", 0.05)))
+    ev = plan.active("rpc_drop", **attrs)
+    if ev is not None:
+        count_fault("rpc_drop")
+        raise ChaosUnavailable(
+            f"chaos: dropped {side} {service}/{method} "
+            f"(event {ev['id']}, scenario {plan.scenario!r})"
+        )
+    ev = plan.active("rpc_error", **attrs)
+    if ev is not None:
+        count_fault("rpc_error")
+        raise RuntimeError(
+            f"chaos: injected {side} error on {service}/{method} "
+            f"(event {ev['id']})"
+        )
+
+
+# ---------------------------------------------------------- agent hook
+def heartbeat_suppressed(agent_id: str) -> bool:
+    """Is this agent's heartbeat suppressed right now (elastic/agent.py)?
+    Simulates an agent hang / one-way partition: the process lives, the
+    master hears nothing."""
+    plan = current_plan()
+    if plan is None:
+        return False
+    ev = plan.active("heartbeat_suppress", agent=agent_id)
+    if ev is not None:
+        count_fault("heartbeat_suppress")
+        return True
+    return False
+
+
+# ---------------------------------------------------------- worker hook
+def maybe_straggle(rank: int) -> None:
+    """Artificial straggler sleep at the step boundary (elastic/worker.py)."""
+    plan = current_plan()
+    if plan is None:
+        return
+    ev = plan.active("straggler", rank=rank)
+    if ev is not None:
+        count_fault("straggler")
+        time.sleep(float(ev.get("params", {}).get("sleep_s", 0.2)))
+
+
+# --------------------------------------------------------- storage hook
+def corrupt_file(path: str, mode: str = "truncate",
+                 keep_bytes: int = 1) -> bool:
+    """Corrupt one on-disk file in place. ``truncate`` leaves ``keep_bytes``
+    (an unreadable npy header — restore raises loudly); ``bitflip`` inverts
+    the middle byte (silent payload damage — documents the checksum gap,
+    see docs/design/chaos.md). Returns False when the file is untouchable."""
+    try:
+        size = os.path.getsize(path)
+        if mode == "bitflip" and size > 0:
+            with open(path, "r+b") as f:
+                f.seek(size // 2)
+                b = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+        else:
+            os.truncate(path, min(keep_bytes, size))
+        return True
+    except OSError as e:
+        log.warning("chaos: could not corrupt %s: %s", path, e)
+        return False
+
+
+def maybe_corrupt_written_file(path: str) -> None:
+    """Post-write gate (core/storage.py PosixStorage): while a
+    ``ckpt_corrupt_write`` window is active, the just-written chunk/manifest
+    is damaged in place — simulating a host dying mid-save or torn IO."""
+    plan = current_plan()
+    if plan is None:
+        return
+    ev = plan.active("ckpt_corrupt_write", path=path)
+    if ev is not None:
+        mode = str(ev.get("params", {}).get("mode", "truncate"))
+        if corrupt_file(path, mode=mode):
+            count_fault("ckpt_corrupt_write")

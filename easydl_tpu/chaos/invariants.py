@@ -1,0 +1,226 @@
+"""Recovery-invariant checking: the assertion half of a chaos drill.
+
+A drill that merely *survives* proves little — the point is that after the
+injected faults the job provably recovered CORRECTLY. This module folds the
+artifacts every simulated-distributed run already produces — per-agent
+``metrics-*.jsonl``, the master's ``events.jsonl``, the final rendezvous
+status, and the PR-1 obs registry/scrape counters — into named invariant
+verdicts:
+
+- ``reached_target_step`` — the job got to its goal (DONE marker or a step
+  record at/after the target);
+- ``generation_monotonic`` — the master's generation never moved backwards
+  across the whole event log (a regressed generation means split-brain);
+- ``steps_lost_bounded`` — across every generation switch, the work thrown
+  away is at most the declared bound (≤ ckpt_interval for plain kills; a
+  corrupted-checkpoint fallback legitimately pays one more interval, so the
+  scenario declares its own bound);
+- ``membership_converged`` — the final world is the planned one (member
+  count AND the world size the workers actually trained at);
+- ``no_directive_ping_pong`` — the master reshaped at most the expected
+  number of times: flapping (kill → rejoin → kill ...) shows up as excess
+  ``draining`` transitions even when the job eventually finishes;
+- ``faults_observed`` (cross-check) — the obs counters saw at least the
+  expected number of injected faults, so a "pass" can't come from a drill
+  that silently injected nothing.
+
+Expectations are a plain dict so scenarios stay declarative::
+
+    expect = {"target_step": 24, "max_steps_lost": 4, "final_workers": 2,
+              "final_world_devices": 2, "max_reshapes": 2, "min_faults": 1}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+
+def read_metrics(workdir: str) -> List[Dict[str, Any]]:
+    """All agents' step records, merged (unsorted)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        names = os.listdir(workdir)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not (name.startswith("metrics-") and name.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(workdir, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            out.append(json.loads(line))
+                        except ValueError:
+                            continue  # torn tail from a killed worker
+        except OSError:
+            continue
+    return out
+
+
+def read_events(workdir: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(os.path.join(workdir, "events.jsonl")) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+    except OSError:
+        pass
+    return out
+
+
+def _steps_by_generation(metrics: List[Dict[str, Any]]) -> Dict[int, List[int]]:
+    by_gen: Dict[int, List[int]] = {}
+    for r in metrics:
+        try:
+            by_gen.setdefault(int(r["generation"]), []).append(int(r["step"]))
+        except (KeyError, TypeError, ValueError):
+            continue
+    return by_gen
+
+
+def check_scenario(
+    workdir: str,
+    expect: Mapping[str, Any],
+    status: Optional[Mapping[str, Any]] = None,
+    fault_counts: Optional[Mapping[str, float]] = None,
+) -> Dict[str, Any]:
+    """Run every applicable invariant; returns::
+
+        {"passed": bool, "checks": {name: {"ok": bool, ...evidence...}}}
+
+    ``status`` is the master's final ``status()`` snapshot (captured before
+    teardown); ``fault_counts`` the injected-fault counters
+    (injectors.injected_fault_counts or a merged scrape)."""
+    metrics = read_metrics(workdir)
+    events = read_events(workdir)
+    by_gen = _steps_by_generation(metrics)
+    checks: Dict[str, Dict[str, Any]] = {}
+
+    # -------------------------------------------------- reached_target_step
+    target = expect.get("target_step")
+    if target is not None:
+        max_step = max((max(v) for v in by_gen.values()), default=0)
+        done = os.path.exists(os.path.join(workdir, "DONE"))
+        checks["reached_target_step"] = {
+            "ok": done or max_step >= int(target),
+            "target": int(target), "max_step": max_step, "done_marker": done,
+        }
+
+    # -------------------------------------------------- generation_monotonic
+    gens = [int(e["generation"]) for e in events
+            if e.get("kind") == "phase" and "generation" in e]
+    regressions = [
+        (a, b) for a, b in zip(gens, gens[1:]) if b < a
+    ]
+    checks["generation_monotonic"] = {
+        "ok": not regressions,
+        "generations_seen": gens,
+        "regressions": regressions,
+    }
+
+    # ---------------------------------------------------- steps_lost_bounded
+    bound = expect.get("max_steps_lost")
+    if bound is not None:
+        ordered = sorted(g for g in by_gen if by_gen[g])
+        losses = []
+        for prev, nxt in zip(ordered, ordered[1:]):
+            # Time-aware boundary: an evicted-but-alive agent's zombie
+            # worker keeps recording steps at the OLD generation after the
+            # new one already started (the heartbeat-loss drill); counting
+            # those post-switch records as "work lost at the switch" would
+            # inflate the loss. The work at risk is what the old generation
+            # had recorded when the new one's first step landed.
+            t_first_next = min(
+                float(r.get("t", 0.0)) for r in metrics
+                if int(r.get("generation", -1)) == nxt
+            )
+            pre = [int(r["step"]) for r in metrics
+                   if int(r.get("generation", -1)) == prev
+                   and float(r.get("t", 0.0)) <= t_first_next]
+            last_pre = max(pre) if pre else max(by_gen[prev])
+            lost = max(0, last_pre - (min(by_gen[nxt]) - 1))
+            losses.append({"from_gen": prev, "to_gen": nxt,
+                           "steps_lost": lost})
+        worst = max((l["steps_lost"] for l in losses), default=0)
+        checks["steps_lost_bounded"] = {
+            "ok": worst <= int(bound),
+            "bound": int(bound), "worst": worst, "transitions": losses,
+        }
+
+    # --------------------------------------------------- membership_converged
+    want_workers = expect.get("final_workers")
+    want_devices = expect.get("final_world_devices")
+    if want_workers is not None or want_devices is not None:
+        members = list((status or {}).get("members", []))
+        final_gen = max(by_gen, default=-1)
+        final_worlds = sorted({
+            int(r.get("world_size", 0)) for r in metrics
+            if int(r.get("generation", -1)) == final_gen
+        })
+        ok = True
+        if want_workers is not None:
+            ok = ok and len(members) == int(want_workers)
+        if want_devices is not None:
+            ok = ok and final_worlds == [int(want_devices)]
+        checks["membership_converged"] = {
+            "ok": ok,
+            "final_members": members,
+            "want_workers": want_workers,
+            "final_generation": final_gen,
+            "final_world_sizes": final_worlds,
+            "want_world_devices": want_devices,
+        }
+
+    # ------------------------------------------------- no_directive_ping_pong
+    max_reshapes = expect.get("max_reshapes")
+    if max_reshapes is not None:
+        # The master's event log samples phases every tick — a drain that
+        # forms the next generation within one tick never lands in it, so
+        # the generation counter (one increment per formed generation,
+        # initial formation = 1) is the authoritative reshape count; the
+        # drain transitions are kept as corroborating evidence.
+        drains = [e for e in events
+                  if e.get("kind") == "phase" and e.get("phase") == "draining"]
+        gen_final = int((status or {}).get("generation", 0))
+        reshapes = max(len(drains), gen_final - 1)
+        checks["no_directive_ping_pong"] = {
+            "ok": reshapes <= int(max_reshapes),
+            "reshapes": reshapes,
+            "drain_transitions": len(drains),
+            "final_generation": gen_final,
+            "max_reshapes": int(max_reshapes),
+        }
+
+    # --------------------------------------------------- recovery_happened
+    min_gen = expect.get("min_final_generation")
+    if min_gen is not None:
+        gen_final = int((status or {}).get("generation", 0))
+        checks["recovery_happened"] = {
+            "ok": gen_final >= int(min_gen),
+            "final_generation": gen_final,
+            "min_final_generation": int(min_gen),
+        }
+
+    # ----------------------------------------------------- faults cross-check
+    min_faults = expect.get("min_faults")
+    if min_faults is not None:
+        total = float(sum((fault_counts or {}).values()))
+        checks["faults_observed"] = {
+            "ok": total >= float(min_faults),
+            "observed": total, "min_faults": float(min_faults),
+            "by_kind": dict(fault_counts or {}),
+        }
+
+    return {
+        "passed": all(c["ok"] for c in checks.values()),
+        "checks": checks,
+    }

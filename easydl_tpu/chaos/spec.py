@@ -1,0 +1,178 @@
+"""Declarative chaos scenarios → deterministic fault timelines.
+
+The north-star claim ("survives preemption, auto-recovers") was backed by
+exactly one hand-rolled SIGKILL in scripts/measure_recovery.py; everything
+else — RPC loss, agent hangs, checkpoint corruption, PS-shard crashes,
+stragglers — was unexercised and unasserted. This module is the declarative
+half of the chaos subsystem (docs/design/chaos.md): a :class:`ChaosSpec`
+lists *faults* (what, roughly when, against whom), and
+:func:`compile_schedule` resolves them — through a PRNG seeded ONLY by the
+spec's seed — into a concrete, sorted timeline of *events*. Same spec + same
+seed ⇒ byte-identical schedule (asserted by tests/test_chaos.py), so a
+failing drill is replayable, Jepsen-style, from its seed alone.
+
+The compiled schedule is a plain JSON document; the harness writes it to
+``<workdir>/chaos-plan.json``, points ``EASYDL_CHAOS_SPEC`` at it, and stamps
+``t0`` (wall clock) once the job reaches steady state. Every event window is
+``[t0+start_s, t0+end_s)``. Until ``t0`` is stamped the plan is inert even
+with the env var set — processes can start in any order.
+
+Two classes of event kind:
+
+- **inline** (consulted by in-process injectors at their hook points):
+  ``rpc_drop``, ``rpc_delay``, ``rpc_error``, ``heartbeat_suppress``,
+  ``straggler``, ``ckpt_corrupt_write``.
+- **process** (executed by the harness at the scheduled offset, through the
+  agent / controller process APIs): ``worker_kill``, ``worker_pause``,
+  ``agent_stop``, ``ps_kill``, ``corrupt_latest_ckpt``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+#: Kinds the in-process injectors act on at their hook points.
+INLINE_KINDS = frozenset({
+    "rpc_drop", "rpc_delay", "rpc_error",
+    "heartbeat_suppress", "straggler", "ckpt_corrupt_write",
+})
+#: Kinds the harness executes itself (process-level faults).
+PROCESS_KINDS = frozenset({
+    "worker_kill", "worker_pause", "agent_stop", "ps_kill",
+    "corrupt_latest_ckpt",
+})
+ALL_KINDS = INLINE_KINDS | PROCESS_KINDS
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One declared fault.
+
+    ``at_s`` is the nominal offset from the scenario's t0; ``jitter_s`` lets
+    the compiler smear it by a seeded-uniform draw in ``[0, jitter_s)`` so a
+    scenario family can explore timings without losing replayability.
+    ``target`` narrows where the fault applies (keys the hook points match
+    on: ``agent``, ``rank``, ``service``, ``method``, ``side``, ``shard``,
+    ``path_contains``); ``params`` carries kind-specific knobs (``p``,
+    ``delay_s``, ``sleep_s``, ``mode``, ``respawn_after_s``, ...)."""
+
+    kind: str
+    at_s: float
+    duration_s: float = 0.0
+    jitter_s: float = 0.0
+    target: Mapping[str, Any] = field(default_factory=dict)
+    params: Mapping[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.kind not in ALL_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (known: {sorted(ALL_KINDS)})"
+            )
+        if self.at_s < 0 or self.duration_s < 0 or self.jitter_s < 0:
+            raise ValueError("at_s/duration_s/jitter_s must be >= 0")
+        if self.kind in INLINE_KINDS and self.duration_s <= 0:
+            # inline faults fire only while their window is OPEN; a
+            # zero-length window compiles fine and then silently injects
+            # nothing — the spec must reject it where the author typed it
+            raise ValueError(
+                f"inline fault {self.kind!r} needs duration_s > 0 "
+                "(a zero-length window never fires)"
+            )
+
+
+@dataclass(frozen=True)
+class ChaosSpec:
+    """A named scenario: seed + fault list (declaration order is part of the
+    identity — the compiler consumes PRNG draws in that order)."""
+
+    name: str
+    seed: int
+    faults: Tuple[FaultSpec, ...] = ()
+    notes: str = ""
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "notes": self.notes,
+            "faults": [
+                {
+                    "kind": f.kind,
+                    "at_s": f.at_s,
+                    "duration_s": f.duration_s,
+                    "jitter_s": f.jitter_s,
+                    "target": dict(f.target),
+                    "params": dict(f.params),
+                }
+                for f in self.faults
+            ],
+        }
+
+    @classmethod
+    def from_json(cls, doc: Mapping[str, Any]) -> "ChaosSpec":
+        return cls(
+            name=str(doc["name"]),
+            seed=int(doc["seed"]),
+            notes=str(doc.get("notes", "")),
+            faults=tuple(
+                FaultSpec(
+                    kind=str(f["kind"]),
+                    at_s=float(f["at_s"]),
+                    duration_s=float(f.get("duration_s", 0.0)),
+                    jitter_s=float(f.get("jitter_s", 0.0)),
+                    target=dict(f.get("target", {})),
+                    params=dict(f.get("params", {})),
+                )
+                for f in doc.get("faults", [])
+            ),
+        )
+
+
+def compile_schedule(spec: ChaosSpec) -> Dict[str, Any]:
+    """Resolve a spec into the concrete event timeline.
+
+    Deterministic by construction: the ONLY entropy source is
+    ``random.Random(spec.seed)``, consumed in fault-declaration order (one
+    draw per fault, jittered or not, so adding jitter to one fault never
+    shifts another's draw). Events are sorted by (start_s, id) and carry a
+    stable integer id — probability decisions at injection time are hashed
+    off (seed, event id, call index), never off wall clock."""
+    rng = random.Random(spec.seed)
+    events: List[Dict[str, Any]] = []
+    for i, f in enumerate(spec.faults):
+        jitter = rng.random() * f.jitter_s  # one draw per fault, always
+        start = round(f.at_s + jitter, 6)
+        events.append({
+            "id": i,
+            "kind": f.kind,
+            "start_s": start,
+            "end_s": round(start + f.duration_s, 6),
+            "target": dict(f.target),
+            "params": dict(f.params),
+        })
+    events.sort(key=lambda e: (e["start_s"], e["id"]))
+    return {
+        "scenario": spec.name,
+        "seed": spec.seed,
+        "t0": None,  # stamped by the harness at steady state
+        "events": events,
+    }
+
+
+def schedule_bytes(schedule: Mapping[str, Any]) -> bytes:
+    """Canonical serialization — the byte-identity the determinism contract
+    (and its test) is stated over."""
+    return json.dumps(schedule, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def process_events(schedule: Mapping[str, Any]) -> List[Dict[str, Any]]:
+    """The harness-executed subset, in timeline order."""
+    return [e for e in schedule["events"] if e["kind"] in PROCESS_KINDS]
+
+
+def inline_events(schedule: Mapping[str, Any]) -> List[Dict[str, Any]]:
+    return [e for e in schedule["events"] if e["kind"] in INLINE_KINDS]

@@ -50,6 +50,11 @@ log = get_logger("core", "checkpoint")
 
 _STEP_RE = re.compile(r"^step_(\d{8})$")
 _COMMITTED = "COMMITTED"
+#: written by quarantine(): the step's bytes proved unreadable at restore
+#: time (truncated chunk, bad manifest). Kept alongside the demoted dir so
+#: operators can autopsy it; a later re-save of the same step clears the
+#: whole dir through the ordinary uncommitted-debris path.
+_CORRUPT = "CORRUPT"
 
 
 def _keystr(path) -> str:
@@ -510,6 +515,28 @@ class CheckpointManager:
             out_leaves.append(arr)
         return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
+    # ------------------------------------------------------------ quarantine
+    def quarantine(self, step: int) -> None:
+        """Demote a committed step whose bytes failed to restore: write the
+        CORRUPT marker first (evidence), then remove COMMITTED — after
+        which :meth:`steps` no longer offers the step and the next
+        :func:`restore_with_fallback` candidate is the previous one. Marker
+        order matters: a crash between the two writes must leave the step
+        either still-committed or visibly corrupt, never silently absent.
+
+        Multi-process callers gate this to one process and barrier after
+        (see elastic/worker.py) — the markers live in shared storage."""
+        step_dir = f"step_{step:08d}"
+        try:
+            self.storage.write_bytes(f"{step_dir}/{_CORRUPT}",
+                                     str(step).encode())
+        except OSError as e:  # marker is evidence, not a gate
+            log.warning("could not write corrupt marker for step %d: %s",
+                        step, e)
+        self.storage.delete_tree(f"{step_dir}/{_COMMITTED}")
+        log.warning("quarantined checkpoint step %d (%s/%s)", step,
+                    self.directory, step_dir)
+
     # -------------------------------------------------------------------- gc
     def _gc(self) -> None:
         if jax.process_index() != 0:
@@ -521,3 +548,58 @@ class CheckpointManager:
             # not as a committed step with missing chunks.
             self.storage.delete_tree(f"{step_dir}/{_COMMITTED}")
             self.storage.delete_tree(step_dir)
+
+
+def restore_with_fallback(
+    manager: CheckpointManager,
+    restore_fn,
+    agree_int=None,
+    all_ok=None,
+    quarantine=None,
+    max_attempts: int = 8,
+):
+    """Restore the newest committed step, falling back past corrupt ones.
+
+    The linchpin of the corrupted-checkpoint chaos scenario: a COMMITTED
+    step whose bytes are damaged (truncated chunk, unreadable manifest)
+    must cost one quarantine + one older restore, not a crash-loop. Loop:
+
+    1. agree on the newest committed step (``agree_int`` broadcasts rank 0's
+       candidate in multi-process runs — two ranks restoring different
+       steps would split the world);
+    2. every rank attempts ``restore_fn(step)``;
+    3. ``all_ok`` agrees the verdict across ranks (corruption often bites
+       only the ranks whose slices overlap the bad chunk — the survivors
+       must discard their restored state and fall back WITH the victims,
+       or they'd hang in the next collective);
+    4. on any failure, ``quarantine(step)`` demotes the step (default:
+       ``manager.quarantine`` — multi-process callers pass a rank-gated,
+       barriered wrapper) and the loop retries one step older.
+
+    Returns ``(state, step)``; ``(None, -1)`` means no restorable
+    checkpoint (callers fresh-init, their pre-existing path). The defaults
+    are the single-process wiring; elastic/worker.py supplies the
+    collective versions."""
+    agree_int = agree_int or (lambda v: v)
+    all_ok = all_ok or (lambda ok: ok)
+    quarantine = quarantine or manager.quarantine
+    for _ in range(max_attempts):
+        local = manager.latest_step()
+        step = int(agree_int(-1 if local is None else local))
+        if step < 0:
+            return None, -1
+        state = None
+        try:
+            state = restore_fn(step)
+            ok = True
+        except Exception as e:
+            log.warning("restore of step %d failed: %r", step, e)
+            ok = False
+        if all_ok(ok):
+            return state, step
+        del state  # a survivor's state from a bad step must not leak
+        quarantine(step)
+    raise RuntimeError(
+        f"no restorable checkpoint under {manager.directory} after "
+        f"{max_attempts} quarantine fallbacks"
+    )

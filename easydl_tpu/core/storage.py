@@ -115,6 +115,7 @@ class PosixStorage(CheckpointStorage):
         os.makedirs(os.path.dirname(full), exist_ok=True)
         with open(full, "wb") as f:
             f.write(data)
+        self._chaos_write_hook(full)
 
     def read_bytes(self, path: str) -> bytes:
         with open(self._p(path), "rb") as f:
@@ -124,6 +125,17 @@ class PosixStorage(CheckpointStorage):
         full = self._p(path)
         os.makedirs(os.path.dirname(full), exist_ok=True)
         np.save(full, arr)
+        self._chaos_write_hook(full)
+
+    @staticmethod
+    def _chaos_write_hook(full: str) -> None:
+        # Chaos hook point: during a ckpt_corrupt_write window the
+        # just-written file is truncated/bit-flipped in place — a host dying
+        # mid-save, torn IO. One env lookup when unarmed.
+        if os.environ.get("EASYDL_CHAOS_SPEC"):
+            from easydl_tpu.chaos.injectors import maybe_corrupt_written_file
+
+            maybe_corrupt_written_file(full)
 
     def load_array(self, path: str) -> np.ndarray:
         # mmap: restore reads only the overlapping slices of each chunk

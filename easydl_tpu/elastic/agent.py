@@ -19,9 +19,11 @@ import threading
 import time
 from typing import Any, Deque, Dict, List, Optional
 
+from easydl_tpu.chaos import banner as chaos_banner
 from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.retry import backoff_delay, retry_transient
 from easydl_tpu.utils.rpc import RpcClient
 
 from easydl_tpu.elastic import timeline
@@ -194,6 +196,22 @@ class Agent:
         if self._proc and self._proc.poll() is None:
             self._proc.kill()
 
+    def pause_worker(self) -> bool:
+        """Fault injection: SIGSTOP the worker (hang/straggler simulation —
+        the process lives, heartbeats keep flowing, steps stop). Returns
+        False when there is no live worker to pause."""
+        if self._proc and self._proc.poll() is None:
+            os.kill(self._proc.pid, signal.SIGSTOP)
+            return True
+        return False
+
+    def resume_worker(self) -> bool:
+        """SIGCONT the paused worker (pairs with :meth:`pause_worker`)."""
+        if self._proc and self._proc.poll() is None:
+            os.kill(self._proc.pid, signal.SIGCONT)
+            return True
+        return False
+
     @property
     def worker_pid(self) -> Optional[int]:
         return self._proc.pid if self._proc and self._proc.poll() is None else None
@@ -258,6 +276,7 @@ class Agent:
         self._m_phase_total.inc(agent=self.agent_id, phase=phase)
 
     def run(self) -> None:
+        chaos_banner(f"agent-{self.agent_id}")
         self._client = RpcClient(MASTER_SERVICE, self.master_address, timeout=10.0)
         self._client.wait_ready(30.0)
         self._exporter = start_exporter(
@@ -298,8 +317,16 @@ class Agent:
             # agents' jax import would otherwise gate the whole new
             # generation's first step.
             self._spawn_warm()
-        directive = self._register()
+        # Registration rides the bounded-backoff retry: a master briefly
+        # unreachable at agent start (pod races, a chaos drop burst) must
+        # not kill the agent, while a genuinely-dead master still surfaces
+        # after the budget and takes the pre-existing failure path.
+        directive = retry_transient(
+            self._register, max_elapsed_s=30.0,
+            describe=f"{self.agent_id} register",
+        )
         fail_since: Optional[float] = None
+        fail_count = 0
         last_kind = pb.DirectiveKind.NOOP
         while not self._stop.is_set():
             state_before = self._state
@@ -327,6 +354,15 @@ class Agent:
             if self._warm_rearm_ready(metrics):
                 self._warm_due = False
                 self._spawn_warm()
+            # Chaos hook point: a heartbeat_suppress window simulates an
+            # agent hang / one-way partition — the loop (and the worker)
+            # keep running, the master just hears nothing. One env lookup
+            # when unarmed.
+            if os.environ.get("EASYDL_CHAOS_SPEC"):
+                from easydl_tpu.chaos.injectors import heartbeat_suppressed
+
+                if heartbeat_suppressed(self.agent_id):
+                    continue
             try:
                 directive = self._client.Heartbeat(
                     pb.HeartbeatRequest(
@@ -348,6 +384,7 @@ class Agent:
                     )
                 )
                 fail_since = None
+                fail_count = 0
                 self._note_heartbeat(metrics)
             except Exception as e:
                 log.warning("%s: heartbeat failed: %s", self.agent_id, e)
@@ -358,7 +395,18 @@ class Agent:
                     if refreshed is not None:
                         directive = refreshed
                         fail_since = None
-                time.sleep(self.heartbeat_interval)
+                        fail_count = 0
+                        continue
+                # Exponential backoff + jitter on repeated failures: a
+                # fleet of agents must not stay phase-locked hammering a
+                # recovering master at the heartbeat rate, and the
+                # first retry after a blip should be prompt. Bounded by
+                # cap (and by master_refresh_s wall-clock above), so a
+                # dead master still surfaces to the follow/refresh path.
+                fail_count += 1
+                time.sleep(backoff_delay(fail_count, base_s=0.1,
+                                         cap_s=max(self.heartbeat_interval,
+                                                   1.0)))
 
     def _note_heartbeat(self, metrics: Dict[str, Any]) -> None:
         """Update cadence + bridged worker gauges after a delivered

@@ -18,6 +18,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from easydl_tpu.api.resource_plan import ResourcePlan
+from easydl_tpu.chaos import banner as chaos_banner
 from easydl_tpu.elastic.membership import Directive, JobPhase, Rendezvous
 from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
@@ -244,6 +245,7 @@ class Master:
         return f"localhost:{self._server.port}"
 
     def start(self) -> "Master":
+        chaos_banner("master")
         self._server = serve(MASTER_SERVICE, _Servicer(self), port=self._port)
         self._exporter = start_exporter(
             "master", workdir=self.workdir,

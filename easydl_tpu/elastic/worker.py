@@ -123,17 +123,21 @@ def run_worker(env: Dict[str, str]) -> int:
     # membership change rebuilds the trainer and re-jits, and without this
     # the recompile dominates recovery time (SURVEY.md §7 hard part 1).
     # Thresholds at 0 so even fast test-scale compiles are cached.
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get(
-                "EASYDL_COMPILE_CACHE", os.path.join(workdir, "jax_cache")
-            ),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:  # older jax without these knobs: cache is best-effort
-        pass
+    # EASYDL_COMPILE_CACHE=off/0/none DISABLES it: on some kernels (this
+    # container's 4.4 era) deserializing a cache entry another process
+    # wrote segfaults XLA:CPU — the chaos harness runs drills with the
+    # cache off so every respawn pays a clean compile instead of SIGSEGV.
+    cache_dir = os.environ.get(
+        "EASYDL_COMPILE_CACHE", os.path.join(workdir, "jax_cache")
+    )
+    if cache_dir.strip().lower() not in ("", "off", "0", "none", "disabled"):
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:  # older jax without these knobs: best-effort
+            pass
     timeline.emit(tl_path, "jax_imported", generation, rank=rank)
     if world > 1:
         jax.distributed.initialize(
@@ -147,7 +151,10 @@ def run_worker(env: Dict[str, str]) -> int:
     import optax
 
     from easydl_tpu.core import MeshSpec, Trainer, TrainConfig, build_mesh
-    from easydl_tpu.core.checkpoint import CheckpointManager
+    from easydl_tpu.core.checkpoint import (
+        CheckpointManager,
+        restore_with_fallback,
+    )
     from easydl_tpu.models import get_model
     from easydl_tpu.utils.logging import get_logger
 
@@ -286,16 +293,34 @@ def run_worker(env: Dict[str, str]) -> int:
     # this (main) thread via ckpt.finalize() at step boundaries below.
     ckpt = CheckpointManager(os.path.join(workdir, "ckpt"), keep=3, async_save=True)
 
-    # Agree on the restore step (a marker committed between two processes'
-    # directory listings must not split the group).
-    local_latest = ckpt.latest_step()
-    latest = int(
-        multihost_utils.broadcast_one_to_all(
-            np.int32(-1 if local_latest is None else local_latest)
-        )
-    ) if world > 1 else (-1 if local_latest is None else local_latest)
-    timeline.emit(tl_path, "restore_agreed", generation, rank=rank,
-                  step=latest)
+    # Chaos hook flag, read once: the straggler injector below costs one
+    # None-check per step when a spec is armed, nothing when not.
+    chaos_armed = bool(os.environ.get("EASYDL_CHAOS_SPEC"))
+
+    # Restore through the quarantine-fallback loop (core/checkpoint.py):
+    # a COMMITTED step with damaged bytes (truncated chunk, torn manifest)
+    # is demoted and the previous step restores instead — paying one extra
+    # ckpt_interval of work, never a crash-loop. The collective wiring
+    # keeps every rank on the same candidate and the same verdict (a
+    # corrupt chunk may bite only the ranks whose slices overlap it).
+    def _agree_int(v: int) -> int:
+        if world > 1:
+            return int(multihost_utils.broadcast_one_to_all(np.int32(v)))
+        return v
+
+    def _all_ok(ok: bool) -> bool:
+        if world > 1:
+            flags = np.asarray(multihost_utils.process_allgather(
+                np.asarray([1 if ok else 0], np.int32)))
+            return bool(flags.min() == 1)
+        return ok
+
+    def _quarantine(step: int) -> None:
+        if rank == 0:
+            ckpt.quarantine(step)
+        if world > 1:
+            multihost_utils.sync_global_devices(
+                f"ckpt_quarantine_{generation}_{step}")
 
     ps_ckpt_dir = os.path.join(workdir, "ps-ckpt")
 
@@ -311,8 +336,25 @@ def run_worker(env: Dict[str, str]) -> int:
             except Exception as e:  # PS save failure must not kill training
                 log.warning("ps snapshot at step %d failed: %s", step, e)
 
+    # The fallback loop owns the agreement collective (a marker committed
+    # between two processes' directory listings must not split the group);
+    # the restore_agreed boundary is emitted per agreed CANDIDATE from
+    # inside restore_fn, so after a corrupt-step fallback the timeline
+    # names the step that actually restored, not a stale hint — and no
+    # second listdir+broadcast is paid on the recovery hot path.
+    def _restore(s: int):
+        timeline.emit(tl_path, "restore_agreed", generation, rank=rank,
+                      step=s)
+        return trainer.restore_from(ckpt, s)
+
+    state, latest = restore_with_fallback(
+        ckpt, _restore,
+        agree_int=_agree_int, all_ok=_all_ok, quarantine=_quarantine,
+    )
+    if latest < 0:  # fresh init: keep the boundary (step -1, as before)
+        timeline.emit(tl_path, "restore_agreed", generation, rank=rank,
+                      step=-1)
     if latest >= 0:
-        state = trainer.restore_from(ckpt, latest)
         start_step = latest
         if ps_mode and rank == 0:
             try:
@@ -431,12 +473,20 @@ def run_worker(env: Dict[str, str]) -> int:
     # subsequent measurement). getppid flips when the parent dies (reparent
     # to init/subreaper, vs the entry-time baseline); one syscall per step
     # is free.
+    maybe_straggle = None
+    if chaos_armed:
+        from easydl_tpu.chaos.injectors import maybe_straggle
+
     step = start_step
     while step < total_steps:
         if os.getppid() != parent_pid:
             log.warning("gen %d: agent (parent) died; worker exiting at "
                         "step %d", generation, step)
             return 4
+        if maybe_straggle is not None:
+            # Chaos hook point: artificial straggler sleep at the step
+            # boundary (rank-targeted window in the armed spec).
+            maybe_straggle(rank)
         # Quiesce consensus at the step boundary. Multi-process workers may
         # only act on the *agreed* flag (acting on the local flag alone would
         # leave peers hanging in the next collective).

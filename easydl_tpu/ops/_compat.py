@@ -7,6 +7,18 @@ is the only file to touch.
 from __future__ import annotations
 
 try:  # jax >= 0.8
-    from jax import shard_map  # noqa: F401
+    from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # noqa: F401
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:  # pragma: no cover - exercised only on older jax
+    # jax renamed check_rep -> check_vma; callers here use the new name,
+    # older installs (like this container's) still expect the old one.
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)

@@ -35,6 +35,11 @@ from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.ps.server import DRAINING, PS_SERVICE, PsShard, spec_to_proto
 from easydl_tpu.ps.table import TableSpec, shard_of
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.retry import (
+    backoff_delay,
+    is_transport_error,
+    retry_transient,
+)
 from easydl_tpu.utils.rpc import RpcClient
 
 log = get_logger("ps", "client")
@@ -157,23 +162,9 @@ class LocalPsClient(_PsClientBase):
         return self.shards[s].Stats(pb.PsStatsRequest(), None)
 
 
-def _is_transport_error(e: BaseException) -> bool:
-    """True for failures that mean "the call never reached a live handler":
-    a channel closed under us (ValueError from grpc) or UNAVAILABLE /
-    CANCELLED / DEADLINE_EXCEEDED transport statuses. UNKNOWN is a
-    server-side handler exception — never retriable."""
-    import grpc
-
-    if isinstance(e, ValueError):  # "Cannot invoke RPC on closed channel!"
-        return True
-    if isinstance(e, grpc.RpcError):
-        code = e.code() if callable(getattr(e, "code", None)) else None
-        return code in (
-            grpc.StatusCode.UNAVAILABLE,
-            grpc.StatusCode.CANCELLED,
-            grpc.StatusCode.DEADLINE_EXCEEDED,
-        )
-    return False
+#: classification now lives in utils/retry.py (shared with the agent's
+#: register path); kept under the old name for in-repo callers.
+_is_transport_error = is_transport_error
 
 
 class ShardedPsClient(_PsClientBase):
@@ -188,10 +179,16 @@ class ShardedPsClient(_PsClientBase):
 
     def __init__(self, addresses: Sequence[str], timeout: float = 60.0,
                  drain_retry_s: float = 60.0,
+                 transient_retry_s: float = 30.0,
                  registry_workdir: Optional[str] = None):
         self.addresses = list(addresses)
         self.num_shards = len(self.addresses)
         self.drain_retry_s = drain_retry_s
+        # Bound for transient-UNAVAILABLE retry on the PULL path (pushes
+        # have the drain window): long enough to ride a shard crash +
+        # registry rescue, short enough that a dead-and-unreplaced shard
+        # still surfaces to the elastic layer as a real failure.
+        self.transient_retry_s = transient_retry_s
         # With a registry (ps/registry.py), a gated/unreachable shard is
         # re-resolved from the latest publications mid-retry — the client
         # follows operator-driven replacements without anyone calling
@@ -248,8 +245,26 @@ class ShardedPsClient(_PsClientBase):
     def _pull_shard(self, s, table, ids):
         if ids.size == 0:
             return np.zeros((0, 0), np.float32)
-        resp = self._clients[s].Pull(pb.PullRequest(table=table, ids=ids.tolist()))
-        return np.frombuffer(resp.values, np.float32).reshape(len(ids), resp.dim)
+
+        # Pulls are read-only — retrying a transient transport failure is
+        # unconditionally safe, and without it ONE sporadic UNAVAILABLE
+        # (shard crash, connection refused during a pod replacement) killed
+        # the training job: the first bug the chaos drills surfaced. Each
+        # retry first re-resolves the shard from the registry, so the loop
+        # follows a rescue pod to its new address mid-outage. ONLY the RPC
+        # itself is inside the retry: reshape of a malformed response
+        # raises ValueError, which the transport classifier would read as
+        # "closed channel" and spin on for the whole budget — a corrupt
+        # reply must surface immediately, as before.
+        req = pb.PullRequest(table=table, ids=ids.tolist())
+        resp = retry_transient(
+            lambda: self._clients[s].Pull(req),
+            max_elapsed_s=self.transient_retry_s,
+            on_retry=lambda e: self._maybe_reroute_from_registry(s),
+            describe=f"ps shard {s} pull",
+        )
+        return np.frombuffer(resp.values, np.float32).reshape(
+            len(ids), resp.dim)
 
     def _push_shard(self, s, table, ids, grads, scale):
         if ids.size == 0:
@@ -258,6 +273,7 @@ class ShardedPsClient(_PsClientBase):
             table=table, ids=ids.tolist(), grads=grads.tobytes(), scale=scale
         )
         deadline = time.monotonic() + self.drain_retry_s
+        transport_fails = 0
         while True:
             try:
                 ack = self._clients[s].Push(req)  # re-read: reroute may swap
@@ -279,8 +295,15 @@ class ShardedPsClient(_PsClientBase):
                         f"{self.drain_retry_s}s: {e}"
                     ) from e
                 self._maybe_reroute_from_registry(s)
-                time.sleep(0.05)
+                # Exponential backoff + jitter (vs the old fixed 50ms):
+                # every worker thread of the fleet hits this loop together
+                # when a shard dies — decorrelate their re-arrival at the
+                # rescue pod.
+                transport_fails += 1
+                time.sleep(backoff_delay(transport_fails, base_s=0.05,
+                                         cap_s=1.0))
                 continue
+            transport_fails = 0
             if ack.ok:
                 return
             if not ack.message.startswith(DRAINING):

@@ -336,6 +336,9 @@ class PsShard:
         """Start the gRPC server (and, when ``obs_workdir`` names the job
         workdir, a discoverable /metrics + /healthz exporter for this
         shard)."""
+        from easydl_tpu.chaos import banner as chaos_banner
+
+        chaos_banner(f"ps-{self.shard_index}")
         self._server = serve(PS_SERVICE, self, port=port)
         self._exporter = start_exporter(
             f"ps-{self.shard_index}", workdir=obs_workdir,

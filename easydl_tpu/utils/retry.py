@@ -1,0 +1,105 @@
+"""Bounded retry with exponential backoff + jitter for transient RPC loss.
+
+The first bug chaos found (ISSUE 2 satellite): one sporadic ``UNAVAILABLE``
+on a PS pull — or on the agent's register call against a briefly-partitioned
+master — killed the training job outright, while genuinely-dead endpoints
+need the failure to SURFACE so the elastic layer can reshape around them.
+This helper holds both requirements: transient-classed errors are retried
+with exponential backoff and full jitter (decorrelating a fleet of clients
+hammering a recovering server), and the TOTAL retry time is capped — past
+``max_elapsed_s`` the last error is re-raised unchanged, so callers'
+existing failure handling still fires.
+
+Only errors ``is_transient`` classifies as transport-level are retried;
+anything else (a server-side handler exception, a programming error)
+re-raises immediately — retrying those would stall real failures.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, TypeVar
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("utils", "retry")
+
+T = TypeVar("T")
+
+
+def is_transport_error(e: BaseException) -> bool:
+    """True for failures that mean "the call never reached a live handler":
+    a channel closed under us (ValueError from grpc) or UNAVAILABLE /
+    CANCELLED / DEADLINE_EXCEEDED transport statuses. UNKNOWN is a
+    server-side handler exception — never retriable. (Connection-refused
+    surfaces as UNAVAILABLE through grpc.)"""
+    import grpc
+
+    if isinstance(e, ValueError):  # "Cannot invoke RPC on closed channel!"
+        return True
+    if isinstance(e, grpc.RpcError):
+        code = e.code() if callable(getattr(e, "code", None)) else None
+        return code in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.CANCELLED,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+    return False
+
+
+def backoff_delay(attempt: int, base_s: float = 0.05, cap_s: float = 2.0,
+                  rng: Optional[Callable[[], float]] = None) -> float:
+    """Full-jitter exponential backoff: uniform in (0, min(cap, base·2^n)].
+
+    Full jitter (vs ±x%) because the recovering-endpoint case is the one
+    that matters: N clients whose retries stay phase-locked re-arrive
+    together and knock the endpoint over again."""
+    rng = rng or random.random
+    # exponent clamped: an unbounded 2**attempt becomes an int too large
+    # for float arithmetic after ~1024 consecutive failures (a long master
+    # outage) and would crash the very retry loop that must survive it
+    ceiling = min(cap_s, base_s * (2.0 ** min(attempt, 62)))
+    return ceiling * max(rng(), 1e-3)
+
+
+def retry_transient(
+    fn: Callable[[], T],
+    *,
+    max_elapsed_s: float,
+    is_transient: Callable[[BaseException], bool] = is_transport_error,
+    base_s: float = 0.05,
+    cap_s: float = 2.0,
+    on_retry: Optional[Callable[[BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[Callable[[], float]] = None,
+    describe: str = "call",
+) -> T:
+    """Run ``fn`` until it succeeds, a non-transient error raises, or the
+    elapsed budget runs out (the last transient error then re-raises).
+
+    ``on_retry`` runs before each backoff sleep — the PS client uses it to
+    re-resolve a crashed shard's replacement from the registry mid-retry."""
+    deadline = time.monotonic() + max_elapsed_s
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as e:
+            if not is_transient(e) or time.monotonic() >= deadline:
+                raise
+            if on_retry is not None:
+                try:
+                    on_retry(e)
+                except Exception as cb_err:
+                    log.warning("%s: on_retry hook failed: %s",
+                                describe, cb_err)
+            delay = backoff_delay(attempt, base_s=base_s, cap_s=cap_s,
+                                  rng=rng)
+            # never sleep past the budget — the final attempt should still
+            # happen inside it
+            delay = min(delay, max(0.0, deadline - time.monotonic()))
+            log.debug("%s: transient failure (%s); retry %d in %.3fs",
+                      describe, e, attempt + 1, delay)
+            sleep(delay)
+            attempt += 1

@@ -20,6 +20,7 @@ Usage::
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent import futures
@@ -82,6 +83,30 @@ def _instrument(fn: Callable, side: str, service: str,
     def wrapped(*args, **kwargs):
         t0 = time.perf_counter()
         try:
+            # Chaos hook point (docs/design/chaos.md): with EASYDL_CHAOS_SPEC
+            # unset this is ONE env-dict lookup — no import, no call. Armed,
+            # the injector may delay the call, raise UNAVAILABLE (drop), or
+            # raise a handler-class error, per the scenario's scheduled
+            # windows. Inside the try so injected faults land in the same
+            # request/error/latency series as real ones.
+            if os.environ.get("EASYDL_CHAOS_SPEC"):
+                from easydl_tpu.chaos.injectors import (
+                    ChaosUnavailable,
+                    rpc_fault,
+                )
+
+                try:
+                    rpc_fault(side, service, method)
+                except ChaosUnavailable as e:
+                    # A server-side drop must reach the CLIENT as transport
+                    # loss: a python exception from a servicer becomes
+                    # status UNKNOWN (handler-bug class, never retried), so
+                    # abort with UNAVAILABLE instead. abort() itself raises.
+                    if side == "server" and len(args) >= 2 \
+                            and hasattr(args[1], "abort"):
+                        args[1].abort(grpc.StatusCode.UNAVAILABLE,
+                                      e.details())
+                    raise
             return fn(*args, **kwargs)
         except Exception:
             errors.inc(service=service, method=method)

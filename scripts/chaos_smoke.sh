@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Chaos smoke: the fastest deterministic drill (worker SIGKILL + invariant
+# check) as a single command — the pre-merge sanity gate for changes that
+# touch the elastic/recovery path. The full catalog (heartbeat loss, RPC
+# burst, PS-shard crash, checkpoint corruption) runs via
+#   python scripts/chaos_run.py
+# and as `pytest -m chaos` (the slow-marked e2e tests).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
+    --scenario worker_kill "$@"

@@ -28,19 +28,25 @@ import tempfile  # noqa: E402
 _cache_root = tempfile.mkdtemp(prefix="easydl-test-chunk-cache-")
 os.environ.setdefault("EASYDL_CHUNK_CACHE", _cache_root)
 
-# One persistent compile cache for the WHOLE suite — the in-process tests
-# AND every worker subprocess they spawn (workers read EASYDL_COMPILE_CACHE;
-# easydl_tpu/elastic/worker.py) — kept across runs: the suite's wall time
-# is dominated by shard_map/jit compiles that are identical run-to-run, and
-# CI's doubled determinism run was paying them twice. Override with
-# EASYDL_TEST_JAX_CACHE (e.g. a CI cache mount); "off" disables.
+# Persistent compile cache for the suite: OFF by default. The shared
+# cross-run cache (added for CI's doubled determinism run) turned out to be
+# a crash source on this container's 4.4-era kernel: XLA:CPU SEGFAULTS
+# deserializing a persistent-cache entry that another process wrote
+# (reproducible — save in one process, jit the same program in a fresh
+# one), so a warm cache makes arbitrary tests die mid-run and takes the
+# whole pytest process with them (the "config3 segfaults at the clean
+# seed" mystery from PR 1 is this same failure class). Opt back in ONLY on
+# machines whose kernel is known good: EASYDL_TEST_JAX_CACHE=<dir>.
+# EASYDL_COMPILE_CACHE is pinned to "off" for spawned workers for the same
+# reason — their default (workdir/jax_cache, shared across generations)
+# is exactly the cross-process read that crashes; an explicit
+# EASYDL_COMPILE_CACHE in the environment still wins.
 _cache_cfg = os.environ.get("EASYDL_TEST_JAX_CACHE", "")
-if _cache_cfg.lower() != "off":
-    _jax_cache = _cache_cfg or os.path.join(
-        tempfile.gettempdir(), "easydl-test-jax-cache"
-    )
-    os.makedirs(_jax_cache, exist_ok=True)
-    os.environ.setdefault("EASYDL_COMPILE_CACHE", _jax_cache)
+if _cache_cfg and _cache_cfg.lower() != "off":
+    os.makedirs(_cache_cfg, exist_ok=True)
+    os.environ.setdefault("EASYDL_COMPILE_CACHE", _cache_cfg)
+else:
+    os.environ.setdefault("EASYDL_COMPILE_CACHE", "off")
 
 # The image's sitecustomize registers the axon TPU plugin and pins
 # jax_platforms="axon,cpu" via jax.config — env vars alone don't win. Re-pin
@@ -48,9 +54,9 @@ if _cache_cfg.lower() != "off":
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-if _cache_cfg.lower() != "off":
+if _cache_cfg and _cache_cfg.lower() != "off":
     try:
-        jax.config.update("jax_compilation_cache_dir", _jax_cache)
+        jax.config.update("jax_compilation_cache_dir", _cache_cfg)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # older jax: cache is best-effort

@@ -1,0 +1,494 @@
+"""Unit tests for the chaos subsystem: schedule determinism, injector hook
+points (and their inertness with the env unset), checkpoint quarantine +
+fallback, and the invariant checker. The live drills are in
+tests/test_chaos_e2e.py."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from easydl_tpu.chaos import injectors
+from easydl_tpu.chaos.injectors import ChaosPlan
+from easydl_tpu.chaos.spec import (
+    ChaosSpec,
+    FaultSpec,
+    compile_schedule,
+    inline_events,
+    process_events,
+    schedule_bytes,
+)
+
+SPEC = ChaosSpec(
+    name="unit", seed=42,
+    faults=(
+        FaultSpec(kind="rpc_drop", at_s=1.0, duration_s=2.0, jitter_s=0.5,
+                  target={"side": "client", "service": "svc"}),
+        FaultSpec(kind="worker_kill", at_s=3.0, target={"agent": "a1"}),
+        FaultSpec(kind="straggler", at_s=0.0, duration_s=10.0,
+                  target={"rank": 1}, params={"sleep_s": 0.01}),
+    ),
+)
+
+
+def _plan_file(tmp_path, schedule, t0=None):
+    import time
+
+    doc = dict(schedule, t0=time.time() if t0 is None else t0)
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def _fault_delta(before, kind):
+    return injectors.injected_fault_counts().get(kind, 0.0) \
+        - before.get(kind, 0.0)
+
+
+# ------------------------------------------------------------ determinism
+
+
+def test_same_seed_compiles_byte_identical_schedule():
+    a, b = compile_schedule(SPEC), compile_schedule(SPEC)
+    assert schedule_bytes(a) == schedule_bytes(b)
+    # jitter actually smeared the first fault, within its declared bound
+    drop = [e for e in a["events"] if e["kind"] == "rpc_drop"][0]
+    assert 1.0 <= drop["start_s"] < 1.5
+    assert drop["end_s"] == pytest.approx(drop["start_s"] + 2.0)
+
+
+def test_different_seed_changes_the_timeline():
+    other = ChaosSpec(name=SPEC.name, seed=43, faults=SPEC.faults)
+    assert schedule_bytes(compile_schedule(SPEC)) != \
+        schedule_bytes(compile_schedule(other))
+
+
+def test_spec_json_round_trip():
+    doc = SPEC.to_json()
+    again = ChaosSpec.from_json(json.loads(json.dumps(doc)))
+    assert again == SPEC
+    assert schedule_bytes(compile_schedule(again)) == \
+        schedule_bytes(compile_schedule(SPEC))
+
+
+def test_event_class_split():
+    sched = compile_schedule(SPEC)
+    assert {e["kind"] for e in process_events(sched)} == {"worker_kill"}
+    assert {e["kind"] for e in inline_events(sched)} == \
+        {"rpc_drop", "straggler"}
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec(kind="meteor_strike", at_s=0.0)
+
+
+# ------------------------------------------------------------ plan matching
+
+
+def test_plan_window_and_target_matching():
+    plan = ChaosPlan(dict(compile_schedule(SPEC), t0=100.0))
+    drop_start = [e for e in plan.events if e["kind"] == "rpc_drop"][0][
+        "start_s"]
+    inside = 100.0 + drop_start + 0.1
+    assert plan.active("rpc_drop", now=inside, side="client",
+                       service="svc", method="M") is not None
+    # window edges and mismatched targets
+    assert plan.active("rpc_drop", now=99.0, side="client",
+                       service="svc", method="M") is None
+    assert plan.active("rpc_drop", now=inside, side="server",
+                       service="svc", method="M") is None
+    assert plan.active("rpc_drop", now=inside, side="client",
+                       service="other", method="M") is None
+    # straggler matches only its rank
+    assert plan.active("straggler", now=105.0, rank=1) is not None
+    assert plan.active("straggler", now=105.0, rank=0) is None
+
+
+def test_plan_inert_until_t0_stamped():
+    plan = ChaosPlan(compile_schedule(SPEC))  # t0 None
+    assert plan.active("straggler", now=1e12, rank=1) is None
+
+
+def test_probability_decisions_are_deterministic_and_roughly_p():
+    spec = ChaosSpec(name="p", seed=5, faults=(
+        FaultSpec(kind="rpc_drop", at_s=0.0, duration_s=10.0,
+                  params={"p": 0.3}),
+    ))
+    sched = compile_schedule(spec)
+
+    def decide_seq(n):
+        plan = ChaosPlan(dict(sched, t0=0.0))
+        return [plan.active("rpc_drop", now=1.0) is not None
+                for _ in range(n)]
+
+    a, b = decide_seq(400), decide_seq(400)
+    assert a == b  # same seed + same call order -> same decisions
+    assert 0.15 < sum(a) / len(a) < 0.45
+
+
+# --------------------------------------------------------- rpc hook points
+
+
+ECHO_KW = dict(side="client", service="easydl.test.Echo")
+
+
+def _rpc_plan(tmp_path, kind, params=None):
+    spec = ChaosSpec(name="rpc", seed=1, faults=(
+        FaultSpec(kind=kind, at_s=0.0, duration_s=3600.0,
+                  target=dict(ECHO_KW), params=params or {}),
+    ))
+    return _plan_file(tmp_path, compile_schedule(spec))
+
+
+def _echo_round_trip():
+    from easydl_tpu.proto import easydl_pb2 as pb
+    from easydl_tpu.utils.rpc import RpcClient, ServiceDef, serve
+
+    svc = ServiceDef("easydl.test.Echo",
+                     {"Report": (pb.StepMetrics, pb.Ack)})
+
+    class Impl:
+        def Report(self, req, ctx):
+            return pb.Ack(ok=True, message=f"step={req.step}")
+
+    server = serve(svc, Impl())
+    try:
+        client = RpcClient(svc, server.address)
+        client.wait_ready()
+        ack = client.Report(pb.StepMetrics(step=3))
+        client.close()
+        return ack
+    finally:
+        server.stop()
+
+
+def test_rpc_drop_raises_transient_unavailable(tmp_path, monkeypatch):
+    from easydl_tpu.utils.retry import is_transport_error
+
+    monkeypatch.setenv(injectors.ENV_VAR, _rpc_plan(tmp_path, "rpc_drop"))
+    before = injectors.injected_fault_counts()
+    with pytest.raises(Exception) as ei:
+        _echo_round_trip()
+    # the injected failure must classify exactly like a real UNAVAILABLE
+    assert is_transport_error(ei.value), ei.value
+    assert _fault_delta(before, "rpc_drop") >= 1
+
+
+def test_server_side_rpc_drop_reaches_client_as_transport_loss(
+        tmp_path, monkeypatch):
+    """A drop injected in the SERVICER must surface to the client as
+    UNAVAILABLE (transport-class, retriable), not UNKNOWN — a plain
+    exception from a handler would be classified as a handler bug and
+    never retried, the opposite of what a drop simulates."""
+    import grpc
+
+    from easydl_tpu.utils.retry import is_transport_error
+
+    spec = ChaosSpec(name="srv", seed=1, faults=(
+        FaultSpec(kind="rpc_drop", at_s=0.0, duration_s=3600.0,
+                  target={"side": "server",
+                          "service": "easydl.test.Echo"}),
+    ))
+    monkeypatch.setenv(injectors.ENV_VAR,
+                       _plan_file(tmp_path, compile_schedule(spec)))
+    with pytest.raises(grpc.RpcError) as ei:
+        _echo_round_trip()
+    assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+    assert is_transport_error(ei.value)
+
+
+def test_rpc_error_is_not_transient(tmp_path, monkeypatch):
+    from easydl_tpu.utils.retry import is_transport_error
+
+    monkeypatch.setenv(injectors.ENV_VAR, _rpc_plan(tmp_path, "rpc_error"))
+    with pytest.raises(Exception) as ei:
+        _echo_round_trip()
+    assert not is_transport_error(ei.value)
+
+
+def test_rpc_delay_injects_latency_then_succeeds(tmp_path, monkeypatch):
+    import time
+
+    monkeypatch.setenv(injectors.ENV_VAR,
+                       _rpc_plan(tmp_path, "rpc_delay",
+                                 {"delay_s": 0.15}))
+    before = injectors.injected_fault_counts()
+    t0 = time.perf_counter()
+    ack = _echo_round_trip()
+    assert ack.ok and time.perf_counter() - t0 >= 0.15
+    assert _fault_delta(before, "rpc_delay") >= 1
+
+
+def test_rpc_layer_inert_without_chaos_env(monkeypatch):
+    """Acceptance: with EASYDL_CHAOS_SPEC unset every hook point is a no-op
+    — the RPC layer behaves identically and no chaos series move."""
+    monkeypatch.delenv(injectors.ENV_VAR, raising=False)
+    assert injectors.current_plan() is None
+    before = injectors.injected_fault_counts()
+    ack = _echo_round_trip()
+    assert ack.ok and ack.message == "step=3"
+    # no chaos series moved during the round trip (earlier tests may have
+    # created the family; its values must be frozen while unarmed)
+    assert injectors.injected_fault_counts() == before
+
+
+# ------------------------------------------------- agent/worker hook points
+
+
+def test_heartbeat_suppressed_matches_agent(tmp_path, monkeypatch):
+    spec = ChaosSpec(name="hb", seed=2, faults=(
+        FaultSpec(kind="heartbeat_suppress", at_s=0.0, duration_s=3600.0,
+                  target={"agent": "a1"}),
+    ))
+    monkeypatch.setenv(injectors.ENV_VAR,
+                       _plan_file(tmp_path, compile_schedule(spec)))
+    assert injectors.heartbeat_suppressed("a1") is True
+    assert injectors.heartbeat_suppressed("a0") is False
+
+
+def test_maybe_straggle_sleeps_for_target_rank(tmp_path, monkeypatch):
+    import time
+
+    spec = ChaosSpec(name="strag", seed=2, faults=(
+        FaultSpec(kind="straggler", at_s=0.0, duration_s=3600.0,
+                  target={"rank": 0}, params={"sleep_s": 0.1}),
+    ))
+    monkeypatch.setenv(injectors.ENV_VAR,
+                       _plan_file(tmp_path, compile_schedule(spec)))
+    t0 = time.perf_counter()
+    injectors.maybe_straggle(rank=1)  # untargeted rank: no sleep
+    assert time.perf_counter() - t0 < 0.05
+    t0 = time.perf_counter()
+    injectors.maybe_straggle(rank=0)
+    assert time.perf_counter() - t0 >= 0.1
+
+
+# ----------------------------------------------------- storage hook point
+
+
+def test_posix_storage_write_corruption_window(tmp_path, monkeypatch):
+    from easydl_tpu.core.storage import PosixStorage
+
+    spec = ChaosSpec(name="ck", seed=2, faults=(
+        FaultSpec(kind="ckpt_corrupt_write", at_s=0.0, duration_s=3600.0,
+                  target={"path_contains": "step_"}),
+    ))
+    monkeypatch.setenv(injectors.ENV_VAR,
+                       _plan_file(tmp_path, compile_schedule(spec)))
+    st = PosixStorage(str(tmp_path / "ckpt"))
+    st.save_array("step_00000001/leaf/0-8.npy", np.arange(8))
+    # inside the window + path match -> truncated in place
+    assert os.path.getsize(
+        str(tmp_path / "ckpt" / "step_00000001" / "leaf" / "0-8.npy")) <= 1
+    # a non-matching path is untouched
+    st.save_array("scratch/0-8.npy", np.arange(8))
+    arr = st.load_array("scratch/0-8.npy")
+    np.testing.assert_array_equal(np.asarray(arr), np.arange(8))
+
+
+def test_corrupt_file_modes(tmp_path):
+    p = tmp_path / "chunk.npy"
+    np.save(p, np.arange(64))
+    orig = p.read_bytes()
+    assert injectors.corrupt_file(str(p), mode="bitflip")
+    flipped = p.read_bytes()
+    assert len(flipped) == len(orig) and flipped != orig
+    assert injectors.corrupt_file(str(p), mode="truncate")
+    assert p.stat().st_size <= 1
+    assert injectors.corrupt_file(str(tmp_path / "absent"), "truncate") is False
+
+
+# ------------------------------------------- quarantine + restore fallback
+
+
+def _mk_manager(tmp_path):
+    from easydl_tpu.core.checkpoint import CheckpointManager
+
+    return CheckpointManager(str(tmp_path / "ckpt"), keep=3,
+                             async_save=False)
+
+
+def _chunk_path(tmp_path, step):
+    return str(tmp_path / "ckpt" / f"step_{step:08d}" / "leaf_00000"
+               / "0-8.npy")
+
+
+def test_quarantine_demotes_committed_step(tmp_path, monkeypatch):
+    monkeypatch.setenv("EASYDL_CHUNK_CACHE", "off")
+    mgr = _mk_manager(tmp_path)
+    mgr.save(2, {"w": np.arange(8, dtype=np.float32)})
+    mgr.save(4, {"w": np.arange(8, dtype=np.float32) * 2})
+    assert mgr.steps() == [2, 4]
+    mgr.quarantine(4)
+    assert mgr.steps() == [2]
+    assert mgr.storage.exists("step_00000004/CORRUPT")
+
+
+def test_restore_with_fallback_skips_corrupt_latest(tmp_path, monkeypatch):
+    from easydl_tpu.core.checkpoint import restore_with_fallback
+
+    monkeypatch.setenv("EASYDL_CHUNK_CACHE", "off")
+    mgr = _mk_manager(tmp_path)
+    mgr.save(2, {"w": np.arange(8, dtype=np.float32)})
+    mgr.save(4, {"w": np.arange(8, dtype=np.float32) * 2})
+    injectors.corrupt_file(_chunk_path(tmp_path, 4), mode="truncate")
+
+    def restore_fn(step):
+        return np.asarray(
+            mgr.storage.load_array(f"step_{step:08d}/leaf_00000/0-8.npy"))
+
+    state, step = restore_with_fallback(mgr, restore_fn)
+    assert step == 2
+    np.testing.assert_array_equal(state, np.arange(8, dtype=np.float32))
+    assert mgr.steps() == [2]  # step 4 quarantined along the way
+
+
+def test_restore_with_fallback_empty_directory(tmp_path, monkeypatch):
+    from easydl_tpu.core.checkpoint import restore_with_fallback
+
+    monkeypatch.setenv("EASYDL_CHUNK_CACHE", "off")
+    mgr = _mk_manager(tmp_path)
+    state, step = restore_with_fallback(mgr, lambda s: s)
+    assert state is None and step == -1
+
+
+def test_restore_with_fallback_survivor_discards_state(tmp_path, monkeypatch):
+    """Multi-rank semantics: a rank whose local restore SUCCEEDED must still
+    fall back when the agreed verdict says a peer failed."""
+    from easydl_tpu.core.checkpoint import restore_with_fallback
+
+    monkeypatch.setenv("EASYDL_CHUNK_CACHE", "off")
+    mgr = _mk_manager(tmp_path)
+    mgr.save(2, {"w": np.arange(8, dtype=np.float32)})
+    mgr.save(4, {"w": np.arange(8, dtype=np.float32) * 2})
+    verdicts = iter([False, True])  # peer failed on step 4, all ok on 2
+
+    state, step = restore_with_fallback(
+        mgr, lambda s: s, all_ok=lambda ok: next(verdicts))
+    assert step == 2 and mgr.steps() == [2]
+
+
+# ------------------------------------------------------------- invariants
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def _populate_run(workdir, *, gens=((1, 1, 10), (2, 9, 20)), world=2,
+                  events=None, done=True):
+    """gens: (generation, first_step, last_step) per generation."""
+    recs = []
+    for gen, first, last in gens:
+        for s in range(first, last + 1):
+            # t strictly ordered by generation THEN step: the time-aware
+            # lost-steps rule anchors on the next generation's first
+            # timestamp, so the fixture must not interleave generations
+            recs.append({"step": s, "generation": gen, "world_size": world,
+                         "loss": 0.5, "step_time_s": 0.1,
+                         "samples_per_sec": 100.0,
+                         "t": gen * 1000.0 + float(s)})
+    _write_jsonl(os.path.join(workdir, "metrics-a0.jsonl"), recs)
+    if events is None:
+        events = [{"t": 0.0, "kind": "phase", "phase": "init", "generation": 0},
+                  {"t": 1.0, "kind": "phase", "phase": "stable", "generation": 1},
+                  {"t": 5.0, "kind": "phase", "phase": "draining", "generation": 1},
+                  {"t": 6.0, "kind": "phase", "phase": "stable", "generation": 2}]
+    _write_jsonl(os.path.join(workdir, "events.jsonl"), events)
+    if done:
+        with open(os.path.join(workdir, "DONE"), "w") as f:
+            f.write("20")
+
+
+def test_invariants_pass_on_clean_recovery(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    _populate_run(str(tmp_path))
+    verdict = invariants.check_scenario(
+        str(tmp_path),
+        {"target_step": 20, "max_steps_lost": 3, "final_workers": 2,
+         "final_world_devices": 2, "max_reshapes": 1, "min_faults": 1},
+        status={"members": ["a0", "a1"]},
+        fault_counts={"worker_kill": 1},
+    )
+    assert verdict["passed"], verdict
+
+
+def test_invariants_catch_excess_lost_steps(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    # gen 2 resumes at step 3 after gen 1 reached 10: 8 steps lost
+    _populate_run(str(tmp_path), gens=((1, 1, 10), (2, 3, 20)))
+    verdict = invariants.check_scenario(
+        str(tmp_path), {"target_step": 20, "max_steps_lost": 3})
+    assert not verdict["passed"]
+    assert not verdict["checks"]["steps_lost_bounded"]["ok"]
+    assert verdict["checks"]["steps_lost_bounded"]["worst"] == 8
+
+
+def test_invariants_catch_generation_regression(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    events = [{"t": 0.0, "kind": "phase", "phase": "stable", "generation": 2},
+              {"t": 1.0, "kind": "phase", "phase": "stable", "generation": 1}]
+    _populate_run(str(tmp_path), events=events)
+    verdict = invariants.check_scenario(str(tmp_path), {"target_step": 20})
+    assert not verdict["checks"]["generation_monotonic"]["ok"]
+
+
+def test_invariants_catch_directive_ping_pong(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    events = []
+    for g in range(1, 5):  # 4 drains where 1 was expected
+        events += [
+            {"t": g, "kind": "phase", "phase": "draining", "generation": g},
+            {"t": g + 0.5, "kind": "phase", "phase": "stable",
+             "generation": g + 1},
+        ]
+    _populate_run(str(tmp_path), events=events)
+    verdict = invariants.check_scenario(
+        str(tmp_path), {"target_step": 20, "max_reshapes": 1})
+    assert not verdict["checks"]["no_directive_ping_pong"]["ok"]
+
+
+def test_invariants_catch_unconverged_membership(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    _populate_run(str(tmp_path))
+    verdict = invariants.check_scenario(
+        str(tmp_path),
+        {"target_step": 20, "final_workers": 2, "final_world_devices": 2},
+        status={"members": ["a0"]},  # one member short of the plan
+    )
+    assert not verdict["checks"]["membership_converged"]["ok"]
+
+
+def test_invariants_cross_check_requires_observed_faults(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    _populate_run(str(tmp_path))
+    verdict = invariants.check_scenario(
+        str(tmp_path), {"target_step": 20, "min_faults": 1},
+        fault_counts={})
+    assert not verdict["checks"]["faults_observed"]["ok"]
+
+
+# ------------------------------------------------------------ catalog sanity
+
+
+def test_scenario_catalog_compiles_deterministically():
+    from easydl_tpu.chaos.harness import FAST_SCENARIO, SCENARIOS
+
+    assert FAST_SCENARIO in SCENARIOS
+    assert len(SCENARIOS) >= 5
+    for name, builder in SCENARIOS.items():
+        sc = builder()
+        assert sc.name == name
+        assert schedule_bytes(compile_schedule(sc.chaos)) == \
+            schedule_bytes(compile_schedule(builder().chaos))
+        assert sc.expect.get("target_step") is not None

@@ -16,6 +16,15 @@ import pytest
 from easydl_tpu.elastic.agent import Agent
 from easydl_tpu.elastic.master import Master
 
+from envprobe import requires_multiproc_cpu
+
+#: every test here except the 1-agent pipeline one forms a >1-process
+#: jax world; on jaxlibs whose CPU backend lacks cross-process collectives
+#: those worlds can never form (workers crash-loop in the restore-agree
+#: broadcast) and each test would burn its full timeout — skip with the
+#: capability named instead (tests/envprobe.py).
+multiproc = requires_multiproc_cpu()
+
 JOB_CFG = {
     "model": "mlp",
     "model_kwargs": {"input_shape": [8, 8, 1], "features": [32, 32]},
@@ -49,6 +58,7 @@ def workdir(tmp_path):
     return str(tmp_path)
 
 
+@multiproc
 def test_elastic_end_to_end_two_workers(workdir):
     master = Master(
         job_name="mnist-mlp",
@@ -76,6 +86,7 @@ def test_elastic_end_to_end_two_workers(workdir):
         master.stop()
 
 
+@multiproc
 def test_scale_up_mid_run(workdir):
     cfg = dict(JOB_CFG, total_steps=600, ckpt_interval=50, sync_every=5)
     # prepare disabled: this test pins the direct quiesce->reshape semantics
@@ -129,6 +140,7 @@ def test_scale_up_mid_run(workdir):
         master.stop()
 
 
+@multiproc
 def test_preemption_kill_recovery(workdir):
     cfg = dict(JOB_CFG, total_steps=30, ckpt_interval=3)
     master = Master(
@@ -179,6 +191,7 @@ def test_preemption_kill_recovery(workdir):
         master.stop()
 
 
+@multiproc
 def test_elastic_worker_with_ps_embedding(workdir):
     """Config 5 under the FULL elastic runtime, multi-process: two elastic
     workers (world 2) discover the operator-launched PS pods through the
@@ -271,6 +284,7 @@ def test_elastic_worker_with_pipeline_mesh(workdir):
         master.stop()
 
 
+@multiproc
 def test_preflight_scale_up_adopts_precompiled_generation(workdir):
     """The r5 recovery centerpiece, end to end with real processes: a
     planned scale-up announces the next generation while generation 1
@@ -351,6 +365,7 @@ def test_preflight_scale_up_adopts_precompiled_generation(workdir):
         master.stop()
 
 
+@multiproc
 def test_preflight_crash_falls_back_to_plain_drain(workdir):
     """Every preflight failure path must degrade to the ordinary switch:
     here every preflight worker crashes on arrival (a compile-OOM stand-
@@ -434,6 +449,7 @@ def test_preflight_crash_falls_back_to_plain_drain(workdir):
         master.stop()
 
 
+@multiproc
 def test_standing_preflight_adopts_on_unplanned_kill(workdir):
     """Opt-in standing preflight, end to end: in steady state the master
     keeps the next generation pre-formed (same members, fresh

@@ -55,7 +55,14 @@ def wait_for(cond, timeout, desc):
     )
 
 
+from envprobe import requires_multiproc_cpu
+
+
+@requires_multiproc_cpu()
 def test_full_reference_lifecycle(tmp_path):
+    # the Brain's startup plan levels 2 worker pods → a 2-process jax
+    # world; unformable where the CPU backend lacks cross-process
+    # collectives (see tests/envprobe.py)
     workdir = str(tmp_path / "work")
     plan_dir = str(tmp_path / "resources")
     os.makedirs(workdir)

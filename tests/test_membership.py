@@ -367,6 +367,76 @@ def test_member_death_outside_prepared_group_keeps_preflight():
     assert d.kind == "run" and d.coordinator == prep2.coordinator
 
 
+# ------------------------------------------- chaos-exposed membership edges
+
+
+def test_rejoin_with_stale_generation_gets_killed_then_rejoins():
+    """An evicted agent that comes back still RUNNING its old generation's
+    worker (the heartbeat_loss drill's second act): the stale worker is
+    hung in collectives against a dead coordinator, so the master must KILL
+    it first, then re-admit the agent — and the generation must only ever
+    move forward."""
+    rdv = mk(desired=2)
+    gen = start_gen(rdv, ["a0", "a1"])
+    # a1 goes silent past the eviction threshold
+    rdv.agents["a1"].last_heartbeat -= 100.0
+    rdv.tick()
+    assert rdv.agents["a1"].state == AgentState.LOST
+    assert rdv.directive_for("a0").kind == "kill"
+    rdv.heartbeat("a0", gen, "idle")
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == gen + 1
+    assert rdv.members == ["a0"]
+    # survivor runs the shrunken generation
+    rdv.heartbeat("a0", gen + 1, "running")
+    # a1 returns, STILL reporting the stale generation as running: its
+    # worker hangs in collectives against a dead coordinator — the master
+    # must order it killed, not adopt it as-is
+    d = rdv.heartbeat("a1", gen, "running")
+    assert d.kind == "kill", d
+    # its worker dies; a1 is now a healthy standby -> reshape back to 2
+    rdv.heartbeat("a1", gen, "idle")
+    assert rdv.phase == JobPhase.DRAINING
+    for a in ("a0", "a1"):
+        if rdv.directive_for(a).kind == "quiesce":
+            rdv.heartbeat(a, rdv.generation, "quiesced")
+    assert rdv.phase == JobPhase.STABLE
+    assert set(rdv.members) == {"a0", "a1"}
+    assert rdv.generation == gen + 2  # forward only, one step per reshape
+
+
+def test_heartbeat_loss_just_below_eviction_threshold_is_tolerated():
+    """A gap of timeout − ε must NOT evict: evicting a member that is
+    merely slow turns one blip into a full generation switch (the rpc_burst
+    drill's no-ping-pong invariant at the FSM level)."""
+    import time as _time
+
+    rdv = mk(desired=2, heartbeat_timeout=5.0)
+    gen = start_gen(rdv, ["a0", "a1"])
+    now = _time.monotonic()
+    rdv.agents["a0"].last_heartbeat = now  # a0 fresh
+    rdv.agents["a1"].last_heartbeat = now - 4.9  # just inside the window
+    rdv.tick(now)
+    assert rdv.agents["a1"].state == AgentState.RUNNING
+    assert rdv.phase == JobPhase.STABLE and rdv.generation == gen
+
+
+def test_heartbeat_loss_just_above_eviction_threshold_evicts():
+    import time as _time
+
+    rdv = mk(desired=2, heartbeat_timeout=5.0)
+    gen = start_gen(rdv, ["a0", "a1"])
+    now = _time.monotonic()
+    rdv.agents["a0"].last_heartbeat = now
+    rdv.agents["a1"].last_heartbeat = now - 5.1  # just past the window
+    rdv.tick(now)
+    assert rdv.agents["a1"].state == AgentState.LOST
+    assert rdv.phase == JobPhase.DRAINING
+    # survivors get KILL (unplanned), and the world reforms without a1
+    assert rdv.directive_for("a0").kind == "kill"
+    rdv.heartbeat("a0", gen, "idle")
+    assert rdv.phase == JobPhase.STABLE and rdv.members == ["a0"]
+
+
 def test_notice_mid_prepare_tightens_window():
     clock = {"t": 0.0}
     rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),

@@ -1,0 +1,102 @@
+"""Unit tests for utils/retry.py — the bounded backoff+jitter retry shared
+by the PS client's pull/push paths and the agent's register path (ISSUE 2
+satellite: transient UNAVAILABLE must be ridden out, real failures must
+still surface)."""
+
+import grpc
+import pytest
+
+from easydl_tpu.utils.retry import (
+    backoff_delay,
+    is_transport_error,
+    retry_transient,
+)
+
+
+class FakeRpcError(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+def test_transport_error_classification():
+    assert is_transport_error(FakeRpcError(grpc.StatusCode.UNAVAILABLE))
+    assert is_transport_error(FakeRpcError(grpc.StatusCode.DEADLINE_EXCEEDED))
+    assert is_transport_error(FakeRpcError(grpc.StatusCode.CANCELLED))
+    assert is_transport_error(ValueError("closed channel"))
+    # handler-side and programming errors are NOT transient
+    assert not is_transport_error(FakeRpcError(grpc.StatusCode.UNKNOWN))
+    assert not is_transport_error(RuntimeError("boom"))
+
+
+def test_backoff_delay_exponential_with_full_jitter():
+    # rng pinned to 1.0 -> the ceiling itself; sequence doubles then caps
+    delays = [backoff_delay(n, base_s=0.1, cap_s=1.0, rng=lambda: 1.0)
+              for n in range(6)]
+    assert delays == [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+    # full jitter: rng scales the ceiling down (floored away from zero)
+    assert backoff_delay(3, base_s=0.1, cap_s=1.0, rng=lambda: 0.5) == 0.4
+
+
+def test_backoff_delay_survives_huge_attempt_counts():
+    # a master outage of hours produces thousands of consecutive failures;
+    # 2**attempt must not overflow float arithmetic and crash the loop
+    assert backoff_delay(100_000, base_s=0.1, cap_s=1.0,
+                         rng=lambda: 1.0) == 1.0
+
+
+def test_retry_transient_recovers_after_transients():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+        return "ok"
+
+    slept = []
+    assert retry_transient(flaky, max_elapsed_s=10.0,
+                           sleep=slept.append) == "ok"
+    assert calls["n"] == 3 and len(slept) == 2
+
+
+def test_retry_transient_gives_up_after_budget():
+    def always_down():
+        raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+
+    with pytest.raises(grpc.RpcError):
+        # zero budget: the first transient failure re-raises unchanged
+        retry_transient(always_down, max_elapsed_s=0.0, sleep=lambda s: None)
+
+
+def test_retry_transient_non_transient_raises_immediately():
+    calls = {"n": 0}
+
+    def handler_bug():
+        calls["n"] += 1
+        raise RuntimeError("handler exploded")
+
+    with pytest.raises(RuntimeError):
+        retry_transient(handler_bug, max_elapsed_s=10.0,
+                        sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_transient_on_retry_hook_runs_and_may_fail():
+    calls = {"n": 0, "hook": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+        return calls["n"]
+
+    def hook(err):
+        calls["hook"] += 1
+        raise OSError("registry unreadable")  # must not break the retry
+
+    assert retry_transient(flaky, max_elapsed_s=10.0, on_retry=hook,
+                           sleep=lambda s: None) == 2
+    assert calls["hook"] == 1
