@@ -28,6 +28,7 @@ import os
 import shutil
 import sys
 import tempfile
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Mapping, Optional
@@ -96,7 +97,12 @@ class ChaosHarness:
         self.schedule = compile_schedule(scenario.chaos)
         self._agents: Dict[str, Any] = {}
         self._master = None
+        self._master_kwargs: Dict[str, Any] = {}
         self._pod_api = None
+        self._timers: List[threading.Timer] = []
+        #: control-plane outage windows [{"t_down": wall, "t_up": wall}] —
+        #: evidence for the training_progress_during_outage invariant
+        self.outages: List[Dict[str, float]] = []
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> Dict[str, Any]:
@@ -154,7 +160,7 @@ class ChaosHarness:
             fault_counts[kind] = fault_counts.get(kind, 0.0) + count
         verdict = invariants.check_scenario(
             self.workdir, sc.expect, status=status,
-            fault_counts=fault_counts,
+            fault_counts=fault_counts, outages=self.outages,
         )
         _scenario_counter().inc(scenario=sc.name,
                                 result="pass" if verdict["passed"] else "fail")
@@ -167,6 +173,7 @@ class ChaosHarness:
             "schedule": self.schedule,
             "expect": dict(sc.expect),
             "faults_injected": fault_counts,
+            "outages": list(self.outages),
             "final_status": status,
             "invariants": verdict,
             "passed": verdict["passed"],
@@ -203,14 +210,20 @@ class ChaosHarness:
             min_workers=1, heartbeat_timeout=2.0, prepare_timeout_s=0.0,
         )
         master_kwargs.update(sc.master_kwargs)
+        self._master_kwargs = master_kwargs
         self._master = Master(
             job_name=sc.name, workdir=self.workdir,
             worker_config=sc.job_cfg, **master_kwargs,
         ).start()
+        # Publish the master address the way the pod entrypoint does:
+        # agents heartbeating a dead control plane re-read this file and
+        # re-present themselves to its replacement (the failover drills).
+        self._publish_master(self._master.address)
         for i in range(sc.n_agents):
             aid = f"a{i}"
             self._agents[aid] = Agent(
                 aid, self._master.address, self.workdir, slots=sc.slots,
+                master_file=self._master_file, master_refresh_s=0.5,
             ).start()
             if i == 0:
                 # Stagger: a0 registers (and, with min_workers=1, becomes
@@ -235,12 +248,66 @@ class ChaosHarness:
                   f"steady state (every member past step {sc.steady_step})")
 
     def _wait_done(self) -> None:
+        # Re-reads self._master every poll: a master_crash event swaps the
+        # instance mid-run, and DONE is only ever reached by the replacement.
         sc = self.scenario
-        if not self._master.wait_done(timeout=sc.done_timeout_s):
-            log.warning("scenario %s: job not DONE after %.0fs: %s",
-                        sc.name, sc.done_timeout_s, self._master.status())
+        deadline = time.monotonic() + sc.done_timeout_s
+        while time.monotonic() < deadline:
+            if self._master.done:
+                return
+            time.sleep(0.2)
+        log.warning("scenario %s: job not DONE after %.0fs: %s",
+                    sc.name, sc.done_timeout_s, self._master.status())
+
+    @property
+    def _master_file(self) -> str:
+        return os.path.join(self.workdir, "master.json")
+
+    def _publish_master(self, address: str) -> None:
+        tmp = self._master_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"address": address}, f)
+        os.replace(tmp, self._master_file)
+
+    def _crash_master(self, restart_after_s: float) -> None:
+        """SIGKILL-equivalent for the in-proc control plane: stop the gRPC
+        server and loops abruptly (no final journal write — durability must
+        come from the journal already on disk), then level a fresh Master
+        in over the same workdir after ``restart_after_s``."""
+        self.outages.append({"t_down": time.time()})
+        log.info("chaos: crashing master (restart in %.1fs)", restart_after_s)
+        self._master.stop()
+        t = threading.Timer(restart_after_s, self._restart_master)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+
+    def _restart_master(self) -> None:
+        from easydl_tpu.elastic.master import Master
+
+        sc = self.scenario
+        if getattr(self, "_torn_down", False):
+            return  # drill already over; don't resurrect into teardown
+        try:
+            m = Master(
+                job_name=sc.name, workdir=self.workdir,
+                worker_config=sc.job_cfg, **self._master_kwargs,
+            ).start()
+        except Exception as e:  # surfaced by the drill's invariants
+            log.error("master restart failed: %s", e)
+            return
+        self._master = m
+        self._publish_master(m.address)
+        for o in self.outages:
+            if "t_up" not in o:
+                o["t_up"] = time.time()
+        log.info("chaos: master restarted at %s over %s",
+                 m.address, self.workdir)
 
     def _teardown(self) -> None:
+        self._torn_down = True
+        for t in self._timers:
+            t.cancel()
         for a in self._agents.values():
             try:
                 a.stop()
@@ -315,14 +382,21 @@ class ChaosHarness:
                 # event-execution thread would shift every later scheduled
                 # event by the pause duration, silently violating the
                 # compiled timeline the subsystem promises
-                import threading
-
                 t = threading.Timer(float(params.get("duration_s", 1.0)),
                                     agent.resume_worker)
                 t.daemon = True
                 t.start()
+                self._timers.append(t)
         elif kind == "agent_stop":
             self._agents[target["agent"]].stop()
+            injectors.count_fault(kind)
+        elif kind == "master_crash":
+            # Restart on a timer for the same reason as worker_pause: the
+            # outage must not shift later scheduled events.
+            self._crash_master(float(params.get("restart_after_s", 1.0)))
+            injectors.count_fault(kind)
+        elif kind == "preempt_notice":
+            self._agents[target["agent"]].notify_preemption()
             injectors.count_fault(kind)
         elif kind == "ps_kill":
             self._ps_crash_and_rescue(int(target["shard"]),
@@ -609,6 +683,84 @@ def scenario_ckpt_corrupt(seed: int = 23) -> Scenario:
     )
 
 
+def scenario_master_crash(seed: int = 29) -> Scenario:
+    """Control-plane failover over a HEALTHY fleet: the master is killed at
+    steady state and a fresh one restarts over the same workdir. The
+    membership journal + reconciliation grace must make this invisible to
+    the data plane: workers keep training through the outage (progress
+    recorded inside the window), agents re-present and are matched against
+    the journal, and ZERO reshapes happen after the failover."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="master_crash", seed=seed,
+            notes="crash the master at steady state; restart over the same "
+                  "workdir 1.5s later — zero reshapes, training never stops",
+            faults=(
+                FaultSpec(kind="master_crash", at_s=0.3,
+                          params={"restart_after_s": 1.5}),
+            ),
+        ),
+        # Long enough that the job is still mid-run through crash + outage +
+        # reconciliation (steps run at hundreds/s on CPU).
+        job_cfg=dict(_MLP_CFG, total_steps=3000, ckpt_interval=150),
+        n_agents=2, desired_workers=1, slots=1, steady_step=5,
+        master_kwargs={"min_workers": 1, "heartbeat_timeout": 2.0,
+                       "reconcile_grace_s": 5.0},
+        expect={
+            "target_step": 3000,
+            "max_steps_lost": 0,          # nothing dies; nothing restores
+            "final_workers": 1,
+            "final_world_devices": 1,
+            "max_reshapes": 0,            # the whole point
+            "max_reshapes_after_failover": 0,
+            "min_steps_during_outage": 5,  # training never stopped
+            "min_faults": 1,
+        },
+    )
+
+
+def scenario_master_restart_mid_drain(seed: int = 31) -> Scenario:
+    """Master crash DURING a planned drain: a preemption notice starts the
+    quiesce of the member just before the control plane dies. The restarted
+    master must resume the in-flight drain from the journal (or adopt its
+    completed result) — one reshape total, generation monotonic, and the
+    preempting host's replacement finishes the job."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="master_restart_mid_drain", seed=seed,
+            notes="preemption notice to the member, then crash the master "
+                  "0.15s later mid-drain; restart after 1.2s",
+            faults=(
+                FaultSpec(kind="preempt_notice", at_s=0.2,
+                          target={"agent": "a0"}),
+                FaultSpec(kind="master_crash", at_s=0.35,
+                          params={"restart_after_s": 1.2}),
+            ),
+        ),
+        job_cfg=dict(_MLP_CFG, total_steps=3000, ckpt_interval=150),
+        n_agents=2, desired_workers=1, slots=1, steady_step=5,
+        master_kwargs={"min_workers": 1, "heartbeat_timeout": 2.0,
+                       "reconcile_grace_s": 5.0},
+        done_timeout_s=420.0,
+        expect={
+            "target_step": 3000,
+            # The notice-driven drain quiesces at a step boundary; the
+            # bound still allows the escalation path if the crash races the
+            # quiesce checkpoint.
+            "max_steps_lost": 300,
+            "final_workers": 1,
+            "final_world_devices": 1,
+            "max_reshapes": 2,
+            "min_final_generation": 2,    # the drain really reshaped
+            # The reshape may complete before the crash (journaled, 0
+            # after) or after the restart (resumed drain, 1 after) — both
+            # are correct; TWO would be the spurious extra this pins.
+            "max_reshapes_after_failover": 1,
+            "min_faults": 2,
+        },
+    )
+
+
 #: name → builder(seed) for scripts/chaos_run.py and the e2e tests.
 SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "worker_kill": scenario_worker_kill,
@@ -616,6 +768,8 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "rpc_burst": scenario_rpc_burst,
     "ps_shard_crash": scenario_ps_shard_crash,
     "ckpt_corrupt": scenario_ckpt_corrupt,
+    "master_crash": scenario_master_crash,
+    "master_restart_mid_drain": scenario_master_restart_mid_drain,
 }
 
 #: the cheapest deterministic drill — what scripts/chaos_smoke.sh runs and

@@ -20,6 +20,13 @@ verdicts:
 - ``no_directive_ping_pong`` — the master reshaped at most the expected
   number of times: flapping (kill → rejoin → kill ...) shows up as excess
   ``draining`` transitions even when the job eventually finishes;
+- ``no_spurious_reshape_after_failover`` — after a master restart restored
+  the membership journal (the WAL's ``failover`` record), the generation
+  advanced at most the declared number of times: a failover over a healthy
+  fleet must cost ZERO reshapes;
+- ``training_progress_during_outage`` — step records were written INSIDE
+  every control-plane outage window: the data plane kept training while the
+  master was dead;
 - ``faults_observed`` (cross-check) — the obs counters saw at least the
   expected number of injected faults, so a "pass" can't come from a drill
   that silently injected nothing.
@@ -61,6 +68,34 @@ def read_metrics(workdir: str) -> List[Dict[str, Any]]:
     return out
 
 
+def read_metrics_by_agent(workdir: str) -> Dict[str, List[Dict[str, Any]]]:
+    """Step records keyed by the agent whose file they came from (the
+    records themselves carry no agent id — the filename does)."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    try:
+        names = os.listdir(workdir)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not (name.startswith("metrics-") and name.endswith(".jsonl")):
+            continue
+        agent = name[len("metrics-"):-len(".jsonl")]
+        records: List[Dict[str, Any]] = []
+        try:
+            with open(os.path.join(workdir, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            records.append(json.loads(line))
+                        except ValueError:
+                            continue
+        except OSError:
+            continue
+        out[agent] = records
+    return out
+
+
 def read_events(workdir: str) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     try:
@@ -92,6 +127,7 @@ def check_scenario(
     expect: Mapping[str, Any],
     status: Optional[Mapping[str, Any]] = None,
     fault_counts: Optional[Mapping[str, float]] = None,
+    outages: Optional[List[Mapping[str, float]]] = None,
 ) -> Dict[str, Any]:
     """Run every applicable invariant; returns::
 
@@ -99,7 +135,10 @@ def check_scenario(
 
     ``status`` is the master's final ``status()`` snapshot (captured before
     teardown); ``fault_counts`` the injected-fault counters
-    (injectors.injected_fault_counts or a merged scrape)."""
+    (injectors.injected_fault_counts or a merged scrape); ``outages`` the
+    harness-recorded control-plane outage windows
+    (``[{"t_down": wall, "t_up": wall}]``, ``t_up`` absent when the master
+    never came back)."""
     metrics = read_metrics(workdir)
     events = read_events(workdir)
     by_gen = _steps_by_generation(metrics)
@@ -209,6 +248,82 @@ def check_scenario(
             "final_generation": gen_final,
             "min_final_generation": int(min_gen),
         }
+
+    # ----------------------------------- no_spurious_reshape_after_failover
+    max_after = expect.get("max_reshapes_after_failover")
+    if max_after is not None:
+        failovers = [e for e in events if e.get("kind") == "failover"]
+        if not failovers:
+            # The drill PROMISED a failover; a run where the restarted
+            # master never restored the journal must not pass vacuously.
+            checks["no_spurious_reshape_after_failover"] = {
+                "ok": False,
+                "reason": "no failover event in the WAL (journal not "
+                          "restored?)",
+                "max_reshapes_after_failover": int(max_after),
+            }
+        else:
+            last = failovers[-1]
+            gen_at_failover = int(last.get("generation", 0))
+            gen_final = int((status or {}).get("generation", gen_at_failover))
+            reshapes_after = max(0, gen_final - gen_at_failover)
+            checks["no_spurious_reshape_after_failover"] = {
+                "ok": reshapes_after <= int(max_after),
+                "failovers": len(failovers),
+                "generation_at_failover": gen_at_failover,
+                "final_generation": gen_final,
+                "reshapes_after_failover": reshapes_after,
+                "max_reshapes_after_failover": int(max_after),
+            }
+
+    # --------------------------------------- training_progress_during_outage
+    min_outage_steps = expect.get("min_steps_during_outage")
+    if min_outage_steps is not None:
+        windows = [
+            (float(o["t_down"]), float(o.get("t_up", float("inf"))))
+            for o in (outages or [])
+        ]
+        if not windows:
+            checks["training_progress_during_outage"] = {
+                "ok": False,
+                "reason": "no control-plane outage recorded by the harness",
+                "min_steps_during_outage": int(min_outage_steps),
+            }
+        else:
+            # Progress is judged PER AGENT (max−min within one worker's
+            # records), then the best agent per window: pooling all agents'
+            # records would read the step SPREAD between two stalled
+            # workers as progress.
+            by_agent = read_metrics_by_agent(workdir)
+            evidence = []
+            ok = True
+            for t_down, t_up in windows:
+                per_agent = {}
+                for agent, records in by_agent.items():
+                    steps = [
+                        int(r["step"]) for r in records
+                        if t_down <= float(r.get("t", 0.0)) <= t_up
+                        and "step" in r
+                    ]
+                    if steps:
+                        per_agent[agent] = {
+                            "records": len(steps),
+                            "progress": max(steps) - min(steps),
+                        }
+                progress = max(
+                    (v["progress"] for v in per_agent.values()), default=0)
+                evidence.append({
+                    "t_down": t_down,
+                    "t_up": None if t_up == float("inf") else t_up,
+                    "per_agent": per_agent,
+                    "step_progress": progress,
+                })
+                ok = ok and progress >= int(min_outage_steps)
+            checks["training_progress_during_outage"] = {
+                "ok": ok,
+                "windows": evidence,
+                "min_steps_during_outage": int(min_outage_steps),
+            }
 
     # ----------------------------------------------------- faults cross-check
     min_faults = expect.get("min_faults")

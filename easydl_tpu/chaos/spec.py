@@ -24,7 +24,10 @@ Two classes of event kind:
   ``straggler``, ``ckpt_corrupt_write``.
 - **process** (executed by the harness at the scheduled offset, through the
   agent / controller process APIs): ``worker_kill``, ``worker_pause``,
-  ``agent_stop``, ``ps_kill``, ``corrupt_latest_ckpt``.
+  ``agent_stop``, ``ps_kill``, ``corrupt_latest_ckpt``, ``master_crash``
+  (stop the control plane abruptly; a fresh Master restarts over the same
+  workdir after ``restart_after_s``), ``preempt_notice`` (deliver the cloud
+  preemption notice to an agent).
 """
 
 from __future__ import annotations
@@ -42,7 +45,7 @@ INLINE_KINDS = frozenset({
 #: Kinds the harness executes itself (process-level faults).
 PROCESS_KINDS = frozenset({
     "worker_kill", "worker_pause", "agent_stop", "ps_kill",
-    "corrupt_latest_ckpt",
+    "corrupt_latest_ckpt", "master_crash", "preempt_notice",
 })
 ALL_KINDS = INLINE_KINDS | PROCESS_KINDS
 

@@ -153,8 +153,25 @@ class Agent:
         self._m_phase_total = reg.counter(
             "easydl_agent_phase_events_total", "Timeline phase boundaries "
             "emitted in-process.", ("agent", "phase"))
+        self._m_outages = reg.counter(
+            "easydl_agent_master_outages_total", "Master-unreachable "
+            "episodes survived (workers kept training).", ("agent",))
+        self._m_outage_seconds = reg.gauge(
+            "easydl_agent_master_outage_seconds", "Duration of the most "
+            "recent master outage.", ("agent",))
+        self._m_outage_buffered = reg.gauge(
+            "easydl_agent_outage_buffered_metrics", "Step-metric records "
+            "buffered during the current/last master outage.", ("agent",))
         self._hb_times: Deque[float] = collections.deque(maxlen=20)
         self._tl_last: Optional[tuple] = None  # (phase, monotonic t)
+        # Step metrics observed while the master is unreachable: buffered
+        # (bounded — the deque keeps the NEWEST 64 distinct-step records,
+        # older history rolls off) and replayed in full, oldest first, on
+        # reconnect. Ordering matters: the master forwards an aggregate to
+        # the Brain only when its step advances past the last reported one,
+        # so the replay must land BEFORE any current-step heartbeat or the
+        # entire backfill is deduplicated away.
+        self._outage_buf: Deque[Dict[str, Any]] = collections.deque(maxlen=64)
 
     #: The agent-side legs of a generation switch whose durations are
     #: meaningful: duration is recorded only for these (previous → current)
@@ -227,6 +244,40 @@ class Agent:
             )
         )
 
+    def _heartbeat_request(self, metrics: Dict[str, Any]) -> pb.HeartbeatRequest:
+        return pb.HeartbeatRequest(
+            agent_id=self.agent_id,
+            generation=self._applied_key[0],
+            state=self._state,
+            prepared=self._preflight_ready(),
+            step=int(metrics.get("step", 0)),
+            metrics=pb.StepMetrics(
+                step=int(metrics.get("step", 0)),
+                step_time_s=float(metrics.get("step_time_s", 0.0)),
+                samples_per_sec=float(metrics.get("samples_per_sec", 0.0)),
+                loss=float(metrics.get("loss", 0.0)),
+                world_size=int(metrics.get("world_size", 0)),
+            ),
+            preemption_notice="preempt" if self._preempting.is_set() else "",
+            host=self.host,
+            slots=self.slots,
+        )
+
+    def _represent(self) -> pb.Directive:
+        """(Re-)introduce this agent to a master that may have restarted.
+
+        An agent that has already run a generation presents its live
+        ``(generation, state)`` via Heartbeat — the restarted master matches
+        it against the membership journal and adopts it AS the running
+        member it is. Register would reset it to a cold joiner, which reads
+        as a worker crash and forces a spurious reshape of a healthy
+        fleet."""
+        if self._applied_key[0] <= 0:
+            return self._register()
+        return self._client.Heartbeat(
+            self._heartbeat_request(self._read_metrics())
+        )
+
     def _maybe_follow_master(self) -> Optional[pb.Directive]:
         """Re-read master_file; if the master moved, reconnect + re-register."""
         if not self.master_file:
@@ -253,7 +304,12 @@ class Agent:
         if old:
             old.close()
         try:
-            return self._register()
+            # Replay the outage backfill BEFORE presenting current-step
+            # metrics (same ordering contract as the main loop's probe) —
+            # the first replayed heartbeat doubles as the re-presentation,
+            # since every heartbeat carries the live (generation, state).
+            self._flush_outage_buffer()
+            return self._represent()
         except Exception as e:
             log.warning("%s: re-register at %s failed: %s",
                         self.agent_id, new_addr, e)
@@ -364,35 +420,48 @@ class Agent:
                 if heartbeat_suppressed(self.agent_id):
                     continue
             try:
+                # Mid-outage, the reconnect probe carries the OLDEST
+                # buffered record as its metrics payload (state/generation
+                # are always current — membership correctness never lags):
+                # the heartbeat that discovers the recovered master is then
+                # itself the first replay, keeping the whole backfill
+                # oldest-first ahead of any current-step report (which
+                # would cap the master's forward-to-Brain step gate).
+                probe = (self._outage_buf[0]
+                         if fail_since is not None and self._outage_buf
+                         else None)
                 directive = self._client.Heartbeat(
-                    pb.HeartbeatRequest(
-                        agent_id=self.agent_id,
-                        generation=self._applied_key[0],
-                        state=self._state,
-                        prepared=self._preflight_ready(),
-                        step=int(metrics.get("step", 0)),
-                        metrics=pb.StepMetrics(
-                            step=int(metrics.get("step", 0)),
-                            step_time_s=float(metrics.get("step_time_s", 0.0)),
-                            samples_per_sec=float(metrics.get("samples_per_sec", 0.0)),
-                            loss=float(metrics.get("loss", 0.0)),
-                            world_size=int(metrics.get("world_size", 0)),
-                        ),
-                        preemption_notice="preempt" if self._preempting.is_set() else "",
-                        host=self.host,
-                        slots=self.slots,
-                    )
+                    self._heartbeat_request(
+                        probe if probe is not None else metrics)
                 )
+                if fail_since is not None:
+                    # Outage over (the SAME master address answered again —
+                    # a restarted master behind a stable address lands
+                    # here; a moved one lands in _maybe_follow_master).
+                    self._note_outage_end(fail_since)
+                    if probe is not None and self._outage_buf:
+                        self._outage_buf.popleft()  # probe already delivered
+                    d = self._flush_outage_buffer()
+                    if d is not None:
+                        directive = d
                 fail_since = None
                 fail_count = 0
                 self._note_heartbeat(metrics)
             except Exception as e:
                 log.warning("%s: heartbeat failed: %s", self.agent_id, e)
                 now = time.monotonic()
-                fail_since = fail_since if fail_since is not None else now
+                if fail_since is None:
+                    fail_since = now
+                    try:
+                        self._m_outages.inc(agent=self.agent_id)
+                    except Exception:
+                        pass
+                self._buffer_outage_metrics(metrics)
                 if now - fail_since > self.master_refresh_s:
                     refreshed = self._maybe_follow_master()
                     if refreshed is not None:
+                        # buffer already replayed inside _maybe_follow_master
+                        self._note_outage_end(fail_since)
                         directive = refreshed
                         fail_since = None
                         fail_count = 0
@@ -407,6 +476,61 @@ class Agent:
                 time.sleep(backoff_delay(fail_count, base_s=0.1,
                                          cap_s=max(self.heartbeat_interval,
                                                    1.0)))
+
+    def _buffer_outage_metrics(self, metrics: Dict[str, Any]) -> None:
+        """Queue a step record observed while the master is unreachable.
+        Deduped by step: the loop re-reads the same JSONL tail every
+        iteration, and replaying N copies of one step would be noise."""
+        if not metrics or float(metrics.get("step_time_s", 0.0)) <= 0:
+            return
+        if self._outage_buf and (
+            int(self._outage_buf[-1].get("step", -1))
+            == int(metrics.get("step", 0))
+        ):
+            return
+        self._outage_buf.append(dict(metrics))
+        try:
+            self._m_outage_buffered.set(len(self._outage_buf),
+                                        agent=self.agent_id)
+        except Exception:
+            pass
+
+    def _note_outage_end(self, fail_since: float) -> None:
+        try:
+            self._m_outage_seconds.set(time.monotonic() - fail_since,
+                                       agent=self.agent_id)
+        except Exception:
+            pass
+        log.info("%s: master reachable again after %.1fs outage "
+                 "(%d buffered step records)", self.agent_id,
+                 time.monotonic() - fail_since, len(self._outage_buf))
+
+    def _flush_outage_buffer(self) -> Optional[pb.Directive]:
+        """Replay the WHOLE buffer to the recovered master, oldest first,
+        so its training-progress view — and, through its monotone
+        forward-to-Brain gate, the Brain's observation stream — is
+        backfilled across the outage (up to the buffer bound: the newest
+        64 distinct-step records; older history rolled off the deque).
+        Must run before any current-step heartbeat, which would cap the
+        gate and dedupe the backfill away. Returns the last directive the
+        replay earned (the freshest word from the master) or None when
+        nothing was replayed."""
+        if not self._outage_buf:
+            return None
+        replay = list(self._outage_buf)
+        self._outage_buf.clear()
+        last: Optional[pb.Directive] = None
+        for rec in replay:
+            try:
+                last = self._client.Heartbeat(self._heartbeat_request(rec))
+            except Exception as e:
+                log.debug("%s: outage replay dropped: %s", self.agent_id, e)
+                break
+        try:
+            self._m_outage_buffered.set(0, agent=self.agent_id)
+        except Exception:
+            pass
+        return last
 
     def _note_heartbeat(self, metrics: Dict[str, Any]) -> None:
         """Update cadence + bridged worker gauges after a delivered
@@ -666,16 +790,44 @@ class Agent:
             "EASYDL_METRICS": self.metrics_path,
             "EASYDL_TIMELINE": self.timeline_path,
         }
+        run_sig = (m.generation, m.coordinator)
         preflight_hit = False
+        dead_preflight = False
         if self._preflight is not None:
             proc, go_file, sig, log_file = self._preflight
-            if sig == (m.generation, m.coordinator) and proc.poll() is None:
+            if sig == run_sig and proc.poll() is None:
                 preflight_hit = True
             else:
                 # Formed generation differs from the prepared one (aborted
                 # prepare, fresh coordinator): this preflight can never be
                 # promoted — its group is dead.
+                dead_preflight = sig == run_sig
                 self._kill_preflight()
+        if not preflight_hit and (
+            dead_preflight or self._preflight_failed_sig == run_sig
+        ):
+            # The RUN adopts the coordinator OUR preflight joined — and that
+            # preflight died after its last "prepared" heartbeat (ADVICE
+            # round 5 medium). Peers are promoting workers already
+            # dist-joined to this coordinator; a cold spawn can never
+            # complete its dist init against the half-formed group (if we
+            # owned rank 0 the coordination service died with the
+            # preflight), so the generation would hang until the dist-init
+            # timeout. Report it unformable instead: state "idle" at the
+            # RUN's generation is the failure heartbeat that makes the
+            # master re-form with a fresh coordinator.
+            log.warning(
+                "%s: RUN gen %d adopts coordinator %s of a DEAD preflight; "
+                "reporting generation unformable instead of cold-joining "
+                "the half-formed group", self.agent_id, m.generation,
+                m.coordinator,
+            )
+            timeline.emit(self.timeline_path, "unformable", m.generation,
+                          coordinator=m.coordinator)
+            self._applied_key = run_sig  # never spawn against this RUN
+            self._proc = None
+            self._state = "idle"
+            return
         warm_hit = bool(
             not preflight_hit
             and self.warm_start and self._warm and self._warm[0].poll() is None

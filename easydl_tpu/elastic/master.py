@@ -60,19 +60,38 @@ class _Servicer:
                 req.agent_id, req.host, req.slots, bool(req.preemption_notice)
             )
             self._m._count_directive(req.agent_id, d.kind)
+            # The journal must carry the new agent (and any cohort change)
+            # before the directive leaves the master.
+            self._m._persist_if_epoch_advanced()
             return self._m._to_proto(d)
 
     def Heartbeat(self, req: pb.HeartbeatRequest, ctx) -> pb.Directive:
         with self._m._lock:
-            if req.agent_id not in self._m.rendezvous.agents and req.host:
-                # Master restarted: adopt the heartbeating agent.
-                log.info("auto-registering unknown agent %s (master restart?)",
-                         req.agent_id)
-                self._m.rendezvous.register(
-                    req.agent_id, req.host, req.slots,
-                    bool(req.preemption_notice),
+            rdv = self._m.rendezvous
+            view = rdv.agents.get(req.agent_id)
+            if view is None and req.host:
+                # Unknown sender: a restarted master whose journal was lost
+                # (or an agent the journal predates). ADOPT the presented
+                # (generation, state) instead of resetting to IDLE — a
+                # surviving worker must not read as a crash.
+                log.info(
+                    "adopting unknown agent %s presenting gen %d state %r "
+                    "(master restart?)", req.agent_id, req.generation,
+                    req.state,
                 )
-            d = self._m.rendezvous.heartbeat(
+                rdv.adopt(
+                    req.agent_id, req.host, req.slots,
+                    req.generation, req.state, step=req.step,
+                    preempting=bool(req.preemption_notice),
+                    prepared=req.prepared,
+                )
+                self._m._m_reconciled.inc(job=self._m.job_name)
+            elif view is not None and view.resumed:
+                # Journal-resumed agent re-presenting after our restart.
+                log.info("agent %s re-presented after failover (gen %d, %s)",
+                         req.agent_id, req.generation, req.state)
+                self._m._m_reconciled.inc(job=self._m.job_name)
+            d = rdv.heartbeat(
                 req.agent_id,
                 req.generation,
                 req.state,
@@ -83,6 +102,7 @@ class _Servicer:
             if req.metrics.step_time_s > 0:
                 self._m._record_metrics(req.agent_id, req.metrics)
             self._m._count_directive(req.agent_id, d.kind)
+            self._m._persist_if_epoch_advanced()
             return self._m._to_proto(d)
 
 
@@ -105,6 +125,7 @@ class Master:
         prepare_min_uptime_s: float = 20.0,
         preempt_prepare_timeout_s: float = 20.0,
         standing_preflight: bool = False,
+        reconcile_grace_s: float = 10.0,
     ):
         self.job_name = job_name
         self.workdir = workdir
@@ -133,6 +154,17 @@ class Master:
             preempt_prepare_timeout_s=preempt_prepare_timeout_s,
             standing_preflight=standing_preflight,
         )
+        # Durable membership journal: rebuild who was registered, what
+        # directive cohort was in force, and any armed prepare — so a master
+        # crash over a healthy fleet costs a reconciliation grace period,
+        # not a full cold reshape (the pre-journal behavior).
+        self.reconcile_grace_s = reconcile_grace_s
+        self._failover = False
+        membership_snap = persisted.get("membership")
+        if isinstance(membership_snap, dict):
+            self._failover = self.rendezvous.restore(
+                membership_snap, grace_s=reconcile_grace_s
+            )
         self._lock = threading.RLock()
         self._server = None
         self._port = port
@@ -152,8 +184,16 @@ class Master:
             )
         #: agent -> (generation at receipt, StepMetrics)
         self._last_metrics: Dict[str, Tuple[int, pb.StepMetrics]] = {}
-        #: agent -> last directive kind sent (directive-transition counting)
-        self._last_directive_kind: Dict[str, str] = {}
+        #: agent -> last directive kind sent (directive-transition counting);
+        #: journaled so a restarted master neither double-counts a held
+        #: directive nor forgets what each agent was last told
+        self._last_directive_kind: Dict[str, str] = dict(
+            persisted.get("last_directives", {})
+        )
+        #: directive epoch already on disk — the journal is (re)written
+        #: BEFORE any directive of a newer epoch leaves the master
+        self._persisted_epoch = self.rendezvous.directive_epoch
+        self._journal_key: Optional[tuple] = None
         self._last_gauge_t = float("-inf")  # brainless train-gauge throttle
         # dedupe: one Brain report per (generation, step)
         self._last_reported_gen = -1
@@ -195,9 +235,33 @@ class Master:
         self._m_train_loss = reg.gauge(
             "easydl_master_train_loss", "Latest aggregated training loss.",
             ("job",))
+        self._m_failovers = reg.counter(
+            "easydl_master_failovers_total", "Master boots that restored a "
+            "live membership journal (control-plane failovers).", ("job",))
+        self._m_reconciled = reg.counter(
+            "easydl_master_reconciled_agents_total", "Agents re-presenting "
+            "their live state to a restarted master (matched against the "
+            "journal instead of cold-joining).", ("job",))
+        self._m_journal_writes = reg.counter(
+            "easydl_master_journal_writes_total", "Membership-journal "
+            "writes to the state file.", ("job",))
         if worker_config is not None:
             with open(os.path.join(workdir, "job.json"), "w") as f:
                 json.dump(worker_config, f)
+        if self._failover:
+            # The WAL records the failover (the invariant checker counts
+            # reshapes AFTER this point), and the journal is immediately
+            # rewritten so a crash during the grace period restores the
+            # same epoch again.
+            self._m_failovers.inc(job=self.job_name)
+            self._event(
+                "failover",
+                generation=self.rendezvous.generation,
+                members=list(self.rendezvous.members),
+                phase=self.rendezvous.phase.value,
+                epoch=self.rendezvous.directive_epoch,
+                grace_s=reconcile_grace_s,
+            )
 
     # ------------------------------------------------------------- persistence
     def _load_state(self) -> Dict[str, Any]:
@@ -223,6 +287,14 @@ class Master:
         return events
 
     def _persist_state(self) -> None:
+        """Write the full control-plane journal atomically.
+
+        Beyond the plan/generation basics, the ``membership`` snapshot
+        carries registered agents, per-agent last state, the armed prepare,
+        and the directive epoch — everything :meth:`Rendezvous.restore`
+        needs so a restarted master resumes the SAME directive cohort
+        instead of cold-reshaping a healthy fleet."""
+        snap = self.rendezvous.snapshot()
         tmp = self._state_path + ".tmp"
         try:
             with open(tmp, "w") as f:
@@ -232,12 +304,50 @@ class Master:
                         "generation": self.rendezvous.generation,
                         "desired_workers": self.rendezvous.desired_workers,
                         "job": self.job_name,
+                        "membership": snap,
+                        "last_directives": dict(self._last_directive_kind),
                     },
                     f,
                 )
             os.replace(tmp, self._state_path)
+            self._persisted_epoch = snap["directive_epoch"]
+            self._journal_key = self._journal_key_of(snap)
+            self._m_journal_writes.inc(job=self.job_name)
         except OSError as e:
             log.warning("master state persist failed: %s", e)
+
+    @staticmethod
+    def _journal_key_of(snap: Dict[str, Any]) -> tuple:
+        """Change-detection key over the snapshot's non-volatile fields
+        (steps drift every heartbeat; they are journaled when something
+        structural changes, not per heartbeat)."""
+        prep = snap.get("prepare")
+        return (
+            snap["phase"], snap["generation"], tuple(snap["members"]),
+            snap["coordinator"], snap["drain_planned"],
+            snap["directive_epoch"], snap["desired_workers"],
+            tuple(sorted(
+                (aid, d["host"], d["slots"], d["state"], d["generation"],
+                 d["prepared"], d["preempting"])
+                for aid, d in snap["agents"].items()
+            )),
+            (prep["generation"], tuple(prep["members"]), prep["coordinator"])
+            if prep else None,
+        )
+
+    def _persist_if_stale(self) -> None:
+        """Journal when the structural membership state drifted from what is
+        on disk (called with the lock held)."""
+        key = self._journal_key_of(self.rendezvous.snapshot())
+        if key != self._journal_key:
+            self._persist_state()
+
+    def _persist_if_epoch_advanced(self) -> None:
+        """The durability contract of the directive epoch: journal BEFORE a
+        directive of a new epoch is returned to any agent (called with the
+        lock held, on the RPC path — writes only on epoch transitions)."""
+        if self.rendezvous.directive_epoch != self._persisted_epoch:
+            self._persist_state()
 
     # ------------------------------------------------------------------ server
     @property
@@ -299,6 +409,10 @@ class Master:
                 self._m_desired.set(self.rendezvous.desired_workers,
                                     job=self.job_name)
                 self._m_plan_version.set(self.plan_version, job=self.job_name)
+                # Background journal freshness: structural drift the RPC
+                # path didn't cover (evictions from tick, prepared reports,
+                # host changes) lands on disk within one tick.
+                self._persist_if_stale()
             self._stop.wait(0.2)
 
     # ------------------------------------------------------------------ plans
@@ -463,12 +577,16 @@ class Master:
     def _event(self, kind: str, **data: Any) -> None:
         ev = {"t": time.time(), "kind": kind, **data}
         self.events.append(ev)
+        # Journal BEFORE appending to the WAL: a crash between the two must
+        # leave the state file at least as new as the last WAL record —
+        # never a WAL that already announced a generation the journal would
+        # roll back on restore (the invariant checker reads the WAL).
+        self._persist_state()
         try:
             with open(self._events_path, "a") as f:
                 f.write(json.dumps(ev) + "\n")
         except OSError as e:
             log.warning("event append failed: %s", e)
-        self._persist_state()
 
     def _count_directive(self, agent_id: str, kind: str) -> None:
         """Count directive TRANSITIONS per agent, not responses: a held

@@ -22,7 +22,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from easydl_tpu.utils.logging import get_logger
 
@@ -57,6 +57,11 @@ class AgentView:
     preempting: bool = False
     #: coordinator of the preflight this agent reports ready ("" = none)
     prepared: str = ""
+    #: True for a view rebuilt from the journal after a master restart,
+    #: until the agent re-presents itself (heartbeat/adopt). While the
+    #: reconciliation grace period is open, resumed agents are exempt from
+    #: LOST-marking — their silence is the master's outage, not theirs.
+    resumed: bool = False
 
 
 @dataclass
@@ -95,6 +100,11 @@ class PrepareState:
     deadline: float
     #: the wall-clock budget the deadline was derived from (for diagnostics)
     window_s: float = 0.0
+    #: when this prepare was armed (rendezvous clock) — a STANDING prepare
+    #: whose members stop reporting ready past the grace period is dropped
+    #: and re-armed with a fresh coordinator instead of silently degrading
+    #: every subsequent switch to cold (ADVICE round 5 low #4)
+    armed_at: float = 0.0
 
 
 class Rendezvous:
@@ -115,6 +125,7 @@ class Rendezvous:
         prepare_min_uptime_s: float = 20.0,
         preempt_prepare_timeout_s: float = 20.0,
         standing_preflight: bool = False,
+        standing_preflight_grace_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.desired_workers = desired_workers
@@ -153,9 +164,22 @@ class Rendezvous:
         #: reshapes preflight regardless (the compile overlaps training
         #: and the drain gates on readiness).
         self.standing_preflight = standing_preflight
+        #: how long an armed STANDING prepare may sit not-all-ready before
+        #: it is dropped and re-armed with a fresh coordinator
+        self.standing_preflight_grace_s = standing_preflight_grace_s
         self._clock = clock
         self._formed_at = float("-inf")
         self.prepare: Optional[PrepareState] = None
+        #: bumped on every (phase, generation, members) transition — the
+        #: version of the directive cohort currently in force. Journaled by
+        #: the master BEFORE directives of a new epoch are handed out, so a
+        #: restarted master resumes the same cohort instead of inventing a
+        #: conflicting one.
+        self.directive_epoch = 0
+        #: monotonic deadline of the post-restore reconciliation grace
+        #: period (-inf = not reconciling): journal-resumed agents that have
+        #: not yet re-presented are exempt from LOST-marking until then
+        self._reconcile_until = float("-inf")
 
     # ------------------------------------------------------------------ events
     def register(self, agent_id: str, host: str, slots: int, preempting: bool = False) -> Directive:
@@ -167,12 +191,60 @@ class Rendezvous:
             log.info("agent %s registered (%d slots)%s", agent_id, slots,
                      " [preempting]" if preempting else "")
         else:
-            # Re-registration after agent restart: treat as fresh.
+            # Re-registration after agent restart: treat as fresh. (An agent
+            # that merely lost the MASTER re-presents its live state via
+            # heartbeat/adopt instead — Register means the agent process
+            # itself restarted and owns no worker.)
             a.state = AgentState.IDLE
             a.last_heartbeat = time.monotonic()
             a.preempting = preempting
+            a.resumed = False
         self._evaluate()
         return self.directive_for(agent_id)
+
+    def adopt(
+        self,
+        agent_id: str,
+        host: str,
+        slots: int,
+        generation: int,
+        state: str,
+        step: int = 0,
+        preempting: bool = False,
+        prepared: str = "",
+    ) -> None:
+        """Admit an agent PRESENTING its live ``(generation, state)`` — the
+        re-registration path after a master restart.
+
+        Unlike :meth:`register`, the presented state is taken at face value
+        instead of being reset to IDLE: a surviving agent whose worker kept
+        training through the master outage must be rebuilt as the RUNNING
+        member it is, not treated as a cold joiner (the destructive reset
+        used to read as a worker crash and force a spurious reshape of a
+        healthy fleet). An agent presenting a STALE generation is admitted
+        as a standby only — ``directive_for`` orders its zombie worker
+        killed through the existing stale-worker path."""
+        a = self.agents.get(agent_id)
+        if a is None:
+            a = AgentView(agent_id=agent_id, host=host, slots=slots)
+            self.agents[agent_id] = a
+            log.info(
+                "adopting agent %s presenting gen %d state %r (%d slots)",
+                agent_id, generation, state, slots,
+            )
+        a.host = host
+        a.slots = slots
+        a.generation = generation
+        a.step = max(a.step, step)
+        a.prepared = prepared
+        a.preempting = preempting or a.preempting
+        a.last_heartbeat = time.monotonic()
+        a.resumed = False
+        try:
+            a.state = AgentState(state)
+        except ValueError:
+            pass
+        self._evaluate()
 
     def heartbeat(
         self,
@@ -189,6 +261,7 @@ class Rendezvous:
             # agents re-register when they see generation 0 noop repeatedly.
             return Directive(kind="noop")
         a.last_heartbeat = time.monotonic()
+        a.resumed = False  # re-presented after a master restart
         a.generation = generation
         a.step = max(a.step, step)
         a.prepared = prepared
@@ -210,7 +283,15 @@ class Rendezvous:
     def tick(self, now: Optional[float] = None) -> None:
         """Advance time: mark lost agents, re-evaluate."""
         now = now if now is not None else time.monotonic()
+        reconciling = now < self._reconcile_until
         for a in self.agents.values():
+            if a.resumed and reconciling:
+                # Journal-resumed agent that has not re-presented yet: its
+                # silence is OUR restart, not its death — hold eviction
+                # until the reconciliation grace period closes. Past it,
+                # the ordinary heartbeat timeout (counted from restore
+                # time) evicts the truly-missing.
+                continue
             if a.state not in (AgentState.LOST, AgentState.DONE) and (
                 now - a.last_heartbeat > self.heartbeat_timeout
             ):
@@ -218,6 +299,14 @@ class Rendezvous:
                             a.agent_id, now - a.last_heartbeat)
                 a.state = AgentState.LOST
         self._evaluate()
+
+    @property
+    def reconciling(self) -> bool:
+        """True while the post-restore grace period is open.
+
+        The window lives on the same clock as ``last_heartbeat``
+        (``time.monotonic``) — ``tick(now=...)`` tests drive both."""
+        return time.monotonic() < self._reconcile_until
 
     def set_desired_workers(self, n: int) -> None:
         if n != self.desired_workers:
@@ -289,6 +378,10 @@ class Rendezvous:
             self._evaluate_once()
             if (self.phase, self.generation, tuple(self.members)) == before:
                 return
+            # A new directive cohort is now in force; the master journals
+            # the epoch (and the state it versions) before handing out any
+            # directive that belongs to it.
+            self.directive_epoch += 1
 
     def _evaluate_once(self) -> None:
         if self.phase == JobPhase.DONE:
@@ -299,6 +392,38 @@ class Rendezvous:
             return
 
         if self.phase in (JobPhase.INIT, JobPhase.STABLE):
+            # A STANDING prepare whose members have stopped reporting ready
+            # (preflight workers crashed; agents latch the failed signature
+            # and never retry the same coordinator) would otherwise sit
+            # armed forever, silently degrading every subsequent switch to
+            # cold. ``armed_at`` is refreshed on every observed all-ready,
+            # so the grace period measures time WITHOUT readiness — a
+            # never-ready prepare re-arms grace seconds after arming, a
+            # crashed-after-ready one grace seconds after readiness was
+            # last seen. Dropping it lets the arm branch below re-arm with
+            # a fresh coordinator, which un-latches the agents' failed-
+            # preflight memory.
+            if (
+                self.prepare is not None
+                and self.prepare.deadline == float("inf")
+            ):
+                if all(
+                    self.agents[m].prepared == self.prepare.coordinator
+                    for m in self.prepare.members
+                    if m in self.agents
+                ):
+                    self.prepare.armed_at = self._clock()
+                elif (
+                    self._clock() - self.prepare.armed_at
+                    > self.standing_preflight_grace_s
+                ):
+                    log.warning(
+                        "standing preflight for generation %d not ready "
+                        "after %.0fs; re-arming with a fresh coordinator",
+                        self.prepare.generation,
+                        self.standing_preflight_grace_s,
+                    )
+                    self.prepare = None
             need, planned = self._want_reshape()
             if not need:
                 # STANDING PREFLIGHT: even with nothing to reshape, keep the
@@ -334,6 +459,7 @@ class Rendezvous:
                                 f"{self._port_alloc()}"
                             ),
                             deadline=float("inf"),  # standing: gates nothing
+                            armed_at=self._clock(),
                         )
                         log.info(
                             "standing preflight armed for generation %d "
@@ -376,6 +502,7 @@ class Rendezvous:
                     ),
                     deadline=self._clock() + window,
                     window_s=window,
+                    armed_at=self._clock(),
                 )
                 self.phase = JobPhase.PREPARING
                 log.info(
@@ -564,6 +691,121 @@ class Rendezvous:
             return self._attach_prepare(Directive(kind="noop"), agent_id)
         return self._attach_prepare(Directive(kind="noop"), agent_id)
 
+    # -------------------------------------------------------------- journaling
+    def snapshot(self) -> Dict[str, Any]:
+        """The membership journal entry: everything a restarted master needs
+        to resume THIS directive cohort instead of cold-reshaping a healthy
+        fleet — members, coordinator, per-agent last state, the armed
+        prepare, and the directive epoch. Plain JSON-serializable data; the
+        prepare deadline is stored as *remaining* seconds (monotonic clocks
+        don't survive a process)."""
+        prep = None
+        if self.prepare is not None:
+            p = self.prepare
+            prep = {
+                "generation": p.generation,
+                "members": list(p.members),
+                "coordinator": p.coordinator,
+                "remaining_s": (
+                    None if p.deadline == float("inf")
+                    else max(0.0, p.deadline - self._clock())
+                ),
+                "window_s": p.window_s,
+            }
+        return {
+            "phase": self.phase.value,
+            "generation": self.generation,
+            "members": list(self.members),
+            "coordinator": self._coordinator,
+            "drain_planned": self._drain_planned,
+            "directive_epoch": self.directive_epoch,
+            "desired_workers": self.desired_workers,
+            "prepare": prep,
+            "agents": {
+                a.agent_id: {
+                    "host": a.host,
+                    "slots": a.slots,
+                    "state": a.state.value,
+                    "generation": a.generation,
+                    "step": a.step,
+                    "prepared": a.prepared,
+                    "preempting": a.preempting,
+                }
+                for a in self.agents.values()
+            },
+        }
+
+    def restore(self, snap: Dict[str, Any], grace_s: float = 10.0) -> bool:
+        """Rebuild membership from a journal snapshot and open the
+        reconciliation grace period.
+
+        The current generation is adopted AS-IS: members, coordinator, and
+        phase resume exactly where the crashed master left them, so a
+        restart over a healthy fleet causes zero reshapes. Journaled agents
+        are marked ``resumed`` — exempt from LOST-marking while the grace
+        period is open; one that never re-presents is evicted through the
+        ordinary heartbeat timeout once it closes. Returns True when the
+        snapshot carried members (a real failover, not a first boot)."""
+        try:
+            self.phase = JobPhase(str(snap.get("phase", "init")))
+        except ValueError:
+            self.phase = JobPhase.INIT
+        self.generation = int(snap.get("generation", self.generation))
+        self.members = [str(m) for m in snap.get("members", [])]
+        self._coordinator = str(snap.get("coordinator", ""))
+        self._drain_planned = bool(snap.get("drain_planned", True))
+        self.directive_epoch = int(snap.get("directive_epoch", 0))
+        self.desired_workers = int(
+            snap.get("desired_workers", self.desired_workers)
+        )
+        now = time.monotonic()
+        self.agents = {}
+        for aid, d in dict(snap.get("agents", {})).items():
+            try:
+                state = AgentState(str(d.get("state", "idle")))
+            except ValueError:
+                state = AgentState.IDLE
+            self.agents[str(aid)] = AgentView(
+                agent_id=str(aid),
+                host=str(d.get("host", "")),
+                slots=int(d.get("slots", 1)),
+                state=state,
+                generation=int(d.get("generation", -1)),
+                step=int(d.get("step", 0)),
+                last_heartbeat=now,
+                preempting=bool(d.get("preempting", False)),
+                prepared=str(d.get("prepared", "")),
+                resumed=True,
+            )
+        prep = snap.get("prepare")
+        self.prepare = None
+        if prep and all(m in self.agents for m in prep.get("members", [])):
+            remaining = prep.get("remaining_s")
+            self.prepare = PrepareState(
+                generation=int(prep["generation"]),
+                members=tuple(str(m) for m in prep["members"]),
+                coordinator=str(prep["coordinator"]),
+                deadline=(
+                    float("inf") if remaining is None
+                    else self._clock() + float(remaining)
+                ),
+                window_s=float(prep.get("window_s", 0.0)),
+                armed_at=self._clock(),
+            )
+        # Treat the restored generation as freshly formed: the min-uptime
+        # preflight gate restarts, which only delays the next preflight —
+        # never correctness.
+        self._formed_at = self._clock()
+        self._reconcile_until = now + max(0.0, grace_s)
+        if self.members:
+            log.info(
+                "restored membership journal: generation %d, %d members, "
+                "phase %s, epoch %d (%.0fs reconciliation grace)",
+                self.generation, len(self.members), self.phase.value,
+                self.directive_epoch, grace_s,
+            )
+        return bool(self.members)
+
     # ------------------------------------------------------------------ status
     def status(self) -> Dict:
         return {
@@ -571,6 +813,8 @@ class Rendezvous:
             "generation": self.generation,
             "members": list(self.members),
             "desired_workers": self.desired_workers,
+            "directive_epoch": self.directive_epoch,
+            "reconciling": self.reconciling,
             "prepare": (
                 {
                     "generation": self.prepare.generation,

@@ -12,6 +12,7 @@ Usage::
 
     python scripts/chaos_run.py                       # every scenario
     python scripts/chaos_run.py --scenario worker_kill
+    python scripts/chaos_run.py --scenario master_crash   # failover drill
     python scripts/chaos_run.py --scenario rpc_burst --seed 99
     python scripts/chaos_run.py --list
 

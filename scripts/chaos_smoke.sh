@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
-# Chaos smoke: the fastest deterministic drill (worker SIGKILL + invariant
-# check) as a single command — the pre-merge sanity gate for changes that
+# Chaos smoke: the two fastest deterministic drills as a single command —
+# worker SIGKILL (data-plane recovery) and master crash/failover
+# (control-plane recovery) — the pre-merge sanity gate for changes that
 # touch the elastic/recovery path. The full catalog (heartbeat loss, RPC
-# burst, PS-shard crash, checkpoint corruption) runs via
+# burst, PS-shard crash, checkpoint corruption, mid-drain failover) runs via
 #   python scripts/chaos_run.py
 # and as `pytest -m chaos` (the slow-marked e2e tests).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
-    --scenario worker_kill "$@"
+    --scenario worker_kill --scenario master_crash "$@"

@@ -36,6 +36,34 @@ def test_chaos_worker_kill_scenario(tmp_path):
     assert verdict["final_status"]["generation"] >= 2
 
 
+@pytest.mark.chaos  # no `slow`: the fast FAILOVER drill also rides tier-1
+def test_chaos_master_crash_scenario(tmp_path):
+    """Control-plane failover: the master dies at steady state and a fresh
+    one restores the membership journal over the same workdir. Zero
+    reshapes after the failover, training progress recorded INSIDE the
+    outage window, generation monotonic, job reaches its target step."""
+    verdict = _run("master_crash", tmp_path)
+    assert verdict["faults_injected"].get("master_crash", 0) >= 1
+    checks = verdict["invariants"]["checks"]
+    assert checks["no_spurious_reshape_after_failover"]["ok"]
+    assert checks["training_progress_during_outage"]["ok"]
+    assert verdict["outages"] and "t_up" in verdict["outages"][0]
+    # the failover really went through the journal-restore path
+    assert checks["no_spurious_reshape_after_failover"]["failovers"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_master_restart_mid_drain_scenario(tmp_path):
+    """Master crash DURING a notice-driven drain: the restarted master
+    resumes the in-flight drain from the journal (or adopts its completed
+    result) — at most one reshape after the failover, never two."""
+    verdict = _run("master_restart_mid_drain", tmp_path)
+    assert verdict["faults_injected"].get("master_crash", 0) >= 1
+    assert verdict["faults_injected"].get("preempt_notice", 0) >= 1
+    assert verdict["final_status"]["generation"] >= 2
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_chaos_heartbeat_loss_scenario(tmp_path):

@@ -1,0 +1,402 @@
+"""Control-plane fault tolerance: the membership journal, the restart
+reconciliation grace period, outage-tolerant agents, and the failover
+invariants.
+
+Three layers:
+- pure :class:`Rendezvous` snapshot/restore units (replayable, no IO);
+- :class:`Master` journal round-trips over a real workdir + gRPC, including
+  the zero-reshape failover an agent's surviving worker must ride out;
+- the two chaos invariants (``no_spurious_reshape_after_failover``,
+  ``training_progress_during_outage``) over synthetic artifacts.
+"""
+
+import itertools
+import json
+import os
+import sys
+import time
+
+from easydl_tpu.chaos import invariants
+from easydl_tpu.elastic.agent import Agent
+from easydl_tpu.elastic.master import MASTER_SERVICE, Master
+from easydl_tpu.elastic.membership import AgentState, JobPhase, Rendezvous
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.rpc import RpcClient
+
+ports = itertools.count(9700)
+
+SLEEP_WORKER = [sys.executable, "-c", "import time; time.sleep(120)"]
+
+
+def mk(desired=2, **kw):
+    kw.setdefault("min_workers", 1)
+    return Rendezvous(desired_workers=desired, port_alloc=lambda: next(ports),
+                      prepare_timeout_s=0.0, prepare_min_uptime_s=0.0, **kw)
+
+
+def start_gen(rdv, agents):
+    for a in agents:
+        rdv.register(a, host="localhost", slots=2)
+    for a in agents:
+        d = rdv.directive_for(a)
+        if d.kind == "run":
+            rdv.heartbeat(a, d.generation, "running")
+    return rdv.generation
+
+
+def _wait(cond, timeout=30.0, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+# --------------------------------------------------- Rendezvous journal units
+
+
+def test_snapshot_restore_same_fleet_same_generation():
+    """The zero-reshape contract: a restore over a healthy fleet adopts the
+    current generation as-is — same members, same coordinator, same epoch —
+    and re-presenting members draw NOOP, not RUN."""
+    rdv = mk(desired=2, min_workers=2)
+    gen = start_gen(rdv, ["a0", "a1"])
+    snap = rdv.snapshot()
+
+    rdv2 = mk(desired=2, min_workers=2)
+    assert rdv2.restore(snap, grace_s=10.0)  # carried members -> failover
+    assert rdv2.generation == gen
+    assert rdv2.members == rdv.members
+    assert rdv2._coordinator == rdv._coordinator
+    assert rdv2.phase == JobPhase.STABLE
+    assert rdv2.directive_epoch == rdv.directive_epoch
+    assert rdv2.reconciling
+    epoch = rdv2.directive_epoch
+    # both members re-present their live state: no directive churn
+    for a in ("a0", "a1"):
+        assert rdv2.agents[a].resumed
+        d = rdv2.heartbeat(a, gen, "running")
+        assert d.kind == "noop", (a, d)
+        assert not rdv2.agents[a].resumed
+    rdv2.tick()
+    assert rdv2.generation == gen and rdv2.phase == JobPhase.STABLE
+    assert rdv2.directive_epoch == epoch  # nothing transitioned
+
+
+def test_snapshot_restore_preserves_armed_prepare():
+    rdv = Rendezvous(desired_workers=2, min_workers=2,
+                     port_alloc=lambda: next(ports),
+                     prepare_timeout_s=60.0, prepare_min_uptime_s=0.0)
+    gen = start_gen(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)
+    rdv.set_desired_workers(3)
+    assert rdv.phase == JobPhase.PREPARING and rdv.prepare is not None
+    snap = rdv.snapshot()
+
+    rdv2 = Rendezvous(desired_workers=2, min_workers=2,
+                      port_alloc=lambda: next(ports),
+                      prepare_timeout_s=60.0, prepare_min_uptime_s=0.0)
+    rdv2.restore(snap, grace_s=10.0)
+    assert rdv2.phase == JobPhase.PREPARING
+    assert rdv2.prepare is not None
+    assert rdv2.prepare.coordinator == rdv.prepare.coordinator
+    assert rdv2.prepare.members == rdv.prepare.members
+    assert rdv2.prepare.generation == gen + 1
+
+
+def test_restore_missing_agent_evicted_only_after_grace():
+    """A journaled member that never re-presents is exempt from eviction
+    while the grace period is open; once it closes, the ordinary heartbeat
+    timeout evicts it and the fleet reshapes around the hole."""
+    rdv = mk(desired=2, min_workers=2, heartbeat_timeout=5.0)
+    gen = start_gen(rdv, ["a0", "a1"])
+    snap = rdv.snapshot()
+
+    rdv2 = mk(desired=2, min_workers=1, heartbeat_timeout=5.0)
+    rdv2.restore(snap, grace_s=60.0)
+    rdv2.heartbeat("a0", gen, "running")  # a0 re-presents; a1 never does
+    # a1 silent WAY past the heartbeat timeout — but inside the grace window
+    rdv2.agents["a1"].last_heartbeat -= 100.0
+    rdv2.tick()
+    assert rdv2.agents["a1"].state != AgentState.LOST
+    assert rdv2.generation == gen and rdv2.phase == JobPhase.STABLE
+    # grace closes: the missing member is evicted, survivors reshape
+    rdv2._reconcile_until = time.monotonic() - 1.0
+    rdv2.tick()
+    assert rdv2.agents["a1"].state == AgentState.LOST
+    assert rdv2.directive_for("a0").kind == "kill"  # unplanned escalation
+    rdv2.heartbeat("a0", gen, "idle")
+    assert rdv2.generation == gen + 1 and rdv2.members == ["a0"]
+
+
+def test_stale_generation_represent_rejected():
+    """An evicted agent re-presenting a STALE generation to the restarted
+    master is admitted as a standby only — its zombie worker is ordered
+    killed, and membership/generation are untouched."""
+    rdv = mk(desired=1)
+    gen = start_gen(rdv, ["a0"])
+    rdv.heartbeat("a0", gen, "idle")       # worker crash -> reshape
+    assert rdv.generation == gen + 1
+    rdv.heartbeat("a0", rdv.generation, "running")
+    snap = rdv.snapshot()
+
+    rdv2 = mk(desired=1)
+    rdv2.restore(snap, grace_s=10.0)
+    cur = rdv2.generation
+    # ghost presents the OLD generation, still running its stale worker
+    rdv2.adopt("ghost", "h9", 2, gen, "running")
+    assert rdv2.members == ["a0"]          # not adopted as a member
+    assert rdv2.generation == cur          # no reshape
+    assert rdv2.directive_for("ghost").kind == "kill"
+
+
+def test_adopt_takes_presented_state_at_face_value():
+    """adopt() must NOT reset a surviving agent to IDLE: that read as a
+    worker crash and forced a spurious reshape (the reason re-registration
+    after a master restart rides Heartbeat, not Register)."""
+    rdv = mk(desired=1)
+    gen = start_gen(rdv, ["a0"])
+    snap = rdv.snapshot()
+    rdv2 = mk(desired=1)
+    rdv2.restore(snap, grace_s=10.0)
+    rdv2.adopt("a0", "localhost", 2, gen, "running")
+    assert rdv2.agents["a0"].state == AgentState.RUNNING
+    assert rdv2.generation == gen and rdv2.phase == JobPhase.STABLE
+
+
+# ------------------------------------------------------ Master journal + gRPC
+
+
+def test_master_failover_zero_reshape_worker_survives(tmp_path):
+    """The tentpole end-to-end at unit scale: master dies and a fresh one
+    restores the journal over the same workdir; the agent's worker must
+    survive untouched — same pid, same generation, zero reshapes — and the
+    WAL must record the failover."""
+    wd = str(tmp_path)
+    mfile = os.path.join(wd, "master.json")
+    m1 = Master(job_name="fo", workdir=wd, desired_workers=1).start()
+    with open(mfile, "w") as f:
+        json.dump({"address": m1.address}, f)
+    agent = Agent("a0", m1.address, wd, slots=1, master_file=mfile,
+                  master_refresh_s=0.5, heartbeat_interval=0.1,
+                  worker_argv=SLEEP_WORKER)
+    agent.start()
+    try:
+        _wait(lambda: m1.rendezvous.agents.get("a0") is not None
+              and m1.rendezvous.agents["a0"].state == AgentState.RUNNING,
+              desc="a0 running under m1")
+        gen1 = m1.rendezvous.generation
+        epoch1 = m1.rendezvous.directive_epoch
+        pid1 = agent.worker_pid
+        assert pid1 is not None
+        m1.stop()  # control-plane crash (no graceful anything)
+
+        m2 = Master(job_name="fo", workdir=wd, desired_workers=1,
+                    reconcile_grace_s=10.0).start()
+        try:
+            with open(mfile + ".tmp", "w") as f:
+                json.dump({"address": m2.address}, f)
+            os.replace(mfile + ".tmp", mfile)
+            # journal restored BEFORE any agent re-presented
+            assert m2.rendezvous.generation == gen1
+            assert m2.rendezvous.members == ["a0"]
+            assert m2.rendezvous.directive_epoch == epoch1
+            assert any(e.get("kind") == "failover" for e in m2.events)
+            _wait(lambda: m2.rendezvous.agents.get("a0") is not None
+                  and not m2.rendezvous.agents["a0"].resumed,
+                  desc="a0 re-presenting to m2")
+            time.sleep(0.5)  # a few more heartbeats: any reshape would land
+            assert m2.rendezvous.generation == gen1, "failover reshaped!"
+            assert m2.rendezvous.members == ["a0"]
+            assert agent.worker_pid == pid1, "worker did not survive failover"
+        finally:
+            m2.stop()
+    finally:
+        agent.stop()
+        agent.join()
+
+
+def test_agent_outage_never_kills_healthy_worker(tmp_path):
+    """Outage tolerance: with the master gone (and never coming back), the
+    agent keeps its worker training in the current generation, backing off
+    heartbeats — it must not kill, respawn, or abandon it."""
+    wd = str(tmp_path)
+    m = Master(job_name="outage", workdir=wd, desired_workers=1).start()
+    agent = Agent("a0", m.address, wd, slots=1, heartbeat_interval=0.1,
+                  worker_argv=SLEEP_WORKER)
+    agent.start()
+    try:
+        _wait(lambda: agent.worker_pid is not None, desc="worker spawned")
+        pid = agent.worker_pid
+        m.stop()  # master gone for good
+        time.sleep(2.5)  # ~25 heartbeat intervals of failures + backoff
+        assert agent.worker_pid == pid
+        assert agent._state == "running"
+    finally:
+        agent.stop()
+        agent.join()
+        m.stop()
+
+
+def test_heartbeat_buffering_replays_after_outage(tmp_path):
+    """Step metrics observed during the outage are buffered (deduped by
+    step) and replayed to the recovered master."""
+    agent = Agent("a0", "127.0.0.1:1", str(tmp_path))
+    agent._buffer_outage_metrics({})                       # no record: skip
+    agent._buffer_outage_metrics({"step": 3, "step_time_s": 0.1, "loss": 1.0})
+    agent._buffer_outage_metrics({"step": 3, "step_time_s": 0.1, "loss": 1.0})
+    agent._buffer_outage_metrics({"step": 4, "step_time_s": 0.1, "loss": 0.9})
+    assert [int(r["step"]) for r in agent._outage_buf] == [3, 4]
+
+    sent = []
+
+    class FakeClient:
+        def Heartbeat(self, req):
+            sent.append(int(req.step))
+            return pb.Directive(kind=pb.DirectiveKind.NOOP)
+
+    agent._client = FakeClient()
+    d = agent._flush_outage_buffer()
+    assert sent == [3, 4]
+    assert d is not None and d.kind == pb.DirectiveKind.NOOP
+    assert not agent._outage_buf
+    assert agent._flush_outage_buffer() is None  # empty: nothing to replay
+
+
+def test_master_heartbeat_adopts_presented_state(tmp_path):
+    """gRPC-level: an unknown agent presenting (generation, state) via
+    Heartbeat is adopted at face value — RUNNING, not reset to IDLE."""
+    master = Master(job_name="adopt2", workdir=str(tmp_path),
+                    desired_workers=1).start()
+    try:
+        client = RpcClient(MASTER_SERVICE, master.address)
+        client.wait_ready()
+        client.Heartbeat(pb.HeartbeatRequest(
+            agent_id="s0", generation=3, state="running", host="h1", slots=2,
+        ))
+        view = master.rendezvous.agents["s0"]
+        assert view.state == AgentState.RUNNING
+        assert view.generation == 3
+        client.close()
+    finally:
+        master.stop()
+
+
+# ------------------------------------------------- unformable preflight (RUN)
+
+
+def test_dead_preflight_run_reports_unformable(tmp_path):
+    """ADVICE r5 medium: a RUN adopting the coordinator of OUR dead
+    preflight must not cold-spawn into the half-formed group — the agent
+    reports the generation unformable (idle at the RUN's generation) so the
+    master re-forms with a fresh coordinator."""
+    a = Agent("a0", "127.0.0.1:1", str(tmp_path))
+    a._preflight_failed_sig = (2, "h0:7001")
+    run = pb.Directive(kind=pb.DirectiveKind.RUN)
+    run.membership.generation = 2
+    run.membership.world_size = 1
+    run.membership.hosts.append("a0")
+    run.membership.coordinator = "h0:7001"
+    a._apply(run)
+    assert a._proc is None                 # nothing spawned
+    assert a._state == "idle"              # the failure heartbeat payload
+    assert a._applied_key == (2, "h0:7001")  # never retried against this RUN
+    # a re-formed generation with a FRESH coordinator spawns normally
+    a.worker_argv = SLEEP_WORKER
+    run2 = pb.Directive(kind=pb.DirectiveKind.RUN)
+    run2.membership.generation = 3
+    run2.membership.world_size = 1
+    run2.membership.hosts.append("a0")
+    run2.membership.coordinator = "h0:7002"
+    try:
+        a._apply(run2)
+        assert a._proc is not None and a._proc.poll() is None
+        assert a._state == "running"
+    finally:
+        a._terminate_worker(graceful=False)
+        if a._log_file is not None:
+            a._log_file.close()
+
+
+# --------------------------------------------------------- invariant checkers
+
+
+def _write_events(wd, events):
+    with open(os.path.join(wd, "events.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def _write_metrics(wd, records):
+    with open(os.path.join(wd, "metrics-a0.jsonl"), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_invariant_no_spurious_reshape_after_failover(tmp_path):
+    wd = str(tmp_path)
+    _write_events(wd, [
+        {"t": 1.0, "kind": "phase", "phase": "stable", "generation": 1},
+        {"t": 2.0, "kind": "failover", "generation": 1},
+    ])
+    v = invariants.check_scenario(
+        wd, {"max_reshapes_after_failover": 0}, status={"generation": 1})
+    assert v["checks"]["no_spurious_reshape_after_failover"]["ok"]
+    # a reshape AFTER the failover violates the zero-reshape contract
+    v = invariants.check_scenario(
+        wd, {"max_reshapes_after_failover": 0}, status={"generation": 2})
+    c = v["checks"]["no_spurious_reshape_after_failover"]
+    assert not c["ok"] and c["reshapes_after_failover"] == 1
+    # a drill that PROMISED a failover but never recorded one must fail
+    _write_events(wd, [
+        {"t": 1.0, "kind": "phase", "phase": "stable", "generation": 1},
+    ])
+    v = invariants.check_scenario(
+        wd, {"max_reshapes_after_failover": 0}, status={"generation": 1})
+    assert not v["checks"]["no_spurious_reshape_after_failover"]["ok"]
+
+
+def test_invariant_training_progress_during_outage(tmp_path):
+    wd = str(tmp_path)
+    _write_metrics(wd, [
+        {"step": s, "generation": 1, "t": 100.0 + s * 0.01,
+         "step_time_s": 0.01, "world_size": 1, "loss": 1.0,
+         "samples_per_sec": 10.0}
+        for s in range(1, 200)
+    ])
+    _write_events(wd, [])
+    ok = invariants.check_scenario(
+        wd, {"min_steps_during_outage": 5},
+        outages=[{"t_down": 100.5, "t_up": 101.0}])
+    assert ok["checks"]["training_progress_during_outage"]["ok"]
+    # an open-ended outage window (master never came back) still counts
+    ok = invariants.check_scenario(
+        wd, {"min_steps_during_outage": 5}, outages=[{"t_down": 100.5}])
+    assert ok["checks"]["training_progress_during_outage"]["ok"]
+    # no training inside the window -> violated
+    bad = invariants.check_scenario(
+        wd, {"min_steps_during_outage": 5},
+        outages=[{"t_down": 300.0, "t_up": 301.0}])
+    assert not bad["checks"]["training_progress_during_outage"]["ok"]
+    # no outage recorded at all -> the drill cannot pass vacuously
+    none = invariants.check_scenario(wd, {"min_steps_during_outage": 5},
+                                     outages=[])
+    assert not none["checks"]["training_progress_during_outage"]["ok"]
+
+
+def test_invariant_outage_progress_is_per_agent_not_step_spread(tmp_path):
+    """Two STALLED workers at different steps must not read as progress:
+    the invariant judges max−min per agent, not across the pooled fleet."""
+    wd = str(tmp_path)
+    for agent, step in (("a0", 100), ("a1", 250)):
+        with open(os.path.join(wd, f"metrics-{agent}.jsonl"), "w") as f:
+            f.write(json.dumps({"step": step, "generation": 1, "t": 100.5,
+                                "step_time_s": 0.01, "world_size": 1,
+                                "loss": 1.0, "samples_per_sec": 10.0}) + "\n")
+    _write_events(wd, [])
+    v = invariants.check_scenario(
+        wd, {"min_steps_during_outage": 5},
+        outages=[{"t_down": 100.0, "t_up": 101.0}])
+    c = v["checks"]["training_progress_during_outage"]
+    assert not c["ok"], c  # 250-100 spread is NOT 150 steps of progress

@@ -282,6 +282,68 @@ def test_standing_preflight_adopted_on_unplanned_loss():
     assert rdv.prepare.coordinator != prep.coordinator
 
 
+def test_standing_preflight_rearms_after_grace_when_never_ready():
+    """ADVICE r5 low #4: a standing prepare whose preflight workers crashed
+    (agents latch the failed signature and stop reporting ready) must be
+    dropped past the grace period and re-armed with a FRESH coordinator —
+    not left silently degrading every subsequent switch to cold."""
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=60.0, prepare_min_uptime_s=0.0,
+                     standing_preflight=True, standing_preflight_grace_s=30.0,
+                     min_workers=2, clock=lambda: clock["t"])
+    gen = start_gen(rdv, ["a0", "a1"])
+    rdv.tick()
+    prep = rdv.prepare
+    assert prep is not None and prep.deadline == float("inf")
+    # nobody ever reports ready (preflights crashed); inside the grace the
+    # armed prepare is kept
+    clock["t"] = 10.0
+    rdv.heartbeat("a0", gen, "running")
+    rdv.heartbeat("a1", gen, "running")
+    rdv.tick()
+    assert rdv.prepare is not None
+    assert rdv.prepare.coordinator == prep.coordinator
+    # past the grace: dropped and re-armed with a fresh coordinator (a new
+    # signature un-latches the agents' failed-preflight memory)
+    clock["t"] = 31.0
+    rdv.tick()
+    assert rdv.prepare is not None
+    assert rdv.prepare.coordinator != prep.coordinator
+    assert rdv.prepare.generation == gen + 1
+    assert rdv.generation == gen  # no reshape happened, only a re-arm
+
+
+def test_standing_preflight_all_ready_is_kept_past_grace():
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=60.0, prepare_min_uptime_s=0.0,
+                     standing_preflight=True, standing_preflight_grace_s=30.0,
+                     min_workers=2, clock=lambda: clock["t"])
+    gen = start_gen(rdv, ["a0", "a1"])
+    rdv.tick()
+    prep = rdv.prepare
+    assert prep is not None
+    # everyone reports ready inside the grace; each observed all-ready
+    # refreshes the grace clock, so a READY standing prepare is kept
+    # indefinitely
+    clock["t"] = 10.0
+    rdv.heartbeat("a0", gen, "running", prepared=prep.coordinator)
+    rdv.heartbeat("a1", gen, "running", prepared=prep.coordinator)
+    for t in (40.0, 80.0, 120.0):
+        clock["t"] = t
+        rdv.tick()
+        assert rdv.prepare is not None
+        assert rdv.prepare.coordinator == prep.coordinator
+    # readiness LOST (preflights crash): re-armed grace seconds later
+    rdv.agents["a0"].prepared = ""
+    rdv.agents["a1"].prepared = ""
+    clock["t"] = 160.0
+    rdv.tick()
+    assert rdv.prepare is not None
+    assert rdv.prepare.coordinator != prep.coordinator  # fresh re-arm
+
+
 def test_standing_preflight_not_adopted_without_all_ready():
     rdv = mk(desired=2, prepare=60.0, standing=True, min_workers=2)
     gen = start_stable(rdv, ["a0", "a1"])
