@@ -303,6 +303,12 @@ class Brain:
 
     # ------------------------------------------------------------------ server
     def start(self, port: int = 0, obs_workdir: Optional[str] = None) -> "Brain":
+        from easydl_tpu.obs import tracing
+
+        # Span sink next to the obs publication; the master's
+        # brain_plan_poll spans inject their context, so GetPlan handler
+        # spans recorded here join the master's trace.
+        tracing.configure("brain", obs_workdir or self._state_dir)
         self._server = serve(BRAIN_SERVICE, self, port=port)
         self._exporter = start_exporter(
             "brain", workdir=obs_workdir or self._state_dir,

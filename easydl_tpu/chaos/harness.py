@@ -117,6 +117,15 @@ class ChaosHarness:
         # (each respawn pays a clean test-scale compile, ~1s).
         cache_before = os.environ.get("EASYDL_COMPILE_CACHE")
         os.environ["EASYDL_COMPILE_CACHE"] = "off"
+        # Arm tracing for the drill (worker/PS subprocesses inherit the
+        # env): the verdict's workdir then carries a complete span record —
+        # scripts/trace_export.py folds it, the timelines, and the master
+        # WAL into one Perfetto trace with the injected faults stamped as
+        # instants. Default-off everywhere else.
+        from easydl_tpu.obs import tracing
+
+        trace_before = os.environ.get(tracing.TRACE_ENV)
+        os.environ[tracing.TRACE_ENV] = "1"
         t_start = time.monotonic()
         status: Dict[str, Any] = {}
         # The registry counter is process-cumulative; without a baseline a
@@ -151,6 +160,10 @@ class ChaosHarness:
                 os.environ.pop("EASYDL_COMPILE_CACHE", None)
             else:
                 os.environ["EASYDL_COMPILE_CACHE"] = cache_before
+            if trace_before is None:
+                os.environ.pop(tracing.TRACE_ENV, None)
+            else:
+                os.environ[tracing.TRACE_ENV] = trace_before
         fault_counts = {
             kind: count - counts_before.get(kind, 0.0)
             for kind, count in injectors.injected_fault_counts().items()

@@ -151,7 +151,11 @@ _fault_counter = None
 
 
 def count_fault(kind: str) -> None:
-    """Increment ``easydl_chaos_faults_injected_total{kind=...}``."""
+    """Increment ``easydl_chaos_faults_injected_total{kind=...}`` — and
+    stamp the fault as an instant event in this process' trace (every
+    fault path, harness-driven or inline, funnels through here), so a
+    drill's Perfetto export shows each injection against the spans it
+    overlapped."""
     global _fault_counter
     with _metrics_lock:
         if _fault_counter is None:
@@ -163,6 +167,12 @@ def count_fault(kind: str) -> None:
                 ("kind",),
             )
     _fault_counter.inc(kind=kind)
+    try:
+        from easydl_tpu.obs import tracing
+
+        tracing.instant(f"fault:{kind}", kind=kind)
+    except Exception:
+        pass
 
 
 FAULT_COUNTER_NAME = "easydl_chaos_faults_injected_total"

@@ -20,7 +20,7 @@ import time
 from typing import Any, Deque, Dict, List, Optional
 
 from easydl_tpu.chaos import banner as chaos_banner
-from easydl_tpu.obs import get_registry, start_exporter
+from easydl_tpu.obs import get_registry, start_exporter, tracing
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.retry import backoff_delay, retry_transient
@@ -164,6 +164,11 @@ class Agent:
             "buffered during the current/last master outage.", ("agent",))
         self._hb_times: Deque[float] = collections.deque(maxlen=20)
         self._tl_last: Optional[tuple] = None  # (phase, monotonic t)
+        # The master's open generation-switch context (from directive-reply
+        # trailing metadata): parents this agent's switch-leg spans and is
+        # handed to spawned workers via EASYDL_TRACE_CONTEXT so worker
+        # spans share the master's trace_id. None outside a switch.
+        self._switch_ctx = None
         # Step metrics observed while the master is unreachable: buffered
         # (bounded — the deque keeps the NEWEST 64 distinct-step records,
         # older history rolls off) and replayed in full, oldest first, on
@@ -182,6 +187,12 @@ class Agent:
     _PHASE_LEGS = {
         ("quiesce_sent", "worker_exit"),  # drain: signal → clean exit
         ("worker_exit", "spawn"),         # re-rendezvous → next spawn
+    }
+
+    #: trace-span names for the measured legs (same pairs as _PHASE_LEGS).
+    _LEG_SPAN_NAMES = {
+        ("quiesce_sent", "worker_exit"): "agent:drain",
+        ("worker_exit", "spawn"): "agent:rerendezvous",
     }
 
     # ------------------------------------------------------------------ control
@@ -324,15 +335,36 @@ class Agent:
             return
         phase = str(rec.get("phase", ""))
         now = time.monotonic()
-        if (self._tl_last is not None
-                and (self._tl_last[0], phase) in self._PHASE_LEGS):
+        leg = (self._tl_last is not None
+               and (self._tl_last[0], phase) in self._PHASE_LEGS)
+        if leg:
             self._m_phase_seconds.set(now - self._tl_last[1],
                                       agent=self.agent_id, phase=phase)
+        # Same boundary, third view: the trace. Measured legs become spans
+        # under the master's switch context (retroactive — the duration is
+        # already known), every other boundary an instant marker, so the
+        # JSONL decomposition, the gauges, and the trace can never drift.
+        try:
+            t_wall = float(rec.get("t", time.time()))
+            if leg:
+                tracing.record_span(
+                    self._LEG_SPAN_NAMES.get(
+                        (self._tl_last[0], phase), phase),
+                    t_wall - (now - self._tl_last[1]), t_wall,
+                    parent=self._switch_ctx, agent=self.agent_id,
+                    gen=rec.get("gen"))
+            else:
+                tracing.instant(f"timeline:{phase}",
+                                parent=self._switch_ctx, t=t_wall,
+                                agent=self.agent_id, gen=rec.get("gen"))
+        except Exception:
+            pass
         self._tl_last = (phase, now)
         self._m_phase_total.inc(agent=self.agent_id, phase=phase)
 
     def run(self) -> None:
         chaos_banner(f"agent-{self.agent_id}")
+        tracing.configure(f"agent-{self.agent_id}", self.workdir)
         self._client = RpcClient(MASTER_SERVICE, self.master_address, timeout=10.0)
         self._client.wait_ready(30.0)
         self._exporter = start_exporter(
@@ -600,6 +632,14 @@ class Agent:
 
     def _apply(self, directive: pb.Directive) -> None:
         kind = directive.kind
+        # Collect the switch context the directive's reply carried (set
+        # thread-locally by the traced client call that produced
+        # `directive` — same thread, no RPC in between). Absent while no
+        # switch is in flight; the last seen context is kept so the RUN
+        # that ends a switch still parents its spawn.
+        ctx = tracing.take_reply_context()
+        if ctx is not None:
+            self._switch_ctx = ctx
         self._maybe_preflight(directive)
         if kind == pb.DirectiveKind.RUN:
             m = directive.membership
@@ -639,6 +679,7 @@ class Agent:
             env.setdefault("OMP_NUM_THREADS", "1")
             env.setdefault("OPENBLAS_NUM_THREADS", "1")
         env["EASYDL_TIMELINE"] = self.timeline_path
+        env[tracing.PROC_ENV] = f"worker-{self.agent_id}"
         return env
 
     def _maybe_preflight(self, directive: pb.Directive) -> None:
@@ -678,17 +719,20 @@ class Agent:
             self.workdir,
             f".go-{self.agent_id}-{prep.generation}-{self._preflight_count}.json",
         )
+        preflight_env = {
+            "EASYDL_RANK": str(rank),
+            "EASYDL_WORLD": str(prep.world_size),
+            "EASYDL_COORD": prep.coordinator,
+            "EASYDL_GEN": str(prep.generation),
+            "EASYDL_WORKDIR": self.workdir,
+            "EASYDL_METRICS": self.metrics_path,
+            "EASYDL_GO_FILE": go_file,
+        }
+        trace_ctx = tracing.inject(self._switch_ctx)
+        if trace_ctx:
+            preflight_env[tracing.CTX_ENV] = trace_ctx
         proc, log_file = self._spawn_gated_worker(
-            {
-                "EASYDL_RANK": str(rank),
-                "EASYDL_WORLD": str(prep.world_size),
-                "EASYDL_COORD": prep.coordinator,
-                "EASYDL_GEN": str(prep.generation),
-                "EASYDL_WORKDIR": self.workdir,
-                "EASYDL_METRICS": self.metrics_path,
-                "EASYDL_GO_FILE": go_file,
-            },
-            gate_file=go_file,
+            preflight_env, gate_file=go_file,
         )
         self._preflight = (proc, go_file, sig, log_file)
         log.info("%s: preflight spawned for gen %d rank %d/%d (pid %d)",
@@ -790,6 +834,13 @@ class Agent:
             "EASYDL_METRICS": self.metrics_path,
             "EASYDL_TIMELINE": self.timeline_path,
         }
+        # Subprocess-env hop of trace propagation: the worker of this
+        # generation roots its spans under the master's switch context. In
+        # the payload (not just the base env) so a warm-standby promotion —
+        # which learns its membership through the warm file — gets it too.
+        trace_ctx = tracing.inject(self._switch_ctx)
+        if trace_ctx:
+            payload[tracing.CTX_ENV] = trace_ctx
         run_sig = (m.generation, m.coordinator)
         preflight_hit = False
         dead_preflight = False

@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from easydl_tpu.api.resource_plan import ResourcePlan
 from easydl_tpu.chaos import banner as chaos_banner
 from easydl_tpu.elastic.membership import Directive, JobPhase, Rendezvous
-from easydl_tpu.obs import get_registry, start_exporter
+from easydl_tpu.obs import get_registry, start_exporter, tracing
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import RpcClient, ServiceDef, serve
@@ -59,10 +59,15 @@ class _Servicer:
             d = self._m.rendezvous.register(
                 req.agent_id, req.host, req.slots, bool(req.preemption_notice)
             )
+            # Open the switch span (if one is now in flight) BEFORE
+            # counting, so the first directive transition of an RPC-path
+            # switch lands on it as an event.
+            sw = self._m._trace_switch_span()
             self._m._count_directive(req.agent_id, d.kind)
             # The journal must carry the new agent (and any cohort change)
             # before the directive leaves the master.
             self._m._persist_if_epoch_advanced()
+            tracing.attach_reply_context(ctx, sw)
             return self._m._to_proto(d)
 
     def Heartbeat(self, req: pb.HeartbeatRequest, ctx) -> pb.Directive:
@@ -101,8 +106,17 @@ class _Servicer:
             )
             if req.metrics.step_time_s > 0:
                 self._m._record_metrics(req.agent_id, req.metrics)
+            # While a generation switch is in flight, every directive reply
+            # carries the switch span's context as trailing metadata — the
+            # agent adopts it as the parent of its switch legs and hands it
+            # to the worker it spawns (EASYDL_TRACE_CONTEXT), so the whole
+            # cross-process tree shares the master's trace_id. Opened (if
+            # newly in flight) before counting, so the first directive
+            # transition lands on the span as an event.
+            sw = self._m._trace_switch_span()
             self._m._count_directive(req.agent_id, d.kind)
             self._m._persist_if_epoch_advanced()
+            tracing.attach_reply_context(ctx, sw)
             return self._m._to_proto(d)
 
 
@@ -130,6 +144,15 @@ class Master:
         self.job_name = job_name
         self.workdir = workdir
         os.makedirs(workdir, exist_ok=True)
+        # Span sink for this process (no-op unless EASYDL_TRACE is set):
+        # the master is the root of every generation-switch trace, so its
+        # spans-master.jsonl anchors scripts/trace_export.py's merge.
+        tracing.configure("master", workdir)
+        #: the open generation-switch span (one tree per switch: opened
+        #: when the rendezvous leaves STABLE — or at boot — and closed once
+        #: every member runs the new generation). Guarded by self._lock.
+        self._switch_span = None
+        self._switch_phase_span = None
         # Control-loop state survives trainer-pod replacement: the operator
         # will happily replace the trainer pod (resource_updation / failure),
         # and a fresh master must resume the plan loop, not reset it.
@@ -391,6 +414,7 @@ class Master:
                 self.rendezvous.tick()
                 phase = self.rendezvous.phase
                 if phase != last_phase:
+                    self._trace_phase(phase)
                     self._event("phase", phase=phase.value,
                                 generation=self.rendezvous.generation)
                     now = time.monotonic()
@@ -413,7 +437,86 @@ class Master:
                 # path didn't cover (evictions from tick, prepared reports,
                 # host changes) lands on disk within one tick.
                 self._persist_if_stale()
+                self._trace_maybe_close_switch(phase)
             self._stop.wait(0.2)
+
+    # ---------------------------------------------------------------- tracing
+    def _members_all_running(self) -> bool:
+        rdv = self.rendezvous
+        return bool(rdv.members) and all(
+            (a := rdv.agents.get(m)) is not None
+            and a.state == "running" and a.generation == rdv.generation
+            for m in rdv.members
+        )
+
+    def _trace_switch_span(self):
+        """The open generation-switch root span, lazily opened while a
+        switch is in flight (lock held). A whole switch can complete ON the
+        RPC path between two ticks (a register triggers instant formation),
+        so the reply path must be able to open the span too — the RUN that
+        ends such a switch still has a context to carry. In-flight means:
+        any non-STABLE phase, or STABLE with members not yet all running
+        the current generation (the directive-delivery window)."""
+        if self._switch_span is not None or not tracing.enabled():
+            return self._switch_span
+        try:
+            phase = self.rendezvous.phase
+            if phase == JobPhase.DONE:
+                return None
+            if phase == JobPhase.STABLE and self._members_all_running():
+                return None  # steady state: no switch to trace
+            # Detached: this span can be opened on a gRPC handler thread
+            # and is closed by the tick loop — it must never sit on any
+            # thread's current-span stack (see tracing.start_span).
+            span = tracing.start_span(
+                "generation_switch", detached=True, job=self.job_name,
+                from_generation=self.rendezvous.generation)
+            self._switch_span = span if span else None
+        except Exception:
+            pass
+        return self._switch_span
+
+    def _trace_phase(self, phase: JobPhase) -> None:
+        """Child span per rendezvous phase under the switch root (called
+        with the lock held, on tick-observed phase transitions). Best-effort
+        by construction: every tracing call is a no-op when disabled."""
+        try:
+            if self._switch_phase_span is not None:
+                self._switch_phase_span.end()
+                self._switch_phase_span = None
+            if phase in (JobPhase.STABLE, JobPhase.DONE):
+                if self._switch_span is not None \
+                        and phase == JobPhase.STABLE:
+                    self._switch_span.add_event(
+                        "formed", generation=self.rendezvous.generation,
+                        members=list(self.rendezvous.members))
+                if phase == JobPhase.DONE and self._switch_span is not None:
+                    self._switch_span.end(outcome="done")
+                    self._switch_span = None
+                return
+            root = self._trace_switch_span()
+            if root is None:
+                return
+            self._switch_phase_span = tracing.start_span(
+                f"phase:{phase.value}", parent=root,
+                generation=self.rendezvous.generation)
+        except Exception:
+            pass
+
+    def _trace_maybe_close_switch(self, phase: JobPhase) -> None:
+        """Close the switch tree once the new generation is live: every
+        member reports RUNNING at the current generation (the first moment
+        the switch is truly over from the fleet's point of view)."""
+        if self._switch_span is None or phase != JobPhase.STABLE:
+            return
+        try:
+            if self._members_all_running():
+                rdv = self.rendezvous
+                self._switch_span.end(generation=rdv.generation,
+                                      members=list(rdv.members))
+                self._switch_span = None
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------ plans
     def apply_plan(self, plan: ResourcePlan) -> None:
@@ -449,9 +552,16 @@ class Master:
                     client.close()
                     client = RpcClient(BRAIN_SERVICE, self.brain_address)
                     built_for = self.brain_address
-                resp = client.GetPlan(
-                    pb.PlanRequest(job_name=self.job_name, current_version=self.plan_version)
-                )
+                # One span per Brain poll: the client call injects its
+                # context, so the Brain's server-side handler span joins
+                # this trace (no-op when tracing is off).
+                with tracing.start_span("brain_plan_poll",
+                                        job=self.job_name,
+                                        version=self.plan_version):
+                    resp = client.GetPlan(
+                        pb.PlanRequest(job_name=self.job_name,
+                                       current_version=self.plan_version)
+                    )
                 if resp.has_plan:
                     from easydl_tpu.brain.convert import plan_from_proto
 
@@ -597,6 +707,12 @@ class Master:
         if self._last_directive_kind.get(agent_id) != kind:
             self._last_directive_kind[agent_id] = kind
             self._m_directives.inc(job=self.job_name, kind=kind)
+            if self._switch_span is not None:
+                # The ladder of the switch (QUIESCE → KILL → RUN per agent)
+                # as events on its span — same transition dedupe as the
+                # counter, so one held QUIESCE is one event.
+                self._switch_span.add_event(f"directive:{kind}",
+                                            agent=agent_id)
 
     def _to_proto(self, d: Directive) -> pb.Directive:
         out = pb.Directive(kind=_KIND_TO_PROTO[d.kind])

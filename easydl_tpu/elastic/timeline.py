@@ -32,6 +32,28 @@ _listeners: List[Callable[[str, Dict[str, Any]], None]] = []
 _listeners_lock = threading.Lock()
 
 
+_listener_errors = None  # lazy: keep the obs import off worker start
+
+
+def _count_listener_error() -> None:
+    """A raising listener is swallowed (the emit contract) but must not be
+    INVISIBLE: a broken timeline→metrics bridge silently loses the whole
+    phase decomposition. Best-effort — counting can never raise either."""
+    global _listener_errors
+    try:
+        if _listener_errors is None:
+            from easydl_tpu.obs import get_registry
+
+            _listener_errors = get_registry().counter(
+                "easydl_timeline_listener_errors_total",
+                "Timeline listener callbacks that raised (exception "
+                "swallowed; the phase bridge is degraded).",
+            )
+        _listener_errors.inc()
+    except Exception:
+        pass
+
+
 def add_listener(fn: Callable[[str, Dict[str, Any]], None]) -> None:
     with _listeners_lock:
         _listeners.append(fn)
@@ -57,7 +79,10 @@ def emit(path: str | None, phase: str, generation: int, **data: Any) -> None:
         try:
             fn(path, rec)
         except Exception:
-            pass  # same contract as the file write: never raises
+            # Same contract as the file write: never raises — but counted,
+            # so a broken bridge shows in /metrics instead of silently
+            # losing phase→gauge data.
+            _count_listener_error()
     try:
         with open(path, "a") as f:
             f.write(json.dumps(rec) + "\n")

@@ -105,11 +105,29 @@ def run_worker(env: Dict[str, str]) -> int:
     tl_path = env.get("EASYDL_TIMELINE")
 
     from easydl_tpu.elastic import timeline
+    from easydl_tpu.obs import tracing
 
     # Phase boundaries for the recovery decomposition (timeline.py): for a
     # warm-promoted standby this "start" is the promote instant, so the
     # imports phase collapses to ~0 — exactly the saving warm start buys.
     timeline.emit(tl_path, "worker_main_start", generation, rank=rank)
+
+    # Trace root for this worker's whole life, parented on the master's
+    # generation-switch context when the agent passed one
+    # (EASYDL_TRACE_CONTEXT) — the subprocess-env hop of propagation. All
+    # no-ops unless EASYDL_TRACE is armed. Left open on crash/kill paths
+    # on purpose: an unfinished worker_run in the flight recorder IS the
+    # evidence (obs_scrape --spans shows it).
+    tracing.configure(
+        env.get(tracing.PROC_ENV) or f"worker-r{rank}", workdir)
+    root_span = tracing.start_span(
+        "worker_run", parent=tracing.from_env(env),
+        generation=generation, rank=rank, world=world)
+    try:
+        trace_step_every = max(
+            1, int(env.get("EASYDL_TRACE_STEP_EVERY", "25") or 25))
+    except ValueError:  # a typo'd knob must not take the worker down
+        trace_step_every = 25
 
     with open(os.path.join(workdir, "job.json")) as f:
         cfg: Dict[str, Any] = json.load(f)
@@ -140,11 +158,14 @@ def run_worker(env: Dict[str, str]) -> int:
             pass
     timeline.emit(tl_path, "jax_imported", generation, rank=rank)
     if world > 1:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=world,
-            process_id=rank,
-        )
+        with tracing.start_span("dist_init", parent=root_span,
+                                coordinator=coordinator, world=world,
+                                rank=rank):
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world,
+                process_id=rank,
+            )
     timeline.emit(tl_path, "dist_init_done", generation, rank=rank)
     from jax.experimental import multihost_utils
 
@@ -286,6 +307,7 @@ def run_worker(env: Dict[str, str]) -> int:
                 or go.get("coordinator") != coordinator):
             log.info("gen %d: preflight aborted (formed %s@%s)", generation,
                      go.get("generation"), go.get("coordinator"))
+            root_span.end(outcome="preflight_abort")
             return 3
         timeline.emit(tl_path, "preflight_go", generation, rank=rank)
 
@@ -347,10 +369,13 @@ def run_worker(env: Dict[str, str]) -> int:
                       step=s)
         return trainer.restore_from(ckpt, s)
 
+    restore_span = tracing.start_span("restore", parent=root_span,
+                                      rank=rank)
     state, latest = restore_with_fallback(
         ckpt, _restore,
         agree_int=_agree_int, all_ok=_all_ok, quarantine=_quarantine,
     )
+    restore_span.end(step=latest)
     if latest < 0:  # fresh init: keep the boundary (step -1, as before)
         timeline.emit(tl_path, "restore_agreed", generation, rank=rank,
                       step=-1)
@@ -482,6 +507,7 @@ def run_worker(env: Dict[str, str]) -> int:
         if os.getppid() != parent_pid:
             log.warning("gen %d: agent (parent) died; worker exiting at "
                         "step %d", generation, step)
+            root_span.end(outcome="orphaned", step=step)
             return 4
         if maybe_straggle is not None:
             # Chaos hook point: artificial straggler sleep at the step
@@ -524,6 +550,7 @@ def run_worker(env: Dict[str, str]) -> int:
             ckpt.save(step, state, metadata=_data_meta())  # no-op if already committed
             ckpt.wait()  # commit must land before this process exits
             timeline.emit(tl_path, "quiesce_exit", generation, step=step)
+            root_span.end(outcome="quiesced", step=step)
             return 0
 
         t0 = time.perf_counter()
@@ -535,6 +562,13 @@ def run_worker(env: Dict[str, str]) -> int:
         ema_dt = dt if ema_dt == 0.0 else 0.8 * ema_dt + 0.2 * dt
         step += 1
         append_metrics(step, loss, dt)
+        if step % trace_step_every == 0:
+            # Sampled per-step span, written retroactively from the timing
+            # the loop already took — tracing adds no step-path work.
+            t_end = time.time()
+            tracing.record_span("step", t_end - dt, t_end,
+                                parent=root_span, step=step,
+                                loss=round(loss, 5))
         if not first_step_emitted:
             # restored -> here = jit compile (or cache hit) + one step.
             timeline.emit(tl_path, "first_step_done", generation,
@@ -568,6 +602,7 @@ def run_worker(env: Dict[str, str]) -> int:
         with open(os.path.join(workdir, "DONE"), "w") as f:
             f.write(str(total_steps))
     log.info("gen %d: job complete at step %d", generation, total_steps)
+    root_span.end(outcome="done", step=total_steps)
     return 0
 
 

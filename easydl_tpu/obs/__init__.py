@@ -7,8 +7,13 @@ The cross-cutting observability subsystem every service records into:
 - :mod:`easydl_tpu.obs.exporter` — stdlib ``/metrics`` + ``/healthz`` HTTP
   exporter thread, address published into the job workdir for discovery;
 - :mod:`easydl_tpu.obs.scrape` — fetch/parse/merge for
-  ``scripts/obs_scrape.py`` and programmatic consumers.
+  ``scripts/obs_scrape.py`` and programmatic consumers;
+- :mod:`easydl_tpu.obs.tracing` — distributed spans with cross-process
+  context propagation and the per-process flight-recorder sink
+  (``scripts/trace_export.py`` merges them into a Perfetto trace).
 """
+
+from easydl_tpu.obs import tracing  # noqa: F401
 
 from easydl_tpu.obs.exporter import (  # noqa: F401
     MetricsExporter,

@@ -91,7 +91,7 @@ class MetricsExporter:
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever,
-            name=f"metrics-exporter-{component}",
+            name=f"obs-metrics-{self.port}",
             daemon=True,
         )
         self._thread.start()
@@ -108,10 +108,48 @@ class MetricsExporter:
         host = os.environ.get("EASYDL_METRICS_HOST", "").strip() or "localhost"
         return f"{host}:{self.port}"
 
+    @staticmethod
+    def _sweep_stale(d: str) -> None:
+        """Drop discovery files whose publishing process is gone.
+
+        A SIGKILLed service never retracts its publication, so a reused
+        workdir accumulates addresses of dead exporters and every
+        ``obs_scrape`` pays a timeout per ghost. Only single-host
+        publications (advertised as ``localhost``) are swept — a pid check
+        is meaningless for another host's process."""
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(d, name)
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                addr = str(doc.get("address", ""))
+                pid = int(doc.get("pid", 0))
+                if not addr.startswith("localhost:") or pid <= 0:
+                    continue
+                if pid == os.getpid():
+                    continue
+                os.kill(pid, 0)  # raises ProcessLookupError when dead
+            except ProcessLookupError:
+                try:
+                    os.remove(path)
+                    log.info("removed stale obs publication %s (pid dead)",
+                             name)
+                except OSError:
+                    pass
+            except (OSError, ValueError, PermissionError):
+                continue  # torn file, or alive-but-not-ours: leave it
+
     def _publish(self, workdir: str) -> None:
         try:
             d = os.path.join(workdir, OBS_DIR)
             os.makedirs(d, exist_ok=True)
+            self._sweep_stale(d)
             path = os.path.join(d, f"{self.component}.json")
             tmp = path + ".tmp"
             with open(tmp, "w") as f:

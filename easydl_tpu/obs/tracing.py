@@ -1,0 +1,567 @@
+"""Dependency-free distributed tracing: spans, context propagation, and a
+per-process flight-recorder sink.
+
+The metrics layer (PR 1) answers *how much* — p99 drain is 4 s — but not
+*why this one*: which agent's quiesce, which PS pull retry, which dist-init
+wait ate a particular reshape. This module is the span layer every process
+records into:
+
+- **Spans** carry ``trace_id``/``span_id``/``parent_id``, a name, wall-clock
+  start/end, attributes, and events. Contexts propagate W3C-traceparent
+  style (``00-<32hex trace>-<16hex span>-01``): through gRPC metadata
+  (``easydl-trace``, injected/extracted in :mod:`easydl_tpu.utils.rpc`) and
+  into worker subprocesses via the ``EASYDL_TRACE_CONTEXT`` environment
+  variable (agent → ``trainer_main``/worker).
+- **Sink**: one JSONL file per process, ``<workdir>/obs/spans-<proc>.jsonl``,
+  size-bounded with one rotation (``.1``) so it acts as an always-on flight
+  recorder — the newest ~2×``EASYDL_TRACE_MAX_BYTES`` of spans survive any
+  crash for autopsy. ``scripts/trace_export.py`` merges every process' file
+  (plus timelines and the master WAL) into one Perfetto-loadable trace.
+
+Contract (same as :func:`easydl_tpu.elastic.timeline.emit`): **emission
+never raises into the caller**, and with ``EASYDL_TRACE`` unset every hook
+is one env-dict lookup — no files are created, no gRPC metadata is added.
+Sampling is therefore default-off; drills and debugging sessions arm it
+with ``EASYDL_TRACE=1``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+#: master switch for the whole layer (default off).
+TRACE_ENV = "EASYDL_TRACE"
+#: traceparent handed to worker subprocesses by the agent.
+CTX_ENV = "EASYDL_TRACE_CONTEXT"
+#: process name override for the span sink of a spawned worker.
+PROC_ENV = "EASYDL_TRACE_PROC"
+#: gRPC metadata key carrying the traceparent (both directions: client
+#: request metadata, and the master's directive replies as trailing
+#: metadata).
+METADATA_KEY = "easydl-trace"
+#: rotate the sink past this size (one ``.1`` generation is kept).
+MAX_BYTES_ENV = "EASYDL_TRACE_MAX_BYTES"
+_DEFAULT_MAX_BYTES = 8 << 20
+
+_HEX = set("0123456789abcdef")
+
+
+def enabled() -> bool:
+    """One env lookup; the gate every hook point checks first."""
+    v = os.environ.get(TRACE_ENV, "")
+    return v not in ("", "0", "off", "false", "no", "disabled", "none")
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str   # 16 lowercase hex chars
+
+
+def _new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def _new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def inject(ctx: "SpanContext | Span | None" = None) -> Optional[str]:
+    """Serialize a context (default: the current span's) as a traceparent
+    string, or None when tracing is disabled / there is nothing to carry."""
+    if not enabled():
+        return None
+    if isinstance(ctx, (Span, _NullSpan)):
+        ctx = ctx.context
+    if ctx is None:
+        ctx = current_context()
+    if ctx is None:
+        return None
+    return f"00-{ctx.trace_id}-{ctx.span_id}-01"
+
+
+def extract(header: Optional[str]) -> Optional[SpanContext]:
+    """Parse a traceparent; malformed/absent input → None, NEVER raises
+    (a bad peer must cost a broken link, not a broken RPC)."""
+    try:
+        if not header or not isinstance(header, str):
+            return None
+        parts = header.strip().split("-")
+        if len(parts) < 3:
+            return None
+        trace_id, span_id = parts[1].lower(), parts[2].lower()
+        if len(trace_id) != 32 or not set(trace_id) <= _HEX:
+            return None
+        if len(span_id) != 16 or not set(span_id) <= _HEX:
+            return None
+        if trace_id == "0" * 32 or span_id == "0" * 16:
+            return None
+        return SpanContext(trace_id, span_id)
+    except Exception:
+        return None
+
+
+def from_env(environ: Optional[Dict[str, str]] = None) -> Optional[SpanContext]:
+    """The subprocess half of propagation: the agent's EASYDL_TRACE_CONTEXT."""
+    env = environ if environ is not None else os.environ
+    return extract(env.get(CTX_ENV, ""))
+
+
+# ------------------------------------------------------------------- sink
+_lock = threading.RLock()
+_state: Dict[str, Any] = {"proc": None, "path": None, "dir": None, "fd": None}
+_tls = threading.local()
+
+
+def configure(proc: str, workdir: Optional[str]) -> None:
+    """Point this process' span sink at ``<workdir>/obs/spans-<proc>.jsonl``.
+
+    Creates NO files (the sink opens lazily on the first enabled emit).
+    Within one job workdir the first service to configure names the process
+    (an in-process master + agent share one sink); configuring with a NEW
+    workdir switches sinks — the chaos runner executes scenarios over fresh
+    workdirs sequentially in one process."""
+    if not workdir:
+        return
+    try:
+        from easydl_tpu.obs.exporter import OBS_DIR
+
+        d = os.path.join(workdir, OBS_DIR)
+        with _lock:
+            if _state["dir"] == d:
+                return
+            if _state["fd"] is not None:
+                try:
+                    _state["fd"].close()
+                except OSError:
+                    pass
+            safe = "".join(c if (c.isalnum() or c in "-._") else "_"
+                           for c in proc) or "proc"
+            _state.update(proc=safe, dir=d,
+                          path=os.path.join(d, f"spans-{safe}.jsonl"),
+                          fd=None)
+    except Exception:
+        pass
+
+
+def sink_path() -> Optional[str]:
+    return _state["path"]
+
+
+def _max_bytes() -> int:
+    try:
+        return int(os.environ.get(MAX_BYTES_ENV, "") or _DEFAULT_MAX_BYTES)
+    except ValueError:
+        return _DEFAULT_MAX_BYTES
+
+
+def _write(rec: Dict[str, Any]) -> None:
+    """Append one record; bounded + rotating; never raises."""
+    try:
+        path = _state["path"]
+        if path is None or not enabled():
+            return
+        line = json.dumps(rec) + "\n"
+        with _lock:
+            fd = _state["fd"]
+            if fd is None:
+                os.makedirs(_state["dir"], exist_ok=True)
+                fd = _state["fd"] = open(path, "a")
+            fd.write(line)
+            fd.flush()
+            if fd.tell() > _max_bytes():
+                # Flight-recorder rotation: current → .1 (dropping the
+                # previous .1) — the newest window always survives.
+                fd.close()
+                _state["fd"] = None
+                os.replace(path, path + ".1")
+    except Exception:
+        with _lock:
+            _state["fd"] = None  # reopen on the next emit
+
+
+# ------------------------------------------------------------------- spans
+def _stack() -> List["Span"]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_span() -> Optional["Span"]:
+    st = _stack()
+    return st[-1] if st else None
+
+
+def current_context() -> Optional[SpanContext]:
+    s = current_span()
+    return s.context if s is not None else None
+
+
+@dataclass
+class Span:
+    """One in-flight span; ``end()`` (or the ``with`` block) writes it."""
+
+    name: str
+    context: SpanContext
+    parent_id: Optional[str] = None
+    t0: float = field(default_factory=time.time)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    events: List[Dict[str, Any]] = field(default_factory=list)
+    _tid: int = 0
+    _ended: bool = False
+
+    def set_attr(self, key: str, value: Any) -> "Span":
+        try:
+            self.attrs[key] = value
+        except Exception:
+            pass
+        return self
+
+    def add_event(self, name: str, **attrs: Any) -> "Span":
+        try:
+            ev: Dict[str, Any] = {"t": time.time(), "name": str(name)}
+            if attrs:
+                ev["attrs"] = attrs
+            self.events.append(ev)
+        except Exception:
+            pass
+        return self
+
+    def end(self, **attrs: Any) -> None:
+        try:
+            if self._ended:
+                return
+            self._ended = True
+            if attrs:
+                self.attrs.update(attrs)
+            st = _stack()
+            if self in st:
+                st.remove(self)
+            rec: Dict[str, Any] = {
+                "ph": "X",
+                "name": self.name,
+                "trace": self.context.trace_id,
+                "span": self.context.span_id,
+                "t": self.t0,
+                "dur": max(time.time() - self.t0, 0.0),
+                "pid": os.getpid(),
+                "tid": self._tid,
+            }
+            if self.parent_id:
+                rec["parent"] = self.parent_id
+            if self.attrs:
+                rec["attrs"] = self.attrs
+            if self.events:
+                rec["events"] = self.events
+            _write(rec)
+        except Exception:
+            pass
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.add_event("error", error=repr(exc))
+        self.end()
+
+    def __bool__(self) -> bool:
+        return True
+
+
+class _NullSpan:
+    """No-op stand-in returned while tracing is disabled, so call sites
+    never branch."""
+
+    context = None
+    attrs: Dict[str, Any] = {}
+    events: List[Dict[str, Any]] = []
+
+    def set_attr(self, key: str, value: Any) -> "_NullSpan":
+        return self
+
+    def add_event(self, name: str, **attrs: Any) -> "_NullSpan":
+        return self
+
+    def end(self, **attrs: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+    def __bool__(self) -> bool:
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+def _tid() -> int:
+    try:
+        return threading.get_native_id()
+    except Exception:
+        return 0
+
+
+def start_span(name: str,
+               parent: "SpanContext | Span | None" = None,
+               detached: bool = False,
+               **attrs: Any):
+    """Open a span (child of ``parent``, else of the thread's current span,
+    else a new root) and make it the thread's current span. Writes a ``B``
+    (open) record immediately so an unfinished span — a hang, a crash — is
+    visible to ``obs_scrape --spans`` and survives in the flight recorder.
+
+    ``detached=True`` skips the thread-local current-span stack: REQUIRED
+    for spans that outlive the opening call and may be ended on a DIFFERENT
+    thread (the master's generation-switch span can be opened on a gRPC
+    handler thread and closed by the tick loop) — ``end()`` pops only the
+    ending thread's stack, so an attached cross-thread span would pin the
+    opener thread's "current span" to a dead span forever."""
+    if not enabled():
+        return NULL_SPAN
+    try:
+        if isinstance(parent, (Span, _NullSpan)):
+            parent = parent.context
+        if parent is None:
+            parent = current_context()
+        if parent is None:
+            ctx = SpanContext(_new_trace_id(), _new_span_id())
+            parent_id = None
+        else:
+            ctx = SpanContext(parent.trace_id, _new_span_id())
+            parent_id = parent.span_id
+        span = Span(name=str(name), context=ctx, parent_id=parent_id,
+                    attrs=dict(attrs), _tid=_tid())
+        if not detached:
+            _stack().append(span)
+        rec: Dict[str, Any] = {
+            "ph": "B",
+            "name": span.name,
+            "trace": ctx.trace_id,
+            "span": ctx.span_id,
+            "t": span.t0,
+            "pid": os.getpid(),
+            "tid": span._tid,
+        }
+        if parent_id:
+            rec["parent"] = parent_id
+        if attrs:
+            rec["attrs"] = dict(attrs)
+        _write(rec)
+        return span
+    except Exception:
+        return NULL_SPAN
+
+
+def record_span(name: str, t0: float, t1: float,
+                parent: "SpanContext | Span | None" = None,
+                **attrs: Any) -> Optional[SpanContext]:
+    """Write a completed span retroactively (no open record): zero-overhead
+    tracing for work that is already timed — a training step, a measured
+    switch leg."""
+    if not enabled():
+        return None
+    try:
+        if isinstance(parent, (Span, _NullSpan)):
+            parent = parent.context
+        if parent is None:
+            parent = current_context()
+        ctx = SpanContext(
+            parent.trace_id if parent else _new_trace_id(), _new_span_id())
+        rec: Dict[str, Any] = {
+            "ph": "X",
+            "name": str(name),
+            "trace": ctx.trace_id,
+            "span": ctx.span_id,
+            "t": float(t0),
+            "dur": max(float(t1) - float(t0), 0.0),
+            "pid": os.getpid(),
+            "tid": _tid(),
+        }
+        if parent:
+            rec["parent"] = parent.span_id
+        if attrs:
+            rec["attrs"] = attrs
+        _write(rec)
+        return ctx
+    except Exception:
+        return None
+
+
+def instant(name: str, parent: "SpanContext | Span | None" = None,
+            t: Optional[float] = None, **attrs: Any) -> None:
+    """A zero-duration marker (chaos faults, timeline boundaries)."""
+    if not enabled():
+        return
+    try:
+        if isinstance(parent, (Span, _NullSpan)):
+            parent = parent.context
+        if parent is None:
+            parent = current_context()
+        rec: Dict[str, Any] = {
+            "ph": "i",
+            "name": str(name),
+            "trace": parent.trace_id if parent else _new_trace_id(),
+            "span": _new_span_id(),
+            "t": float(t) if t is not None else time.time(),
+            "pid": os.getpid(),
+            "tid": _tid(),
+        }
+        if parent:
+            rec["parent"] = parent.span_id
+        if attrs:
+            rec["attrs"] = attrs
+        _write(rec)
+    except Exception:
+        pass
+
+
+def add_event(name: str, **attrs: Any) -> None:
+    """Attach an event to the thread's current span; no-op without one.
+    utils/retry.py stamps each retry attempt through this, so a PS pull
+    that rode three UNAVAILABLEs shows them inside its span."""
+    s = current_span()
+    if s is not None:
+        s.add_event(name, **attrs)
+
+
+# --------------------------------------------------------------- gRPC glue
+def start_rpc_server_span(service: str, method: str, grpc_context):
+    """Open the per-handler server span: child of the caller's injected
+    context when present, a fresh root otherwise (absent/malformed metadata
+    must never fail the RPC)."""
+    if not enabled():
+        return NULL_SPAN
+    parent = None
+    try:
+        md = grpc_context.invocation_metadata() if grpc_context is not None \
+            else None
+        for key, value in md or ():
+            if key == METADATA_KEY:
+                parent = extract(value)
+                break
+    except Exception:
+        parent = None
+    return start_span(f"rpc:{service}/{method}", parent=parent,
+                      service=service, method=method)
+
+
+def attach_reply_context(grpc_context,
+                         ctx: "SpanContext | Span | None") -> None:
+    """Server side of the reply direction: piggyback a context (the
+    master's open generation-switch span) on the response's trailing
+    metadata. Directives are RESPONSES to agent-initiated RPCs, so this is
+    the only gRPC channel the master has back to its agents."""
+    if ctx is None or not enabled():
+        return
+    try:
+        header = inject(ctx)
+        if header and grpc_context is not None \
+                and hasattr(grpc_context, "set_trailing_metadata"):
+            grpc_context.set_trailing_metadata(((METADATA_KEY, header),))
+    except Exception:
+        pass
+
+
+def note_reply_metadata(metadata) -> None:
+    """Client side: stash the reply's traceparent (or None) for the caller
+    to collect via :func:`take_reply_context`. Thread-local — the agent's
+    run loop issues the RPC and collects the context on the same thread."""
+    header = None
+    try:
+        for key, value in metadata or ():
+            if key == METADATA_KEY:
+                header = value
+                break
+    except Exception:
+        header = None
+    _tls.reply = header
+
+
+def take_reply_context() -> Optional[SpanContext]:
+    """The context the last traced RPC's reply carried (cleared on read)."""
+    header = getattr(_tls, "reply", None)
+    _tls.reply = None
+    return extract(header)
+
+
+# ----------------------------------------------------------- file reading
+def span_files(workdir: str) -> List[str]:
+    """Every process' span sink under ``<workdir>/obs/`` (rotated ``.1``
+    generations included, oldest first per process)."""
+    from easydl_tpu.obs.exporter import OBS_DIR
+
+    out: List[str] = []
+    d = os.path.join(workdir, OBS_DIR)
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("spans-") and name.endswith(".jsonl.1"):
+            out.append(os.path.join(d, name))
+    for name in names:
+        if name.startswith("spans-") and name.endswith(".jsonl"):
+            out.append(os.path.join(d, name))
+    return out
+
+
+def read_records(path: str) -> List[Dict[str, Any]]:
+    """One file's records, torn tail lines skipped; each record is tagged
+    with its source process (``proc``, from the filename)."""
+    base = os.path.basename(path)
+    proc = base[len("spans-"):].split(".jsonl")[0]
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    rec["proc"] = proc
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def read_all(workdir: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for path in span_files(workdir):
+        out.extend(read_records(path))
+    return out
+
+
+def open_spans(workdir: str) -> List[Dict[str, Any]]:
+    """Spans with an open (``B``) record and no matching end — what every
+    process is doing *right now* (or was doing when it died): the
+    poor-man's hung-drill debugger behind ``obs_scrape --spans``."""
+    opens: Dict[str, Dict[str, Any]] = {}
+    for rec in read_all(workdir):
+        sid = str(rec.get("span", ""))
+        if rec.get("ph") == "B":
+            opens[sid] = rec
+        elif rec.get("ph") == "X":
+            opens.pop(sid, None)
+    now = time.time()
+    out = []
+    for rec in opens.values():
+        rec = dict(rec)
+        rec["age_s"] = round(now - float(rec.get("t", now)), 3)
+        out.append(rec)
+    return sorted(out, key=lambda r: (str(r.get("proc")), -r["age_s"]))

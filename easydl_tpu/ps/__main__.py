@@ -281,6 +281,9 @@ def main() -> None:
             index, rescued, claim_path = resolve_fresh_shard(
                 args.workdir, args.name, num_shards
             )
+    from easydl_tpu.obs import tracing
+
+    tracing.configure(f"ps-{index}", args.workdir)
     shard = PsShard(shard_index=index, num_shards=num_shards)
     server = shard.serve(port=args.port, obs_workdir=args.workdir)
     log.info("ps pod %s serving shard %d/%d on %s",

@@ -31,6 +31,7 @@ from jax.experimental import io_callback
 
 import time
 
+from easydl_tpu.obs import tracing
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.ps.server import DRAINING, PS_SERVICE, PsShard, spec_to_proto
 from easydl_tpu.ps.table import TableSpec, shard_of
@@ -257,12 +258,17 @@ class ShardedPsClient(_PsClientBase):
         # "closed channel" and spin on for the whole budget — a corrupt
         # reply must surface immediately, as before.
         req = pb.PullRequest(table=table, ids=ids.tolist())
-        resp = retry_transient(
-            lambda: self._clients[s].Pull(req),
-            max_elapsed_s=self.transient_retry_s,
-            on_retry=lambda e: self._maybe_reroute_from_registry(s),
-            describe=f"ps shard {s} pull",
-        )
+        # Span per shard pull; utils/retry.py stamps every transient retry
+        # as an event inside it, so a slow pull names its retries. No-op
+        # with tracing disabled.
+        with tracing.start_span("ps_pull", shard=s, table=table,
+                                ids=int(ids.size)):
+            resp = retry_transient(
+                lambda: self._clients[s].Pull(req),
+                max_elapsed_s=self.transient_retry_s,
+                on_retry=lambda e: self._maybe_reroute_from_registry(s),
+                describe=f"ps shard {s} pull",
+            )
         return np.frombuffer(resp.values, np.float32).reshape(
             len(ids), resp.dim)
 
@@ -273,6 +279,16 @@ class ShardedPsClient(_PsClientBase):
             table=table, ids=ids.tolist(), grads=grads.tobytes(), scale=scale
         )
         deadline = time.monotonic() + self.drain_retry_s
+        # Span per shard push; the drain/transport retry loop below stamps
+        # each wait as an event inside it (tracing disabled: all no-ops).
+        span = tracing.start_span("ps_push", shard=s, table=table,
+                                  ids=int(ids.size))
+        try:
+            self._push_with_retries(s, req, deadline, span)
+        finally:
+            span.end()
+
+    def _push_with_retries(self, s, req, deadline, span):
         transport_fails = 0
         while True:
             try:
@@ -294,6 +310,8 @@ class ShardedPsClient(_PsClientBase):
                         f"ps shard {s} unreachable past "
                         f"{self.drain_retry_s}s: {e}"
                     ) from e
+                span.add_event("retry", error=repr(e),
+                               attempt=transport_fails + 1)
                 self._maybe_reroute_from_registry(s)
                 # Exponential backoff + jitter (vs the old fixed 50ms):
                 # every worker thread of the fleet hits this loop together
@@ -313,6 +331,7 @@ class ShardedPsClient(_PsClientBase):
                     f"ps shard {s} stayed draining past "
                     f"{self.drain_retry_s}s; no reroute arrived"
                 )
+            span.add_event("draining")
             self._maybe_reroute_from_registry(s)
             time.sleep(0.05)
 

@@ -88,6 +88,17 @@ def retry_transient(
         except BaseException as e:
             if not is_transient(e) or time.monotonic() >= deadline:
                 raise
+            # Trace hook: when the caller runs under a span (PS pull/push,
+            # agent register), each attempt lands as an event inside it —
+            # the trace then shows WHICH retries ate a slow pull. No-op
+            # without an active span or with tracing disabled.
+            try:
+                from easydl_tpu.obs import tracing
+
+                tracing.add_event("retry", attempt=attempt + 1,
+                                  what=describe, error=repr(e))
+            except Exception:
+                pass
             if on_retry is not None:
                 try:
                     on_retry(e)

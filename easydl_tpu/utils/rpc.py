@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import grpc
 
 from easydl_tpu.obs import get_registry
+from easydl_tpu.obs import tracing
 
 
 @dataclass(frozen=True)
@@ -82,6 +83,16 @@ def _instrument(fn: Callable, side: str, service: str,
 
     def wrapped(*args, **kwargs):
         t0 = time.perf_counter()
+        # Tracing hook (obs/tracing.py): a span per SERVER handler call,
+        # child of the caller's injected `easydl-trace` metadata when
+        # present, a fresh root otherwise — absent/malformed metadata can
+        # never fail the RPC. Disabled (the default) this is one env
+        # lookup; client-side spans live in RpcClient.invoke, where the
+        # metadata is built.
+        span = (tracing.start_rpc_server_span(service, method,
+                                              args[1] if len(args) > 1
+                                              else None)
+                if side == "server" else tracing.NULL_SPAN)
         try:
             # Chaos hook point (docs/design/chaos.md): with EASYDL_CHAOS_SPEC
             # unset this is ONE env-dict lookup — no import, no call. Armed,
@@ -108,10 +119,12 @@ def _instrument(fn: Callable, side: str, service: str,
                                       e.details())
                     raise
             return fn(*args, **kwargs)
-        except Exception:
+        except Exception as e:
             errors.inc(service=service, method=method)
+            span.add_event("error", error=repr(e))
             raise
         finally:
+            span.end()
             requests.inc(service=service, method=method)
             latency.observe(
                 time.perf_counter() - t0, service=service, method=method
@@ -200,10 +213,38 @@ class RpcClient:
         call = self._call(method)
         timeout = self._timeout
 
-        def invoke(request, timeout_s: Optional[float] = None):
-            return call(request, timeout=timeout_s or timeout)
+        service = self._service.name
 
-        return _instrument(invoke, "client", self._service.name, method)
+        def invoke(request, timeout_s: Optional[float] = None):
+            if not tracing.enabled():
+                return call(request, timeout=timeout_s or timeout)
+            # Traced path: inject the current context as `easydl-trace`
+            # request metadata (a client span is opened only when a parent
+            # span is active — steady-state heartbeat loops must not mint a
+            # root trace per beat), and collect the reply's trailing
+            # metadata: directives are responses, so the master's
+            # generation-switch context rides back to the agent here.
+            span = (tracing.start_span(f"rpc:{service}/{method}",
+                                       service=service, method=method)
+                    if tracing.current_span() is not None
+                    else tracing.NULL_SPAN)
+            try:
+                header = tracing.inject()
+                resp, grpc_call = call.with_call(
+                    request, timeout=timeout_s or timeout,
+                    metadata=((tracing.METADATA_KEY, header),)
+                    if header else None,
+                )
+                tracing.note_reply_metadata(grpc_call.trailing_metadata())
+                return resp
+            except Exception as e:
+                tracing.note_reply_metadata(None)
+                span.add_event("error", error=repr(e))
+                raise
+            finally:
+                span.end()
+
+        return _instrument(invoke, "client", service, method)
 
     def wait_ready(self, timeout: float = 10.0) -> None:
         grpc.channel_ready_future(self._channel).result(timeout=timeout)

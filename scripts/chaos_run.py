@@ -94,6 +94,7 @@ def main() -> None:
     if unknown:
         raise SystemExit(f"unknown scenario(s) {unknown}; "
                          f"known: {sorted(SCENARIOS)}")
+    os.makedirs(args.out_dir, exist_ok=True)
     rnd = args.round if args.round is not None else next_round(args.out_dir)
     failed = []
     for name in names:

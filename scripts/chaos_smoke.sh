@@ -6,7 +6,35 @@
 # burst, PS-shard crash, checkpoint corruption, mid-drain failover) runs via
 #   python scripts/chaos_run.py
 # and as `pytest -m chaos` (the slow-marked e2e tests).
+#
+# After the drills, each kept workdir is folded into a Perfetto trace by
+# scripts/trace_export.py; an empty or unparseable merged trace FAILS the
+# smoke — export rot is caught in-tree, next to the drills that feed it.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
-    --scenario worker_kill --scenario master_crash "$@"
+
+LOG=$(mktemp)
+trap 'rm -f "$LOG"' EXIT
+
+env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
+    --scenario worker_kill --scenario master_crash --keep-workdir "$@" \
+    2>&1 | tee "$LOG"
+
+# Verdict files from THIS run (chaos_run prints "PASS <name> ... -> <path>").
+VERDICTS=$(awk '/^(PASS|FAIL) .* -> .*\.json$/{print $NF}' "$LOG")
+test -n "$VERDICTS" || { echo "chaos_smoke: no verdicts found" >&2; exit 1; }
+
+for verdict in $VERDICTS; do
+    wd=$(python -c 'import json,sys; print(json.load(open(sys.argv[1]))["workdir"])' "$verdict")
+    python scripts/trace_export.py --workdir "$wd" --out "$wd/trace.json"
+    python - "$wd/trace.json" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+events = [e for e in doc.get("traceEvents", []) if e.get("ph") != "M"]
+assert events, f"{sys.argv[1]}: merged trace is EMPTY"
+spans = [e for e in events if e.get("ph") == "X"]
+assert spans, f"{sys.argv[1]}: merged trace has no spans"
+print(f"trace OK: {len(events)} events, {len(spans)} spans")
+PY
+    rm -rf "$wd"   # kept only for the export; drop after the check
+done

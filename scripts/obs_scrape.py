@@ -17,6 +17,11 @@ throughput. Additional (or non-workdir) endpoints via ``--target``::
 ``--json`` emits the full machine-readable document
 (``{"services": {...}, "merged": {series: value}}``); ``--grep`` filters the
 console view; ``--watch N`` re-scrapes every N seconds.
+
+``--spans`` switches to the tracing layer: it tails the span flight
+recorders (``<workdir>/obs/spans-*.jsonl``) and prints every OPEN
+(unfinished) span per process — what each process is doing right now, or
+was doing when it died. Combine with ``--watch``/``--json``.
 """
 
 from __future__ import annotations
@@ -42,6 +47,40 @@ def _parse_target(spec: str):
     return component.strip(), address.strip()
 
 
+def run_spans(args) -> int:
+    """``--spans``: print open (unfinished) spans per process — the
+    poor-man's "what is the job doing right now". An old open span on a
+    live process is a hang suspect; on a dead one, its last act."""
+    from easydl_tpu.obs import tracing
+
+    while True:
+        spans = tracing.open_spans(args.workdir)
+        if args.json:
+            print(json.dumps(spans, indent=2, sort_keys=True))
+        else:
+            if not spans:
+                print("no open spans (job idle, finished, or not traced — "
+                      "EASYDL_TRACE=1 arms span recording)")
+            proc = None
+            for rec in spans:
+                if rec.get("proc") != proc:
+                    proc = rec.get("proc")
+                    print(f"== {proc}")
+                attrs = rec.get("attrs") or {}
+                extra = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+                print(f"  {rec.get('name'):<32s} open {rec['age_s']:>8.1f}s"
+                      f"  trace={str(rec.get('trace'))[:16]}…"
+                      f"{('  ' + extra) if extra else ''}")
+        if not args.watch:
+            break
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            break
+        print()
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="merge every easydl service's /metrics into one snapshot"
@@ -52,6 +91,11 @@ def main() -> int:
     ap.add_argument("--target", action="append", default=[],
                     metavar="[NAME=]HOST:PORT",
                     help="extra endpoint to scrape (repeatable)")
+    ap.add_argument("--spans", action="store_true",
+                    help="instead of metrics, tail the span flight "
+                         "recorders under <workdir>/obs/ and print OPEN "
+                         "(unfinished) spans per process — what the job is "
+                         "doing right now (hung-drill debugging)")
     ap.add_argument("--json", action="store_true",
                     help="print the merged snapshot as JSON")
     ap.add_argument("--grep", default="",
@@ -62,6 +106,11 @@ def main() -> int:
     args = ap.parse_args()
     if not args.workdir and not args.target:
         ap.error("need --workdir and/or --target")
+    if args.spans:
+        if not args.workdir:
+            ap.error("--spans needs --workdir (span files live under "
+                     "<workdir>/obs/)")
+        return run_spans(args)
     targets = dict(_parse_target(t) for t in args.target)
 
     while True:

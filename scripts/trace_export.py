@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Merge a job's distributed-trace artifacts into one Perfetto trace.
+
+Inputs (all under the job workdir; every piece is optional):
+
+- ``obs/spans-<proc>.jsonl[.1]`` — the per-process span flight recorders
+  (easydl_tpu/obs/tracing.py): master generation-switch trees, per-RPC
+  server spans, agent switch legs, worker run/dist-init/restore/step spans,
+  PS push/pull spans, and chaos-fault instants;
+- ``timeline-<agent>.jsonl`` — the phase-boundary timelines
+  (easydl_tpu/elastic/timeline.py);
+- ``events.jsonl`` — the master's WAL (plan/phase/failover records).
+
+Output is Chrome trace-event JSON (``trace.json``), loadable in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing: one pid per process (named),
+spans as complete ("X") events on their real thread, faults/timeline/WAL
+records as instant ("i") markers. Span/trace ids ride in ``args`` so a
+worker span can be matched to the master switch tree that caused it.
+
+    python scripts/trace_export.py --workdir /tmp/job1 [--out trace.json]
+
+Exit status: 0 with a non-empty trace, 2 when the workdir held nothing to
+export (scripts/chaos_smoke.sh gates on this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from easydl_tpu.elastic import timeline  # noqa: E402
+from easydl_tpu.obs import tracing  # noqa: E402
+
+#: synthetic tids for sources that carry no thread of their own
+TIMELINE_TID = 990_001
+WAL_TID = 990_002
+
+
+def _us(t: float) -> int:
+    return int(float(t) * 1e6)
+
+
+class _Pids:
+    """Stable proc-name → synthetic pid mapping (+ process_name metadata)."""
+
+    def __init__(self) -> None:
+        self._pids: Dict[str, int] = {}
+        self.meta: List[Dict[str, Any]] = []
+
+    def pid(self, proc: str) -> int:
+        if proc not in self._pids:
+            self._pids[proc] = len(self._pids) + 1
+            self.meta.append({
+                "ph": "M", "name": "process_name", "pid": self._pids[proc],
+                "tid": 0, "args": {"name": proc},
+            })
+        return self._pids[proc]
+
+    def known(self, proc: str) -> bool:
+        return proc in self._pids
+
+
+def export_spans(records: List[Dict[str, Any]], pids: _Pids,
+                 out: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Span/instant records → trace events. Returns counters for the
+    summary. Open (B) records that never ended become explicit
+    "(unfinished)" markers — a hung or killed process' evidence."""
+    ended = {str(r.get("span")) for r in records if r.get("ph") == "X"}
+    counts = {"spans": 0, "instants": 0, "unfinished": 0}
+    for rec in records:
+        proc = str(rec.get("proc", "unknown"))
+        pid = pids.pid(proc)
+        tid = int(rec.get("tid", 0) or 0)
+        args = {"trace": rec.get("trace"), "span": rec.get("span")}
+        if rec.get("parent"):
+            args["parent"] = rec["parent"]
+        args.update(rec.get("attrs") or {})
+        ph = rec.get("ph")
+        if ph == "X":
+            counts["spans"] += 1
+            out.append({
+                "ph": "X", "name": str(rec.get("name", "span")),
+                "cat": "span", "pid": pid, "tid": tid,
+                "ts": _us(rec.get("t", 0.0)),
+                "dur": max(_us(rec.get("dur", 0.0)), 1),
+                "args": args,
+            })
+            for ev in rec.get("events") or []:
+                counts["instants"] += 1
+                ev_args = dict(ev.get("attrs") or {})
+                ev_args["span"] = rec.get("span")
+                out.append({
+                    "ph": "i", "name": str(ev.get("name", "event")),
+                    "cat": "event", "pid": pid, "tid": tid, "s": "t",
+                    "ts": _us(ev.get("t", rec.get("t", 0.0))),
+                    "args": ev_args,
+                })
+        elif ph == "i":
+            counts["instants"] += 1
+            scope = "p" if str(rec.get("name", "")).startswith("fault:") \
+                else "t"
+            out.append({
+                "ph": "i", "name": str(rec.get("name", "instant")),
+                "cat": "fault" if scope == "p" else "event",
+                "pid": pid, "tid": tid, "s": scope,
+                "ts": _us(rec.get("t", 0.0)), "args": args,
+            })
+        elif ph == "B" and str(rec.get("span")) not in ended:
+            counts["unfinished"] += 1
+            args["unfinished"] = True
+            out.append({
+                "ph": "i",
+                "name": f"{rec.get('name', 'span')} (unfinished)",
+                "cat": "span", "pid": pid, "tid": tid, "s": "t",
+                "ts": _us(rec.get("t", 0.0)), "args": args,
+            })
+    return counts
+
+
+def export_timelines(workdir: str, pids: _Pids,
+                     out: List[Dict[str, Any]]) -> int:
+    n = 0
+    for rec in timeline.read_all(workdir):
+        source = str(rec.pop("source", "timeline"))
+        # Land each agent's timeline on that agent's pid when its span sink
+        # exists; workers share the agent's timeline file by design.
+        proc = f"agent-{source}" if pids.known(f"agent-{source}") \
+            else f"timeline-{source}"
+        args = {k: v for k, v in rec.items() if k not in ("t", "phase")}
+        out.append({
+            "ph": "i", "name": f"timeline:{rec.get('phase', '?')}",
+            "cat": "timeline", "pid": pids.pid(proc), "tid": TIMELINE_TID,
+            "s": "t", "ts": _us(rec.get("t", 0.0)), "args": args,
+        })
+        n += 1
+    return n
+
+
+def export_wal(workdir: str, pids: _Pids, out: List[Dict[str, Any]]) -> int:
+    n = 0
+    proc = "master" if pids.known("master") else "master-wal"
+    # timeline.read is the one copy of torn-line-tolerant JSONL reading;
+    # the WAL is the same format.
+    for rec in timeline.read(os.path.join(workdir, "events.jsonl")):
+        args = {k: v for k, v in rec.items() if k not in ("t", "kind")}
+        out.append({
+            "ph": "i", "name": f"master:{rec.get('kind', '?')}",
+            "cat": "wal", "pid": pids.pid(proc), "tid": WAL_TID, "s": "t",
+            "ts": _us(rec.get("t", 0.0)), "args": args,
+        })
+        n += 1
+    return n
+
+
+def build_trace(workdir: str) -> Dict[str, Any]:
+    pids = _Pids()
+    events: List[Dict[str, Any]] = []
+    span_records = tracing.read_all(workdir)
+    # Deterministic pid order: master first (the trace's causal root),
+    # then everything else alphabetically.
+    for proc in sorted({str(r.get("proc", "unknown")) for r in span_records},
+                       key=lambda p: (p != "master", p)):
+        pids.pid(proc)
+    counts = export_spans(span_records, pids, events)
+    counts["timeline"] = export_timelines(workdir, pids, events)
+    counts["wal"] = export_wal(workdir, pids, events)
+    events.sort(key=lambda e: e["ts"])
+    return {
+        "traceEvents": pids.meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "workdir": os.path.abspath(workdir),
+            "counts": counts,
+            "processes": len(pids.meta),
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="merge a job's spans/timelines/WAL into a Perfetto "
+                    "trace.json")
+    ap.add_argument("--workdir", required=True, help="job workdir")
+    ap.add_argument("--out", default="",
+                    help="output path (default <workdir>/trace.json)")
+    args = ap.parse_args()
+    doc = build_trace(args.workdir)
+    n = sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
+    out_path = args.out or os.path.join(args.workdir, "trace.json")
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.write("\n")
+    os.replace(tmp, out_path)
+    counts = doc["otherData"]["counts"]
+    print(f"{out_path}: {n} events from {doc['otherData']['processes']} "
+          f"processes ({counts['spans']} spans, {counts['instants']} "
+          f"instants, {counts['unfinished']} unfinished, "
+          f"{counts['timeline']} timeline, {counts['wal']} WAL)")
+    if n == 0:
+        print("nothing to export (was the job traced? EASYDL_TRACE=1, or "
+              "any timeline/WAL in the workdir)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

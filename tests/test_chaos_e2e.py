@@ -10,10 +10,15 @@ with ``pytest -m chaos`` or ``python scripts/chaos_run.py``.
 """
 
 import json
+import os
+import subprocess
+import sys
 
 import pytest
 
 from easydl_tpu.chaos.harness import run_scenario
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(name, tmp_path):
@@ -50,6 +55,33 @@ def test_chaos_master_crash_scenario(tmp_path):
     assert verdict["outages"] and "t_up" in verdict["outages"][0]
     # the failover really went through the journal-restore path
     assert checks["no_spurious_reshape_after_failover"]["failovers"] >= 1
+
+    # ISSUE 4 acceptance: the completed drill's workdir exports to a
+    # Perfetto-loadable trace.json with ≥1 generation-switch span tree
+    # whose worker-side child spans share the master's trace_id, and the
+    # injected fault present as an instant event.
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "trace_export.py"),
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    events = doc["traceEvents"]
+    proc_names = {e["pid"]: e["args"]["name"] for e in events
+                  if e.get("ph") == "M" and e["name"] == "process_name"}
+    switches = [e for e in events
+                if e["name"] == "generation_switch" and e["ph"] == "X"]
+    assert switches, "no generation_switch span tree in the merged trace"
+    switch_traces = {e["args"]["trace"] for e in switches}
+    worker_spans = [
+        e for e in events
+        if str(proc_names.get(e.get("pid"), "")).startswith("worker-")
+        and e.get("args", {}).get("trace") in switch_traces
+    ]
+    assert worker_spans, "no worker-side span shares a switch trace_id"
+    faults = [e for e in events if e["name"].startswith("fault:")]
+    assert any(e["name"] == "fault:master_crash" for e in faults), faults
 
 
 @pytest.mark.slow

@@ -1,0 +1,392 @@
+"""Unit tests for the distributed-tracing layer (obs/tracing.py): context
+inject/extract, the gRPC metadata hops in utils/rpc.py, the flight-recorder
+sink, retry-attempt events, the timeline listener-error counter, the
+exporter satellite fixes, and the Perfetto export merge."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import grpc
+import pytest
+
+from easydl_tpu.obs import tracing
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.rpc import RpcClient, ServiceDef, serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    """Tracing armed, sink under this test's workdir."""
+    monkeypatch.setenv(tracing.TRACE_ENV, "1")
+    tracing.configure("test", str(tmp_path))
+    return str(tmp_path)
+
+
+def read_spans(workdir):
+    return tracing.read_all(workdir)
+
+
+# ------------------------------------------------------------ context codec
+def test_inject_extract_roundtrip(traced):
+    root = tracing.start_span("root")
+    try:
+        header = tracing.inject()
+        ctx = tracing.extract(header)
+        assert ctx == root.context
+        # explicit context injects too
+        other = tracing.SpanContext("ab" * 16, "cd" * 8)
+        assert tracing.extract(tracing.inject(other)) == other
+    finally:
+        root.end()
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-also-01", "00--"," - - - ",
+    "00-" + "z" * 32 + "-" + "1" * 16 + "-01",
+    "00-" + "0" * 32 + "-" + "0" * 16 + "-01",  # all-zero ids are invalid
+    "00-" + "a" * 31 + "-" + "1" * 16 + "-01",  # wrong length
+    123, b"00-aa-bb-01",
+])
+def test_extract_malformed_never_raises(bad):
+    assert tracing.extract(bad) is None
+
+
+def test_from_env(traced):
+    ctx = tracing.SpanContext("12" * 16, "34" * 8)
+    env = {tracing.CTX_ENV: tracing.inject(ctx)}
+    assert tracing.from_env(env) == ctx
+    assert tracing.from_env({}) is None
+    assert tracing.from_env({tracing.CTX_ENV: "nope"}) is None
+
+
+# ------------------------------------------------------------ disabled mode
+def test_disabled_is_inert(tmp_path, monkeypatch):
+    monkeypatch.delenv(tracing.TRACE_ENV, raising=False)
+    tracing.configure("inert", str(tmp_path))
+    span = tracing.start_span("x", a=1)
+    assert not span  # NULL span
+    span.add_event("e")
+    span.end()
+    tracing.instant("i")
+    tracing.record_span("r", time.time() - 1, time.time())
+    assert tracing.inject() is None
+    # no obs dir, no files: disabled tracing writes NOTHING
+    assert not os.path.exists(os.path.join(str(tmp_path), "obs"))
+
+
+# ------------------------------------------------------------------- sink
+def test_span_records_parenting_and_events(traced):
+    root = tracing.start_span("switch", job="j")
+    child = tracing.start_span("leg")
+    child.add_event("retry", attempt=1)
+    child.end()
+    root.end(generation=3)
+    recs = read_spans(traced)
+    done = {r["name"]: r for r in recs if r["ph"] == "X"}
+    assert done["leg"]["parent"] == root.context.span_id
+    assert done["leg"]["trace"] == root.context.trace_id
+    assert done["leg"]["events"][0]["name"] == "retry"
+    assert done["switch"]["attrs"] == {"job": "j", "generation": 3}
+
+
+def test_record_span_and_instant(traced):
+    parent = tracing.SpanContext("ef" * 16, "ab" * 8)
+    t1 = time.time()
+    ctx = tracing.record_span("step", t1 - 0.5, t1, parent=parent, step=7)
+    assert ctx.trace_id == parent.trace_id
+    tracing.instant("fault:worker_kill", parent=parent, kind="worker_kill")
+    recs = read_spans(traced)
+    step = next(r for r in recs if r["name"] == "step")
+    assert step["ph"] == "X" and abs(step["dur"] - 0.5) < 1e-6
+    assert step["parent"] == parent.span_id
+    fault = next(r for r in recs if r["name"] == "fault:worker_kill")
+    assert fault["ph"] == "i" and fault["trace"] == parent.trace_id
+
+
+def test_sink_rotation_bounds_the_recorder(traced, monkeypatch):
+    monkeypatch.setenv(tracing.MAX_BYTES_ENV, "2000")
+    for i in range(100):
+        tracing.record_span(f"s{i}", time.time() - 0.1, time.time())
+    path = tracing.sink_path()
+    assert os.path.exists(path + ".1")  # rotated at least once
+    assert os.path.getsize(path) <= 2000 + 500  # current stays bounded
+    # read_all still sees both generations, newest included
+    names = {r["name"] for r in read_spans(traced)}
+    assert "s99" in names
+
+
+def test_detached_span_never_pins_the_opener_thread(traced):
+    """Regression: the master's switch span is opened on a gRPC handler
+    thread and ended by the tick loop (another thread). Detached spans
+    must not sit on the opener's current-span stack — otherwise every
+    later metadata-less RPC on that pool thread would parent onto a dead
+    span and the stack would grow per switch."""
+    opened = {}
+
+    def handler_thread():
+        opened["span"] = tracing.start_span("generation_switch",
+                                            detached=True)
+        opened["current_after_open"] = tracing.current_span()
+
+    t = threading.Thread(target=handler_thread)
+    t.start()
+    t.join()
+    assert opened["current_after_open"] is None  # not ambient anywhere
+    # end on THIS thread (the tick loop's role): no error, span written
+    opened["span"].end(generation=2)
+    rec = next(r for r in read_spans(traced)
+               if r["ph"] == "X" and r["name"] == "generation_switch")
+    assert rec["attrs"]["generation"] == 2
+    assert tracing.current_span() is None
+
+
+def test_open_spans_tracks_unfinished_work(traced):
+    done = tracing.start_span("done")
+    done.end()
+    hung = tracing.start_span("hung", agent="a0")
+    try:
+        opens = tracing.open_spans(traced)
+        assert [r["name"] for r in opens] == ["hung"]
+        assert opens[0]["age_s"] >= 0
+    finally:
+        hung.end()
+    assert tracing.open_spans(traced) == []
+
+
+def test_obs_scrape_spans_cli(traced):
+    hung = tracing.start_span("stuck_thing", agent="a0")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join("scripts", "obs_scrape.py"),
+             "--workdir", traced, "--spans", "--json"],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+            env=dict(os.environ, EASYDL_TRACE="1"),
+        )
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert [r["name"] for r in doc] == ["stuck_thing"]
+    finally:
+        hung.end()
+
+
+# ------------------------------------------------------------ gRPC hops
+SVC = ServiceDef("easydl.TraceTest", {"Ping": (pb.Ack, pb.Ack)})
+
+
+class _Impl:
+    def __init__(self):
+        self.metadata = []
+        self.reply_ctx = None
+
+    def Ping(self, req, ctx):
+        self.metadata.append(dict(ctx.invocation_metadata() or ()))
+        if self.reply_ctx is not None:
+            tracing.attach_reply_context(ctx, self.reply_ctx)
+        return pb.Ack(ok=True)
+
+
+@pytest.fixture
+def echo():
+    impl = _Impl()
+    server = serve(SVC, impl)
+    client = RpcClient(SVC, server.address)
+    yield impl, client
+    client.close()
+    server.stop()
+
+
+def test_disabled_rpc_adds_no_metadata(echo, tmp_path, monkeypatch):
+    monkeypatch.delenv(tracing.TRACE_ENV, raising=False)
+    impl, client = echo
+    assert client.Ping(pb.Ack()).ok
+    assert tracing.METADATA_KEY not in impl.metadata[-1]
+    assert tracing.take_reply_context() is None
+    assert not os.path.exists(os.path.join(str(tmp_path), "obs"))
+
+
+def test_rpc_context_propagates_client_to_server(echo, traced):
+    impl, client = echo
+    root = tracing.start_span("root")
+    try:
+        assert client.Ping(pb.Ack()).ok
+    finally:
+        root.end()
+    sent = impl.metadata[-1]
+    assert tracing.extract(sent[tracing.METADATA_KEY]).trace_id \
+        == root.context.trace_id
+    # the server-side handler span landed in the sink, same trace
+    server_spans = [r for r in read_spans(traced)
+                    if r["ph"] == "X" and r["name"].startswith("rpc:")]
+    assert any(r["trace"] == root.context.trace_id for r in server_spans)
+
+
+def test_rpc_without_parent_sends_no_metadata_server_roots(echo, traced):
+    impl, client = echo
+    assert client.Ping(pb.Ack()).ok  # enabled, but no active span
+    assert tracing.METADATA_KEY not in impl.metadata[-1]
+    server_spans = [r for r in read_spans(traced)
+                    if r["ph"] == "X" and r["name"].startswith("rpc:")]
+    assert server_spans and all("parent" not in r for r in server_spans)
+
+
+def test_rpc_malformed_metadata_is_new_root_never_error(echo, traced):
+    _impl, client = echo
+    # bypass RpcClient: send garbage easydl-trace metadata directly
+    channel = grpc.insecure_channel(client._address)
+    call = channel.unary_unary(
+        "/easydl.TraceTest/Ping",
+        request_serializer=pb.Ack.SerializeToString,
+        response_deserializer=pb.Ack.FromString,
+    )
+    resp = call(pb.Ack(), timeout=10.0,
+                metadata=((tracing.METADATA_KEY, "not-a-traceparent"),))
+    assert resp.ok  # the RPC succeeded despite the garbage
+    channel.close()
+    spans = [r for r in read_spans(traced)
+             if r["ph"] == "X" and r["name"].startswith("rpc:")]
+    assert spans and all("parent" not in r for r in spans)
+
+
+def test_reply_context_rides_trailing_metadata(echo, traced):
+    impl, client = echo
+    impl.reply_ctx = tracing.SpanContext("aa" * 16, "bb" * 8)
+    assert client.Ping(pb.Ack()).ok
+    got = tracing.take_reply_context()
+    assert got == impl.reply_ctx
+    assert tracing.take_reply_context() is None  # cleared on read
+
+
+# --------------------------------------------------------------- retry hook
+def test_retry_attempts_land_as_span_events(traced):
+    from easydl_tpu.utils.retry import retry_transient
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ValueError("closed channel")  # transient-classed
+        return "ok"
+
+    span = tracing.start_span("ps_pull", shard=0)
+    try:
+        assert retry_transient(flaky, max_elapsed_s=5.0,
+                               sleep=lambda s: None) == "ok"
+    finally:
+        span.end()
+    rec = next(r for r in read_spans(traced) if r["ph"] == "X"
+               and r["name"] == "ps_pull")
+    retries = [e for e in rec.get("events", []) if e["name"] == "retry"]
+    assert len(retries) == 2
+    assert retries[0]["attrs"]["attempt"] == 1
+
+
+# ------------------------------------------------- timeline listener errors
+def test_timeline_listener_errors_are_counted(tmp_path):
+    from easydl_tpu.elastic import timeline
+    from easydl_tpu.obs import get_registry
+
+    def broken(path, rec):
+        raise RuntimeError("bridge broke")
+
+    timeline.add_listener(broken)
+    try:
+        path = str(tmp_path / "timeline-x.jsonl")
+        timeline.emit(path, "spawn", 1)  # must not raise
+        timeline.emit(path, "spawn", 2)
+    finally:
+        timeline.remove_listener(broken)
+    fam = get_registry().get("easydl_timeline_listener_errors_total")
+    assert fam is not None
+    assert sum(fam.samples().values()) >= 2
+
+
+# ------------------------------------------------------- exporter satellite
+def test_exporter_thread_name_and_stale_sweep(tmp_path):
+    from easydl_tpu.obs.exporter import MetricsExporter
+
+    # a publication from a process that no longer exists
+    dead = subprocess.Popen(["sleep", "0"])
+    dead.wait()
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    stale = obs_dir / "old-agent.json"
+    stale.write_text(json.dumps({
+        "component": "old-agent", "address": "localhost:1",
+        "pid": dead.pid, "registry": 1, "t": 0,
+    }))
+    remote = obs_dir / "remote.json"
+    remote.write_text(json.dumps({
+        "component": "remote", "address": "otherhost:9100",
+        "pid": dead.pid, "registry": 1, "t": 0,
+    }))
+    exp = MetricsExporter(component="fresh", workdir=str(tmp_path))
+    try:
+        threads = {t.name for t in threading.enumerate()}
+        assert f"obs-metrics-{exp.port}" in threads
+        assert not stale.exists()   # dead-pid localhost publication swept
+        assert remote.exists()      # cross-host publication untouched
+        assert (obs_dir / "fresh.json").exists()
+    finally:
+        exp.stop()
+    assert not (obs_dir / "fresh.json").exists()  # clean-shutdown retract
+
+
+# ------------------------------------------------------------ trace export
+def test_trace_export_merges_spans_timeline_and_wal(traced, tmp_path):
+    # spans from two "processes"
+    root = tracing.start_span("generation_switch", job="j")
+    tracing.record_span("worker_run", time.time() - 1, time.time(),
+                        parent=root, rank=0)
+    tracing.instant("fault:worker_kill", kind="worker_kill")
+    hung = tracing.start_span("dist_init")  # left open on purpose
+    root.end()
+    # a timeline and a WAL
+    from easydl_tpu.elastic import timeline
+
+    timeline.emit(str(tmp_path / "timeline-a0.jsonl"), "spawn", 1,
+                  mode="cold")
+    (tmp_path / "events.jsonl").write_text(
+        json.dumps({"t": time.time(), "kind": "failover", "generation": 1})
+        + "\n")
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "trace_export.py"),
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    hung.end()
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    events = doc["traceEvents"]
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    # process metadata + spans + instants + timeline + WAL all present
+    assert any(e["ph"] == "M" for e in events)
+    switch = by_name["generation_switch"][0]
+    worker = by_name["worker_run"][0]
+    assert switch["ph"] == "X" and worker["ph"] == "X"
+    assert worker["args"]["trace"] == switch["args"]["trace"]
+    assert by_name["fault:worker_kill"][0]["ph"] == "i"
+    assert "dist_init (unfinished)" in by_name
+    assert by_name["timeline:spawn"][0]["cat"] == "timeline"
+    assert by_name["master:failover"][0]["cat"] == "wal"
+    # timestamps are sorted (Perfetto requirement is tolerant, but keep it)
+    ts = [e["ts"] for e in events if e["ph"] != "M"]
+    assert ts == sorted(ts)
+
+
+def test_trace_export_empty_workdir_fails(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "trace_export.py"),
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert proc.returncode == 2

@@ -1,0 +1,113 @@
+"""Acceptance test for distributed tracing (ISSUE 4): on a live local job —
+real gRPC master, real agent thread, real worker subprocess — the master's
+generation-switch trace context crosses the gRPC hop (directive reply
+metadata → agent) and the subprocess-env hop (EASYDL_TRACE_CONTEXT →
+worker), so worker-side spans carry the MASTER's trace_id. Also pins the
+disabled contract: an untraced job writes no span files."""
+
+import os
+import time
+
+import pytest
+
+from easydl_tpu.elastic.agent import Agent
+from easydl_tpu.elastic.master import Master
+from easydl_tpu.obs import tracing
+
+JOB = "trace-e2e"
+CFG = {
+    "model": "mlp",
+    "model_kwargs": {"input_shape": [8, 8, 1], "features": [32, 32]},
+    "global_batch": 32,
+    # Long enough that the job is still live while we read span files.
+    "total_steps": 100_000,
+    "ckpt_interval": 50,
+    "lr": 0.01,
+    "seed": 0,
+}
+
+
+def wait_for(cond, timeout=180.0, interval=0.2, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+def test_worker_step_span_carries_master_trace_id(tmp_path, monkeypatch):
+    workdir = str(tmp_path)
+    monkeypatch.setenv(tracing.TRACE_ENV, "1")
+    monkeypatch.setenv("EASYDL_TRACE_STEP_EVERY", "5")
+    master = Master(
+        job_name=JOB, workdir=workdir, desired_workers=1, min_workers=1,
+        worker_config=CFG,
+    ).start()
+    agent = Agent("a0", master.address, workdir, slots=1).start()
+    try:
+        wait_for(
+            lambda: master.status()["agents"].get("a0", {}).get("step", 0)
+            >= 10,
+            desc="worker training past step 10",
+        )
+
+        def switch_closed():
+            return any(
+                r["ph"] == "X" and r["name"] == "generation_switch"
+                for r in tracing.read_all(workdir)
+            )
+        wait_for(switch_closed, timeout=30,
+                 desc="generation_switch span closed on the master")
+
+        recs = tracing.read_all(workdir)
+        switch = next(r for r in recs if r["ph"] == "X"
+                      and r["name"] == "generation_switch")
+        # the switch really formed generation 1 and saw its directives
+        assert switch["attrs"]["generation"] >= 1
+        assert any(e["name"] == "directive:run"
+                   for e in switch.get("events", []))
+        assert switch["proc"] == "master"
+
+        # worker-side spans: same trace as the master's switch — the
+        # context crossed gRPC (reply metadata) AND the subprocess env.
+        worker = [r for r in recs if r["proc"] == "worker-a0"]
+        assert worker, sorted({r["proc"] for r in recs})
+        run = next(r for r in worker if r["name"] == "worker_run"
+                   and r["ph"] == "B")
+        assert run["trace"] == switch["trace"]
+        wait_for(
+            lambda: any(r["ph"] == "X" and r["name"] == "step"
+                        for r in tracing.read_all(workdir)),
+            timeout=30, desc="a sampled worker step span",
+        )
+        step = next(r for r in tracing.read_all(workdir)
+                    if r["ph"] == "X" and r["name"] == "step")
+        assert step["trace"] == switch["trace"]
+        assert step["attrs"]["step"] % 5 == 0
+
+        # the generic RPC server spans exist for the heartbeat stream
+        assert any(r["name"] == "rpc:easydl.Master/Heartbeat"
+                   for r in recs if r["proc"] == "master")
+    finally:
+        agent.stop()
+        master.stop()
+
+
+def test_untraced_job_writes_no_span_files(tmp_path, monkeypatch):
+    monkeypatch.delenv(tracing.TRACE_ENV, raising=False)
+    workdir = str(tmp_path)
+    master = Master(
+        job_name=JOB, workdir=workdir, desired_workers=1, min_workers=1,
+        worker_config=dict(CFG, total_steps=30),
+    ).start()
+    agent = Agent("a0", master.address, workdir, slots=1).start()
+    try:
+        wait_for(lambda: master.done, desc="tiny job done")
+    finally:
+        agent.stop()
+        master.stop()
+    obs = os.path.join(workdir, "obs")
+    if os.path.isdir(obs):
+        spans = [n for n in os.listdir(obs) if n.startswith("spans-")]
+        assert spans == [], spans
