@@ -354,6 +354,12 @@ def run_worker(env: Dict[str, str]) -> int:
         double-count into optimizer accumulators."""
         if ps_mode and rank == 0:
             try:
+                # Async-push boundary contract (ps/trainer.py): queued
+                # pushes must land before the snapshot or the saved sparse
+                # state would trail the dense state it is paired with.
+                # No-op on this strict train_step loop, load-bearing if the
+                # loop ever moves to the pipelined train_steps.
+                trainer.drain_pushes()
                 trainer.client.save(ps_ckpt_dir, step)
             except Exception as e:  # PS save failure must not kill training
                 log.warning("ps snapshot at step %d failed: %s", step, e)

@@ -19,6 +19,7 @@ its local batch shard.
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from functools import partial
@@ -31,28 +32,88 @@ from jax.experimental import io_callback
 
 import time
 
-from easydl_tpu.obs import tracing
+from easydl_tpu.obs import get_registry, tracing
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.ps.server import DRAINING, PS_SERVICE, PsShard, spec_to_proto
 from easydl_tpu.ps.table import TableSpec, shard_of
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.env import env_flag as _env_flag
 from easydl_tpu.utils.retry import (
     backoff_delay,
     is_transport_error,
     retry_transient,
 )
-from easydl_tpu.utils.rpc import RpcClient
+from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, RpcClient
 
 log = get_logger("ps", "client")
 
 
+_client_metrics_cache: Optional[tuple] = None
+
+
+def _client_metrics():
+    global _client_metrics_cache
+    if _client_metrics_cache is None:
+        reg = get_registry()
+        _client_metrics_cache = (
+            reg.gauge(
+                "easydl_ps_client_dedup_ratio",
+                "unique/total ids of the last coalesced pull, per table "
+                "(client side; 1.0 = no duplicates in the batch).",
+                ("table",),
+            ),
+        )
+    return _client_metrics_cache
+
+
 class _PsClientBase:
-    """Routing + scatter/gather shared by both transports."""
+    """Routing + scatter/gather shared by both transports.
+
+    The hot path is *coalesced* by default (``EASYDL_PS_COALESCE=0`` or
+    ``coalesce=False`` restores the strict pre-coalescing path): ids are
+    deduplicated with ``np.unique`` before any RPC and the pulled rows are
+    scattered back on return, so wire bytes and server work scale with the
+    batch's UNIQUE ids — on Zipf-distributed recommendation batches that is
+    a multiple, not a percentage. Pushes pre-accumulate duplicate ids
+    client-side (occurrence order, bit-identical to the server's own
+    accumulation) and shard routing uses one argsort-based partition
+    instead of ``num_shards`` boolean-mask scans.
+    """
 
     num_shards: int
+    coalesce: bool = True
     # Guards lazy pool creation (class-level: trivially race-free; contended
     # only during the one-time init).
     _pool_lock = threading.Lock()
+
+    # ------------------------------------------------------- coalescing plan
+    def _plan(self, flat: np.ndarray):
+        """(routed, routed_inv, offs) for a flat id batch, cached
+        for the immediately-following call with the SAME ids — the training
+        loop always pushes the exact batch it just pulled, so the sort/
+        unique/partition work is paid once per step, not twice. The key is
+        the full id buffer (exact memcmp, no hashing): a false hit would
+        route gradients to wrong rows, so probabilistic keys are out.
+
+        ``routed`` is the unique ids already in shard order (shard s owns
+        ``routed[offs[s]:offs[s+1]]``) and ``routed_inv`` maps each batch
+        position straight to its routed row — so pull scatters with ONE
+        fancy gather and push accumulates directly into routed positions.
+        """
+        key = flat.tobytes()
+        # Two entries, not one: the pipelined loop pulls batch k+1 while
+        # the write-behind queue pushes batch k, so both plans are live.
+        cached = getattr(self, "_plan_cache", ())
+        for k, plan in cached:
+            if k == key:
+                return plan
+        uniq, inv = np.unique(flat, return_inverse=True)
+        order, offs = self._partition(uniq)
+        pos = np.empty(len(uniq), np.int64)
+        pos[order] = np.arange(len(uniq), dtype=np.int64)
+        plan = (uniq[order], pos[inv], offs)
+        self._plan_cache = ((key, plan),) + tuple(cached[:1])
+        return plan
 
     # Subclasses implement the per-shard primitives.
     def _pull_shard(self, shard: int, table: str, ids: np.ndarray) -> np.ndarray:
@@ -84,19 +145,80 @@ class _PsClientBase:
                     )
         return list(pool.map(fn, range(self.num_shards)))
 
+    # --------------------------------------------------------------- routing
+    def _partition(self, ids: np.ndarray):
+        """One stable argsort groups ids by owning shard; returns
+        ``(order, offsets)`` such that ``ids[order[offs[s]:offs[s+1]]]`` is
+        shard ``s``'s slice. Replaces the O(num_shards · n) boolean-mask
+        scans of the old path with O(n log n) once."""
+        owner = shard_of(ids, self.num_shards)
+        order = np.argsort(owner, kind="stable")
+        counts = np.bincount(owner, minlength=self.num_shards)
+        offs = np.zeros(self.num_shards + 1, np.int64)
+        np.cumsum(counts, out=offs[1:])
+        return order, offs
+
+    def _table_dim(self, table: str) -> int:
+        """The table's embedding dim, for empty pulls (shape contract:
+        ``ids.shape + (dim,)`` even with zero ids) and empty shard slices."""
+        d = self._dims.get(table)
+        if not d:
+            d = self._lookup_dim(table)
+            if d:
+                self._dims[table] = d
+        return d
+
+    def _lookup_dim(self, table: str) -> int:  # subclass transport-specific
+        raise NotImplementedError
+
     # ------------------------------------------------------------------- api
     def create_table(self, spec: TableSpec) -> None:
         self._for_all(lambda s: self._create_shard(s, spec))
+        self._dims[spec.name] = spec.dim
 
     def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
         """ids any shape -> float32 ``ids.shape + (dim,)``."""
         ids = np.asarray(ids)
         flat = ids.reshape(-1).astype(np.int64)
+        if flat.size == 0:
+            return np.zeros(ids.shape + (self._table_dim(table),), np.float32)
+        # Resolve (and cache) the dim ONCE before fanning out: the shard
+        # worker threads all consult it for chunk sizing, and a cold cache
+        # would otherwise send num_shards concurrent Stats calls at shard 0.
+        self._table_dim(table)
+        if not self.coalesce:
+            return self._pull_strict(table, ids, flat)
+        # Dedup before the RPC: every duplicate of a hot id would otherwise
+        # ride the wire and hit the store once per occurrence.
+        routed, routed_inv, offs = self._plan(flat)
+        _client_metrics()[0].set(len(routed) / len(flat), table=table)
+        parts = self._for_all(
+            lambda s: self._pull_shard(s, table, routed[offs[s]:offs[s + 1]])
+        )
+        dim = next((p.shape[-1] for p in parts if p.size),
+                   self._table_dim(table))
+        self._dims.setdefault(table, dim)
+        # Skip zero-row parts: an empty shard slice may carry a (0, 0)
+        # placeholder when the table dim could not be resolved, and
+        # np.concatenate would reject the column mismatch. At least one
+        # part is non-empty (flat.size > 0), and dropping empties keeps
+        # shard order, so the result still lines up with ``routed``.
+        nonempty = [p for p in parts if len(p)]
+        rows = nonempty[0] if len(nonempty) == 1 else np.concatenate(nonempty)
+        # Scatter back to batch positions (duplicates fan out here, on the
+        # client, for free): one gather, straight from shard-routed rows.
+        return rows[routed_inv].reshape(ids.shape + (dim,))
+
+    def _pull_strict(self, table: str, ids: np.ndarray,
+                     flat: np.ndarray) -> np.ndarray:
+        """Pre-coalescing pull (row per batch position on the wire) — the
+        parity/bench baseline."""
         owner = shard_of(flat, self.num_shards)
         parts = self._for_all(
             lambda s: self._pull_shard(s, table, flat[owner == s])
         )
-        dim = next(p.shape[-1] for p in parts if p.size) if flat.size else 0
+        dim = next((p.shape[-1] for p in parts if p.size),
+                   self._table_dim(table))
         out = np.zeros((len(flat), dim), np.float32)
         for s, part in enumerate(parts):
             if part.size:
@@ -108,10 +230,46 @@ class _PsClientBase:
         ids = np.asarray(ids)
         flat = ids.reshape(-1).astype(np.int64)
         g = np.ascontiguousarray(grads, np.float32).reshape(len(flat), -1)
-        owner = shard_of(flat, self.num_shards)
+        if flat.size == 0:
+            return
+        if not self.coalesce:
+            owner = shard_of(flat, self.num_shards)
+            self._for_all(
+                lambda s: self._push_shard(
+                    s, table, flat[owner == s], g[owner == s], scale
+                )
+            )
+            return
+        # Pre-accumulate duplicate ids client-side, in batch-occurrence
+        # order — bit-identical to the accumulation the store itself would
+        # do (np.add.at / embedding_store.cc both sum occurrences in batch
+        # order; the shard-order permutation does not change any single
+        # id's occurrence sequence), so the optimizer sees the same
+        # gradient either way. Accumulation lands directly in routed
+        # (shard-order) positions — no post-hoc reorder copy.
+        routed, routed_inv, offs = self._plan(flat)
+        if len(routed) == len(flat):
+            acc = np.empty_like(g)  # no duplicates: pure scatter to
+            acc[routed_inv] = g     # shard-routed positions
+        else:
+            # np.add.at is the only vectorized op with the sequential
+            # occurrence-order adds parity requires (reduceat/bincount sum
+            # pairwise/in float64 — different bits), but it is slow — so
+            # route only the rows of genuinely-duplicated ids through it
+            # and copy the singletons (typically the majority even on
+            # Zipf batches) directly.
+            counts = np.bincount(routed_inv, minlength=len(routed))
+            single = counts == 1
+            acc = np.empty((len(routed), g.shape[1]), np.float32)
+            sel_single = single[routed_inv]
+            acc[routed_inv[sel_single]] = g[sel_single]
+            acc[~single] = 0.0
+            sel = ~sel_single
+            np.add.at(acc, routed_inv[sel], g[sel])
         self._for_all(
             lambda s: self._push_shard(
-                s, table, flat[owner == s], g[owner == s], scale
+                s, table, routed[offs[s]:offs[s + 1]],
+                acc[offs[s]:offs[s + 1]], scale
             )
         )
 
@@ -131,14 +289,30 @@ class _PsClientBase:
 
 
 class LocalPsClient(_PsClientBase):
-    """In-process PS cluster: N shards, no sockets."""
+    """In-process PS cluster: N shards, no sockets.
 
-    def __init__(self, num_shards: int = 1, backend: str = "auto"):
+    Coalescing is OFF by default here (unlike the gRPC client): dedup pays
+    for itself by shrinking *wire* bytes, and there is no wire — the store
+    accumulates duplicates itself either way (bit-identically), so
+    client-side np.unique + re-accumulation would be pure added latency.
+    """
+
+    def __init__(self, num_shards: int = 1, backend: str = "auto",
+                 coalesce: Optional[bool] = None):
         self.num_shards = num_shards
+        self.coalesce = (_env_flag("EASYDL_PS_COALESCE", False)
+                        if coalesce is None else coalesce)
+        self._dims: Dict[str, int] = {}
         self.shards = [
             PsShard(shard_index=i, num_shards=num_shards, backend=backend)
             for i in range(num_shards)
         ]
+
+    def _lookup_dim(self, table):
+        try:
+            return self.shards[0].table(table).dim
+        except KeyError:
+            return 0
 
     def _pull_shard(self, s, table, ids):
         if ids.size == 0:
@@ -181,9 +355,38 @@ class ShardedPsClient(_PsClientBase):
     def __init__(self, addresses: Sequence[str], timeout: float = 60.0,
                  drain_retry_s: float = 60.0,
                  transient_retry_s: float = 30.0,
-                 registry_workdir: Optional[str] = None):
+                 registry_workdir: Optional[str] = None,
+                 coalesce: Optional[bool] = None,
+                 raw_ids: Optional[bool] = None,
+                 pull_fp16: Optional[bool] = None,
+                 chunk_bytes: Optional[int] = None):
         self.addresses = list(addresses)
         self.num_shards = len(self.addresses)
+        self.coalesce = (_env_flag("EASYDL_PS_COALESCE", True)
+                         if coalesce is None else coalesce)
+        # Wire format: raw_ids (little-endian int64 bytes) replaces the
+        # varint-encoded repeated ids — zero encode/decode on the hot path.
+        # Back-compat is negotiated per shard: until a PullResponse carries
+        # `dtype` (new servers always set it) the request includes BOTH
+        # raw_ids and the legacy list, so an old server keeps working and a
+        # new one confirms itself on the first round-trip.
+        self.raw_ids = (_env_flag("EASYDL_PS_RAW_IDS", True)
+                        if raw_ids is None else raw_ids)
+        self.pull_fp16 = (_env_flag("EASYDL_PS_PULL_FP16", False)
+                          if pull_fp16 is None else pull_fp16)
+        # Large unary messages are superlinearly slow through python gRPC
+        # (measured: one 2 MB pull costs ~2.5x two 1 MB pulls), so per-shard
+        # transfers split into ~EASYDL_PS_CHUNK_BYTES value-payload chunks
+        # issued concurrently over the shard's HTTP/2 channel. 0 disables.
+        self.chunk_bytes = (
+            int(os.environ.get("EASYDL_PS_CHUNK_BYTES", str(1 << 20)))
+            if chunk_bytes is None else chunk_bytes)
+        self._chunk_pool: Optional[ThreadPoolExecutor] = None
+        self._raw_capable = [False] * self.num_shards
+        # Bumped by reroute(): a capability-bearing response only counts if
+        # no reroute happened while it was in flight (see _pull_chunk).
+        self._reroute_epoch = [0] * self.num_shards
+        self._dims: Dict[str, int] = {}
         self.drain_retry_s = drain_retry_s
         # Bound for transient-UNAVAILABLE retry on the PULL path (pushes
         # have the drain window): long enough to ride a shard crash +
@@ -197,7 +400,8 @@ class ShardedPsClient(_PsClientBase):
         self.registry_workdir = registry_workdir
         self._registry_checked_at = 0.0
         self._clients = [
-            RpcClient(PS_SERVICE, a, timeout=timeout) for a in self.addresses
+            RpcClient(PS_SERVICE, a, timeout=timeout,
+                      options=GRPC_MSG_OPTIONS) for a in self.addresses
         ]
 
     @classmethod
@@ -240,13 +444,76 @@ class ShardedPsClient(_PsClientBase):
         pool = getattr(self, "_pool", None)
         if pool is not None:
             pool.shutdown(wait=False)
+        if self._chunk_pool is not None:
+            self._chunk_pool.shutdown(wait=False)
         for c in self._clients:
             c.close()
 
+    # ------------------------------------------------------------- chunking
+    def _chunks(self, n: int, dim: int):
+        """Row ranges splitting an n-row transfer into ~chunk_bytes value
+        payloads. One range (no split) when chunking is off, the payload is
+        small, or the dim is still unknown."""
+        row_bytes = 4 * max(dim, 1)
+        if not self.chunk_bytes or dim <= 0:
+            return [(0, n)]
+        rows = max(int(self.chunk_bytes // row_bytes), 256)
+        # Balanced split: ceil-divide into equal chunks rather than
+        # budget-sized chunks plus a runt (a 50-row tail chunk is a whole
+        # RPC of overhead for no payload). Slight overshoot past the budget
+        # (< 1.5x) beats an extra round trip.
+        if n <= (rows * 3) // 2:
+            return [(0, n)]
+        nchunks = -(-n // rows)
+        size = -(-n // nchunks)
+        return [(i, min(i + size, n)) for i in range(0, n, size)]
+
+    def _chunk_fan(self, tasks):
+        """Run chunk thunks concurrently (shared bounded pool, lazily
+        created under the same class-level lock as the shard pool)."""
+        if len(tasks) == 1:
+            return [tasks[0]()]
+        pool = self._chunk_pool
+        if pool is None:
+            with _PsClientBase._pool_lock:
+                pool = self._chunk_pool
+                if pool is None:
+                    pool = self._chunk_pool = ThreadPoolExecutor(
+                        max_workers=8, thread_name_prefix="ps-chunk",
+                    )
+        return [f.result() for f in [pool.submit(t) for t in tasks]]
+
+    def _lookup_dim(self, table):
+        try:
+            for st in self._stats_shard(0).tables:
+                if st.name == table:
+                    return st.dim
+        except Exception:
+            pass
+        return 0
+
+    def _wire_ids(self, s, ids) -> dict:
+        """Request kwargs for the id list: raw bytes by default, plus the
+        legacy varint list until shard ``s`` has proven (via
+        PullResponse.dtype) that it understands raw_ids."""
+        if not self.raw_ids:
+            return {"ids": ids.tolist()}
+        kwargs = {"raw_ids": np.ascontiguousarray(ids, "<i8").tobytes()}
+        if not self._raw_capable[s]:
+            kwargs["ids"] = ids.tolist()
+        return kwargs
+
     def _pull_shard(self, s, table, ids):
         if ids.size == 0:
-            return np.zeros((0, 0), np.float32)
+            return np.zeros((0, self._table_dim(table)), np.float32)
+        ranges = self._chunks(len(ids), self._table_dim(table))
+        parts = self._chunk_fan(
+            [lambda lo=lo, hi=hi: self._pull_chunk(s, table, ids[lo:hi])
+             for lo, hi in ranges]
+        )
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
+    def _pull_chunk(self, s, table, ids):
         # Pulls are read-only — retrying a transient transport failure is
         # unconditionally safe, and without it ONE sporadic UNAVAILABLE
         # (shard crash, connection refused during a pod replacement) killed
@@ -256,43 +523,96 @@ class ShardedPsClient(_PsClientBase):
         # itself is inside the retry: reshape of a malformed response
         # raises ValueError, which the transport classifier would read as
         # "closed channel" and spin on for the whole budget — a corrupt
-        # reply must surface immediately, as before.
-        req = pb.PullRequest(table=table, ids=ids.tolist())
-        # Span per shard pull; utils/retry.py stamps every transient retry
-        # as an event inside it, so a slow pull names its retries. No-op
-        # with tracing disabled.
+        # reply must surface immediately, as before. The request is
+        # REBUILT on every attempt: a mid-retry reroute() resets the
+        # shard's raw-capability, and the retried RPC must re-include the
+        # legacy ids list in case the replacement runs older code.
+        # The epoch is re-read per attempt: only a response from the
+        # CURRENT routing may arm the raw capability below — a reply from
+        # the pre-reroute server arriving after reroute()'s capability
+        # reset must not re-arm it for a replacement that may run older
+        # code (concurrent chunks make that interleaving real).
+        state = {"epoch": self._reroute_epoch[s]}
+
+        def attempt():
+            state["epoch"] = self._reroute_epoch[s]
+            req = pb.PullRequest(
+                table=table,
+                value_dtype="f16" if self.pull_fp16 else "",
+                **self._wire_ids(s, ids),
+            )
+            return self._clients[s].Pull(req)
+
+        # Span per chunk; utils/retry.py stamps every transient retry as an
+        # event inside it, so a slow pull names its retries. No-op with
+        # tracing disabled.
         with tracing.start_span("ps_pull", shard=s, table=table,
                                 ids=int(ids.size)):
             resp = retry_transient(
-                lambda: self._clients[s].Pull(req),
+                attempt,
                 max_elapsed_s=self.transient_retry_s,
                 on_retry=lambda e: self._maybe_reroute_from_registry(s),
                 describe=f"ps shard {s} pull",
             )
-        return np.frombuffer(resp.values, np.float32).reshape(
-            len(ids), resp.dim)
+        if resp.dtype and self._reroute_epoch[s] == state["epoch"]:
+            # A dtype-bearing response is the raw-capability handshake:
+            # later requests to this shard drop the duplicate legacy list.
+            self._raw_capable[s] = True
+        if resp.dtype == "f16":
+            vals = np.frombuffer(resp.values, "<f2").astype(np.float32)
+        else:
+            vals = np.frombuffer(resp.values, "<f4")
+        return vals.reshape(len(ids), resp.dim)
 
     def _push_shard(self, s, table, ids, grads, scale):
         if ids.size == 0:
             return
-        req = pb.PushRequest(
-            table=table, ids=ids.tolist(), grads=grads.tobytes(), scale=scale
+        # Chunking is safe ONLY on the coalesced path, where ids are unique:
+        # chunks then carry DISJOINT ids, so concurrent application on the
+        # shard cannot interleave updates to one row, and a drain gate
+        # landing between chunks retries only the unapplied remainder —
+        # exactly the semantics of two back-to-back smaller pushes. The
+        # strict path may repeat an id; splitting its occurrences across
+        # concurrent chunks would apply the nonlinear (adagrad) update to
+        # partial sums in nondeterministic order, so it keeps the pre-PR
+        # one-message-per-shard shape.
+        ranges = (self._chunks(len(ids), grads.shape[1])
+                  if self.coalesce else [(0, len(ids))])
+        self._chunk_fan(
+            [lambda lo=lo, hi=hi: self._push_chunk(
+                s, table, ids[lo:hi], grads[lo:hi], scale)
+             for lo, hi in ranges]
         )
+
+    def _push_chunk(self, s, table, ids, grads, scale):
+        grads_bytes = grads.tobytes()
+
+        def make_req():
+            # Rebuilt per attempt: a mid-retry reroute() resets the shard's
+            # raw-capability, and the retried push must re-include the
+            # legacy ids list in case the replacement runs older code (the
+            # grads payload is reused — only the id encoding can change).
+            return pb.PushRequest(
+                table=table, grads=grads_bytes, scale=scale,
+                **self._wire_ids(s, ids),
+            )
+
         deadline = time.monotonic() + self.drain_retry_s
-        # Span per shard push; the drain/transport retry loop below stamps
-        # each wait as an event inside it (tracing disabled: all no-ops).
+        # Span per chunk; the drain/transport retry loop below stamps each
+        # wait as an event inside it (tracing disabled: all no-ops).
         span = tracing.start_span("ps_push", shard=s, table=table,
                                   ids=int(ids.size))
         try:
-            self._push_with_retries(s, req, deadline, span)
+            self._push_with_retries(s, make_req, deadline, span)
         finally:
             span.end()
 
-    def _push_with_retries(self, s, req, deadline, span):
+    def _push_with_retries(self, s, make_req, deadline, span):
         transport_fails = 0
         while True:
             try:
-                ack = self._clients[s].Push(req)  # re-read: reroute may swap
+                # re-read client AND rebuild request: reroute may swap both
+                ack = self._clients[s].Push(make_req())
             except Exception as e:
                 # Transport failure mid-handoff: reroute() may close the old
                 # client while this retry loop holds it (the next iteration
@@ -340,7 +660,8 @@ class ShardedPsClient(_PsClientBase):
         """Point ``shard``'s traffic at a replacement server (handoff step
         3). In-flight draining pushes pick up the new client on their next
         retry."""
-        client = RpcClient(PS_SERVICE, address, timeout=60.0)
+        client = RpcClient(PS_SERVICE, address, timeout=60.0,
+                           options=GRPC_MSG_OPTIONS)
         try:
             client.wait_ready(30.0)
         except Exception:
@@ -348,6 +669,12 @@ class ShardedPsClient(_PsClientBase):
             raise
         old, self._clients[shard] = self._clients[shard], client
         self.addresses[shard] = address
+        # The replacement may run older code: re-negotiate the raw_ids
+        # capability from scratch (one both-fields request, then raw-only).
+        # The epoch bump invalidates capability signals from responses
+        # still in flight to the OLD server, so they cannot re-arm it.
+        self._reroute_epoch[shard] += 1
+        self._raw_capable[shard] = False
         old.close()
         log.info("ps shard %d rerouted to %s", shard, address)
 
@@ -368,7 +695,8 @@ class ShardedPsClient(_PsClientBase):
         )
         if not ack.ok:
             raise RuntimeError(f"ps shard {shard} drain failed: {ack.message}")
-        repl = RpcClient(PS_SERVICE, new_address, timeout=60.0)
+        repl = RpcClient(PS_SERVICE, new_address, timeout=60.0,
+                         options=GRPC_MSG_OPTIONS)
         try:
             repl.wait_ready(30.0)
             rack = repl.Restore(

@@ -30,7 +30,7 @@ from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.ps.table import EmbeddingTable, TableSpec, shard_of
 from easydl_tpu.utils.logging import get_logger
-from easydl_tpu.utils.rpc import ServiceDef, serve
+from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, ServiceDef, serve
 
 log = get_logger("ps", "server")
 
@@ -53,6 +53,15 @@ PS_SERVICE = ServiceDef(
 #: Ack.message prefix that tells clients a push was NOT applied because the
 #: shard is migrating — retry (against the replacement once rerouted).
 DRAINING = "draining"
+
+
+def request_ids(req) -> np.ndarray:
+    """Decode a Pull/PushRequest's ids: ``raw_ids`` (zero-copy little-endian
+    int64 — the default wire format) when present, else the legacy varint
+    ``repeated int64 ids`` old clients still send."""
+    if req.raw_ids:
+        return np.frombuffer(req.raw_ids, dtype="<i8")
+    return np.asarray(req.ids, np.int64)
 
 
 def spec_to_proto(spec: TableSpec) -> pb.TableConfig:
@@ -123,6 +132,16 @@ class PsShard:
         self._m_push_rejected = reg.counter(
             "easydl_ps_push_rejected_total", "Pushes rejected (draining "
             "gate or invalid scale).", ("shard",))
+        # Wire-byte accounting (request + response proto bytes): with
+        # client-side dedup the bytes per step shrink with the UNIQUE id
+        # count, so these are the counters that prove the dedup ratio on a
+        # live job (scripts/obs_scrape.py merges them fleet-wide).
+        self._m_pull_bytes = reg.counter(
+            "easydl_ps_pull_bytes_total", "Wire bytes (request+response) "
+            "over Pull.", ("shard", "table"))
+        self._m_push_bytes = reg.counter(
+            "easydl_ps_push_bytes_total", "Wire bytes (request+response) "
+            "over Push.", ("shard", "table"))
         self._shard_label = shard_l
 
     # ----------------------------------------------------------- table admin
@@ -257,11 +276,23 @@ class PsShard:
 
     def Pull(self, req: pb.PullRequest, ctx) -> pb.PullResponse:
         t = self.table(req.table)
-        ids = np.asarray(req.ids, np.int64)
+        ids = request_ids(req)
         values = t.pull(ids)
+        if req.value_dtype == "f16":
+            # Opt-in half-precision response (EASYDL_PS_PULL_FP16 on the
+            # client): halves pull bytes; the client re-widens to float32.
+            payload, dtype = values.astype("<f2").tobytes(), "f16"
+        else:
+            payload, dtype = values.astype("<f4", copy=False).tobytes(), "f32"
+        # dtype is ALWAYS set: besides naming the encoding it is the
+        # capability signal that lets new clients drop the duplicate legacy
+        # ids list from every later request to this shard.
+        resp = pb.PullResponse(values=payload, dim=t.dim, dtype=dtype)
         self._m_pulls.inc(len(ids), shard=self._shard_label, table=req.table)
+        self._m_pull_bytes.inc(req.ByteSize() + resp.ByteSize(),
+                               shard=self._shard_label, table=req.table)
         self._m_rows.set(t.rows, shard=self._shard_label, table=req.table)
-        return pb.PullResponse(values=values.tobytes(), dim=t.dim)
+        return resp
 
     def Push(self, req: pb.PushRequest, ctx) -> pb.Ack:
         with self._drain_cv:
@@ -286,12 +317,14 @@ class PsShard:
                             "(0.0 would silently discard the update)",
                 )
             t = self.table(req.table)
-            ids = np.asarray(req.ids, np.int64)
+            ids = request_ids(req)
             grads = np.frombuffer(req.grads, np.float32).reshape(
                 len(ids), t.dim)
             t.push(ids, grads, scale=req.scale)
             self._m_pushes.inc(len(ids), shard=self._shard_label,
                                table=req.table)
+            self._m_push_bytes.inc(req.ByteSize() + 2,  # + Ack(ok=True)
+                                   shard=self._shard_label, table=req.table)
             self._m_rows.set(t.rows, shard=self._shard_label, table=req.table)
             return pb.Ack(ok=True)
         finally:
@@ -339,7 +372,8 @@ class PsShard:
         from easydl_tpu.chaos import banner as chaos_banner
 
         chaos_banner(f"ps-{self.shard_index}")
-        self._server = serve(PS_SERVICE, self, port=port)
+        self._server = serve(PS_SERVICE, self, port=port,
+                             options=GRPC_MSG_OPTIONS)
         self._exporter = start_exporter(
             f"ps-{self.shard_index}", workdir=obs_workdir,
             health_fn=lambda: {

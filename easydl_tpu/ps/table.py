@@ -16,6 +16,7 @@ the dense scatter-add gradient semantics of the on-device embedding path
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 from dataclasses import dataclass
 from typing import Tuple
@@ -23,8 +24,14 @@ from typing import Tuple
 import numpy as np
 
 from easydl_tpu.ps import build as _build
+from easydl_tpu.utils.env import env_flag as _env_flag
 
 OPTIMIZERS = {"sgd": 0, "adagrad": 1}
+
+#: Debug/benchmark escape hatch: force the pre-vectorization per-id python
+#: loops in _NumpyStore (the pre-PR hot path). Parity tests compare the two;
+#: scripts/bench_ps.py uses it for honest before/after numbers.
+_STORE_LOOP = "EASYDL_PS_STORE_LOOP"
 
 _SQRT3 = np.float32(1.7320508075688772)
 _U24 = np.float32(1.0 / 16777216.0)
@@ -70,69 +77,171 @@ class _NumpyStore:
 
     One coarse lock stands in for the C++ store's stripe locks: the gRPC
     shard serves pulls/pushes from a thread pool, so the fallback must be
-    just as safe under concurrent workers (it only trades throughput)."""
+    just as safe under concurrent workers (it only trades throughput).
+
+    Rows live in ONE contiguous ``(capacity, row_width)`` float32 array with
+    an id→row-index dict on the side, so pull is a batched gather, push a
+    batched scatter, and the splitmix64 lazy init runs vectorized over all
+    missing ids of a batch at once — the per-id python loop the mutex used
+    to serialize is gone (it was the whole embedding tier's throughput
+    ceiling whenever the C++ store isn't buildable). ``EASYDL_PS_STORE_LOOP``
+    forces the old loop for parity tests and before/after benchmarks; both
+    paths are bit-identical.
+    """
 
     def __init__(self, spec: TableSpec):
         self.spec = spec
-        self._rows: dict = {}
+        self._index: dict = {}  # id -> row index into _data/_ids
+        self._ids = np.zeros(0, np.int64)  # insertion order, first _n valid
+        self._data = np.zeros((0, spec.row_width), np.float32)
+        self._n = 0
         self._mu = threading.Lock()
+        self._loop = _env_flag(_STORE_LOOP, False)
 
-    def _init_row(self, id_: int) -> np.ndarray:
-        base = splitmix64(np.uint64(self.spec.seed) ^ np.uint64(np.int64(id_)))
+    # ----------------------------------------------------------- row init
+    def _init_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Vectorized lazy init for a batch of ids — identical bits to the
+        old one-id-at-a-time loop (same splitmix64 stream per id)."""
+        dim = self.spec.dim
+        base = splitmix64(np.uint64(self.spec.seed) ^ ids.astype(np.uint64))
         with np.errstate(over="ignore"):
-            bits = splitmix64(base + np.arange(self.spec.dim, dtype=np.uint64))
+            bits = splitmix64(
+                base[:, None] + np.arange(dim, dtype=np.uint64)[None, :]
+            )
         u = (bits >> np.uint64(40)).astype(np.float32) * _U24
         a = np.float32(self.spec.init_std) * _SQRT3
-        row = np.zeros(self.spec.row_width, np.float32)
-        row[: self.spec.dim] = (np.float32(2.0) * u - np.float32(1.0)) * a
-        return row
+        rows = np.zeros((len(ids), self.spec.row_width), np.float32)
+        rows[:, :dim] = (np.float32(2.0) * u - np.float32(1.0)) * a
+        return rows
 
-    def _row(self, id_: int) -> np.ndarray:
-        r = self._rows.get(id_)
-        if r is None:
-            r = self._rows[id_] = self._init_row(id_)
-        return r
+    def _init_row(self, id_: int) -> np.ndarray:
+        return self._init_rows(np.asarray([id_], np.int64))[0]
 
+    def _grow(self, extra: int) -> None:
+        need = self._n + extra
+        cap = len(self._data)
+        if need <= cap:
+            return
+        new_cap = max(64, 2 * cap, need)
+        data = np.zeros((new_cap, self.spec.row_width), np.float32)
+        data[: self._n] = self._data[: self._n]
+        ids = np.zeros(new_cap, np.int64)
+        ids[: self._n] = self._ids[: self._n]
+        self._data, self._ids = data, ids
+
+    def _indices(self, ids: np.ndarray, init_missing=None) -> np.ndarray:
+        """Row index per id, materialising missing rows. Caller holds _mu.
+
+        ``init_missing``: None → deterministic lazy init; else a callable
+        ``(missing_ids) -> rows`` (import path supplies the restored rows).
+        """
+        index = self._index
+        idx = np.fromiter(
+            (index.get(i, -1) for i in ids.tolist()), np.int64, len(ids)
+        )
+        miss = idx < 0
+        if miss.any():
+            # A batch may repeat a missing id (duplicate-heavy pushes on the
+            # loop-free path): materialise each missing id once.
+            new_ids = np.unique(ids[miss])
+            rows = (self._init_rows(new_ids) if init_missing is None
+                    else init_missing(new_ids))
+            self._grow(len(new_ids))
+            n = self._n
+            self._data[n: n + len(new_ids)] = rows
+            self._ids[n: n + len(new_ids)] = new_ids
+            index.update(zip(new_ids.tolist(), range(n, n + len(new_ids))))
+            self._n = n + len(new_ids)
+            sub = np.fromiter(
+                (index[i] for i in ids[miss].tolist()), np.int64,
+                int(miss.sum()),
+            )
+            idx[miss] = sub
+        return idx
+
+    # ------------------------------------------------------------ pull/push
     def pull(self, ids: np.ndarray, out: np.ndarray) -> None:
         dim = self.spec.dim
         with self._mu:
-            for i, id_ in enumerate(ids):
-                out[i] = self._row(int(id_))[:dim]
+            if self._loop:
+                for i, id_ in enumerate(ids):
+                    out[i] = self._row_loop(int(id_))[:dim]
+                return
+            idx = self._indices(ids)
+            out[:] = self._data[idx, :dim]
 
     def push(self, ids: np.ndarray, grads: np.ndarray, scale: float) -> None:
         spec = self.spec
-        uniq, inv = np.unique(ids, return_inverse=True)
-        acc = np.zeros((len(uniq), spec.dim), np.float32)
-        np.add.at(acc, inv, grads)
+        dim = spec.dim
+        uniq, first, inv = np.unique(ids, return_index=True,
+                                     return_inverse=True)
+        if len(uniq) == len(ids):
+            # Already deduplicated (the coalescing client's steady state):
+            # skip the np.add.at scatter, just reorder into unique order.
+            acc = np.ascontiguousarray(grads[first])
+        else:
+            acc = np.zeros((len(uniq), dim), np.float32)
+            np.add.at(acc, inv, grads)
         lr, eps = np.float32(spec.lr), np.float32(spec.eps)
         with self._mu:
-            for u, id_ in enumerate(uniq):
-                row = self._row(int(id_))
-                g = acc[u] * np.float32(scale)
-                if spec.optimizer == "adagrad":
-                    slot = row[spec.dim:]
-                    slot += g * g
-                    row[: spec.dim] -= lr * g / (np.sqrt(slot) + eps)
-                else:
-                    row[: spec.dim] -= lr * g
+            if self._loop:
+                self._push_loop(uniq, acc, scale, lr, eps)
+                return
+            idx = self._indices(uniq)
+            g = acc * np.float32(scale)
+            if spec.optimizer == "adagrad":
+                slot = self._data[idx, dim:] + g * g
+                self._data[idx, dim:] = slot
+                self._data[idx, :dim] -= lr * g / (np.sqrt(slot) + eps)
+            else:
+                self._data[idx, :dim] -= lr * g
 
+    # ---------------------------------------------- pre-vectorization path
+    def _row_loop(self, id_: int) -> np.ndarray:
+        j = self._index.get(id_)
+        if j is None:
+            self._grow(1)
+            j = self._n
+            self._data[j] = self._init_row(id_)
+            self._ids[j] = id_
+            self._index[id_] = j
+            self._n += 1
+        return self._data[j]
+
+    def _push_loop(self, uniq, acc, scale, lr, eps) -> None:
+        spec = self.spec
+        for u, id_ in enumerate(uniq):
+            row = self._row_loop(int(id_))
+            g = acc[u] * np.float32(scale)
+            if spec.optimizer == "adagrad":
+                slot = row[spec.dim:]
+                slot += g * g
+                row[: spec.dim] -= lr * g / (np.sqrt(slot) + eps)
+            else:
+                row[: spec.dim] -= lr * g
+
+    # ------------------------------------------------------------- admin
     def size(self) -> int:
         with self._mu:
-            return len(self._rows)
+            return self._n
 
     def export_rows(self) -> Tuple[np.ndarray, np.ndarray]:
         with self._mu:
-            n = len(self._rows)
-            ids = np.fromiter(self._rows.keys(), np.int64, n)
-            rows = np.stack([self._rows[int(i)] for i in ids]) if n else np.zeros(
-                (0, self.spec.row_width), np.float32
-            )
-        return ids, rows
+            return self._ids[: self._n].copy(), self._data[: self._n].copy()
 
     def import_rows(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        ids = np.ascontiguousarray(ids, np.int64)
+        rows = np.ascontiguousarray(rows, np.float32)
         with self._mu:
-            for i, id_ in enumerate(ids):
-                self._rows[int(id_)] = rows[i].astype(np.float32).copy()
+            # Existing ids overwrite in place; new ids append with the
+            # imported bytes (never the lazy init).
+            order = {int(i): k for k, i in enumerate(ids)}  # last dup wins
+            idx = self._indices(
+                ids, init_missing=lambda missing: rows[
+                    [order[int(i)] for i in missing]
+                ],
+            )
+            self._data[idx] = rows
 
 
 class _NativeStore:

@@ -17,6 +17,8 @@ moves the pull/push *inside* the jitted step via
 
 from __future__ import annotations
 
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -40,6 +42,47 @@ from easydl_tpu.ps.table import TableSpec
 from easydl_tpu.utils.logging import get_logger
 
 log = get_logger("ps", "trainer")
+
+
+class AsyncPusher:
+    """Bounded background queue for PS pushes (classic async-PS write-behind).
+
+    A single worker thread preserves push ORDER (the PS optimizer is
+    order-sensitive), the depth bound keeps staleness at most ``depth``
+    steps, and :meth:`drain` is the checkpoint-boundary barrier: once it
+    returns, every submitted push has been acked by the shards — so a
+    ``save``/``drain``/migrate started after a drain sees exactly the same
+    table state a synchronous pusher would have produced. Exceptions from a
+    background push re-raise on the next :meth:`submit` or :meth:`drain`
+    (never silently lost)."""
+
+    def __init__(self, client, depth: int = 2):
+        if depth < 1:
+            raise ValueError("AsyncPusher depth must be >= 1")
+        self._client = client
+        self._depth = depth
+        self._pending: deque = deque()
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ps-push")
+
+    def submit(self, table: str, ids: np.ndarray, grads: np.ndarray,
+               scale: float = 1.0) -> None:
+        while len(self._pending) >= self._depth:
+            self._pending.popleft().result()  # backpressure + error surface
+        self._pending.append(
+            self._pool.submit(self._client.push, table, ids, grads, scale)
+        )
+
+    def drain(self) -> None:
+        """Block until every queued push has been applied (or raised)."""
+        while self._pending:
+            self._pending.popleft().result()
+
+    def close(self) -> None:
+        try:
+            self.drain()
+        finally:
+            self._pool.shutdown(wait=False)
 
 
 def make_ps_model(init_fn: InitFn, loss_fn: LossFn, handle: int,
@@ -84,6 +127,8 @@ class PsTrainer(Trainer):
         ids_key: str = "sparse_ids",
         emb_key: str = "sparse_emb",
         push_scale: float = 1.0,
+        async_push: bool = True,
+        push_queue_depth: int = 2,
     ):
         if config.grad_accum > 1:
             raise ValueError("PsTrainer does not support grad_accum > 1")
@@ -94,7 +139,23 @@ class PsTrainer(Trainer):
         self.ids_key = ids_key
         self.emb_key = emb_key
         self.push_scale = push_scale
+        # async_push governs the pipelined train_steps loop only: pushes
+        # move off the critical path onto a bounded AsyncPusher (depth
+        # `push_queue_depth`), drained at loop exit and via drain_pushes()
+        # before any save/drain/migrate boundary. train_step stays strictly
+        # synchronous (pull -> step -> push) regardless.
+        self.async_push = async_push
+        self.push_queue_depth = push_queue_depth
+        self._pusher: Optional[AsyncPusher] = None
         client.create_table(table)
+
+    def drain_pushes(self) -> None:
+        """Barrier for the async-push queue: returns once every queued push
+        has been applied by the PS tier. MUST run before a PS ``save`` /
+        ``drain`` / migrate that is expected to include this trainer's
+        updates; a no-op when no async pushes are in flight."""
+        if self._pusher is not None:
+            self._pusher.drain()
 
     def _build_step(self):
         compute_dtype = self.config.compute_dtype
@@ -162,15 +223,21 @@ class PsTrainer(Trainer):
     def train_steps(self, state: TrainState, data, n: int,
                     on_metrics=None):
         """Pipelined loop: the NEXT batch's embedding pull overlaps the
-        device step (classic async-PS software pipeline). Pulls may observe
-        one-step-stale rows for ids pushed by the in-flight step — the
-        standard async-PS staleness; use :meth:`train_step` for the strict
-        pull→step→push ordering.
+        device step (classic async-PS software pipeline), and with
+        ``async_push`` (the default) the push leaves the critical path too —
+        a bounded write-behind queue (depth ``push_queue_depth``, order
+        preserved) applies it while the next step computes, and is fully
+        drained before this method returns. Pulls may observe rows up to
+        ``push_queue_depth`` steps stale — the standard async-PS staleness;
+        use :meth:`train_step` for the strict pull→step→push ordering.
         """
-        from concurrent.futures import ThreadPoolExecutor
-
         pool = ThreadPoolExecutor(max_workers=1,
                                   thread_name_prefix="ps-prefetch")
+        pusher = None
+        if self.async_push:
+            pusher = self._pusher = AsyncPusher(
+                self.client, depth=self.push_queue_depth
+            )
 
         def fetch():
             b = next(data)
@@ -187,13 +254,25 @@ class PsTrainer(Trainer):
                 state, metrics, gemb = self.step_fn(
                     state, self.shard_batch(emb), self.shard_batch(rest)
                 )
-                self.client.push(
-                    self.table.name, ids, self._local_rows(gemb),
-                    self.push_scale,
-                )
+                gemb_host = self._local_rows(gemb)
+                if pusher is not None:
+                    pusher.submit(self.table.name, ids, gemb_host,
+                                  self.push_scale)
+                else:
+                    self.client.push(self.table.name, ids, gemb_host,
+                                     self.push_scale)
                 if on_metrics is not None:
                     on_metrics(metrics)
         finally:
             fut.cancel()
             pool.shutdown(wait=False)
+            if pusher is not None:
+                # Drain-before-return IS the checkpoint-boundary contract:
+                # callers save/drain/migrate only after train_steps (or
+                # after drain_pushes()), so the collective-save and PS
+                # handoff semantics are unchanged by async push.
+                try:
+                    pusher.close()
+                finally:
+                    self._pusher = None
         return state, metrics

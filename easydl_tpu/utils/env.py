@@ -6,6 +6,15 @@ import os
 from typing import Dict, Mapping, Optional
 
 
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean EASYDL_* knob convention: unset → ``default``; ``"0"``,
+    ``"false"``/``"False"`` and empty mean off; anything else means on."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v not in ("", "0", "false", "False")
+
+
 def obs_port_from_env(component: str, default: int = 0):
     """Resolve a service's metrics-exporter port from the environment.
 

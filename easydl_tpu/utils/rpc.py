@@ -163,16 +163,30 @@ def _handlers_for(service: ServiceDef, impl: Any) -> grpc.GenericRpcHandler:
     return grpc.method_handlers_generic_handler(service.name, table)
 
 
+#: gRPC's 4 MB default message cap is too small for the PS tier (a single
+#: un-chunked 8192-id pull at dim 128 already exceeds it). The PS client
+#: keeps typical messages ~1 MB via chunking; this is the hard ceiling,
+#: not the operating point. ONLY the PS server/client pass these — the
+#: control plane (master/agent/brain) keeps the 4 MB default so a
+#: misbehaving peer cannot make those processes buffer giant messages.
+GRPC_MSG_OPTIONS = (
+    ("grpc.max_send_message_length", 256 << 20),
+    ("grpc.max_receive_message_length", 256 << 20),
+)
+
+
 def serve(
     service: ServiceDef,
     impl: Any,
     port: int = 0,
     max_workers: int = 16,
     extra: Optional[list] = None,
+    options: Optional[Tuple] = None,
 ) -> Server:
     """Start a server hosting ``service`` (and optionally more
     ``(ServiceDef, impl)`` pairs via ``extra``)."""
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                         options=list(options) if options else None)
     server.add_generic_rpc_handlers((_handlers_for(service, impl),))
     for svc, obj in extra or []:
         server.add_generic_rpc_handlers((_handlers_for(svc, obj),))
@@ -186,11 +200,13 @@ def serve(
 class RpcClient:
     """Typed unary-unary client for a :class:`ServiceDef`."""
 
-    def __init__(self, service: ServiceDef, address: str, timeout: float = 30.0):
+    def __init__(self, service: ServiceDef, address: str,
+                 timeout: float = 30.0, options: Optional[Tuple] = None):
         self._service = service
         self._address = address
         self._timeout = timeout
-        self._channel = grpc.insecure_channel(address)
+        self._channel = grpc.insecure_channel(
+            address, options=list(options) if options else None)
         self._calls: Dict[str, Callable] = {}
         self._lock = threading.Lock()
 

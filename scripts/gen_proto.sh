@@ -1,7 +1,11 @@
 #!/usr/bin/env bash
-# Regenerate protobuf Python code. (No grpc plugin in this image — services are
-# registered at runtime via grpc generic handlers, see easydl_tpu/utils/rpc.py.)
+# Regenerate protobuf Python code. This image ships neither protoc nor
+# grpc_tools, so codegen runs through scripts/proto_compile.py — a
+# pure-python generator whose output is byte-identical to protoc's for the
+# proto3 subset this repo uses (verified against the original protoc output;
+# kept in sync by tests/test_ps_wire.py::test_committed_pb2_in_sync).
+# Services are registered at runtime via grpc generic handlers, see
+# easydl_tpu/utils/rpc.py — no grpc plugin needed.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-protoc --python_out=easydl_tpu/proto -I easydl_tpu/proto easydl_tpu/proto/easydl.proto
-echo "regenerated easydl_tpu/proto/easydl_pb2.py"
+python scripts/proto_compile.py

@@ -1,0 +1,489 @@
+"""PS hot-path overhaul tests: zero-copy wire format + back-compat
+negotiation, coalescing/chunking/async-push bitwise parity against the
+strict pre-PR path, the vectorized store against its per-id loop, the
+empty-pull dim contract, and the bench/proto tooling.
+
+The parity bar is BIT-identical table state — the PR's fast paths are
+re-orderings of the same float ops (client-side accumulation replays the
+server's occurrence-order adds; the vectorized store applies the same
+elementwise updates), so any rounding drift is a bug, not noise.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps import LocalPsClient, PsShard, ShardedPsClient, TableSpec
+from easydl_tpu.ps.table import _NumpyStore
+from easydl_tpu.ps.trainer import AsyncPusher
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spec(**kw):
+    base = dict(name="emb", dim=8, init_std=0.01, seed=7,
+                optimizer="adagrad", lr=0.05)
+    base.update(kw)
+    return TableSpec(**base)
+
+
+def zipf_batches(n_batches=4, batch=300, vocab=500, dim=8, seed=3):
+    """Duplicate-heavy id streams + matching grads."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        ids = (rng.zipf(1.3, batch) % vocab).astype(np.int64)
+        grads = rng.standard_normal((batch, dim)).astype(np.float32)
+        out.append((ids, grads))
+    return out
+
+
+def table_state(client, vocab=500):
+    return client.pull("emb", np.arange(vocab))
+
+
+# ----------------------------------------------------------- proto tooling
+
+
+def test_committed_pb2_in_sync():
+    """gen_proto.sh output must be committed: regenerate via the pure-python
+    generator and byte-compare (no protoc in this image — the generator's
+    output was verified byte-identical to protoc's for the original file)."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import proto_compile
+    finally:
+        sys.path.pop(0)
+    with open(proto_compile.PROTO) as f:
+        generated = proto_compile.generate_pb2(f.read())
+    with open(proto_compile.OUT) as f:
+        committed = f.read()
+    assert committed == generated, \
+        "easydl_pb2.py out of sync with easydl.proto; run scripts/gen_proto.sh"
+
+
+def test_raw_ids_proto_roundtrip():
+    ids = np.array([-5, 0, 2**40, 7], np.int64)
+    req = pb.PullRequest(table="t", raw_ids=ids.astype("<i8").tobytes())
+    back = pb.PullRequest.FromString(req.SerializeToString())
+    np.testing.assert_array_equal(np.frombuffer(back.raw_ids, "<i8"), ids)
+    push = pb.PushRequest(table="t", raw_ids=back.raw_ids, grads=b"",
+                          scale=1.0)
+    assert pb.PushRequest.FromString(
+        push.SerializeToString()).raw_ids == req.raw_ids
+
+
+# ------------------------------------------------- wire-format back-compat
+
+
+class RecordingShard(PsShard):
+    """Records every Pull/Push request for wire-format assertions."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.pull_reqs, self.push_reqs = [], []
+
+    def Pull(self, req, ctx):
+        self.pull_reqs.append(req)
+        return super().Pull(req, ctx)
+
+    def Push(self, req, ctx):
+        self.push_reqs.append(req)
+        return super().Push(req, ctx)
+
+
+class LegacyShard(PsShard):
+    """Pre-PR server behavior: only the varint ids list is understood and
+    the response carries no dtype capability signal."""
+
+    def Pull(self, req, ctx):
+        t = self.table(req.table)
+        ids = np.asarray(req.ids, np.int64)
+        return pb.PullResponse(values=t.pull(ids).tobytes(), dim=t.dim)
+
+    def Push(self, req, ctx):
+        t = self.table(req.table)
+        ids = np.asarray(req.ids, np.int64)
+        grads = np.frombuffer(req.grads, np.float32).reshape(len(ids), t.dim)
+        t.push(ids, grads, scale=req.scale)
+        return pb.Ack(ok=True)
+
+
+def test_new_client_negotiates_raw_ids_with_new_server():
+    shard = RecordingShard(shard_index=0, num_shards=1)
+    server = shard.serve()
+    try:
+        client = ShardedPsClient([server.address])
+        client.create_table(spec())
+        ids = np.arange(20)
+        client.pull("emb", ids)
+        # Capability unknown on the first request: BOTH encodings present,
+        # so even an old server would have answered correctly.
+        first = shard.pull_reqs[0]
+        assert first.raw_ids and list(first.ids) == list(range(20))
+        # The dtype-bearing response confirmed the shard: raw only now.
+        client.pull("emb", ids)
+        client.push("emb", ids, np.ones((20, 8), np.float32), 0.5)
+        assert shard.pull_reqs[1].raw_ids and not shard.pull_reqs[1].ids
+        assert shard.push_reqs[0].raw_ids and not shard.push_reqs[0].ids
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_new_client_against_old_server_bit_matches():
+    """raw_ids-capable client ↔ pre-PR server: the permanent both-fields
+    fallback must produce bit-identical state to a new-server cluster."""
+    legacy, modern = (LegacyShard(shard_index=0, num_shards=1),
+                      PsShard(shard_index=0, num_shards=1))
+    s_old, s_new = legacy.serve(), modern.serve()
+    try:
+        c_old = ShardedPsClient([s_old.address])
+        c_new = ShardedPsClient([s_new.address])
+        for c in (c_old, c_new):
+            c.create_table(spec())
+        for ids, grads in zipf_batches():
+            np.testing.assert_array_equal(c_old.pull("emb", ids),
+                                          c_new.pull("emb", ids))
+            c_old.push("emb", ids, grads, 0.5)
+            c_new.push("emb", ids, grads, 0.5)
+        np.testing.assert_array_equal(table_state(c_old), table_state(c_new))
+        # never-confirmed capability: the legacy list is still being sent
+        assert c_old._raw_capable == [False]
+        assert c_new._raw_capable == [True]
+        c_old.close()
+        c_new.close()
+    finally:
+        s_old.stop()
+        s_new.stop()
+
+
+def test_reroute_to_legacy_replacement_renegotiates(tmp_path):
+    """A shard replacement may run OLDER code: after reroute() the client
+    must re-include the legacy ids list (capability reset + per-attempt
+    request rebuild) — otherwise the pushes the handoff exists to preserve
+    would arrive as zero-id no-ops on the replacement."""
+    modern = PsShard(shard_index=0, num_shards=1)
+    legacy = LegacyShard(shard_index=0, num_shards=1)
+    s_new, s_old = modern.serve(), legacy.serve()
+    try:
+        client = ShardedPsClient([s_new.address])
+        client.create_table(spec(optimizer="sgd", lr=1.0))
+        ids = np.arange(30)
+        client.pull("emb", ids)          # confirms raw capability
+        assert client._raw_capable == [True]
+        # replace-then-retire onto the legacy pod
+        modern.drain(str(tmp_path / "mig"), step=0)
+        legacy.restore(str(tmp_path / "mig"))
+        client.reroute(0, s_old.address)
+        assert client._raw_capable == [False]  # re-negotiation armed
+        before = client.pull("emb", ids).copy()
+        client.push("emb", ids, np.ones((30, 8), np.float32), 1.0)
+        after = client.pull("emb", ids)
+        # sgd lr=1, scale=1: the push really landed on the legacy pod
+        np.testing.assert_allclose(after, before - 1.0, rtol=1e-6)
+        client.close()
+    finally:
+        s_new.stop()
+        s_old.stop()
+
+
+def test_old_client_against_new_server():
+    """Pre-PR client (varint ids, no raw_ids, no value_dtype) ↔ new server:
+    the legacy fields must still drive the full path."""
+    shard = PsShard(shard_index=0, num_shards=1)
+    server = shard.serve()
+    try:
+        # the old client IS the new one with the new wire features disabled
+        client = ShardedPsClient([server.address], coalesce=False,
+                                 raw_ids=False, chunk_bytes=0)
+        client.create_table(spec())
+        ids = np.array([3, 1, 3, 9])
+        ref = PsShard(shard_index=0, num_shards=1)
+        ref.create_table(spec())
+        np.testing.assert_array_equal(client.pull("emb", ids),
+                                      ref.table("emb").pull(ids))
+        g = np.ones((4, 8), np.float32)
+        client.push("emb", ids, g, 0.5)
+        ref.table("emb").push(ids, g, 0.5)
+        np.testing.assert_array_equal(client.pull("emb", ids),
+                                      ref.table("emb").pull(ids))
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_fp16_pull_halves_bytes_within_tolerance():
+    shard = RecordingShard(shard_index=0, num_shards=1)
+    server = shard.serve()
+    try:
+        c16 = ShardedPsClient([server.address], pull_fp16=True)
+        c32 = ShardedPsClient([server.address])
+        c16.create_table(spec())
+        ids = np.arange(50)
+        exact = c32.pull("emb", ids)
+        approx = c16.pull("emb", ids)
+        np.testing.assert_allclose(approx, exact, rtol=1e-2, atol=1e-4)
+        by_dtype = {}
+        for req in shard.pull_reqs:
+            resp = PsShard.Pull(shard, req, None)
+            by_dtype[resp.dtype] = len(resp.values)
+        assert by_dtype["f16"] * 2 == by_dtype["f32"]
+        c16.close()
+        c32.close()
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------ parity paths
+
+
+def test_coalesced_path_bit_matches_strict_local():
+    fast = LocalPsClient(num_shards=3, coalesce=True)
+    strict = LocalPsClient(num_shards=3, coalesce=False)
+    for c in (fast, strict):
+        c.create_table(spec())
+    for ids, grads in zipf_batches():
+        shaped = ids.reshape(30, 10)
+        np.testing.assert_array_equal(fast.pull("emb", shaped),
+                                      strict.pull("emb", shaped))
+        fast.push("emb", shaped, grads.reshape(30, 10, 8), 0.25)
+        strict.push("emb", shaped, grads.reshape(30, 10, 8), 0.25)
+    np.testing.assert_array_equal(table_state(fast), table_state(strict))
+
+
+def test_coalesced_chunked_grpc_bit_matches_strict():
+    """The full optimized wire stack (dedup + raw ids + multi-chunk
+    concurrent transfers) against the strict single-message path."""
+    shards = [PsShard(shard_index=i, num_shards=2) for i in range(2)]
+    servers = [s.serve() for s in shards]
+    try:
+        fast = ShardedPsClient([sv.address for sv in servers],
+                               chunk_bytes=1024)  # force many chunks
+        strict = ShardedPsClient([sv.address for sv in servers],
+                                 coalesce=False, raw_ids=False,
+                                 chunk_bytes=0)
+        fast.create_table(spec())
+        ref = LocalPsClient(num_shards=2, coalesce=False)
+        ref.create_table(spec())
+        for ids, grads in zipf_batches():
+            np.testing.assert_array_equal(fast.pull("emb", ids),
+                                          ref.pull("emb", ids))
+            fast.push("emb", ids, grads, 0.25)
+            ref.push("emb", ids, grads, 0.25)
+        np.testing.assert_array_equal(table_state(fast), table_state(ref))
+        np.testing.assert_array_equal(table_state(strict), table_state(ref))
+        fast.close()
+        strict.close()
+    finally:
+        for sv in servers:
+            sv.stop()
+
+
+def test_vectorized_store_bit_matches_loop():
+    for opt in ("sgd", "adagrad"):
+        sp = spec(optimizer=opt, lr=0.1)
+        vec, loop = _NumpyStore(sp), _NumpyStore(sp)
+        loop._loop = True
+        ids = np.array([5, -3, 5, 2**40, 5, -3, 7], np.int64)
+        grads = np.random.default_rng(0).standard_normal(
+            (len(ids), 8)).astype(np.float32)
+        for store in (vec, loop):
+            out = np.zeros((len(ids), 8), np.float32)
+            store.pull(ids, out)
+            store.push(ids, grads, 0.7)
+        o1 = np.zeros((len(ids), 8), np.float32)
+        o2 = np.zeros((len(ids), 8), np.float32)
+        vec.pull(ids, o1)
+        loop.pull(ids, o2)
+        np.testing.assert_array_equal(o1, o2)
+        # content-equal exports (insertion order may differ)
+        i1, r1 = vec.export_rows()
+        i2, r2 = loop.export_rows()
+        s1, s2 = np.argsort(i1), np.argsort(i2)
+        np.testing.assert_array_equal(i1[s1], i2[s2])
+        np.testing.assert_array_equal(r1[s1], r2[s2])
+
+
+def test_store_import_overwrites_and_appends():
+    sp = spec()
+    a = _NumpyStore(sp)
+    out = np.zeros((3, 8), np.float32)
+    a.pull(np.array([1, 2, 3]), out)  # materialise
+    rows = np.arange(10 * sp.row_width, dtype=np.float32).reshape(10, -1)
+    a.import_rows(np.arange(10), rows)  # ids 1..3 overwrite, rest append
+    got = np.zeros((10, 8), np.float32)
+    a.pull(np.arange(10), got)
+    np.testing.assert_array_equal(got, rows[:, :8])
+    assert a.size() == 10
+
+
+# ------------------------------------------------------------- async push
+
+
+def test_async_push_bit_matches_sync():
+    sync_c = LocalPsClient(num_shards=2)
+    async_c = LocalPsClient(num_shards=2)
+    for c in (sync_c, async_c):
+        c.create_table(spec())
+    pusher = AsyncPusher(async_c, depth=2)
+    for ids, grads in zipf_batches(n_batches=6):
+        sync_c.push("emb", ids, grads, 0.5)
+        pusher.submit("emb", ids, grads, 0.5)
+    pusher.drain()
+    np.testing.assert_array_equal(table_state(sync_c), table_state(async_c))
+    pusher.close()
+
+
+def test_async_push_drains_before_save(tmp_path):
+    """drain() is the checkpoint-boundary barrier: a save after drain must
+    contain every queued push (the collective-save contract)."""
+    client = LocalPsClient(num_shards=1)
+    client.create_table(spec(lr=1.0, optimizer="sgd"))
+    ids = np.arange(40)
+    pusher = AsyncPusher(client, depth=2)
+    for _ in range(5):
+        pusher.submit("emb", ids, np.ones((40, 8), np.float32), 1.0)
+    pusher.drain()
+    client.save(str(tmp_path), step=1)
+    pusher.close()
+    restored = PsShard(shard_index=0, num_shards=1)
+    restored.restore(str(tmp_path))
+    np.testing.assert_array_equal(
+        restored.table("emb").pull(ids), client.pull("emb", ids)
+    )
+
+
+def test_async_push_surfaces_errors():
+    client = LocalPsClient(num_shards=1)
+    client.create_table(spec())
+    pusher = AsyncPusher(client, depth=1)
+    pusher.submit("no_such_table", np.arange(4),
+                  np.ones((4, 8), np.float32), 1.0)
+    with pytest.raises(KeyError):
+        pusher.drain()
+    pusher.close()
+
+
+def test_ps_trainer_drain_pushes_noop_when_idle():
+    import optax
+
+    from easydl_tpu.core.mesh import MeshSpec
+    from easydl_tpu.core.train_loop import TrainConfig
+    from easydl_tpu.models.registry import get_model
+    from easydl_tpu.ps.trainer import PsTrainer
+
+    bundle = get_model("deepfm", vocab=500, dim=8, hidden=(16,),
+                       embedding="ps", num_sparse=3, num_dense=2)
+    trainer = PsTrainer(
+        init_fn=bundle.init_fn, loss_fn=bundle.loss_fn,
+        optimizer=optax.adam(1e-2),
+        config=TrainConfig(global_batch=8),
+        client=LocalPsClient(num_shards=1),
+        table=spec(),
+        mesh_spec=MeshSpec(dp=1),
+    )
+    trainer.drain_pushes()  # no pusher active: must be a silent no-op
+
+
+# ----------------------------------------------------------- shape contract
+
+
+def test_empty_pull_returns_table_dim():
+    local = LocalPsClient(num_shards=2)
+    local.create_table(spec())
+    assert local.pull("emb", np.zeros((4, 0), np.int64)).shape == (4, 0, 8)
+    shard = PsShard(shard_index=0, num_shards=1)
+    server = shard.serve()
+    try:
+        client = ShardedPsClient([server.address])
+        client.create_table(spec())
+        assert client.pull("emb", np.zeros(0, np.int64)).shape == (0, 8)
+        # per-shard empty slices also carry the dim
+        assert client._pull_shard(0, "emb", np.zeros(0, np.int64)
+                                  ).shape == (0, 8)
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_empty_pull_dim_resolved_from_stats_without_create():
+    """A client attached to a pre-existing cluster (no create_table on this
+    client) still learns the dim for empty pulls — via Stats."""
+    shard = PsShard(shard_index=0, num_shards=1)
+    shard.create_table(spec())
+    server = shard.serve()
+    try:
+        client = ShardedPsClient([server.address])
+        assert client.pull("emb", np.zeros(0, np.int64)).shape == (0, 8)
+        client.close()
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------ obs counters
+
+
+def test_wire_byte_counters_and_dedup_gauge():
+    from easydl_tpu.obs import get_registry
+
+    reg = get_registry()
+    pull_c = reg.counter("easydl_ps_pull_bytes_total",
+                         "Wire bytes (request+response) over Pull.",
+                         ("shard", "table"))
+    push_c = reg.counter("easydl_ps_push_bytes_total",
+                         "Wire bytes (request+response) over Push.",
+                         ("shard", "table"))
+    gauge = reg.gauge(
+        "easydl_ps_client_dedup_ratio",
+        "unique/total ids of the last coalesced pull, per table "
+        "(client side; 1.0 = no duplicates in the batch).",
+        ("table",),
+    )
+    shard = PsShard(shard_index=0, num_shards=1)
+    server = shard.serve()
+    try:
+        client = ShardedPsClient([server.address])
+        client.create_table(spec(name="wire_t"))
+        b_pull = pull_c.value(shard="0", table="wire_t")
+        b_push = push_c.value(shard="0", table="wire_t")
+        ids = np.array([1, 1, 1, 2])  # dedup ratio 0.5
+        client.pull("wire_t", ids)
+        client.push("wire_t", ids, np.ones((4, 8), np.float32), 1.0)
+        assert pull_c.value(shard="0", table="wire_t") > b_pull
+        assert push_c.value(shard="0", table="wire_t") > b_push
+        assert gauge.value(table="wire_t") == 0.5
+        client.close()
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------ bench smoke
+
+
+def test_bench_ps_smoke(tmp_path):
+    """The perf path stays exercised by tier-1: the microbenchmark's smoke
+    mode must run end to end (subprocess shard servers included) and emit
+    the JSON shape the BENCH_PS artifact uses."""
+    out = tmp_path / "bench.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_ps.py"),
+         "--smoke", "--streams", "zipf", "--out", str(out)],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(out.read_text())
+    assert doc["config"]["smoke"] is True
+    cell = doc["results"]["sharded"]["zipf"]
+    for mode in ("baseline", "optimized", "optimized_strict"):
+        assert cell[mode]["roundtrips_per_s"] > 0
+        assert cell[mode]["elapsed_s"] > 0
+    assert cell["baseline"]["wire_bytes"] > 0
+    assert 0 < doc["dedup_ratio"]["zipf"] <= 1
+    assert doc["results"]["local"]["zipf"]["optimized"]["roundtrips_per_s"] > 0
