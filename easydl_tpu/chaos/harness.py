@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import sys
 import tempfile
@@ -64,6 +65,15 @@ class Scenario:
     steady_timeout_s: float = 240.0
     done_timeout_s: float = 300.0
     ps_shards: int = 0
+    #: PS push-storm mode (the zero-loss drills): instead of a training
+    #: job, the harness itself drives a deterministic pull/push storm
+    #: against the PS pods and, at the end, proves the surviving tier
+    #: bit-identical to a fault-free in-process reference replay of the
+    #: same stream. Keys: steps, batch, vocab, dim, zipf_a, save_at (batch
+    #: at which a mid-storm ps-ckpt snapshot commits), arm_at (batch at
+    #: which t0 is stamped — the fault offsets count from here, so the
+    #: kill provably lands after the snapshot and mid-storm), pace_s.
+    ps_storm: Optional[Dict[str, Any]] = None
 
     @property
     def name(self) -> str:
@@ -87,6 +97,48 @@ def _write_plan(path: str, schedule: Mapping[str, Any]) -> None:
     os.replace(tmp, path)
 
 
+def _table_digests(directory: str, step: int) -> Dict[str, str]:
+    """Canonical per-table digest of a saved PS tier: every shard's
+    (ids, rows) merged and sorted by id, then hashed over the raw bytes.
+
+    Sorting is what makes the digest compare table STATE, not history: a
+    rescued shard's row arena holds snapshot rows first and replayed rows
+    after, while the fault-free reference inserted in pure stream order —
+    same id→row mapping, different arena order. ``rows`` carries the full
+    row width (embedding + optimizer state), so a match also proves the
+    accumulators replayed bit-identically."""
+    import hashlib
+
+    import numpy as np
+
+    d = os.path.join(directory, f"step_{step:010d}")
+    by_table: Dict[str, list] = {}
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return {}
+    for name in names:
+        m = _SHARD_FILE_RE.match(name)
+        if not m:
+            continue
+        with np.load(os.path.join(d, name)) as z:
+            by_table.setdefault(m.group(1), []).append(
+                (np.asarray(z["ids"]), np.asarray(z["rows"])))
+    out: Dict[str, str] = {}
+    for table, parts in sorted(by_table.items()):
+        ids = np.concatenate([p[0] for p in parts])
+        rows = np.concatenate([p[1] for p in parts])
+        order = np.argsort(ids, kind="stable")
+        h = hashlib.blake2b(digest_size=16)
+        h.update(np.ascontiguousarray(ids[order], "<i8").tobytes())
+        h.update(np.ascontiguousarray(rows[order], "<f4").tobytes())
+        out[table] = f"{len(ids)}:{h.hexdigest()}"
+    return out
+
+
+_SHARD_FILE_RE = re.compile(r"^(.+)\.shard-(\d+)-of-(\d+)\.npz$")
+
+
 class ChaosHarness:
     """Runs one :class:`Scenario`; single-use."""
 
@@ -106,6 +158,11 @@ class ChaosHarness:
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> Dict[str, Any]:
+        if self.scenario.ps_storm is not None:
+            return self._run_ps_storm()
+        return self._run_job()
+
+    def _run_job(self) -> Dict[str, Any]:
         sc = self.scenario
         plan_path = os.path.join(self.workdir, "chaos-plan.json")
         _write_plan(plan_path, self.schedule)
@@ -191,6 +248,315 @@ class ChaosHarness:
             "invariants": verdict,
             "passed": verdict["passed"],
         }
+
+    # ------------------------------------------------------- ps push storm
+    def _run_ps_storm(self) -> Dict[str, Any]:
+        """The zero-loss drills: PS pods only, no training job. The harness
+        drives a deterministic pull/push storm, a scheduled fault kills (or
+        SIGSTOPs) a shard mid-storm, a rescue pod recovers it from snapshot
+        + WAL, and the verdict's evidence is the strongest the subsystem
+        has: the live tier's saved tables are digest-compared against a
+        fault-free in-process replay of the exact same stream."""
+        sc = self.scenario
+        plan_path = os.path.join(self.workdir, "chaos-plan.json")
+        _write_plan(plan_path, self.schedule)
+        env_before = os.environ.get(injectors.ENV_VAR)
+        os.environ[injectors.ENV_VAR] = plan_path
+        # A SIGSTOP'd zombie keeps its listen socket open, so liveness
+        # probes against it only fail by timeout — shrink it or the rescue
+        # pod pays 2×5s per probe (and the drill its multiple).
+        probe_before = os.environ.get("EASYDL_PS_PROBE_TIMEOUT_S")
+        os.environ["EASYDL_PS_PROBE_TIMEOUT_S"] = "1.0"
+        from easydl_tpu.obs import tracing
+
+        trace_before = os.environ.get(tracing.TRACE_ENV)
+        os.environ[tracing.TRACE_ENV] = "1"
+        t_start = time.monotonic()
+        counts_before = injectors.injected_fault_counts()
+        self._zombie: Optional[Dict[str, Any]] = None
+        try:
+            self._launch_ps()
+            evidence = self._drive_push_storm(plan_path)
+        finally:
+            self._teardown()
+            if env_before is None:
+                os.environ.pop(injectors.ENV_VAR, None)
+            else:
+                os.environ[injectors.ENV_VAR] = env_before
+            if probe_before is None:
+                os.environ.pop("EASYDL_PS_PROBE_TIMEOUT_S", None)
+            else:
+                os.environ["EASYDL_PS_PROBE_TIMEOUT_S"] = probe_before
+            if trace_before is None:
+                os.environ.pop(tracing.TRACE_ENV, None)
+            else:
+                os.environ[tracing.TRACE_ENV] = trace_before
+        fault_counts = {
+            kind: count - counts_before.get(kind, 0.0)
+            for kind, count in injectors.injected_fault_counts().items()
+            if count - counts_before.get(kind, 0.0) > 0
+        }
+        verdict = invariants.check_scenario(
+            self.workdir, sc.expect, status={}, fault_counts=fault_counts,
+            outages=self.outages,
+        )
+        _scenario_counter().inc(scenario=sc.name,
+                                result="pass" if verdict["passed"] else "fail")
+        return {
+            "scenario": sc.name,
+            "seed": sc.chaos.seed,
+            "notes": sc.chaos.notes,
+            "workdir": self.workdir,
+            "wall_s": round(time.monotonic() - t_start, 2),
+            "schedule": self.schedule,
+            "expect": dict(sc.expect),
+            "faults_injected": fault_counts,
+            "zero_loss": evidence,
+            "final_status": {},
+            "invariants": verdict,
+            "passed": verdict["passed"],
+        }
+
+    def _drive_push_storm(self, plan_path: str) -> Dict[str, Any]:
+        import numpy as np
+
+        from easydl_tpu.ps.client import LocalPsClient, ShardedPsClient
+        from easydl_tpu.ps.table import TableSpec
+
+        sc = self.scenario
+        storm = dict(sc.ps_storm or {})
+        steps = int(storm.get("steps", 400))
+        batch = int(storm.get("batch", 256))
+        vocab = int(storm.get("vocab", 4000))
+        dim = int(storm.get("dim", 8))
+        zipf_a = float(storm.get("zipf_a", 1.1))
+        save_at = int(storm.get("save_at", steps // 4))
+        arm_at = int(storm.get("arm_at", save_at + steps // 8))
+        pace_s = float(storm.get("pace_s", 0.004))
+        # Both optimizers: adagrad rows carry an accumulator (2×dim), so
+        # digest parity also proves the OPTIMIZER state replayed exactly.
+        specs = [
+            TableSpec(name="storm_adagrad", dim=dim, optimizer="adagrad",
+                      seed=5, lr=0.05),
+            TableSpec(name="storm_sgd", dim=dim, optimizer="sgd",
+                      seed=6, lr=0.05),
+        ]
+        # The whole stream is generated up front from the scenario seed —
+        # the live cluster and the fault-free reference see byte-identical
+        # input, so any digest divergence is the recovery path's fault.
+        rng = np.random.default_rng(int(storm.get("seed", sc.chaos.seed)))
+        stream = []
+        for _ in range(steps):
+            ids = (rng.zipf(zipf_a, batch) % vocab).astype(np.int64)
+            grads = [rng.standard_normal((batch, dim)).astype(np.float32)
+                     for _ in specs]
+            stream.append((ids, grads))
+        client = ShardedPsClient.from_registry(
+            self.workdir, sc.ps_shards, timeout=2.0,
+            drain_retry_s=120.0, transient_retry_s=60.0,
+        )
+        reference = LocalPsClient(num_shards=sc.ps_shards, coalesce=False)
+        events_thread = None
+        try:
+            for spec in specs:
+                client.create_table(spec)
+                reference.create_table(spec)
+            ckpt_dir = os.path.join(self.workdir, "ps-ckpt")
+            for i, (ids, grads) in enumerate(stream):
+                if i == save_at:
+                    # Mid-storm snapshot: retires the WAL segments behind
+                    # it, so the rescue exercises the REAL path — restore
+                    # the snapshot, replay only the surviving tail.
+                    client.save(ckpt_dir, step=i)
+                if i == arm_at:
+                    t0 = time.time()
+                    self.schedule = dict(self.schedule, t0=t0)
+                    _write_plan(plan_path, self.schedule)
+                    log.info("storm %s armed at t0=%.3f (batch %d)",
+                             sc.name, t0, i)
+                    events_thread = threading.Thread(
+                        target=self._execute_process_events, args=(t0,),
+                        daemon=True, name="chaos-storm-events")
+                    events_thread.start()
+                for spec, g in zip(specs, grads):
+                    client.push(spec.name, ids, g, scale=0.125)
+                    reference.push(spec.name, ids, g, scale=0.125)
+                if i % 16 == 0:
+                    # Pulls ride the same outage via the pull retry loop;
+                    # they are exercise, not evidence — the digests are.
+                    client.pull(specs[0].name, ids[:32])
+                time.sleep(pace_s)
+            if events_thread is not None:
+                events_thread.join(timeout=180.0)
+            return self._verify_zero_loss(client, reference, specs)
+        finally:
+            client.close()
+
+    def _verify_zero_loss(self, client, reference, specs) -> Dict[str, Any]:
+        """Build the ``ps-zero-loss.json`` evidence artifact: zombie checks
+        first (the verify save would retire the predecessor's WAL dir),
+        then digest live-vs-reference, then the pods' WAL/fence counters
+        (scraped while their exporters are still up)."""
+        evidence: Dict[str, Any] = {"tables": [s.name for s in specs]}
+        if self._zombie is not None:
+            evidence["zombie"] = dict(self._zombie)
+            evidence["zombie"].update(self._probe_zombie(specs[0]))
+            evidence["zombie"].update(self._zombie_excess_wal_bytes())
+        verify_step = 999999
+        live_dir = os.path.join(self.workdir, "ps-verify-live")
+        ref_dir = os.path.join(self.workdir, "ps-verify-ref")
+        client.save(live_dir, verify_step)
+        reference.save(ref_dir, verify_step)
+        evidence["live_digests"] = _table_digests(live_dir, verify_step)
+        evidence["reference_digests"] = _table_digests(ref_dir, verify_step)
+        evidence["digests_match"] = (
+            bool(evidence["live_digests"])
+            and evidence["live_digests"] == evidence["reference_digests"]
+        )
+        evidence["counters"] = self._scrape_ps_counters()
+        path = os.path.join(self.workdir, "ps-zero-loss.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(evidence, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return evidence
+
+    def _scrape_ps_counters(self) -> Dict[str, float]:
+        from easydl_tpu.obs.scrape import merge_snapshot
+
+        try:
+            merged = merge_snapshot(workdir=self.workdir).get("merged", {})
+        except Exception as e:  # evidence, never a crash
+            log.warning("ps counter scrape failed: %s", e)
+            return {}
+
+        def total(name: str) -> float:
+            return float(sum(v for k, v in merged.items()
+                             if k.startswith(name)))
+
+        return {
+            "wal_appends": total("easydl_ps_wal_appends_total"),
+            "wal_bytes": total("easydl_ps_wal_bytes_total"),
+            "wal_replayed_records": total(
+                "easydl_ps_wal_replayed_records_total"),
+            "wal_deduped_pushes": total("easydl_ps_wal_deduped_pushes_total"),
+            "wal_retired_segments": total(
+                "easydl_ps_wal_retired_segments_total"),
+            "fence_rejected": total("easydl_ps_push_fence_rejected_total"),
+        }
+
+    def _ps_pause_and_rescue(self, shard: int, respawn_after_s: float) -> None:
+        """The zombie-writer variant: SIGSTOP the pod serving ``shard`` (it
+        holds its socket, its registry entry, its claim — it is NOT dead,
+        just silent), level in a rescue pod, and SIGCONT the old process
+        only after the rescuer has published a higher epoch. The resumed
+        zombie must then fence itself on its first push — the drill's
+        post-storm probe proves it."""
+        import signal as _signal
+
+        from easydl_tpu.controller.pod_api import Pod
+        from easydl_tpu.ps import registry as ps_registry
+
+        sc = self.scenario
+        name = f"{sc.name}-ps-{shard}"
+        entry = self._pod_api._procs.get(name)  # harness-only: raw handle
+        if entry is None or entry.proc.poll() is not None:
+            raise RuntimeError(f"ps pod {name} not running")
+        prior = ps_registry.shard_map(self.workdir).get(shard) or {}
+        old_epoch = int(prior.get("epoch", 0))
+        os.kill(entry.proc.pid, _signal.SIGSTOP)
+        injectors.count_fault("ps_pause")
+        log.info("chaos: SIGSTOP ps pod %s (pid %d, epoch %d)",
+                 name, entry.proc.pid, old_epoch)
+        time.sleep(respawn_after_s)
+        self._pod_api.create_pod(Pod(
+            name=f"{sc.name}-ps-rescue-{shard}", job=sc.name,
+            role="parameter_server",
+            command=(
+                f"{sys.executable} -m easydl_tpu.ps"
+                f" --name {sc.name}-ps-rescue-{shard}"
+                f" --workdir {self.workdir} --num-shards {sc.ps_shards}"
+            ),
+        ))
+        _wait_for(
+            lambda: int((ps_registry.shard_map(self.workdir).get(shard)
+                         or {}).get("epoch", 0)) > old_epoch,
+            90.0, f"rescue of shard {shard} to publish a higher epoch",
+        )
+        os.kill(entry.proc.pid, _signal.SIGCONT)
+        self._zombie = {
+            "shard": shard,
+            "pod": name,
+            "pid": entry.proc.pid,
+            "address": str(prior.get("address", "")),
+            "epoch": old_epoch,
+        }
+        log.info("chaos: SIGCONT zombie %s — rescuer epoch %s is live",
+                 name, ps_registry.shard_map(self.workdir)[shard]["epoch"])
+
+    def _probe_zombie(self, spec) -> Dict[str, Any]:
+        """Push directly at the resumed zombie, stamped with ITS OWN old
+        epoch (the worst case: a client that never heard of the rescue).
+        The zombie's registry self-check must reject it without applying —
+        an ok Ack here is a diverged table and fails the drill."""
+        import numpy as np
+
+        from easydl_tpu.proto import easydl_pb2 as pb
+        from easydl_tpu.ps.server import PS_SERVICE, STALE_EPOCH
+        from easydl_tpu.ps.table import shard_of
+        from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, RpcClient
+
+        z = self._zombie or {}
+        ids = np.arange(4096, dtype=np.int64)
+        ids = ids[shard_of(ids, self.scenario.ps_shards)
+                  == int(z.get("shard", 0))][:16]
+        grads = np.ones((len(ids), spec.dim), np.float32)
+        try:
+            cl = RpcClient(PS_SERVICE, z["address"], timeout=10.0,
+                           options=GRPC_MSG_OPTIONS)
+            ack = cl.Push(pb.PushRequest(
+                table=spec.name, raw_ids=ids.astype("<i8").tobytes(),
+                grads=grads.tobytes(), scale=1.0,
+                epoch=int(z.get("epoch", 0)),
+            ))
+            return {
+                "probe_acked_ok": bool(ack.ok),
+                "probe_message": str(ack.message),
+                "probe_rejected_stale_epoch": (
+                    not ack.ok and ack.message.startswith(STALE_EPOCH)),
+            }
+        except Exception as e:
+            # An unreachable zombie rejects nothing — record the failure;
+            # the invariant treats a missing rejection as a violation.
+            return {"probe_acked_ok": False, "probe_error": repr(e),
+                    "probe_rejected_stale_epoch": False}
+
+    def _zombie_excess_wal_bytes(self) -> Dict[str, Any]:
+        """Bytes in the zombie's WAL epoch dir past the rescuer's REPLAYED
+        caps. Any excess is a push the zombie applied AFTER it was
+        superseded — the exact divergence the fence exists to prevent."""
+        from easydl_tpu.ps import wal as ps_wal
+
+        z = self._zombie or {}
+        d = os.path.join(self.workdir, "ps-wal", f"shard-{z.get('shard')}",
+                         f"epoch-{int(z.get('epoch', 0)):06d}")
+        caps = ps_wal.read_replay_caps(d)
+        excess = 0
+        segments = {}
+        try:
+            names = sorted(n for n in os.listdir(d)
+                           if n.startswith("seg-") and n.endswith(".wal"))
+        except OSError:
+            names = []
+        for n in names:
+            size = os.path.getsize(os.path.join(d, n))
+            cap = caps.get(n)
+            over = size if cap is None else max(0, size - cap)
+            segments[n] = {"bytes": size, "replayed_cap": cap,
+                           "excess": over}
+            excess += over
+        return {"excess_wal_bytes": excess, "wal_segments": segments,
+                "replay_caps_found": bool(caps)}
 
     # --------------------------------------------------------------- helpers
     def _launch_ps(self) -> None:
@@ -413,6 +779,9 @@ class ChaosHarness:
             injectors.count_fault(kind)
         elif kind == "ps_kill":
             self._ps_crash_and_rescue(int(target["shard"]),
+                                      float(params.get("respawn_after_s", 0.5)))
+        elif kind == "ps_pause":
+            self._ps_pause_and_rescue(int(target["shard"]),
                                       float(params.get("respawn_after_s", 0.5)))
         elif kind == "corrupt_latest_ckpt":
             self._corrupt_latest_ckpt(str(params.get("mode", "truncate")))
@@ -774,6 +1143,74 @@ def scenario_master_restart_mid_drain(seed: int = 31) -> Scenario:
     )
 
 
+def scenario_ps_shard_crash_zero_loss(seed: int = 37) -> Scenario:
+    """SIGKILL a PS shard mid-push-storm and prove the rescue recovers
+    BIT-IDENTICAL table state — zero lost pushes, not "back to the last
+    snapshot". The harness drives a deterministic Zipf push storm, commits
+    a mid-storm ps-ckpt (so surviving WAL segments cover only the tail —
+    the real rescue shape), kills shard 1 after the snapshot, and at the
+    end digest-compares every table (embedding AND optimizer rows) against
+    a fault-free in-process replay of the same stream. The verdict must
+    also show the rescue actually replayed WAL records — a pass via an
+    empty log would prove nothing."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="ps_shard_crash_zero_loss", seed=seed,
+            notes="SIGKILL ps shard 1 mid-push-storm after a snapshot "
+                  "commit; rescue = restore + WAL replay; verdict = "
+                  "bitwise digest parity vs fault-free reference",
+            faults=(
+                FaultSpec(kind="ps_kill", at_s=0.3, target={"shard": 1},
+                          params={"respawn_after_s": 0.3}),
+            ),
+        ),
+        job_cfg={},
+        ps_shards=2,
+        ps_storm={"steps": 260, "batch": 192, "vocab": 3000, "dim": 8,
+                  "zipf_a": 1.1, "save_at": 80, "arm_at": 120,
+                  "pace_s": 0.004},
+        expect={
+            "ps_zero_loss": True,
+            "min_wal_replays": 1,
+            "min_faults": 1,
+        },
+    )
+
+
+def scenario_ps_zombie_writer(seed: int = 41) -> Scenario:
+    """The partition variant: the shard's pod is SIGSTOPped, not killed —
+    it keeps its socket, registry entry and claim, and wakes up later
+    believing it still owns the shard. A rescue pod levels in and bumps
+    the shard epoch; the resumed zombie must fence itself (reject its
+    first post-resume push via the registry self-check) and apply ZERO
+    stale-epoch pushes — the drill probes it directly with an old-epoch
+    push and measures excess WAL bytes past the rescuer's replay caps.
+    Digest parity against the fault-free reference still holds: the
+    zombie's divergence, had it applied anything, would break it."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="ps_zombie_writer", seed=seed,
+            notes="SIGSTOP ps shard 1 mid-storm; rescue bumps the epoch; "
+                  "SIGCONT the zombie and prove it fenced itself",
+            faults=(
+                FaultSpec(kind="ps_pause", at_s=0.3, target={"shard": 1},
+                          params={"respawn_after_s": 0.3}),
+            ),
+        ),
+        job_cfg={},
+        ps_shards=2,
+        ps_storm={"steps": 260, "batch": 192, "vocab": 3000, "dim": 8,
+                  "zipf_a": 1.1, "save_at": 80, "arm_at": 120,
+                  "pace_s": 0.004},
+        expect={
+            "ps_zero_loss": True,
+            "min_wal_replays": 1,
+            "zombie_fenced": True,
+            "min_faults": 1,
+        },
+    )
+
+
 #: name → builder(seed) for scripts/chaos_run.py and the e2e tests.
 SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "worker_kill": scenario_worker_kill,
@@ -783,6 +1220,8 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "ckpt_corrupt": scenario_ckpt_corrupt,
     "master_crash": scenario_master_crash,
     "master_restart_mid_drain": scenario_master_restart_mid_drain,
+    "ps_shard_crash_zero_loss": scenario_ps_shard_crash_zero_loss,
+    "ps_zombie_writer": scenario_ps_zombie_writer,
 }
 
 #: the cheapest deterministic drill — what scripts/chaos_smoke.sh runs and

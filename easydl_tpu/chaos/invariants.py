@@ -27,6 +27,17 @@ verdicts:
 - ``training_progress_during_outage`` — step records were written INSIDE
   every control-plane outage window: the data plane kept training while the
   master was dead;
+- ``ps_zero_loss_bit_identical`` — after a PS-shard crash + rescue, every
+  table's saved state (embedding AND optimizer rows, all shards merged,
+  id-sorted) digest-matches a fault-free in-process replay of the exact
+  same push stream: the recovery lost NOTHING, not "recovered to the last
+  snapshot";
+- ``ps_wal_replayed`` — the rescue actually consumed WAL records (a
+  zero-loss pass with an empty log would be vacuous: it would only prove
+  the kill landed before any post-snapshot push);
+- ``ps_zombie_fenced`` — the SIGSTOP-resumed predecessor rejected a push
+  stamped with its own superseded epoch AND wrote zero WAL bytes past the
+  rescuer's replay caps: a zombie writer can never diverge the table;
 - ``faults_observed`` (cross-check) — the obs counters saw at least the
   expected number of injected faults, so a "pass" can't come from a drill
   that silently injected nothing.
@@ -324,6 +335,66 @@ def check_scenario(
                 "windows": evidence,
                 "min_steps_during_outage": int(min_outage_steps),
             }
+
+    # ------------------------------------------------------- ps zero loss
+    if expect.get("ps_zero_loss"):
+        evidence: Dict[str, Any] = {}
+        try:
+            with open(os.path.join(workdir, "ps-zero-loss.json")) as f:
+                evidence = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if not evidence:
+            # The drill PROMISED digest evidence; a storm that crashed
+            # before writing it must not pass vacuously.
+            checks["ps_zero_loss_bit_identical"] = {
+                "ok": False,
+                "reason": "no ps-zero-loss.json evidence in the workdir",
+            }
+        else:
+            checks["ps_zero_loss_bit_identical"] = {
+                "ok": bool(evidence.get("digests_match")),
+                "live_digests": evidence.get("live_digests", {}),
+                "reference_digests": evidence.get("reference_digests", {}),
+            }
+            min_replays = expect.get("min_wal_replays")
+            if min_replays is not None:
+                counters = evidence.get("counters", {}) or {}
+                replayed = float(counters.get("wal_replayed_records", 0.0))
+                checks["ps_wal_replayed"] = {
+                    "ok": replayed >= float(min_replays),
+                    "wal_replayed_records": replayed,
+                    "min_wal_replays": float(min_replays),
+                    "counters": counters,
+                }
+            if expect.get("zombie_fenced"):
+                z = evidence.get("zombie") or {}
+                if not z:
+                    checks["ps_zombie_fenced"] = {
+                        "ok": False,
+                        "reason": "no zombie evidence recorded (SIGSTOP "
+                                  "fault never executed?)",
+                    }
+                else:
+                    rejected = bool(z.get("probe_rejected_stale_epoch"))
+                    excess = int(z.get("excess_wal_bytes", -1))
+                    checks["ps_zombie_fenced"] = {
+                        # Both halves: the direct old-epoch probe was
+                        # turned away, AND the zombie's WAL shows no
+                        # append past what the rescuer replayed (no
+                        # stale-epoch push was ever APPLIED — an applied
+                        # push always logs first).
+                        "ok": rejected and excess == 0
+                        and bool(z.get("replay_caps_found")),
+                        "probe_rejected_stale_epoch": rejected,
+                        "probe_message": z.get("probe_message",
+                                               z.get("probe_error", "")),
+                        "excess_wal_bytes": excess,
+                        "replay_caps_found": bool(
+                            z.get("replay_caps_found")),
+                        "zombie": {k: z.get(k) for k in
+                                   ("shard", "pod", "epoch", "address")},
+                    }
 
     # ----------------------------------------------------- faults cross-check
     min_faults = expect.get("min_faults")

@@ -44,7 +44,7 @@ INLINE_KINDS = frozenset({
 })
 #: Kinds the harness executes itself (process-level faults).
 PROCESS_KINDS = frozenset({
-    "worker_kill", "worker_pause", "agent_stop", "ps_kill",
+    "worker_kill", "worker_pause", "agent_stop", "ps_kill", "ps_pause",
     "corrupt_latest_ckpt", "master_crash", "preempt_notice",
 })
 ALL_KINDS = INLINE_KINDS | PROCESS_KINDS

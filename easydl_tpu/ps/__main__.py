@@ -29,7 +29,6 @@ environment the pod backend exports.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import signal
 import sys
@@ -57,9 +56,14 @@ def probe_alive(address: str, timeout: float = 5.0, attempts: int = 2) -> bool:
     Retried: declaring a LIVE shard dead is far worse than a slow rescue —
     a rescue pod would hijack the healthy shard and re-publish it with
     stale checkpoint rows. One slow Stats reply (load, GC pause) must not
-    read as death."""
+    read as death. (Hijack is additionally bounded by the epoch fence now:
+    a wrongly-rescued live shard gets fenced, clients reroute, and its
+    WAL is replayed — but the probe stays conservative.)
+    ``EASYDL_PS_PROBE_TIMEOUT_S`` overrides the per-attempt timeout (chaos
+    drills shrink it so a SIGSTOP'd zombie is declared dead quickly)."""
     from easydl_tpu.proto import easydl_pb2 as pb
 
+    timeout = float(os.environ.get("EASYDL_PS_PROBE_TIMEOUT_S", timeout))
     for attempt in range(attempts):
         client = RpcClient(PS_SERVICE, address, timeout=timeout)
         try:
@@ -73,36 +77,10 @@ def probe_alive(address: str, timeout: float = 5.0, attempts: int = 2) -> bool:
     return False
 
 
-def _locked_claim(path: str, mutate) -> dict:
-    """Read-check-write a claim file atomically under an exclusive flock.
-
-    ``mutate(doc) -> new_doc | None`` runs with the lock held; None leaves
-    the file unchanged. The file's inode is stable (in-place truncate+write,
-    never os.replace), so the flock actually serializes every writer —
-    a rename-based update would silently drop the lock's protection.
-    Returns the doc now in the file. A missing file returns {}."""
-    import fcntl
-
-    try:
-        with open(path, "r+") as f:
-            fcntl.flock(f, fcntl.LOCK_EX)
-            try:
-                try:
-                    doc = json.load(f)
-                except ValueError:
-                    doc = {}  # torn write from a crashed claimant
-                new = mutate(doc)
-                if new is not None:
-                    f.seek(0)
-                    f.truncate()
-                    json.dump(new, f)
-                    f.flush()
-                    os.fsync(f.fileno())
-                return new if new is not None else doc
-            finally:
-                fcntl.flock(f, fcntl.LOCK_UN)
-    except FileNotFoundError:
-        return {}
+#: Read-check-write a claim file atomically under an exclusive flock — the
+#: idiom now lives in registry.py (the epoch counter needed it too); the
+#: old name stays for in-repo callers and tests.
+_locked_claim = registry.locked_mutate
 
 
 def claim_owner(path: str) -> Optional[str]:
@@ -171,6 +149,27 @@ def claim_heartbeat(claim_path: str, pod: str, stop, interval: float) -> None:
             pass
 
 
+def prior_shard_state_exists(workdir: str, shard: int) -> bool:
+    """Is there on-disk PS state a newly-assigned shard must recover
+    instead of starting empty? True when a complete ps-ckpt save exists or
+    the shard's WAL root holds surviving segments. This decides "rescue"
+    independently of a dead registry publication — the startup sweep
+    (registry.sweep_stale) removes dead entries, and a rescue decision
+    that hinged on seeing one would silently skip the restore after a
+    sweep (or on a reused workdir)."""
+    from easydl_tpu.ps import wal as ps_wal
+    from easydl_tpu.ps.server import PsShard
+
+    if PsShard.saved_steps(os.path.join(workdir, "ps-ckpt")):
+        return True
+    root = os.path.join(workdir, "ps-wal", f"shard-{shard}")
+    return any(
+        name.startswith("seg-")
+        for _epoch, d in ps_wal.epoch_dirs(root)
+        for name in os.listdir(d)
+    )
+
+
 def resolve_fresh_shard(workdir: str, pod: str,
                         num_shards: int) -> Tuple[int, bool, Optional[str]]:
     """Decide which shard a fresh (non-replacement) PS pod serves.
@@ -180,7 +179,8 @@ def resolve_fresh_shard(workdir: str, pod: str,
     (reconciler.py), so ``job-parameter_server-2`` may well be the rescue of
     crashed shard 0. The registry decides: a shard whose latest publication
     no longer answers is orphaned, and an orphan outranks the name. Returns
-    (shard index, rescued — a dead prior publication exists, claim path)."""
+    (shard index, rescued — prior shard state must be recovered, claim
+    path)."""
     smap = registry.shard_map(workdir)
     live, dead = set(), set()
     for s, doc in smap.items():
@@ -194,8 +194,13 @@ def resolve_fresh_shard(workdir: str, pod: str,
         # including the in-place restart of our own named shard — must go
         # through the claim below: a same-name restart and a levelled-in
         # fresh pod can race for the same dead shard, and without a claim
-        # both would restore and publish it (round-4 review).
-        return name_idx, False, None
+        # both would restore and publish it (round-4 review). "Nothing
+        # needs rescue" now also requires no recoverable on-disk state:
+        # after the startup sweep a crashed predecessor leaves no dead
+        # entry, only its checkpoint/WAL — which must be restored, not
+        # shadowed by an empty table.
+        if not prior_shard_state_exists(workdir, name_idx):
+            return name_idx, False, None
     orphans = [s for s in range(num_shards) if s not in live]
     # Prefer the name's own shard when it is among the orphans (less churn).
     orphans.sort(key=lambda s: (s != name_idx, s))
@@ -212,7 +217,7 @@ def resolve_fresh_shard(workdir: str, pod: str,
         )
     log.info("pod %s adopting orphaned shard %d (name suggested %s)",
              pod, s, name_idx)
-    return s, s in dead, claim
+    return s, s in dead or prior_shard_state_exists(workdir, s), claim
 
 
 def wait_registry_entry(workdir: str, pod: str, wait_s: float = 60.0) -> dict:
@@ -265,6 +270,13 @@ def main() -> None:
         ap.error("--name and --workdir (or EASYDL_POD_NAME/EASYDL_WORKDIR) "
                  "are required")
 
+    # Registry hygiene first: a crashed pod never retracts its entry, so a
+    # reused workdir accumulates dead publications that rescue discovery
+    # pays a probe timeout for and a rerouting client could briefly adopt.
+    # Rescue-worthiness does NOT depend on the swept entries (see
+    # prior_shard_state_exists); the epoch counters survive the sweep.
+    registry.sweep_stale(args.workdir)
+
     old = None
     rescued, claim_path = False, None
     if args.replaces:
@@ -284,7 +296,23 @@ def main() -> None:
     from easydl_tpu.obs import tracing
 
     tracing.configure(f"ps-{index}", args.workdir)
-    shard = PsShard(shard_index=index, num_shards=num_shards)
+    # Fencing epoch: strictly monotonic per shard, taken by every
+    # incarnation before it serves — pushes stamped with any OTHER epoch
+    # are rejected retriably, and the first evidence of a successor (a
+    # newer stamp, or a newer registry publication) fences this server for
+    # good. The WAL lives under an epoch-named dir so a zombie predecessor
+    # and its rescuer never write to the same segment files.
+    epoch = registry.bump_epoch(args.workdir, index)
+    shard = PsShard(
+        shard_index=index, num_shards=num_shards, epoch=epoch,
+        wal_root=os.path.join(args.workdir, "ps-wal", f"shard-{index}"),
+        workdir=args.workdir,
+        # Only snapshots committing to the rescue lineage may retire WAL
+        # segments (server.save): a save anywhere else — the chaos
+        # harness's verify dumps, ad-hoc Save RPCs — must leave the log
+        # intact or a later failure rescue silently loses those pushes.
+        rescue_dir=os.path.join(args.workdir, "ps-ckpt"),
+    )
     server = shard.serve(port=args.port, obs_workdir=args.workdir)
     log.info("ps pod %s serving shard %d/%d on %s",
              args.name, shard.shard_index, num_shards, server.address)
@@ -300,13 +328,25 @@ def main() -> None:
         hb_thread.start()
 
     if old is not None:
+        # No WAL replay here: the drain snapshot is complete by
+        # construction (the predecessor gated new pushes and exported
+        # under the gate), so every record in its surviving segments is
+        # ALREADY in the restored rows — replaying them would double-
+        # apply. The segments still outlive the handoff (retire_wal=False
+        # on the drain path) for the one reader that does need them: a
+        # failure rescue of THIS replacement before its first ps-ckpt
+        # save, which restores the older ps-ckpt and replays predecessor
+        # + own segments in epoch order.
         run_handoff(old, args.workdir, shard)
     elif rescued:
-        # Failure rescue: the shard's previous server died without a drain,
-        # so recover its rows from the last complete PS checkpoint (workers
-        # save the PS tier alongside dense checkpoints; restore() keeps only
-        # this shard's ids). Updates since that checkpoint are lost — same
-        # bound as the dense state after a crash.
+        # Failure rescue: the shard's previous server died without a drain.
+        # Recover its rows from the last complete PS checkpoint (workers
+        # save the PS tier alongside dense checkpoints; restore() keeps
+        # only this shard's ids) and then REPLAY the surviving WAL segments
+        # on top — every push the dead server acked since that checkpoint,
+        # re-applied through the same store math, so the recovered table is
+        # bit-identical to the pre-crash one (zero lost updates, the bound
+        # the snapshot-only rescue could not give).
         ckpt_dir = os.path.join(args.workdir, "ps-ckpt")
         try:
             step = shard.restore(ckpt_dir)
@@ -314,10 +354,13 @@ def main() -> None:
                      index, ckpt_dir, step)
         except FileNotFoundError:
             log.warning("no complete PS checkpoint under %s; rescued shard "
-                        "%d starts empty", ckpt_dir, index)
+                        "%d starts from its WAL alone", ckpt_dir, index)
         # Last line of defense against hijacking a live shard: the restore
         # took time — if the shard's prior publication answers NOW, the
-        # "dead" verdict was a slow probe, not a death. Stand down.
+        # "dead" verdict was a slow probe, not a death. Stand down. This
+        # MUST precede the WAL replay: replay caps the predecessor's
+        # segments with REPLAYED markers, which would wrongly freeze a
+        # still-living shard's log.
         prior = registry.shard_map(args.workdir).get(index)
         if prior is not None and probe_alive(prior["address"]):
             server.stop()
@@ -325,6 +368,10 @@ def main() -> None:
                 f"shard {index}'s prior server {prior['pod']!r} answers "
                 "again — it was slow, not dead; standing down"
             )
+        stats = shard.replay_wal()
+        if stats["torn"]:
+            log.warning("rescue of shard %d truncated %d torn wal tail(s)",
+                        index, stats["torn"])
 
     if hb_stop is not None:
         hb_stop.set()
@@ -339,7 +386,7 @@ def main() -> None:
                 f"claim on shard {index} taken over by {owner!r}; exiting"
             )
     registry.publish(args.workdir, args.name, shard.shard_index,
-                     num_shards, server.address)
+                     num_shards, server.address, epoch=epoch)
     if claim_path is not None:
         # Close the remaining check-then-publish window: if ownership moved
         # between the check above and our publish, bow out LOUDLY (stop

@@ -34,7 +34,13 @@ import time
 
 from easydl_tpu.obs import get_registry, tracing
 from easydl_tpu.proto import easydl_pb2 as pb
-from easydl_tpu.ps.server import DRAINING, PS_SERVICE, PsShard, spec_to_proto
+from easydl_tpu.ps.server import (
+    DRAINING,
+    PS_SERVICE,
+    STALE_EPOCH,
+    PsShard,
+    spec_to_proto,
+)
 from easydl_tpu.ps.table import TableSpec, shard_of
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.env import env_flag as _env_flag
@@ -386,6 +392,13 @@ class ShardedPsClient(_PsClientBase):
         # Bumped by reroute(): a capability-bearing response only counts if
         # no reroute happened while it was in flight (see _pull_chunk).
         self._reroute_epoch = [0] * self.num_shards
+        # Shard fencing epochs (ps/registry.py): the epoch of the
+        # publication each shard's route came from, stamped on every push.
+        # 0 = unknown (plain-address construction, no registry) — servers
+        # accept unstamped pushes, so nothing changes for registry-less
+        # deployments; with a registry the stamp is what lets a server
+        # reject pushes routed by a superseded publication.
+        self._epochs = [0] * self.num_shards
         self._dims: Dict[str, int] = {}
         self.drain_retry_s = drain_retry_s
         # Bound for transient-UNAVAILABLE retry on the PULL path (pushes
@@ -412,16 +425,24 @@ class ShardedPsClient(_PsClientBase):
         from easydl_tpu.ps import registry
 
         addrs = registry.addresses(workdir, num_shards, timeout=wait_s)
-        return cls(addrs, registry_workdir=workdir, **kwargs)
+        client = cls(addrs, registry_workdir=workdir, **kwargs)
+        smap = registry.shard_map(workdir)
+        client._epochs = [
+            int(smap.get(s, {}).get("epoch", 0)) for s in range(num_shards)
+        ]
+        return client
 
-    def _maybe_reroute_from_registry(self, shard: int) -> bool:
+    def _maybe_reroute_from_registry(self, shard: int,
+                                     force: bool = False) -> bool:
         if not self.registry_workdir:
             return False
         # Throttle: the retry loops call this every ~50ms for the whole
         # drain window; scanning/parsing the registry dir (often network FS)
         # that often is pure waste — publications are seconds apart.
+        # ``force`` bypasses it: a stale-epoch rejection is PROOF the
+        # registry moved, so the refresh must not wait out the throttle.
         now = time.monotonic()
-        if now - self._registry_checked_at < 0.5:
+        if not force and now - self._registry_checked_at < 0.5:
             return False
         self._registry_checked_at = now
         from easydl_tpu.ps import registry
@@ -429,7 +450,8 @@ class ShardedPsClient(_PsClientBase):
         entry = registry.shard_map(self.registry_workdir).get(shard)
         if entry and entry["address"] != self.addresses[shard]:
             try:
-                self.reroute(shard, entry["address"])
+                self.reroute(shard, entry["address"],
+                             epoch=int(entry.get("epoch", 0)))
             except Exception as e:
                 # The published replacement may itself be gone (double
                 # preemption): treat as "no reroute yet" and keep retrying
@@ -438,6 +460,13 @@ class ShardedPsClient(_PsClientBase):
                             shard, entry["address"], e)
                 return False
             return True
+        if entry:
+            # Same address, newer epoch: an in-place re-publication (e.g. a
+            # same-port restart). Adopt the epoch so stamped pushes match.
+            ep = int(entry.get("epoch", 0))
+            if ep and ep != self._epochs[shard]:
+                self._epochs[shard] = ep
+                return True
         return False
 
     def close(self) -> None:
@@ -592,8 +621,12 @@ class ShardedPsClient(_PsClientBase):
             # raw-capability, and the retried push must re-include the
             # legacy ids list in case the replacement runs older code (the
             # grads payload is reused — only the id encoding can change).
+            # The epoch stamp is re-read too: a reroute or a stale-epoch
+            # rejection refreshes it, and the retried push must carry the
+            # successor's epoch to pass its fence.
             return pb.PushRequest(
                 table=table, grads=grads_bytes, scale=scale,
+                epoch=self._epochs[s],
                 **self._wire_ids(s, ids),
             )
 
@@ -609,6 +642,7 @@ class ShardedPsClient(_PsClientBase):
 
     def _push_with_retries(self, s, make_req, deadline, span):
         transport_fails = 0
+        last_ack = ""  # the last retriable Ack.message, for error context
         while True:
             try:
                 # re-read client AND rebuild request: reroute may swap both
@@ -620,15 +654,17 @@ class ShardedPsClient(_PsClientBase):
                 # retired. ONLY those are retriable — a server-side handler
                 # error surfaces as RpcError(UNKNOWN) and must raise now with
                 # its real cause, not stall out the drain window. Re-applying
-                # on retry cannot double-count: during the handoff window the
-                # old shard is gated (DRAINING), so an interrupted call was
-                # never applied.
+                # on retry cannot double-count: during a handoff the old
+                # shard is gated (DRAINING), and across a crash rescue the
+                # WAL-replay dedupe on the rescuer recognises a retried
+                # push it already replayed.
                 if not _is_transport_error(e):
                     raise
                 if time.monotonic() > deadline:
                     raise RuntimeError(
-                        f"ps shard {s} unreachable past "
-                        f"{self.drain_retry_s}s: {e}"
+                        f"ps shard {s} ({self.addresses[s]}) unreachable "
+                        f"past {self.drain_retry_s}s: {e}"
+                        + (f"; last ack: {last_ack!r}" if last_ack else "")
                     ) from e
                 span.add_event("retry", error=repr(e),
                                attempt=transport_fails + 1)
@@ -644,22 +680,35 @@ class ShardedPsClient(_PsClientBase):
             transport_fails = 0
             if ack.ok:
                 return
-            if not ack.message.startswith(DRAINING):
+            retriable_fence = ack.message.startswith(STALE_EPOCH)
+            if not (ack.message.startswith(DRAINING) or retriable_fence):
                 raise RuntimeError(f"ps shard {s} push failed: {ack.message}")
+            last_ack = ack.message
             if time.monotonic() > deadline:
+                # Exhausted the drain/reroute window: name the shard AND
+                # the last Ack so the failure is debuggable from the
+                # message alone — this raise typically surfaces through an
+                # AsyncPusher drain several call frames from the push site.
                 raise RuntimeError(
-                    f"ps shard {s} stayed draining past "
-                    f"{self.drain_retry_s}s; no reroute arrived"
+                    f"ps shard {s} ({self.addresses[s]}) kept rejecting "
+                    f"pushes past {self.drain_retry_s}s with no reroute; "
+                    f"last ack: {last_ack!r}"
                 )
-            span.add_event("draining")
-            self._maybe_reroute_from_registry(s)
+            span.add_event("fence" if retriable_fence else "draining")
+            # A stale-epoch Ack is proof the registry moved on: refresh
+            # immediately (bypass the reroute throttle) so the retried
+            # push carries the successor's route + epoch.
+            self._maybe_reroute_from_registry(s, force=retriable_fence)
             time.sleep(0.05)
 
     # ------------------------------------------------------------- migration
-    def reroute(self, shard: int, address: str) -> None:
+    def reroute(self, shard: int, address: str,
+                epoch: Optional[int] = None) -> None:
         """Point ``shard``'s traffic at a replacement server (handoff step
         3). In-flight draining pushes pick up the new client on their next
-        retry."""
+        retry. ``epoch`` is the replacement publication's fencing epoch
+        (None keeps the current stamp — manual reroutes without a
+        registry)."""
         client = RpcClient(PS_SERVICE, address, timeout=60.0,
                            options=GRPC_MSG_OPTIONS)
         try:
@@ -669,6 +718,8 @@ class ShardedPsClient(_PsClientBase):
             raise
         old, self._clients[shard] = self._clients[shard], client
         self.addresses[shard] = address
+        if epoch is not None:
+            self._epochs[shard] = int(epoch)
         # The replacement may run older code: re-negotiate the raw_ids
         # capability from scratch (one both-fields request, then raw-only).
         # The epoch bump invalidates capability signals from responses

@@ -4,15 +4,26 @@ The operator creates/retires PS pods by *name* (replace-then-retire,
 docs/design/elastic-training-operator.md:86-101) and knows nothing about
 shards; clients route by *shard index*. This registry is the join between
 the two worlds: every PS pod publishes one JSON file
-``<workdir>/ps/ps-<pod>.json`` with its shard index, address and a
-publish timestamp. Readers resolve "who serves shard i" as the LATEST
-publication for that shard — a replacement pod publishes only after it has
-drained its predecessor and restored the rows, so the newest entry is by
-construction the authoritative one.
+``<workdir>/ps/ps-<pod>.json`` with its shard index, address, a publish
+timestamp — and, since the WAL/fencing PR, the shard *epoch* and the
+publishing pid. Readers resolve "who serves shard i" as the
+highest-epoch (then latest) publication for that shard — a replacement
+pod publishes only after it has drained its predecessor and restored the
+rows, so the newest entry is by construction the authoritative one.
 
-Atomic single-file writes (tmp + rename) on a shared workdir; no locks, no
-coordination — the same pattern as the master-address file the agents
-already follow.
+The epoch is the fencing token: a strictly monotonic per-shard counter
+kept in ``epoch-shard-<i>.json`` and advanced under an exclusive flock
+(:func:`bump_epoch`) by every pod that takes the shard over. It survives
+entry sweeps and workdir reuse, so a zombie predecessor can always be
+recognised as superseded — the server rejects pushes whose stamped epoch
+does not match its own (ps/server.py), and fences itself permanently on
+proof of a successor.
+
+Atomic single-file writes (tmp + rename) on a shared workdir for the
+entries; the epoch counter is the one piece that genuinely needs
+read-modify-write, so it reuses the in-place flock idiom of the claim
+files (stable inode — a rename-based update would drop the lock's
+protection).
 """
 
 from __future__ import annotations
@@ -22,7 +33,44 @@ import os
 import time
 from typing import Dict, Optional, Tuple
 
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("ps", "registry")
+
 REG_DIR = "ps"
+
+
+def locked_mutate(path: str, mutate) -> dict:
+    """Read-check-write a JSON doc atomically under an exclusive flock.
+
+    ``mutate(doc) -> new_doc | None`` runs with the lock held; None leaves
+    the file unchanged. The file's inode is stable (in-place truncate +
+    write, never os.replace), so the flock actually serializes every
+    writer. Returns the doc now in the file; a missing file returns {}.
+    Shared by the shard-claim files (ps/__main__.py) and the epoch
+    counter below."""
+    import fcntl
+
+    try:
+        with open(path, "r+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                try:
+                    doc = json.load(f)
+                except ValueError:
+                    doc = {}  # torn write from a crashed claimant
+                new = mutate(doc)
+                if new is not None:
+                    f.seek(0)
+                    f.truncate()
+                    json.dump(new, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                return new if new is not None else doc
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+    except FileNotFoundError:
+        return {}
 
 
 def _dir(workdir: str) -> str:
@@ -30,8 +78,11 @@ def _dir(workdir: str) -> str:
 
 
 def publish(workdir: str, pod: str, shard: int, num_shards: int,
-            address: str) -> str:
-    """Publish/overwrite this pod's registry entry; returns the file path."""
+            address: str, epoch: int = 0) -> str:
+    """Publish/overwrite this pod's registry entry; returns the file path.
+
+    ``epoch`` is the fencing token from :func:`bump_epoch`; 0 means the
+    publisher predates fencing (readers treat it as the lowest epoch)."""
     os.makedirs(_dir(workdir), exist_ok=True)
     path = os.path.join(_dir(workdir), f"ps-{pod}.json")
     doc = {
@@ -39,6 +90,8 @@ def publish(workdir: str, pod: str, shard: int, num_shards: int,
         "shard": int(shard),
         "num_shards": int(num_shards),
         "address": address,
+        "epoch": int(epoch),
+        "pid": os.getpid(),
         "published_at": time.time(),
     }
     tmp = path + ".tmp"
@@ -46,6 +99,79 @@ def publish(workdir: str, pod: str, shard: int, num_shards: int,
         json.dump(doc, f)
     os.replace(tmp, path)
     return path
+
+
+def bump_epoch(workdir: str, shard: int) -> int:
+    """Advance and return the shard's fencing epoch (first call returns 1).
+
+    Strictly monotonic across pod restarts, entry sweeps and workdir reuse:
+    the counter lives in its own flock-serialized file, never in the
+    publications (which are swept when their pod dies). Two pods that both
+    bump get DISTINCT epochs — the claim file decides who may publish, the
+    epoch decides who the servers obey; a wasted bump by a loser is
+    harmless."""
+    d = _dir(workdir)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"epoch-shard-{int(shard)}.json")
+    try:  # O_EXCL create so the first bump has a file to flock
+        os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+    except FileExistsError:
+        pass
+    doc = locked_mutate(
+        path, lambda doc: {"epoch": int(doc.get("epoch", 0)) + 1}
+    )
+    return int(doc["epoch"])
+
+
+def shard_epoch(workdir: str, shard: int) -> int:
+    """Current fencing epoch for a shard (0 = never bumped). Read under the
+    same flock writers hold."""
+    path = os.path.join(_dir(workdir), f"epoch-shard-{int(shard)}.json")
+    return int(locked_mutate(path, lambda doc: None).get("epoch", 0))
+
+
+def sweep_stale(workdir: str) -> int:
+    """Drop publications whose publishing process is dead; returns the
+    number removed.
+
+    Mirrors the obs-exporter discovery sweep (obs/exporter.py): a
+    SIGKILLed pod never retracts its entry, so a reused workdir
+    accumulates dead addresses that rescue discovery must probe (paying a
+    timeout per ghost) and that a client reroute could briefly adopt.
+    Only single-host publications (advertised as ``localhost``) with a
+    recorded pid are swept — a pid check is meaningless for another
+    host's process. Epoch counters and claim files are never touched (the
+    fencing history must survive the sweep)."""
+    removed = 0
+    d = _dir(workdir)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return 0
+    for name in names:
+        if not (name.startswith("ps-") and name.endswith(".json")):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            addr = str(doc.get("address", ""))
+            pid = int(doc.get("pid", 0))
+            if not addr.startswith("localhost:") or pid <= 0:
+                continue
+            if pid == os.getpid():
+                continue
+            os.kill(pid, 0)  # raises ProcessLookupError when dead
+        except ProcessLookupError:
+            try:
+                os.remove(path)
+                removed += 1
+                log.info("swept stale ps publication %s (pid dead)", name)
+            except OSError:
+                pass
+        except (OSError, ValueError, PermissionError):
+            continue  # torn file, or alive-but-not-ours: leave it
+    return removed
 
 
 def entries(workdir: str) -> Dict[str, dict]:
@@ -72,11 +198,15 @@ def entry_for_pod(workdir: str, pod: str) -> Optional[dict]:
 
 
 def shard_map(workdir: str) -> Dict[int, dict]:
-    """shard index -> latest entry (the authoritative server for the shard)."""
+    """shard index -> the authoritative entry for the shard: highest epoch
+    wins (the fencing order), publish time breaks ties among epoch-less
+    legacy entries."""
     latest: Dict[int, dict] = {}
     for doc in entries(workdir).values():
         s = int(doc["shard"])
-        if s not in latest or doc["published_at"] > latest[s]["published_at"]:
+        key = (int(doc.get("epoch", 0)), doc["published_at"])
+        if s not in latest or key > (int(latest[s].get("epoch", 0)),
+                                     latest[s]["published_at"]):
             latest[s] = doc
     return latest
 

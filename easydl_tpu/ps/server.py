@@ -22,13 +22,16 @@ import json
 import os
 import re
 import threading
-from typing import Dict
+import time
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps import wal as _wal
 from easydl_tpu.ps.table import EmbeddingTable, TableSpec, shard_of
+from easydl_tpu.utils.env import env_flag as _env_flag
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, ServiceDef, serve
 
@@ -53,6 +56,18 @@ PS_SERVICE = ServiceDef(
 #: Ack.message prefix that tells clients a push was NOT applied because the
 #: shard is migrating — retry (against the replacement once rerouted).
 DRAINING = "draining"
+
+#: Ack.message prefix for the epoch fence: the push's stamped epoch does not
+#: match the serving shard's (stale client route, or the server itself is a
+#: superseded zombie). Retriable the same way as DRAINING — the client
+#: refreshes its route + epoch from the registry and re-sends.
+STALE_EPOCH = "stale-epoch"
+
+#: How often (seconds) a serving shard re-checks the registry for a
+#: higher-epoch publication of its own shard — the zombie self-fence. A
+#: paused-then-resumed process has always exceeded this by wakeup time, so
+#: its first post-resume push triggers the check before anything is applied.
+ENV_FENCE_CHECK_S = "EASYDL_PS_FENCE_CHECK_S"
 
 
 def request_ids(req) -> np.ndarray:
@@ -95,12 +110,50 @@ class PsShard:
     call — the local client and tests drive it directly.
     """
 
-    def __init__(self, shard_index: int = 0, num_shards: int = 1, backend: str = "auto"):
+    def __init__(self, shard_index: int = 0, num_shards: int = 1,
+                 backend: str = "auto", epoch: int = 0,
+                 wal_root: Optional[str] = None,
+                 workdir: Optional[str] = None,
+                 rescue_dir: Optional[str] = None):
         if not 0 <= shard_index < num_shards:
             raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
         self.shard_index = shard_index
         self.num_shards = num_shards
         self._backend = backend
+        # Fencing: `epoch` is this incarnation's registry epoch (0 = fencing
+        # off — in-process shards and tests). A push stamped with a DIFFERENT
+        # nonzero epoch is rejected retriably; one stamped with a NEWER epoch
+        # additionally proves a successor exists, so the shard fences itself
+        # for good. `workdir` lets the shard also self-check the registry on
+        # a throttle — the path a SIGSTOP'd zombie takes on resume.
+        self.epoch = int(epoch)
+        self._workdir = workdir
+        self._fenced = False
+        self._fence_check_at = 0.0
+        self._fence_check_s = float(os.environ.get(ENV_FENCE_CHECK_S, "0.5"))
+        # Push write-ahead log (ps/wal.py): enabled when the shard has a WAL
+        # root (pod entrypoint wires <workdir>/ps-wal/shard-<i>) and
+        # EASYDL_PS_WAL is not off. `_wal_mu` is the ordering lock: append
+        # order == store-apply order == replay order, and a snapshot's
+        # segment cut is an exact partition of the push stream.
+        self._wal_root = wal_root
+        self._wal: Optional[_wal.PsWal] = None
+        self._wal_mu = threading.Lock()
+        self._replay_digests: set = set()
+        self._replaying = False
+        # `rescue_dir` is the checkpoint dir a failure rescue restores from
+        # (the pod entrypoint wires <workdir>/ps-ckpt). Segment retirement
+        # is gated on it: a snapshot anywhere else (verify dumps, handoff
+        # dirs) is one a rescue never reads, so retiring against it would
+        # delete records no restorable snapshot covers. `_replay_cut` is
+        # the restored snapshot's WAL boundary (epoch, first live segment),
+        # read back by restore() so replay_wal() re-applies exactly the
+        # records the snapshot does NOT contain.
+        self._rescue_dir = rescue_dir
+        self._replay_cut: Optional[Tuple[int, str]] = None
+        if wal_root is not None and _env_flag(_wal.ENV_WAL, True):
+            self._wal = _wal.PsWal(
+                os.path.join(wal_root, f"epoch-{max(self.epoch, 1):06d}"))
         self._tables: Dict[str, EmbeddingTable] = {}
         self._lock = threading.Lock()
         self._server = None
@@ -142,21 +195,61 @@ class PsShard:
         self._m_push_bytes = reg.counter(
             "easydl_ps_push_bytes_total", "Wire bytes (request+response) "
             "over Push.", ("shard", "table"))
+        # WAL + fencing telemetry — the counters the crash-recovery runbook
+        # reads (docs/operations.md §8): appends/bytes say the log is alive,
+        # replays say a rescue actually recovered from it, fence rejections
+        # say the epoch fence turned a zombie or stale route away, dedups
+        # say a retried-after-crash push was recognised instead of applied
+        # twice.
+        self._m_wal_appends = reg.counter(
+            "easydl_ps_wal_appends_total", "Push records appended to the "
+            "shard WAL.", ("shard",))
+        self._m_wal_bytes = reg.counter(
+            "easydl_ps_wal_bytes_total", "Framed bytes appended to the "
+            "shard WAL.", ("shard",))
+        self._m_wal_replayed = reg.counter(
+            "easydl_ps_wal_replayed_records_total", "WAL push records "
+            "replayed into this shard during rescue.", ("shard",))
+        self._m_wal_retired = reg.counter(
+            "easydl_ps_wal_retired_segments_total", "WAL segment files "
+            "retired at snapshot commits.", ("shard",))
+        self._m_wal_deduped = reg.counter(
+            "easydl_ps_wal_deduped_pushes_total", "Retried pushes "
+            "recognised as already applied via WAL replay (acked without "
+            "re-applying).", ("shard",))
+        self._m_fence_rejected = reg.counter(
+            "easydl_ps_push_fence_rejected_total", "Pushes rejected by the "
+            "shard-epoch fence (stale client route or fenced zombie).",
+            ("shard",))
+        self._m_epoch = reg.gauge(
+            "easydl_ps_shard_epoch", "This shard incarnation's fencing "
+            "epoch (0 = fencing off).", ("shard",))
+        self._m_epoch.set(self.epoch, shard=shard_l)
         self._shard_label = shard_l
 
     # ----------------------------------------------------------- table admin
     def create_table(self, spec: TableSpec) -> EmbeddingTable:
-        """Idempotent when the spec matches; error on a conflicting respec."""
-        with self._lock:
-            existing = self._tables.get(spec.name)
-            if existing is not None:
-                if existing.spec != spec:
-                    raise ValueError(
-                        f"table {spec.name!r} exists with different spec"
-                    )
-                return existing
-            t = EmbeddingTable(spec, backend=self._backend)
-            self._tables[spec.name] = t
+        """Idempotent when the spec matches; error on a conflicting respec.
+
+        The WAL ordering lock wraps the insert + create-record append as
+        one unit, so no concurrent push to the new table can land in the
+        log ahead of the record that creates it — replay would otherwise
+        push into a table that does not exist yet. Replay itself must not
+        re-append what it reads (its records stay owned by the
+        predecessor's epoch dir), hence the ``_replaying`` guard."""
+        with self._wal_mu:
+            with self._lock:
+                existing = self._tables.get(spec.name)
+                if existing is not None:
+                    if existing.spec != spec:
+                        raise ValueError(
+                            f"table {spec.name!r} exists with different spec"
+                        )
+                    return existing
+                t = EmbeddingTable(spec, backend=self._backend)
+                self._tables[spec.name] = t
+            if self._wal is not None and not self._replaying:
+                self._wal.append(_wal.encode_create(_spec_json(spec)))
             return t
 
     def table(self, name: str) -> EmbeddingTable:
@@ -167,27 +260,81 @@ class PsShard:
 
     # ------------------------------------------------------------ checkpoint
     def save(self, directory: str, step: int,
-             marker_expected: int | None = None) -> None:
+             marker_expected: int | None = None,
+             retire_wal: bool = True) -> None:
         """``marker_expected`` overrides the completeness count written to
         the done marker (default: the cluster's shard count). A migration
         save (one shard alone in its own directory) passes 1 so the
-        replacement's restore sees it as complete."""
+        replacement's restore sees it as complete.
+
+        WAL interplay: the segment cut and the row export happen under one
+        hold of the ordering lock, so the snapshot contains exactly the
+        pushes in the completed segments — nothing more, nothing less. The
+        cut boundary (this incarnation's epoch + the first post-cut
+        segment) is written into the step dir as a per-shard cut marker,
+        and a rescue that restores this snapshot replays only records past
+        it — so replay correctness never depends on which segments happen
+        to still exist. Retirement is then pure garbage collection, and
+        deliberately conservative: segments (plus predecessor incarnation
+        dirs, whose replayed records are in this state too) are deleted
+        only when the done marker commits a CLUSTER-complete step in the
+        shard's rescue dir — the one snapshot lineage a failure rescue
+        restores from. A torn multi-shard save (a sibling shard died
+        before its marker) or a save to any other directory keeps the log;
+        the next qualifying save sweeps the leftovers (cut() re-lists all
+        completed segments). ``retire_wal=False`` is the drain/handoff
+        path: its snapshot goes to a handoff dir a failure rescue never
+        reads, so the log must outlive it (the replacement's rescue story
+        is ps-ckpt + predecessor segments)."""
         d = os.path.join(directory, f"step_{step:010d}")
         os.makedirs(d, exist_ok=True)
-        for name, t in list(self._tables.items()):
-            ids, rows = t.export_rows()
+        retired_segments: list = []
+        cut_first_live = None
+        if self._wal is not None:
+            with self._wal_mu:
+                retired_segments = self._wal.cut()
+                cut_first_live = os.path.basename(self._wal.path)
+                exports = [(name, t.spec, *t.export_rows())
+                           for name, t in list(self._tables.items())]
+                # A snapshot commit also ends the post-rescue dedupe
+                # window: any applied-but-unacked push a client was going
+                # to retry has long been retried (the reroute storm is
+                # seconds; save cadence is not), and digests kept past
+                # this point could swallow a future, legitimately
+                # byte-identical push.
+                self._replay_digests.clear()
+        else:
+            exports = [(name, t.spec, *t.export_rows())
+                       for name, t in list(self._tables.items())]
+        for name, spec, ids, rows in exports:
             path = os.path.join(
                 d, f"{name}.shard-{self.shard_index}-of-{self.num_shards}.npz"
             )
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:  # file handle: savez won't append .npz
-                np.savez(f, ids=ids, rows=rows, spec=_spec_json(t.spec))
+                np.savez(f, ids=ids, rows=rows, spec=_spec_json(spec))
             os.replace(tmp, path)
+        if cut_first_live is not None:
+            # Cut marker BEFORE the done marker: any restorable step
+            # carries its replay boundary.
+            cut_path = os.path.join(d, self._cut_marker_name())
+            tmp = cut_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"epoch": max(self.epoch, 1),
+                           "first_live_segment": cut_first_live}, f)
+            os.replace(tmp, cut_path)
         # done marker lets restorers skip torn saves; the content records the
         # shard count so completeness = all n markers present.
+        expected = (marker_expected if marker_expected is not None
+                    else self.num_shards)
         with open(os.path.join(d, f".done-{self.shard_index}"), "w") as f:
-            f.write(str(marker_expected if marker_expected is not None
-                        else self.num_shards))
+            f.write(str(expected))
+        if (self._wal is not None and retire_wal
+                and self._covers_rescue(directory)
+                and len(glob.glob(os.path.join(d, ".done-*"))) >= expected):
+            n = _wal.retire_segments(retired_segments, root=self._wal_root,
+                                     before_epoch=self.epoch)
+            self._m_wal_retired.inc(n, shard=self._shard_label)
         log.info("ps shard %d saved %d tables at step %d", self.shard_index,
                  len(self._tables), step)
 
@@ -207,7 +354,31 @@ class PsShard:
             # zero, no new ones can start, so the snapshot is complete.
             while self._inflight_pushes > 0:
                 self._drain_cv.wait(timeout=0.1)
-        self.save(directory, step, marker_expected=1)
+        # retire_wal=False: the drain snapshot lands in a handoff dir that a
+        # failure rescue never looks at, so the WAL must survive — if the
+        # replacement dies before its first ps-ckpt save, the rescue is
+        # ps-ckpt + THESE segments + the replacement's own.
+        self.save(directory, step, marker_expected=1, retire_wal=False)
+
+    def _cut_marker_name(self) -> str:
+        # Shard count in the name: after a reshard the boundary no longer
+        # describes this shard's stream, so restore() simply won't find a
+        # marker and replay falls back to every surviving segment.
+        return (f"wal-cut.shard-{self.shard_index}"
+                f"-of-{self.num_shards}.json")
+
+    def _covers_rescue(self, directory: str) -> bool:
+        """Does a snapshot in ``directory`` land where a failure rescue
+        restores from? Only then may it retire WAL segments. An
+        unconfigured rescue dir (in-process shards, tests) keeps the old
+        behavior: any save retires."""
+        if self._rescue_dir is None:
+            return True
+        try:
+            return os.path.realpath(directory) == \
+                os.path.realpath(self._rescue_dir)
+        except OSError:
+            return False
 
     @staticmethod
     def saved_steps(directory: str):
@@ -241,6 +412,17 @@ class PsShard:
         if step not in steps:
             raise FileNotFoundError(f"no PS checkpoint for step {step}")
         d = os.path.join(directory, f"step_{step:010d}")
+        # The snapshot's WAL cut boundary rides inside the step dir, so it
+        # survives whatever happened to retirement; replay_wal() uses it to
+        # skip every record this snapshot already contains.
+        self._replay_cut = None
+        try:
+            with open(os.path.join(d, self._cut_marker_name())) as f:
+                doc = json.load(f)
+            self._replay_cut = (int(doc["epoch"]),
+                                str(doc["first_live_segment"]))
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
         by_table: Dict[str, list] = {}
         for path in sorted(glob.glob(os.path.join(d, "*.shard-*-of-*.npz"))):
             name = os.path.basename(path).rsplit(".shard-", 1)[0]
@@ -266,6 +448,108 @@ class PsShard:
                  ", ".join(f"{n}:{self._tables[n].rows}" for n in by_table))
         return step
 
+    # ---------------------------------------------------------- wal rescue
+    def replay_wal(self) -> Dict[str, int]:
+        """Replay the surviving predecessor-epoch WAL records the restored
+        snapshot does NOT already contain (its cut marker, read by
+        restore(), is the boundary) — the step that turns "recover to the
+        last snapshot" into "recover bit-identically".
+
+        Records apply through the same vectorized store path as the
+        original pushes (create records recreate tables born after the
+        last snapshot; push records re-apply the exact decoded arguments),
+        per-record checksums are validated and a torn/corrupt tail is
+        truncated (ps/wal.py read_segment). Replayed push digests are kept
+        so a client retrying a push the dead shard applied-but-never-acked
+        is recognised and acked WITHOUT applying twice. Finally the
+        consumed byte offsets are recorded in each predecessor dir, so a
+        zombie's post-rescue appends can never leak into a later rescue.
+        """
+        stats = {"segments": 0, "records": 0, "pushes": 0, "creates": 0,
+                 "ids": 0, "torn": 0, "foreign_ids": 0}
+        if self._wal_root is None:
+            return stats
+        self._replaying = True
+        try:
+            consumed_by_dir: Dict[str, Dict[str, int]] = {}
+            for epoch, path, payloads, consumed, clean in _wal.iter_replay(
+                    self._wal_root, max(self.epoch, 1),
+                    start=self._replay_cut):
+                d, name = os.path.split(path)
+                consumed_by_dir.setdefault(d, {})[name] = consumed
+                stats["segments"] += 1
+                if not clean:
+                    stats["torn"] += 1
+                    log.warning("ps wal %s: torn/corrupt tail truncated at "
+                                "byte %d", path, consumed)
+                for payload in payloads:
+                    stats["records"] += 1
+                    kind = _wal.record_kind(payload)
+                    if kind == _wal.REC_CREATE:
+                        spec = TableSpec(
+                            **json.loads(_wal.decode_create(payload)))
+                        self.create_table(spec)
+                        stats["creates"] += 1
+                    elif kind == _wal.REC_PUSH:
+                        table, ids, grads, scale = _wal.decode_push(payload)
+                        # A shard-count change between incarnations can
+                        # leave foreign ids in old records; apply only ours
+                        # (mirrors restore()'s reshard-on-restore filter).
+                        mine = shard_of(ids, self.num_shards) == \
+                            self.shard_index
+                        if not mine.all():
+                            stats["foreign_ids"] += int((~mine).sum())
+                            ids, grads = ids[mine], grads[mine]
+                        if len(ids):
+                            self.table(table).push(ids, grads, scale=scale)
+                            stats["ids"] += len(ids)
+                        stats["pushes"] += 1
+                        self._replay_digests.add(_wal.push_digest(payload))
+            for d, consumed in consumed_by_dir.items():
+                _wal.write_replay_marker(d, consumed)
+        finally:
+            self._replaying = False
+        self._m_wal_replayed.inc(stats["pushes"], shard=self._shard_label)
+        if stats["records"]:
+            log.info("ps shard %d replayed %d wal records (%d pushes, %d "
+                     "ids, %d torn tails) from %s", self.shard_index,
+                     stats["records"], stats["pushes"], stats["ids"],
+                     stats["torn"], self._wal_root)
+        return stats
+
+    # -------------------------------------------------------------- fencing
+    def _fence(self, why: str) -> None:
+        if not self._fenced:
+            self._fenced = True
+            log.warning("ps shard %d (epoch %d) FENCED: %s — all further "
+                        "pushes rejected retriably", self.shard_index,
+                        self.epoch, why)
+
+    def _check_fence(self, force: bool = False) -> None:
+        """Throttled registry self-check: a higher-epoch publication for
+        our shard proves a successor took over (we are the zombie). A
+        resumed-from-SIGSTOP process always exceeds the throttle, so its
+        first post-resume push pays this check before anything applies.
+        ``force`` skips the throttle — taken when a push arrives stamped
+        with a NEWER epoch than ours: strong evidence of a successor, but
+        the registry stays the only authority that can fence us for good
+        (a bogus client stamp must not disable a healthy shard)."""
+        if self._fenced or not self.epoch or not self._workdir:
+            return
+        now = time.monotonic()
+        if not force and now - self._fence_check_at < self._fence_check_s:
+            return
+        self._fence_check_at = now
+        try:
+            from easydl_tpu.ps import registry as _registry
+
+            entry = _registry.shard_map(self._workdir).get(self.shard_index)
+        except Exception:
+            return  # registry unreadable: fencing stays client-epoch-driven
+        if entry and int(entry.get("epoch", 0)) > self.epoch:
+            self._fence(f"registry shows epoch {entry.get('epoch')} "
+                        f"publication by {entry.get('pod')!r}")
+
     # ---------------------------------------------------------- rpc handlers
     def CreateTable(self, req: pb.TableConfig, ctx) -> pb.Ack:
         try:
@@ -275,6 +559,25 @@ class PsShard:
             return pb.Ack(ok=False, message=str(e))
 
     def Pull(self, req: pb.PullRequest, ctx) -> pb.PullResponse:
+        # A fenced zombie must stop answering READS too: pulls carry no
+        # epoch stamp and never fail on a responsive server, so a reader
+        # pinned to a superseded shard would consume frozen rows forever
+        # while pushes land on the rescuer. Abort with UNAVAILABLE — the
+        # one status the pull retry loop treats as transport loss — so its
+        # per-attempt registry reroute converges on the rescuer (a python
+        # exception would surface as UNKNOWN and kill the pull instead).
+        if self.epoch:
+            self._check_fence()
+            if self._fenced:
+                self._m_fence_rejected.inc(shard=self._shard_label)
+                msg = (f"{STALE_EPOCH}: shard {self.shard_index} epoch "
+                       f"{self.epoch} is fenced (superseded); refresh the "
+                       "route from the registry")
+                if ctx is not None and hasattr(ctx, "abort"):
+                    import grpc
+
+                    ctx.abort(grpc.StatusCode.UNAVAILABLE, msg)
+                raise RuntimeError(msg)
         t = self.table(req.table)
         ids = request_ids(req)
         values = t.pull(ids)
@@ -305,6 +608,36 @@ class PsShard:
                 )
             self._inflight_pushes += 1
         try:
+            # Epoch fence, BEFORE anything applies. Three gates, strictest
+            # first: (1) a push stamped with a NEWER epoch is strong
+            # evidence the registry promoted someone else — it forces an
+            # unthrottled registry check, and the REGISTRY's confirmation
+            # fences permanently (the stamp alone never does: a bogus or
+            # cross-wired client epoch must not disable a healthy shard);
+            # (2) the throttled registry self-check (the path a resumed
+            # zombie takes even when every remaining client is stale);
+            # (3) a plain mismatch — the client's route is stale, reject
+            # retriably so its reroute loop refreshes from the registry.
+            # Unstamped pushes (epoch 0: legacy clients, no registry)
+            # bypass the fence entirely.
+            if self.epoch:
+                self._check_fence(force=req.epoch > self.epoch)
+                if self._fenced:
+                    self._m_fence_rejected.inc(shard=self._shard_label)
+                    return pb.Ack(
+                        ok=False,
+                        message=f"{STALE_EPOCH}: shard {self.shard_index} "
+                                f"epoch {self.epoch} is fenced (superseded); "
+                                "refresh the route from the registry",
+                    )
+                if req.epoch and req.epoch != self.epoch:
+                    self._m_fence_rejected.inc(shard=self._shard_label)
+                    return pb.Ack(
+                        ok=False,
+                        message=f"{STALE_EPOCH}: shard {self.shard_index} "
+                                f"serves epoch {self.epoch}, push stamped "
+                                f"{req.epoch}; refresh the route",
+                    )
             # scale is a proto3 double: an unset field is indistinguishable
             # from an explicit 0.0, and 0.0 would silently no-op every
             # update. It is never a meaningful value, so reject it instead
@@ -320,7 +653,47 @@ class PsShard:
             ids = request_ids(req)
             grads = np.frombuffer(req.grads, np.float32).reshape(
                 len(ids), t.dim)
-            t.push(ids, grads, scale=req.scale)
+            if self._wal is not None:
+                # WAL-then-apply under the ordering lock: log order == apply
+                # order == replay order, and the record hits the OS before
+                # the ack leaves (a SIGKILL can lose in-flight pushes —
+                # which clients retry — but never an acked one). The dedupe
+                # set catches the inverse race: a push the dead predecessor
+                # applied-and-logged whose ack was lost comes back as a
+                # retry; recognising the payload acks it without a second
+                # apply. A WalError deliberately FAILS the push — quietly
+                # continuing without the log would fake the zero-loss
+                # guarantee.
+                payload = _wal.encode_push_parts(req.table, ids, grads,
+                                                 req.scale)
+                with self._wal_mu:
+                    if self._replay_digests:
+                        dg = _wal.push_digest(payload)
+                        if dg in self._replay_digests:
+                            self._replay_digests.discard(dg)
+                            self._m_wal_deduped.inc(shard=self._shard_label)
+                            return pb.Ack(
+                                ok=True,
+                                message="deduped: already applied via wal "
+                                        "replay",
+                            )
+                    try:
+                        n_bytes = self._wal.append(payload)
+                    except _wal.WalError as e:
+                        return pb.Ack(ok=False, message=str(e))
+                    try:
+                        t.push(ids, grads, scale=req.scale)
+                    except Exception:
+                        # The apply never happened and the client sees an
+                        # error, yet the record is durably framed — a later
+                        # rescue would replay an update the acked history
+                        # never contained. Truncate the frame back off.
+                        self._wal.rollback(n_bytes)
+                        raise
+                self._m_wal_appends.inc(shard=self._shard_label)
+                self._m_wal_bytes.inc(n_bytes, shard=self._shard_label)
+            else:
+                t.push(ids, grads, scale=req.scale)
             self._m_pushes.inc(len(ids), shard=self._shard_label,
                                table=req.table)
             self._m_push_bytes.inc(req.ByteSize() + 2,  # + Ack(ok=True)
@@ -356,6 +729,22 @@ class PsShard:
             return pb.Ack(ok=False, message=str(e))
 
     def Stats(self, req: pb.PsStatsRequest, ctx) -> pb.PsStatsResponse:
+        # A fenced (superseded) shard must read as DEAD here: rescue
+        # discovery decides liveness by this very call (probe_alive), and
+        # a fenced zombie that kept answering would be adopted as "live"
+        # after its rescuer dies — permanently blocking the shard's next
+        # rescue while rejecting all traffic. Same abort contract as Pull.
+        if self.epoch:
+            self._check_fence()
+            if self._fenced:
+                msg = (f"{STALE_EPOCH}: shard {self.shard_index} epoch "
+                       f"{self.epoch} is fenced (superseded); refresh the "
+                       "route from the registry")
+                if ctx is not None and hasattr(ctx, "abort"):
+                    import grpc
+
+                    ctx.abort(grpc.StatusCode.UNAVAILABLE, msg)
+                raise RuntimeError(msg)
         resp = pb.PsStatsResponse(
             shard_index=self.shard_index, num_shards=self.num_shards
         )
@@ -381,6 +770,9 @@ class PsShard:
                 "num_shards": self.num_shards,
                 "tables": len(self._tables),
                 "draining": self._draining,
+                "epoch": self.epoch,
+                "fenced": self._fenced,
+                "wal": self._wal is not None,
             },
         )
         log.info("ps shard %d/%d serving on :%d", self.shard_index,
@@ -394,6 +786,9 @@ class PsShard:
         if self._exporter is not None:
             self._exporter.stop()
             self._exporter = None
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
 
 
 def _spec_json(spec: TableSpec) -> str:

@@ -65,12 +65,25 @@ class AsyncPusher:
         self._pool = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="ps-push")
 
+    def _push(self, table: str, ids: np.ndarray, grads: np.ndarray,
+              scale: float) -> None:
+        try:
+            self._client.push(table, ids, grads, scale)
+        except Exception as e:
+            # The raise surfaces on a LATER submit()/drain(), far from the
+            # push site — name the push so the failure is attributable from
+            # the message alone (the chained cause carries the shard id and
+            # last Ack, see ShardedPsClient._push_with_retries).
+            raise RuntimeError(
+                f"async push of table {table!r} ({ids.size} ids) failed: {e}"
+            ) from e
+
     def submit(self, table: str, ids: np.ndarray, grads: np.ndarray,
                scale: float = 1.0) -> None:
         while len(self._pending) >= self._depth:
             self._pending.popleft().result()  # backpressure + error surface
         self._pending.append(
-            self._pool.submit(self._client.push, table, ids, grads, scale)
+            self._pool.submit(self._push, table, ids, grads, scale)
         )
 
     def drain(self) -> None:

@@ -1,0 +1,467 @@
+"""Per-shard push write-ahead log: the durability half of zero-loss rescue.
+
+A PS shard crash used to fall back to the last sparse snapshot, silently
+discarding every push applied since it. The WAL closes that gap: every
+applied push is appended — in the exact order the store applied it — to a
+size-rotated segment file under the shard's WAL directory, and rescue
+(ps/__main__.py) replays surviving segments on top of the restored
+snapshot, reproducing the pre-crash table **bit-identically** (replay goes
+through the same vectorized store math as the original apply).
+
+Layout::
+
+    <workdir>/ps-wal/shard-<i>/            the shard's WAL root
+        epoch-<e>/                         one dir per shard incarnation
+            seg-00000001.wal ...           size-rotated record segments
+            REPLAYED.json                  written by the rescuer: bytes of
+                                           each segment it consumed, so a
+                                           zombie's late appends are never
+                                           replayed by a LATER rescue
+
+Record framing (little-endian): ``u32 payload_len | u32 crc32(payload) |
+payload``. The payload leads with a kind byte — ``0`` = push (table,
+scale, ids, grads: the exact decoded arguments the store applied),
+``1`` = create_table (the spec JSON, so replay can recreate a table born
+after the last snapshot). Readers validate every record's checksum and
+stop at the first bad/short frame — a torn tail from a SIGKILL truncates,
+it never poisons the replay.
+
+Durability contract: records are ``write()``-en to the OS before the push
+is acked (process-crash safe — a SIGKILLed shard loses nothing it acked),
+while ``fsync`` runs on a background cadence (``EASYDL_PS_WAL_SYNC_S``),
+bounding host-crash loss to one sync interval. This mirrors the PR-5
+AsyncPusher discipline: the hot path pays one buffered append, the
+expensive barrier runs behind it, and errors surface on the next append
+rather than vanishing. Segments are retired atomically when a snapshot
+commits (ps/server.py ``save``): once the rows are durably in the
+checkpoint lineage a rescue restores from, the log that produced them is
+dead weight.
+
+Knobs: ``EASYDL_PS_WAL`` (default on for pod-served shards),
+``EASYDL_PS_WAL_SEGMENT_BYTES`` (rotation threshold, default 32 MiB),
+``EASYDL_PS_WAL_SYNC_S`` (fsync cadence, default 0.2s; 0 = fsync every
+append, negative = never fsync).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("ps", "wal")
+
+ENV_WAL = "EASYDL_PS_WAL"
+ENV_SEGMENT_BYTES = "EASYDL_PS_WAL_SEGMENT_BYTES"
+ENV_SYNC_S = "EASYDL_PS_WAL_SYNC_S"
+
+DEFAULT_SEGMENT_BYTES = 32 << 20
+DEFAULT_SYNC_S = 0.2
+
+REC_PUSH = 0
+REC_CREATE = 1
+
+_HEADER = struct.Struct("<II")  # payload_len, crc32(payload)
+_PUSH_HEAD = struct.Struct("<BHdII")  # kind, table_len, scale, n_ids, dim
+
+REPLAYED_MARKER = "REPLAYED.json"
+
+
+class WalError(RuntimeError):
+    """The WAL could not be appended — durability is broken, so the push
+    that triggered it must FAIL (a silent fallback to no-WAL would turn
+    the zero-loss promise into a lie)."""
+
+
+# ------------------------------------------------------------------ encoding
+def encode_push_parts(table: str, ids: np.ndarray, grads: np.ndarray,
+                      scale: float) -> List[bytes]:
+    """Payload for one applied push as scatter-gather parts: the exact
+    arguments the store saw (raw-ids wire form — little-endian int64
+    bytes, float32 grads). Parts, not one buffer: a push on the wire is a
+    few MB, and the hot-path append (:meth:`PsWal.append`) checksums the
+    parts incrementally and hands them to ``os.writev`` — zero joins, zero
+    full-payload copies. ``ids``/``grads`` decoded off the wire are
+    already little-endian contiguous, so the casts below are no-ops
+    there."""
+    tb = table.encode()
+    ids = np.ascontiguousarray(ids, "<i8")
+    grads = np.ascontiguousarray(grads, "<f4")
+    return [
+        _PUSH_HEAD.pack(REC_PUSH, len(tb), float(scale), len(ids),
+                        grads.shape[1] if grads.ndim == 2 else 0),
+        tb,
+        ids.tobytes(),
+        grads.tobytes(),
+    ]
+
+
+def encode_push(table: str, ids: np.ndarray, grads: np.ndarray,
+                scale: float) -> bytes:
+    return b"".join(encode_push_parts(table, ids, grads, scale))
+
+
+def decode_push(payload: bytes) -> Tuple[str, np.ndarray, np.ndarray, float]:
+    kind, tlen, scale, n, dim = _PUSH_HEAD.unpack_from(payload, 0)
+    if kind != REC_PUSH:
+        raise ValueError(f"not a push record (kind={kind})")
+    off = _PUSH_HEAD.size
+    table = payload[off:off + tlen].decode()
+    off += tlen
+    ids = np.frombuffer(payload, "<i8", count=n, offset=off)
+    off += 8 * n
+    grads = np.frombuffer(payload, "<f4", count=n * dim,
+                          offset=off).reshape(n, dim)
+    return table, ids, grads, scale
+
+
+def encode_create(spec_json: str) -> bytes:
+    return bytes((REC_CREATE,)) + spec_json.encode()
+
+
+def decode_create(payload: bytes) -> str:
+    return payload[1:].decode()
+
+
+def record_kind(payload: bytes) -> int:
+    return payload[0] if payload else -1
+
+
+def frame(payload: bytes) -> bytes:
+    return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def push_digest(payload) -> bytes:
+    """Identity of one applied push, for replay-vs-retry dedupe: a client
+    that never saw the ack of a push the dead shard DID apply (and WAL)
+    will retry it verbatim against the rescuer — the rescuer recognises
+    the payload bytes and acks without applying twice. The digest is over
+    the payload only (the stamped epoch is NOT part of it: the retry
+    carries the successor's epoch). Accepts the joined payload or its
+    scatter-gather parts — both digest identically."""
+    h = hashlib.blake2b(digest_size=16)
+    for part in ([payload] if isinstance(payload, bytes) else payload):
+        h.update(part)
+    return h.digest()
+
+
+# ------------------------------------------------------------------- reading
+def read_segment(path: str, limit: Optional[int] = None
+                 ) -> Tuple[List[bytes], int, bool]:
+    """Parse one segment: ``(payloads, bytes_consumed, clean)``.
+
+    Stops at the first short or checksum-failing frame — everything from
+    there on is treated as a torn tail and excluded (``clean`` False).
+    ``limit`` caps the bytes considered (a rescuer's recorded replay
+    offset: appends a zombie made after that rescue must stay invisible
+    to later rescues — they were re-acked by the successor)."""
+    payloads: List[bytes] = []
+    consumed = 0
+    clean = True
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return payloads, 0, False
+    if limit is not None:
+        data = data[:limit]
+    off = 0
+    while off + _HEADER.size <= len(data):
+        length, crc = _HEADER.unpack_from(data, off)
+        start = off + _HEADER.size
+        end = start + length
+        if end > len(data):
+            clean = False  # torn tail: killed mid-append
+            break
+        payload = data[start:end]
+        if zlib.crc32(payload) != crc:
+            clean = False  # corrupt record: stop, never apply past it
+            break
+        payloads.append(payload)
+        consumed = end
+        off = end
+    if off + _HEADER.size > len(data) and off != len(data):
+        clean = False  # trailing partial header
+    return payloads, consumed, clean
+
+
+def _segments(d: str) -> List[str]:
+    try:
+        return sorted(
+            n for n in os.listdir(d)
+            if n.startswith("seg-") and n.endswith(".wal")
+        )
+    except OSError:
+        return []
+
+
+def epoch_dirs(root: str) -> List[Tuple[int, str]]:
+    """``(epoch, path)`` of every incarnation dir under a shard WAL root,
+    epoch-sorted."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for n in names:
+        if n.startswith("epoch-"):
+            try:
+                out.append((int(n[len("epoch-"):]), os.path.join(root, n)))
+            except ValueError:
+                continue
+    out.sort()
+    return out
+
+
+def read_replay_caps(epoch_dir: str) -> Dict[str, int]:
+    """Parse an incarnation dir's ``REPLAYED.json`` consumed-offset caps
+    (empty when absent/unreadable). The one reader of the marker format —
+    replay and the chaos zombie-fence check both go through here, so the
+    schema lives in exactly one place."""
+    try:
+        with open(os.path.join(epoch_dir, REPLAYED_MARKER)) as f:
+            return {str(k): int(v)
+                    for k, v in json.load(f).get("segments", {}).items()}
+    except (OSError, ValueError):
+        return {}
+
+
+def iter_replay(root: str, before_epoch: int,
+                start: Optional[Tuple[int, str]] = None
+                ) -> Iterator[Tuple[int, str, List[bytes], int, bool]]:
+    """Yield ``(epoch, segment_path, payloads, consumed, clean)`` for every
+    segment of every incarnation older than ``before_epoch``, in apply
+    order (epoch, then segment name). Honors a prior rescuer's
+    ``REPLAYED.json`` offsets as hard caps.
+
+    ``start`` is the restored snapshot's cut boundary ``(epoch,
+    first_live_segment)`` (ps/server.py writes it into every step dir):
+    records the snapshot already contains must not replay on top of it.
+    Epochs older than the snapshot writer's are skipped whole — any
+    record of theirs was replayed (or handed off) into the writer's state
+    before it could take a snapshot — and within the writer's epoch only
+    segments at or past the cut replay. Without a boundary every
+    surviving segment replays, which is the pre-cut-marker contract where
+    correctness leaned on retirement alone."""
+    for epoch, d in epoch_dirs(root):
+        if before_epoch and epoch >= before_epoch:
+            continue
+        if start is not None and epoch < start[0]:
+            continue
+        caps = read_replay_caps(d)
+        for name in _segments(d):
+            if start is not None and epoch == start[0] and name < start[1]:
+                continue
+            path = os.path.join(d, name)
+            payloads, consumed, clean = read_segment(path, caps.get(name))
+            yield epoch, path, payloads, consumed, clean
+
+
+def write_replay_marker(epoch_dir: str, consumed: Dict[str, int]) -> None:
+    """Record how far a rescue consumed each segment of a predecessor
+    incarnation, so a zombie predecessor's post-rescue appends (acked by
+    the SUCCESSOR when the client retried them) are never replayed by a
+    later rescue. Merges over an existing marker: a cap, once written,
+    never grows."""
+    path = os.path.join(epoch_dir, REPLAYED_MARKER)
+    merged = dict(consumed)
+    try:
+        with open(path) as f:
+            for k, v in json.load(f).get("segments", {}).items():
+                merged[str(k)] = min(int(v), merged.get(str(k), int(v)))
+    except (OSError, ValueError):
+        pass
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"segments": merged}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# ------------------------------------------------------------------- writing
+class PsWal:
+    """The append side: one open segment, size-rotated, background-fsynced.
+
+    NOT thread-safe by itself — the shard serializes appends (and the
+    append→store-apply pair) under its WAL ordering lock, which is what
+    guarantees file order == apply order == replay order."""
+
+    def __init__(self, epoch_dir: str,
+                 segment_bytes: Optional[int] = None,
+                 sync_s: Optional[float] = None):
+        self.dir = epoch_dir
+        os.makedirs(epoch_dir, exist_ok=True)
+        self.segment_bytes = int(
+            os.environ.get(ENV_SEGMENT_BYTES, DEFAULT_SEGMENT_BYTES)
+            if segment_bytes is None else segment_bytes)
+        self.sync_s = float(
+            os.environ.get(ENV_SYNC_S, DEFAULT_SYNC_S)
+            if sync_s is None else sync_s)
+        existing = _segments(epoch_dir)
+        self._next_index = (int(existing[-1][4:-4]) + 1) if existing else 1
+        self._fd: Optional[int] = None
+        self._size = 0
+        self._path = ""
+        self._dirty = False
+        self._broken: Optional[Exception] = None
+        # Guards fd close/reassign against the background syncer: without
+        # it, cut() closing the segment between the syncer's fd check and
+        # its fsync raises EBADF (or fsyncs an unrelated reused fd) and
+        # permanently bricks the log via _broken.
+        self._fdmu = threading.Lock()
+        self._open_segment()
+        self._stop = threading.Event()
+        self._syncer: Optional[threading.Thread] = None
+        if self.sync_s > 0:
+            self._syncer = threading.Thread(
+                target=self._sync_loop, name="ps-wal-sync", daemon=True)
+            self._syncer.start()
+
+    # ------------------------------------------------------------ internals
+    def _open_segment(self) -> None:
+        self._path = os.path.join(
+            self.dir, f"seg-{self._next_index:08d}.wal")
+        self._next_index += 1
+        self._fd = os.open(self._path,
+                           os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+        self._size = 0
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self.sync_s):
+            try:
+                self.sync()
+            except OSError as e:  # surfaces on the next append
+                self._broken = e
+
+    # ----------------------------------------------------------------- api
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def append(self, payload) -> int:
+        """Frame + write one record; returns the framed byte count. Caller
+        holds the shard's WAL ordering lock. Raises :class:`WalError` if
+        the log is unappendable (the push must then fail — see class
+        docstring).
+
+        Accepts the payload either joined or as scatter-gather parts
+        (:func:`encode_push_parts`): the parts form checksums incrementally
+        and lands via one ``os.writev`` — no joined-buffer copy, which is
+        most of a multi-MB append's cost on the push hot path."""
+        if self._broken is not None:
+            raise WalError(f"ps wal {self.dir} broken: {self._broken}")
+        # Rotate BEFORE the write, not after: the frame just appended is
+        # then always wholly inside the OPEN segment, which is what makes
+        # :meth:`rollback` a plain ftruncate when the store apply it was
+        # logged for fails.
+        if self._size >= self.segment_bytes:
+            self.cut()
+        parts = [payload] if isinstance(payload, bytes) else list(payload)
+        length = sum(len(p) for p in parts)
+        crc = 0
+        for p in parts:
+            crc = zlib.crc32(p, crc)
+        total = _HEADER.size + length
+        try:
+            written = os.writev(self._fd,
+                                [_HEADER.pack(length, crc)] + parts)
+            if written < total:  # partial writev: finish the frame plainly
+                rest = (_HEADER.pack(length, crc)
+                        + b"".join(parts))[written:]
+                while rest:
+                    rest = rest[os.write(self._fd, rest):]
+            if self.sync_s == 0:
+                os.fsync(self._fd)
+        except OSError as e:
+            self._broken = e
+            raise WalError(f"ps wal append to {self._path} failed: {e}")
+        self._size += total
+        self._dirty = True
+        return total
+
+    def rollback(self, n_bytes: int) -> None:
+        """Truncate the last ``n_bytes`` (one just-appended frame) off the
+        open segment: the store apply it logged never happened, and leaving
+        the record would make a rescue replay an update the acked history
+        does not contain. Only valid immediately after the append, under
+        the same ordering lock (append rotates first, so the frame is
+        always in the open segment). A failed truncate marks the log
+        broken — subsequent pushes then fail loudly rather than diverge."""
+        with self._fdmu:
+            if self._fd is None:
+                return
+            self._size = max(0, self._size - n_bytes)
+            try:
+                os.ftruncate(self._fd, self._size)
+            except OSError as e:
+                self._broken = e
+
+    def sync(self) -> None:
+        with self._fdmu:
+            if self._dirty and self._fd is not None:
+                self._dirty = False
+                os.fsync(self._fd)
+
+    def cut(self) -> List[str]:
+        """Close the open segment and start a fresh one; returns the paths
+        of every COMPLETED segment (candidates for retirement once a
+        snapshot covering them commits). Caller holds the ordering lock,
+        so the cut is an exact partition of the record stream."""
+        with self._fdmu:
+            if self._fd is not None:
+                try:
+                    os.fsync(self._fd)
+                except OSError:
+                    pass
+                os.close(self._fd)
+            done = self._path
+            self._open_segment()
+            self._dirty = False
+        older = [os.path.join(self.dir, n) for n in _segments(self.dir)]
+        return [p for p in older if p != self._path and p <= done]
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._syncer is not None:
+            # A still-running syncer (join timeout) is why the fd close
+            # below must also happen under _fdmu.
+            self._syncer.join(timeout=2.0)
+        try:
+            self.sync()
+        except OSError:
+            pass
+        with self._fdmu:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+
+def retire_segments(paths, root: Optional[str] = None,
+                    before_epoch: int = 0) -> int:
+    """Delete retired segment files (and, when ``root``/``before_epoch``
+    name them, whole predecessor incarnation dirs) after a snapshot
+    commit. Every record in them is durably inside the snapshot a rescue
+    would restore, so losing them loses nothing. Returns files removed."""
+    removed = 0
+    for p in paths:
+        try:
+            os.remove(p)
+            removed += 1
+        except OSError:
+            pass
+    if root and before_epoch:
+        import shutil
+
+        for epoch, d in epoch_dirs(root):
+            if epoch < before_epoch:
+                shutil.rmtree(d, ignore_errors=True)
+    return removed

@@ -58,7 +58,9 @@ _SERVE_SHARD = r"""
 import sys, time
 from easydl_tpu.ps.server import PsShard
 idx, n, backend, addr_file, obs_dir = sys.argv[1:6]
-shard = PsShard(shard_index=int(idx), num_shards=int(n), backend=backend)
+wal_root = sys.argv[6] if len(sys.argv) > 6 else ""
+shard = PsShard(shard_index=int(idx), num_shards=int(n), backend=backend,
+                epoch=1 if wal_root else 0, wal_root=wal_root or None)
 server = shard.serve(obs_workdir=obs_dir or None)
 with open(addr_file + ".tmp", "w") as f:
     f.write(server.address)
@@ -82,7 +84,8 @@ def make_stream(kind: str, steps: int, batch: int, vocab: int,
     return out
 
 
-def _spawn_shards(n: int, backend: str, workdir: str, store_loop: bool):
+def _spawn_shards(n: int, backend: str, workdir: str, store_loop: bool,
+                  wal: bool = False):
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     env.pop("EASYDL_PS_STORE_LOOP", None)
     if store_loop:
@@ -91,9 +94,11 @@ def _spawn_shards(n: int, backend: str, workdir: str, store_loop: bool):
     for i in range(n):
         addr_file = os.path.join(workdir, f"shard-{i}.addr")
         addr_files.append(addr_file)
+        wal_root = (os.path.join(workdir, "ps-wal", f"shard-{i}")
+                    if wal else "")
         procs.append(subprocess.Popen(
             [sys.executable, "-c", _SERVE_SHARD, str(i), str(n), backend,
-             addr_file, workdir],
+             addr_file, workdir, wal_root],
             env=env, cwd=REPO,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         ))
@@ -119,6 +124,20 @@ def _scrape_wire_bytes(workdir: str) -> float:
     return sum(v for k, v in merged.items()
                if k.startswith("easydl_ps_pull_bytes_total")
                or k.startswith("easydl_ps_push_bytes_total"))
+
+
+def _scrape_wal_counters(workdir: str) -> dict:
+    from easydl_tpu.obs.scrape import merge_snapshot
+
+    merged = merge_snapshot(workdir=workdir).get("merged", {})
+
+    def total(name: str) -> float:
+        return sum(v for k, v in merged.items() if k.startswith(name))
+
+    return {
+        "appends": int(total("easydl_ps_wal_appends_total")),
+        "bytes": int(total("easydl_ps_wal_bytes_total")),
+    }
 
 
 def _pass(client, stream, grads, scale: float = 0.125,
@@ -157,11 +176,12 @@ def _result(elapsed: float, stream, wire: float) -> dict:
 
 def run_sharded(optimized: bool, stream, dim: int, shards: int,
                 backend: str, fp16: bool = False,
-                async_push: bool = False, repeats: int = 3) -> dict:
+                async_push: bool = False, repeats: int = 3,
+                wal: bool = False) -> dict:
     spec = TableSpec(name=TABLE, dim=dim, optimizer="adagrad", seed=11)
     with tempfile.TemporaryDirectory(prefix="bench_ps_") as workdir:
         procs, addrs = _spawn_shards(shards, backend, workdir,
-                                     store_loop=not optimized)
+                                     store_loop=not optimized, wal=wal)
         client = None
         try:
             client = ShardedPsClient(addrs, coalesce=optimized,
@@ -178,7 +198,10 @@ def run_sharded(optimized: bool, stream, dim: int, shards: int,
             elapsed = min(_pass(client, stream, grads, async_push=async_push)
                           for _ in range(repeats))
             wire = (_scrape_wire_bytes(workdir) - b0) / repeats
-            return _result(elapsed, stream, wire)
+            out = _result(elapsed, stream, wire)
+            if wal:
+                out["wal"] = _scrape_wal_counters(workdir)
+            return out
         finally:
             if client is not None:
                 client.close()
@@ -206,6 +229,76 @@ def run_local(optimized: bool, stream, dim: int, shards: int,
         os.environ.pop("EASYDL_PS_STORE_LOOP", None)
 
 
+def run_wal_mode(args) -> int:
+    """WAL-overhead mode: the full post-PR sharded hot path (coalesced raw
+    wire, chunked transfers, async push) measured with the push WAL off vs
+    on — the only delta is the log append + background fsync on every
+    applied push. When a prior ``BENCH_PS.json`` exists its optimized
+    round-trip rate is folded in as a cross-run reference (same machine,
+    different boot: same-run wal_off is the honest denominator; the
+    reference guards against the wal_off run itself having regressed)."""
+    doc = {
+        "bench": "ps_wal_overhead",
+        "config": {
+            "shards": args.shards, "dim": args.dim, "batch": args.batch,
+            "steps": args.steps, "repeats": args.repeats,
+            "vocab": args.vocab, "zipf_a": args.zipf_a,
+            "backend": args.backend, "smoke": bool(args.smoke),
+        },
+        "machine": {
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+            "numpy": np.__version__,
+        },
+        "results": {},
+    }
+    reference = {}
+    if args.reference:
+        try:
+            with open(args.reference) as f:
+                reference = json.load(f)
+        except (OSError, ValueError):
+            print(f"note: no reference artifact at {args.reference}")
+    for kind in args.streams.split(","):
+        stream = make_stream(kind, args.steps, args.batch, args.vocab,
+                             args.zipf_a)
+        off = run_sharded(True, stream, args.dim, args.shards, args.backend,
+                          async_push=True, repeats=args.repeats)
+        on = run_sharded(True, stream, args.dim, args.shards, args.backend,
+                         async_push=True, repeats=args.repeats, wal=True)
+        cell = {
+            "wal_off": off,
+            "wal_on": on,
+            # overhead = throughput lost to the log, as a fraction
+            "overhead": round(
+                1.0 - on["roundtrips_per_s"] / off["roundtrips_per_s"], 4),
+            "wal_bytes_per_roundtrip": int(
+                on.get("wal", {}).get("bytes", 0) / max(len(stream), 1)
+                / max(args.repeats + 1, 1)),
+        }
+        ref_cell = (reference.get("results", {}).get("sharded", {})
+                    .get(kind, {}).get("optimized"))
+        if ref_cell:
+            cell["reference_roundtrips_per_s"] = ref_cell["roundtrips_per_s"]
+            cell["overhead_vs_reference"] = round(
+                1.0 - on["roundtrips_per_s"] / ref_cell["roundtrips_per_s"],
+                4)
+        doc["results"][kind] = cell
+        line = (f"wal/{kind:<8s} off {off['roundtrips_per_s']:8.1f} rt/s  "
+                f"on {on['roundtrips_per_s']:8.1f} rt/s  "
+                f"overhead {cell['overhead'] * 100:5.1f}%")
+        if ref_cell:
+            line += (f"  vs-ref {cell['overhead_vs_reference'] * 100:5.1f}%"
+                     f" (ref {ref_cell['roundtrips_per_s']:.1f})")
+        print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description="PS pull/push microbenchmark")
     ap.add_argument("--shards", type=int, default=2)
@@ -231,12 +324,23 @@ def main() -> int:
                     help="add an optimized+fp16-pull variant (sharded only)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI-sized: runs in seconds on CPU")
+    ap.add_argument("--wal", action="store_true",
+                    help="WAL-overhead mode: the post-PR sharded hot path "
+                         "with the push write-ahead log OFF vs ON (same "
+                         "stream, same shards); compares against "
+                         "BENCH_PS.json when present. Acceptance: ≤10%% "
+                         "round-trip overhead on the Zipf(1.1) stream.")
+    ap.add_argument("--reference", default=os.path.join(REPO, "BENCH_PS.json"),
+                    help="--wal mode: prior bench artifact to compare "
+                         "against ('' skips)")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
     if args.smoke:
         args.shards, args.dim = 2, 8
         args.batch, args.steps, args.vocab = 1024, 4, 20_000
         args.repeats = 1
+    if args.wal:
+        return run_wal_mode(args)
 
     doc = {
         "bench": "ps_hot_path",

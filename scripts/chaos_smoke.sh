@@ -1,15 +1,20 @@
 #!/usr/bin/env bash
-# Chaos smoke: the two fastest deterministic drills as a single command —
-# worker SIGKILL (data-plane recovery) and master crash/failover
-# (control-plane recovery) — the pre-merge sanity gate for changes that
-# touch the elastic/recovery path. The full catalog (heartbeat loss, RPC
-# burst, PS-shard crash, checkpoint corruption, mid-drain failover) runs via
+# Chaos smoke: the fastest deterministic drills as a single command —
+# worker SIGKILL (data-plane recovery), master crash/failover
+# (control-plane recovery), and the PS zero-loss drill (shard SIGKILL
+# mid-push-storm; rescue must replay the push WAL to bit-identical table
+# state) — the pre-merge sanity gate for changes that touch the
+# elastic/recovery path. The full catalog (heartbeat loss, RPC burst,
+# checkpoint corruption, mid-drain failover, zombie writer) runs via
 #   python scripts/chaos_run.py
 # and as `pytest -m chaos` (the slow-marked e2e tests).
 #
 # After the drills, each kept workdir is folded into a Perfetto trace by
 # scripts/trace_export.py; an empty or unparseable merged trace FAILS the
 # smoke — export rot is caught in-tree, next to the drills that feed it.
+# The zero-loss verdict must additionally record at least one replayed WAL
+# record: a "pass" where the rescue never consumed the log would only
+# prove the kill missed the window, and the smoke refuses to count it.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -17,7 +22,8 @@ LOG=$(mktemp)
 trap 'rm -f "$LOG"' EXIT
 
 env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
-    --scenario worker_kill --scenario master_crash --keep-workdir "$@" \
+    --scenario worker_kill --scenario master_crash \
+    --scenario ps_shard_crash_zero_loss --keep-workdir "$@" \
     2>&1 | tee "$LOG"
 
 # Verdict files from THIS run (chaos_run prints "PASS <name> ... -> <path>").
@@ -25,6 +31,19 @@ VERDICTS=$(awk '/^(PASS|FAIL) .* -> .*\.json$/{print $NF}' "$LOG")
 test -n "$VERDICTS" || { echo "chaos_smoke: no verdicts found" >&2; exit 1; }
 
 for verdict in $VERDICTS; do
+    case "$verdict" in
+    *ps_shard_crash_zero_loss*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+replayed = doc["zero_loss"]["counters"].get("wal_replayed_records", 0)
+assert replayed >= 1, (
+    f"{sys.argv[1]}: zero-loss verdict shows {replayed} WAL records "
+    "replayed — the rescue never exercised the log, the pass is vacuous")
+print(f"zero-loss OK: {int(replayed)} WAL records replayed")
+PY
+        ;;
+    esac
     wd=$(python -c 'import json,sys; print(json.load(open(sys.argv[1]))["workdir"])' "$verdict")
     python scripts/trace_export.py --workdir "$wd" --out "$wd/trace.json"
     python - "$wd/trace.json" <<'PY'

@@ -1,0 +1,756 @@
+"""PS push write-ahead log + shard-epoch fencing tests (zero-loss rescue).
+
+Covers the ISSUE-6 tentpole surface: record framing and torn-tail/checksum
+truncation, segment rotation and snapshot-commit retirement, rescue replay
+bit-parity (snapshot + WAL == never-crashed table, optimizer state
+included), replay-vs-retry dedupe, the epoch fence (stale route rejection,
+zombie self-fencing, proof-of-successor), registry epoch bookkeeping and
+the startup sweep, and the AsyncPusher drain error contract.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps import registry, wal
+from easydl_tpu.ps.client import ShardedPsClient
+from easydl_tpu.ps.server import DRAINING, STALE_EPOCH, PsShard
+from easydl_tpu.ps.table import TableSpec
+from easydl_tpu.ps.trainer import AsyncPusher
+
+
+def spec(**kw):
+    base = dict(name="emb", dim=8, init_std=0.01, seed=7,
+                optimizer="adagrad", lr=0.1)
+    base.update(kw)
+    return TableSpec(**base)
+
+
+def push_req(table, ids, grads, scale=1.0, epoch=0):
+    return pb.PushRequest(
+        table=table, raw_ids=np.ascontiguousarray(ids, "<i8").tobytes(),
+        grads=np.ascontiguousarray(grads, np.float32).tobytes(),
+        scale=scale, epoch=epoch,
+    )
+
+
+def stream(n=6, ids_n=16, dim=8, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.integers(0, 50, ids_n).astype(np.int64),
+         rng.standard_normal((ids_n, dim)).astype(np.float32))
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------- framing
+
+
+def test_push_record_roundtrip():
+    ids = np.array([3, -7, 2**40], np.int64)
+    grads = np.arange(24, dtype=np.float32).reshape(3, 8)
+    payload = wal.encode_push("emb", ids, grads, 0.25)
+    assert wal.record_kind(payload) == wal.REC_PUSH
+    table, rids, rgrads, scale = wal.decode_push(payload)
+    assert table == "emb" and scale == 0.25
+    np.testing.assert_array_equal(rids, ids)
+    np.testing.assert_array_equal(rgrads, grads)
+
+
+def test_create_record_roundtrip():
+    payload = wal.encode_create('{"name": "emb"}')
+    assert wal.record_kind(payload) == wal.REC_CREATE
+    assert wal.decode_create(payload) == '{"name": "emb"}'
+
+
+def test_read_segment_stops_at_torn_tail(tmp_path):
+    seg = str(tmp_path / "seg-00000001.wal")
+    frames = [wal.frame(wal.encode_create(f'{{"n": {i}}}')) for i in range(3)]
+    with open(seg, "wb") as f:
+        f.write(b"".join(frames))
+        f.write(frames[0][: len(frames[0]) // 2])  # killed mid-append
+    payloads, consumed, clean = wal.read_segment(seg)
+    assert len(payloads) == 3 and not clean
+    assert consumed == sum(len(fr) for fr in frames)
+
+
+def test_read_segment_stops_at_checksum_mismatch(tmp_path):
+    seg = str(tmp_path / "seg-00000001.wal")
+    frames = [wal.frame(wal.encode_create(f'{{"n": {i}}}')) for i in range(3)]
+    data = bytearray(b"".join(frames))
+    # flip one payload byte of the SECOND record: its crc fails and nothing
+    # at or past it may ever be applied
+    off = len(frames[0]) + struct.calcsize("<II") + 2
+    data[off] ^= 0xFF
+    with open(seg, "wb") as f:
+        f.write(bytes(data))
+    payloads, consumed, clean = wal.read_segment(seg)
+    assert len(payloads) == 1 and not clean
+    assert consumed == len(frames[0])
+    assert wal.decode_create(payloads[0]) == '{"n": 0}'
+
+
+def test_read_segment_respects_replay_cap(tmp_path):
+    seg = str(tmp_path / "seg-00000001.wal")
+    frames = [wal.frame(wal.encode_create(f'{{"n": {i}}}')) for i in range(3)]
+    with open(seg, "wb") as f:
+        f.write(b"".join(frames))
+    cap = len(frames[0]) + len(frames[1])
+    payloads, consumed, _clean = wal.read_segment(seg, limit=cap)
+    assert len(payloads) == 2 and consumed == cap
+
+
+def test_wal_rotates_segments(tmp_path):
+    w = wal.PsWal(str(tmp_path), segment_bytes=64, sync_s=-1)
+    for i in range(5):
+        w.append(wal.encode_create(json.dumps({"n": i, "pad": "x" * 40})))
+    w.close()
+    segs = sorted(p for p in os.listdir(tmp_path) if p.endswith(".wal"))
+    assert len(segs) >= 5  # 64-byte threshold: every append rotates
+    got = [
+        wal.decode_create(p)
+        for s in segs
+        for p in wal.read_segment(str(tmp_path / s))[0]
+    ]
+    assert [json.loads(g)["n"] for g in got] == list(range(5))
+
+
+def test_wal_rollback_truncates_last_frame(tmp_path):
+    w = wal.PsWal(str(tmp_path), sync_s=-1)
+    w.append(wal.encode_create('{"n": 1}'))
+    n = w.append(wal.encode_create('{"n": 2}'))
+    w.rollback(n)
+    w.append(wal.encode_create('{"n": 3}'))
+    w.close()
+    payloads, _consumed, clean = wal.read_segment(w.path)
+    assert clean
+    assert [json.loads(wal.decode_create(p))["n"] for p in payloads] == [1, 3]
+
+
+def test_failed_store_apply_rolls_back_wal_record(tmp_path, monkeypatch):
+    """WAL-then-apply with the apply raising: the client saw an error, so
+    the durably framed record must come back OFF the log — a rescue
+    replaying it would recover a table the acked history never produced.
+    The log must stay appendable afterwards (the rollback is a truncate,
+    not a brick)."""
+    shard = PsShard(epoch=1, wal_root=wal_root(tmp_path))
+    shard.create_table(spec())
+    ids, grads = np.arange(4), np.ones((4, 8), np.float32)
+    assert shard.Push(push_req("emb", ids, grads), None).ok
+
+    t = shard.table("emb")
+    real_push = t.push
+    monkeypatch.setattr(
+        t, "push",
+        lambda *a, **kw: (_ for _ in ()).throw(MemoryError("arena growth")))
+    with pytest.raises(MemoryError):
+        shard.Push(push_req("emb", ids, 2 * grads), None)
+    monkeypatch.setattr(t, "push", real_push)
+    assert shard.Push(push_req("emb", ids, 3 * grads), None).ok
+    shard._wal.sync()  # crash: no close, just stop using it
+
+    rescuer = PsShard(epoch=2, wal_root=wal_root(tmp_path))
+    rescuer.replay_wal()
+    reference = PsShard()
+    reference.create_table(spec())
+    reference.table("emb").push(ids, grads)
+    reference.table("emb").push(ids, 3 * grads)
+    np.testing.assert_array_equal(rescuer.table("emb").pull(ids),
+                                  reference.table("emb").pull(ids))
+
+
+def test_wal_broken_append_raises_wal_error(tmp_path):
+    w = wal.PsWal(str(tmp_path), sync_s=-1)
+    os.close(w._fd)  # simulate the volume dying under the log
+    w._fd = os.open("/dev/full", os.O_WRONLY)
+    with pytest.raises(wal.WalError):
+        w.append(b"x" * 64)
+    with pytest.raises(wal.WalError):  # stays broken: durability is gone
+        w.append(b"y")
+
+
+# ------------------------------------------------------------ rescue replay
+
+
+def wal_root(tmp_path, shard=0):
+    return str(tmp_path / "ps-wal" / f"shard-{shard}")
+
+
+def test_rescue_replay_is_bit_identical(tmp_path):
+    """Snapshot mid-stream + crash + replay == the table that never died —
+    embedding AND adagrad accumulator rows, bit for bit."""
+    batches = stream(8)
+    victim = PsShard(epoch=1, wal_root=wal_root(tmp_path))
+    reference = PsShard()
+    for s in (victim, reference):
+        s.create_table(spec())
+    ckpt = str(tmp_path / "ps-ckpt")
+    for i, (ids, grads) in enumerate(batches):
+        if i == 4:
+            victim.save(ckpt, step=i)  # retires the covered segments
+        victim.table("emb").push(ids, grads, scale=0.5)
+        victim._wal.append(wal.encode_push("emb", ids, grads, 0.5))
+        reference.table("emb").push(ids, grads, scale=0.5)
+    victim._wal.sync()  # crash: no close, just stop using it
+
+    rescuer = PsShard(epoch=2, wal_root=wal_root(tmp_path))
+    rescuer.restore(ckpt)
+    stats = rescuer.replay_wal()
+    # the create record died with the retired pre-snapshot segment; the
+    # table itself came back through restore()'s snapshot spec
+    assert stats["pushes"] == 4 and stats["torn"] == 0
+    probe = np.arange(50)
+    np.testing.assert_array_equal(
+        rescuer.table("emb").pull(probe), reference.table("emb").pull(probe))
+    ids_r, rows_r = rescuer.table("emb").export_rows()
+    ids_f, rows_f = reference.table("emb").export_rows()
+    np.testing.assert_array_equal(np.sort(ids_r), np.sort(ids_f))
+    np.testing.assert_array_equal(
+        rows_r[np.argsort(ids_r, kind="stable")],
+        rows_f[np.argsort(ids_f, kind="stable")])
+
+
+def test_rescue_replay_truncates_torn_tail(tmp_path):
+    """A SIGKILL mid-append leaves a half-written record: replay applies
+    everything before it and equals a reference that never saw the lost
+    push."""
+    batches = stream(5)
+    victim = PsShard(epoch=1, wal_root=wal_root(tmp_path))
+    reference = PsShard()
+    for s in (victim, reference):
+        s.create_table(spec())
+    for i, (ids, grads) in enumerate(batches):
+        victim.table("emb").push(ids, grads, scale=0.5)
+        victim._wal.append(wal.encode_push("emb", ids, grads, 0.5))
+        if i < len(batches) - 1:  # the final push never made the reference
+            reference.table("emb").push(ids, grads, scale=0.5)
+    victim._wal.sync()
+    seg = victim._wal.path
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:  # tear the last record in half
+        f.truncate(size - 40)
+
+    rescuer = PsShard(epoch=2, wal_root=wal_root(tmp_path))
+    stats = rescuer.replay_wal()
+    assert stats["torn"] == 1 and stats["pushes"] == len(batches) - 1
+    probe = np.arange(50)
+    np.testing.assert_array_equal(
+        rescuer.table("emb").pull(probe), reference.table("emb").pull(probe))
+
+
+def test_rescue_replay_stops_at_corrupt_record(tmp_path):
+    """Bit-rot inside a record body: the crc catches it and replay stops
+    THERE — later (possibly fine) records must not apply out of order."""
+    victim = PsShard(epoch=1, wal_root=wal_root(tmp_path))
+    victim.create_table(spec())
+    reference = PsShard()
+    reference.create_table(spec())
+    batches = stream(4)
+    offsets = []  # byte offset of each record in the open segment
+    for ids, grads in batches:
+        victim.table("emb").push(ids, grads, scale=0.5)
+        offsets.append(os.path.getsize(victim._wal.path))
+        victim._wal.append(wal.encode_push("emb", ids, grads, 0.5))
+    victim._wal.sync()
+    # reference sees only the pushes before the corrupt record (the 3rd)
+    for ids, grads in batches[:2]:
+        reference.table("emb").push(ids, grads, scale=0.5)
+    seg = victim._wal.path
+    with open(seg, "r+b") as f:  # corrupt one byte INSIDE record 3's body
+        f.seek(offsets[2] + struct.calcsize("<II") + 8)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    rescuer = PsShard(epoch=2, wal_root=wal_root(tmp_path))
+    stats = rescuer.replay_wal()
+    assert stats["torn"] == 1 and stats["pushes"] == 2
+    probe = np.arange(50)
+    np.testing.assert_array_equal(
+        rescuer.table("emb").pull(probe), reference.table("emb").pull(probe))
+
+
+def test_replay_dedupes_retried_push(tmp_path):
+    """A push the dead shard applied-and-logged but never acked comes back
+    as a client retry: the rescuer recognises the payload and acks WITHOUT
+    applying twice."""
+    ids = np.arange(8)
+    grads = np.ones((8, 8), np.float32)
+    victim = PsShard(epoch=1, wal_root=wal_root(tmp_path))
+    victim.create_table(spec())
+    victim.table("emb").push(ids, grads, scale=1.0)
+    victim._wal.append(wal.encode_push("emb", ids, grads, 1.0))
+    victim._wal.sync()
+
+    rescuer = PsShard(epoch=2, wal_root=wal_root(tmp_path))
+    rescuer.replay_wal()
+    before = rescuer.table("emb").pull(ids).copy()
+    ack = rescuer.Push(push_req("emb", ids, grads, epoch=2), None)
+    assert ack.ok and "dedup" in ack.message
+    np.testing.assert_array_equal(rescuer.table("emb").pull(ids), before)
+    # the SAME bytes again are a genuinely new push now (dedupe is one-shot)
+    ack2 = rescuer.Push(push_req("emb", ids, grads, epoch=2), None)
+    assert ack2.ok and "dedup" not in ack2.message
+    assert not np.array_equal(rescuer.table("emb").pull(ids), before)
+
+
+def test_replay_markers_freeze_zombie_appends(tmp_path):
+    """Appends a zombie makes AFTER a rescue consumed its segments must be
+    invisible to any LATER rescue — the rescuer re-acked those retries
+    itself (or fenced them)."""
+    ids = np.arange(4)
+    grads = np.ones((4, 8), np.float32)
+    zombie = PsShard(epoch=1, wal_root=wal_root(tmp_path))
+    zombie.create_table(spec())
+    zombie.table("emb").push(ids, grads, scale=1.0)
+    zombie._wal.append(wal.encode_push("emb", ids, grads, 1.0))
+    zombie._wal.sync()
+
+    rescuer = PsShard(epoch=2, wal_root=wal_root(tmp_path))
+    rescuer.replay_wal()
+    # zombie wakes up and logs one more (unfenced local append)
+    zombie._wal.append(wal.encode_push("emb", ids, grads * 9, 1.0))
+    zombie._wal.sync()
+
+    second = PsShard(epoch=3, wal_root=wal_root(tmp_path))
+    stats = second.replay_wal()
+    # epoch-1 replay capped at the marker (1 push), epoch-2 wal had the
+    # create + nothing else
+    assert stats["pushes"] == 1
+    probe = np.arange(4)
+    np.testing.assert_array_equal(second.table("emb").pull(probe),
+                                  rescuer.table("emb").pull(probe))
+
+
+def test_save_retires_segments_and_predecessor_dirs(tmp_path):
+    batches = stream(3)
+    victim = PsShard(epoch=1, wal_root=wal_root(tmp_path))
+    victim.create_table(spec())
+    for ids, grads in batches:
+        victim.table("emb").push(ids, grads, scale=0.5)
+        victim._wal.append(wal.encode_push("emb", ids, grads, 0.5))
+    victim._wal.sync()
+
+    rescuer = PsShard(epoch=2, wal_root=wal_root(tmp_path))
+    rescuer.replay_wal()
+    epoch_dirs = [d for _e, d in wal.epoch_dirs(wal_root(tmp_path))]
+    assert len(epoch_dirs) == 2
+    # snapshot commit: the predecessor's whole incarnation dir dies with
+    # the covered segments — everything in it is in this snapshot
+    rescuer.save(str(tmp_path / "ps-ckpt"), step=10)
+    left = wal.epoch_dirs(wal_root(tmp_path))
+    assert [e for e, _d in left] == [2]
+    segs = [n for n in os.listdir(left[0][1]) if n.endswith(".wal")]
+    assert len(segs) == 1  # only the freshly-cut open segment remains
+    payloads, _c, _ok = wal.read_segment(os.path.join(left[0][1], segs[0]))
+    assert payloads == []
+
+
+def test_drain_save_keeps_wal(tmp_path):
+    """The drain/handoff snapshot must NOT retire the log: it lands in a
+    handoff dir a failure rescue never reads."""
+    ids = np.arange(4)
+    grads = np.ones((4, 8), np.float32)
+    shard = PsShard(epoch=1, wal_root=wal_root(tmp_path))
+    shard.create_table(spec())
+    shard.table("emb").push(ids, grads, scale=1.0)
+    shard._wal.append(wal.encode_push("emb", ids, grads, 1.0))
+    shard.save(str(tmp_path / "handoff"), step=0, marker_expected=1,
+               retire_wal=False)
+    d = wal.epoch_dirs(wal_root(tmp_path))[0][1]
+    recs = [
+        p for n in sorted(os.listdir(d)) if n.endswith(".wal")
+        for p in wal.read_segment(os.path.join(d, n))[0]
+    ]
+    assert sum(1 for p in recs if wal.record_kind(p) == wal.REC_PUSH) == 1
+
+
+def test_save_outside_rescue_dir_keeps_wal(tmp_path):
+    """A snapshot committed anywhere but the rescue lineage (verify dumps,
+    ad-hoc Save RPCs) must not retire segments: a failure rescue never
+    reads it, so retiring against it would silently lose those pushes."""
+    batches = stream(6)
+    ck = str(tmp_path / "ps-ckpt")
+    victim = PsShard(epoch=1, wal_root=wal_root(tmp_path), rescue_dir=ck)
+    reference = PsShard()
+    for s in (victim, reference):
+        s.create_table(spec())
+    for i, (ids, grads) in enumerate(batches):
+        victim.Push(push_req("emb", ids, grads, scale=0.5), None)
+        reference.table("emb").push(ids, grads, scale=0.5)
+        if i == 2:
+            victim.save(str(tmp_path / "ps-verify"), step=0)
+    victim._wal.sync()
+
+    rescuer = PsShard(epoch=2, wal_root=wal_root(tmp_path), rescue_dir=ck)
+    with pytest.raises(FileNotFoundError):
+        rescuer.restore(ck)  # the verify save is not a rescue point
+    stats = rescuer.replay_wal()
+    assert stats["pushes"] == len(batches)  # nothing was retired
+    probe = np.arange(50)
+    np.testing.assert_array_equal(
+        rescuer.table("emb").pull(probe), reference.table("emb").pull(probe))
+
+
+def test_torn_multi_shard_save_defers_retirement(tmp_path):
+    """A save whose sibling shard dies before its done marker is not
+    restorable, so it must keep the log; and once the step DOES complete,
+    a rescue restoring it must not double-apply the records the snapshot
+    already holds (the cut marker is the boundary)."""
+    from easydl_tpu.ps.table import shard_of
+
+    ck = str(tmp_path / "ps-ckpt")
+    victim = PsShard(shard_index=0, num_shards=2, epoch=1,
+                     wal_root=wal_root(tmp_path), rescue_dir=ck)
+    reference = PsShard(shard_index=0, num_shards=2)
+    for s in (victim, reference):
+        s.create_table(spec())
+    rng = np.random.default_rng(11)
+    batches = []
+    for _ in range(6):
+        ids = rng.integers(0, 200, 64).astype(np.int64)
+        ids = np.unique(ids[shard_of(ids, 2) == 0])[:16]
+        grads = rng.standard_normal((len(ids), 8)).astype(np.float32)
+        batches.append((ids, grads))
+    epoch_dir = None
+    pre_save_segs: set = set()
+    for i, (ids, grads) in enumerate(batches):
+        victim.Push(push_req("emb", ids, grads, scale=0.5), None)
+        reference.table("emb").push(ids, grads, scale=0.5)
+        if i == 2:
+            epoch_dir = wal.epoch_dirs(wal_root(tmp_path))[0][1]
+            pre_save_segs = set(os.listdir(epoch_dir))
+            victim.save(ck, step=7)  # shard 1 never writes its marker
+            assert pre_save_segs <= set(os.listdir(epoch_dir))
+    victim._wal.sync()
+    step_dir = os.path.join(ck, "step_0000000007")
+    assert not PsShard.saved_steps(ck)  # torn: invisible to restore
+    # the sibling commits its marker AFTER the victim died
+    with open(os.path.join(step_dir, ".done-1"), "w") as f:
+        f.write("2")
+
+    rescuer = PsShard(shard_index=0, num_shards=2, epoch=2,
+                      wal_root=wal_root(tmp_path), rescue_dir=ck)
+    assert rescuer.restore(ck) == 7
+    stats = rescuer.replay_wal()
+    assert stats["pushes"] == 3  # only the post-snapshot pushes
+    probe = np.arange(200)
+    probe = probe[shard_of(probe, 2) == 0]
+    np.testing.assert_array_equal(
+        rescuer.table("emb").pull(probe), reference.table("emb").pull(probe))
+
+
+def test_pull_rejected_when_fenced(tmp_path):
+    """A superseded zombie must stop answering READS too: pulls are not
+    epoch-stamped and never fail on a responsive server, so the fence
+    aborts them with UNAVAILABLE — the one status the pull retry loop
+    reroutes on."""
+    import grpc
+
+    workdir = str(tmp_path)
+    shard = PsShard(epoch=1, wal_root=wal_root(tmp_path), workdir=workdir)
+    shard.create_table(spec())
+    ids = np.arange(4)
+    grads = np.ones((4, 8), np.float32)
+    # proof of successor: a newer-stamped push forces the registry check,
+    # and the registry confirms the higher-epoch publication
+    registry.publish(workdir, "rescuer", 0, 1, "localhost:2", epoch=2)
+    ack = shard.Push(push_req("emb", ids, grads, epoch=2), None)
+    assert not ack.ok and ack.message.startswith(STALE_EPOCH)
+
+    class Abort(Exception):
+        pass
+
+    class Ctx:
+        def abort(self, code, details):
+            raise Abort(code, details)
+
+    with pytest.raises(Abort) as ei:
+        shard.Pull(pb.PullRequest(
+            table="emb", raw_ids=ids.astype("<i8").tobytes()), Ctx())
+    code, details = ei.value.args
+    assert code == grpc.StatusCode.UNAVAILABLE
+    assert STALE_EPOCH in details
+
+
+def test_replay_dedupe_window_closes_at_snapshot_commit(tmp_path):
+    """Replay digests exist to absorb the post-rescue retry storm; a
+    snapshot commit ends that window, after which byte-identical pushes
+    are genuinely new updates and must apply."""
+    ids = np.arange(8)
+    grads = np.ones((8, 8), np.float32)
+    victim = PsShard(epoch=1, wal_root=wal_root(tmp_path))
+    victim.create_table(spec())
+    victim.table("emb").push(ids, grads, scale=1.0)
+    victim._wal.append(wal.encode_push("emb", ids, grads, 1.0))
+    victim._wal.sync()
+
+    rescuer = PsShard(epoch=2, wal_root=wal_root(tmp_path))
+    rescuer.replay_wal()
+    rescuer.save(str(tmp_path / "ps-ckpt"), step=1)
+    before = rescuer.table("emb").pull(ids).copy()
+    ack = rescuer.Push(push_req("emb", ids, grads, epoch=2), None)
+    assert ack.ok and "dedup" not in ack.message
+    assert not np.array_equal(rescuer.table("emb").pull(ids), before)
+
+
+def test_background_sync_survives_concurrent_cuts(tmp_path):
+    """The background fsync races segment rotation: an fsync landing on
+    the fd cut() just closed used to EBADF and permanently brick the log
+    via _broken. Hammer the pair and prove the WAL stays appendable."""
+    w = wal.PsWal(str(tmp_path), segment_bytes=1 << 30, sync_s=0.002)
+    deadline = time.monotonic() + 0.5
+    while time.monotonic() < deadline:
+        w.append(wal.encode_create('{"n": 1}'))
+        w.cut()
+    assert w._broken is None
+    w.append(wal.encode_create('{"n": 2}'))
+    w.close()
+
+
+# ----------------------------------------------------------------- fencing
+
+
+def test_stale_epoch_push_rejected_retriably():
+    shard = PsShard(epoch=3)
+    shard.create_table(spec())
+    ids, grads = np.arange(4), np.ones((4, 8), np.float32)
+    before = shard.table("emb").pull(ids).copy()
+    ack = shard.Push(push_req("emb", ids, grads, epoch=2), None)
+    assert not ack.ok and ack.message.startswith(STALE_EPOCH)
+    np.testing.assert_array_equal(shard.table("emb").pull(ids), before)
+    # matching stamp applies; unstamped (legacy) is always accepted
+    assert shard.Push(push_req("emb", ids, grads, epoch=3), None).ok
+    assert shard.Push(push_req("emb", ids, grads, epoch=0), None).ok
+
+
+def test_newer_epoch_push_fences_permanently(tmp_path, monkeypatch):
+    """A push stamped with a NEWER epoch forces an unthrottled registry
+    check; with the successor's publication confirmed there, the shard
+    fences for good — even correctly-stamped pushes are now rejected (the
+    zombie may not diverge from the successor). The huge throttle proves
+    the FORCED check fenced us, not the periodic one."""
+    monkeypatch.setenv("EASYDL_PS_FENCE_CHECK_S", "3600")
+    workdir = str(tmp_path)
+    shard = PsShard(epoch=3, workdir=workdir)
+    shard.create_table(spec())
+    registry.publish(workdir, "successor", 0, 1, "localhost:2", epoch=4)
+    ids, grads = np.arange(4), np.ones((4, 8), np.float32)
+    ack = shard.Push(push_req("emb", ids, grads, epoch=4), None)
+    assert not ack.ok and ack.message.startswith(STALE_EPOCH)
+    ack2 = shard.Push(push_req("emb", ids, grads, epoch=3), None)
+    assert not ack2.ok and ack2.message.startswith(STALE_EPOCH)
+    assert shard._fenced
+
+
+def test_bogus_newer_stamp_does_not_fence_healthy_shard(tmp_path,
+                                                        monkeypatch):
+    """The registry is the only authority that can fence permanently: a
+    push carrying a bogus higher epoch (client bug, corrupt field) against
+    a shard the registry still shows as current is rejected retriably and
+    must NOT disable the shard — correctly-stamped traffic keeps
+    applying."""
+    monkeypatch.setenv("EASYDL_PS_FENCE_CHECK_S", "0.0")
+    workdir = str(tmp_path)
+    shard = PsShard(epoch=3, workdir=workdir)
+    shard.create_table(spec())
+    registry.publish(workdir, "me", 0, 1, "localhost:1", epoch=3)
+    ids, grads = np.arange(4), np.ones((4, 8), np.float32)
+    ack = shard.Push(push_req("emb", ids, grads, epoch=7), None)
+    assert not ack.ok and ack.message.startswith(STALE_EPOCH)
+    assert not shard._fenced
+    assert shard.Push(push_req("emb", ids, grads, epoch=3), None).ok
+
+
+def test_fenced_shard_reads_dead_to_liveness_probe(tmp_path, monkeypatch):
+    """A fenced zombie must FAIL the Stats liveness probe: probe_alive
+    decides rescue-discovery liveness via Stats, and a fenced shard that
+    kept answering would be adopted as live after its rescuer died —
+    permanently blocking the next rescue while rejecting all traffic."""
+    from easydl_tpu.ps.__main__ import probe_alive
+
+    monkeypatch.setenv("EASYDL_PS_FENCE_CHECK_S", "0.0")
+    monkeypatch.setenv("EASYDL_PS_PROBE_TIMEOUT_S", "2.0")
+    workdir = str(tmp_path)
+    shard = PsShard(epoch=1, workdir=workdir)
+    shard.create_table(spec())
+    srv = shard.serve(port=0)
+    try:
+        registry.publish(workdir, "me", 0, 1, srv.address, epoch=1)
+        assert probe_alive(srv.address, attempts=1)
+        registry.publish(workdir, "rescuer", 0, 1, "localhost:2", epoch=2)
+        ids, grads = np.arange(4), np.ones((4, 8), np.float32)
+        ack = shard.Push(push_req("emb", ids, grads, epoch=1), None)
+        assert not ack.ok and shard._fenced
+        assert not probe_alive(srv.address, attempts=1)
+    finally:
+        srv.stop()
+        shard.stop()
+
+
+def test_zombie_self_fences_via_registry(tmp_path, monkeypatch):
+    """The resumed-zombie path: every client is stale (all stamp the OLD
+    epoch), so only the shard's own throttled registry check can catch the
+    takeover."""
+    monkeypatch.setenv("EASYDL_PS_FENCE_CHECK_S", "0.0")
+    workdir = str(tmp_path)
+    shard = PsShard(epoch=1, workdir=workdir)
+    shard.create_table(spec())
+    ids, grads = np.arange(4), np.ones((4, 8), np.float32)
+    registry.publish(workdir, "me", 0, 1, "localhost:1", epoch=1)
+    assert shard.Push(push_req("emb", ids, grads, epoch=1), None).ok
+    # ... SIGSTOP here, a rescuer takes over, SIGCONT ...
+    registry.publish(workdir, "rescuer", 0, 1, "localhost:2", epoch=2)
+    ack = shard.Push(push_req("emb", ids, grads, epoch=1), None)
+    assert not ack.ok and ack.message.startswith(STALE_EPOCH)
+    assert shard._fenced
+
+
+def test_fence_rejection_reroutes_client_to_successor(tmp_path, monkeypatch):
+    """The full convergence loop over real gRPC: a client with a stale
+    route+epoch pushes at the superseded server, gets the retriable fence
+    Ack, refreshes from the registry, and the push lands on the successor
+    — bit-identical to a never-rerouted reference."""
+    # No throttle on the registry self-check: the superseded server must
+    # notice the takeover on its very next push (a real zombie has been
+    # SIGSTOPped past the throttle anyway by the time it wakes).
+    monkeypatch.setenv("EASYDL_PS_FENCE_CHECK_S", "0.0")
+    workdir = str(tmp_path)
+    old = PsShard(epoch=registry.bump_epoch(workdir, 0),
+                  wal_root=wal_root(tmp_path), workdir=workdir)
+    old_srv = old.serve(port=0)
+    registry.publish(workdir, "old", 0, 1, old_srv.address, epoch=old.epoch)
+    client = ShardedPsClient.from_registry(workdir, 1, timeout=10.0,
+                                           drain_retry_s=30.0)
+    reference = PsShard()
+    reference.create_table(spec())
+    batches = stream(4)
+    probe = np.arange(50)
+    try:
+        client.create_table(spec())
+        for ids, grads in batches[:2]:
+            client.push("emb", ids, grads, scale=0.5)
+            reference.table("emb").push(ids, grads, scale=0.5)
+        old_state = old.table("emb").pull(probe).copy()
+
+        # successor levels in: WAL-only recovery, higher epoch, republish
+        new = PsShard(epoch=registry.bump_epoch(workdir, 0),
+                      wal_root=wal_root(tmp_path), workdir=workdir)
+        new.replay_wal()
+        new_srv = new.serve(port=0)
+        registry.publish(workdir, "new", 0, 1, new_srv.address,
+                         epoch=new.epoch)
+        try:
+            # client still points at `old`; the fence bounces it across
+            for ids, grads in batches[2:]:
+                client.push("emb", ids, grads, scale=0.5)
+                reference.table("emb").push(ids, grads, scale=0.5)
+            assert client.addresses[0] == new_srv.address
+            assert client._epochs[0] == new.epoch
+            np.testing.assert_array_equal(
+                new.table("emb").pull(probe),
+                reference.table("emb").pull(probe))
+            # the zombie fenced itself and applied nothing post-takeover
+            assert old._fenced
+            np.testing.assert_array_equal(old.table("emb").pull(probe),
+                                          old_state)
+        finally:
+            new_srv.stop()
+            new.stop()
+    finally:
+        old_srv.stop()
+        old.stop()
+        client.close()
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_bump_epoch_monotonic(tmp_path):
+    w = str(tmp_path)
+    assert registry.bump_epoch(w, 0) == 1
+    assert registry.bump_epoch(w, 0) == 2
+    assert registry.bump_epoch(w, 1) == 1  # per-shard counters
+    assert registry.shard_epoch(w, 0) == 2
+    assert registry.shard_epoch(w, 5) == 0  # never bumped
+
+
+def test_shard_map_prefers_highest_epoch(tmp_path):
+    w = str(tmp_path)
+    registry.publish(w, "a", 0, 1, "localhost:1", epoch=2)
+    time.sleep(0.01)
+    # later publish, LOWER epoch (a zombie re-publishing): must not win
+    registry.publish(w, "b", 0, 1, "localhost:2", epoch=1)
+    assert registry.shard_map(w)[0]["address"] == "localhost:1"
+    registry.publish(w, "c", 0, 1, "localhost:3", epoch=3)
+    assert registry.shard_map(w)[0]["address"] == "localhost:3"
+
+
+def test_sweep_stale_removes_dead_pid_entries(tmp_path):
+    w = str(tmp_path)
+    alive = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(60)"])
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    try:
+        registry.publish(w, "alive", 0, 2, "localhost:1", epoch=1)
+        registry.publish(w, "dead", 1, 2, "localhost:2", epoch=1)
+        registry.publish(w, "remote", 1, 2, "otherhost:3", epoch=1)
+        # rewrite pids: publish() stamps os.getpid()
+        for pod, pid in (("alive", alive.pid), ("dead", dead.pid),
+                         ("remote", dead.pid)):
+            p = os.path.join(w, "ps", f"ps-{pod}.json")
+            with open(p) as f:
+                doc = json.load(f)
+            doc["pid"] = pid
+            with open(p, "w") as f:
+                json.dump(doc, f)
+        assert registry.sweep_stale(w) == 1
+        left = set(registry.entries(w))
+        # dead localhost entry swept; live pid and other-host entries stay
+        assert left == {"alive", "remote"}
+        # the epoch counters outlive the sweep (fencing history)
+        assert registry.bump_epoch(w, 1) == 1
+    finally:
+        alive.kill()
+        alive.wait()
+
+
+# ------------------------------------------------------------- async pusher
+
+
+def test_drain_pushes_raises_promptly_when_no_reroute(tmp_path):
+    """A shard stuck DRAINING with no replacement ever published: the
+    bounded drain window must RAISE (naming the shard and the last Ack),
+    not hang — and the raise must surface through AsyncPusher.drain with
+    the failing push named."""
+    shard = PsShard()
+    srv = shard.serve(port=0)
+    client = ShardedPsClient([srv.address], timeout=10.0, drain_retry_s=1.0)
+    pusher = AsyncPusher(client, depth=2)
+    try:
+        client.create_table(spec())
+        shard._draining = True  # migration started; nobody ever finishes it
+        t0 = time.monotonic()
+        pusher.submit("emb", np.arange(4), np.ones((4, 8), np.float32), 1.0)
+        with pytest.raises(RuntimeError) as ei:
+            pusher.drain()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0  # bounded by drain_retry_s, not a hang
+        msg = str(ei.value)
+        assert "emb" in msg  # the wrapper names the push
+        cause = str(ei.value.__cause__)
+        assert "shard 0" in cause and DRAINING in cause  # id + last ack
+    finally:
+        pusher.close()
+        srv.stop()
+        shard.stop()
+        client.close()

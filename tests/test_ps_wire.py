@@ -365,8 +365,11 @@ def test_async_push_surfaces_errors():
     pusher = AsyncPusher(client, depth=1)
     pusher.submit("no_such_table", np.arange(4),
                   np.ones((4, 8), np.float32), 1.0)
-    with pytest.raises(KeyError):
+    # The raise surfaces far from the push site, so the wrapper must name
+    # the failing push; the original error rides along as the cause.
+    with pytest.raises(RuntimeError, match="no_such_table") as ei:
         pusher.drain()
+    assert isinstance(ei.value.__cause__, KeyError)
     pusher.close()
 
 
