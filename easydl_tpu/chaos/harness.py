@@ -73,6 +73,19 @@ class Scenario:
     #: at which a mid-storm ps-ckpt snapshot commits), arm_at (batch at
     #: which t0 is stamped — the fault offsets count from here, so the
     #: kill provably lands after the snapshot and mid-storm), pace_s.
+    #:
+    #: Optional ``reshard`` sub-config (the live-resharding drill): at
+    #: batch ``at`` a coordinator thread runs an online split to
+    #: ``to_shards`` (ps/reshard.py) while the storm keeps pushing, then
+    #: — when ``then_to_shards`` is set — a second migration back.
+    #: Faults are injected at PROTOCOL points, not wall-clock offsets
+    #: (the phases take variable time, and "mid-migration" must be
+    #: deterministic): ``kill_source`` SIGKILLs that source shard's pod
+    #: right after the export phase (a rescue pod levels in, comes up
+    #: push-gated, and the coordinator's cutover re-resolves it);
+    #: ``pause_dest`` SIGSTOPs that destination pod right after the
+    #: restore phase for ``pause_s`` seconds (the tail-replay retry loop
+    #: must ride it out).
     ps_storm: Optional[Dict[str, Any]] = None
 
     @property
@@ -274,6 +287,7 @@ class ChaosHarness:
         t_start = time.monotonic()
         counts_before = injectors.injected_fault_counts()
         self._zombie: Optional[Dict[str, Any]] = None
+        self._reshard: Dict[str, Any] = {}
         try:
             self._launch_ps()
             evidence = self._drive_push_storm(plan_path)
@@ -357,6 +371,8 @@ class ChaosHarness:
         )
         reference = LocalPsClient(num_shards=sc.ps_shards, coalesce=False)
         events_thread = None
+        reshard_thread = None
+        reshard_cfg = storm.get("reshard")
         try:
             for spec in specs:
                 client.create_table(spec)
@@ -378,6 +394,16 @@ class ChaosHarness:
                         target=self._execute_process_events, args=(t0,),
                         daemon=True, name="chaos-storm-events")
                     events_thread.start()
+                if reshard_cfg is not None and i == int(reshard_cfg["at"]):
+                    # The coordinator runs beside the storm: pushes keep
+                    # flowing THROUGH the migration (riding stale-route
+                    # retriably over the cutover window) — that is the
+                    # drill. Faults inject at protocol points inside.
+                    reshard_thread = threading.Thread(
+                        target=self._run_reshard_migrations,
+                        args=(dict(reshard_cfg),),
+                        daemon=True, name="chaos-reshard")
+                    reshard_thread.start()
                 for spec, g in zip(specs, grads):
                     client.push(spec.name, ids, g, scale=0.125)
                     reference.push(spec.name, ids, g, scale=0.125)
@@ -388,9 +414,109 @@ class ChaosHarness:
                 time.sleep(pace_s)
             if events_thread is not None:
                 events_thread.join(timeout=180.0)
+            if reshard_thread is not None:
+                reshard_thread.join(timeout=600.0)
+                if reshard_thread.is_alive():
+                    self._reshard.setdefault("errors", []).append(
+                        "reshard thread still running at storm end")
             return self._verify_zero_loss(client, reference, specs)
         finally:
             client.close()
+
+    # --------------------------------------------------- live resharding
+    def _run_reshard_migrations(self, cfg: Dict[str, Any]) -> None:
+        """Run the online split (and, when configured, the shrink back)
+        against the live storm, injecting the drill's faults at protocol
+        points via the coordinator's phase hook. Failures land in the
+        evidence (``errors``) — the ps_reshard_completed invariant turns
+        a torn migration into a failed verdict, never a harness crash."""
+        from easydl_tpu.ps import reshard as ps_reshard
+
+        self._reshard = {"migrations": [], "errors": []}
+        legs = [{"to_shards": int(cfg["to_shards"]),
+                 "kill_source": cfg.get("kill_source"),
+                 "pause_dest": cfg.get("pause_dest"),
+                 "pause_s": float(cfg.get("pause_s", 2.0))}]
+        if cfg.get("then_to_shards"):
+            legs.append({"to_shards": int(cfg["then_to_shards"]),
+                         "kill_source": None, "pause_dest": None,
+                         "pause_s": 0.0})
+        for leg in legs:
+            try:
+                summary = ps_reshard.run_reshard(
+                    self.workdir, leg["to_shards"],
+                    owner=f"chaos-{self.scenario.name}",
+                    ensure_destinations=self._spawn_reshard_dests,
+                    on_phase=self._make_reshard_fault_hook(leg),
+                    rpc_timeout=10.0, phase_timeout_s=240.0,
+                    dest_wait_s=120.0,
+                )
+                self._reshard["migrations"].append(summary)
+            except Exception as e:
+                log.exception("reshard leg to %d shards failed",
+                              leg["to_shards"])
+                self._reshard["errors"].append(
+                    f"to_shards={leg['to_shards']}: {e!r}")
+                return  # a failed split leaves nothing for the shrink leg
+
+    def _spawn_reshard_dests(self, plan: Dict[str, Any]) -> None:
+        """Bring up the destination shard set: fresh ``--reshard-dest``
+        pods publishing under the plan's generation (invisible to clients
+        until commit)."""
+        from easydl_tpu.controller.pod_api import Pod
+
+        sc = self.scenario
+        gen, to_shards = int(plan["generation"]), int(plan["to_shards"])
+        for d in range(to_shards):
+            self._pod_api.create_pod(Pod(
+                name=self._reshard_dest_pod(gen, d), job=sc.name,
+                role="parameter_server",
+                command=(
+                    f"{sys.executable} -m easydl_tpu.ps"
+                    f" --name {self._reshard_dest_pod(gen, d)}"
+                    f" --workdir {self.workdir} --num-shards {to_shards}"
+                    f" --shard-index {d} --reshard-dest"
+                ),
+            ))
+
+    def _reshard_dest_pod(self, generation: int, shard: int) -> str:
+        return f"{self.scenario.name}-ps-g{generation}-{shard}"
+
+    def _make_reshard_fault_hook(self, leg: Dict[str, Any]):
+        """Phase hook injecting this leg's faults exactly where the drill
+        promises them: source SIGKILL after export (mid-migration, before
+        cutover — the rescue must come up push-gated and the coordinator
+        must finish through it), destination SIGSTOP after restore (the
+        tail replay must retry through the stall)."""
+        def hook(phase: str, plan: Dict[str, Any]) -> None:
+            if phase == "exported" and leg.get("kill_source") is not None:
+                self._ps_crash_and_rescue(int(leg["kill_source"]), 0.2)
+            if phase == "restored" and leg.get("pause_dest") is not None:
+                self._pause_reshard_dest(int(plan["generation"]),
+                                         int(leg["pause_dest"]),
+                                         leg["pause_s"])
+        return hook
+
+    def _pause_reshard_dest(self, generation: int, shard: int,
+                            pause_s: float) -> None:
+        """SIGSTOP a destination pod mid-migration; SIGCONT on a timer so
+        the coordinator's replay retry loop (not the harness) is what
+        rides the stall out."""
+        import signal as _signal
+
+        name = self._reshard_dest_pod(generation, shard)
+        entry = self._pod_api._procs.get(name)  # harness-only: raw handle
+        if entry is None or entry.proc.poll() is not None:
+            raise RuntimeError(f"reshard dest pod {name} not running")
+        os.kill(entry.proc.pid, _signal.SIGSTOP)
+        injectors.count_fault("ps_pause")
+        log.info("chaos: SIGSTOP reshard dest %s (pid %d) for %.1fs",
+                 name, entry.proc.pid, pause_s)
+        t = threading.Timer(pause_s, os.kill,
+                            args=(entry.proc.pid, _signal.SIGCONT))
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
 
     def _verify_zero_loss(self, client, reference, specs) -> Dict[str, Any]:
         """Build the ``ps-zero-loss.json`` evidence artifact: zombie checks
@@ -402,6 +528,13 @@ class ChaosHarness:
             evidence["zombie"] = dict(self._zombie)
             evidence["zombie"].update(self._probe_zombie(specs[0]))
             evidence["zombie"].update(self._zombie_excess_wal_bytes())
+        if self._reshard:
+            evidence["reshard"] = dict(self._reshard)
+            # The verify save below must fan out over the POST-migration
+            # shard set; the storm's last pushes may have finished before
+            # the commit, so adopt the committed routing explicitly.
+            if hasattr(client, "refresh_routing"):
+                client.refresh_routing()
         verify_step = 999999
         live_dir = os.path.join(self.workdir, "ps-verify-live")
         ref_dir = os.path.join(self.workdir, "ps-verify-ref")
@@ -443,6 +576,12 @@ class ChaosHarness:
             "wal_retired_segments": total(
                 "easydl_ps_wal_retired_segments_total"),
             "fence_rejected": total("easydl_ps_push_fence_rejected_total"),
+            "stale_route_rejected": total(
+                "easydl_ps_push_stale_route_total"),
+            "reshard_rows_migrated": total(
+                "easydl_ps_reshard_rows_migrated_total"),
+            "reshard_replayed_records": total(
+                "easydl_ps_reshard_replayed_records_total"),
         }
 
     def _ps_pause_and_rescue(self, shard: int, respawn_after_s: float) -> None:
@@ -1211,6 +1350,45 @@ def scenario_ps_zombie_writer(seed: int = 41) -> Scenario:
     )
 
 
+def scenario_ps_reshard_under_fire(seed: int = 43) -> Scenario:
+    """Live resharding under fire: a 2→4 online split (and a 4→2 shrink
+    back) runs UNDER a deterministic Zipf push storm, with a source shard
+    SIGKILLed right after the export phase (its rescue must come up
+    push-gated and the migration must finish through the rescuer) and a
+    destination SIGSTOPped right after the restore phase (the tail-replay
+    retry must ride the stall out). The client stream never hard-fails —
+    pushes over the cutover window only ever see retriable `stale-route`
+    Acks — and at the end every table's id-sorted digest (full row width,
+    optimizer rows included) must match a fault-free, never-resharded
+    in-process reference of the exact same stream: zero acked pushes
+    lost across two full migrations plus a mid-migration crash."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="ps_reshard_under_fire", seed=seed,
+            notes="2->4 reshard mid-storm with a source SIGKILL after "
+                  "export and a dest SIGSTOP after restore, then 4->2 "
+                  "back; digests must match a never-resharded reference",
+            faults=(),  # injected at protocol points, not wall offsets
+        ),
+        job_cfg={},
+        ps_shards=2,
+        ps_storm={"steps": 420, "batch": 160, "vocab": 3000, "dim": 8,
+                  "zipf_a": 1.1, "save_at": 60, "arm_at": 70,
+                  "pace_s": 0.008,
+                  "reshard": {"at": 90, "to_shards": 4,
+                              "kill_source": 1, "pause_dest": 2,
+                              "pause_s": 2.0, "then_to_shards": 2}},
+        expect={
+            "ps_zero_loss": True,
+            "min_wal_replays": 1,          # the killed source's rescue
+            "min_reshard_migrations": 2,   # the split AND the shrink
+            "min_rows_migrated": 1,
+            "min_reshard_replays": 1,      # the mid-migration WAL tail
+            "min_faults": 2,               # ps_kill + ps_pause
+        },
+    )
+
+
 #: name → builder(seed) for scripts/chaos_run.py and the e2e tests.
 SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "worker_kill": scenario_worker_kill,
@@ -1222,6 +1400,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "master_restart_mid_drain": scenario_master_restart_mid_drain,
     "ps_shard_crash_zero_loss": scenario_ps_shard_crash_zero_loss,
     "ps_zombie_writer": scenario_ps_zombie_writer,
+    "ps_reshard_under_fire": scenario_ps_reshard_under_fire,
 }
 
 #: the cheapest deterministic drill — what scripts/chaos_smoke.sh runs and

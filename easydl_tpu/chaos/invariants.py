@@ -38,6 +38,13 @@ verdicts:
 - ``ps_zombie_fenced`` — the SIGSTOP-resumed predecessor rejected a push
   stamped with its own superseded epoch AND wrote zero WAL bytes past the
   rescuer's replay caps: a zombie writer can never diverge the table;
+- ``ps_reshard_completed`` — every online-reshard migration the drill
+  launched committed its new routing generation with no errors, actually
+  moved rows into the destination set (``min_rows_migrated``), and
+  replayed at least ``min_reshard_replays`` mid-migration WAL tail
+  pushes — a "pass" where the migration never ran, or ran against a
+  silent tier, is refused (same no-vacuous-pass stance as
+  ``ps_wal_replayed``);
 - ``faults_observed`` (cross-check) — the obs counters saw at least the
   expected number of injected faults, so a "pass" can't come from a drill
   that silently injected nothing.
@@ -366,6 +373,33 @@ def check_scenario(
                     "wal_replayed_records": replayed,
                     "min_wal_replays": float(min_replays),
                     "counters": counters,
+                }
+            min_migrations = expect.get("min_reshard_migrations")
+            if min_migrations is not None:
+                resh = evidence.get("reshard") or {}
+                migrations = resh.get("migrations", []) or []
+                errors = resh.get("errors", []) or []
+                committed = [m for m in migrations
+                             if m.get("committed_routing")]
+                rows = sum(int(m.get("rows_migrated", 0))
+                           for m in committed)
+                tail = sum(int(m.get("tail_pushes_replayed", 0))
+                           for m in committed)
+                min_rows = int(expect.get("min_rows_migrated", 1))
+                min_tail = int(expect.get("min_reshard_replays", 1))
+                checks["ps_reshard_completed"] = {
+                    "ok": (not errors
+                           and len(committed) >= int(min_migrations)
+                           and rows >= min_rows and tail >= min_tail),
+                    "migrations_committed": len(committed),
+                    "min_reshard_migrations": int(min_migrations),
+                    "rows_migrated": rows,
+                    "min_rows_migrated": min_rows,
+                    "tail_pushes_replayed": tail,
+                    "min_reshard_replays": min_tail,
+                    "errors": errors,
+                    "committed_routing": [m.get("committed_routing")
+                                          for m in committed],
                 }
             if expect.get("zombie_fenced"):
                 z = evidence.get("zombie") or {}

@@ -229,3 +229,113 @@ def reconcile(job: str, plan: ResourcePlan, pods: List[Pod],
     desired, sigs = encode_desired(job, plan)
     observed = encode_observed(pods)
     return decode_ops(reconcile_wire(desired, observed, force_python)), sigs
+
+
+# ----------------------------------------------------- PS hot-shard split
+
+#: Skew triggers (env-overridable where maybe_split_ps is wired into a
+#: loop): a split pays a full table migration, so it needs BOTH a
+#: genuinely hot shard (skew the hash layout cannot fix by itself) and a
+#: tier big enough that the imbalance matters. The ratio is against the
+#: MEAN, whose max is the shard count itself (one shard holding all
+#: rows) — 1.5 fires on real Zipf skew while a ratio ≥ the shard count
+#: could never fire at all.
+PS_SPLIT_HOT_RATIO = 1.5
+PS_SPLIT_MIN_ROWS = 100_000
+PS_SPLIT_MAX_SHARDS = 64
+
+
+def ps_split_decision(shard_rows: Dict[int, float], num_shards: int,
+                      hot_ratio: float = PS_SPLIT_HOT_RATIO,
+                      min_total_rows: float = PS_SPLIT_MIN_ROWS,
+                      max_shards: int = PS_SPLIT_MAX_SHARDS) -> Optional[int]:
+    """Pure decision: observed per-shard row counts → target shard count
+    for an online split (ps/reshard.py), or None.
+
+    Doubles the shard count when the hottest shard holds ≥ ``hot_ratio``
+    × the mean (static hash-sharding concentrating a Zipf id stream) and
+    the tier holds at least ``min_total_rows`` rows; capped at
+    ``max_shards``. Deliberately the same shape as the reconcile core:
+    pure inputs → pure verdict, so policy is unit-testable without a
+    live tier."""
+    if num_shards <= 0 or not shard_rows:
+        return None
+    total = float(sum(shard_rows.values()))
+    if total < float(min_total_rows):
+        return None
+    target = num_shards * 2
+    if target > max_shards:
+        return None
+    hottest = max(shard_rows.values())
+    if hottest < hot_ratio * (total / num_shards):
+        return None
+    return target
+
+
+def maybe_split_ps(workdir: str,
+                   hot_ratio: Optional[float] = None,
+                   min_total_rows: Optional[float] = None,
+                   max_shards: Optional[int] = None) -> Optional[int]:
+    """Scrape the live PS tier's ``easydl_ps_table_rows`` gauges (the
+    PR-1 per-shard telemetry) from the job workdir's exporters and run
+    :func:`ps_split_decision` over them. Returns the recommended target
+    shard count, or None.
+
+    Recommendation only — it never writes a migration plan: a plan in
+    the routing table gates freshly-rescued source pods (ps/__main__.py),
+    so claiming one without a coordinator ready to execute it would
+    degrade the tier for nothing. The caller hands the verdict to
+    ``ps.reshard.run_reshard``, which claims the plan itself. Skipped
+    (None) while a plan is already in flight.
+
+    The thresholds default from the environment
+    (``EASYDL_PS_SPLIT_HOT_RATIO`` / ``EASYDL_PS_SPLIT_MIN_ROWS`` /
+    ``EASYDL_PS_SPLIT_MAX_SHARDS``) so a deployed operator loop is
+    tunable without a rollout; explicit args win."""
+    import re as _re
+
+    if hot_ratio is None:
+        hot_ratio = float(os.environ.get("EASYDL_PS_SPLIT_HOT_RATIO",
+                                         PS_SPLIT_HOT_RATIO))
+    if min_total_rows is None:
+        min_total_rows = float(os.environ.get("EASYDL_PS_SPLIT_MIN_ROWS",
+                                              PS_SPLIT_MIN_ROWS))
+    if max_shards is None:
+        max_shards = int(os.environ.get("EASYDL_PS_SPLIT_MAX_SHARDS",
+                                        PS_SPLIT_MAX_SHARDS))
+
+    from easydl_tpu.obs.scrape import merge_snapshot
+    from easydl_tpu.ps import registry as ps_registry
+
+    rt = ps_registry.routing_table(workdir)
+    if rt.get("plan"):
+        return None
+    smap = ps_registry.shard_map(workdir)
+    num_shards = int(rt.get("num_shards", 0))
+    if num_shards <= 0:
+        if not smap:
+            return None
+        num_shards = max(int(d["num_shards"]) for d in smap.values())
+    try:
+        snap = merge_snapshot(workdir=workdir)
+    except Exception:
+        return None
+    # Per-service, filtered to the COMMITTED generation's pods — not the
+    # blind merge: after a reshard the superseded sources are gated but
+    # alive, still exporting easydl_ps_table_rows under the same shard
+    # labels, and last-write-wins across exporters would hand the
+    # decision phantom (pre-split) counts.
+    committed = {f"ps-{d['pod']}" for d in smap.values() if d.get("pod")}
+    rows_re = _re.compile(r'^easydl_ps_table_rows\{.*shard="(\d+)"')
+    shard_rows: Dict[int, float] = {}
+    for component, svc in (snap.get("services") or {}).items():
+        if component not in committed:
+            continue
+        for series, value in (svc.get("metrics") or {}).items():
+            m2 = rows_re.match(series)
+            if m2:
+                s = int(m2.group(1))
+                shard_rows[s] = shard_rows.get(s, 0.0) + float(value)
+    return ps_split_decision(shard_rows, num_shards, hot_ratio=hot_ratio,
+                             min_total_rows=min_total_rows,
+                             max_shards=max_shards)

@@ -32,6 +32,7 @@ import argparse
 import os
 import signal
 import sys
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -59,21 +60,35 @@ def probe_alive(address: str, timeout: float = 5.0, attempts: int = 2) -> bool:
     read as death. (Hijack is additionally bounded by the epoch fence now:
     a wrongly-rescued live shard gets fenced, clients reroute, and its
     WAL is replayed — but the probe stays conservative.)
-    ``EASYDL_PS_PROBE_TIMEOUT_S`` overrides the per-attempt timeout (chaos
-    drills shrink it so a SIGSTOP'd zombie is declared dead quickly)."""
+
+    ``EASYDL_PS_PROBE_TIMEOUT_S`` overrides the per-attempt timeout and
+    ``EASYDL_PS_PROBE_RETRIES`` the attempt count (chaos drills shrink
+    them so a SIGSTOP'd zombie is declared dead quickly; a flaky network
+    raises them). The verdict and its latency are logged per probe —
+    slow-rescue triage reads this line instead of attaching a debugger."""
     from easydl_tpu.proto import easydl_pb2 as pb
 
     timeout = float(os.environ.get("EASYDL_PS_PROBE_TIMEOUT_S", timeout))
+    attempts = max(1, int(os.environ.get("EASYDL_PS_PROBE_RETRIES",
+                                         attempts)))
+    t0 = time.monotonic()
+    last = ""
     for attempt in range(attempts):
         client = RpcClient(PS_SERVICE, address, timeout=timeout)
         try:
             client.Stats(pb.PsStatsRequest())
+            log.info("probe %s: ALIVE in %.3fs (attempt %d/%d)", address,
+                     time.monotonic() - t0, attempt + 1, attempts)
             return True
-        except Exception:
+        except Exception as e:
+            last = repr(e)
             if attempt + 1 < attempts:
                 time.sleep(0.5)
         finally:
             client.close()
+    log.info("probe %s: DEAD after %.3fs (%d attempt(s), timeout %.1fs "
+             "each; last: %s)", address, time.monotonic() - t0, attempts,
+             timeout, last)
     return False
 
 
@@ -126,6 +141,72 @@ def claim_orphan_shard(workdir: str, pod: str, orphans,
         if _locked_claim(path, take).get("pod") == pod:
             return s, path
     return None, None
+
+
+def release_claim(claim_path: str, pod: str) -> bool:
+    """Drop our claim file after a clean publish: the claim exists to
+    serialize RESCUES, and once the shard is served (published, clients
+    routed) it has done its job — leaving it would make the next rescue
+    of this shard wait out the staleness window before stealing. Owner-
+    checked under the flock (a thief's claim must survive us); the unlink
+    races nothing: a concurrent O_EXCL creator simply gets a fresh file.
+    Returns True when the file was actually removed.
+
+    The ownership check and the unlink happen under ONE hold of the
+    flock: a check-then-remove would let a steal land in between and our
+    unlink would destroy the thief's claim. (A waiter blocked on the
+    flock when we unlink holds the dead inode's lock — harmless: its
+    mutation writes to an unlinked file, and its publish-time ownership
+    re-check runs against the fresh claim file.)"""
+    import fcntl
+    import json as _json
+
+    try:
+        with open(claim_path, "r+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                try:
+                    doc = _json.load(f)
+                except ValueError:
+                    doc = {}
+                if doc.get("pod") != pod:
+                    return False
+                os.remove(claim_path)
+                return True
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+    except OSError:
+        return False
+
+
+def _gate_watchdog(shard, workdir: str, plan_generation: int,
+                   interval: float = 2.0) -> None:
+    """Un-gate a push-gated source pod whose migration was aborted behind
+    its back. A pod that gated itself against an in-flight plan but was
+    not yet in the committed ``shard_map`` at rollback time (a rescuer
+    mid-publish) never receives the rollback's ``ReshardResume`` — so it
+    watches the plan itself: the plan vanishing WITHOUT the routing
+    generation reaching it means abort, and the gate must lift or the
+    shard is permanently unavailable. The plan committing (generation
+    reaches ours) correctly leaves the gate down — the generation this
+    pod serves is superseded."""
+    while shard._cutover:
+        try:
+            rt = registry.routing_table(workdir)
+        except OSError:
+            time.sleep(interval)
+            continue
+        plan = rt.get("plan")
+        if plan and int(plan.get("generation", -1)) == plan_generation:
+            time.sleep(interval)  # still in flight
+            continue
+        if int(rt.get("generation", 0)) >= plan_generation:
+            return  # committed: stay gated, we are superseded
+        log.warning("reshard plan generation %d vanished uncommitted "
+                    "(rollback missed this pod) — lifting the push gate",
+                    plan_generation)
+        shard.reshard_resume()
+        return
 
 
 def claim_heartbeat(claim_path: str, pod: str, stop, interval: float) -> None:
@@ -261,6 +342,14 @@ def main() -> None:
                          "pods) or inherited from the replaced pod")
     ap.add_argument("--replaces",
                     default=os.environ.get("EASYDL_REPLACES", ""))
+    ap.add_argument("--reshard-dest", action="store_true",
+                    default=bool(os.environ.get("EASYDL_RESHARD_DEST")),
+                    help="this pod is a DESTINATION shard of an in-flight "
+                         "online reshard (ps/reshard.py): skip rescue/claim "
+                         "discovery, publish under the migration plan's "
+                         "routing generation (invisible to clients until "
+                         "the coordinator commits), and wait for the "
+                         "coordinator's Restore/ReshardReplay RPCs")
     ap.add_argument("--ready-file", default="",
                     help="touched once serving (and any handoff) is "
                          "complete — the pod backend's readiness gate")
@@ -279,7 +368,19 @@ def main() -> None:
 
     old = None
     rescued, claim_path = False, None
-    if args.replaces:
+    if args.reshard_dest:
+        # Migration destination: the shard index is assigned by the
+        # coordinator (argv or the name's trailing index), never rescued —
+        # its rows arrive via the coordinator's Restore + ReshardReplay,
+        # not from this workdir's ps-ckpt (which belongs to the SOURCE
+        # generation's lineage until the post-commit save).
+        num_shards = args.num_shards
+        index = (args.shard_index if args.shard_index >= 0
+                 else shard_index_from_name(args.name))
+        if index is None or not 0 <= index < num_shards:
+            ap.error("--reshard-dest needs a shard index (argv or a "
+                     "numeric name suffix) in [0, num_shards)")
+    elif args.replaces:
         # The shard identity is inherited from the pod being replaced — the
         # operator names replacements with a fresh trailing index, so the
         # name is NOT the shard.
@@ -295,7 +396,10 @@ def main() -> None:
             )
     from easydl_tpu.obs import tracing
 
-    tracing.configure(f"ps-{index}", args.workdir)
+    # Trace/exporter identity is the POD, not the shard index: indices are
+    # shared across reshard generations (source, rescuer, destinations),
+    # and per-process artifact files keyed by index would collide.
+    tracing.configure(f"ps-{args.name}", args.workdir)
     # Fencing epoch: strictly monotonic per shard, taken by every
     # incarnation before it serves — pushes stamped with any OTHER epoch
     # are rejected retriably, and the first evidence of a successor (a
@@ -303,6 +407,13 @@ def main() -> None:
     # good. The WAL lives under an epoch-named dir so a zombie predecessor
     # and its rescuer never write to the same segment files.
     epoch = registry.bump_epoch(args.workdir, index)
+    # The routing generation this pod publishes under: a DECLARED reshard
+    # destination publishes under the in-flight plan's generation —
+    # invisible to clients until the coordinator commits; everyone else
+    # under the committed one (shard-count coincidence with a plan target
+    # is deliberately not enough — see generation_for_publication).
+    route_gen = registry.generation_for_publication(
+        args.workdir, num_shards, dest=args.reshard_dest)
     shard = PsShard(
         shard_index=index, num_shards=num_shards, epoch=epoch,
         wal_root=os.path.join(args.workdir, "ps-wal", f"shard-{index}"),
@@ -312,8 +423,10 @@ def main() -> None:
         # harness's verify dumps, ad-hoc Save RPCs — must leave the log
         # intact or a later failure rescue silently loses those pushes.
         rescue_dir=os.path.join(args.workdir, "ps-ckpt"),
+        route_generation=route_gen,
     )
-    server = shard.serve(port=args.port, obs_workdir=args.workdir)
+    server = shard.serve(port=args.port, obs_workdir=args.workdir,
+                         obs_name=f"ps-{args.name}")
     log.info("ps pod %s serving shard %d/%d on %s",
              args.name, shard.shard_index, num_shards, server.address)
 
@@ -373,6 +486,39 @@ def main() -> None:
             log.warning("rescue of shard %d truncated %d torn wal tail(s)",
                         index, stats["torn"])
 
+    if not args.reshard_dest:
+        # A SOURCE-generation pod coming up while a reshard plan is in
+        # flight starts push-GATED (the same gate ReshardCutover sets):
+        # by the time a mid-migration rescue serves, some destination may
+        # already have replayed this shard's WAL tail — a push accepted
+        # here now would be invisible to that replay and silently lost at
+        # commit. Gated, the push bounces with a retriable `stale-route`
+        # until the coordinator either commits (client re-partitions onto
+        # the new set) or aborts (its rollback sends ReshardResume, which
+        # lifts the gate). The coordinator's cutover phase re-resolves
+        # this rescuer from the registry, so the migration completes
+        # through it rather than stalling on the dead predecessor.
+        plan = registry.routing_table(args.workdir).get("plan")
+        if plan and int(plan.get("from_shards", -1)) == num_shards:
+            shard.cutover()
+            log.warning("ps pod %s (shard %d/%d) starts push-gated: "
+                        "reshard plan generation %s is in flight",
+                        args.name, index, num_shards,
+                        plan.get("generation"))
+            # Gate watchdog: the rollback of an aborted migration sends
+            # ReshardResume to the COMMITTED shard_map — a rescuer that
+            # gated itself here but had not yet published is invisible to
+            # it and would stay gated forever with no coordinator left to
+            # un-gate it. Watch the plan instead: if it disappears
+            # without the routing generation moving (abort, not commit),
+            # lift our own gate. A commit leaves us gated — correctly:
+            # this generation is superseded.
+            threading.Thread(
+                target=_gate_watchdog,
+                args=(shard, args.workdir, int(plan["generation"])),
+                daemon=True, name=f"ps-gate-watchdog-{index}",
+            ).start()
+
     if hb_stop is not None:
         hb_stop.set()
         hb_thread.join(timeout=1.0)
@@ -386,7 +532,8 @@ def main() -> None:
                 f"claim on shard {index} taken over by {owner!r}; exiting"
             )
     registry.publish(args.workdir, args.name, shard.shard_index,
-                     num_shards, server.address, epoch=epoch)
+                     num_shards, server.address, epoch=epoch,
+                     generation=route_gen)
     if claim_path is not None:
         # Close the remaining check-then-publish window: if ownership moved
         # between the check above and our publish, bow out LOUDLY (stop
@@ -399,6 +546,10 @@ def main() -> None:
                 f"claim on shard {index} lost to {owner!r} at publish; "
                 "exiting"
             )
+        # Published and authoritative: the claim has done its job — drop
+        # it so the shard's NEXT rescue starts from a fresh O_EXCL create
+        # instead of waiting out the staleness window to steal ours.
+        release_claim(claim_path, args.name)
     if args.ready_file:
         with open(args.ready_file, "w") as f:
             f.write(server.address)

@@ -38,6 +38,7 @@ from easydl_tpu.ps.server import (
     DRAINING,
     PS_SERVICE,
     STALE_EPOCH,
+    STALE_ROUTE,
     PsShard,
     spec_to_proto,
 )
@@ -52,6 +53,15 @@ from easydl_tpu.utils.retry import (
 from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, RpcClient
 
 log = get_logger("ps", "client")
+
+
+class RoutingChanged(Exception):
+    """Internal control flow for live resharding: the registry committed a
+    new routing-table generation (different shard count / shard set) while
+    an operation was in flight. The failed CHUNK — whose ids the old shard
+    provably never applied (it answered `stale-route`, or the transport
+    died before an ack) is re-dispatched through the NEW partition via the
+    top-level pull/push, which re-routes each id to its new owner."""
 
 
 _client_metrics_cache: Optional[tuple] = None
@@ -91,22 +101,32 @@ class _PsClientBase:
     # Guards lazy pool creation (class-level: trivially race-free; contended
     # only during the one-time init).
     _pool_lock = threading.Lock()
+    # Set while a thread is re-dispatching a chunk through the top-level
+    # pull/push (the RoutingChanged path of a live reshard). Such a thread
+    # IS a bounded-pool worker, so its nested operation must run every
+    # fan-out INLINE: submitting back into the pools from their own
+    # workers deadlocks the moment every worker is a re-dispatcher
+    # waiting for a slot. The ordinary shard-pool → chunk-pool nesting is
+    # unaffected (two different pools, no cycle).
+    _inline_dispatch = threading.local()
 
     # ------------------------------------------------------- coalescing plan
-    def _plan(self, flat: np.ndarray):
-        """(routed, routed_inv, offs) for a flat id batch, cached
-        for the immediately-following call with the SAME ids — the training
-        loop always pushes the exact batch it just pulled, so the sort/
-        unique/partition work is paid once per step, not twice. The key is
-        the full id buffer (exact memcmp, no hashing): a false hit would
-        route gradients to wrong rows, so probabilistic keys are out.
+    def _plan(self, flat: np.ndarray, n: int):
+        """(routed, routed_inv, offs) for a flat id batch under an
+        ``n``-shard partition, cached for the immediately-following call
+        with the SAME ids — the training loop always pushes the exact batch
+        it just pulled, so the sort/unique/partition work is paid once per
+        step, not twice. The key is the shard count plus the full id buffer
+        (exact memcmp, no hashing): a false hit would route gradients to
+        wrong rows, so probabilistic keys are out — and a live reshard
+        changes ``n``, which invalidates every cached plan by construction.
 
         ``routed`` is the unique ids already in shard order (shard s owns
         ``routed[offs[s]:offs[s+1]]``) and ``routed_inv`` maps each batch
         position straight to its routed row — so pull scatters with ONE
         fancy gather and push accumulates directly into routed positions.
         """
-        key = flat.tobytes()
+        key = (n, flat.tobytes())
         # Two entries, not one: the pipelined loop pulls batch k+1 while
         # the write-behind queue pushes batch k, so both plans are live.
         cached = getattr(self, "_plan_cache", ())
@@ -114,53 +134,93 @@ class _PsClientBase:
             if k == key:
                 return plan
         uniq, inv = np.unique(flat, return_inverse=True)
-        order, offs = self._partition(uniq)
+        order, offs = self._partition(uniq, n)
         pos = np.empty(len(uniq), np.int64)
         pos[order] = np.arange(len(uniq), dtype=np.int64)
         plan = (uniq[order], pos[inv], offs)
         self._plan_cache = ((key, plan),) + tuple(cached[:1])
         return plan
 
-    # Subclasses implement the per-shard primitives.
-    def _pull_shard(self, shard: int, table: str, ids: np.ndarray) -> np.ndarray:
+    # Subclasses implement the per-shard primitives. ``route_gen`` is the
+    # routing generation in force when the caller computed its partition
+    # (None when the transport has no routing, e.g. Local): the gRPC
+    # client's retry loops compare it against the live generation and
+    # re-dispatch on a move.
+    def _pull_shard(self, shard: int, table: str, ids: np.ndarray,
+                    route_gen=None) -> np.ndarray:
         raise NotImplementedError
 
     def _push_shard(self, shard: int, table: str, ids: np.ndarray,
-                    grads: np.ndarray, scale: float) -> None:
+                    grads: np.ndarray, scale: float,
+                    route_gen=None) -> None:
         raise NotImplementedError
 
     def _create_shard(self, shard: int, spec: TableSpec) -> None:
         raise NotImplementedError
 
-    def _for_all(self, fn) -> list:
+    def _for_all(self, fn, n: Optional[int] = None) -> list:
         # One persistent pool per client: _for_all runs twice per training
         # step (pull + push), so per-call pool setup/teardown would sit on
         # the hot path. The pipelined PsTrainer loop drives pull and push
         # from different threads, so the lazy init must be locked — two
-        # racing creations would leak an un-shutdown executor.
-        if self.num_shards == 1:
-            return [fn(0)]
-        pool = getattr(self, "_pool", None)
-        if pool is None:
-            with _PsClientBase._pool_lock:
-                pool = getattr(self, "_pool", None)
-                if pool is None:
-                    pool = self._pool = ThreadPoolExecutor(
-                        max_workers=self.num_shards,
-                        thread_name_prefix="ps-client",
-                    )
-        return list(pool.map(fn, range(self.num_shards)))
+        # racing creations would leak an un-shutdown executor. ``n`` pins
+        # the fan-out width for one operation: a routing rebuild swapping
+        # ``self.num_shards`` mid-flight must not widen/narrow a fan-out
+        # whose partition offsets were computed under the old count.
+        if n is None:
+            n = self.num_shards
+        if n == 1 or getattr(_PsClientBase._inline_dispatch, "active",
+                             False):
+            return [fn(s) for s in range(n)]
+        dead = None
+        while True:
+            pool = getattr(self, "_pool", None)
+            if pool is None:
+                with _PsClientBase._pool_lock:
+                    pool = getattr(self, "_pool", None)
+                    if pool is None:
+                        pool = self._pool = ThreadPoolExecutor(
+                            max_workers=max(n, 2),
+                            thread_name_prefix="ps-client",
+                        )
+            try:
+                futures = [pool.submit(fn, s) for s in range(n)]
+            except RuntimeError:
+                # A routing rebuild shut this pool down between our fetch
+                # and the submit; loop to pick up the lazily-recreated one.
+                # Same dead pool twice = the client itself was close()d —
+                # surface that instead of spinning.
+                if pool is dead:
+                    raise
+                dead = pool
+                continue
+            return [f.result() for f in futures]
+
+    @staticmethod
+    def _dispatch_inline(op, *args):
+        """Run a nested top-level pull/push (the reshard re-dispatch) with
+        every fan-out forced inline — see ``_inline_dispatch``. Save/
+        restore, not set/clear: back-to-back routing moves (a 2→4 split
+        then the 4→2 shrink) can nest a re-dispatch inside a re-dispatch,
+        and the inner one's exit must not re-enable pool submission for
+        the still-running outer one."""
+        prev = getattr(_PsClientBase._inline_dispatch, "active", False)
+        _PsClientBase._inline_dispatch.active = True
+        try:
+            return op(*args)
+        finally:
+            _PsClientBase._inline_dispatch.active = prev
 
     # --------------------------------------------------------------- routing
-    def _partition(self, ids: np.ndarray):
+    def _partition(self, ids: np.ndarray, n: int):
         """One stable argsort groups ids by owning shard; returns
         ``(order, offsets)`` such that ``ids[order[offs[s]:offs[s+1]]]`` is
         shard ``s``'s slice. Replaces the O(num_shards · n) boolean-mask
         scans of the old path with O(n log n) once."""
-        owner = shard_of(ids, self.num_shards)
+        owner = shard_of(ids, n)
         order = np.argsort(owner, kind="stable")
-        counts = np.bincount(owner, minlength=self.num_shards)
-        offs = np.zeros(self.num_shards + 1, np.int64)
+        counts = np.bincount(owner, minlength=n)
+        offs = np.zeros(n + 1, np.int64)
         np.cumsum(counts, out=offs[1:])
         return order, offs
 
@@ -188,18 +248,33 @@ class _PsClientBase:
         flat = ids.reshape(-1).astype(np.int64)
         if flat.size == 0:
             return np.zeros(ids.shape + (self._table_dim(table),), np.float32)
+        # Capture the routing generation FIRST, then the shard count:
+        # partition offsets and the fan-out width must agree even if a
+        # live reshard swaps the routing while this pull is in flight
+        # (the stale chunks then re-dispatch through the rebuilt routing
+        # at the chunk level). The generation is the chunks' staleness
+        # check, so it must be the one in force when the partition was
+        # computed — captured at chunk time it could post-date a rebuild
+        # and silently bless an old-count partition against the new shard
+        # set. Rebuilds publish num_shards before the generation, so this
+        # read order can only err toward a spurious (safe, idempotent)
+        # re-dispatch.
+        gen0 = getattr(self, "_route_generation", None)
+        n = self.num_shards
         # Resolve (and cache) the dim ONCE before fanning out: the shard
         # worker threads all consult it for chunk sizing, and a cold cache
         # would otherwise send num_shards concurrent Stats calls at shard 0.
         self._table_dim(table)
         if not self.coalesce:
-            return self._pull_strict(table, ids, flat)
+            return self._pull_strict(table, ids, flat, n, gen0)
         # Dedup before the RPC: every duplicate of a hot id would otherwise
         # ride the wire and hit the store once per occurrence.
-        routed, routed_inv, offs = self._plan(flat)
+        routed, routed_inv, offs = self._plan(flat, n)
         _client_metrics()[0].set(len(routed) / len(flat), table=table)
         parts = self._for_all(
-            lambda s: self._pull_shard(s, table, routed[offs[s]:offs[s + 1]])
+            lambda s: self._pull_shard(s, table, routed[offs[s]:offs[s + 1]],
+                                       gen0),
+            n,
         )
         dim = next((p.shape[-1] for p in parts if p.size),
                    self._table_dim(table))
@@ -216,12 +291,14 @@ class _PsClientBase:
         return rows[routed_inv].reshape(ids.shape + (dim,))
 
     def _pull_strict(self, table: str, ids: np.ndarray,
-                     flat: np.ndarray) -> np.ndarray:
+                     flat: np.ndarray, n: int,
+                     route_gen=None) -> np.ndarray:
         """Pre-coalescing pull (row per batch position on the wire) — the
         parity/bench baseline."""
-        owner = shard_of(flat, self.num_shards)
+        owner = shard_of(flat, n)
         parts = self._for_all(
-            lambda s: self._pull_shard(s, table, flat[owner == s])
+            lambda s: self._pull_shard(s, table, flat[owner == s],
+                                       route_gen), n
         )
         dim = next((p.shape[-1] for p in parts if p.size),
                    self._table_dim(table))
@@ -238,12 +315,16 @@ class _PsClientBase:
         g = np.ascontiguousarray(grads, np.float32).reshape(len(flat), -1)
         if flat.size == 0:
             return
+        # generation-then-count capture order; see pull()
+        gen0 = getattr(self, "_route_generation", None)
+        n = self.num_shards
         if not self.coalesce:
-            owner = shard_of(flat, self.num_shards)
+            owner = shard_of(flat, n)
             self._for_all(
                 lambda s: self._push_shard(
-                    s, table, flat[owner == s], g[owner == s], scale
-                )
+                    s, table, flat[owner == s], g[owner == s], scale, gen0
+                ),
+                n,
             )
             return
         # Pre-accumulate duplicate ids client-side, in batch-occurrence
@@ -253,7 +334,7 @@ class _PsClientBase:
         # id's occurrence sequence), so the optimizer sees the same
         # gradient either way. Accumulation lands directly in routed
         # (shard-order) positions — no post-hoc reorder copy.
-        routed, routed_inv, offs = self._plan(flat)
+        routed, routed_inv, offs = self._plan(flat, n)
         if len(routed) == len(flat):
             acc = np.empty_like(g)  # no duplicates: pure scatter to
             acc[routed_inv] = g     # shard-routed positions
@@ -275,8 +356,9 @@ class _PsClientBase:
         self._for_all(
             lambda s: self._push_shard(
                 s, table, routed[offs[s]:offs[s + 1]],
-                acc[offs[s]:offs[s + 1]], scale
-            )
+                acc[offs[s]:offs[s + 1]], scale, gen0
+            ),
+            n,
         )
 
     def save(self, directory: str, step: int) -> None:
@@ -320,13 +402,13 @@ class LocalPsClient(_PsClientBase):
         except KeyError:
             return 0
 
-    def _pull_shard(self, s, table, ids):
+    def _pull_shard(self, s, table, ids, route_gen=None):
         if ids.size == 0:
             sh = self.shards[s]
             return np.zeros((0, sh.table(table).dim), np.float32)
         return self.shards[s].table(table).pull(ids)
 
-    def _push_shard(self, s, table, ids, grads, scale):
+    def _push_shard(self, s, table, ids, grads, scale, route_gen=None):
         if ids.size:
             self.shards[s].table(table).push(ids, grads, scale)
 
@@ -368,6 +450,7 @@ class ShardedPsClient(_PsClientBase):
                  chunk_bytes: Optional[int] = None):
         self.addresses = list(addresses)
         self.num_shards = len(self.addresses)
+        self._timeout = timeout
         self.coalesce = (_env_flag("EASYDL_PS_COALESCE", True)
                          if coalesce is None else coalesce)
         # Wire format: raw_ids (little-endian int64 bytes) replaces the
@@ -409,28 +492,130 @@ class ShardedPsClient(_PsClientBase):
         # With a registry (ps/registry.py), a gated/unreachable shard is
         # re-resolved from the latest publications mid-retry — the client
         # follows operator-driven replacements without anyone calling
-        # reroute() explicitly.
+        # reroute() explicitly. `_route_generation` is the routing-table
+        # generation the current shard set was built from: when the
+        # registry commits a NEWER one (a live reshard), the whole routing
+        # — addresses, clients, epochs, partition plans, dims — is rebuilt
+        # atomically under `_routing_lock` and in-flight chunks re-dispatch
+        # through the new partition (see RoutingChanged).
         self.registry_workdir = registry_workdir
         self._registry_checked_at = 0.0
+        self._route_generation = 0
+        self._routing_lock = threading.Lock()
         self._clients = [
             RpcClient(PS_SERVICE, a, timeout=timeout,
                       options=GRPC_MSG_OPTIONS) for a in self.addresses
         ]
 
     @classmethod
-    def from_registry(cls, workdir: str, num_shards: int,
+    def from_registry(cls, workdir: str, num_shards: Optional[int] = None,
                       wait_s: float = 60.0, **kwargs) -> "ShardedPsClient":
         """Resolve shard addresses from the pod registry (operator-managed
-        PS clusters publish there; see easydl_tpu/ps/__main__.py)."""
+        PS clusters publish there; see easydl_tpu/ps/__main__.py).
+        ``num_shards=None`` takes the cluster shape from the registry
+        itself (the routing table when one exists, else the publications),
+        so callers need no out-of-band shard count."""
         from easydl_tpu.ps import registry
 
-        addrs = registry.addresses(workdir, num_shards, timeout=wait_s)
+        if num_shards is None:
+            num_shards, addrs = registry.discover(workdir, timeout=wait_s)
+        else:
+            addrs = registry.addresses(workdir, num_shards, timeout=wait_s)
         client = cls(addrs, registry_workdir=workdir, **kwargs)
         smap = registry.shard_map(workdir)
         client._epochs = [
             int(smap.get(s, {}).get("epoch", 0)) for s in range(num_shards)
         ]
+        client._route_generation = registry.committed_generation(workdir)
         return client
+
+    # ------------------------------------------------------ routing refresh
+    def refresh_routing(self) -> bool:
+        """Adopt the registry's committed routing generation if it moved
+        (un-throttled). Returns True when the shard set was rebuilt. The
+        retry loops call this implicitly; explicit calls are for callers
+        about to do shard-shaped work (save/stats) after a possible
+        reshard."""
+        return self._check_routing_generation(force=True)
+
+    def _check_routing_generation(self, force: bool = False) -> bool:
+        """If the registry committed a routing generation NEWER than the
+        one this client's shard set was built from, rebuild the whole
+        routing. Returns True when a rebuild happened."""
+        if not self.registry_workdir:
+            return False
+        from easydl_tpu.ps import registry
+
+        try:
+            rt = registry.routing_table(self.registry_workdir)
+        except OSError:
+            return False
+        gen = int(rt.get("generation", 0))
+        if gen <= self._route_generation:
+            return False
+        n = int(rt.get("num_shards", 0))
+        if n <= 0:
+            return False
+        return self._rebuild_routing(gen, n, force=force)
+
+    def _rebuild_routing(self, gen: int, n: int, force: bool = False) -> bool:
+        from easydl_tpu.ps import registry
+
+        with self._routing_lock:
+            if gen <= self._route_generation:
+                return True  # another thread already rebuilt
+            try:
+                addrs = registry.addresses(self.registry_workdir, n,
+                                           timeout=10.0 if force else 0.0)
+            except TimeoutError:
+                # Committed but not fully published yet (or a publication
+                # race): keep the old routing, the next retry re-checks.
+                return False
+            smap = registry.shard_map(self.registry_workdir)
+            old_clients = self._clients
+            old_pool = getattr(self, "_pool", None)
+            self._clients = [
+                RpcClient(PS_SERVICE, a, timeout=self._timeout,
+                          options=GRPC_MSG_OPTIONS) for a in addrs
+            ]
+            self.addresses = list(addrs)
+            self.num_shards = n
+            self._epochs = [int(smap.get(s, {}).get("epoch", 0))
+                            for s in range(n)]
+            self._raw_capable = [False] * n
+            self._reroute_epoch = [0] * n
+            # A shard-count change invalidates every partition plan and the
+            # dims cache (dims re-resolve via Stats on the new shard 0).
+            self._plan_cache = ()
+            self._dims = {}
+            if old_pool is not None:
+                self._pool = None  # recreated lazily, sized to the new n
+            # Publish the new generation LAST: chunk retry loops key their
+            # "did routing change under me" check on it, and must only see
+            # it move once the new shard set is fully in place.
+            self._route_generation = gen
+        if old_pool is not None:
+            old_pool.shutdown(wait=False)
+        for c in old_clients:
+            c.close()
+        log.info("ps routing rebuilt: generation %d, %d shard(s) (%s)",
+                 gen, n, ", ".join(addrs))
+        return True
+
+    def _reshard_plan_active(self) -> bool:
+        """Whether the registry shows an in-flight reshard plan — the one
+        condition under which a shard may legitimately refuse service for
+        longer than the transient budget (push-gated source awaiting
+        cutover/commit)."""
+        if not self.registry_workdir:
+            return False
+        from easydl_tpu.ps import registry
+
+        try:
+            return bool(registry.routing_table(
+                self.registry_workdir).get("plan"))
+        except OSError:
+            return False
 
     def _maybe_reroute_from_registry(self, shard: int,
                                      force: bool = False) -> bool:
@@ -439,15 +624,37 @@ class ShardedPsClient(_PsClientBase):
         # Throttle: the retry loops call this every ~50ms for the whole
         # drain window; scanning/parsing the registry dir (often network FS)
         # that often is pure waste — publications are seconds apart.
-        # ``force`` bypasses it: a stale-epoch rejection is PROOF the
-        # registry moved, so the refresh must not wait out the throttle.
+        # ``force`` bypasses it: a stale-epoch/stale-route rejection is
+        # PROOF the registry moved, so the refresh must not wait out the
+        # throttle.
         now = time.monotonic()
         if not force and now - self._registry_checked_at < 0.5:
             return False
         self._registry_checked_at = now
         from easydl_tpu.ps import registry
 
-        entry = registry.shard_map(self.registry_workdir).get(shard)
+        # Routing generation first: after a reshard commit, the per-shard
+        # map below describes the NEW shard set — adopting one of its
+        # addresses into an old-generation slot would route a partition
+        # computed under the old shard count at a shard that owns different
+        # ids. A generation move always rebuilds the whole routing.
+        if self._check_routing_generation(force=force):
+            return True
+        if shard >= self.num_shards:
+            return False  # stale index from before a shrink; chunk re-checks
+        # Per-shard reroute is for SAME-generation replacements (a rescue
+        # pod taking over the index) — resolve within the generation THIS
+        # client routes by, never the registry's committed one: the commit
+        # can land between the generation check above and this read, and
+        # the committed map would then hand back the NEW generation's pod
+        # for this index. Adopting it (address + epoch) re-aims an
+        # OLD-partition chunk at a shard that accepts and applies ids it
+        # does not own — rows landing outside the migration lineage, i.e.
+        # silent loss. Cross-generation moves must always go through the
+        # full rebuild (which raises RoutingChanged up the retry loops).
+        entry = registry.shard_map(
+            self.registry_workdir,
+            generation=self._route_generation).get(shard)
         if entry and entry["address"] != self.addresses[shard]:
             try:
                 self.reroute(shard, entry["address"],
@@ -499,9 +706,15 @@ class ShardedPsClient(_PsClientBase):
 
     def _chunk_fan(self, tasks):
         """Run chunk thunks concurrently (shared bounded pool, lazily
-        created under the same class-level lock as the shard pool)."""
-        if len(tasks) == 1:
-            return [tasks[0]()]
+        created under the same class-level lock as the shard pool). From a
+        thread that is ITSELF a fan-out worker (a reshard re-dispatch),
+        run inline — submitting back into the bounded pool from its own
+        workers deadlocks once every worker is a re-dispatcher waiting
+        for a slot."""
+        if (len(tasks) == 1
+                or getattr(_PsClientBase._inline_dispatch, "active",
+                           False)):
+            return [t() for t in tasks]
         pool = self._chunk_pool
         if pool is None:
             with _PsClientBase._pool_lock:
@@ -510,7 +723,8 @@ class ShardedPsClient(_PsClientBase):
                     pool = self._chunk_pool = ThreadPoolExecutor(
                         max_workers=8, thread_name_prefix="ps-chunk",
                     )
-        return [f.result() for f in [pool.submit(t) for t in tasks]]
+        futures = [pool.submit(t) for t in tasks]
+        return [f.result() for f in futures]
 
     def _lookup_dim(self, table):
         try:
@@ -532,17 +746,18 @@ class ShardedPsClient(_PsClientBase):
             kwargs["ids"] = ids.tolist()
         return kwargs
 
-    def _pull_shard(self, s, table, ids):
+    def _pull_shard(self, s, table, ids, route_gen=None):
         if ids.size == 0:
             return np.zeros((0, self._table_dim(table)), np.float32)
         ranges = self._chunks(len(ids), self._table_dim(table))
         parts = self._chunk_fan(
-            [lambda lo=lo, hi=hi: self._pull_chunk(s, table, ids[lo:hi])
+            [lambda lo=lo, hi=hi: self._pull_chunk(s, table, ids[lo:hi],
+                                                   route_gen)
              for lo, hi in ranges]
         )
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
-    def _pull_chunk(self, s, table, ids):
+    def _pull_chunk(self, s, table, ids, route_gen=None):
         # Pulls are read-only — retrying a transient transport failure is
         # unconditionally safe, and without it ONE sporadic UNAVAILABLE
         # (shard crash, connection refused during a pod replacement) killed
@@ -561,29 +776,89 @@ class ShardedPsClient(_PsClientBase):
         # the pre-reroute server arriving after reroute()'s capability
         # reset must not re-arm it for a replacement that may run older
         # code (concurrent chunks make that interleaving real).
-        state = {"epoch": self._reroute_epoch[s]}
+        # len() guard, not num_shards: a concurrent routing rebuild assigns
+        # num_shards before it swaps the per-shard lists, and this read sits
+        # outside the RoutingChanged-mapping try below.
+        state = {"epoch": self._reroute_epoch[s]
+                 if s < len(self._reroute_epoch) else 0}
+        # A live reshard invalidates this chunk's shard index itself (the
+        # ids repartition under the new count): every attempt first checks
+        # the routing generation, and a move re-dispatches the chunk
+        # through the top-level pull — the registry-rebuilt partition then
+        # routes each id to its new owner. Reads are idempotent, so the
+        # re-dispatch is unconditionally safe. The generation is the one
+        # captured by the TOP-LEVEL op next to its shard count (a chunk-
+        # time capture could post-date a rebuild and bless an old-count
+        # partition against the new shard set); None only on internal
+        # callers with no partition at stake.
+        if route_gen is None:
+            route_gen = self._route_generation
 
         def attempt():
-            state["epoch"] = self._reroute_epoch[s]
-            req = pb.PullRequest(
-                table=table,
-                value_dtype="f16" if self.pull_fp16 else "",
-                **self._wire_ids(s, ids),
-            )
-            return self._clients[s].Pull(req)
+            # Generation check and per-shard reads under ONE hold of the
+            # routing lock: checked lock-free, a rebuild completing between
+            # the check and the reads would hand this old-partition chunk a
+            # NEW-generation client+epoch — it would pass the new shard's
+            # fence and read rows it doesn't own. The RPC itself runs
+            # outside the lock (a rebuild mid-RPC closes the old channel,
+            # which surfaces as a retriable transport error).
+            try:
+                with self._routing_lock:
+                    if self._route_generation != route_gen:
+                        raise RoutingChanged()
+                    state["epoch"] = self._reroute_epoch[s]
+                    req = pb.PullRequest(
+                        table=table,
+                        value_dtype="f16" if self.pull_fp16 else "",
+                        **self._wire_ids(s, ids),
+                    )
+                    client = self._clients[s]
+            except IndexError:
+                raise RoutingChanged()  # rebuilt to fewer shards mid-flight
+            return client.Pull(req)
 
         # Span per chunk; utils/retry.py stamps every transient retry as an
         # event inside it, so a slow pull names its retries. No-op with
-        # tracing disabled.
-        with tracing.start_span("ps_pull", shard=s, table=table,
-                                ids=int(ids.size)):
-            resp = retry_transient(
-                attempt,
-                max_elapsed_s=self.transient_retry_s,
-                on_retry=lambda e: self._maybe_reroute_from_registry(s),
-                describe=f"ps shard {s} pull",
-            )
-        if resp.dtype and self._reroute_epoch[s] == state["epoch"]:
+        # tracing disabled. The outer loop is the live-reshard ride-out:
+        # a push-gated source (a rescue born mid-plan, or the brief
+        # cutover→commit window) aborts pulls UNAVAILABLE for as long as
+        # the migration runs, which can legitimately exceed the transient
+        # budget sized for dead-shard detection — so an exhausted budget
+        # only becomes a hard failure once no reshard plan is in flight
+        # (or the overall drain budget, the same bound pushes get, is
+        # spent). Pulls are idempotent, so re-entering the retry is free.
+        try:
+            ride_deadline = time.monotonic() + max(self.drain_retry_s,
+                                                   self.transient_retry_s)
+            while True:
+                try:
+                    with tracing.start_span("ps_pull", shard=s, table=table,
+                                            ids=int(ids.size)):
+                        resp = retry_transient(
+                            attempt,
+                            max_elapsed_s=self.transient_retry_s,
+                            on_retry=lambda e:
+                                self._maybe_reroute_from_registry(s),
+                            describe=f"ps shard {s} pull",
+                        )
+                    break
+                except RoutingChanged:
+                    raise
+                except Exception as e:
+                    if (not _is_transport_error(e)
+                            or time.monotonic() > ride_deadline
+                            or not self._reshard_plan_active()):
+                        raise
+        except RoutingChanged:
+            # Inline: this thread is a chunk/shard pool worker — the nested
+            # pull must not submit back into the bounded pools (deadlock
+            # once every worker is a re-dispatcher waiting for a slot).
+            return np.ascontiguousarray(
+                self._dispatch_inline(self.pull, table, ids)
+                .reshape(len(ids), -1))
+        if (s < len(self._reroute_epoch) and resp.dtype
+                and self._reroute_epoch[s] == state["epoch"]
+                and self._route_generation == route_gen):
             # A dtype-bearing response is the raw-capability handshake:
             # later requests to this shard drop the duplicate legacy list.
             self._raw_capable[s] = True
@@ -593,7 +868,7 @@ class ShardedPsClient(_PsClientBase):
             vals = np.frombuffer(resp.values, "<f4")
         return vals.reshape(len(ids), resp.dim)
 
-    def _push_shard(self, s, table, ids, grads, scale):
+    def _push_shard(self, s, table, ids, grads, scale, route_gen=None):
         if ids.size == 0:
             return
         # Chunking is safe ONLY on the coalesced path, where ids are unique:
@@ -609,11 +884,11 @@ class ShardedPsClient(_PsClientBase):
                   if self.coalesce else [(0, len(ids))])
         self._chunk_fan(
             [lambda lo=lo, hi=hi: self._push_chunk(
-                s, table, ids[lo:hi], grads[lo:hi], scale)
+                s, table, ids[lo:hi], grads[lo:hi], scale, route_gen)
              for lo, hi in ranges]
         )
 
-    def _push_chunk(self, s, table, ids, grads, scale):
+    def _push_chunk(self, s, table, ids, grads, scale, route_gen=None):
         grads_bytes = grads.tobytes()
 
         def make_req():
@@ -636,17 +911,51 @@ class ShardedPsClient(_PsClientBase):
         span = tracing.start_span("ps_push", shard=s, table=table,
                                   ids=int(ids.size))
         try:
-            self._push_with_retries(s, make_req, deadline, span)
+            # The staleness baseline is the TOP-LEVEL op's captured
+            # generation (see pull) — a chunk-time capture could post-date
+            # a rebuild and bless an old-count partition.
+            self._push_with_retries(
+                s, make_req, deadline, span,
+                self._route_generation if route_gen is None else route_gen)
+        except RoutingChanged:
+            # Live reshard: this chunk's ids repartition under the new
+            # shard count — and possibly across SEVERAL new shards — so the
+            # per-shard loop cannot simply re-aim. Re-dispatch through the
+            # top-level push, which re-partitions under the rebuilt
+            # routing. Exactly-once: the old shard rejected the chunk
+            # (`stale-route`, applied nothing) or the transport died before
+            # an ack — and a WAL'd-but-unacked apply is recognised by the
+            # destination's replay-digest dedupe.
+            span.add_event("rerouted-reshard")
+            # Inline for the same reason as the pull re-dispatch: no pool
+            # re-entry from a pool worker.
+            self._dispatch_inline(self.push, table, ids, grads, scale)
         finally:
             span.end()
 
-    def _push_with_retries(self, s, make_req, deadline, span):
+    def _push_with_retries(self, s, make_req, deadline, span,
+                           route_gen=None):
         transport_fails = 0
         last_ack = ""  # the last retriable Ack.message, for error context
         while True:
+            # Snapshot under the routing lock — same rationale as the pull
+            # attempt: the generation check and the client/epoch reads
+            # must come from ONE routing state, or a rebuild landing
+            # between them sends this old-partition chunk to a
+            # new-generation shard that will accept and misapply it.
             try:
-                # re-read client AND rebuild request: reroute may swap both
-                ack = self._clients[s].Push(make_req())
+                with self._routing_lock:
+                    if (route_gen is not None
+                            and self._route_generation != route_gen):
+                        raise RoutingChanged()
+                    # re-read client AND rebuild request: reroute may swap
+                    # both
+                    client = self._clients[s]
+                    req = make_req()
+            except IndexError:
+                raise RoutingChanged()  # rebuilt to fewer shards mid-flight
+            try:
+                ack = client.Push(req)
             except Exception as e:
                 # Transport failure mid-handoff: reroute() may close the old
                 # client while this retry loop holds it (the next iteration
@@ -661,8 +970,10 @@ class ShardedPsClient(_PsClientBase):
                 if not _is_transport_error(e):
                     raise
                 if time.monotonic() > deadline:
+                    addr = (self.addresses[s] if s < len(self.addresses)
+                            else "?")
                     raise RuntimeError(
-                        f"ps shard {s} ({self.addresses[s]}) unreachable "
+                        f"ps shard {s} ({addr}) unreachable "
                         f"past {self.drain_retry_s}s: {e}"
                         + (f"; last ack: {last_ack!r}" if last_ack else "")
                     ) from e
@@ -681,7 +992,9 @@ class ShardedPsClient(_PsClientBase):
             if ack.ok:
                 return
             retriable_fence = ack.message.startswith(STALE_EPOCH)
-            if not (ack.message.startswith(DRAINING) or retriable_fence):
+            retriable_route = ack.message.startswith(STALE_ROUTE)
+            if not (ack.message.startswith(DRAINING) or retriable_fence
+                    or retriable_route):
                 raise RuntimeError(f"ps shard {s} push failed: {ack.message}")
             last_ack = ack.message
             if time.monotonic() > deadline:
@@ -694,11 +1007,18 @@ class ShardedPsClient(_PsClientBase):
                     f"pushes past {self.drain_retry_s}s with no reroute; "
                     f"last ack: {last_ack!r}"
                 )
-            span.add_event("fence" if retriable_fence else "draining")
-            # A stale-epoch Ack is proof the registry moved on: refresh
-            # immediately (bypass the reroute throttle) so the retried
-            # push carries the successor's route + epoch.
-            self._maybe_reroute_from_registry(s, force=retriable_fence)
+            span.add_event("fence" if retriable_fence
+                           else "stale-route" if retriable_route
+                           else "draining")
+            # A stale-epoch/stale-route Ack is proof the registry moved on:
+            # refresh immediately (bypass the reroute throttle) so the
+            # retried push carries the successor's route + epoch — or, for
+            # stale-route, so the routing-generation rebuild fires the
+            # moment the reshard coordinator commits (the gen check at the
+            # loop top then raises RoutingChanged and the chunk
+            # re-partitions).
+            self._maybe_reroute_from_registry(
+                s, force=retriable_fence or retriable_route)
             time.sleep(0.05)
 
     # ------------------------------------------------------------- migration

@@ -19,11 +19,24 @@ recognised as superseded — the server rejects pushes whose stamped epoch
 does not match its own (ps/server.py), and fences itself permanently on
 proof of a successor.
 
+Since the live-resharding PR the registry also holds the **routing
+table** (``routing.json``): the committed ``(generation, num_shards)``
+pair every client routes by, plus — while a reshard is in flight — the
+migration *plan* (target shard count, the new generation, the claiming
+coordinator). Publications carry the generation they serve, and
+:func:`shard_map` filters to the committed generation, so a half-built
+destination shard set is invisible to clients until the coordinator
+commits the cutover — and a superseded source set becomes invisible the
+instant it does. The per-shard epoch counters are shared across
+generations (one monotonic lineage per shard *index*), which is what
+lets the same fencing machinery arbitrate a source, its rescuer, and
+the destination that inherits the index.
+
 Atomic single-file writes (tmp + rename) on a shared workdir for the
-entries; the epoch counter is the one piece that genuinely needs
-read-modify-write, so it reuses the in-place flock idiom of the claim
-files (stable inode — a rename-based update would drop the lock's
-protection).
+entries; the epoch counter and the routing table are the pieces that
+genuinely need read-modify-write, so they reuse the in-place flock idiom
+of the claim files (stable inode — a rename-based update would drop the
+lock's protection).
 """
 
 from __future__ import annotations
@@ -78,11 +91,15 @@ def _dir(workdir: str) -> str:
 
 
 def publish(workdir: str, pod: str, shard: int, num_shards: int,
-            address: str, epoch: int = 0) -> str:
+            address: str, epoch: int = 0, generation: int = 0) -> str:
     """Publish/overwrite this pod's registry entry; returns the file path.
 
     ``epoch`` is the fencing token from :func:`bump_epoch`; 0 means the
-    publisher predates fencing (readers treat it as the lowest epoch)."""
+    publisher predates fencing (readers treat it as the lowest epoch).
+    ``generation`` is the routing-table generation this pod serves
+    (:func:`generation_for_publication`); readers resolve shards within
+    ONE generation, so a reshard's destination set stays invisible to
+    clients until the coordinator commits the new generation."""
     os.makedirs(_dir(workdir), exist_ok=True)
     path = os.path.join(_dir(workdir), f"ps-{pod}.json")
     doc = {
@@ -91,6 +108,7 @@ def publish(workdir: str, pod: str, shard: int, num_shards: int,
         "num_shards": int(num_shards),
         "address": address,
         "epoch": int(epoch),
+        "generation": int(generation),
         "pid": os.getpid(),
         "published_at": time.time(),
     }
@@ -130,6 +148,191 @@ def shard_epoch(workdir: str, shard: int) -> int:
     return int(locked_mutate(path, lambda doc: None).get("epoch", 0))
 
 
+# ------------------------------------------------------------ routing table
+#: The one file clients route by: committed ``generation``/``num_shards``
+#: plus, while a reshard is in flight, the migration ``plan``. Lives next
+#: to the publications; mutated only under its flock (locked_mutate).
+ROUTING_FILE = "routing.json"
+
+
+def _routing_path(workdir: str) -> str:
+    return os.path.join(_dir(workdir), ROUTING_FILE)
+
+
+def routing_table(workdir: str) -> dict:
+    """The routing doc as-is ({} when the job predates routing tables —
+    readers then treat the committed generation as 0)."""
+    return locked_mutate(_routing_path(workdir), lambda doc: None)
+
+
+def committed_generation(workdir: str) -> int:
+    return int(routing_table(workdir).get("generation", 0))
+
+
+def generation_for_publication(workdir: str, num_shards: int,
+                               dest: bool = False) -> int:
+    """Which generation a pod serving ``num_shards`` shards publishes
+    under. ``dest`` is the pod's EXPLICIT destination role
+    (``--reshard-dest``): only a declared destination may publish under
+    an in-flight plan's generation — shard-count coincidence must not be
+    enough, or an ordinary pod whose count happens to equal a later
+    plan's target (a 4→2 shrink while generation-0 ran 2 shards) would
+    silently publish into the uncommitted destination set, un-gated.
+
+    Non-destination pods always publish under the committed generation.
+    A destination publishes under the matching in-flight plan's
+    generation; after the commit (e.g. a destination pod restarting) the
+    committed generation IS its generation — matched by shard count.
+    Anything else is a config error and raises."""
+    doc = routing_table(workdir)
+    plan = doc.get("plan")
+    if not dest:
+        return int(doc.get("generation", 0))
+    if plan and int(plan.get("to_shards", -1)) == int(num_shards):
+        return int(plan["generation"])
+    if int(doc.get("num_shards", 0)) == int(num_shards):
+        return int(doc.get("generation", 0))
+    raise ValueError(
+        f"reshard destination serving {num_shards} shards matches neither "
+        f"the in-flight plan ({plan and plan.get('to_shards')}) nor the "
+        f"committed routing ({doc.get('num_shards')})")
+
+
+def begin_reshard(workdir: str, from_shards: int, to_shards: int,
+                  owner: str, stale_s: float = 600.0) -> Optional[dict]:
+    """Claim the (single) reshard slot and write the migration plan:
+    generation ``committed+1``, target ``to_shards``. Returns the plan
+    dict, or None when another coordinator's plan is active. A plan whose
+    ``t`` is older than ``stale_s`` with no commit is presumed abandoned
+    (the coordinator died mid-migration) and stolen — the age re-check and
+    the overwrite are one atomic mutation under the routing flock, the
+    same discipline as the shard-claim files."""
+    if int(to_shards) <= 0:
+        raise ValueError(f"to_shards must be positive, got {to_shards}")
+    if int(to_shards) == int(from_shards):
+        raise ValueError(
+            f"reshard {from_shards}->{to_shards} is a no-op (and would make "
+            "the destination set indistinguishable from the source set)")
+    path = _routing_path(workdir)
+    os.makedirs(_dir(workdir), exist_ok=True)
+    try:  # O_EXCL create so the first plan has a file to flock
+        os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+    except FileExistsError:
+        pass
+    out: Dict[str, Optional[dict]] = {"plan": None}
+
+    def mutate(doc):
+        plan = doc.get("plan")
+        if plan and time.time() - float(plan.get("t", 0)) <= stale_s:
+            return None  # an active migration owns the slot
+        gen = int(doc.get("generation", 0))
+        committed = int(doc.get("num_shards") or from_shards)
+        out["plan"] = {
+            "generation": gen + 1,
+            "from_shards": committed,
+            "to_shards": int(to_shards),
+            "owner": owner,
+            "t": time.time(),
+        }
+        return {"generation": gen, "num_shards": committed,
+                "plan": out["plan"]}
+
+    locked_mutate(path, mutate)
+    if out["plan"] is not None:
+        log.info("reshard plan claimed by %r: %d -> %d shards (generation "
+                 "%d)", owner, out["plan"]["from_shards"],
+                 out["plan"]["to_shards"], out["plan"]["generation"])
+    return out["plan"]
+
+
+def touch_reshard(workdir: str, owner: str) -> bool:
+    """Refresh the in-flight plan's timestamp — the coordinator's
+    liveness heartbeat, the same role claim_heartbeat plays for shard
+    claims. Without it a healthy migration whose phase budgets sum past
+    ``stale_s`` would be stolen mid-flight, and the loser's rollback
+    would un-gate sources the thief already cut over. Owner-checked;
+    returns False (without touching anything) when the plan is gone or
+    stolen — the next owner-checked operation will fail loudly."""
+    touched: Dict[str, bool] = {"v": False}
+
+    def mutate(doc):
+        plan = doc.get("plan")
+        if not plan or plan.get("owner") != owner:
+            return None
+        plan["t"] = time.time()
+        touched["v"] = True
+        return doc
+
+    locked_mutate(_routing_path(workdir), mutate)
+    return touched["v"]
+
+
+def commit_reshard(workdir: str, owner: str) -> dict:
+    """Atomically switch the committed routing to the plan's generation /
+    shard count — the cutover instant every client converges on. Only the
+    plan's owner may commit; raises on a lost/stolen plan rather than
+    committing someone else's migration."""
+    state: Dict[str, object] = {}
+
+    def mutate(doc):
+        plan = doc.get("plan")
+        if not plan or plan.get("owner") != owner:
+            state["error"] = (f"no reshard plan owned by {owner!r} "
+                              f"(found {plan!r})")
+            return None
+        new = {"generation": int(plan["generation"]),
+               "num_shards": int(plan["to_shards"])}
+        state["doc"] = new
+        return new
+
+    locked_mutate(_routing_path(workdir), mutate)
+    if "error" in state:
+        raise RuntimeError(f"commit_reshard: {state['error']}")
+    log.info("reshard committed: routing generation %d, %d shards",
+             state["doc"]["generation"], state["doc"]["num_shards"])
+    return state["doc"]  # type: ignore[return-value]
+
+
+def abort_reshard(workdir: str, owner: str) -> bool:
+    """Drop an in-flight plan (rollback: the committed routing is
+    untouched, clients never left the source set). Owner-checked; returns
+    True when a plan was actually dropped."""
+    dropped: Dict[str, bool] = {"v": False}
+
+    def mutate(doc):
+        plan = doc.get("plan")
+        if not plan or plan.get("owner") != owner:
+            return None
+        dropped["v"] = True
+        return {k: v for k, v in doc.items() if k != "plan"}
+
+    locked_mutate(_routing_path(workdir), mutate)
+    if dropped["v"]:
+        log.warning("reshard plan owned by %r aborted; committed routing "
+                    "unchanged", owner)
+    return dropped["v"]
+
+
+def _published_by_dead_local_pid(doc: dict) -> bool:
+    """True when the entry's publisher is provably dead: a single-host
+    (``localhost``) publication whose recorded pid no longer exists. Any
+    doubt (other host, no pid, permissions) reads as alive — the filter
+    must never hide a live shard."""
+    try:
+        addr = str(doc.get("address", ""))
+        pid = int(doc.get("pid", 0))
+        if not addr.startswith("localhost:") or pid <= 0:
+            return False
+        if pid == os.getpid():
+            return False
+        os.kill(pid, 0)
+        return False
+    except ProcessLookupError:
+        return True
+    except (OSError, ValueError, PermissionError, TypeError):
+        return False  # alive-but-not-ours, or malformed: leave it
+
+
 def sweep_stale(workdir: str) -> int:
     """Drop publications whose publishing process is dead; returns the
     number removed.
@@ -155,22 +358,15 @@ def sweep_stale(workdir: str) -> int:
         try:
             with open(path) as f:
                 doc = json.load(f)
-            addr = str(doc.get("address", ""))
-            pid = int(doc.get("pid", 0))
-            if not addr.startswith("localhost:") or pid <= 0:
-                continue
-            if pid == os.getpid():
-                continue
-            os.kill(pid, 0)  # raises ProcessLookupError when dead
-        except ProcessLookupError:
+        except (OSError, ValueError):
+            continue  # torn file: leave it
+        if _published_by_dead_local_pid(doc):
             try:
                 os.remove(path)
                 removed += 1
                 log.info("swept stale ps publication %s (pid dead)", name)
             except OSError:
                 pass
-        except (OSError, ValueError, PermissionError):
-            continue  # torn file, or alive-but-not-ours: leave it
     return removed
 
 
@@ -197,12 +393,24 @@ def entry_for_pod(workdir: str, pod: str) -> Optional[dict]:
     return entries(workdir).get(pod)
 
 
-def shard_map(workdir: str) -> Dict[int, dict]:
-    """shard index -> the authoritative entry for the shard: highest epoch
-    wins (the fencing order), publish time breaks ties among epoch-less
-    legacy entries."""
+def shard_map(workdir: str,
+              generation: Optional[int] = None) -> Dict[int, dict]:
+    """shard index -> the authoritative entry for the shard, within ONE
+    routing generation (default: the committed one — mid-reshard that is
+    still the source set, so clients never adopt a half-built destination
+    shard). Within the generation the highest epoch wins (the fencing
+    order), publish time breaks ties among epoch-less legacy entries.
+    Entries whose publishing process is provably dead (localhost pid gone)
+    are filtered at read time: ``sweep_stale`` only runs at pod startup,
+    and a reroute mid-job must never adopt a ghost."""
+    if generation is None:
+        generation = committed_generation(workdir)
     latest: Dict[int, dict] = {}
     for doc in entries(workdir).values():
+        if int(doc.get("generation", 0)) != int(generation):
+            continue
+        if _published_by_dead_local_pid(doc):
+            continue
         s = int(doc["shard"])
         key = (int(doc.get("epoch", 0)), doc["published_at"])
         if s not in latest or key > (int(latest[s].get("epoch", 0)),
@@ -213,20 +421,22 @@ def shard_map(workdir: str) -> Dict[int, dict]:
 
 def discover(workdir: str, timeout: float = 120.0) -> Tuple[int, Tuple[str, ...]]:
     """Learn the cluster shape from the registry itself: wait (one deadline)
-    until some pod has published — its entry carries ``num_shards`` — and
-    every shard of that count is present. Returns (num_shards, addresses)."""
+    until the shape is known — the routing table's committed ``num_shards``
+    when one exists, else some pod's published ``num_shards`` — and every
+    shard of that count is present in the committed generation. Returns
+    (num_shards, addresses)."""
     deadline = time.monotonic() + timeout
     while True:
-        ents = entries(workdir)
-        if ents:
-            n = max(int(d["num_shards"]) for d in ents.values())
-            m = shard_map(workdir)
+        m = shard_map(workdir)
+        if m:
+            n = int(routing_table(workdir).get("num_shards", 0) or
+                    max(int(d["num_shards"]) for d in m.values()))
             if all(s in m for s in range(n)):
                 return n, tuple(m[s]["address"] for s in range(n))
         if time.monotonic() >= deadline:
             raise TimeoutError(
                 f"ps registry under {workdir} incomplete after {timeout:.0f}s"
-                f" ({len(ents)} publication(s))"
+                f" ({len(m)} live publication(s))"
             )
         time.sleep(0.1)
 

@@ -1,0 +1,337 @@
+"""Online PS resharding N→M: the migration coordinator.
+
+Composes the PR-6 primitives — per-shard WAL with a foreign-id replay
+filter, monotonic shard epochs, registry-confirmed fencing, retriable
+Acks — into a zero-loss live migration of a serving PS tier to a new
+shard count, without stopping the push stream. The protocol, in the
+order :func:`run_reshard` drives it:
+
+1. **plan** — claim the single reshard slot in the registry's routing
+   table (:func:`registry.begin_reshard`): generation ``committed+1``,
+   target shard count, owner. A second coordinator gets ``None`` back; a
+   plan whose owner died is stolen after ``stale_s``.
+2. **export** — every *source* shard cuts a snapshot + WAL boundary
+   under its ordering lock (``ReshardExport``) and writes its rows into
+   ``<workdir>/ps-reshard/gen-<g>``. Pushes KEEP flowing: everything
+   after the cut lands in the WAL tail.
+3. **destinations** — the new shard set (fresh pods, ``--reshard-dest``)
+   publishes under the PLAN's generation, invisible to clients
+   (``registry.shard_map`` filters to the committed generation).
+4. **restore** — each destination restores the export; the existing
+   reshard-on-restore filter keeps only ids that hash to it under the
+   NEW count.
+5. **cutover** — each source gates pushes for good (``ReshardCutover``,
+   retriable ``stale-route`` Acks) and fsyncs its WAL: the tail is now
+   final. An update that passed the gate was WAL'd and acked before the
+   cutover returned, so it is part of the tail.
+6. **replay** — each destination replays every source's tail (the
+   records past its export cut marker) through the foreign-id filter
+   (``ReshardReplay``): pushes acked mid-migration land exactly once,
+   and the final state is bit-identical to a never-resharded reference.
+7. **commit** — the routing table atomically switches to the plan's
+   generation (:func:`registry.commit_reshard`). Clients bouncing off
+   ``stale-route`` rebuild their whole routing on the next refresh and
+   re-partition the rejected chunks onto the new shard set.
+8. **checkpoint** — each destination saves into the rescue lineage
+   (``ps-ckpt``) at a fresh step, so a destination crash recovers
+   through the normal snapshot+WAL rescue (and the sources' now-covered
+   WAL epochs are garbage-collected by that save).
+
+Failure matrix (the chaos drill injects the first two):
+
+- **Source SIGKILLed mid-migration** — its registry entry vanishes
+  (dead-pid filter), a rescue pod recovers it from snapshot + WAL at a
+  higher epoch, and every per-shard RPC here re-resolves the address
+  from the registry per attempt, so the retried export/cutover lands on
+  the rescuer. The destinations' tail replay iterates ALL epochs past
+  the cut, so a rescued source's records are covered either way. A pod
+  that comes up while a plan is active starts push-GATED
+  (ps/__main__.py): a rescuer accepting pushes after a destination
+  already replayed its tail would lose them — gating turns that window
+  into bounded retriable Acks instead.
+- **Destination SIGSTOPped mid-migration** — its restore/replay RPC
+  stalls; the per-phase retry loop keeps re-issuing until the pod
+  resumes or the phase deadline aborts the migration.
+- **Coordinator dies mid-migration** — the plan goes stale and is
+  stolen by the next :func:`run_reshard` call; sources re-export (a
+  fresh cut supersedes the old markers), destinations re-restore. The
+  committed routing never moved, so clients never saw the torn attempt.
+- **Abort** — any phase failing past its deadline rolls back: sources
+  get ``ReshardResume`` (the push gate lifts), the plan is dropped, and
+  the committed routing is untouched — clients never left the source
+  set. Destinations replayed into tables no client ever read; the pods
+  are torn down by the caller.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps import registry
+from easydl_tpu.ps.server import PS_SERVICE, PsShard
+from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, RpcClient
+
+log = get_logger("ps", "reshard")
+
+#: Where migration exports land: one dir per routing generation, so a
+#: stolen/retried plan at the same generation overwrites (never mixes
+#: with) the torn attempt, and operators can inspect a migration's
+#: artifacts after the fact.
+RESHARD_DIR = "ps-reshard"
+
+
+class ReshardError(RuntimeError):
+    """A migration phase failed past its deadline (after rollback)."""
+
+
+class ReshardInProgress(ReshardError):
+    """Another coordinator's plan is active (and not stale)."""
+
+
+def export_dir(workdir: str, generation: int) -> str:
+    return os.path.join(workdir, RESHARD_DIR, f"gen-{int(generation)}")
+
+
+def _rpc(address: str, timeout: float) -> RpcClient:
+    return RpcClient(PS_SERVICE, address, timeout=timeout,
+                     options=GRPC_MSG_OPTIONS)
+
+
+def _committed_shards(workdir: str) -> int:
+    """The serving tier's current shard count: the routing table's when
+    one exists, else the committed publications'."""
+    n = int(registry.routing_table(workdir).get("num_shards", 0))
+    if n > 0:
+        return n
+    m = registry.shard_map(workdir)
+    if not m:
+        raise ReshardError(f"no PS publications under {workdir}")
+    return max(int(d["num_shards"]) for d in m.values())
+
+
+class _Phase:
+    """One retriable per-shard RPC phase: re-resolves the target address
+    from the registry on EVERY attempt (a SIGKILLed source's rescuer
+    publishes a fresh address; a SIGSTOPped destination keeps its old
+    one and simply times out until it resumes)."""
+
+    def __init__(self, workdir: str, generation: Optional[int],
+                 rpc_timeout: float, deadline: float):
+        self.workdir = workdir
+        self.generation = generation  # None = committed (source side)
+        self.rpc_timeout = rpc_timeout
+        self.deadline = deadline
+
+    def _address(self, shard: int) -> Optional[str]:
+        entry = registry.shard_map(self.workdir,
+                                   generation=self.generation).get(shard)
+        return entry["address"] if entry else None
+
+    def call(self, shard: int, method: str, req, describe: str):
+        """Issue ``method(req)`` against whoever currently serves
+        ``shard``, retrying transport failures and not-ok Acks until the
+        phase deadline. Returns the ok Ack."""
+        last = "no publication for the shard yet"
+        while True:
+            addr = self._address(shard)
+            if addr is not None:
+                client = _rpc(addr, self.rpc_timeout)
+                try:
+                    ack = getattr(client, method)(req)
+                    if ack.ok:
+                        return ack
+                    last = f"ack: {ack.message}"
+                except Exception as e:  # transport loss or stalled pod
+                    last = repr(e)
+                finally:
+                    client.close()
+            if time.monotonic() > self.deadline:
+                raise ReshardError(
+                    f"{describe} (shard {shard}) failed past the phase "
+                    f"deadline; last: {last}")
+            time.sleep(0.2)
+
+
+def run_reshard(
+    workdir: str,
+    to_shards: int,
+    owner: str,
+    *,
+    ensure_destinations: Optional[Callable[[Dict[str, Any]], None]] = None,
+    on_phase: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    rpc_timeout: float = 15.0,
+    phase_timeout_s: float = 180.0,
+    dest_wait_s: float = 120.0,
+    plan_stale_s: float = 600.0,
+) -> Dict[str, Any]:
+    """Drive one complete online reshard to ``to_shards``; returns the
+    migration summary (plan, per-destination replay stats, wall times).
+
+    ``ensure_destinations(plan)`` is called once after the export phase
+    to bring up the destination shard set (spawn ``--reshard-dest``
+    pods); without it the coordinator simply waits for destinations to
+    appear in the registry under the plan's generation.
+    ``on_phase(name, info)`` fires at every phase boundary — the chaos
+    drill hooks its faults there, so "SIGKILL a source mid-migration"
+    means *after export, before cutover* deterministically rather than
+    by wall-clock luck. A hook that raises aborts (and rolls back) the
+    migration like any phase failure."""
+    t_start = time.monotonic()
+    from_shards = _committed_shards(workdir)
+    plan = registry.begin_reshard(workdir, from_shards, to_shards, owner,
+                                  stale_s=plan_stale_s)
+    if plan is None:
+        raise ReshardInProgress(
+            f"a reshard plan is already active under {workdir}")
+    gen = int(plan["generation"])
+    from_shards = int(plan["from_shards"])  # authoritative (plan steal)
+    directory = export_dir(workdir, gen)
+    step = gen  # the step dir inside the export dir is the generation
+    summary: Dict[str, Any] = {
+        "plan": dict(plan),
+        "export_dir": directory,
+        "phases": {},
+    }
+
+    def phase(name: str, **info) -> None:
+        summary["phases"][name] = {
+            "t_s": round(time.monotonic() - t_start, 3), **info}
+        log.info("reshard gen %d phase %s (%.2fs)%s", gen, name,
+                 time.monotonic() - t_start,
+                 f" {info}" if info else "")
+        # Plan heartbeat: every phase boundary refreshes the plan's
+        # timestamp so a LIVE migration can never look stale — each
+        # individual phase is bounded well under plan_stale_s, but their
+        # sum is not, and a steal mid-migration would let the loser's
+        # rollback un-gate sources the thief already cut over.
+        registry.touch_reshard(workdir, owner)
+        if on_phase is not None:
+            on_phase(name, dict(plan))
+
+    committed = False
+    try:
+        phase("planned")
+        # -------------------------------------------------------- export
+        src = _Phase(workdir, None, rpc_timeout,
+                     time.monotonic() + phase_timeout_s)
+        for s in range(from_shards):
+            src.call(s, "ReshardExport",
+                     pb.PsSaveRequest(directory=directory, step=step),
+                     "reshard export")
+        phase("exported")
+        # -------------------------------------------------- destinations
+        if ensure_destinations is not None:
+            ensure_destinations(dict(plan))
+        deadline = time.monotonic() + dest_wait_s
+        while True:
+            m = registry.shard_map(workdir, generation=gen)
+            if all(d in m for d in range(to_shards)):
+                break
+            if time.monotonic() > deadline:
+                missing = [d for d in range(to_shards) if d not in m]
+                raise ReshardError(
+                    f"destination shards {missing} never published under "
+                    f"generation {gen}")
+            time.sleep(0.2)
+        phase("destinations_ready")
+        # ------------------------------------------------------- restore
+        dst = _Phase(workdir, gen, rpc_timeout,
+                     time.monotonic() + phase_timeout_s)
+        for d in range(to_shards):
+            dst.call(d, "Restore",
+                     pb.PsRestoreRequest(directory=directory, step=step),
+                     "reshard destination restore")
+        phase("restored")
+        # ------------------------------------------------------- cutover
+        # Addresses re-resolve inside the phase: a source SIGKILLed after
+        # export answers here through its rescuer (which came up
+        # push-gated — see module docstring — so no push can slip past
+        # the tail between its birth and this cutover).
+        cut = _Phase(workdir, None, rpc_timeout,
+                     time.monotonic() + phase_timeout_s)
+        for s in range(from_shards):
+            cut.call(s, "ReshardCutover", pb.PsSaveRequest(),
+                     "reshard cutover")
+        phase("cutover")
+        # -------------------------------------------------------- replay
+        replays: List[Dict[str, Any]] = []
+        rep = _Phase(workdir, gen, rpc_timeout,
+                     time.monotonic() + phase_timeout_s)
+        for d in range(to_shards):
+            ack = rep.call(d, "ReshardReplay",
+                           pb.PsSaveRequest(directory=directory, step=step),
+                           "reshard tail replay")
+            try:
+                replays.append(json.loads(ack.message))
+            except ValueError:
+                replays.append({})
+        summary["replays"] = replays
+        summary["rows_migrated"] = int(sum(
+            r.get("rows_migrated", 0) for r in replays))
+        summary["tail_pushes_replayed"] = int(sum(
+            r.get("pushes", 0) for r in replays))
+        summary["tail_foreign_ids_filtered"] = int(sum(
+            r.get("foreign_ids", 0) for r in replays))
+        phase("replayed",
+              rows_migrated=summary["rows_migrated"],
+              tail_pushes=summary["tail_pushes_replayed"])
+        # -------------------------------------------------------- commit
+        summary["committed_routing"] = registry.commit_reshard(workdir,
+                                                               owner)
+        committed = True
+        phase("committed")
+        # -------------------------------------- rescue-lineage checkpoint
+        # A destination that crashes after commit must recover through
+        # the normal snapshot+WAL rescue; its first rescue-dir save both
+        # anchors that (cut marker under the NEW count) and retires the
+        # sources' now-covered WAL epochs under its shard root.
+        ckpt = os.path.join(workdir, "ps-ckpt")
+        steps = PsShard.saved_steps(ckpt)
+        save_step = (max(steps) + 1) if steps else 0
+        sv = _Phase(workdir, gen, rpc_timeout,
+                    time.monotonic() + phase_timeout_s)
+        for d in range(to_shards):
+            sv.call(d, "Save",
+                    pb.PsSaveRequest(directory=ckpt, step=save_step),
+                    "post-commit checkpoint")
+        summary["post_commit_ckpt_step"] = save_step
+        phase("saved")
+    except BaseException:
+        if not committed:
+            _rollback(workdir, owner, from_shards, rpc_timeout)
+        raise
+    summary["wall_s"] = round(time.monotonic() - t_start, 3)
+    log.info("reshard %d->%d committed as generation %d in %.2fs "
+             "(%d rows migrated, %d tail pushes replayed)",
+             from_shards, to_shards, gen, summary["wall_s"],
+             summary["rows_migrated"], summary["tail_pushes_replayed"])
+    return summary
+
+
+def _rollback(workdir: str, owner: str, from_shards: int,
+              rpc_timeout: float) -> None:
+    """Best-effort abort: un-gate every source (a cutover source would
+    otherwise bounce pushes forever against a routing that will never
+    move), then drop the plan. The committed routing never changed, so
+    clients never left the source set; whatever the destinations
+    restored/replayed was never read by anyone."""
+    log.warning("reshard under %s aborting: resuming %d source shard(s) "
+                "and dropping the plan", workdir, from_shards)
+    for s in range(from_shards):
+        entry = registry.shard_map(workdir).get(s)
+        if entry is None:
+            continue
+        client = _rpc(entry["address"], rpc_timeout)
+        try:
+            client.ReshardResume(pb.PsSaveRequest())
+        except Exception as e:  # the abort path must never mask the cause
+            log.warning("reshard rollback: resume of shard %d failed: %s",
+                        s, e)
+        finally:
+            client.close()
+    registry.abort_reshard(workdir, owner)

@@ -50,6 +50,20 @@ PS_SERVICE = ServiceDef(
         # a PS pod): stop applying pushes, save this shard for its
         # replacement. Reuses PsSaveRequest — drain IS a save plus a gate.
         "Drain": (pb.PsSaveRequest, pb.Ack),
+        # Online resharding N→M (ps/reshard.py coordinator). All four reuse
+        # PsSaveRequest — the export/replay carry a directory+step, the
+        # cutover/resume carry nothing — so no wire change was needed.
+        # Source side: ReshardExport cuts a snapshot + WAL boundary under
+        # the ordering lock (pushes KEEP flowing — post-cut pushes live in
+        # the WAL tail); ReshardCutover gates pushes for good with a
+        # retriable `stale-route` Ack; ReshardResume un-gates (rollback of
+        # an aborted migration). Destination side: ReshardReplay re-applies
+        # every source's WAL tail past its export cut through the
+        # foreign-id filter.
+        "ReshardExport": (pb.PsSaveRequest, pb.Ack),
+        "ReshardCutover": (pb.PsSaveRequest, pb.Ack),
+        "ReshardResume": (pb.PsSaveRequest, pb.Ack),
+        "ReshardReplay": (pb.PsSaveRequest, pb.Ack),
     },
 )
 
@@ -62,6 +76,15 @@ DRAINING = "draining"
 #: superseded zombie). Retriable the same way as DRAINING — the client
 #: refreshes its route + epoch from the registry and re-sends.
 STALE_EPOCH = "stale-epoch"
+
+#: Ack.message prefix for the reshard cutover fence: this shard handed its
+#: rows to a NEW shard set (a different routing-table generation), so the
+#: client's whole partition — not just one shard's address — is stale.
+#: Retriable: the client re-reads the routing table, rebuilds its shard
+#: map once the coordinator commits, and re-partitions the rejected chunk
+#: onto the new shard set (nothing was applied here, so the re-send is
+#: exactly-once).
+STALE_ROUTE = "stale-route"
 
 #: How often (seconds) a serving shard re-checks the registry for a
 #: higher-epoch publication of its own shard — the zombie self-fence. A
@@ -114,7 +137,8 @@ class PsShard:
                  backend: str = "auto", epoch: int = 0,
                  wal_root: Optional[str] = None,
                  workdir: Optional[str] = None,
-                 rescue_dir: Optional[str] = None):
+                 rescue_dir: Optional[str] = None,
+                 route_generation: int = 0):
         if not 0 <= shard_index < num_shards:
             raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
         self.shard_index = shard_index
@@ -140,6 +164,15 @@ class PsShard:
         self._wal: Optional[_wal.PsWal] = None
         self._wal_mu = threading.Lock()
         self._replay_digests: set = set()
+        # One-shot shield: the reshard tail replay arms it so the
+        # coordinator's immediate post-commit checkpoint does not clear
+        # the dedupe digests out from under the gated clients' retries.
+        self._preserve_digests_once = False
+        # Tail-replay idempotence under the coordinator's RPC retry: one
+        # replay per restore (reshard_replay re-checks under the mutex,
+        # restore() re-arms).
+        self._reshard_replay_mu = threading.Lock()
+        self._reshard_replay_done: Optional[tuple] = None
         self._replaying = False
         # `rescue_dir` is the checkpoint dir a failure rescue restores from
         # (the pod entrypoint wires <workdir>/ps-ckpt). Segment retirement
@@ -158,6 +191,19 @@ class PsShard:
         self._lock = threading.Lock()
         self._server = None
         self._draining = False
+        # Online-reshard state. `route_generation` is the routing-table
+        # generation this incarnation publishes under (observability only —
+        # routing is arbitrated by the registry). `_reshard_active` is set
+        # by the export RPC and blocks WAL-segment retirement for the rest
+        # of this incarnation: a trainer's ps-ckpt save landing mid-
+        # migration would otherwise retire post-export-cut records the
+        # destinations still have to replay. `_cutover` is the permanent
+        # push gate — every later push gets a retriable `stale-route` Ack
+        # and is NOT applied, which is what makes the client's re-partition
+        # onto the new shard set exactly-once.
+        self.route_generation = int(route_generation)
+        self._reshard_active = False
+        self._cutover = False
         # Push/Drain coordination: the gRPC server handles requests on a
         # thread pool, so a Push that passed the draining gate could still
         # be applying while drain() exports the snapshot — the update would
@@ -221,6 +267,22 @@ class PsShard:
             "easydl_ps_push_fence_rejected_total", "Pushes rejected by the "
             "shard-epoch fence (stale client route or fenced zombie).",
             ("shard",))
+        # Live-reshard telemetry (docs/operations.md §9): stale_route says
+        # the cutover gate turned traffic away retriably, rows_migrated
+        # says a destination actually inherited rows via the export
+        # restore, replayed_records says the mid-migration WAL tail was
+        # consumed — the two counters the chaos smoke gate refuses to pass
+        # without.
+        self._m_stale_route = reg.counter(
+            "easydl_ps_push_stale_route_total", "Pushes rejected retriably "
+            "by the reshard cutover gate.", ("shard",))
+        self._m_reshard_rows = reg.counter(
+            "easydl_ps_reshard_rows_migrated_total", "Rows this destination "
+            "shard inherited from the source exports at reshard-replay "
+            "time.", ("shard",))
+        self._m_reshard_replayed = reg.counter(
+            "easydl_ps_reshard_replayed_records_total", "Mid-migration WAL "
+            "push records replayed into this destination shard.", ("shard",))
         self._m_epoch = reg.gauge(
             "easydl_ps_shard_epoch", "This shard incarnation's fencing "
             "epoch (0 = fencing off).", ("shard",))
@@ -301,8 +363,16 @@ class PsShard:
                 # to retry has long been retried (the reroute storm is
                 # seconds; save cadence is not), and digests kept past
                 # this point could swallow a future, legitimately
-                # byte-identical push.
-                self._replay_digests.clear()
+                # byte-identical push. One save is exempt — the reshard
+                # coordinator's post-commit checkpoint lands milliseconds
+                # after the tail replay, RACING the gated clients'
+                # re-dispatched retries; clearing on it would re-open the
+                # double-apply hole the digests exist to close, so
+                # reshard_replay shields exactly that one save.
+                if self._preserve_digests_once:
+                    self._preserve_digests_once = False
+                else:
+                    self._replay_digests.clear()
         else:
             exports = [(name, t.spec, *t.export_rows())
                        for name, t in list(self._tables.items())]
@@ -329,7 +399,12 @@ class PsShard:
                     else self.num_shards)
         with open(os.path.join(d, f".done-{self.shard_index}"), "w") as f:
             f.write(str(expected))
+        # `_reshard_active` blocks retirement outright: once this shard cut
+        # its export boundary, records past it belong to the destinations'
+        # tail replay — a concurrent trainer ps-ckpt save must not garbage-
+        # collect them out from under the migration.
         if (self._wal is not None and retire_wal
+                and not self._reshard_active
                 and self._covers_rescue(directory)
                 and len(glob.glob(os.path.join(d, ".done-*"))) >= expected):
             n = _wal.retire_segments(retired_segments, root=self._wal_root,
@@ -359,6 +434,187 @@ class PsShard:
         # replacement dies before its first ps-ckpt save, the rescue is
         # ps-ckpt + THESE segments + the replacement's own.
         self.save(directory, step, marker_expected=1, retire_wal=False)
+
+    # ------------------------------------------------------ online reshard
+    def reshard_export(self, directory: str, step: int) -> None:
+        """Source side, phase 1: cut a snapshot + WAL boundary under the
+        ordering lock and export this shard's rows into the shared reshard
+        directory. Pushes are NOT gated — the shard keeps serving, and
+        every post-cut push lands in the WAL tail the destinations replay
+        after cutover. The per-shard cut marker save() writes into the
+        step dir is the tail's start boundary; from this moment on no save
+        may retire segments (the flag is permanent for this incarnation —
+        sources are retired, not reused, after a migration)."""
+        self._reshard_active = True
+        self.save(directory, step, retire_wal=False)
+        log.info("ps shard %d/%d exported for reshard into %s (step %d); "
+                 "WAL retirement frozen", self.shard_index, self.num_shards,
+                 directory, step)
+
+    def cutover(self) -> None:
+        """Source side, phase 2: gate pushes for good. Waits out in-flight
+        pushes (same discipline as drain — an update that passed the gate
+        is WAL'd and acked before the cutover returns, so it is part of
+        the frozen tail), then fsyncs the WAL so the tail the destinations
+        are about to read is durable. Idempotent: the coordinator retries
+        it through transport blips."""
+        with self._drain_cv:
+            first = not self._cutover
+            self._cutover = True
+            self._reshard_active = True
+            while self._inflight_pushes > 0:
+                self._drain_cv.wait(timeout=0.1)
+        if self._wal is not None:
+            with self._wal_mu:
+                self._wal.sync()
+        if first:
+            log.info("ps shard %d/%d cut over: pushes now answer "
+                     "stale-route; WAL tail frozen", self.shard_index,
+                     self.num_shards)
+
+    def reshard_resume(self) -> None:
+        """Rollback: an aborted migration un-gates this source. Safe even
+        after destinations replayed the tail — the routing table never
+        committed, so no client ever applied anything on them; the
+        destination set is torn down and a retry re-restores from
+        scratch."""
+        with self._drain_cv:
+            was = self._cutover
+            self._cutover = False
+            self._reshard_active = False
+        if was:
+            log.warning("ps shard %d/%d resumed after an aborted reshard",
+                        self.shard_index, self.num_shards)
+
+    def reshard_replay(self, directory: str, step: int) -> Dict[str, int]:
+        """Destination side: replay every source shard's WAL tail — the
+        records past its export cut marker — through the foreign-id filter,
+        so pushes the sources acked mid-migration land here exactly once
+        and the final table state is bit-identical to a never-resharded
+        reference. Runs strictly after every source's cutover (the
+        coordinator sequences it), so the tails are final.
+
+        Per-id ordering is preserved by construction: under the source
+        shard count every id's updates live in exactly ONE source's WAL,
+        replayed in file order; cross-source interleaving only mixes
+        disjoint id sets. Replayed push digests are kept so a client whose
+        ack was lost in the cutover window and whose retry lands here
+        verbatim is recognised instead of double-applied."""
+        if self._workdir is None:
+            raise RuntimeError("reshard replay needs a workdir (WAL roots)")
+        d = os.path.join(directory, f"step_{step:010d}")
+        markers = sorted(glob.glob(os.path.join(d, "wal-cut.shard-*.json")))
+        if not markers:
+            raise FileNotFoundError(f"no wal-cut markers under {d} — "
+                                    "sources never exported?")
+        # Idempotence under the coordinator's retry: _Phase.call re-issues
+        # ReshardReplay when the RPC deadline beats a long tail, and a
+        # second full application would double every tail push — exactly
+        # the corruption this RPC exists to prevent. One replay per
+        # restore: the mutex serialises a retry racing the in-flight
+        # first call, the done-key returns its cached stats, and a fresh
+        # Restore (a stolen/retried plan re-restores first) re-arms it.
+        key = (os.path.realpath(directory), int(step))
+        with self._reshard_replay_mu:
+            if (self._reshard_replay_done
+                    and self._reshard_replay_done[0] == key):
+                return dict(self._reshard_replay_done[1])
+            stats = {"sources": 0, "segments": 0, "records": 0,
+                     "pushes": 0, "applied_pushes": 0, "creates": 0,
+                     "ids": 0, "foreign_ids": 0, "torn": 0,
+                     "rows_migrated": int(sum(
+                         t.rows for t in self._tables.values()))}
+            # Everything in the tables right now came in via the export
+            # restore — that IS the completed row migration the drill
+            # gate counts.
+            self._m_reshard_rows.inc(stats["rows_migrated"],
+                                     shard=self._shard_label)
+            self._replaying = True
+            try:
+                for marker in markers:
+                    m = re.fullmatch(r"wal-cut\.shard-(\d+)-of-(\d+)\.json",
+                                     os.path.basename(marker))
+                    if not m:
+                        continue
+                    src = int(m.group(1))
+                    with open(marker) as f:
+                        doc = json.load(f)
+                    start = (int(doc["epoch"]),
+                             str(doc["first_live_segment"]))
+                    root = os.path.join(self._workdir, "ps-wal",
+                                        f"shard-{src}")
+                    stats["sources"] += 1
+                    # before_epoch=0: the tail spans the exporting
+                    # incarnation AND any later rescue of it (a source
+                    # killed mid-migration comes back at a higher epoch;
+                    # its post-rescue pushes are part of the tail too).
+                    # `start` excludes everything the export rows already
+                    # contain.
+                    for _epoch, _path, payloads, _consumed, clean in \
+                            _wal.iter_replay(root, 0, start=start):
+                        stats["segments"] += 1
+                        if not clean:
+                            stats["torn"] += 1
+                        for payload in payloads:
+                            stats["records"] += 1
+                            self._apply_replay_payload(payload, stats)
+            finally:
+                self._replaying = False
+            # "pushes" reported = records that LANDED rows here: every
+            # destination walks every source's full tail, so counting
+            # fully-foreign records would overstate the replay by about
+            # the destination count in every verdict and counter.
+            stats["pushes"] = stats.pop("applied_pushes")
+            self._m_reshard_replayed.inc(stats["pushes"],
+                                         shard=self._shard_label)
+            log.info("ps shard %d/%d reshard-replayed %d records (%d "
+                     "landed pushes, %d ids kept, %d foreign filtered) "
+                     "from %d source(s)", self.shard_index,
+                     self.num_shards, stats["records"], stats["pushes"],
+                     stats["ids"], stats["foreign_ids"], stats["sources"])
+            # Shield the dedupe set through the coordinator's immediate
+            # post-commit checkpoint (see save()): the gated clients'
+            # retries are racing that save, and a replayed-but-unacked
+            # push retried after it must still be recognised, not
+            # double-applied.
+            self._preserve_digests_once = True
+            self._reshard_replay_done = (key, dict(stats))
+            return stats
+
+    def _apply_replay_payload(self, payload: bytes, stats: dict) -> None:
+        """One WAL record through the store — the shared body of the
+        rescue replay (replay_wal) and the migration tail replay
+        (reshard_replay): create/push dispatch, the foreign-id filter for
+        shard-count changes, and dedupe-digest registration. The digest
+        is kept in BOTH shapes — the original payload, and the filtered
+        subset re-encoded — because a client whose ack was lost retries
+        verbatim against a rescuer but RE-PARTITIONED (the subset) after
+        a reshard commit; both must be recognised and acked without a
+        second apply. ``applied_pushes`` counts only records that landed
+        rows here; ``pushes`` counts every push record walked."""
+        kind = _wal.record_kind(payload)
+        if kind == _wal.REC_CREATE:
+            self.create_table(TableSpec(
+                **json.loads(_wal.decode_create(payload))))
+            stats["creates"] += 1
+            return
+        if kind != _wal.REC_PUSH:
+            return
+        table, ids, grads, scale = _wal.decode_push(payload)
+        mine = shard_of(ids, self.num_shards) == self.shard_index
+        filtered = not mine.all()
+        if filtered:
+            stats["foreign_ids"] += int((~mine).sum())
+            ids, grads = ids[mine], grads[mine]
+        if len(ids):
+            self.table(table).push(ids, grads, scale=scale)
+            stats["ids"] += len(ids)
+            stats["applied_pushes"] += 1
+        stats["pushes"] += 1
+        self._replay_digests.add(_wal.push_digest(payload))
+        if filtered and len(ids):
+            self._replay_digests.add(_wal.push_digest(
+                _wal.encode_push(table, ids, grads, scale)))
 
     def _cut_marker_name(self) -> str:
         # Shard count in the name: after a reshard the boundary no longer
@@ -412,6 +668,11 @@ class PsShard:
         if step not in steps:
             raise FileNotFoundError(f"no PS checkpoint for step {step}")
         d = os.path.join(directory, f"step_{step:010d}")
+        # A fresh restore re-arms the one-replay-per-restore guard: a
+        # stolen/retried reshard plan re-restores its destinations before
+        # re-replaying, and THAT replay must run for real.
+        with self._reshard_replay_mu:
+            self._reshard_replay_done = None
         # The snapshot's WAL cut boundary rides inside the step dir, so it
         # survives whatever happened to retirement; replay_wal() uses it to
         # skip every record this snapshot already contains.
@@ -466,7 +727,7 @@ class PsShard:
         zombie's post-rescue appends can never leak into a later rescue.
         """
         stats = {"segments": 0, "records": 0, "pushes": 0, "creates": 0,
-                 "ids": 0, "torn": 0, "foreign_ids": 0}
+                 "ids": 0, "torn": 0, "foreign_ids": 0, "applied_pushes": 0}
         if self._wal_root is None:
             return stats
         self._replaying = True
@@ -484,27 +745,7 @@ class PsShard:
                                 "byte %d", path, consumed)
                 for payload in payloads:
                     stats["records"] += 1
-                    kind = _wal.record_kind(payload)
-                    if kind == _wal.REC_CREATE:
-                        spec = TableSpec(
-                            **json.loads(_wal.decode_create(payload)))
-                        self.create_table(spec)
-                        stats["creates"] += 1
-                    elif kind == _wal.REC_PUSH:
-                        table, ids, grads, scale = _wal.decode_push(payload)
-                        # A shard-count change between incarnations can
-                        # leave foreign ids in old records; apply only ours
-                        # (mirrors restore()'s reshard-on-restore filter).
-                        mine = shard_of(ids, self.num_shards) == \
-                            self.shard_index
-                        if not mine.all():
-                            stats["foreign_ids"] += int((~mine).sum())
-                            ids, grads = ids[mine], grads[mine]
-                        if len(ids):
-                            self.table(table).push(ids, grads, scale=scale)
-                            stats["ids"] += len(ids)
-                        stats["pushes"] += 1
-                        self._replay_digests.add(_wal.push_digest(payload))
+                    self._apply_replay_payload(payload, stats)
             for d, consumed in consumed_by_dir.items():
                 _wal.write_replay_marker(d, consumed)
         finally:
@@ -578,6 +819,20 @@ class PsShard:
 
                     ctx.abort(grpc.StatusCode.UNAVAILABLE, msg)
                 raise RuntimeError(msg)
+        if self._cutover:
+            # A cut-over source's rows go stale the moment the new shard
+            # set starts applying pushes; abort UNAVAILABLE (the transport-
+            # loss class the pull retry loop reroutes on) so readers
+            # converge on the committed routing, same contract as the
+            # fence above.
+            msg = (f"{STALE_ROUTE}: shard {self.shard_index} of "
+                   f"{self.num_shards} was resharded away; refresh the "
+                   "routing table")
+            if ctx is not None and hasattr(ctx, "abort"):
+                import grpc
+
+                ctx.abort(grpc.StatusCode.UNAVAILABLE, msg)
+            raise RuntimeError(msg)
         t = self.table(req.table)
         ids = request_ids(req)
         values = t.pull(ids)
@@ -599,6 +854,17 @@ class PsShard:
 
     def Push(self, req: pb.PushRequest, ctx) -> pb.Ack:
         with self._drain_cv:
+            if self._cutover:
+                # Reshard cutover: rejected BEFORE the WAL append, so
+                # nothing is applied/logged and the client's re-partition
+                # onto the new shard set is exactly-once.
+                self._m_stale_route.inc(shard=self._shard_label)
+                return pb.Ack(
+                    ok=False,
+                    message=f"{STALE_ROUTE}: shard {self.shard_index} of "
+                            f"{self.num_shards} handed its rows to a new "
+                            "shard set; refresh the routing table",
+                )
             if self._draining:
                 self._m_push_rejected.inc(shard=self._shard_label)
                 return pb.Ack(
@@ -653,6 +919,27 @@ class PsShard:
             ids = request_ids(req)
             grads = np.frombuffer(req.grads, np.float32).reshape(
                 len(ids), t.dim)
+            # Ownership gate: every id must hash to THIS shard under THIS
+            # shard count. A violation means the client's partition and
+            # this server disagree about the routing — seen in the wild as
+            # a mid-reshard reroute adopting a new-generation pod into an
+            # old-partition slot: the foreign rows would be created fresh
+            # here, invisible to the migration lineage, and the update
+            # silently lost. Reject retriably — the client's reroute loop
+            # re-reads the routing and re-partitions. (Epoch-0 legacy
+            # clients still partition by the same hash, so the gate holds
+            # for them too; num_shards==1 owns everything.)
+            if self.num_shards > 1 and ids.size:
+                if not (shard_of(ids, self.num_shards)
+                        == self.shard_index).all():
+                    self._m_stale_route.inc(shard=self._shard_label)
+                    return pb.Ack(
+                        ok=False,
+                        message=f"{STALE_ROUTE}: push contains ids not "
+                                f"owned by shard {self.shard_index} of "
+                                f"{self.num_shards}; refresh the routing "
+                                "and re-partition",
+                    )
             if self._wal is not None:
                 # WAL-then-apply under the ordering lock: log order == apply
                 # order == replay order, and the record hits the OS before
@@ -728,6 +1015,30 @@ class PsShard:
         except OSError as e:
             return pb.Ack(ok=False, message=str(e))
 
+    def ReshardExport(self, req: pb.PsSaveRequest, ctx) -> pb.Ack:
+        try:
+            self.reshard_export(req.directory, req.step)
+            return pb.Ack(ok=True)
+        except (OSError, _wal.WalError) as e:
+            return pb.Ack(ok=False, message=str(e))
+
+    def ReshardCutover(self, req: pb.PsSaveRequest, ctx) -> pb.Ack:
+        self.cutover()
+        return pb.Ack(ok=True)
+
+    def ReshardResume(self, req: pb.PsSaveRequest, ctx) -> pb.Ack:
+        self.reshard_resume()
+        return pb.Ack(ok=True)
+
+    def ReshardReplay(self, req: pb.PsSaveRequest, ctx) -> pb.Ack:
+        try:
+            stats = self.reshard_replay(req.directory, req.step)
+            # The stats ride back in the Ack message: the coordinator folds
+            # them into its migration summary (and the chaos verdict).
+            return pb.Ack(ok=True, message=json.dumps(stats))
+        except (OSError, ValueError, KeyError, RuntimeError) as e:
+            return pb.Ack(ok=False, message=str(e))
+
     def Stats(self, req: pb.PsStatsRequest, ctx) -> pb.PsStatsResponse:
         # A fenced (superseded) shard must read as DEAD here: rescue
         # discovery decides liveness by this very call (probe_alive), and
@@ -754,17 +1065,23 @@ class PsShard:
         return resp
 
     # ----------------------------------------------------------------- serve
-    def serve(self, port: int = 0, obs_workdir: str | None = None):
+    def serve(self, port: int = 0, obs_workdir: str | None = None,
+              obs_name: str | None = None):
         """Start the gRPC server (and, when ``obs_workdir`` names the job
         workdir, a discoverable /metrics + /healthz exporter for this
-        shard)."""
+        shard). ``obs_name`` names the exporter's discovery file — pods
+        pass their POD name: shard INDICES are shared across routing
+        generations (a reshard source, its rescuer, and two generations
+        of destinations can all be "shard 1" concurrently), and
+        same-named discovery files overwrite each other, silently
+        dropping a live pod's counters from every fleet scrape."""
         from easydl_tpu.chaos import banner as chaos_banner
 
-        chaos_banner(f"ps-{self.shard_index}")
+        chaos_banner(obs_name or f"ps-{self.shard_index}")
         self._server = serve(PS_SERVICE, self, port=port,
                              options=GRPC_MSG_OPTIONS)
         self._exporter = start_exporter(
-            f"ps-{self.shard_index}", workdir=obs_workdir,
+            obs_name or f"ps-{self.shard_index}", workdir=obs_workdir,
             health_fn=lambda: {
                 "shard": self.shard_index,
                 "num_shards": self.num_shards,
@@ -773,6 +1090,8 @@ class PsShard:
                 "epoch": self.epoch,
                 "fenced": self._fenced,
                 "wal": self._wal is not None,
+                "route_generation": self.route_generation,
+                "cutover": self._cutover,
             },
         )
         log.info("ps shard %d/%d serving on :%d", self.shard_index,

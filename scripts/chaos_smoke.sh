@@ -15,6 +15,10 @@
 # The zero-loss verdict must additionally record at least one replayed WAL
 # record: a "pass" where the rescue never consumed the log would only
 # prove the kill missed the window, and the smoke refuses to count it.
+# The reshard-under-fire verdict gets the same treatment: it must record
+# at least one completed row migration AND at least one mid-migration WAL
+# tail push replayed onto a destination — a "pass" where the cutover beat
+# every in-flight push would never have exercised the tail-replay path.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -23,7 +27,8 @@ trap 'rm -f "$LOG"' EXIT
 
 env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
     --scenario worker_kill --scenario master_crash \
-    --scenario ps_shard_crash_zero_loss --keep-workdir "$@" \
+    --scenario ps_shard_crash_zero_loss \
+    --scenario ps_reshard_under_fire --keep-workdir "$@" \
     2>&1 | tee "$LOG"
 
 # Verdict files from THIS run (chaos_run prints "PASS <name> ... -> <path>").
@@ -41,6 +46,26 @@ assert replayed >= 1, (
     f"{sys.argv[1]}: zero-loss verdict shows {replayed} WAL records "
     "replayed — the rescue never exercised the log, the pass is vacuous")
 print(f"zero-loss OK: {int(replayed)} WAL records replayed")
+PY
+        ;;
+    *ps_reshard_under_fire*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+resh = doc["zero_loss"]["reshard"]
+migrations = resh.get("migrations", [])
+rows = sum(m.get("rows_migrated", 0) for m in migrations)
+tail = sum(m.get("tail_pushes_replayed", 0) for m in migrations)
+assert migrations and rows >= 1, (
+    f"{sys.argv[1]}: reshard verdict shows {len(migrations)} migration(s) "
+    f"with {rows} rows migrated — no split actually moved data, the pass "
+    "is vacuous")
+assert tail >= 1, (
+    f"{sys.argv[1]}: reshard verdict shows 0 mid-migration WAL tail "
+    "pushes replayed — the cutover beat every in-flight push and the "
+    "tail-replay path was never exercised")
+print(f"reshard OK: {len(migrations)} migration(s), {rows} rows "
+      f"migrated, {tail} tail pushes replayed")
 PY
         ;;
     esac

@@ -1,0 +1,159 @@
+"""Rescue-claim contention: the O_EXCL claim file's steal/heartbeat/
+release protocol (easydl_tpu/ps/__main__.py) under direct unit pressure —
+claimant-crashed-mid-rescue steal, a steal race between many rescuers,
+heartbeat protection of an ACTIVE claimant, and claim release on a clean
+handoff. Plus the probe_alive tunables satellite."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from easydl_tpu.ps.__main__ import (
+    claim_heartbeat,
+    claim_orphan_shard,
+    claim_owner,
+    probe_alive,
+    release_claim,
+)
+from easydl_tpu.ps import registry
+
+
+def _claim_path(workdir, shard):
+    return os.path.join(workdir, registry.REG_DIR,
+                        f"claim-shard-{shard}.json")
+
+
+def _age_claim(path, seconds):
+    registry.locked_mutate(
+        path, lambda doc: dict(doc, t=time.time() - seconds))
+
+
+def test_fresh_claim_is_exclusive(tmp_path):
+    w = str(tmp_path)
+    s, path = claim_orphan_shard(w, "pod-a", [0])
+    assert (s, claim_owner(path)) == (0, "pod-a")
+    # a concurrent rescuer cannot take a FRESH claim
+    s2, path2 = claim_orphan_shard(w, "pod-b", [0])
+    assert (s2, path2) == (None, None)
+    assert claim_owner(path) == "pod-a"
+
+
+def test_crashed_claimant_is_stolen(tmp_path):
+    """Claimant crashed mid-rescue: its claim ages past stale_s with the
+    shard still unserved, and the next rescuer steals it. The original,
+    if it ever resumes, loses at its publish-time ownership re-check."""
+    w = str(tmp_path)
+    _, path = claim_orphan_shard(w, "crashed", [0])
+    _age_claim(path, 120.0)
+    s, path2 = claim_orphan_shard(w, "rescuer", [0], stale_s=30.0)
+    assert s == 0 and path2 == path
+    assert claim_owner(path) == "rescuer"
+    # the resumed original observes the loss exactly where main() checks
+    assert claim_owner(path) != "crashed"
+
+
+def test_steal_race_has_exactly_one_winner(tmp_path):
+    """Many rescuers hit a stale claim concurrently: the age-re-check and
+    the overwrite are one atomic mutation under the flock, so exactly one
+    steals — the rest see a now-fresh claim and stand down."""
+    w = str(tmp_path)
+    _, path = claim_orphan_shard(w, "crashed", [0])
+    _age_claim(path, 120.0)
+    results = []
+    barrier = threading.Barrier(8)
+
+    def rescuer(i):
+        barrier.wait()
+        s, _p = claim_orphan_shard(w, f"rescuer-{i}", [0], stale_s=30.0)
+        results.append((i, s))
+
+    threads = [threading.Thread(target=rescuer, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    winners = [i for i, s in results if s == 0]
+    assert len(winners) == 1, results
+    assert claim_owner(path) == f"rescuer-{winners[0]}"
+
+
+def test_heartbeat_protects_active_claimant(tmp_path):
+    """An ACTIVE claimant (heartbeat refreshing the timestamp) can never
+    look stale, so a would-be stealer with an aggressive stale_s loses."""
+    w = str(tmp_path)
+    _, path = claim_orphan_shard(w, "worker", [0])
+    stop = threading.Event()
+    hb = threading.Thread(target=claim_heartbeat,
+                          args=(path, "worker", stop, 0.05), daemon=True)
+    hb.start()
+    try:
+        time.sleep(0.2)
+        s, _ = claim_orphan_shard(w, "thief", [0], stale_s=0.15)
+        assert s is None
+        assert claim_owner(path) == "worker"
+    finally:
+        stop.set()
+        hb.join(timeout=2.0)
+
+
+def test_heartbeat_stands_down_after_steal(tmp_path):
+    """A claimant that resumes from a wedge AFTER losing its claim must
+    not resurrect its ownership over the legitimate steal: the heartbeat
+    observes the loss inside the lock and exits."""
+    w = str(tmp_path)
+    _, path = claim_orphan_shard(w, "wedged", [0])
+    _age_claim(path, 120.0)
+    s, _ = claim_orphan_shard(w, "thief", [0], stale_s=30.0)
+    assert s == 0
+    stop = threading.Event()
+    hb = threading.Thread(target=claim_heartbeat,
+                          args=(path, "wedged", stop, 0.02), daemon=True)
+    hb.start()
+    hb.join(timeout=5.0)  # exits on its own: the claim is not ours
+    assert not hb.is_alive()
+    assert claim_owner(path) == "thief"
+    stop.set()
+
+
+def test_release_on_clean_handoff(tmp_path):
+    """A published claimant releases its claim: the file is gone, and the
+    shard's NEXT rescue claims fresh via O_EXCL — no staleness wait."""
+    w = str(tmp_path)
+    s, path = claim_orphan_shard(w, "pod-a", [0])
+    assert s == 0
+    assert release_claim(path, "pod-a") is True
+    assert not os.path.exists(path)
+    # immediately claimable by the next rescuer, no steal path involved
+    s2, path2 = claim_orphan_shard(w, "pod-b", [0])
+    assert s2 == 0 and claim_owner(path2) == "pod-b"
+
+
+def test_release_is_owner_checked(tmp_path):
+    w = str(tmp_path)
+    _, path = claim_orphan_shard(w, "pod-a", [0])
+    assert release_claim(path, "impostor") is False
+    assert os.path.exists(path)
+    assert claim_owner(path) == "pod-a"
+    # releasing an already-gone claim is a quiet no-op
+    assert release_claim(path, "pod-a") is True
+    assert release_claim(path, "pod-a") is False
+
+
+def test_probe_alive_tunables(monkeypatch):
+    """EASYDL_PS_PROBE_TIMEOUT_S / EASYDL_PS_PROBE_RETRIES bound the probe
+    budget: one 0.2s attempt against a dead port verdicts DEAD fast."""
+    monkeypatch.setenv("EASYDL_PS_PROBE_TIMEOUT_S", "0.2")
+    monkeypatch.setenv("EASYDL_PS_PROBE_RETRIES", "1")
+    t0 = time.monotonic()
+    assert probe_alive("localhost:1") is False
+    single = time.monotonic() - t0
+    assert single < 3.0
+    # more retries = a bigger budget (each attempt + the 0.5s inter-try
+    # sleep), proving the knob actually drives the loop
+    monkeypatch.setenv("EASYDL_PS_PROBE_RETRIES", "3")
+    t0 = time.monotonic()
+    assert probe_alive("localhost:1") is False
+    assert time.monotonic() - t0 > single + 0.5

@@ -1,0 +1,520 @@
+"""Online PS resharding: routing-table registry semantics, the cutover
+gates, the coordinator protocol end-to-end (real gRPC shards, concurrent
+pushes, bit-identical digests), and the hot-shard split policy.
+
+The e2e tests are the tier-1 face of the `ps_reshard_under_fire` chaos
+drill: same protocol, in-process servers instead of pods, deterministic
+phase-hook pushes instead of wall-clock racing."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from easydl_tpu.chaos.harness import _table_digests
+from easydl_tpu.controller.reconciler import ps_split_decision
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps import registry, reshard
+from easydl_tpu.ps.client import LocalPsClient, ShardedPsClient
+from easydl_tpu.ps.server import STALE_ROUTE, PsShard
+from easydl_tpu.ps.table import TableSpec, shard_of
+
+
+def spec(**kw):
+    kw.setdefault("name", "emb")
+    kw.setdefault("dim", 8)
+    kw.setdefault("optimizer", "adagrad")
+    kw.setdefault("lr", 0.05)
+    kw.setdefault("seed", 3)
+    return TableSpec(**kw)
+
+
+# ------------------------------------------------------ registry routing
+class TestRoutingTable:
+    def test_begin_commit_lifecycle(self, tmp_path):
+        w = str(tmp_path)
+        assert registry.committed_generation(w) == 0
+        plan = registry.begin_reshard(w, 2, 4, "me")
+        assert plan["generation"] == 1
+        assert plan["from_shards"] == 2 and plan["to_shards"] == 4
+        # the slot is exclusive while the plan is fresh
+        assert registry.begin_reshard(w, 2, 8, "other") is None
+        # publication generations: only a DECLARED destination publishes
+        # under the plan; count coincidence alone never does.
+        assert registry.generation_for_publication(w, 2) == 0
+        assert registry.generation_for_publication(w, 4) == 0
+        assert registry.generation_for_publication(w, 4, dest=True) == 1
+        # a declared destination whose count matches neither the plan nor
+        # the committed routing is a config error, not a silent publish
+        with pytest.raises(ValueError, match="matches neither"):
+            registry.generation_for_publication(w, 8, dest=True)
+        doc = registry.commit_reshard(w, "me")
+        assert doc == {"generation": 1, "num_shards": 4}
+        rt = registry.routing_table(w)
+        assert rt["generation"] == 1 and rt["num_shards"] == 4
+        assert "plan" not in rt
+        # post-commit, 4 IS the committed count (a restarting destination
+        # resolves to the committed generation)
+        assert registry.generation_for_publication(w, 4) == 1
+        assert registry.generation_for_publication(w, 4, dest=True) == 1
+
+    def test_commit_is_owner_checked(self, tmp_path):
+        w = str(tmp_path)
+        registry.begin_reshard(w, 2, 4, "me")
+        with pytest.raises(RuntimeError, match="no reshard plan owned"):
+            registry.commit_reshard(w, "impostor")
+        assert registry.committed_generation(w) == 0
+
+    def test_abort_keeps_committed_routing(self, tmp_path):
+        w = str(tmp_path)
+        registry.begin_reshard(w, 2, 4, "me")
+        assert registry.abort_reshard(w, "impostor") is False
+        assert registry.abort_reshard(w, "me") is True
+        assert registry.committed_generation(w) == 0
+        assert "plan" not in registry.routing_table(w)
+        # the slot is free again
+        assert registry.begin_reshard(w, 2, 4, "me2") is not None
+
+    def test_stale_plan_is_stolen(self, tmp_path):
+        w = str(tmp_path)
+        registry.begin_reshard(w, 2, 4, "dead-coordinator")
+        # age the plan past the staleness window
+        path = os.path.join(w, registry.REG_DIR, registry.ROUTING_FILE)
+        registry.locked_mutate(
+            path, lambda doc: dict(
+                doc, plan=dict(doc["plan"], t=time.time() - 1e4)))
+        plan = registry.begin_reshard(w, 2, 8, "thief", stale_s=600.0)
+        assert plan is not None and plan["owner"] == "thief"
+        assert plan["to_shards"] == 8
+        # the dead coordinator can no longer commit its torn migration
+        with pytest.raises(RuntimeError):
+            registry.commit_reshard(w, "dead-coordinator")
+
+    def test_noop_and_invalid_reshards_rejected(self, tmp_path):
+        w = str(tmp_path)
+        with pytest.raises(ValueError):
+            registry.begin_reshard(w, 2, 2, "me")
+        with pytest.raises(ValueError):
+            registry.begin_reshard(w, 2, 0, "me")
+
+    def test_shard_map_filters_by_generation(self, tmp_path):
+        w = str(tmp_path)
+        registry.publish(w, "src-0", 0, 2, "h1:1", epoch=1, generation=0)
+        registry.publish(w, "dst-0", 0, 4, "h2:1", epoch=2, generation=1)
+        # committed generation is 0: the destination stays invisible even
+        # though its epoch is higher
+        assert registry.shard_map(w)[0]["pod"] == "src-0"
+        assert registry.shard_map(w, generation=1)[0]["pod"] == "dst-0"
+        registry.begin_reshard(w, 2, 4, "me")
+        registry.commit_reshard(w, "me")
+        assert registry.shard_map(w)[0]["pod"] == "dst-0"
+
+    def test_shard_map_filters_dead_local_pids_at_read_time(self, tmp_path):
+        """The reroute-never-targets-a-ghost satellite: a dead-pid
+        localhost publication is invisible to readers even when no
+        startup sweep ran."""
+        w = str(tmp_path)
+        registry.publish(w, "ghost", 0, 1, "localhost:1", epoch=5)
+        # forge a provably-dead pid into the entry
+        path = os.path.join(w, registry.REG_DIR, "ps-ghost.json")
+        doc = json.load(open(path))
+        doc["pid"] = 2 ** 22 + 9  # beyond this container's pid space
+        json.dump(doc, open(path, "w"))
+        assert 0 not in registry.shard_map(w)
+        # non-localhost entries are never pid-filtered (other host)
+        registry.publish(w, "remote", 0, 1, "otherhost:1", epoch=1)
+        path = os.path.join(w, registry.REG_DIR, "ps-remote.json")
+        doc = json.load(open(path))
+        doc["pid"] = 2 ** 22 + 9
+        json.dump(doc, open(path, "w"))
+        assert registry.shard_map(w)[0]["pod"] == "remote"
+
+    def test_discover_prefers_routing_table_shape(self, tmp_path):
+        w = str(tmp_path)
+        registry.publish(w, "a", 0, 2, "h:1", epoch=1)
+        registry.publish(w, "b", 1, 2, "h:2", epoch=1)
+        n, addrs = registry.discover(w, timeout=5.0)
+        assert n == 2 and addrs == ("h:1", "h:2")
+        # a committed routing table overrides the publications' count
+        registry.begin_reshard(w, 2, 4, "me")
+        registry.commit_reshard(w, "me")
+        for d in range(4):
+            registry.publish(w, f"d{d}", d, 4, f"h:{10 + d}", epoch=2,
+                             generation=1)
+        n, addrs = registry.discover(w, timeout=5.0)
+        assert n == 4 and addrs == tuple(f"h:{10 + d}" for d in range(4))
+
+
+# ------------------------------------------------------------ server gates
+class TestCutoverGates:
+    def _push_req(self, ids, dim=8, scale=0.5, table="emb"):
+        ids = np.asarray(ids, np.int64)
+        return pb.PushRequest(
+            table=table, raw_ids=ids.astype("<i8").tobytes(),
+            grads=np.ones((len(ids), dim), np.float32).tobytes(),
+            scale=scale)
+
+    def test_cutover_gates_push_and_pull_retriably(self):
+        shard = PsShard()
+        shard.create_table(spec())
+        shard.cutover()
+        ack = shard.Push(self._push_req([1, 2]), None)
+        assert not ack.ok and ack.message.startswith(STALE_ROUTE)
+        with pytest.raises(RuntimeError, match=STALE_ROUTE):
+            shard.Pull(pb.PullRequest(table="emb", ids=[1]), None)
+        # nothing was applied behind the gate
+        assert shard.table("emb").rows == 0
+        # cutover is idempotent; resume (abort rollback) lifts the gate
+        shard.cutover()
+        shard.reshard_resume()
+        assert shard.Push(self._push_req([1, 2]), None).ok
+        assert shard.table("emb").rows == 2
+
+    def test_push_ownership_gate_bounces_foreign_ids(self):
+        """A push whose ids do not hash to the serving shard means the
+        client's partition and the server disagree about the routing (the
+        mid-reshard wrong-generation-reroute race): applying it would
+        create foreign rows outside the migration lineage — silent loss.
+        It must bounce retriably instead."""
+        shard = PsShard(shard_index=1, num_shards=2)
+        shard.create_table(spec())
+        ids = np.arange(64, dtype=np.int64)
+        mine = ids[shard_of(ids, 2) == 1]
+        foreign = ids[shard_of(ids, 2) == 0]
+        ack = shard.Push(self._push_req(foreign), None)
+        assert not ack.ok and ack.message.startswith(STALE_ROUTE)
+        assert shard.table("emb").rows == 0
+        # a mixed batch is equally mis-partitioned — all-or-nothing
+        ack = shard.Push(self._push_req(ids), None)
+        assert not ack.ok and ack.message.startswith(STALE_ROUTE)
+        assert shard.table("emb").rows == 0
+        assert shard.Push(self._push_req(mine), None).ok
+        assert shard.table("emb").rows == len(mine)
+
+    def test_per_shard_reroute_never_adopts_other_generation(self, tmp_path):
+        """The race behind a real drill failure: a reshard commit landing
+        between the reroute's generation check and its shard_map read used
+        to hand back the NEW generation's pod for an old-partition slot —
+        the client adopted its address+epoch without rebuilding, and the
+        old-count chunk was applied wholesale on a shard that does not own
+        its ids. Per-shard reroutes must resolve strictly within the
+        client's own routing generation."""
+        from easydl_tpu.ps.client import ShardedPsClient
+
+        w = str(tmp_path)
+        new1 = PsShard(shard_index=1, num_shards=4, epoch=2)
+        server = new1.serve()
+        try:
+            registry.publish(w, "old-0", 0, 2, "localhost:1111", epoch=1,
+                             generation=0)
+            registry.publish(w, "old-1", 1, 2, "localhost:1112", epoch=1,
+                             generation=0)
+            client = ShardedPsClient(["localhost:1111", "localhost:1112"],
+                                     registry_workdir=w)
+            client._epochs = [1, 1]
+            # a committed reshard: generation 1, 4 shards, a LIVE new pod
+            # for index 1 (live so the buggy path's adoption would succeed)
+            registry.begin_reshard(w, 2, 4, "c")
+            registry.publish(w, "new-1", 1, 4, server.address, epoch=2,
+                             generation=1)
+            registry.commit_reshard(w, "c")
+            # the per-shard path must NOT adopt the generation-1
+            # publication into the generation-0 slot, whatever the
+            # full-rebuild path reported
+            client._maybe_reroute_from_registry(1, force=False)
+            assert client.addresses[1] == "localhost:1112"
+            assert client._epochs[1] == 1
+            client.close()
+        finally:
+            new1.stop()
+
+    def test_reshard_export_freezes_wal_retirement(self, tmp_path):
+        w = str(tmp_path)
+        shard = PsShard(shard_index=0, num_shards=1, epoch=1,
+                        wal_root=os.path.join(w, "ps-wal", "shard-0"),
+                        workdir=w, rescue_dir=os.path.join(w, "ps-ckpt"))
+        shard.create_table(spec())
+        assert shard.Push(self._push_req([1, 2, 3]), None).ok
+        shard.reshard_export(os.path.join(w, "ps-reshard", "gen-1"), 1)
+        assert shard.Push(self._push_req([4, 5]), None).ok  # NOT gated
+        # a rescue-lineage save mid-migration must NOT retire the tail
+        shard.save(os.path.join(w, "ps-ckpt"), step=10)
+        segs = [
+            name
+            for _e, d in __import__(
+                "easydl_tpu.ps.wal", fromlist=["epoch_dirs"]
+            ).epoch_dirs(os.path.join(w, "ps-wal", "shard-0"))
+            for name in os.listdir(d) if name.startswith("seg-")
+        ]
+        assert segs, "export froze retirement, segments must survive"
+        shard.stop()
+
+    def test_replay_dedupes_repartitioned_subset_retry(self, tmp_path):
+        """The applied-but-unacked race across a reshard: a push the dying
+        source WAL'd lands on the destination twice — once via the tail
+        replay, once as the client's re-partitioned retry (the SUBSET of
+        the record this destination owns). The second arrival must ack
+        without applying."""
+        w = str(tmp_path)
+        src = PsShard(shard_index=0, num_shards=1, epoch=1,
+                      wal_root=os.path.join(w, "ps-wal", "shard-0"),
+                      workdir=w, rescue_dir=os.path.join(w, "ps-ckpt"))
+        src.create_table(spec())
+        export = os.path.join(w, "ps-reshard", "gen-1")
+        src.reshard_export(export, 1)
+        ids = np.arange(64, dtype=np.int64)  # tail record, ids span shards
+        assert src.Push(self._push_req(ids), None).ok
+        src.cutover()
+
+        dst = PsShard(shard_index=1, num_shards=2, epoch=2,
+                      wal_root=os.path.join(w, "ps-wal", "shard-1"),
+                      workdir=w, rescue_dir=os.path.join(w, "ps-ckpt"))
+        dst.restore(export, step=1)
+        stats = dst.reshard_replay(export, 1)
+        assert stats["pushes"] == 1 and stats["foreign_ids"] > 0
+        mine = ids[shard_of(ids, 2) == 1]
+        assert stats["ids"] == len(mine)
+        before = dst.table("emb").pull(mine).copy()
+        # the client's retry: the SAME update re-partitioned onto this
+        # destination — exactly the subset it already replayed
+        ack = dst.Push(self._push_req(mine), None)
+        assert ack.ok and "dedup" in ack.message
+        after = dst.table("emb").pull(mine)
+        np.testing.assert_array_equal(before, after)
+        # a genuinely new push with the same ids is NOT swallowed
+        ack = dst.Push(self._push_req(mine), None)
+        assert ack.ok and "dedup" not in ack.message
+        src.stop()
+        dst.stop()
+
+    def test_reshard_replay_is_idempotent_under_rpc_retry(self, tmp_path):
+        """The coordinator re-issues ReshardReplay when the RPC deadline
+        beats a long tail; the second call must return the first call's
+        stats WITHOUT re-applying the tail — and a fresh restore (a
+        stolen plan's retry) must re-arm the real replay."""
+        w = str(tmp_path)
+        src = PsShard(shard_index=0, num_shards=1, epoch=1,
+                      wal_root=os.path.join(w, "ps-wal", "shard-0"),
+                      workdir=w, rescue_dir=os.path.join(w, "ps-ckpt"))
+        src.create_table(spec())
+        export = os.path.join(w, "ps-reshard", "gen-1")
+        src.reshard_export(export, 1)
+        ids = np.arange(64, dtype=np.int64)
+        assert src.Push(self._push_req(ids), None).ok
+        src.cutover()
+
+        dst = PsShard(shard_index=1, num_shards=2, epoch=2,
+                      wal_root=os.path.join(w, "ps-wal", "shard-1"),
+                      workdir=w, rescue_dir=os.path.join(w, "ps-ckpt"))
+        dst.restore(export, step=1)
+        first = dst.reshard_replay(export, 1)
+        mine = ids[shard_of(ids, 2) == 1]
+        once = dst.table("emb").pull(mine).copy()
+        again = dst.reshard_replay(export, 1)  # the coordinator's retry
+        assert again == first
+        np.testing.assert_array_equal(dst.table("emb").pull(mine), once)
+        # a re-restore re-arms: the replay then really runs again
+        dst.restore(export, step=1)
+        rerun = dst.reshard_replay(export, 1)
+        assert rerun["pushes"] == first["pushes"]
+        np.testing.assert_array_equal(dst.table("emb").pull(mine), once)
+        src.stop()
+        dst.stop()
+
+
+# --------------------------------------------------------- split policy
+class TestSplitDecision:
+    def test_needs_heat_and_size(self):
+        # balanced tier: no split however big
+        assert ps_split_decision({0: 5e5, 1: 5e5}, 2) is None
+        # hot but tiny: not worth a migration
+        assert ps_split_decision({0: 900, 1: 100}, 2) is None
+        # hot and big: double
+        assert ps_split_decision({0: 4e5, 1: 1e5}, 2) == 4
+        # capped
+        assert ps_split_decision({0: 4e5, 1: 1e5}, 2, max_shards=2) is None
+        assert ps_split_decision({}, 2) is None
+        assert ps_split_decision({0: 1e6}, 0) is None
+
+
+# ----------------------------------------------------------- coordinator
+class _Cluster:
+    """In-process gRPC shard servers published to a real registry — the
+    coordinator and client see exactly what pods would give them."""
+
+    def __init__(self, workdir: str):
+        self.workdir = workdir
+        self.live = []  # (shard, server)
+
+    def start_set(self, num_shards: int, generation: int = 0,
+                  prefix: str = "src") -> None:
+        for i in range(num_shards):
+            epoch = registry.bump_epoch(self.workdir, i)
+            shard = PsShard(
+                shard_index=i, num_shards=num_shards, epoch=epoch,
+                wal_root=os.path.join(self.workdir, "ps-wal", f"shard-{i}"),
+                workdir=self.workdir,
+                rescue_dir=os.path.join(self.workdir, "ps-ckpt"),
+                route_generation=generation,
+            )
+            server = shard.serve()
+            registry.publish(self.workdir, f"{prefix}-{num_shards}-{i}", i,
+                             num_shards, server.address, epoch=epoch,
+                             generation=generation)
+            self.live.append((shard, server))
+
+    def ensure_destinations(self, plan):
+        self.start_set(int(plan["to_shards"]),
+                       generation=int(plan["generation"]),
+                       prefix=f"dst-g{plan['generation']}")
+
+    def stop(self):
+        for shard, _server in self.live:
+            shard.stop()
+        self.live.clear()
+
+
+def _storm(n_batches, batch=96, vocab=1200, seed=7):
+    rng = np.random.default_rng(seed)
+    return [
+        ((rng.zipf(1.1, batch) % vocab).astype(np.int64),
+         rng.standard_normal((batch, 8)).astype(np.float32))
+        for _ in range(n_batches)
+    ]
+
+
+def test_online_reshard_grow_and_shrink_bit_identical(tmp_path):
+    """The tentpole, end to end in-process: a 2→4 online split and a 4→2
+    shrink run under a live push stream. Deterministic mid-migration
+    traffic is injected at the phase boundaries (a push after `exported`
+    is provably in the WAL tail; a push after `cutover` provably rides
+    the stale-route bounce into the new shard set), and after both
+    migrations every table digest-matches a never-resharded reference —
+    optimizer rows included."""
+    w = str(tmp_path)
+    cluster = _Cluster(w)
+    cluster.start_set(2)
+    client = ShardedPsClient.from_registry(w, 2, timeout=5.0,
+                                           drain_retry_s=60.0,
+                                           transient_retry_s=30.0)
+    reference = LocalPsClient(num_shards=2, coalesce=False)
+    stream = iter(_storm(64))
+    try:
+        for c in (client, reference):
+            c.create_table(spec())
+        def push_batches(n):
+            for _ in range(n):
+                ids, g = next(stream)
+                client.push("emb", ids, g, scale=0.125)
+                reference.push("emb", ids, g, scale=0.125)
+
+        push_batches(6)
+        client.save(os.path.join(w, "ps-ckpt"), step=5)  # rescue lineage
+
+        tail_pushes = {"n": 0}
+
+        def on_phase(phase, plan):
+            # Mid-migration traffic at exact protocol points: after the
+            # export cut (tail records) and after cutover (stale-route →
+            # re-partition onto the new set once committed — run async:
+            # the bounce only resolves when the coordinator commits).
+            if phase == "exported":
+                push_batches(2)
+                tail_pushes["n"] += 2
+            if phase == "cutover":
+                t = threading.Thread(target=push_batches, args=(2,))
+                t.start()
+                on_phase.cut_thread = t
+
+        summary = reshard.run_reshard(
+            w, 4, "test-grow", ensure_destinations=cluster.ensure_destinations,
+            on_phase=on_phase, rpc_timeout=5.0, phase_timeout_s=60.0,
+            dest_wait_s=30.0)
+        on_phase.cut_thread.join(timeout=60.0)
+        assert not on_phase.cut_thread.is_alive()
+        assert summary["committed_routing"] == {"generation": 1,
+                                                "num_shards": 4}
+        assert summary["rows_migrated"] > 0
+        assert summary["tail_pushes_replayed"] >= 1
+        assert summary["tail_foreign_ids_filtered"] > 0
+        # the post-commit rescue-lineage checkpoint landed (4 markers)
+        assert summary["post_commit_ckpt_step"] in PsShard.saved_steps(
+            os.path.join(w, "ps-ckpt"))
+        # the client converged onto the new shard set via stale-route
+        push_batches(4)
+        assert client.num_shards == 4
+        assert registry.committed_generation(w) == 1
+
+        # ------------------------------------------------------ shrink back
+        summary2 = reshard.run_reshard(
+            w, 2, "test-shrink",
+            ensure_destinations=cluster.ensure_destinations,
+            on_phase=on_phase, rpc_timeout=5.0, phase_timeout_s=60.0,
+            dest_wait_s=30.0)
+        on_phase.cut_thread.join(timeout=60.0)
+        assert not on_phase.cut_thread.is_alive()
+        assert summary2["committed_routing"] == {"generation": 2,
+                                                 "num_shards": 2}
+        assert summary2["tail_pushes_replayed"] >= 1
+        push_batches(4)
+        assert client.num_shards == 2
+
+        # ---------------------------------------------------- digest parity
+        live_dir, ref_dir = os.path.join(w, "live"), os.path.join(w, "ref")
+        client.save(live_dir, 999)
+        reference.save(ref_dir, 999)
+        live = _table_digests(live_dir, 999)
+        ref = _table_digests(ref_dir, 999)
+        assert live and live == ref, (live, ref)
+    finally:
+        client.close()
+        cluster.stop()
+
+
+def test_reshard_abort_rolls_back_and_sources_resume(tmp_path):
+    """A phase failure (destinations never publish) aborts: the plan is
+    dropped, sources are un-gated, the committed routing never moved, and
+    the client stream continues against the source set as if nothing
+    happened."""
+    w = str(tmp_path)
+    cluster = _Cluster(w)
+    cluster.start_set(2)
+    client = ShardedPsClient.from_registry(w, 2, timeout=5.0)
+    try:
+        client.create_table(spec())
+        ids = np.arange(100, dtype=np.int64)
+        g = np.ones((100, 8), np.float32)
+        client.push("emb", ids, g, scale=0.1)
+        with pytest.raises(reshard.ReshardError,
+                           match="never published"):
+            reshard.run_reshard(w, 4, "test-abort",
+                                rpc_timeout=2.0, phase_timeout_s=10.0,
+                                dest_wait_s=1.0)
+        assert registry.committed_generation(w) == 0
+        assert "plan" not in registry.routing_table(w)
+        # sources serve again (rollback resumed any gate)
+        client.push("emb", ids, g, scale=0.1)
+        assert client.num_shards == 2
+    finally:
+        client.close()
+        cluster.stop()
+
+
+def test_second_coordinator_is_locked_out(tmp_path):
+    w = str(tmp_path)
+    registry.begin_reshard(w, 2, 4, "first")
+    cluster = _Cluster(w)
+    cluster.start_set(2)
+    try:
+        with pytest.raises(reshard.ReshardInProgress):
+            reshard.run_reshard(w, 4, "second", rpc_timeout=1.0,
+                                phase_timeout_s=2.0, dest_wait_s=1.0)
+        # the loser must not have damaged the winner's plan
+        assert registry.routing_table(w)["plan"]["owner"] == "first"
+    finally:
+        cluster.stop()
