@@ -381,22 +381,62 @@ class Autoscaler:
         }
 
     def restore_state(self, doc: Dict[str, object]) -> None:
-        self._per_size = {}
-        for s, vals in (doc.get("per_size") or {}).items():
-            stats = _SizeStats()
-            for v in vals:
-                stats.add(float(v), self.config.window)
-            self._per_size[int(s)] = stats
-        self._bad_sizes = set(doc.get("bad_sizes") or [])
-        self._best_per_chip = float(doc.get("best_per_chip") or 0.0)
-        self._last_size = int(doc.get("last_size") or 0)
-        pending = doc.get("pending_check")
-        self._pending_check = tuple(pending) if pending else None
-        elapsed = doc.get("cooldown_elapsed_s")
-        if elapsed is None:
+        """Restore a :meth:`to_state` snapshot. NEVER raises: the doc comes
+        off disk, and a Brain pod crashed mid-journal-write leaves a torn /
+        partial / garbage document behind — a replacement that dies on its
+        own state file can never come back. Anything unusable degrades to
+        fresh state with a logged warning; autoscaling then re-learns its
+        windows instead of staying down for the rest of the job."""
+        import math
+
+        def reset() -> None:
+            self._per_size = {}
+            self._bad_sizes = set()
+            self._best_per_chip = 0.0
+            self._last_size = 0
+            self._pending_check = None
             self._last_decision_t = -1e18
-        else:
-            self._last_decision_t = self._clock() - float(elapsed)
+
+        try:
+            if not isinstance(doc, dict):
+                raise TypeError(f"state doc is {type(doc).__name__}, "
+                                "not dict")
+            per_size: Dict[int, _SizeStats] = {}
+            for s, vals in (doc.get("per_size") or {}).items():
+                stats = _SizeStats()
+                for v in vals:
+                    v = float(v)
+                    if math.isfinite(v) and v > 0:
+                        stats.add(v, self.config.window)
+                per_size[int(s)] = stats
+            bad_sizes = {int(b) for b in doc.get("bad_sizes") or []}
+            best = float(doc.get("best_per_chip") or 0.0)
+            best = best if math.isfinite(best) else 0.0
+            last_size = int(doc.get("last_size") or 0)
+            pending = doc.get("pending_check")
+            pending_check = (
+                (int(pending[0]), int(pending[1])) if pending else None
+            )
+            elapsed = doc.get("cooldown_elapsed_s")
+            last_decision_t = (
+                -1e18 if elapsed is None
+                else self._clock() - float(elapsed)
+            )
+        except Exception as e:
+            log.warning(
+                "corrupt autoscaler state doc (%s); degrading to fresh "
+                "state — windows will re-learn", e,
+            )
+            reset()
+            return
+        # Every field validated: install atomically (a raise above leaves
+        # the autoscaler untouched until reset()).
+        self._per_size = per_size
+        self._bad_sizes = bad_sizes
+        self._best_per_chip = best
+        self._last_size = last_size
+        self._pending_check = pending_check
+        self._last_decision_t = last_decision_t
 
     # ------------------------------------------------------------------ status
     def status(self) -> Dict[str, object]:

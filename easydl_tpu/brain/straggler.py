@@ -1,0 +1,285 @@
+"""Straggler detection: per-member step-time skew → damped eviction.
+
+ROADMAP item 3 names the gap: PRs 1+4 give the control plane per-step,
+per-process data it never used. A straggling host (thermal throttle, noisy
+neighbor, sick NIC) drags every peer down in synchronous training — the
+whole world steps at the slowest rank's pace — yet nothing watched for it.
+
+This module is the *decision* half, pure by design: no IO, no clocks of its
+own — every observation and every query carries an explicit ``now``, so the
+exact same object (and therefore the exact same policy) runs inside the
+live master's tick loop AND inside the offline control-plane simulator
+(easydl_tpu/sim/). The master wires the mitigation: an eviction candidate
+becomes a planned reshape that excludes the straggler
+(``Rendezvous.exclude_agent``), counted under
+``easydl_master_reshapes_total{reason="straggler"}``.
+
+Detection rule (the ISSUE's "rank step-time > k× rolling median for m
+consecutive windows"):
+
+- per agent, a long rolling *baseline* window of recorded step times; the
+  baseline is its median. New step samples are deduped by step number — a
+  stalled agent re-reporting one step must not inflate its streak.
+- a window is *skewed* when its median exceeds ``ratio`` × the reference.
+  The reference is the fleet median of the OTHER reporters' recent-window
+  medians when at least ``min_peer_agents`` report (cross-rank skew
+  against the fleet's *current* pace: a global slowdown — input stall,
+  shared-fs hiccup — moves the reference with the fleet and is NOT a
+  straggler); with fewer reporters the agent is judged against its OWN
+  baseline median only when ``allow_self_skew`` is set (this container
+  cannot run multi-member worlds, so the single-member chaos drills opt
+  in; a production fleet keeps the cross-rank default). Skewed windows
+  are NOT admitted into the baseline — a straggler must not become its
+  own reference.
+- one *window* observation is the median of the last ``recent_window``
+  samples — an isolated burst (async checkpoint commit, GC pause,
+  scheduler hiccup) poisons at most half a window, so the median shrugs
+  it off, while a persistent straggler saturates every window;
+- ``consecutive`` skewed windows in a row flag the agent as a suspect.
+
+Damping (the anti-ping-pong half, the invariant the chaos drill and the
+simulator both assert):
+
+- after any eviction, a hold-down window of ``holddown_s`` during which NO
+  further straggler eviction fires — the reshape itself perturbs step
+  times (restore + first-step compile), and reacting to that perturbation
+  is exactly the flapping the north star forbids;
+- an evicted agent's state is forgotten, so a post-holddown relapse is
+  judged on fresh evidence, not a stale streak.
+"""
+
+from __future__ import annotations
+
+import statistics
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Sequence
+
+
+def actuate_eviction(detector: "StragglerDetector", rendezvous,
+                     now: float) -> Optional[str]:
+    """ONE copy of the eviction actuation, shared verbatim by the live
+    master's tick loop and the offline simulator (the whole point of the
+    replayable-policy design: the two can never drift). Duck-typed against
+    :class:`easydl_tpu.elastic.membership.Rendezvous` so brain/ stays free
+    of an elastic/ import. Returns the evicted agent id, or None."""
+    if getattr(rendezvous.phase, "value", "") != "stable":
+        return None
+    cand = detector.evict_candidate(
+        rendezvous.members, rendezvous.healthy_agent_ids(),
+        rendezvous.min_workers, now,
+    )
+    if cand is None:
+        return None
+    if not rendezvous.exclude_agent(
+            cand, detector.config.holddown_s, reason="straggler"):
+        return None
+    detector.note_eviction(cand, now)
+    return cand
+
+
+def _median(vals: Sequence[float]) -> float:
+    return float(statistics.median(vals)) if vals else 0.0
+
+
+@dataclass
+class StragglerConfig:
+    """Knobs for the detector (docs/operations.md §10 has the tuning
+    table). Defaults are deliberately conservative: 4× the median for 3
+    consecutive samples is far outside normal jitter, and a 30s hold-down
+    outlasts a reshape's restore+compile transient."""
+
+    #: enable the detector (the master skips observe/evict entirely when off)
+    enabled: bool = True
+    #: a sample is skewed when step_time > ratio × reference median
+    ratio: float = 4.0
+    #: consecutive skewed samples before an agent is a suspect
+    consecutive: int = 3
+    #: rolling baseline window per agent (samples)
+    baseline_window: int = 32
+    #: samples an agent must have before it can be judged at all (the
+    #: first post-spawn step is a compile; a thin baseline is noise)
+    min_samples: int = 6
+    #: agents that must be reporting for CROSS-rank skew; below this the
+    #: detector falls back to self-skew against the agent's own baseline
+    #: ONLY when allow_self_skew is set
+    min_peer_agents: int = 2
+    #: judge a lone reporter against its OWN rolling baseline. Off by
+    #: default: cross-rank skew is the robust signal (a global slowdown —
+    #: input stall, CPU-shares throttling on a shared box — moves every
+    #: rank and must not read as one straggler), and a single-member
+    #: world has no peer to be slower THAN. The single-member chaos
+    #: drills and simulator replays opt in explicitly.
+    allow_self_skew: bool = False
+    #: hold-down after any eviction: no further straggler eviction fires
+    #: inside this window (the anti-ping-pong damping)
+    holddown_s: float = 30.0
+    #: each skew "window" observation is the MEDIAN of this many recent
+    #: samples, not a raw sample: an isolated burst (async checkpoint
+    #: commit, GC, a scheduler hiccup) poisons at most half a window and
+    #: the median shrugs it off, while a persistent straggler saturates
+    #: every window. 1 = judge raw samples (hair-trigger; the mis-tuned
+    #: negative control uses it).
+    recent_window: int = 5
+
+
+@dataclass
+class _AgentWindow:
+    samples: Deque[float]
+    recent: Deque[float]
+    last_step: int = -1
+    streak: int = 0
+    generation: int = 0
+
+
+class StragglerDetector:
+    """Feed :meth:`observe` with per-member step times; ask
+    :meth:`evict_candidate` whether a damped eviction is due. Deterministic
+    given the observation stream and the ``now`` values supplied."""
+
+    def __init__(self, config: Optional[StragglerConfig] = None):
+        self.config = config or StragglerConfig()
+        self._agents: Dict[str, _AgentWindow] = {}
+        self._holddown_until: float = float("-inf")
+        self._evictions: List[Dict[str, object]] = []
+
+    # ------------------------------------------------------------- intake
+    def observe(self, agent_id: str, step_time_s: float, step: int,
+                now: float, generation: int = 0) -> None:
+        """One member step-time sample (deduped by step number WITHIN a
+        generation: an unplanned reshape rolls members back to the last
+        checkpoint and re-executed steps are fresh evidence — and a new
+        generation's pace is a new regime, so the window restarts rather
+        than letting a pre-reshape pace serve as the reference)."""
+        cfg = self.config
+        if not cfg.enabled or step_time_s <= 0:
+            return
+        w = self._agents.get(agent_id)
+        if w is None or generation != w.generation:
+            w = self._agents[agent_id] = _AgentWindow(
+                samples=deque(maxlen=cfg.baseline_window),
+                recent=deque(maxlen=max(cfg.recent_window, 1)),
+                generation=generation)
+        if step <= w.last_step:
+            return  # stale re-report of a step already judged
+        w.last_step = step
+        w.recent.append(step_time_s)
+        # Per-agent gates first: the fleet reference is an O(agents)
+        # median and this runs on the heartbeat path under the master
+        # lock — don't pay it during warm-up.
+        skewed = False
+        if len(w.samples) >= cfg.min_samples \
+                and len(w.recent) == w.recent.maxlen:
+            ref = self._reference_median(agent_id)
+            skewed = ref > 0 and _median(w.recent) > cfg.ratio * ref
+        w.streak = w.streak + 1 if skewed else 0
+        # Freeze-under-skew: a skewed window's sample is NOT admitted to
+        # the agent's baseline. Without this, a short pre-straggle history
+        # (the baseline may hold only min_samples fast steps) is overrun
+        # by the straggler's own slow samples within one window and the
+        # skew judges itself away before the streak can mature.
+        if not skewed:
+            w.samples.append(step_time_s)
+
+    def _reference_median(self, agent_id: str) -> float:
+        """The pace this agent is judged against: the fleet median of the
+        OTHER reporters' recent-window medians (cross-rank skew — peers'
+        *current* pace, so a global slowdown moves the reference with the
+        fleet and flags nobody), else — with ``allow_self_skew`` — the
+        agent's own frozen baseline median."""
+        cfg = self.config
+        others = [
+            _median(w.recent) for aid, w in self._agents.items()
+            if aid != agent_id
+            and len(w.samples) >= cfg.min_samples
+            and len(w.recent) == w.recent.maxlen
+        ]
+        if others and len(others) + 1 >= cfg.min_peer_agents:
+            return _median(others)
+        if not cfg.allow_self_skew:
+            return 0.0
+        w = self._agents.get(agent_id)
+        if w is not None and len(w.samples) >= cfg.min_samples:
+            return _median(w.samples)
+        return 0.0
+
+    # ----------------------------------------------------------- decision
+    def suspects(self, now: float) -> List[str]:
+        """Agents currently past the consecutive-skew threshold."""
+        cfg = self.config
+        return sorted(
+            aid for aid, w in self._agents.items()
+            if w.streak >= cfg.consecutive
+        )
+
+    def evict_candidate(self, members: Sequence[str],
+                        available: Sequence[str], min_workers: int,
+                        now: float) -> Optional[str]:
+        """The member to evict right now, or None.
+
+        ``available`` is the healthy replacement pool (members AND
+        standbys, excluding anyone already excluded) — the caller's
+        ``Rendezvous.healthy_agent_ids()``. None while the hold-down
+        window is open (damping), when no member is a suspect, or when
+        evicting would leave fewer than ``min_workers`` usable agents —
+        trading the whole job for one slow host is worse than the slow
+        host."""
+        cfg = self.config
+        if not cfg.enabled or now < self._holddown_until:
+            return None
+        # Prune departed agents first: an ex-member's frozen window must
+        # not serve as the fleet reference after a legitimate pace change
+        # (it would falsely flag every survivor), and its matured streak
+        # must not evict it instantly on stale evidence if re-admitted —
+        # a returning host is judged on fresh observations.
+        for aid in [a for a in self._agents if a not in members]:
+            self._agents.pop(aid)
+        suspect_members = [a for a in self.suspects(now) if a in members]
+        if not suspect_members:
+            return None
+        # Worst offender first: longest streak, then slowest baseline.
+        def badness(aid: str):
+            w = self._agents[aid]
+            return (w.streak, _median(w.samples))
+        for cand in sorted(suspect_members, key=badness, reverse=True):
+            remaining = sum(1 for a in available if a != cand)
+            if remaining >= max(min_workers, 1):
+                return cand
+        return None
+
+    def note_eviction(self, agent_id: str, now: float) -> None:
+        """Arm the hold-down and forget the evicted agent's windows (a
+        post-holddown relapse is judged on fresh evidence)."""
+        self._holddown_until = now + self.config.holddown_s
+        self._evictions.append({"agent": agent_id, "t": now})
+        self._agents.pop(agent_id, None)
+
+    @property
+    def holddown_until(self) -> float:
+        return self._holddown_until
+
+    @property
+    def evictions(self) -> List[Dict[str, object]]:
+        return list(self._evictions)
+
+    # ------------------------------------------------------------- status
+    def status(self) -> Dict[str, object]:
+        return {
+            "agents": {
+                aid: {
+                    "n": len(w.samples),
+                    "median_s": round(_median(w.samples), 5),
+                    "streak": w.streak,
+                    "last_step": w.last_step,
+                }
+                for aid, w in sorted(self._agents.items())
+            },
+            # None, not -inf: this dict lands in JSON documents (the
+            # master's status/health, chaos verdicts) and -Infinity is
+            # not valid RFC 8259 JSON.
+            "holddown_until": (
+                None if self._holddown_until == float("-inf")
+                else self._holddown_until
+            ),
+            "evictions": list(self._evictions),
+        }

@@ -51,6 +51,10 @@ class Scenario:
     chaos: ChaosSpec
     job_cfg: Dict[str, Any]
     expect: Dict[str, Any]
+    #: where this drill runs by default: "tier-1" (rides the default test
+    #: suite and chaos_smoke.sh), "smoke" (chaos_smoke.sh only), or
+    #: "slow" (pytest -m chaos / scripts/chaos_run.py)
+    tier: str = "slow"
     n_agents: int = 2
     #: plan-desired worker count (default: n_agents). The drills run
     #: member+standby topologies with desired_workers=1: this container's
@@ -168,6 +172,11 @@ class ChaosHarness:
         #: control-plane outage windows [{"t_down": wall, "t_up": wall}] —
         #: evidence for the training_progress_during_outage invariant
         self.outages: List[Dict[str, float]] = []
+        #: every executed worker_kill, with wall time and whether a live
+        #: worker was actually hit — the preempt_race drill's evidence
+        #: that the drain beat the kill (a tolerated no-op kill IS the
+        #: success case there)
+        self.kill_marks: List[Dict[str, Any]] = []
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> Dict[str, Any]:
@@ -241,9 +250,12 @@ class ChaosHarness:
         }
         for kind, count in subprocess_counts.items():
             fault_counts[kind] = fault_counts.get(kind, 0.0) + count
+        for kind, count in self._scrape_worker_trace_faults().items():
+            fault_counts[kind] = fault_counts.get(kind, 0.0) + count
         verdict = invariants.check_scenario(
             self.workdir, sc.expect, status=status,
             fault_counts=fault_counts, outages=self.outages,
+            kills=self.kill_marks,
         )
         _scenario_counter().inc(scenario=sc.name,
                                 result="pass" if verdict["passed"] else "fail")
@@ -257,6 +269,7 @@ class ChaosHarness:
             "expect": dict(sc.expect),
             "faults_injected": fault_counts,
             "outages": list(self.outages),
+            "kills": list(self.kill_marks),
             "final_status": status,
             "invariants": verdict,
             "passed": verdict["passed"],
@@ -842,8 +855,9 @@ class ChaosHarness:
         this scenario). The harness process' own exporters are excluded —
         its counters are accounted as deltas against the pre-run baseline.
         Worker subprocesses run no exporter, so worker-side inline faults
-        (straggler, ckpt_corrupt_write) are NOT visible here; scenarios
-        relying on them should not set ``min_faults`` on those kinds."""
+        (straggler, ckpt_corrupt_write) are NOT visible here — those are
+        recovered from the workers' trace flight recorders instead
+        (:meth:`_scrape_worker_trace_faults`)."""
         from easydl_tpu.obs import scrape
 
         out: Dict[str, float] = {}
@@ -861,6 +875,42 @@ class ChaosHarness:
                     out[kind] = out.get(kind, 0.0) + count
         except Exception as e:  # counting is evidence, never a crash
             log.warning("subprocess fault scrape failed: %s", e)
+        return out
+
+    def _scrape_worker_trace_faults(self) -> Dict[str, float]:
+        """Worker-side inline faults (straggler, ckpt_corrupt_write) from
+        the workers' span flight recorders: workers run no /metrics
+        exporter, but every count_fault also stamps a ``fault:<kind>``
+        instant into the firing process' spans JSONL, and drills run with
+        tracing armed. Only ``spans-worker-*`` files are read — agent/
+        master/PS fault instants are already counted via the registry
+        delta or the exporter scrape, and double-counting would let a
+        drill pass min_faults on one real injection."""
+        out: Dict[str, float] = {}
+        obs_dir = os.path.join(self.workdir, "obs")
+        try:
+            names = sorted(os.listdir(obs_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.startswith("spans-worker-"):
+                continue
+            if not (name.endswith(".jsonl") or name.endswith(".jsonl.1")):
+                continue
+            try:
+                with open(os.path.join(obs_dir, name)) as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue  # torn tail from a killed worker
+                        label = str(rec.get("name", ""))
+                        if rec.get("ph") == "i" \
+                                and label.startswith("fault:"):
+                            kind = label[len("fault:"):]
+                            out[kind] = out.get(kind, 0.0) + 1.0
+            except OSError:
+                continue
         return out
 
     # ------------------------------------------------------- process events
@@ -883,7 +933,23 @@ class ChaosHarness:
         log.info("chaos event %s: %s target=%s", ev["id"], kind, target)
         if kind == "worker_kill":
             agent = self._agents[target["agent"]]
-            if agent.worker_pid is None:
+            alive = agent.worker_pid is not None
+            self.kill_marks.append({
+                "t": time.time(), "agent": str(target["agent"]),
+                "worker_alive": alive,
+                "tolerate_dead": bool(params.get("tolerate_dead")),
+            })
+            if not alive:
+                if params.get("tolerate_dead"):
+                    # The preempt_race shape: the "VM death" fires on
+                    # schedule whether or not the drain already emptied
+                    # the host — a dead worker here is the proactive
+                    # drain WINNING, recorded in the mark, judged by the
+                    # proactive_drain invariant.
+                    log.info("worker_kill on %s hit no live worker "
+                             "(tolerated; drain may have won the race)",
+                             target["agent"])
+                    return
                 # Counting a kill that hit nothing would let a drill "pass"
                 # without ever injecting its fault (job already done, or
                 # worker dead for another reason) — fail the event loudly
@@ -1038,6 +1104,7 @@ def scenario_worker_kill(seed: int = 7) -> Scenario:
                           target={"agent": "a0"}),
             ),
         ),
+        tier="tier-1",
         # Steps run at hundreds/s on CPU — the job must be big enough to
         # still be mid-run when the kill fires (a done job makes the kill
         # a no-op, which worker_kill dispatch + faults_observed then FAIL).
@@ -1221,6 +1288,7 @@ def scenario_master_crash(seed: int = 29) -> Scenario:
                           params={"restart_after_s": 1.5}),
             ),
         ),
+        tier="tier-1",
         # Long enough that the job is still mid-run through crash + outage +
         # reconciliation (steps run at hundreds/s on CPU).
         job_cfg=dict(_MLP_CFG, total_steps=3000, ckpt_interval=150),
@@ -1303,6 +1371,7 @@ def scenario_ps_shard_crash_zero_loss(seed: int = 37) -> Scenario:
                           params={"respawn_after_s": 0.3}),
             ),
         ),
+        tier="tier-1",
         job_cfg={},
         ps_shards=2,
         ps_storm={"steps": 260, "batch": 192, "vocab": 3000, "dim": 8,
@@ -1370,6 +1439,7 @@ def scenario_ps_reshard_under_fire(seed: int = 43) -> Scenario:
                   "back; digests must match a never-resharded reference",
             faults=(),  # injected at protocol points, not wall offsets
         ),
+        tier="smoke",
         job_cfg={},
         ps_shards=2,
         ps_storm={"steps": 420, "batch": 160, "vocab": 3000, "dim": 8,
@@ -1389,6 +1459,103 @@ def scenario_ps_reshard_under_fire(seed: int = 43) -> Scenario:
     )
 
 
+def scenario_straggler_mitigation(seed: int = 47) -> Scenario:
+    """Straggler detection + damped eviction (ROADMAP item 3's first named
+    invariant): 2s after steady state the member's worker starts sleeping
+    0.25s at every step boundary — step time jumps ~100× over its
+    baseline. The master's skew detector (fed from the same heartbeat
+    metrics the Brain sees) must evict the host within budget via a
+    PLANNED reshape that excludes it, the standby takes over, and — the
+    anti-ping-pong half — ZERO further reshapes happen inside the
+    hold-down window even though the straggler window stays open. The
+    injector's fault count is recovered from the worker's trace flight
+    recorder, so a run where the sleep never fired cannot pass."""
+    from easydl_tpu.brain.straggler import StragglerConfig
+
+    return Scenario(
+        chaos=ChaosSpec(
+            name="straggler_mitigation", seed=seed,
+            notes="0.25s/step straggler on the member (a0) from t0+2s; "
+                  "skew eviction must exclude it, then hold-down quiet",
+            faults=(
+                FaultSpec(kind="straggler", at_s=2.0, duration_s=120.0,
+                          target={"agent": "a0"},
+                          params={"sleep_s": 0.25}),
+            ),
+        ),
+        tier="slow",
+        # Long enough that the job is still mid-run through detection +
+        # eviction + hold-down (steps run at hundreds/s on CPU once the
+        # straggler is gone).
+        job_cfg=dict(_MLP_CFG, total_steps=6000, ckpt_interval=300),
+        n_agents=2, desired_workers=1, slots=1, steady_step=5,
+        master_kwargs={
+            "min_workers": 1, "heartbeat_timeout": 4.0,
+            # allow_self_skew: these worlds have ONE reporting member
+            # (this jax build runs no cross-process collectives), so the
+            # skew reference is the member's own baseline
+            "straggler": StragglerConfig(ratio=8.0, consecutive=6,
+                                         min_samples=6, holddown_s=10.0,
+                                         allow_self_skew=True),
+        },
+        done_timeout_s=420.0,
+        expect={
+            "target_step": 6000,
+            "max_steps_lost": 600,        # 2×ckpt_interval (async commit)
+            "final_workers": 1,
+            "final_world_devices": 1,
+            "max_reshapes": 2,            # the mitigation, NO flapping
+            "min_final_generation": 2,    # the eviction really reshaped
+            "straggler_evicted": "a0",
+            "evict_budget_s": 30.0,       # onset → eviction WAL record
+            "holddown_quiet": True,
+            "min_faults": 1,              # ≥1 straggled step (trace scrape)
+        },
+    )
+
+
+def scenario_preempt_race(seed: int = 53) -> Scenario:
+    """The preemption race (ROADMAP item 3's second named invariant): a
+    cloud preemption notice reaches the member at t0+0.3s; the VM "dies"
+    (SIGKILL, tolerated if the worker is already gone) 2.5s later. The
+    notice must trigger a PROACTIVE drain — quiesce checkpoint committed
+    and worker exited strictly BEFORE the kill timestamp — rather than
+    reactive crash recovery after it. The invariant reads the worker's
+    own quiesce_exit timeline record against the harness' kill mark and
+    fails loudly when the kill found the worker still alive."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="preempt_race", seed=seed,
+            notes="preemption notice to the member at t0+0.3s, VM SIGKILL "
+                  "at t0+2.8s; drain checkpoint must beat the kill",
+            faults=(
+                FaultSpec(kind="preempt_notice", at_s=0.3,
+                          target={"agent": "a0"}),
+                FaultSpec(kind="worker_kill", at_s=2.8,
+                          target={"agent": "a0"},
+                          params={"tolerate_dead": True}),
+            ),
+        ),
+        tier="slow",
+        job_cfg=dict(_MLP_CFG, total_steps=3000, ckpt_interval=150),
+        n_agents=2, desired_workers=1, slots=1, steady_step=5,
+        master_kwargs={"min_workers": 1, "heartbeat_timeout": 2.0},
+        expect={
+            "target_step": 3000,
+            # The quiesce drain checkpoints at the exact step boundary;
+            # the bound only leaves margin for the escalation path, which
+            # the proactive_drain invariant would flag anyway.
+            "max_steps_lost": 150,
+            "final_workers": 1,
+            "final_world_devices": 1,
+            "max_reshapes": 2,
+            "min_final_generation": 2,    # the drain really reshaped
+            "proactive_drain": "a0",
+            "min_faults": 1,              # the notice (kill may be a no-op)
+        },
+    )
+
+
 #: name → builder(seed) for scripts/chaos_run.py and the e2e tests.
 SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "worker_kill": scenario_worker_kill,
@@ -1401,6 +1568,8 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "ps_shard_crash_zero_loss": scenario_ps_shard_crash_zero_loss,
     "ps_zombie_writer": scenario_ps_zombie_writer,
     "ps_reshard_under_fire": scenario_ps_reshard_under_fire,
+    "straggler_mitigation": scenario_straggler_mitigation,
+    "preempt_race": scenario_preempt_race,
 }
 
 #: the cheapest deterministic drill — what scripts/chaos_smoke.sh runs and

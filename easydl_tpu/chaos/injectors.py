@@ -250,12 +250,17 @@ def heartbeat_suppressed(agent_id: str) -> bool:
 
 
 # ---------------------------------------------------------- worker hook
-def maybe_straggle(rank: int) -> None:
-    """Artificial straggler sleep at the step boundary (elastic/worker.py)."""
+def maybe_straggle(rank: int, agent: str = "") -> None:
+    """Artificial straggler sleep at the step boundary (elastic/worker.py).
+
+    Targetable by ``rank`` or by ``agent`` (the host id): after a
+    straggler-mitigation reshape the replacement member's worker is rank 0
+    again, so a rank-targeted window would chase the fault onto the
+    healthy successor — the mitigation drill targets the HOST."""
     plan = current_plan()
     if plan is None:
         return
-    ev = plan.active("straggler", rank=rank)
+    ev = plan.active("straggler", rank=rank, agent=agent)
     if ev is not None:
         count_fault("straggler")
         time.sleep(float(ev.get("params", {}).get("sleep_s", 0.2)))

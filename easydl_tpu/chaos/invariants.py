@@ -45,6 +45,19 @@ verdicts:
   pushes — a "pass" where the migration never ran, or ran against a
   silent tier, is refused (same no-vacuous-pass stance as
   ``ps_wal_replayed``);
+- ``straggler_mitigated`` — the master's skew detector actually evicted
+  the declared straggler (``straggler_evicted`` WAL record), the final
+  membership excludes it, and — when the scenario declares
+  ``evict_budget_s`` — the eviction landed within budget of the armed
+  straggler window's start;
+- ``holddown_quiet`` — the anti-ping-pong half: after each eviction, NO
+  further reshape inside the detector's hold-down window (beyond the
+  mitigation reshape itself); vacuous-pass refused when no eviction
+  happened;
+- ``proactive_drain_before_kill`` — the preemption race: the noticed
+  member's own ``quiesce_exit`` timeline record (checkpoint committed,
+  worker exited) precedes the harness' kill mark, and the kill found no
+  live worker — reactive crash-recovery after the kill fails the drill;
 - ``faults_observed`` (cross-check) — the obs counters saw at least the
   expected number of injected faults, so a "pass" can't come from a drill
   that silently injected nothing.
@@ -114,6 +127,23 @@ def read_metrics_by_agent(workdir: str) -> Dict[str, List[Dict[str, Any]]]:
     return out
 
 
+def read_timeline(workdir: str, agent: str) -> List[Dict[str, Any]]:
+    """One agent's phase-boundary timeline records (timeline.py JSONL)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(os.path.join(workdir, f"timeline-{agent}.jsonl")) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+    except OSError:
+        pass
+    return out
+
+
 def read_events(workdir: str) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     try:
@@ -128,6 +158,51 @@ def read_events(workdir: str) -> List[Dict[str, Any]]:
     except OSError:
         pass
     return out
+
+
+def holddown_violations(
+    evictions: List[Mapping[str, Any]],
+    reshapes: List[Mapping[str, Any]],
+) -> List[Dict[str, Any]]:
+    """ONE copy of the hold-down rule, shared by the live drill checker
+    and the offline simulator (sim/invariants.py) so the same-named
+    invariant can never drift between the two: inside each eviction's
+    hold-down window the ONLY permitted reshape is the mitigation itself
+    — the first ``reason == "straggler"`` record — and anything else
+    (matched by WAL attributes, not a timing fudge) is flapping."""
+    out: List[Dict[str, Any]] = []
+    for ev in evictions:
+        te = float(ev.get("t", 0.0))
+        h = float(ev.get("holddown_s", 0.0))
+        inside = [r for r in reshapes
+                  if te <= float(r.get("t", 0.0)) <= te + h]
+        mitigation_seen = False
+        flaps = []
+        for r in inside:
+            if not mitigation_seen and str(r.get("reason")) == "straggler":
+                mitigation_seen = True
+                continue
+            flaps.append(dict(r))
+        if flaps:
+            out.append({"eviction": dict(ev), "reshapes": flaps})
+    return out
+
+
+def drain_race(drain_ts: List[float], kill_t: float,
+               worker_alive: bool) -> Dict[str, Any]:
+    """ONE copy of the preemption-race rule (live + sim): the drain wins
+    iff a drain completion precedes the kill AND the kill found no live
+    worker."""
+    drain_t = max((t for t in drain_ts if t < kill_t), default=None)
+    won = drain_t is not None and not worker_alive
+    return {
+        "kill_t": kill_t,
+        "drain_t": drain_t,
+        "worker_alive_at_kill": bool(worker_alive),
+        "margin_s": (round(kill_t - drain_t, 6)
+                     if drain_t is not None else None),
+        "won": won,
+    }
 
 
 def _steps_by_generation(metrics: List[Dict[str, Any]]) -> Dict[int, List[int]]:
@@ -146,6 +221,7 @@ def check_scenario(
     status: Optional[Mapping[str, Any]] = None,
     fault_counts: Optional[Mapping[str, float]] = None,
     outages: Optional[List[Mapping[str, float]]] = None,
+    kills: Optional[List[Mapping[str, Any]]] = None,
 ) -> Dict[str, Any]:
     """Run every applicable invariant; returns::
 
@@ -156,7 +232,9 @@ def check_scenario(
     (injectors.injected_fault_counts or a merged scrape); ``outages`` the
     harness-recorded control-plane outage windows
     (``[{"t_down": wall, "t_up": wall}]``, ``t_up`` absent when the master
-    never came back)."""
+    never came back); ``kills`` the harness' worker_kill marks
+    (``{"t": wall, "agent", "worker_alive"}``) — the preempt-race
+    evidence."""
     metrics = read_metrics(workdir)
     events = read_events(workdir)
     by_gen = _steps_by_generation(metrics)
@@ -343,6 +421,89 @@ def check_scenario(
                 "min_steps_during_outage": int(min_outage_steps),
             }
 
+    # ------------------------------------------------- straggler mitigation
+    evicted = expect.get("straggler_evicted")
+    if evicted is not None:
+        evict_events = [e for e in events
+                        if e.get("kind") == "straggler_evicted"
+                        and e.get("agent") == evicted]
+        members = list((status or {}).get("members", []))
+        if not evict_events:
+            # The drill PROMISED an eviction; a run where the detector
+            # never fired must not pass on the reshape bound alone.
+            checks["straggler_mitigated"] = {
+                "ok": False,
+                "reason": "no straggler_evicted event in the WAL "
+                          "(detector never fired?)",
+                "agent": evicted,
+            }
+        else:
+            ev = evict_events[0]
+            ok = evicted not in members
+            budget = expect.get("evict_budget_s")
+            latency = None
+            if budget is not None:
+                # Onset = the armed schedule's straggler window start
+                # (t0 + start_s), read from the plan the harness wrote.
+                onset = _straggler_onset(workdir, evicted)
+                if onset is None:
+                    ok = False
+                else:
+                    latency = round(float(ev.get("t", 0.0)) - onset, 3)
+                    ok = ok and 0 <= latency <= float(budget)
+            checks["straggler_mitigated"] = {
+                "ok": ok,
+                "agent": evicted,
+                "evictions": len(evict_events),
+                "final_members": members,
+                "latency_s": latency,
+                "evict_budget_s": budget,
+            }
+
+    if expect.get("holddown_quiet"):
+        evict_events = [e for e in events
+                        if e.get("kind") == "straggler_evicted"]
+        reshape_events = [e for e in events if e.get("kind") == "reshape"]
+        if not evict_events:
+            checks["holddown_quiet"] = {
+                "ok": False,
+                "reason": "no eviction in the WAL — the anti-ping-pong "
+                          "window was never exercised (vacuous)",
+            }
+        else:
+            violations = holddown_violations(evict_events, reshape_events)
+            checks["holddown_quiet"] = {
+                "ok": not violations,
+                "evictions": len(evict_events),
+                "violations": violations,
+            }
+
+    # -------------------------------------------------- proactive drain race
+    race_agent = expect.get("proactive_drain")
+    if race_agent:
+        marks = [k for k in (kills or [])
+                 if str(k.get("agent", "")) == str(race_agent)]
+        if not marks:
+            checks["proactive_drain_before_kill"] = {
+                "ok": False,
+                "reason": "no worker_kill mark recorded for the noticed "
+                          "agent — the race was never run (vacuous)",
+                "agent": race_agent,
+            }
+        else:
+            tl = read_timeline(workdir, str(race_agent))
+            quiesce_exits = [float(r.get("t", 0.0)) for r in tl
+                             if r.get("phase") == "quiesce_exit"]
+            evidence = [
+                drain_race(quiesce_exits, float(k.get("t", 0.0)),
+                           bool(k.get("worker_alive")))
+                for k in marks
+            ]
+            checks["proactive_drain_before_kill"] = {
+                "ok": all(e["won"] for e in evidence),
+                "agent": race_agent, "races": evidence,
+            }
+
     # ------------------------------------------------------- ps zero loss
     if expect.get("ps_zero_loss"):
         evidence: Dict[str, Any] = {}
@@ -444,3 +605,23 @@ def check_scenario(
         "passed": all(c["ok"] for c in checks.values()),
         "checks": checks,
     }
+
+
+def _straggler_onset(workdir: str, agent: str) -> Optional[float]:
+    """Wall-clock start of the armed straggler window targeting ``agent``
+    (t0 + start_s from the harness' chaos-plan.json)."""
+    try:
+        with open(os.path.join(workdir, "chaos-plan.json")) as f:
+            plan = json.load(f)
+    except (OSError, ValueError):
+        return None
+    t0 = plan.get("t0")
+    if t0 is None:
+        return None
+    starts = [
+        float(t0) + float(e.get("start_s", 0.0))
+        for e in plan.get("events", [])
+        if e.get("kind") == "straggler"
+        and str(e.get("target", {}).get("agent", "")) == agent
+    ]
+    return min(starts) if starts else None

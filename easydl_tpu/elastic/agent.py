@@ -679,6 +679,9 @@ class Agent:
             env.setdefault("OMP_NUM_THREADS", "1")
             env.setdefault("OPENBLAS_NUM_THREADS", "1")
         env["EASYDL_TIMELINE"] = self.timeline_path
+        # Explicit host identity for the worker (agent-targeted chaos
+        # windows key on it) — never derived from a file-path convention.
+        env["EASYDL_AGENT_ID"] = self.agent_id
         env[tracing.PROC_ENV] = f"worker-{self.agent_id}"
         return env
 

@@ -18,6 +18,9 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from easydl_tpu.api.resource_plan import ResourcePlan
+from easydl_tpu.brain.straggler import (
+    StragglerConfig, StragglerDetector, actuate_eviction,
+)
 from easydl_tpu.chaos import banner as chaos_banner
 from easydl_tpu.elastic.membership import Directive, JobPhase, Rendezvous
 from easydl_tpu.obs import get_registry, start_exporter, tracing
@@ -67,6 +70,7 @@ class _Servicer:
             # The journal must carry the new agent (and any cohort change)
             # before the directive leaves the master.
             self._m._persist_if_epoch_advanced()
+            self._m._drain_reshape_log()
             tracing.attach_reply_context(ctx, sw)
             return self._m._to_proto(d)
 
@@ -116,6 +120,7 @@ class _Servicer:
             sw = self._m._trace_switch_span()
             self._m._count_directive(req.agent_id, d.kind)
             self._m._persist_if_epoch_advanced()
+            self._m._drain_reshape_log()
             tracing.attach_reply_context(ctx, sw)
             return self._m._to_proto(d)
 
@@ -140,6 +145,7 @@ class Master:
         preempt_prepare_timeout_s: float = 20.0,
         standing_preflight: bool = False,
         reconcile_grace_s: float = 10.0,
+        straggler: Optional[StragglerConfig] = None,
     ):
         self.job_name = job_name
         self.workdir = workdir
@@ -268,6 +274,20 @@ class Master:
         self._m_journal_writes = reg.counter(
             "easydl_master_journal_writes_total", "Membership-journal "
             "writes to the state file.", ("job",))
+        self._m_reshapes = reg.counter(
+            "easydl_master_reshapes_total", "Reshapes of a running "
+            "generation initiated, by cause (plan-change / member-lost / "
+            "preemption / straggler).", ("job", "reason"))
+        self._m_straggler_evictions = reg.counter(
+            "easydl_master_straggler_evictions_total", "Members evicted by "
+            "the step-time skew detector.", ("job",))
+        # Straggler mitigation: the detector is pure (brain/straggler.py)
+        # and shared verbatim with the offline control-plane simulator —
+        # the master only feeds it member step times and actuates its
+        # eviction decision as a damped planned reshape.
+        self._straggler = StragglerDetector(straggler or StragglerConfig())
+        #: reshape_log entries already drained into counters + the WAL
+        self._reshape_seen = 0
         if worker_config is not None:
             with open(os.path.join(workdir, "job.json"), "w") as f:
                 json.dump(worker_config, f)
@@ -412,6 +432,8 @@ class Master:
         while not self._stop.is_set():
             with self._lock:
                 self.rendezvous.tick()
+                self._maybe_evict_straggler()
+                self._drain_reshape_log()
                 phase = self.rendezvous.phase
                 if phase != last_phase:
                     self._trace_phase(phase)
@@ -570,6 +592,41 @@ class Master:
                 log.warning("brain poll failed: %s", e)
             self._stop.wait(self.brain_poll_interval)
 
+    # ------------------------------------------------------- straggler policy
+    def _maybe_evict_straggler(self) -> None:
+        """Actuate the skew detector's decision (lock held): exclude the
+        straggling member — a planned reshape of the survivors plus any
+        standby — and arm the detector's hold-down so the reshape's own
+        restore/compile transient cannot trigger a follow-up eviction (the
+        anti-ping-pong invariant the chaos drill asserts)."""
+        rdv = self.rendezvous
+        cand = actuate_eviction(self._straggler, rdv, time.monotonic())
+        if cand is None:
+            return
+        holddown = self._straggler.config.holddown_s
+        log.warning("straggler detected: evicted %s (hold-down %.0fs)",
+                    cand, holddown)
+        self._m_straggler_evictions.inc(job=self.job_name)
+        self._event(
+            "straggler_evicted", agent=cand, holddown_s=holddown,
+            generation=rdv.generation,
+        )
+
+    def _drain_reshape_log(self) -> None:
+        """Fold newly-initiated reshapes (rendezvous reshape_log) into
+        easydl_master_reshapes_total{reason} and the events WAL (lock
+        held). Runs on the tick loop and after RPC-path evaluations; the
+        seen-cursor makes it idempotent."""
+        entries = self.rendezvous.reshape_log
+        while self._reshape_seen < len(entries):
+            e = entries[self._reshape_seen]
+            self._reshape_seen += 1
+            self._m_reshapes.inc(job=self.job_name, reason=e["reason"])
+            self._event(
+                "reshape", reason=e["reason"], planned=bool(e["planned"]),
+                from_generation=int(e["from_generation"]),
+            )
+
     # ------------------------------------------------------------------ misc
     def _record_metrics(self, agent_id: str, m: pb.StepMetrics) -> None:
         # Keyed by the generation at receipt: aggregation must only mix
@@ -578,6 +635,13 @@ class Master:
         # (pin world_size after a scale-down, suppress the step gate).
         gen = self.rendezvous.generation
         self._last_metrics[agent_id] = (gen, m)
+        # Straggler intake: members only (a standby's warm-up steps are not
+        # fleet skew), deduped by step WITHIN the generation inside the
+        # detector (a rollback's re-executed steps are fresh evidence).
+        if agent_id in self.rendezvous.members and m.step_time_s > 0:
+            self._straggler.observe(agent_id, float(m.step_time_s),
+                                    int(m.step), time.monotonic(),
+                                    generation=gen)
         # Without a Brain the aggregate exists only to feed three gauges —
         # don't pay the O(members log members) median under the master lock
         # on EVERY heartbeat of a brainless fleet; once a second is plenty
@@ -741,6 +805,7 @@ class Master:
                 }
                 for aid, (_, m) in self._last_metrics.items()
             }
+            s["straggler"] = self._straggler.status()
         s["plan_version"] = self.plan_version
         s["job"] = self.job_name
         return s

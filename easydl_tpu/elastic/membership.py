@@ -5,7 +5,8 @@ The reference's elasticity is pod-level reconciliation
 *running* job absorbs a world-size change — is this FSM. XLA's compiled world
 is static (SURVEY.md §7 hard part 1), so membership changes are generations:
 
-  STABLE ──(plan change / member lost / preemption notice)──► DRAINING
+  STABLE ──(plan change / member lost / preemption notice / straggler
+            eviction)──► DRAINING
   DRAINING: planned → QUIESCE members (checkpoint at the exact step boundary:
             zero lost work); unplanned (member died) → KILL members (restore
             from the last periodic checkpoint)
@@ -55,6 +56,10 @@ class AgentView:
     step: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
     preempting: bool = False
+    #: rendezvous-clock time until which this agent is excluded from
+    #: membership (straggler mitigation); -inf = not excluded
+    excluded_until: float = float("-inf")
+    excluded_reason: str = ""
     #: coordinator of the preflight this agent reports ready ("" = none)
     prepared: str = ""
     #: True for a view rebuilt from the journal after a master restart,
@@ -180,13 +185,21 @@ class Rendezvous:
         #: period (-inf = not reconciling): journal-resumed agents that have
         #: not yet re-presented are exempt from LOST-marking until then
         self._reconcile_until = float("-inf")
+        #: every reshape of a RUNNING generation, appended when the FSM
+        #: leaves STABLE for PREPARING/DRAINING: {"t": clock, "reason",
+        #: "from_generation"}. The master drains it into
+        #: easydl_master_reshapes_total{reason} and the events WAL; the
+        #: simulator reads it directly. Reasons: plan-change | member-lost
+        #: | preemption | straggler.
+        self.reshape_log: List[Dict[str, Any]] = []
 
     # ------------------------------------------------------------------ events
     def register(self, agent_id: str, host: str, slots: int, preempting: bool = False) -> Directive:
         a = self.agents.get(agent_id)
         if a is None:
             self.agents[agent_id] = AgentView(
-                agent_id=agent_id, host=host, slots=slots, preempting=preempting
+                agent_id=agent_id, host=host, slots=slots,
+                preempting=preempting, last_heartbeat=self._clock(),
             )
             log.info("agent %s registered (%d slots)%s", agent_id, slots,
                      " [preempting]" if preempting else "")
@@ -196,7 +209,7 @@ class Rendezvous:
             # heartbeat/adopt instead — Register means the agent process
             # itself restarted and owns no worker.)
             a.state = AgentState.IDLE
-            a.last_heartbeat = time.monotonic()
+            a.last_heartbeat = self._clock()
             a.preempting = preempting
             a.resumed = False
         self._evaluate()
@@ -238,7 +251,7 @@ class Rendezvous:
         a.step = max(a.step, step)
         a.prepared = prepared
         a.preempting = preempting or a.preempting
-        a.last_heartbeat = time.monotonic()
+        a.last_heartbeat = self._clock()
         a.resumed = False
         try:
             a.state = AgentState(state)
@@ -260,7 +273,7 @@ class Rendezvous:
             # Unknown agent (master restarted): ask it to register by NOOP —
             # agents re-register when they see generation 0 noop repeatedly.
             return Directive(kind="noop")
-        a.last_heartbeat = time.monotonic()
+        a.last_heartbeat = self._clock()
         a.resumed = False  # re-presented after a master restart
         a.generation = generation
         a.step = max(a.step, step)
@@ -282,7 +295,7 @@ class Rendezvous:
 
     def tick(self, now: Optional[float] = None) -> None:
         """Advance time: mark lost agents, re-evaluate."""
-        now = now if now is not None else time.monotonic()
+        now = now if now is not None else self._clock()
         reconciling = now < self._reconcile_until
         for a in self.agents.values():
             if a.resumed and reconciling:
@@ -305,8 +318,9 @@ class Rendezvous:
         """True while the post-restore grace period is open.
 
         The window lives on the same clock as ``last_heartbeat``
-        (``time.monotonic``) — ``tick(now=...)`` tests drive both."""
-        return time.monotonic() < self._reconcile_until
+        (the injected ``clock``, ``time.monotonic`` by default) —
+        ``tick(now=...)`` tests drive both."""
+        return self._clock() < self._reconcile_until
 
     def set_desired_workers(self, n: int) -> None:
         if n != self.desired_workers:
@@ -314,15 +328,41 @@ class Rendezvous:
             self.desired_workers = n
             self._evaluate()
 
+    def exclude_agent(self, agent_id: str, holddown_s: float,
+                      reason: str = "straggler") -> bool:
+        """Exclude a misbehaving member from membership for ``holddown_s``
+        seconds (straggler mitigation): the next target drops it — a
+        PLANNED reshape, its peers quiesce at a step boundary — and it
+        cannot be re-admitted until the window closes, so a recovering
+        straggler cannot flap the membership. Returns False for an unknown
+        agent."""
+        a = self.agents.get(agent_id)
+        if a is None:
+            return False
+        a.excluded_until = self._clock() + max(holddown_s, 0.0)
+        a.excluded_reason = reason
+        log.warning("excluding agent %s from membership for %.0fs (%s)",
+                    agent_id, holddown_s, reason)
+        self._evaluate()
+        return True
+
     def shutdown(self) -> None:
         self.phase = JobPhase.DONE
         self._evaluate()
 
     # ------------------------------------------------------------------ logic
+    def healthy_agent_ids(self) -> List[str]:
+        """Usable agents (members and standbys; excludes lost/done/
+        preempting/excluded) — the straggler policy's replacement pool."""
+        return [a.agent_id for a in self._healthy()]
+
     def _healthy(self) -> List[AgentView]:
+        now = self._clock()
         out = [
             a for a in self.agents.values()
-            if a.state not in (AgentState.LOST, AgentState.DONE) and not a.preempting
+            if a.state not in (AgentState.LOST, AgentState.DONE)
+            and not a.preempting
+            and a.excluded_until <= now
         ]
         return sorted(out, key=lambda a: a.agent_id)
 
@@ -338,18 +378,20 @@ class Rendezvous:
         extra = [i for i in healthy_ids if i not in keep]
         return (keep + extra)[: self.desired_workers]
 
-    def _want_reshape(self) -> Tuple[bool, bool]:
-        """(reshape needed, planned?)"""
+    def _want_reshape(self) -> Tuple[bool, bool, str]:
+        """(reshape needed, planned?, reason) — reason is one of
+        plan-change | member-lost | preemption | straggler, the label the
+        master counts reshapes under."""
         target = self._target()
         if not self.members:
-            return (len(target) >= self.min_workers, True)
+            return (len(target) >= self.min_workers, True, "plan-change")
         member_lost = any(
             self.agents[m].state == AgentState.LOST
             for m in self.members
             if m in self.agents
         )
         if member_lost:
-            return True, False
+            return True, False, "member-lost"
         # A member whose worker died (agent alive, reports idle at the current
         # generation): peers are hung in collectives — unplanned reshape.
         member_crashed = any(
@@ -359,16 +401,24 @@ class Rendezvous:
             if m in self.agents
         )
         if member_crashed:
-            return True, False
+            return True, False, "member-lost"
         member_preempting = any(
             self.agents[m].preempting for m in self.members if m in self.agents
         )
         if member_preempting:
             # Planned: the notice arrives before the VM disappears — drain now.
-            return True, True
+            return True, True, "preemption"
         if set(target) != set(self.members) and len(target) >= self.min_workers:
-            return True, True
-        return False, True
+            now = self._clock()
+            member_excluded = any(
+                self.agents[m].excluded_until > now
+                for m in self.members
+                if m in self.agents
+            )
+            return True, True, (
+                "straggler" if member_excluded else "plan-change"
+            )
+        return False, True, "plan-change"
 
     def _evaluate(self) -> None:
         # Run to a fixpoint: a single event can complete several transitions
@@ -424,7 +474,7 @@ class Rendezvous:
                         self.standing_preflight_grace_s,
                     )
                     self.prepare = None
-            need, planned = self._want_reshape()
+            need, planned, reason = self._want_reshape()
             if not need:
                 # STANDING PREFLIGHT: even with nothing to reshape, keep the
                 # next generation pre-formed — same members, fresh
@@ -470,6 +520,18 @@ class Rendezvous:
                 return
             self._drain_planned = planned
             target = tuple(self._target())
+            if self.members:
+                # A reshape of a RUNNING generation is being initiated —
+                # log it once, with its cause, for the master's
+                # reshapes-by-reason counter, the events WAL, and the
+                # simulator's verdicts. (Initial formation is not a
+                # reshape and is not logged.)
+                self.reshape_log.append({
+                    "t": self._clock(),
+                    "reason": reason,
+                    "planned": planned,
+                    "from_generation": self.generation,
+                })
             if not self.members:
                 self._form_generation()
             elif (
@@ -730,6 +792,15 @@ class Rendezvous:
                     "step": a.step,
                     "prepared": a.prepared,
                     "preempting": a.preempting,
+                    # Monotonic reading → journaled as REMAINING seconds
+                    # (same contract as the prepare deadline): a restarted
+                    # master must keep a straggler excluded for the rest
+                    # of its hold-down, not forever and not zero.
+                    "excluded_remaining_s": (
+                        max(0.0, a.excluded_until - self._clock())
+                        if a.excluded_until > self._clock() else 0.0
+                    ),
+                    "excluded_reason": a.excluded_reason,
                 }
                 for a in self.agents.values()
             },
@@ -758,13 +829,14 @@ class Rendezvous:
         self.desired_workers = int(
             snap.get("desired_workers", self.desired_workers)
         )
-        now = time.monotonic()
+        now = self._clock()
         self.agents = {}
         for aid, d in dict(snap.get("agents", {})).items():
             try:
                 state = AgentState(str(d.get("state", "idle")))
             except ValueError:
                 state = AgentState.IDLE
+            excluded_s = float(d.get("excluded_remaining_s", 0.0) or 0.0)
             self.agents[str(aid)] = AgentView(
                 agent_id=str(aid),
                 host=str(d.get("host", "")),
@@ -776,6 +848,10 @@ class Rendezvous:
                 preempting=bool(d.get("preempting", False)),
                 prepared=str(d.get("prepared", "")),
                 resumed=True,
+                excluded_until=(
+                    now + excluded_s if excluded_s > 0 else float("-inf")
+                ),
+                excluded_reason=str(d.get("excluded_reason", "")),
             )
         prep = snap.get("prepare")
         self.prepare = None
@@ -830,6 +906,7 @@ class Rendezvous:
                     "gen": a.generation,
                     "step": a.step,
                     "preempting": a.preempting,
+                    "excluded": a.excluded_until > self._clock(),
                 }
                 for a in self.agents.values()
             },

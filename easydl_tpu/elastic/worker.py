@@ -103,6 +103,11 @@ def run_worker(env: Dict[str, str]) -> int:
     workdir = env["EASYDL_WORKDIR"]
     metrics_path = env["EASYDL_METRICS"]
     tl_path = env.get("EASYDL_TIMELINE")
+    # The host/agent id, for agent-targeted chaos windows. Set explicitly
+    # by the agent; the filename fallback (metrics-<agent>.jsonl is the
+    # agent's convention) only covers standalone/manual worker runs.
+    agent_id = env.get("EASYDL_AGENT_ID") or (
+        os.path.basename(metrics_path)[len("metrics-"):-len(".jsonl")])
 
     from easydl_tpu.elastic import timeline
     from easydl_tpu.obs import tracing
@@ -515,10 +520,6 @@ def run_worker(env: Dict[str, str]) -> int:
                         "step %d", generation, step)
             root_span.end(outcome="orphaned", step=step)
             return 4
-        if maybe_straggle is not None:
-            # Chaos hook point: artificial straggler sleep at the step
-            # boundary (rank-targeted window in the armed spec).
-            maybe_straggle(rank)
         # Quiesce consensus at the step boundary. Multi-process workers may
         # only act on the *agreed* flag (acting on the local flag alone would
         # leave peers hanging in the next collective).
@@ -560,6 +561,13 @@ def run_worker(env: Dict[str, str]) -> int:
             return 0
 
         t0 = time.perf_counter()
+        if maybe_straggle is not None:
+            # Chaos hook point: artificial straggler sleep, INSIDE the
+            # timed window — a simulated slow host must look slow in the
+            # step metrics (the skew detector's signal), exactly as a
+            # thermally-throttled chip would. Placed after the quiesce
+            # check so a draining worker exits promptly regardless.
+            maybe_straggle(rank, agent=agent_id)
         state, metrics = trainer.train_step(state, next(data))
         loss = float(metrics["loss"])  # blocks: real step time
         dt = time.perf_counter() - t0

@@ -1,0 +1,23 @@
+"""Offline control-plane simulator (ROADMAP item 3).
+
+Replays recorded or synthetic signal timelines through the REAL policy
+objects — Rendezvous, StragglerDetector, Autoscaler — on a virtual clock:
+a multi-hour scaling scenario regression-tests in milliseconds, entirely
+in tier-1, with byte-identical verdicts across runs.
+
+- :mod:`easydl_tpu.sim.timeline` — the fixture format + workdir recorder
+  + synthetic generators;
+- :mod:`easydl_tpu.sim.simulator` — the discrete-event engine;
+- :mod:`easydl_tpu.sim.invariants` — policy invariants over a result.
+
+Entry points: :func:`easydl_tpu.sim.simulator.simulate` in-process, or
+``python scripts/policy_replay.py`` from a shell / chaos_smoke.sh.
+"""
+
+from easydl_tpu.sim.simulator import (  # noqa: F401
+    ControlPlaneSimulator, SimPolicy, simulate,
+)
+from easydl_tpu.sim.timeline import (  # noqa: F401
+    load_fixture, load_workdir, make_timeline, save_fixture,
+    synthetic_autoscale, synthetic_preempt, synthetic_straggler,
+)

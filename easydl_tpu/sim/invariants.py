@@ -1,0 +1,187 @@
+"""Policy invariants over a simulation result — the assertion half of the
+offline control plane, mirroring chaos/invariants.py's stance: a replay
+that merely *runs* proves little; the verdict is named checks with
+evidence, and vacuous passes are refused.
+
+Expectations are a plain dict (scenarios stay declarative)::
+
+    expect = {
+        "target_step": 2000,            # some member reached this step
+        "max_steps_lost": 200,          # worst generation switch
+        "final_workers": 1,
+        "max_reshapes": 2,              # total reshape initiations
+        "straggler_evicted": "a0",      # this agent ends up excluded
+        "evict_budget_s": 30.0,         # onset → eviction latency bound
+        "holddown_quiet": True,         # NO reshape inside the hold-down
+        "proactive_drain": True,        # drain strictly before the kill
+        "min_scale_ups": 2,             # autoscaler really climbed
+        "final_desired_workers": 4,
+    }
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping
+
+# The window/race cores are SHARED with the live drill checker — the two
+# implementations of a same-named invariant must never drift.
+from easydl_tpu.chaos.invariants import drain_race, holddown_violations
+
+
+def check(result: Mapping[str, Any], expect: Dict[str, Any],
+          timeline: Mapping[str, Any]) -> Dict[str, Any]:
+    checks: Dict[str, Dict[str, Any]] = {}
+    final = dict(result.get("final", {}))
+    reshapes: List[Dict[str, Any]] = list(result.get("reshapes", []))
+    evictions: List[Dict[str, Any]] = list(result.get("evictions", []))
+    switches: List[Dict[str, Any]] = list(result.get("switches", []))
+    drains: List[Dict[str, Any]] = list(result.get("drains", []))
+    kills: List[Dict[str, Any]] = list(result.get("kills", []))
+    preempts: List[Dict[str, Any]] = list(result.get("preempts", []))
+    faults: List[Dict[str, Any]] = list(timeline.get("faults", []))
+
+    # ------------------------------------------------- reached_target_step
+    target = expect.get("target_step")
+    if target is not None:
+        max_step = int(final.get("max_step", 0))
+        done = final.get("phase") == "done"
+        checks["reached_target_step"] = {
+            "ok": done or max_step >= int(target),
+            "target": int(target), "max_step": max_step, "done": done,
+        }
+
+    # --------------------------------------------------- steps_lost_bounded
+    bound = expect.get("max_steps_lost")
+    if bound is not None:
+        worst = max((int(s.get("steps_lost", 0)) for s in switches),
+                    default=0)
+        checks["steps_lost_bounded"] = {
+            "ok": worst <= int(bound), "bound": int(bound), "worst": worst,
+            "switches": switches,
+        }
+
+    # -------------------------------------------------- membership_converged
+    want_workers = expect.get("final_workers")
+    if want_workers is not None:
+        members = list(final.get("members", []))
+        checks["membership_converged"] = {
+            "ok": len(members) == int(want_workers),
+            "final_members": members, "want_workers": int(want_workers),
+        }
+
+    # ------------------------------------------------ no_directive_ping_pong
+    max_reshapes = expect.get("max_reshapes")
+    if max_reshapes is not None:
+        checks["no_directive_ping_pong"] = {
+            "ok": len(reshapes) <= int(max_reshapes),
+            "reshapes": len(reshapes),
+            "max_reshapes": int(max_reshapes),
+            "by_reason": _count_by(reshapes, "reason"),
+        }
+
+    # ----------------------------------------------------- straggler_evicted
+    evicted = expect.get("straggler_evicted")
+    if evicted is not None:
+        hits = [e for e in evictions if e.get("agent") == evicted]
+        onset = min(
+            (float(f["t"]) for f in faults
+             if f.get("kind") == "straggler" and f.get("agent") == evicted),
+            default=None,
+        )
+        budget = expect.get("evict_budget_s")
+        ok = bool(hits) and evicted not in final.get("members", [])
+        latency = None
+        if hits and onset is not None:
+            latency = round(float(hits[0]["t"]) - onset, 6)
+            if budget is not None:
+                ok = ok and latency <= float(budget)
+        elif budget is not None and onset is None:
+            # A latency budget against a timeline with no straggler marker
+            # can only pass vacuously — refuse it.
+            ok = False
+        checks["straggler_evicted"] = {
+            "ok": ok, "agent": evicted, "evictions": hits,
+            "onset_t": onset, "latency_s": latency,
+            "evict_budget_s": budget,
+            "final_members": list(final.get("members", [])),
+        }
+
+    # -------------------------------------------------------- holddown_quiet
+    if expect.get("holddown_quiet"):
+        if not evictions:
+            checks["holddown_quiet"] = {
+                "ok": False,
+                "reason": "no eviction happened — the anti-ping-pong "
+                          "window was never exercised (vacuous)",
+            }
+        else:
+            violations = holddown_violations(evictions, reshapes)
+            checks["holddown_quiet"] = {
+                "ok": not violations,
+                "evictions": evictions,
+                "violations": violations,
+            }
+
+    # --------------------------------------------------------- eviction churn
+    max_evictions = expect.get("max_evictions")
+    if max_evictions is not None:
+        checks["eviction_churn_bounded"] = {
+            "ok": len(evictions) <= int(max_evictions),
+            "evictions": len(evictions),
+            "max_evictions": int(max_evictions),
+        }
+
+    # ------------------------------------------------ proactive_drain (race)
+    if expect.get("proactive_drain"):
+        noticed = {str(p.get("agent", "")) for p in preempts}
+        races = [k for k in kills if str(k.get("agent", "")) in noticed]
+        if not races:
+            checks["proactive_drain_before_kill"] = {
+                "ok": False,
+                "reason": "no kill of a noticed agent in the replay — the "
+                          "race was never run (vacuous)",
+            }
+        else:
+            evidence = []
+            for k in races:
+                aid, tk = str(k["agent"]), float(k["t"])
+                drain_ts = [float(d["t"]) for d in drains
+                            if d.get("agent") == aid]
+                race = drain_race(drain_ts, tk,
+                                  bool(k.get("worker_alive")))
+                race["agent"] = aid
+                evidence.append(race)
+            checks["proactive_drain_before_kill"] = {
+                "ok": all(e["won"] for e in evidence),
+                "races": evidence,
+            }
+
+    # ------------------------------------------------------- autoscaler path
+    min_ups = expect.get("min_scale_ups")
+    if min_ups is not None:
+        ups = [s for s in result.get("scale_decisions", [])
+               if int(s.get("to_workers", 0)) > int(s.get("from_workers", 0))]
+        checks["autoscaler_scaled_up"] = {
+            "ok": len(ups) >= int(min_ups),
+            "scale_ups": ups, "min_scale_ups": int(min_ups),
+        }
+    want_desired = expect.get("final_desired_workers")
+    if want_desired is not None:
+        got = int(final.get("desired_workers", 0))
+        checks["autoscaler_converged"] = {
+            "ok": got == int(want_desired),
+            "final_desired_workers": got, "want": int(want_desired),
+        }
+
+    return {
+        "passed": all(c["ok"] for c in checks.values()),
+        "checks": checks,
+    }
+
+
+def _count_by(entries: List[Dict[str, Any]], key: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for e in entries:
+        k = str(e.get(key, ""))
+        out[k] = out.get(k, 0) + 1
+    return out

@@ -1,0 +1,458 @@
+"""Deterministic virtual-clock control-plane simulator.
+
+The tentpole of ROADMAP item 3: scaling/drain/eviction policy used to be
+exercisable only by live multi-process chaos drills (seconds-to-minutes
+each, wall-clock-jittered); this engine replays a recorded or synthetic
+signal timeline (sim/timeline.py) through the **real** policy objects —
+
+- the real :class:`easydl_tpu.elastic.membership.Rendezvous` (the FSM is
+  constructed with an injected virtual clock; every transition rule,
+  including the preemption short-window and the straggler exclusion, is
+  the production code path),
+- the real :class:`easydl_tpu.brain.straggler.StragglerDetector` actuated
+  through the same :func:`~easydl_tpu.brain.straggler.actuate_eviction`
+  helper the live master's tick loop calls,
+- the real :class:`easydl_tpu.brain.policy.Autoscaler` (``force_python``
+  so verdicts are byte-identical with or without the native toolchain),
+
+— under a discrete-event loop that models only what the control plane
+cannot see: workers stepping at the recorded durations, heartbeats at the
+agent cadence, checkpoints at the job cadence, faults at their scheduled
+virtual timestamps. A multi-minute incident replays in milliseconds, with
+NO subprocesses, NO sleeps, NO wall-clock reads — same timeline + same
+policy ⇒ byte-identical verdict (asserted by chaos_smoke.sh running every
+committed fixture twice).
+
+The worker model is deliberately coarse (steps, checkpoints, drain at a
+step boundary, fixed restart delay): the subject under test is the
+*decision* layer, and every decision input it sees — step-time skew,
+preemption flags, member loss, heartbeat gaps — is faithful to the
+timeline. Invariants over the result live in sim/invariants.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import statistics
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from easydl_tpu.brain.policy import Autoscaler, AutoscalerConfig
+from easydl_tpu.brain.straggler import (
+    StragglerConfig, StragglerDetector, actuate_eviction,
+)
+from easydl_tpu.elastic.membership import JobPhase, Rendezvous
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("sim", "simulator")
+
+
+@dataclass
+class SimPolicy:
+    """The control-plane configuration under test — the simulator's
+    equivalent of the live Master's constructor knobs."""
+
+    desired_workers: int = 1
+    min_workers: int = 1
+    heartbeat_interval: float = 0.3
+    heartbeat_timeout: float = 5.0
+    tick_interval: float = 0.2
+    #: agents register this far apart (mirrors the harness stagger: a0
+    #: first, so single-member worlds deterministically pick it)
+    register_stagger_s: float = 0.25
+    #: RUN directive → first post-restore step (process spawn + restore +
+    #: compile, collapsed into one constant)
+    restart_delay_s: float = 1.0
+    prepare_timeout_s: float = 0.0
+    preempt_prepare_timeout_s: float = 20.0
+    straggler: StragglerConfig = field(default_factory=StragglerConfig)
+    #: feed the real Autoscaler and actuate its decisions as desired-worker
+    #: changes when set (None = hold desired_workers fixed)
+    autoscaler: Optional[AutoscalerConfig] = None
+
+
+@dataclass
+class _SimAgent:
+    agent_id: str
+    stream: List[List[float]]
+    tail_dt: float
+    registered: bool = False
+    alive: bool = True
+    preempting: bool = False
+    state: str = "idle"
+    generation: int = -1
+    coordinator: str = ""
+    step: int = 0
+    idx: int = 0          # next stream sample to consume
+    next_hb_t: float = 0.0
+    step_done_t: Optional[float] = None
+    quiesce_pending: bool = False
+    #: latest completed sample [dt, rate, world] — what the next heartbeat
+    #: reports (the live agent reads only the metrics-JSONL tail too)
+    last_sample: Optional[List[float]] = None
+    last_observed_step: int = -1
+
+
+def _median(vals: List[float]) -> float:
+    return float(statistics.median(vals)) if vals else 0.0
+
+
+class ControlPlaneSimulator:
+    """Single-use: build with a timeline + policy, call :meth:`run`."""
+
+    #: dispatch priority at equal timestamps (then agent id): faults hit
+    #: before anything reacts, steps land before the heartbeat that would
+    #: report them, the master tick observes last.
+    _PRIO = {"fault": 0, "step": 1, "hb": 2, "tick": 3}
+
+    def __init__(self, timeline: Mapping[str, Any],
+                 policy: Optional[SimPolicy] = None):
+        self.timeline = timeline
+        self.policy = policy or SimPolicy()
+        self.now = 0.0
+        p = self.policy
+        ports = itertools.count(50000)
+        self.rdv = Rendezvous(
+            desired_workers=p.desired_workers,
+            min_workers=p.min_workers,
+            heartbeat_timeout=p.heartbeat_timeout,
+            port_alloc=lambda: next(ports),
+            prepare_timeout_s=p.prepare_timeout_s,
+            prepare_min_uptime_s=0.0,
+            preempt_prepare_timeout_s=p.preempt_prepare_timeout_s,
+            clock=lambda: self.now,
+        )
+        self.detector = StragglerDetector(p.straggler)
+        self.autoscaler = (
+            Autoscaler(p.autoscaler, clock=lambda: self.now,
+                       force_python=True)
+            if p.autoscaler is not None else None
+        )
+        meta = dict(timeline.get("meta", {}))
+        self.total_steps = int(meta.get("total_steps", 0) or 0)
+        self.ckpt_interval = int(meta.get("ckpt_interval", 100) or 100)
+        self.world_profile: Dict[str, List[float]] = dict(
+            meta.get("world_profile", {}))
+        self.agents: Dict[str, _SimAgent] = {}
+        for i, (aid, stream) in enumerate(
+                sorted(timeline.get("agents", {}).items())):
+            # Exhausted-stream extrapolation: the recording's FINAL regime
+            # continues. A recording cut mid-straggle (the live policy
+            # mitigated and the worker stopped) must keep looking slow —
+            # the median of the last 16 would erase a short recorded
+            # straggle and a stricter replay policy would run out of
+            # signal it is entitled to.
+            tail = _median([s[0] for s in stream[-8:]]) or 0.05
+            self.agents[aid] = _SimAgent(
+                agent_id=aid, stream=[list(s) for s in stream],
+                tail_dt=tail, next_hb_t=i * p.register_stagger_s,
+            )
+        self.faults: List[Dict[str, Any]] = [
+            dict(f) for f in timeline.get("faults", [])
+        ]
+        self._fault_i = 0
+        self._next_tick = 0.0
+        self._active_stragglers: List[Dict[str, Any]] = []
+        self.job_ckpt_step = 0
+        self._gen_max_step: Dict[int, int] = {}
+        self._gen_seen: set = set()
+        self._as_last_fed: Tuple[int, int] = (-1, -1)
+        # ---- evidence the invariants judge
+        self.evictions: List[Dict[str, Any]] = []
+        self.switches: List[Dict[str, Any]] = []
+        self.drains: List[Dict[str, Any]] = []
+        self.kills: List[Dict[str, Any]] = []
+        self.preempts: List[Dict[str, Any]] = []
+        self.scale_decisions: List[Dict[str, Any]] = []
+        self.events_simulated = 0
+        meta_dur = float(meta.get("duration_s", 0.0) or 0.0)
+        longest = max(
+            (sum(s[0] for s in a.stream) for a in self.agents.values()),
+            default=0.0,
+        )
+        self.horizon = meta_dur if meta_dur > 0 else (longest * 2.0 + 60.0)
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> Dict[str, Any]:
+        guard = 0
+        while self.now <= self.horizon:
+            guard += 1
+            if guard > 2_000_000:
+                raise RuntimeError("simulator event-count guard tripped")
+            nxt = self._next_event()
+            if nxt is None or nxt[0] > self.horizon:
+                break
+            t, _prio, _key, kind, payload = nxt
+            self.now = t
+            self.events_simulated += 1
+            if kind == "fault":
+                self._dispatch_fault(payload)
+            elif kind == "step":
+                self._complete_step(payload)
+            elif kind == "hb":
+                self._heartbeat(payload)
+            elif kind == "tick":
+                self._tick()
+            if self.rdv.phase == JobPhase.DONE:
+                break
+        return self._result()
+
+    def _next_event(self):
+        best = None
+        if self._fault_i < len(self.faults):
+            f = self.faults[self._fault_i]
+            best = self._consider(best, float(f["t"]), "fault", "", f)
+        for aid in sorted(self.agents):
+            a = self.agents[aid]
+            if a.alive:
+                best = self._consider(best, a.next_hb_t, "hb", aid, a)
+            if a.step_done_t is not None:
+                best = self._consider(best, a.step_done_t, "step", aid, a)
+        best = self._consider(best, self._next_tick, "tick", "", None)
+        return best
+
+    def _consider(self, best, t: float, kind: str, key: str, payload):
+        cand = (t, self._PRIO[kind], key, kind, payload)
+        return cand if best is None or cand[:3] < best[:3] else best
+
+    # ------------------------------------------------------------- faults
+    def _dispatch_fault(self, f: Dict[str, Any]) -> None:
+        self._fault_i += 1
+        kind = f["kind"]
+        aid = str(f.get("agent", ""))
+        a = self.agents.get(aid)
+        if kind == "straggler":
+            if f.get("inject", True):
+                self._active_stragglers.append(f)
+        elif kind == "preempt_notice":
+            if a is not None:
+                a.preempting = True
+            self.preempts.append({"t": self.now, "agent": aid})
+        elif kind == "kill":
+            worker_alive = a is not None and a.state == "running"
+            if a is not None:
+                if a.state == "running":
+                    a.state = "idle"
+                a.step_done_t = None
+                a.quiesce_pending = False
+                if dict(f.get("params", {})).get("vm_dies"):
+                    a.alive = False
+            self.kills.append({
+                "t": self.now, "agent": aid,
+                "worker_alive": worker_alive,
+                "step": a.step if a is not None else 0,
+            })
+        elif kind == "agent_down":
+            if a is not None:
+                a.alive = False
+                if a.state == "running":
+                    a.state = "idle"
+                a.step_done_t = None
+
+    def _dt_for(self, a: _SimAgent) -> Tuple[float, float, int]:
+        profile = self.world_profile.get(str(len(self.rdv.members)))
+        if profile is not None:
+            dt, rate = float(profile[0]), float(profile[1])
+            world = len(self.rdv.members)
+        elif a.idx < len(a.stream):
+            dt, rate, world = a.stream[a.idx]
+        else:
+            dt, rate, world = a.tail_dt, 0.0, 1
+        for f in self._active_stragglers:
+            if f.get("agent") != a.agent_id:
+                continue
+            if self.now < float(f["t"]) or self.now >= float(
+                    f.get("end_t", float("inf"))):
+                continue
+            params = dict(f.get("params", {}))
+            if "factor" in params:
+                dt *= float(params["factor"])
+            if "sleep_s" in params:
+                dt += float(params["sleep_s"])
+        return float(dt), float(rate), int(world)
+
+    # -------------------------------------------------------------- steps
+    def _complete_step(self, a: _SimAgent) -> None:
+        dt, rate, world = self._dt_for(a)
+        a.step += 1
+        a.idx += 1
+        a.last_sample = [dt, rate, world]
+        if a.agent_id in self.rdv.members:
+            g = self.rdv.generation
+            self._gen_max_step[g] = max(self._gen_max_step.get(g, 0),
+                                        a.step)
+            if self.ckpt_interval > 0 and a.step % self.ckpt_interval == 0:
+                self.job_ckpt_step = max(self.job_ckpt_step, a.step)
+        if a.quiesce_pending:
+            a.quiesce_pending = False
+            a.state = "quiesced"
+            a.step_done_t = None
+            self.job_ckpt_step = max(self.job_ckpt_step, a.step)
+            self.drains.append({"t": self.now, "agent": a.agent_id,
+                                "step": a.step})
+            return
+        if self.total_steps and a.step >= self.total_steps:
+            a.state = "done"
+            a.step_done_t = None
+            return
+        ndt, _, _ = self._dt_for(a)
+        a.step_done_t = self.now + ndt
+
+    # ---------------------------------------------------------- heartbeats
+    def _heartbeat(self, a: _SimAgent) -> None:
+        a.next_hb_t = self.now + self.policy.heartbeat_interval
+        self._master_intake(a)
+        if not a.registered:
+            d = self.rdv.register(a.agent_id, host=a.agent_id, slots=1,
+                                  preempting=a.preempting)
+            a.registered = True
+        else:
+            d = self.rdv.heartbeat(
+                a.agent_id, a.generation, a.state, step=a.step,
+                preempting=a.preempting,
+            )
+        self._apply_directive(a, d)
+
+    def _master_intake(self, a: _SimAgent) -> None:
+        """What the live master does with a heartbeat's metrics payload:
+        feed the straggler detector (members only, step-deduped inside)
+        and the autoscaler (one aggregate per advanced job step)."""
+        if a.last_sample is None or a.agent_id not in self.rdv.members:
+            return
+        dt, rate, world = a.last_sample
+        if a.step > a.last_observed_step:
+            a.last_observed_step = a.step
+            self.detector.observe(a.agent_id, dt, a.step, self.now,
+                                  generation=self.rdv.generation)
+        if self.autoscaler is not None and rate > 0 \
+                and a.agent_id == (self.rdv.members or [""])[0]:
+            gen = self.rdv.generation
+            if (gen, a.step) > self._as_last_fed:
+                self._as_last_fed = (gen, a.step)
+                self.autoscaler.observe(pb.StepMetrics(
+                    step=a.step, step_time_s=dt, samples_per_sec=rate,
+                    world_size=max(world, 1),
+                ))
+
+    def _apply_directive(self, a: _SimAgent, d) -> None:
+        if d.kind == "run":
+            if (d.generation, d.coordinator) == (a.generation,
+                                                 a.coordinator):
+                return
+            a.generation = d.generation
+            a.coordinator = d.coordinator
+            a.state = "running"
+            a.quiesce_pending = False
+            if d.generation not in self._gen_seen:
+                self._gen_seen.add(d.generation)
+                prev_max = max(
+                    (s for g, s in self._gen_max_step.items()
+                     if g < d.generation), default=0)
+                self.switches.append({
+                    "t": self.now, "generation": d.generation,
+                    "members": list(d.hosts),
+                    "resumed_from_step": self.job_ckpt_step,
+                    "steps_lost": max(0, prev_max - self.job_ckpt_step),
+                })
+            a.step = self.job_ckpt_step
+            ndt, _, _ = self._dt_for(a)
+            a.step_done_t = self.now + self.policy.restart_delay_s + ndt
+        elif d.kind == "quiesce":
+            if a.state == "running":
+                a.quiesce_pending = True
+        elif d.kind == "kill":
+            if a.state == "running":
+                a.state = "idle"
+            a.step_done_t = None
+            a.quiesce_pending = False
+        elif d.kind == "shutdown":
+            a.state = "done"
+            a.step_done_t = None
+
+    # --------------------------------------------------------------- tick
+    def _tick(self) -> None:
+        self._next_tick = self.now + self.policy.tick_interval
+        self.rdv.tick(self.now)
+        cand = actuate_eviction(self.detector, self.rdv, self.now)
+        if cand is not None:
+            self.evictions.append({
+                "t": self.now, "agent": cand,
+                "holddown_s": self.detector.config.holddown_s,
+            })
+        if self.autoscaler is not None \
+                and self.rdv.phase == JobPhase.STABLE and self.rdv.members:
+            world = len(self.rdv.members)
+            target = self.autoscaler.decide(world)
+            if target != world and target != self.rdv.desired_workers:
+                self.scale_decisions.append({
+                    "t": self.now, "from_workers": world,
+                    "to_workers": target,
+                })
+                self.rdv.set_desired_workers(target)
+
+    # ------------------------------------------------------------- result
+    def _result(self) -> Dict[str, Any]:
+        def r6(x: float) -> float:
+            return round(float(x), 6)
+
+        def stamp(entries: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+            out = []
+            for e in entries:
+                e = dict(e)
+                for k, v in e.items():
+                    if isinstance(v, float):
+                        e[k] = r6(v)
+                out.append(e)
+            return out
+
+        pol = asdict(self.policy)
+        det = self.detector.status()
+        hu = det.get("holddown_until")
+        det["holddown_until"] = None if hu is None else r6(float(hu))
+        det["evictions"] = stamp(det["evictions"])
+        return {
+            "name": str(self.timeline.get("name", "")),
+            "source": str(self.timeline.get("source", "")),
+            "policy": pol,
+            "final": {
+                "phase": self.rdv.phase.value,
+                "generation": self.rdv.generation,
+                "members": list(self.rdv.members),
+                "desired_workers": self.rdv.desired_workers,
+                "steps": {aid: a.step
+                          for aid, a in sorted(self.agents.items())},
+                "excluded": sorted(
+                    aid for aid, v in self.rdv.agents.items()
+                    if v.excluded_until > self.now),
+                "max_step": max(
+                    (a.step for a in self.agents.values()), default=0),
+            },
+            "reshapes": stamp(self.rdv.reshape_log),
+            "evictions": stamp(self.evictions),
+            "switches": stamp(self.switches),
+            "drains": stamp(self.drains),
+            "kills": stamp(self.kills),
+            "preempts": stamp(self.preempts),
+            "scale_decisions": stamp(self.scale_decisions),
+            "detector": det,
+            "events_simulated": self.events_simulated,
+            "sim_end_t": r6(self.now),
+        }
+
+
+def simulate(timeline: Mapping[str, Any],
+             policy: Optional[SimPolicy] = None,
+             expect: Optional[Mapping[str, Any]] = None) -> Dict[str, Any]:
+    """Run one simulation and (when ``expect`` is given) attach the
+    invariant verdict — the one-call entry scripts/policy_replay.py and
+    the tier-1 tests use."""
+    result = ControlPlaneSimulator(timeline, policy).run()
+    if expect is not None:
+        from easydl_tpu.sim import invariants
+
+        verdict = invariants.check(result, dict(expect), timeline)
+        result["expect"] = dict(expect)
+        result["invariants"] = verdict
+        result["passed"] = verdict["passed"]
+    return result

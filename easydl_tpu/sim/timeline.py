@@ -1,0 +1,310 @@
+"""Signal timelines for the control-plane simulator: the recorded (or
+synthesized) per-agent step streams + fault markers a simulation replays.
+
+A timeline is a plain JSON-serializable document — the committed fixture
+format — with three parts:
+
+- ``agents``: per agent, the ordered list of ``[step_time_s,
+  samples_per_sec, world_size]`` samples its worker produced. This is the
+  *signal* stream: the simulator's worker model replays these durations one
+  step at a time, so the control plane under test sees exactly the step
+  times a real (or imagined) fleet produced.
+- ``faults``: control-plane inputs at relative timestamps —
+  ``straggler`` (synthetic slowdown windows; ``inject`` false when the
+  slowdown is already baked into recorded durations and the marker only
+  anchors invariant budgets), ``preempt_notice``, ``kill`` (the VM dies:
+  worker SIGKILL + agent silence), ``agent_down``.
+- ``meta``: job facts the worker model needs (``total_steps``,
+  ``ckpt_interval``) plus provenance.
+
+``load_workdir`` turns any kept chaos/job workdir into a timeline: the
+``metrics-<agent>.jsonl`` streams (PR 1) become the signal streams, and the
+workdir's ``chaos-plan.json`` — when present — becomes the fault markers,
+re-anchored from wall clock to the recording's own t axis. That is the
+"incident replay" path: scripts/policy_replay.py feeds the result through
+the REAL Autoscaler/Rendezvous/StragglerDetector in milliseconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("sim", "timeline")
+
+#: fault kinds a timeline may carry (superset-checked at load)
+FAULT_KINDS = ("straggler", "preempt_notice", "kill", "agent_down")
+
+
+def _round(x: float, nd: int = 6) -> float:
+    return round(float(x), nd)
+
+
+def make_timeline(name: str, agents: Mapping[str, List[List[float]]],
+                  faults: Optional[List[Dict[str, Any]]] = None,
+                  meta: Optional[Dict[str, Any]] = None,
+                  source: str = "synthetic") -> Dict[str, Any]:
+    """Assemble + validate a timeline document."""
+    doc = {
+        "name": str(name),
+        "source": str(source),
+        "agents": {
+            str(a): [[_round(s[0]), _round(s[1]), int(s[2])]
+                     for s in stream]
+            for a, stream in sorted(agents.items())
+        },
+        "faults": sorted(
+            (dict(f) for f in (faults or [])),
+            key=lambda f: (float(f["t"]), str(f.get("agent", ""))),
+        ),
+        "meta": dict(meta or {}),
+    }
+    for f in doc["faults"]:
+        if f.get("kind") not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {f.get('kind')!r} "
+                             f"(known: {FAULT_KINDS})")
+        f["t"] = _round(f["t"])
+    return doc
+
+
+def save_fixture(timeline: Mapping[str, Any], path: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        # Compact rows, but one line per top-level key stays greppable:
+        # sort_keys + fixed separators also make re-recording the same
+        # workdir byte-stable.
+        json.dump(timeline, f, sort_keys=True, separators=(",", ":"))
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def load_fixture(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    return make_timeline(
+        doc.get("name", os.path.basename(path)),
+        doc.get("agents", {}), doc.get("faults", []),
+        doc.get("meta", {}), doc.get("source", path),
+    )
+
+
+# ------------------------------------------------------------- recordings
+def load_workdir(workdir: str, name: Optional[str] = None) -> Dict[str, Any]:
+    """Build a timeline from a kept job/chaos workdir.
+
+    Signal streams come from ``metrics-<agent>.jsonl``; records are sorted
+    by wall time and deduped by (generation, step) — a killed worker's torn
+    tail lines are skipped, matching the chaos invariant readers. Faults
+    come from ``chaos-plan.json`` when the drill kept one AND stamped t0;
+    ``straggler`` events are marked ``inject: false`` (the slowdown is
+    already in the recorded durations — re-applying it would double-count).
+    All timestamps are re-anchored so t=0 is the earliest step record."""
+    streams: Dict[str, List[List[float]]] = {}
+    times: Dict[str, List[float]] = {}
+    t_base: Optional[float] = None
+    for fn in sorted(os.listdir(workdir)):
+        if not (fn.startswith("metrics-") and fn.endswith(".jsonl")):
+            continue
+        agent = fn[len("metrics-"):-len(".jsonl")]
+        recs: List[Dict[str, Any]] = []
+        with open(os.path.join(workdir, fn)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail from a killed worker
+        recs.sort(key=lambda r: float(r.get("t", 0.0)))
+        seen = set()
+        stream: List[List[float]] = []
+        ts: List[float] = []
+        for r in recs:
+            try:
+                key = (int(r.get("generation", 0)), int(r["step"]))
+                dt = float(r["step_time_s"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if key in seen or dt <= 0:
+                continue
+            seen.add(key)
+            stream.append([dt, float(r.get("samples_per_sec", 0.0)),
+                           int(r.get("world_size", 1))])
+            ts.append(float(r.get("t", 0.0)))
+        if stream:
+            streams[agent] = stream
+            times[agent] = ts
+            first = ts[0]
+            t_base = first if t_base is None else min(t_base, first)
+    if not streams:
+        raise ValueError(f"no usable metrics-*.jsonl streams in {workdir}")
+
+    faults = _faults_from_chaos_plan(workdir, t_base or 0.0)
+    meta: Dict[str, Any] = {
+        "recorded_from": os.path.basename(os.path.abspath(workdir)),
+        "total_steps": _total_steps_from_job(workdir, streams),
+        "ckpt_interval": _ckpt_interval_from_job(workdir),
+    }
+    return make_timeline(
+        name or (os.path.basename(os.path.abspath(workdir)) or "recorded"),
+        streams, faults, meta, source=os.path.abspath(workdir),
+    )
+
+
+def _faults_from_chaos_plan(workdir: str, t_base: float
+                            ) -> List[Dict[str, Any]]:
+    path = os.path.join(workdir, "chaos-plan.json")
+    try:
+        with open(path) as f:
+            plan = json.load(f)
+    except (OSError, ValueError):
+        return []
+    t0 = plan.get("t0")
+    if t0 is None:
+        return []
+    out: List[Dict[str, Any]] = []
+    for ev in plan.get("events", []):
+        kind = str(ev.get("kind", ""))
+        target = dict(ev.get("target", {}))
+        params = dict(ev.get("params", {}))
+        rel = float(t0) + float(ev.get("start_s", 0.0)) - t_base
+        if kind == "straggler":
+            out.append({
+                "t": rel, "kind": "straggler",
+                "agent": str(target.get("agent", "")),
+                "end_t": float(t0) + float(ev.get("end_s", 0.0)) - t_base,
+                "params": params,
+                # recorded: the sleep already shows in the durations
+                "inject": False,
+            })
+        elif kind == "preempt_notice":
+            out.append({"t": rel, "kind": "preempt_notice",
+                        "agent": str(target.get("agent", ""))})
+        elif kind == "worker_kill":
+            out.append({"t": rel, "kind": "kill",
+                        "agent": str(target.get("agent", "")),
+                        "params": params})
+        elif kind == "agent_stop":
+            out.append({"t": rel, "kind": "agent_down",
+                        "agent": str(target.get("agent", ""))})
+        # other kinds (rpc_*, heartbeat_suppress, ps_*, master_crash) have
+        # no control-plane-simulator equivalent yet; they are dropped.
+    return out
+
+
+def _total_steps_from_job(workdir: str,
+                          streams: Mapping[str, List[List[float]]]) -> int:
+    try:
+        with open(os.path.join(workdir, "job.json")) as f:
+            return int(json.load(f).get("total_steps", 0))
+    except (OSError, ValueError):
+        return max(len(s) for s in streams.values())
+
+
+def _ckpt_interval_from_job(workdir: str) -> int:
+    try:
+        with open(os.path.join(workdir, "job.json")) as f:
+            return int(json.load(f).get("ckpt_interval", 100))
+    except (OSError, ValueError):
+        return 100
+
+
+# ------------------------------------------------------------- synthetic
+def _lcg_noise(seed: int):
+    """Tiny deterministic noise source (no global RNG, no wall clock):
+    yields floats in [0, 1)."""
+    state = (seed * 6364136223846793005 + 1442695040888963407) & (2**64 - 1)
+    while True:
+        state = (state * 6364136223846793005 + 1442695040888963407) \
+            & (2**64 - 1)
+        yield (state >> 11) / float(2**53)
+
+
+def synthetic_straggler(name: str = "synthetic_straggler",
+                        n_agents: int = 3, base_dt: float = 0.05,
+                        noise: float = 0.1, straggle_factor: float = 10.0,
+                        straggle_at: float = 12.0,
+                        straggle_agent: str = "a0",
+                        total_steps: int = 2000, duration_s: float = 90.0,
+                        seed: int = 7) -> Dict[str, Any]:
+    """N agents stepping at ``base_dt`` (±noise); one turns ``factor``×
+    slower at ``straggle_at`` and stays slow. The straggler fault is
+    ``inject: true`` — the simulator applies the slowdown, so the SAME
+    base stream serves the tuned policy and the mis-tuned negative
+    control."""
+    rng = _lcg_noise(seed)
+    agents: Dict[str, List[List[float]]] = {}
+    steps = int(duration_s / base_dt) + 8
+    for i in range(n_agents):
+        stream = []
+        for _ in range(steps):
+            dt = base_dt * (1.0 + noise * (2.0 * next(rng) - 1.0))
+            stream.append([dt, 32.0 / dt, 1])
+        agents[f"a{i}"] = stream
+    faults = [{
+        "t": straggle_at, "kind": "straggler", "agent": straggle_agent,
+        "end_t": duration_s, "inject": True,
+        "params": {"factor": straggle_factor},
+    }]
+    return make_timeline(
+        name, agents, faults,
+        meta={"total_steps": total_steps, "ckpt_interval": 200,
+              "duration_s": duration_s},
+    )
+
+
+def synthetic_autoscale(name: str = "synthetic_autoscale",
+                        n_agents: int = 4, total_steps: int = 1500,
+                        duration_s: float = 150.0) -> Dict[str, Any]:
+    """Scale-up ramp for the real Autoscaler: per-world (dt, rate) profile
+    with efficiency 1.0 → 0.94 → 0.78, so a correctly-damped policy climbs
+    1→2→4 workers and then HOLDS (the 4→8 step would land under the
+    efficiency floor)."""
+    agents = {f"a{i}": [[0.05, 640.0, 1]] * 4 for i in range(n_agents)}
+    return make_timeline(
+        name, agents, [],
+        meta={
+            "total_steps": total_steps, "ckpt_interval": 200,
+            "duration_s": duration_s,
+            # world size → [step_time_s, global samples_per_sec]
+            "world_profile": {
+                "1": [0.05, 640.0],
+                "2": [0.0533, 1200.0],   # eff 0.9375 ≥ floor: keep going
+                "3": [0.052, 1700.0],
+                "4": [0.064, 2000.0],    # eff 0.78 < floor: hold here
+            },
+        },
+    )
+
+
+def synthetic_preempt(name: str = "synthetic_preempt",
+                      n_agents: int = 2, base_dt: float = 0.05,
+                      notice_at: float = 10.0, grace_s: float = 8.0,
+                      target_agent: str = "a0", total_steps: int = 1500,
+                      duration_s: float = 120.0,
+                      seed: int = 11) -> Dict[str, Any]:
+    """A preemption notice to one member at ``notice_at``, the VM SIGKILL
+    ``grace_s`` later — the race the proactive-drain invariant judges."""
+    rng = _lcg_noise(seed)
+    agents: Dict[str, List[List[float]]] = {}
+    steps = int(duration_s / base_dt) + 8
+    for i in range(n_agents):
+        stream = []
+        for _ in range(steps):
+            dt = base_dt * (1.0 + 0.05 * (2.0 * next(rng) - 1.0))
+            stream.append([dt, 32.0 / dt, 1])
+        agents[f"a{i}"] = stream
+    faults = [
+        {"t": notice_at, "kind": "preempt_notice", "agent": target_agent},
+        {"t": notice_at + grace_s, "kind": "kill", "agent": target_agent,
+         "params": {"vm_dies": True}},
+    ]
+    return make_timeline(
+        name, agents, faults,
+        meta={"total_steps": total_steps, "ckpt_interval": 200,
+              "duration_s": duration_s},
+    )

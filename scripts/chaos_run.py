@@ -86,7 +86,8 @@ def main() -> None:
     if args.list:
         for name, builder in SCENARIOS.items():
             sc = builder()
-            print(f"{name:16s} seed={sc.chaos.seed:<4d} {sc.chaos.notes}")
+            print(f"{name:24s} seed={sc.chaos.seed:<4d} "
+                  f"tier={sc.tier:7s} {sc.chaos.notes}")
         return
 
     names = args.scenario or list(SCENARIOS)

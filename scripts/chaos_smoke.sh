@@ -82,3 +82,26 @@ print(f"trace OK: {len(events)} events, {len(spans)} spans")
 PY
     rm -rf "$wd"   # kept only for the export; drop after the check
 done
+
+# Offline policy replay gate: every committed simulator fixture (recorded
+# chaos timelines) plus the synthetic catalog (incl. the mis-tuned
+# negative controls) must pass its policy invariants, and each fixture
+# replay must be byte-identical across back-to-back runs — the simulator's
+# determinism contract, checked where the drills that feed it live.
+SIMDIR=$(mktemp -d)
+trap 'rm -f "$LOG"; rm -rf "$SIMDIR"' EXIT
+
+env JAX_PLATFORMS=cpu python scripts/policy_replay.py --out-dir "$SIMDIR"
+
+for fixture in tests/fixtures/sim/*.json; do
+    name=$(basename "$fixture" .json)
+    env JAX_PLATFORMS=cpu python scripts/policy_replay.py \
+        --fixture "$fixture" --out "$SIMDIR/replay-$name-1.json"
+    env JAX_PLATFORMS=cpu python scripts/policy_replay.py \
+        --fixture "$fixture" --out "$SIMDIR/replay-$name-2.json"
+    cmp "$SIMDIR/replay-$name-1.json" "$SIMDIR/replay-$name-2.json" || {
+        echo "chaos_smoke: NONDETERMINISTIC replay for $fixture" >&2
+        exit 1
+    }
+    echo "policy replay OK: $name (deterministic, invariants hold)"
+done

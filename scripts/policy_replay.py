@@ -1,0 +1,225 @@
+"""Replay control-plane signal timelines through the real policy stack and
+write ``SIM_r*.json`` verdicts — the offline half of the chaos subsystem.
+
+Turns any kept chaos/job workdir into a simulator regression fixture, and
+replays fixtures (or built-in synthetic scenarios) through the REAL
+Rendezvous + StragglerDetector + Autoscaler on a virtual clock: a
+multi-minute incident re-judges in milliseconds, deterministically
+(byte-identical verdict for the same inputs — chaos_smoke.sh runs every
+committed fixture twice and compares bytes). Exit code is non-zero when
+any replay's policy invariants fail: a gate, not a report.
+
+Usage::
+
+    # every built-in synthetic scenario (+ negative controls)
+    python scripts/policy_replay.py
+
+    # one scenario
+    python scripts/policy_replay.py --scenario straggler_noise
+
+    # replay a kept chaos workdir (e.g. chaos_run.py --keep-workdir)
+    python scripts/policy_replay.py --workdir /tmp/chaos-straggler-xyz
+
+    # record a workdir into a committed fixture, then replay fixtures
+    python scripts/policy_replay.py --workdir /tmp/chaos-... \
+        --save-fixture tests/fixtures/sim/straggler_mitigation.json
+    python scripts/policy_replay.py \
+        --fixture tests/fixtures/sim/straggler_mitigation.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from easydl_tpu.brain.policy import AutoscalerConfig  # noqa: E402
+from easydl_tpu.brain.straggler import StragglerConfig  # noqa: E402
+from easydl_tpu.sim import (  # noqa: E402
+    SimPolicy, load_fixture, load_workdir, save_fixture, simulate,
+    synthetic_autoscale, synthetic_preempt, synthetic_straggler,
+)
+
+#: the default drill policy for replays: matches the live chaos drills'
+#: member+standby shape (desired 1, immediate drains, one reporting
+#: member — so skew is judged against the member's own baseline).
+def _drill_policy() -> SimPolicy:
+    return SimPolicy(
+        desired_workers=1, min_workers=1,
+        straggler=StragglerConfig(ratio=8.0, consecutive=6, min_samples=6,
+                                  holddown_s=10.0, allow_self_skew=True),
+    )
+
+
+def _scenarios() -> Dict[str, Tuple[Any, SimPolicy, Dict[str, Any]]]:
+    """name → (timeline, policy, expect) for the built-in synthetic
+    catalog. ``*_negative`` entries are negative controls: a deliberately
+    mis-tuned policy whose verdict must FAIL (this script inverts them, so
+    the run as a whole passes only when the invariants caught the bad
+    tuning)."""
+    tuned = StragglerConfig(ratio=4.0, consecutive=3, holddown_s=20.0)
+    mis_tuned = StragglerConfig(ratio=1.02, consecutive=1, min_samples=2,
+                                holddown_s=0.5, recent_window=1)
+    return {
+        "straggler_noise": (
+            synthetic_straggler(n_agents=3, total_steps=1200,
+                                duration_s=90.0),
+            SimPolicy(desired_workers=2, straggler=tuned),
+            {"straggler_evicted": "a0", "evict_budget_s": 20.0,
+             "holddown_quiet": True, "max_reshapes": 2,
+             "max_evictions": 1, "final_workers": 2},
+        ),
+        "straggler_noise_negative": (
+            synthetic_straggler(n_agents=3, total_steps=1200,
+                                duration_s=90.0, noise=0.35),
+            SimPolicy(desired_workers=2, straggler=mis_tuned),
+            {"max_reshapes": 2, "holddown_quiet": True,
+             "max_evictions": 1},
+        ),
+        "preempt_race": (
+            synthetic_preempt(grace_s=8.0),
+            _drill_policy(),
+            {"proactive_drain": True, "max_steps_lost": 0,
+             "target_step": 1500, "final_workers": 1, "max_reshapes": 1},
+        ),
+        "preempt_race_negative": (
+            synthetic_preempt(grace_s=0.05),
+            _drill_policy(),
+            {"proactive_drain": True},
+        ),
+        "autoscale_ramp": (
+            synthetic_autoscale(),
+            SimPolicy(desired_workers=1,
+                      autoscaler=AutoscalerConfig(
+                          max_workers=8, cooldown_s=3.0, min_samples=5)),
+            {"min_scale_ups": 2, "final_desired_workers": 4,
+             "final_workers": 4, "max_reshapes": 3, "target_step": 1500},
+        ),
+    }
+
+
+#: expectations used when replaying a RECORDED timeline, keyed by the
+#: chaos scenario that produced it (detected from the fault markers).
+def _recorded_expect(timeline: Dict[str, Any]) -> Dict[str, Any]:
+    kinds = {f.get("kind") for f in timeline.get("faults", [])}
+    agents_of = lambda k: [f.get("agent") for f in timeline["faults"]
+                           if f.get("kind") == k]
+    expect: Dict[str, Any] = {"max_reshapes": 2}
+    if "straggler" in kinds:
+        expect.update({
+            "straggler_evicted": agents_of("straggler")[0],
+            "evict_budget_s": 30.0,
+            "holddown_quiet": True,
+            "max_evictions": 1,
+        })
+    if "preempt_notice" in kinds and "kill" in kinds:
+        expect.update({"proactive_drain": True})
+    return expect
+
+
+def next_round(out_dir: str) -> int:
+    rounds = [0]
+    for path in glob.glob(os.path.join(out_dir, "SIM_r*.json")):
+        m = re.match(r"SIM_r(\d+)", os.path.basename(path))
+        if m:
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def _verdict_bytes(doc: Dict[str, Any]) -> bytes:
+    return (json.dumps(doc, indent=2, sort_keys=True) + "\n").encode()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="offline control-plane policy replay")
+    ap.add_argument("--scenario", action="append", default=None,
+                    help="built-in synthetic scenario (repeatable; "
+                         "default: all)")
+    ap.add_argument("--workdir", default=None,
+                    help="replay a recorded job/chaos workdir")
+    ap.add_argument("--fixture", action="append", default=None,
+                    help="replay a committed fixture JSON (repeatable)")
+    ap.add_argument("--save-fixture", default=None,
+                    help="with --workdir: write the recorded timeline "
+                         "here (and still replay it)")
+    ap.add_argument("--name", default=None,
+                    help="with --workdir: stable timeline name for the "
+                         "fixture (default: the workdir basename)")
+    ap.add_argument("--out-dir", default=REPO,
+                    help="where SIM_r*.json verdicts land")
+    ap.add_argument("--out", default=None,
+                    help="exact verdict path (single replay only)")
+    ap.add_argument("--round", type=int, default=None)
+    ap.add_argument("--list", action="store_true",
+                    help="list built-in scenarios and exit")
+    args = ap.parse_args()
+
+    catalog = _scenarios()
+    if args.list:
+        for name, (tl, _pol, expect) in catalog.items():
+            neg = " [negative control]" if name.endswith("_negative") else ""
+            print(f"{name:28s} agents={len(tl['agents'])} "
+                  f"checks={sorted(expect)}{neg}")
+        return
+
+    jobs = []  # (name, timeline, policy, expect, invert)
+    if args.workdir:
+        tl = load_workdir(args.workdir, name=args.name)
+        if args.save_fixture:
+            save_fixture(tl, args.save_fixture)
+            print(f"fixture saved -> {args.save_fixture}")
+        jobs.append((tl["name"], tl, _drill_policy(),
+                     _recorded_expect(tl), False))
+    for path in args.fixture or []:
+        tl = load_fixture(path)
+        jobs.append((tl["name"], tl, _drill_policy(),
+                     _recorded_expect(tl), False))
+    if not args.workdir and not args.fixture:
+        names = args.scenario or list(catalog)
+        unknown = [n for n in names if n not in catalog]
+        if unknown:
+            raise SystemExit(f"unknown scenario(s) {unknown}; "
+                             f"known: {sorted(catalog)}")
+        for n in names:
+            tl, pol, expect = catalog[n]
+            jobs.append((n, tl, pol, expect, n.endswith("_negative")))
+
+    if args.out and len(jobs) != 1:
+        raise SystemExit("--out requires exactly one replay")
+    os.makedirs(args.out_dir, exist_ok=True)
+    rnd = args.round if args.round is not None else next_round(args.out_dir)
+    failed = []
+    for name, tl, pol, expect, invert in jobs:
+        result = simulate(tl, pol, expect)
+        ok = (not result["passed"]) if invert else result["passed"]
+        if invert:
+            result["negative_control"] = True
+            result["caught_mis_tuned_policy"] = not result["passed"]
+        out = args.out or os.path.join(
+            args.out_dir, f"SIM_r{rnd:02d}_{name}.json")
+        with open(out, "wb") as f:
+            f.write(_verdict_bytes(result))
+        status = "PASS" if ok else "FAIL"
+        print(f"{status} {name}: {result['events_simulated']} events, "
+              f"{len(result['reshapes'])} reshapes, "
+              f"sim_end={result['sim_end_t']}s -> {out}", flush=True)
+        for check, doc in result.get("invariants", {}) \
+                                .get("checks", {}).items():
+            print(f"  [{'ok' if doc['ok'] else 'VIOLATED'}] {check}")
+        if not ok:
+            failed.append(name)
+    if failed:
+        raise SystemExit(f"policy replays FAILED: {failed}")
+
+
+if __name__ == "__main__":
+    main()

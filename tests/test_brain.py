@@ -9,6 +9,8 @@ this framework's own (SURVEY.md §7 hard part 5).
 
 import time
 
+import pytest
+
 from easydl_tpu.api import ResourcePlan, RolePlan
 from easydl_tpu.brain.convert import plan_from_proto, plan_to_proto
 from easydl_tpu.brain.policy import Autoscaler, AutoscalerConfig, startup_plan
@@ -573,3 +575,68 @@ def test_native_python_decide_parity_randomized():
                 )
                 cur_a, cur_b = ta, tb
         assert a.to_state() == b.to_state(), f"trial {trial}: durable drift"
+
+
+# --------------------------------------------------------------------------
+# restore_state hardening (ISSUE 8 satellite): a Brain pod crashed
+# mid-journal-write leaves a torn/partial/garbage doc — the replacement
+# must degrade to fresh state with a warning, never die on boot.
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("doc", [
+    "not a dict at all",
+    ["a", "list"],
+    {"per_size": "garbage"},
+    {"per_size": {"not_an_int": [1.0]}},
+    {"per_size": {"2": "nan"}},
+    {"per_size": {"2": [1.0, "bogus"]}},
+    {"bad_sizes": ["x", None]},
+    {"pending_check": 123},
+    {"pending_check": ["a", "b"]},
+    {"cooldown_elapsed_s": "soon"},
+    {"best_per_chip": "fast"},
+])
+def test_restore_state_degrades_on_garbage_doc(doc):
+    a = Autoscaler(AutoscalerConfig(), clock=lambda: 100.0,
+                   force_python=True)
+    a.restore_state(doc)  # must not raise
+    # fresh-state semantics: no windows, no memory, no cooldown in force
+    st = a.to_state()
+    assert st["per_size"] == {}
+    assert st["bad_sizes"] == []
+    assert st["pending_check"] is None
+    assert st["cooldown_elapsed_s"] is None
+    # and the autoscaler still decides (holds steady with no samples)
+    assert a.decide(4) == 4
+
+
+def test_restore_state_filters_nonfinite_samples_but_keeps_the_rest():
+    a = Autoscaler(AutoscalerConfig(), clock=lambda: 100.0,
+                   force_python=True)
+    a.restore_state({
+        "per_size": {"2": [1.0, float("nan"), float("inf"), -3.0, 2.0]},
+        "bad_sizes": [8],
+        "best_per_chip": float("nan"),
+        "cooldown_elapsed_s": 5.0,
+    })
+    st = a.to_state()
+    assert st["per_size"] == {"2": [1.0, 2.0]}
+    assert st["bad_sizes"] == [8]
+    assert st["best_per_chip"] == 0.0  # NaN scrubbed
+    assert st["cooldown_elapsed_s"] == 5.0
+
+
+def test_restore_state_round_trip_still_exact_for_good_docs():
+    clock = {"t": 0.0}
+    a = Autoscaler(AutoscalerConfig(min_samples=3), clock=lambda: clock["t"],
+                   force_python=True)
+    for step in range(6):
+        a.observe(pb.StepMetrics(step=step, samples_per_sec=100.0,
+                                 world_size=2))
+    a.decide(2)
+    snap = a.to_state()
+    b = Autoscaler(AutoscalerConfig(min_samples=3), clock=lambda: clock["t"],
+                   force_python=True)
+    b.restore_state(snap)
+    assert b.to_state() == snap

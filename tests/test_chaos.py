@@ -497,3 +497,161 @@ def test_scenario_catalog_compiles_deterministically():
             assert sc.expect.get("ps_zero_loss")
         else:
             assert sc.expect.get("target_step") is not None
+
+
+# ---------------------------------------------- ISSUE 8: new drill invariants
+
+
+def test_maybe_straggle_targets_agent(tmp_path, monkeypatch):
+    """Agent-targeted straggler windows: after a mitigation reshape the
+    successor worker is rank 0 again, so the drill targets the HOST."""
+    import time
+
+    spec = ChaosSpec(name="strag-agent", seed=3, faults=(
+        FaultSpec(kind="straggler", at_s=0.0, duration_s=3600.0,
+                  target={"agent": "a0"}, params={"sleep_s": 0.1}),
+    ))
+    monkeypatch.setenv(injectors.ENV_VAR,
+                       _plan_file(tmp_path, compile_schedule(spec)))
+    t0 = time.perf_counter()
+    injectors.maybe_straggle(rank=0, agent="a1")  # wrong host: no sleep
+    assert time.perf_counter() - t0 < 0.05
+    t0 = time.perf_counter()
+    injectors.maybe_straggle(rank=0, agent="a0")
+    assert time.perf_counter() - t0 >= 0.1
+
+
+def _straggler_run(workdir, *, evict_t=1500.5, holddown=10.0,
+                   extra_reshape_t=None, members=("a1",)):
+    events = [
+        {"t": 1000.0, "kind": "phase", "phase": "stable", "generation": 1},
+        {"t": evict_t, "kind": "straggler_evicted", "agent": "a0",
+         "holddown_s": holddown, "generation": 1},
+        {"t": evict_t + 0.1, "kind": "reshape", "reason": "straggler",
+         "planned": True, "from_generation": 1},
+        {"t": evict_t + 0.4, "kind": "phase", "phase": "stable",
+         "generation": 2},
+    ]
+    if extra_reshape_t is not None:
+        events.append({"t": extra_reshape_t, "kind": "reshape",
+                       "reason": "plan-change", "planned": True,
+                       "from_generation": 2})
+    _populate_run(str(workdir), events=events)
+    with open(os.path.join(str(workdir), "chaos-plan.json"), "w") as f:
+        json.dump({"t0": 1499.0, "events": [
+            {"kind": "straggler", "start_s": 0.5, "end_s": 60.0,
+             "target": {"agent": "a0"}, "params": {"sleep_s": 0.25}},
+        ]}, f)
+    return {"members": list(members)}
+
+
+def test_invariants_straggler_mitigated_and_holddown_quiet(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    status = _straggler_run(tmp_path)
+    verdict = invariants.check_scenario(
+        str(tmp_path),
+        {"straggler_evicted": "a0", "evict_budget_s": 5.0,
+         "holddown_quiet": True},
+        status=status)
+    assert verdict["passed"], verdict
+    assert verdict["checks"]["straggler_mitigated"]["latency_s"] == 1.0
+
+
+def test_invariants_straggler_missing_eviction_fails_not_vacuous(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    _populate_run(str(tmp_path))  # no straggler_evicted event at all
+    verdict = invariants.check_scenario(
+        str(tmp_path),
+        {"straggler_evicted": "a0", "holddown_quiet": True},
+        status={"members": ["a1"]})
+    assert not verdict["checks"]["straggler_mitigated"]["ok"]
+    assert not verdict["checks"]["holddown_quiet"]["ok"]
+
+
+def test_invariants_straggler_still_member_fails(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    status = _straggler_run(tmp_path, members=("a0", "a1"))
+    verdict = invariants.check_scenario(
+        str(tmp_path), {"straggler_evicted": "a0"}, status=status)
+    assert not verdict["checks"]["straggler_mitigated"]["ok"]
+
+
+def test_invariants_holddown_flap_detected(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    # a second reshape 3s into the 10s hold-down: the flapping this
+    # invariant exists to catch
+    status = _straggler_run(tmp_path, extra_reshape_t=1503.5)
+    verdict = invariants.check_scenario(
+        str(tmp_path), {"straggler_evicted": "a0", "holddown_quiet": True},
+        status=status)
+    assert not verdict["checks"]["holddown_quiet"]["ok"]
+    assert verdict["checks"]["holddown_quiet"]["violations"]
+
+
+def _preempt_run(workdir, *, quiesce_exit_t, kill_t, worker_alive):
+    _populate_run(str(workdir))
+    _write_jsonl(os.path.join(str(workdir), "timeline-a0.jsonl"), [
+        {"t": quiesce_exit_t - 0.2, "phase": "quiesce_ckpt_begin", "gen": 1},
+        {"t": quiesce_exit_t, "phase": "quiesce_exit", "gen": 1},
+    ])
+    return [{"t": kill_t, "agent": "a0", "worker_alive": worker_alive,
+             "tolerate_dead": True}]
+
+
+def test_invariants_proactive_drain_win_and_loss(tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    kills = _preempt_run(tmp_path, quiesce_exit_t=2000.0, kill_t=2002.0,
+                         worker_alive=False)
+    verdict = invariants.check_scenario(
+        str(tmp_path), {"proactive_drain": "a0"},
+        status={"members": ["a1"]}, kills=kills)
+    race = verdict["checks"]["proactive_drain_before_kill"]
+    assert race["ok"] and race["races"][0]["margin_s"] == 2.0
+
+    # reactive: the kill found the worker alive (drain lost) — must fail
+    kills = _preempt_run(tmp_path, quiesce_exit_t=2005.0, kill_t=2002.0,
+                         worker_alive=True)
+    verdict = invariants.check_scenario(
+        str(tmp_path), {"proactive_drain": "a0"},
+        status={"members": ["a1"]}, kills=kills)
+    assert not verdict["checks"]["proactive_drain_before_kill"]["ok"]
+
+
+def test_invariants_proactive_drain_without_kill_mark_is_vacuous_fail(
+        tmp_path):
+    from easydl_tpu.chaos import invariants
+
+    _populate_run(str(tmp_path))
+    verdict = invariants.check_scenario(
+        str(tmp_path), {"proactive_drain": "a0"},
+        status={"members": ["a1"]}, kills=[])
+    assert not verdict["checks"]["proactive_drain_before_kill"]["ok"]
+
+
+def test_chaos_run_list_prints_catalog_with_tiers():
+    """ISSUE 8 satellite: the catalog is discoverable from the CLI —
+    name, seed, tier, one-line description — without reading harness.py."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "chaos_run.py"), "--list"],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr
+    from easydl_tpu.chaos.harness import SCENARIOS
+
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == len(SCENARIOS)
+    for name, builder in SCENARIOS.items():
+        sc = builder()
+        line = next(l for l in lines if l.startswith(name))
+        assert f"seed={sc.chaos.seed}" in line
+        assert f"tier={sc.tier}" in line
+        assert sc.chaos.notes[:30] in line

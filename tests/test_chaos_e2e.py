@@ -175,3 +175,49 @@ def test_chaos_ps_zombie_writer_scenario(tmp_path):
     assert checks["ps_zombie_fenced"]["ok"]
     z = verdict["zero_loss"]["zombie"]
     assert z["probe_rejected_stale_epoch"] and z["excess_wal_bytes"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_straggler_mitigation_scenario(tmp_path):
+    """ISSUE 8 acceptance: the member's worker turns ~100x slower; the
+    master's skew detector must evict it via a planned reshape that
+    excludes the host (within the declared budget of the straggler
+    window's start), the standby takes over, and ZERO further reshapes
+    happen inside the hold-down window. The injector count is recovered
+    from the worker's trace flight recorder — anti-vacuous."""
+    verdict = _run("straggler_mitigation", tmp_path)
+    assert verdict["faults_injected"].get("straggler", 0) >= 1
+    checks = verdict["invariants"]["checks"]
+    assert checks["straggler_mitigated"]["ok"]
+    assert checks["holddown_quiet"]["ok"]
+    assert "a0" not in verdict["final_status"]["members"]
+    # the reshape was counted under its cause
+    events = [e for e in _events(tmp_path) if e.get("kind") == "reshape"]
+    assert any(e.get("reason") == "straggler" for e in events), events
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_preempt_race_scenario(tmp_path):
+    """ISSUE 8 acceptance: preemption notice at t, SIGKILL at t+grace —
+    the drain checkpoint (the worker's own quiesce_exit record) must land
+    strictly before the kill timestamp, with the kill finding no live
+    worker. Reactive recovery after the kill fails the drill."""
+    verdict = _run("preempt_race", tmp_path)
+    assert verdict["faults_injected"].get("preempt_notice", 0) >= 1
+    race = verdict["invariants"]["checks"]["proactive_drain_before_kill"]
+    assert race["ok"] and race["races"][0]["margin_s"] > 0
+    assert race["races"][0]["worker_alive_at_kill"] is False
+    events = [e for e in _events(tmp_path) if e.get("kind") == "reshape"]
+    assert any(e.get("reason") == "preemption" for e in events), events
+
+
+def _events(tmp_path):
+    out = []
+    with open(os.path.join(str(tmp_path), "events.jsonl")) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

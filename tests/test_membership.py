@@ -288,10 +288,14 @@ def test_standing_preflight_rearms_after_grace_when_never_ready():
     dropped past the grace period and re-armed with a FRESH coordinator —
     not left silently degrading every subsequent switch to cold."""
     clock = {"t": 0.0}
+    # heartbeat_timeout is on the SAME injected clock as everything else
+    # now (unified-clock FSM): large, so advancing the fake clock past the
+    # grace period does not also evict the silent-but-healthy agents.
     rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
                      prepare_timeout_s=60.0, prepare_min_uptime_s=0.0,
                      standing_preflight=True, standing_preflight_grace_s=30.0,
-                     min_workers=2, clock=lambda: clock["t"])
+                     min_workers=2, heartbeat_timeout=1e6,
+                     clock=lambda: clock["t"])
     gen = start_gen(rdv, ["a0", "a1"])
     rdv.tick()
     prep = rdv.prepare
@@ -316,10 +320,14 @@ def test_standing_preflight_rearms_after_grace_when_never_ready():
 
 def test_standing_preflight_all_ready_is_kept_past_grace():
     clock = {"t": 0.0}
+    # heartbeat_timeout is on the SAME injected clock as everything else
+    # now (unified-clock FSM): large, so advancing the fake clock past the
+    # grace period does not also evict the silent-but-healthy agents.
     rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
                      prepare_timeout_s=60.0, prepare_min_uptime_s=0.0,
                      standing_preflight=True, standing_preflight_grace_s=30.0,
-                     min_workers=2, clock=lambda: clock["t"])
+                     min_workers=2, heartbeat_timeout=1e6,
+                     clock=lambda: clock["t"])
     gen = start_gen(rdv, ["a0", "a1"])
     rdv.tick()
     prep = rdv.prepare
@@ -504,7 +512,7 @@ def test_notice_mid_prepare_tightens_window():
     rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
                      prepare_timeout_s=600.0, preempt_prepare_timeout_s=15.0,
                      prepare_min_uptime_s=0.0, min_workers=2,
-                     clock=lambda: clock["t"])
+                     heartbeat_timeout=1e6, clock=lambda: clock["t"])
     gen = start_gen(rdv, ["a0", "a1"])
     rdv.register("a2", "h2", 2)
     rdv.set_desired_workers(3)  # ordinary planned reshape: long window
@@ -519,3 +527,149 @@ def test_notice_mid_prepare_tightens_window():
     clock["t"] = 30.0  # past the tightened deadline, far before 600
     rdv.tick()
     assert rdv.phase == JobPhase.DRAINING
+
+
+# --------------------------------------------------------------------------
+# preempt_prepare_timeout_s short-window selection (ISSUE 8 satellite):
+# previously only exercised implicitly by live drills.
+# --------------------------------------------------------------------------
+
+
+def test_preempting_member_reshape_gets_the_short_prepare_window():
+    clock = {"t": 0.0}
+    # form the initial world COLD (prepare off), then enable the preflight
+    # so the window under test is the notice-driven reshape's, not the
+    # startup ramp's
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=0.0, preempt_prepare_timeout_s=15.0,
+                     prepare_min_uptime_s=0.0, heartbeat_timeout=1e6,
+                     clock=lambda: clock["t"])
+    gen = start_gen(rdv, ["a0", "a1"])
+    rdv.prepare_timeout_s = 600.0
+    rdv.register("a2", "h2", 2)  # standby replacement
+    # the notice arrives: the reshape preflights with the SHORT window —
+    # the drain checkpoint must land before the noticed VM dies
+    rdv.heartbeat("a1", gen, "running", preempting=True)
+    assert rdv.phase == JobPhase.PREPARING
+    assert rdv.prepare.window_s == 15.0
+    assert rdv.prepare.deadline == 15.0  # clock at 0
+    # the prepared group excludes the preempting member
+    assert "a1" not in rdv.prepare.members
+
+
+def test_non_preempting_reshape_keeps_the_long_prepare_window():
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=2, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=600.0, preempt_prepare_timeout_s=15.0,
+                     prepare_min_uptime_s=0.0, heartbeat_timeout=1e6,
+                     clock=lambda: clock["t"])
+    start_gen(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)
+    rdv.set_desired_workers(3)  # ordinary planned reshape
+    assert rdv.phase == JobPhase.PREPARING
+    assert rdv.prepare.window_s == 600.0
+
+
+def test_mixed_preempting_and_healthy_members_still_shorten_the_window():
+    """ONE preempting member among healthy peers is enough: the window is
+    sized for the weakest link's remaining lifetime."""
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=3, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=0.0, preempt_prepare_timeout_s=15.0,
+                     prepare_min_uptime_s=0.0, heartbeat_timeout=1e6,
+                     min_workers=1, clock=lambda: clock["t"])
+    gen = start_gen(rdv, ["a0", "a1", "a2"])
+    rdv.prepare_timeout_s = 600.0
+    rdv.register("a3", "h3", 2)
+    rdv.heartbeat("a1", gen, "running", preempting=True)
+    rdv.heartbeat("a0", gen, "running")
+    rdv.heartbeat("a2", gen, "running")
+    assert rdv.phase == JobPhase.PREPARING
+    assert rdv.prepare.window_s == 15.0
+    assert set(rdv.prepare.members) == {"a0", "a2", "a3"}
+
+
+# --------------------------------------------------------------------------
+# straggler exclusion (ISSUE 8 tentpole: the membership half of mitigation)
+# --------------------------------------------------------------------------
+
+
+def test_exclude_agent_reshapes_with_straggler_reason_and_holddown():
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=1, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=0.0, heartbeat_timeout=1e6,
+                     clock=lambda: clock["t"])
+    gen = start_gen(rdv, ["a0"])
+    rdv.register("a1", "h1", 2)  # standby
+    assert rdv.exclude_agent("a0", holddown_s=30.0, reason="straggler")
+    # planned drain of the excluded member, logged with its cause
+    assert rdv.phase == JobPhase.DRAINING
+    assert rdv.directive_for("a0").kind == "quiesce"
+    assert rdv.reshape_log[-1]["reason"] == "straggler"
+    assert rdv.reshape_log[-1]["planned"] is True
+    rdv.heartbeat("a0", gen, "quiesced")
+    assert rdv.phase == JobPhase.STABLE and rdv.members == ["a1"]
+    # inside the hold-down the excluded agent cannot be re-admitted...
+    clock["t"] = 10.0
+    rdv.heartbeat("a0", 0, "idle")
+    rdv.tick()
+    assert rdv.members == ["a1"]
+    # ...and after it expires it is a standby again — NOT a reshape (the
+    # current member is kept; no ping-pong on recovery)
+    clock["t"] = 31.0
+    rdv.tick()
+    assert rdv.members == ["a1"]
+    assert "a0" in rdv.healthy_agent_ids()
+    assert len(rdv.reshape_log) == 1
+
+
+def test_excluded_member_reason_survives_journal_round_trip():
+    clock = {"t": 0.0}
+    rdv = Rendezvous(desired_workers=1, port_alloc=lambda: next(ports),
+                     prepare_timeout_s=0.0, heartbeat_timeout=1e6,
+                     clock=lambda: clock["t"])
+    gen = start_gen(rdv, ["a0"])
+    rdv.register("a1", "h1", 2)
+    rdv.exclude_agent("a0", holddown_s=30.0)
+    rdv.heartbeat("a0", gen, "quiesced")
+    clock["t"] = 5.0
+    snap = rdv.snapshot()
+    assert snap["agents"]["a0"]["excluded_remaining_s"] == 25.0
+    clock2 = {"t": 1000.0}
+    rdv2 = Rendezvous(desired_workers=1, port_alloc=lambda: next(ports),
+                      prepare_timeout_s=0.0, heartbeat_timeout=1e6,
+                      clock=lambda: clock2["t"])
+    rdv2.restore(snap)
+    # still excluded for the REMAINING window on the new clock
+    assert "a0" not in rdv2.healthy_agent_ids()
+    clock2["t"] = 1026.0
+    assert "a0" in rdv2.healthy_agent_ids()
+
+
+def test_reshape_log_reasons_cover_all_causes():
+    rdv = mk(desired=2, heartbeat_timeout=1e6)
+    gen = start_gen(rdv, ["a0", "a1"])
+    rdv.register("a2", "h2", 2)
+    # plan change
+    rdv.set_desired_workers(3)
+    assert rdv.reshape_log[-1]["reason"] == "plan-change"
+    for a in ("a0", "a1"):
+        rdv.heartbeat(a, gen, "quiesced")
+    gen = rdv.generation
+    for a in ("a0", "a1", "a2"):
+        d = rdv.directive_for(a)
+        rdv.heartbeat(a, gen, "running")
+    # member lost (unplanned)
+    rdv.agents["a2"].last_heartbeat -= 1e9
+    rdv.heartbeat_timeout = 5.0
+    rdv.tick()
+    assert rdv.reshape_log[-1]["reason"] == "member-lost"
+    assert rdv.reshape_log[-1]["planned"] is False
+    for a in ("a0", "a1"):
+        rdv.heartbeat(a, rdv.generation - 1, "idle")
+    gen = rdv.generation
+    for a in ("a0", "a1"):
+        rdv.heartbeat(a, gen, "running")
+    # preemption
+    rdv.heartbeat("a0", gen, "running", preempting=True)
+    assert rdv.reshape_log[-1]["reason"] == "preemption"
