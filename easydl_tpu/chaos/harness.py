@@ -78,6 +78,16 @@ class Scenario:
     #: which t0 is stamped — the fault offsets count from here, so the
     #: kill provably lands after the snapshot and mid-storm), pace_s.
     #:
+    #: Optional ``serve`` sub-config (the serving drill): a serving
+    #: replica — ServeFrontend over a hot-id-cached PsReadClient against
+    #: the live registry-backed tier — runs UNDER the storm (and any
+    #: configured reshard), driving batched inference requests the whole
+    #: time. Keys: rows, fields, pace_s, cache_mb, seed. Evidence:
+    #: request verdict counts (zero hard failures expected — sheds are
+    #: retriable and retried), cache stats, and a post-storm stale-read
+    #: check (every id the replica ever served re-read through the cache
+    #: path and bit-compared against a fresh cache-bypassing client).
+    #:
     #: Optional ``reshard`` sub-config (the live-resharding drill): at
     #: batch ``at`` a coordinator thread runs an online split to
     #: ``to_shards`` (ps/reshard.py) while the storm keeps pushing, then
@@ -301,6 +311,7 @@ class ChaosHarness:
         counts_before = injectors.injected_fault_counts()
         self._zombie: Optional[Dict[str, Any]] = None
         self._reshard: Dict[str, Any] = {}
+        self._serve: Dict[str, Any] = {}
         try:
             self._launch_ps()
             evidence = self._drive_push_storm(plan_path)
@@ -385,11 +396,22 @@ class ChaosHarness:
         reference = LocalPsClient(num_shards=sc.ps_shards, coalesce=False)
         events_thread = None
         reshard_thread = None
+        serve_stop = None
+        serve_thread = None
+        serve_state: Dict[str, Any] = {}
         reshard_cfg = storm.get("reshard")
         try:
             for spec in specs:
                 client.create_table(spec)
                 reference.create_table(spec)
+            if storm.get("serve") is not None:
+                serve_stop = threading.Event()
+                serve_thread = threading.Thread(
+                    target=self._drive_serve_load,
+                    args=(dict(storm["serve"]), dict(storm), specs[0],
+                          serve_stop, serve_state),
+                    daemon=True, name="chaos-serve")
+                serve_thread.start()
             ckpt_dir = os.path.join(self.workdir, "ps-ckpt")
             for i, (ids, grads) in enumerate(stream):
                 if i == save_at:
@@ -432,9 +454,136 @@ class ChaosHarness:
                 if reshard_thread.is_alive():
                     self._reshard.setdefault("errors", []).append(
                         "reshard thread still running at storm end")
+            if serve_thread is not None:
+                serve_stop.set()
+                serve_thread.join(timeout=120.0)
+                self._finish_serve(serve_state, reference, specs[0])
             return self._verify_zero_loss(client, reference, specs)
         finally:
+            if serve_stop is not None:
+                serve_stop.set()
             client.close()
+
+    # ----------------------------------------------------- serving drill
+    def _drive_serve_load(self, cfg: Dict[str, Any], storm: Dict[str, Any],
+                          spec, stop: threading.Event,
+                          state: Dict[str, Any]) -> None:
+        """A serving replica under load beside the storm: batched
+        inference through the full frontend (queue + admission + hot-id
+        cache + shared read client) against the live registry-backed
+        tier, for the whole drill — including any live reshard. Hard
+        request failures are the drill's primary evidence; sheds are
+        retriable by contract and retried here."""
+        import numpy as np
+
+        from easydl_tpu.ps.client import ShardedPsClient
+        from easydl_tpu.ps.read_client import PsReadClient
+        from easydl_tpu.serve import HotIdCache, ServeConfig, ServeFrontend
+
+        sc = self.scenario
+        rows = int(cfg.get("rows", 16))
+        fields = int(cfg.get("fields", 4))
+        pace_s = float(cfg.get("pace_s", 0.02))
+        vocab = int(storm.get("vocab", 4000))
+        zipf_a = float(storm.get("zipf_a", 1.1))
+        rng = np.random.default_rng(int(cfg.get("seed", sc.chaos.seed + 9)))
+        out = state["counts"] = {
+            "requests": 0, "ok": 0, "shed": 0, "hard_failures": 0,
+            "failure_samples": [],
+        }
+        client = ShardedPsClient.from_registry(
+            self.workdir, sc.ps_shards, timeout=2.0,
+            drain_retry_s=120.0, transient_retry_s=60.0)
+        reads = PsReadClient(
+            client, cache=HotIdCache(int(cfg.get("cache_mb", 16)) << 20))
+        frontend = ServeFrontend(
+            reads,
+            ServeConfig(table=spec.name, fields=fields, dense_dim=0,
+                        max_batch=rows * 4, max_wait_ms=2.0,
+                        request_timeout_s=240.0),
+            name="serve-drill")
+        frontend.serve(obs_workdir=self.workdir, obs_name="serve-drill")
+        state["frontend"] = frontend
+        state["reads"] = reads
+        served: list = []
+        state["served_ids"] = served
+        while not stop.is_set():
+            ids = (rng.zipf(zipf_a, rows * fields) % vocab).astype(
+                np.int64).reshape(rows, fields)
+            served.append(ids.reshape(-1))
+            out["requests"] += 1
+            # Retriable sheds re-send the SAME request (the client
+            # contract the verdict asks for) — a fresh batch instead
+            # would quietly drop whatever the shed request exercised.
+            while not stop.is_set():
+                result = frontend.infer(ids)
+                if result.ok:
+                    out["ok"] += 1
+                    break
+                if result.retriable:
+                    out["shed"] += 1
+                    time.sleep(0.005)
+                    continue
+                out["hard_failures"] += 1
+                if len(out["failure_samples"]) < 5:
+                    out["failure_samples"].append(result.verdict)
+                break
+            stop.wait(pace_s)
+
+    def _finish_serve(self, state: Dict[str, Any], reference, spec) -> None:
+        """Post-storm serving evidence: (1) the stale-read check — every
+        id the replica ever requested, re-read through the HOT CACHE path
+        and bit-compared against a fresh, cache-bypassing client on the
+        COMMITTED (post-migration) routing; (2) mirror those ids into the
+        fault-free reference so rows the serving reads lazily
+        materialised exist on both sides of the digest comparison
+        (deterministic init: identical bytes unless something is truly
+        stale)."""
+        import numpy as np
+
+        from easydl_tpu.ps.client import ShardedPsClient
+
+        self._serve = dict(state.get("counts") or {})
+        frontend = state.get("frontend")
+        reads = state.get("reads")
+        if frontend is None or reads is None:
+            self._serve.setdefault("errors", []).append(
+                "serve replica never came up")
+            return
+        try:
+            served = state.get("served_ids") or []
+            ids = (np.unique(np.concatenate(served)) if served
+                   else np.zeros(0, np.int64))
+            bypass = ShardedPsClient.from_registry(
+                self.workdir, timeout=5.0, num_shards=None,
+                drain_retry_s=60.0, transient_retry_s=30.0)
+            try:
+                via_cache = reads.pull(spec.name, ids)
+                direct = bypass.pull(spec.name, ids)
+                mism = int((~np.all(
+                    via_cache == direct, axis=-1)).sum()) if len(ids) else 0
+                self._serve["stale_check"] = {
+                    "ids_checked": int(len(ids)),
+                    "stale_rows": mism,
+                }
+            finally:
+                bypass.close()
+            # Mirror every served id into the reference (same lazy init).
+            if len(ids):
+                reference.pull(spec.name, ids)
+            self._serve["cache"] = reads.cache.stats()
+            self._serve["batches_run"] = frontend.batches_run
+        except Exception as e:
+            self._serve.setdefault("errors", []).append(repr(e))
+        finally:
+            try:
+                frontend.stop()
+            except Exception:
+                pass
+            try:
+                reads.client.close()
+            except Exception:
+                pass
 
     # --------------------------------------------------- live resharding
     def _run_reshard_migrations(self, cfg: Dict[str, Any]) -> None:
@@ -541,6 +690,8 @@ class ChaosHarness:
             evidence["zombie"] = dict(self._zombie)
             evidence["zombie"].update(self._probe_zombie(specs[0]))
             evidence["zombie"].update(self._zombie_excess_wal_bytes())
+        if self._serve:
+            evidence["serve"] = dict(self._serve)
         if self._reshard:
             evidence["reshard"] = dict(self._reshard)
             # The verify save below must fan out over the POST-migration
@@ -1459,6 +1610,50 @@ def scenario_ps_reshard_under_fire(seed: int = 43) -> Scenario:
     )
 
 
+def scenario_serve_during_reshard(seed: int = 59) -> Scenario:
+    """The serving tier rides a live 2→4 shard split under load: a
+    serving replica (full frontend — micro-batch queue, admission
+    control, hot-id cache, shared read client) serves batched inference
+    against the registry-backed tier while the Zipf push storm keeps
+    training it AND the reshard coordinator splits it online. The
+    serving stream must see ZERO hard request failures (cutover windows
+    surface only as retried pulls inside the batch, never as errors),
+    and after the migration every id the replica ever served must read
+    bit-identical through the hot cache and through a fresh
+    cache-bypassing client — a cached row surviving the generation flip
+    or a trainer push would diverge here. Digest parity against the
+    never-resharded reference still holds (served rows are mirrored into
+    the reference: lazy init is deterministic)."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="serve_during_reshard", seed=seed,
+            notes="serving replica under load across a live 2->4 split; "
+                  "zero hard request failures, zero stale reads "
+                  "(bit-checked vs the post-migration tier)",
+            faults=(),  # the migration itself is the disturbance
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=2,
+        ps_storm={"steps": 380, "batch": 160, "vocab": 3000, "dim": 8,
+                  "zipf_a": 1.1, "save_at": 60, "arm_at": 70,
+                  "pace_s": 0.008,
+                  "reshard": {"at": 90, "to_shards": 4},
+                  "serve": {"rows": 16, "fields": 4, "pace_s": 0.01,
+                            "cache_mb": 16}},
+        expect={
+            "ps_zero_loss": True,
+            "min_reshard_migrations": 1,
+            "min_rows_migrated": 1,
+            "min_reshard_replays": 1,
+            "serve_no_hard_failures": True,
+            "serve_no_stale_reads": True,
+            "min_serve_requests": 50,
+            "min_serve_cache_hits": 1,
+        },
+    )
+
+
 def scenario_straggler_mitigation(seed: int = 47) -> Scenario:
     """Straggler detection + damped eviction (ROADMAP item 3's first named
     invariant): 2s after steady state the member's worker starts sleeping
@@ -1568,6 +1763,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "ps_shard_crash_zero_loss": scenario_ps_shard_crash_zero_loss,
     "ps_zombie_writer": scenario_ps_zombie_writer,
     "ps_reshard_under_fire": scenario_ps_reshard_under_fire,
+    "serve_during_reshard": scenario_serve_during_reshard,
     "straggler_mitigation": scenario_straggler_mitigation,
     "preempt_race": scenario_preempt_race,
 }

@@ -562,6 +562,47 @@ def check_scenario(
                     "committed_routing": [m.get("committed_routing")
                                           for m in committed],
                 }
+            if (expect.get("serve_no_hard_failures")
+                    or expect.get("serve_no_stale_reads")
+                    or expect.get("min_serve_requests") is not None):
+                sv = evidence.get("serve") or {}
+                if not sv:
+                    checks["serve_healthy"] = {
+                        "ok": False,
+                        "reason": "no serve evidence recorded (serving "
+                                  "replica never ran?)",
+                    }
+                else:
+                    stale = sv.get("stale_check") or {}
+                    cache = sv.get("cache") or {}
+                    min_req = int(expect.get("min_serve_requests", 1))
+                    min_hits = int(expect.get("min_serve_cache_hits", 0))
+                    ok = not sv.get("errors")
+                    ok = ok and int(sv.get("requests", 0)) >= min_req
+                    if expect.get("serve_no_hard_failures"):
+                        ok = ok and int(sv.get("hard_failures", -1)) == 0
+                    if expect.get("serve_no_stale_reads"):
+                        # Anti-vacuous both ways: the check must have
+                        # examined at least one id AND found zero stale.
+                        ok = (ok and int(stale.get("ids_checked", 0)) > 0
+                              and int(stale.get("stale_rows", -1)) == 0)
+                    if min_hits:
+                        # A run the cache never served would prove
+                        # nothing about invalidation under the split.
+                        ok = ok and float(cache.get("hits", 0)) >= min_hits
+                    checks["serve_healthy"] = {
+                        "ok": ok,
+                        "requests": sv.get("requests"),
+                        "ok_requests": sv.get("ok"),
+                        "shed": sv.get("shed"),
+                        "hard_failures": sv.get("hard_failures"),
+                        "failure_samples": sv.get("failure_samples"),
+                        "stale_check": stale,
+                        "cache_hits": cache.get("hits"),
+                        "cache_hit_ratio": cache.get("hit_ratio"),
+                        "errors": sv.get("errors"),
+                        "min_serve_requests": min_req,
+                    }
             if expect.get("zombie_fenced"):
                 z = evidence.get("zombie") or {}
                 if not z:

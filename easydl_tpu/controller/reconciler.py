@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import json
+import math
 import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -339,3 +340,118 @@ def maybe_split_ps(workdir: str,
     return ps_split_decision(shard_rows, num_shards, hot_ratio=hot_ratio,
                              min_total_rows=min_total_rows,
                              max_shards=max_shards)
+
+
+# ------------------------------------------------- serve replica autoscale
+
+#: Replica-policy defaults (env-overridable through maybe_scale_serve):
+#: a replica is "full" at SERVE_TARGET_QPS_PER_REPLICA, and p99 past the
+#: budget means queueing — scale up even when the QPS math says there is
+#: headroom (latency is the symptom the batch queue shows FIRST when the
+#: forward or the PS pull saturates). Scale-down needs the fleet
+#: comfortably under target (hysteresis) so a noisy minute can't flap
+#: replicas — the serving twin of the straggler hold-down.
+SERVE_TARGET_QPS_PER_REPLICA = 500.0
+SERVE_P99_BUDGET_S = 0.050
+SERVE_MIN_REPLICAS = 1
+SERVE_MAX_REPLICAS = 64
+SERVE_SCALE_DOWN_FRACTION = 0.4
+
+
+def serve_scale_decision(replica_qps: Dict[str, float],
+                         replica_p99: Dict[str, float],
+                         target_qps: float = SERVE_TARGET_QPS_PER_REPLICA,
+                         p99_budget_s: float = SERVE_P99_BUDGET_S,
+                         min_replicas: int = SERVE_MIN_REPLICAS,
+                         max_replicas: int = SERVE_MAX_REPLICAS,
+                         scale_down_fraction: float =
+                         SERVE_SCALE_DOWN_FRACTION) -> Optional[int]:
+    """Pure decision: observed per-replica QPS and p99 → target replica
+    count, or None for "leave it alone". Same shape as
+    :func:`ps_split_decision`: pure inputs → pure verdict, unit-testable
+    without a live tier.
+
+    - **capacity**: enough replicas that total QPS / replica ≤ target;
+    - **latency**: any replica's p99 past the budget adds at least one
+      replica even under the QPS target (queueing has started);
+    - **hysteresis**: scale down only when total QPS would keep even the
+      SHRUNK fleet under ``scale_down_fraction`` × target per replica and
+      every p99 is under half the budget.
+    """
+    replicas = len(replica_qps)
+    if replicas <= 0 or target_qps <= 0:
+        return None
+    total_qps = float(sum(replica_qps.values()))
+    worst_p99 = max(replica_p99.values(), default=0.0)
+    need_capacity = max(1, math.ceil(total_qps / target_qps))
+    want = replicas
+    if worst_p99 > p99_budget_s:
+        want = max(need_capacity, replicas + 1)
+    elif need_capacity > replicas:
+        want = need_capacity
+    elif (replicas > min_replicas
+          and worst_p99 < 0.5 * p99_budget_s
+          and total_qps < (scale_down_fraction * target_qps
+                           * (replicas - 1))):
+        want = max(need_capacity, min_replicas, replicas - 1)
+    want = max(min_replicas, min(max_replicas, want))
+    return want if want != replicas else None
+
+
+def maybe_scale_serve(workdir: str,
+                      target_qps: Optional[float] = None,
+                      p99_budget_s: Optional[float] = None,
+                      min_replicas: Optional[int] = None,
+                      max_replicas: Optional[int] = None) -> Optional[int]:
+    """Scrape every serving replica's rolling ``easydl_serve_qps_recent``
+    / ``easydl_serve_p99_seconds_recent`` gauges (the PR-1 exporters under
+    the job workdir) and run :func:`serve_scale_decision` over them.
+    Returns the recommended replica count, or None.
+
+    Recommendation only, like :func:`maybe_split_ps`: the operator loop
+    (or a human reading the runbook) levels the replica set — the same
+    CREATE/DELETE pod mechanics every other role uses. Thresholds default
+    from ``EASYDL_SERVE_TARGET_QPS`` / ``EASYDL_SERVE_P99_BUDGET_S`` /
+    ``EASYDL_SERVE_MIN_REPLICAS`` / ``EASYDL_SERVE_MAX_REPLICAS``;
+    explicit args win."""
+    import re as _re
+
+    if target_qps is None:
+        target_qps = float(os.environ.get("EASYDL_SERVE_TARGET_QPS",
+                                          SERVE_TARGET_QPS_PER_REPLICA))
+    if p99_budget_s is None:
+        p99_budget_s = float(os.environ.get("EASYDL_SERVE_P99_BUDGET_S",
+                                            SERVE_P99_BUDGET_S))
+    if min_replicas is None:
+        min_replicas = int(os.environ.get("EASYDL_SERVE_MIN_REPLICAS",
+                                          SERVE_MIN_REPLICAS))
+    if max_replicas is None:
+        max_replicas = int(os.environ.get("EASYDL_SERVE_MAX_REPLICAS",
+                                          SERVE_MAX_REPLICAS))
+
+    from easydl_tpu.obs.scrape import merge_snapshot
+
+    try:
+        snap = merge_snapshot(workdir=workdir)
+    except Exception:
+        return None
+    qps_re = _re.compile(r'^easydl_serve_qps_recent\{.*replica="([^"]+)"')
+    p99_re = _re.compile(
+        r'^easydl_serve_p99_seconds_recent\{.*replica="([^"]+)"')
+    replica_qps: Dict[str, float] = {}
+    replica_p99: Dict[str, float] = {}
+    for _component, svc in (snap.get("services") or {}).items():
+        for series, value in (svc.get("metrics") or {}).items():
+            m = qps_re.match(series)
+            if m:
+                replica_qps[m.group(1)] = float(value)
+                continue
+            m = p99_re.match(series)
+            if m:
+                replica_p99[m.group(1)] = float(value)
+    if not replica_qps:
+        return None
+    return serve_scale_decision(
+        replica_qps, replica_p99, target_qps=target_qps,
+        p99_budget_s=p99_budget_s, min_replicas=min_replicas,
+        max_replicas=max_replicas)

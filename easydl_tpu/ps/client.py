@@ -64,6 +64,36 @@ class RoutingChanged(Exception):
     top-level pull/push, which re-routes each id to its new owner."""
 
 
+class PullVersions:
+    """Per-shard table push-versions observed by ONE pull (the meta the
+    hot-id serving cache keys invalidation on, see ps/read_client.py).
+
+    Chunk workers record concurrently; the per-shard value kept is the
+    MINIMUM seen — chunks of one shard race pushes independently, and the
+    oldest version is the only tag under which every chunk's rows are
+    provably fresh. A live-reshard re-dispatch (RoutingChanged) marks the
+    whole collection incomplete: its rows came from a different routing
+    generation and must not be cached under this one's tags. Version 0
+    (legacy server, no version info) is never recorded."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.versions: Dict[int, int] = {}
+        self.complete = True
+
+    def record(self, shard: int, version: int) -> None:
+        if not version:
+            return
+        with self._mu:
+            cur = self.versions.get(shard)
+            if cur is None or version < cur:
+                self.versions[shard] = int(version)
+
+    def invalidate(self) -> None:
+        with self._mu:
+            self.complete = False
+
+
 _client_metrics_cache: Optional[tuple] = None
 
 
@@ -147,7 +177,8 @@ class _PsClientBase:
     # client's retry loops compare it against the live generation and
     # re-dispatch on a move.
     def _pull_shard(self, shard: int, table: str, ids: np.ndarray,
-                    route_gen=None) -> np.ndarray:
+                    route_gen=None, vout: Optional[PullVersions] = None
+                    ) -> np.ndarray:
         raise NotImplementedError
 
     def _push_shard(self, shard: int, table: str, ids: np.ndarray,
@@ -242,8 +273,12 @@ class _PsClientBase:
         self._for_all(lambda s: self._create_shard(s, spec))
         self._dims[spec.name] = spec.dim
 
-    def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
-        """ids any shape -> float32 ``ids.shape + (dim,)``."""
+    def pull(self, table: str, ids: np.ndarray,
+             versions: Optional[PullVersions] = None) -> np.ndarray:
+        """ids any shape -> float32 ``ids.shape + (dim,)``. ``versions``
+        (optional) collects the per-shard table push-versions the rows
+        were read under — the caching layer's invalidation meta; plain
+        callers never pay for it."""
         ids = np.asarray(ids)
         flat = ids.reshape(-1).astype(np.int64)
         if flat.size == 0:
@@ -266,14 +301,14 @@ class _PsClientBase:
         # would otherwise send num_shards concurrent Stats calls at shard 0.
         self._table_dim(table)
         if not self.coalesce:
-            return self._pull_strict(table, ids, flat, n, gen0)
+            return self._pull_strict(table, ids, flat, n, gen0, versions)
         # Dedup before the RPC: every duplicate of a hot id would otherwise
         # ride the wire and hit the store once per occurrence.
         routed, routed_inv, offs = self._plan(flat, n)
         _client_metrics()[0].set(len(routed) / len(flat), table=table)
         parts = self._for_all(
             lambda s: self._pull_shard(s, table, routed[offs[s]:offs[s + 1]],
-                                       gen0),
+                                       gen0, versions),
             n,
         )
         dim = next((p.shape[-1] for p in parts if p.size),
@@ -292,13 +327,14 @@ class _PsClientBase:
 
     def _pull_strict(self, table: str, ids: np.ndarray,
                      flat: np.ndarray, n: int,
-                     route_gen=None) -> np.ndarray:
+                     route_gen=None,
+                     versions: Optional[PullVersions] = None) -> np.ndarray:
         """Pre-coalescing pull (row per batch position on the wire) — the
         parity/bench baseline."""
         owner = shard_of(flat, n)
         parts = self._for_all(
             lambda s: self._pull_shard(s, table, flat[owner == s],
-                                       route_gen), n
+                                       route_gen, versions), n
         )
         dim = next((p.shape[-1] for p in parts if p.size),
                    self._table_dim(table))
@@ -375,6 +411,14 @@ class _PsClientBase:
             t.rows for st in self.stats() for t in st.tables if t.name == table
         )
 
+    def probe_versions(self, table: str, shards) -> Dict[int, int]:
+        """Current push-version of ``table`` on each of ``shards`` — the
+        serving cache's cheap freshness probe for batches it can answer
+        without any row pull. Best-effort: shards that fail the probe (or
+        run legacy code with no version counter) are simply absent, and
+        the caller treats their cached rows as unvalidated."""
+        return {}
+
 
 class LocalPsClient(_PsClientBase):
     """In-process PS cluster: N shards, no sockets.
@@ -402,11 +446,23 @@ class LocalPsClient(_PsClientBase):
         except KeyError:
             return 0
 
-    def _pull_shard(self, s, table, ids, route_gen=None):
+    def _pull_shard(self, s, table, ids, route_gen=None, vout=None):
         if ids.size == 0:
             sh = self.shards[s]
             return np.zeros((0, sh.table(table).dim), np.float32)
-        return self.shards[s].table(table).pull(ids)
+        t = self.shards[s].table(table)
+        if vout is not None:
+            vout.record(s, t.push_version)  # before the gather, like Pull
+        return t.pull(ids)
+
+    def probe_versions(self, table, shards):
+        out = {}
+        for s in shards:
+            try:
+                out[s] = self.shards[s].table(table).push_version
+            except (KeyError, IndexError):
+                continue
+        return out
 
     def _push_shard(self, s, table, ids, grads, scale, route_gen=None):
         if ids.size:
@@ -428,6 +484,28 @@ class LocalPsClient(_PsClientBase):
 #: classification now lives in utils/retry.py (shared with the agent's
 #: register path); kept under the old name for in-repo callers.
 _is_transport_error = is_transport_error
+
+
+#: Process-wide table-dims cache, one dict per registry-identified PS
+#: *cluster*. Every ShardedPsClient against the same workdir shares ONE
+#: dict: before this, each new client (the trainer's, a serving
+#: replica's, a bench probe's) re-paid a Stats RPC at shard 0 on its
+#: first empty pull to learn dims the process already knew. A routing
+#: rebuild clears the dict IN PLACE so every sharer sees the
+#: invalidation at once. Registry-less clients (plain address lists) get
+#: a PRIVATE dict: addresses identify a cluster only for its lifetime,
+#: and a later cluster reusing the same ports in this process (tests,
+#: benches) must not inherit stale dims.
+_SHARED_DIMS: Dict[str, Dict[str, int]] = {}
+_SHARED_DIMS_LOCK = threading.Lock()
+
+
+def _shared_dims_for(registry_workdir: Optional[str]) -> Dict[str, int]:
+    if not registry_workdir:
+        return {}
+    key = os.path.realpath(registry_workdir)
+    with _SHARED_DIMS_LOCK:
+        return _SHARED_DIMS.setdefault(key, {})
 
 
 class ShardedPsClient(_PsClientBase):
@@ -482,7 +560,7 @@ class ShardedPsClient(_PsClientBase):
         # deployments; with a registry the stamp is what lets a server
         # reject pushes routed by a superseded publication.
         self._epochs = [0] * self.num_shards
-        self._dims: Dict[str, int] = {}
+        self._dims = _shared_dims_for(registry_workdir)
         self.drain_retry_s = drain_retry_s
         # Bound for transient-UNAVAILABLE retry on the PULL path (pushes
         # have the drain window): long enough to ride a shard crash +
@@ -586,8 +664,10 @@ class ShardedPsClient(_PsClientBase):
             self._reroute_epoch = [0] * n
             # A shard-count change invalidates every partition plan and the
             # dims cache (dims re-resolve via Stats on the new shard 0).
+            # clear(), not rebind: the dict is shared with every other
+            # client of this cluster, and they must see the invalidation.
             self._plan_cache = ()
-            self._dims = {}
+            self._dims.clear()
             if old_pool is not None:
                 self._pool = None  # recreated lazily, sized to the new n
             # Publish the new generation LAST: chunk retry loops key their
@@ -746,18 +826,43 @@ class ShardedPsClient(_PsClientBase):
             kwargs["ids"] = ids.tolist()
         return kwargs
 
-    def _pull_shard(self, s, table, ids, route_gen=None):
+    def _pull_shard(self, s, table, ids, route_gen=None, vout=None):
         if ids.size == 0:
             return np.zeros((0, self._table_dim(table)), np.float32)
         ranges = self._chunks(len(ids), self._table_dim(table))
         parts = self._chunk_fan(
             [lambda lo=lo, hi=hi: self._pull_chunk(s, table, ids[lo:hi],
-                                                   route_gen)
+                                                   route_gen, vout)
              for lo, hi in ranges]
         )
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
-    def _pull_chunk(self, s, table, ids, route_gen=None):
+    def probe_versions(self, table, shards):
+        """Zero-id Pull per shard: the response carries the table's
+        push-version and dim but no rows — a few hundred bytes of wire,
+        issued concurrently over the chunk pool (the probe sits on the
+        serving hot path, and N sequential RTTs would tax exactly the
+        all-hit batches the cache exists to make cheap). Errors (dead
+        shard, fenced zombie, cut-over source, no such table) just omit
+        the shard: the caller's cached rows for it count as unvalidated,
+        which degrades to a plain re-pull — the retriable path — never
+        to serving a possibly-stale row."""
+        def probe(s):
+            try:
+                with self._routing_lock:
+                    if s >= len(self._clients):
+                        return None
+                    client = self._clients[s]
+                resp = client.Pull(pb.PullRequest(table=table))
+            except Exception:
+                return None
+            return (int(s), int(resp.version)) if resp.version else None
+
+        shards = list(shards)
+        results = self._chunk_fan([lambda s=s: probe(s) for s in shards])
+        return dict(r for r in results if r is not None)
+
+    def _pull_chunk(self, s, table, ids, route_gen=None, vout=None):
         # Pulls are read-only — retrying a transient transport failure is
         # unconditionally safe, and without it ONE sporadic UNAVAILABLE
         # (shard crash, connection refused during a pod replacement) killed
@@ -853,9 +958,16 @@ class ShardedPsClient(_PsClientBase):
             # Inline: this thread is a chunk/shard pool worker — the nested
             # pull must not submit back into the bounded pools (deadlock
             # once every worker is a re-dispatcher waiting for a slot).
+            # The re-dispatched rows come from a DIFFERENT routing
+            # generation: the whole version collection is void (a cache
+            # must not tag them under this generation's shard indices).
+            if vout is not None:
+                vout.invalidate()
             return np.ascontiguousarray(
                 self._dispatch_inline(self.pull, table, ids)
                 .reshape(len(ids), -1))
+        if vout is not None:
+            vout.record(s, resp.version)
         if (s < len(self._reroute_epoch) and resp.dtype
                 and self._reroute_epoch[s] == state["epoch"]
                 and self._route_generation == route_gen):

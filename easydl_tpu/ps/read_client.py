@@ -1,0 +1,208 @@
+"""The shared PS read client: ONE pull code path for trainer and server.
+
+:class:`PsReadClient` wraps any :class:`easydl_tpu.ps.client._PsClientBase`
+transport (gRPC or Local). Without a cache it is a transparent passthrough
+— exactly what the trainer wants, and what guarantees both consumers
+inherit every wire win (raw_ids, fp16 pulls, chunked concurrent
+transfers, duplicate-id coalescing, stale-route / RoutingChanged
+handling) from one implementation. With a
+:class:`easydl_tpu.serve.cache.HotIdCache` it becomes the serving hot
+path: batch reads are split hit/miss, misses ride the ordinary pull, and
+every batch is **version-validated** so the cache can never serve a row a
+trainer push (or a live reshard) made stale.
+
+The freshness contract, precisely::
+
+    a cached row tagged (generation g, shard s, version v) is served only
+    if (1) the client's routing generation is still g, and (2) shard s
+    reports push-version v for the table AT THIS BATCH, observed from a
+    zero-id probe Pull issued after the batch arrived; rows the cache
+    cannot serve ride ONE ordinary pull, and are inserted tagged with
+    that pull's own versions.
+
+Server-side, versions bump after every applied mutation and Pull reads
+the version before the row gather (apply-then-bump / read-version-first),
+so "version unchanged" proves "no push completed in between". Validation
+happens after the serve request arrived, which is the linearization
+point: a push ACKED before the request is always reflected; a push racing
+the request may or may not be — the same semantics an uncached pull has.
+``max_probe_age_s > 0`` relaxes (2) into bounded staleness: probe results
+are reused for that long, trading freshness for one tiny RPC per shard
+per batch. The default (0) is strict.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from easydl_tpu.ps.client import PullVersions, _PsClientBase
+from easydl_tpu.ps.table import shard_of
+
+
+class PsReadClient:
+    """Pull-side facade over a PS client, optionally hot-id cached."""
+
+    def __init__(self, client: _PsClientBase, cache=None,
+                 max_probe_age_s: float = 0.0):
+        self.client = client
+        self.cache = cache
+        self.max_probe_age_s = float(max_probe_age_s)
+        self._mu = threading.Lock()
+        self._batch_mu = threading.Lock()
+        self._probe_at: Dict[Tuple[str, int], Tuple[float, int]] = {}
+        #: cumulative batch accounting (the serve frontend drains these
+        #: into easydl_serve_* counters)
+        self.counters: Dict[str, int] = {
+            "batches": 0, "hits": 0, "misses": 0, "demoted": 0,
+            "probes": 0, "pulled_rows": 0, "uncacheable": 0,
+        }
+
+    # ------------------------------------------------------------------ api
+    def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
+        """ids any shape -> float32 ``ids.shape + (dim,)`` — the same
+        contract as the transport's own pull."""
+        if self.cache is None:
+            return self.client.pull(table, ids)
+        return self._cached_pull(table, np.asarray(ids))
+
+    def __getattr__(self, name):
+        # Everything that isn't the read hot path (create_table, push,
+        # save, stats, close, ...) delegates to the transport — callers
+        # can treat the read client as "the client".
+        return getattr(self.client, name)
+
+    # ------------------------------------------------------------ internals
+    def _generation(self) -> int:
+        return int(getattr(self.client, "_route_generation", 0) or 0)
+
+    def _probe(self, table: str, shards) -> Dict[int, int]:
+        """probe_versions with optional bounded-staleness reuse."""
+        now = time.monotonic()
+        out: Dict[int, int] = {}
+        need = []
+        if self.max_probe_age_s > 0:
+            with self._mu:
+                for s in shards:
+                    cached = self._probe_at.get((table, s))
+                    if cached and now - cached[0] <= self.max_probe_age_s:
+                        out[s] = cached[1]
+                    else:
+                        need.append(s)
+        else:
+            need = list(shards)
+        if need:
+            fresh = self.client.probe_versions(table, need)
+            with self._mu:
+                self.counters["probes"] += len(need)
+                for s, v in fresh.items():
+                    self._probe_at[(table, s)] = (now, v)
+            out.update(fresh)
+        return out
+
+    def _cached_pull(self, table: str, ids: np.ndarray) -> np.ndarray:
+        # One batch at a time per read client: cache slot handles from
+        # lookup() are only stable until the next mutating call, and the
+        # frontend's single batch runner is the intended driver anyway.
+        with self._batch_mu:
+            return self._cached_pull_locked(table, ids)
+
+    def _cached_pull_locked(self, table: str, ids: np.ndarray) -> np.ndarray:
+        flat = ids.reshape(-1).astype(np.int64)
+        if flat.size == 0:
+            return self.client.pull(table, ids)
+        cache = self.cache
+        gen = self._generation()
+        if cache.set_generation(gen):
+            with self._mu:
+                self._probe_at.clear()  # versions belong to shard indices
+        n = int(self.client.num_shards)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        owner = shard_of(uniq, n)
+        k = len(uniq)
+        slots, hit_shards, hit_versions = cache.lookup(table, uniq)
+        found = slots >= 0
+        miss = ~found
+        # ---- phase A: pull the plain misses. Its per-shard versions
+        # double as the freshness signal for hits on the same shards —
+        # the pull happened after the batch arrived, which is all the
+        # linearization point needs — so a batch with misses on every
+        # shard pays ZERO extra probe RPCs.
+        fresh_arr = np.zeros(n, np.uint64)
+        va = PullVersions()
+        pulled_a = None
+        if miss.any():
+            pulled_a = self.client.pull(table, uniq[miss], versions=va)
+            if va.complete:
+                for s, v in va.versions.items():
+                    if 0 <= s < n:
+                        fresh_arr[s] = v
+        # ---- probe (zero-id Pull) only the hit-shards phase A did not
+        # already report on. The probe/pull is this batch's
+        # linearization point: any push ACKED before the request arrived
+        # is in its version.
+        if found.any():
+            uncovered = [int(s) for s in np.unique(owner[found])
+                         if not fresh_arr[s]]
+            if uncovered:
+                for s, v in self._probe(table, uncovered).items():
+                    if 0 <= s < n:
+                        fresh_arr[s] = v
+        valid = (found
+                 & (hit_versions == fresh_arr[owner])
+                 & (fresh_arr[owner] != 0)
+                 & (hit_shards == owner))
+        demoted = found & ~valid
+        # ---- phase B: re-pull the version-demoted hits (rare — only a
+        # push/import/restore on the owning shard triggers it).
+        vb = PullVersions()
+        pulled_b = None
+        if demoted.any():
+            cache.demote(table, uniq[demoted], slots[demoted])
+            pulled_b = self.client.pull(table, uniq[demoted], versions=vb)
+        dim = (pulled_a.shape[-1] if pulled_a is not None
+               else pulled_b.shape[-1] if pulled_b is not None
+               else cache.dim(table))
+        out = np.empty((k, dim), np.float32)
+        if valid.any():
+            pos = np.nonzero(valid)[0]
+            cache.gather_into(table, slots[pos], out, pos)
+        # Insert fresh rows tagged with the version of THEIR OWN pull
+        # (never the probe's: the tag must be the version the row bytes
+        # were read under) — unless the routing generation moved
+        # mid-batch: the rows are fine to SERVE (the transport
+        # re-dispatched them through the new routing) but their shard
+        # tags are not.
+        cacheable = self._generation() == gen
+        for mask, pulled, coll in ((miss, pulled_a, va),
+                                   (demoted, pulled_b, vb)):
+            if pulled is None:
+                continue
+            out[mask] = pulled
+            if not (cacheable and coll.complete):
+                continue
+            coll_arr = np.zeros(n, np.uint64)
+            for s, v in coll.versions.items():
+                if 0 <= s < n:
+                    coll_arr[s] = v
+            ins_versions = coll_arr[owner[mask]]
+            ok = ins_versions != 0
+            if ok.any():
+                cache.put(table, uniq[mask][ok], pulled[ok],
+                          owner[mask][ok], ins_versions[ok])
+        if not cacheable:
+            with self._mu:
+                self.counters["uncacheable"] += 1
+            cache.set_generation(self._generation())
+        n_demoted = int(demoted.sum())
+        n_missing = int(miss.sum()) + n_demoted
+        with self._mu:
+            self.counters["batches"] += 1
+            self.counters["hits"] += int(valid.sum())
+            self.counters["misses"] += n_missing
+            self.counters["demoted"] += n_demoted
+            self.counters["pulled_rows"] += n_missing
+        return out[inv].reshape(ids.shape + (dim,))

@@ -308,7 +308,11 @@ class PsShard:
                             f"table {spec.name!r} exists with different spec"
                         )
                     return existing
-                t = EmbeddingTable(spec, backend=self._backend)
+                # version_base: incarnation-disjoint push-version space
+                # (see EmbeddingTable) — the epoch is exactly the
+                # per-incarnation counter the registry already maintains.
+                t = EmbeddingTable(spec, backend=self._backend,
+                                   version_base=max(self.epoch, 0) << 32)
                 self._tables[spec.name] = t
             if self._wal is not None and not self._replaying:
                 self._wal.append(_wal.encode_create(_spec_json(spec)))
@@ -834,6 +838,12 @@ class PsShard:
                 ctx.abort(grpc.StatusCode.UNAVAILABLE, msg)
             raise RuntimeError(msg)
         t = self.table(req.table)
+        # Version BEFORE the row gather: a push landing in between then
+        # tags the rows with a version older than their content — the safe
+        # direction (the cache re-validates and spuriously re-pulls); the
+        # reverse order could tag a pre-push row with a post-push version
+        # and a serving cache would keep it past the trainer's update.
+        version = t.push_version
         ids = request_ids(req)
         values = t.pull(ids)
         if req.value_dtype == "f16":
@@ -845,7 +855,8 @@ class PsShard:
         # dtype is ALWAYS set: besides naming the encoding it is the
         # capability signal that lets new clients drop the duplicate legacy
         # ids list from every later request to this shard.
-        resp = pb.PullResponse(values=payload, dim=t.dim, dtype=dtype)
+        resp = pb.PullResponse(values=payload, dim=t.dim, dtype=dtype,
+                               version=version)
         self._m_pulls.inc(len(ids), shard=self._shard_label, table=req.table)
         self._m_pull_bytes.inc(req.ByteSize() + resp.ByteSize(),
                                shard=self._shard_label, table=req.table)
@@ -1061,7 +1072,8 @@ class PsShard:
         )
         with self._lock:
             for name, t in self._tables.items():
-                resp.tables.add(name=name, rows=t.rows, dim=t.dim)
+                resp.tables.add(name=name, rows=t.rows, dim=t.dim,
+                                version=t.push_version)
         return resp
 
     # ----------------------------------------------------------------- serve

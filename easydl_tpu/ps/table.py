@@ -312,13 +312,27 @@ class EmbeddingTable:
     """One named table. ``backend`` is ``"auto"`` (native if buildable),
     ``"native"`` (require C++), or ``"numpy"``."""
 
-    def __init__(self, spec: TableSpec, backend: str = "auto"):
+    def __init__(self, spec: TableSpec, backend: str = "auto",
+                 version_base: int = 0):
         self.spec = spec
         lib = _build.load_native() if backend in ("auto", "native") else None
         if backend == "native" and lib is None:
             raise RuntimeError("native embedding store requested but unavailable")
         self._store = _NativeStore(spec, lib) if lib is not None else _NumpyStore(spec)
         self.backend = "native" if lib is not None else "numpy"
+        # Push-version counter for client-side caching (PullResponse.version):
+        # bumped AFTER every applied mutation, under its own lock so
+        # concurrent pushes can never lose an increment — "version unchanged
+        # between two reads" must mean "no push completed in between", or a
+        # serving cache would keep an entry a trainer push just made stale.
+        # Starts at base+1: 0 is the wire's "no version info" (legacy
+        # server). ``version_base`` makes version SPACES disjoint across
+        # shard incarnations (PsShard passes epoch << 32): a rescuer's
+        # counter restarting from 1 could otherwise numerically collide
+        # with a pre-crash tag while holding newer rows, and the equality
+        # check would bless a stale cache entry.
+        self._push_version = int(version_base) + 1
+        self._version_mu = threading.Lock()
 
     @property
     def name(self) -> str:
@@ -331,6 +345,18 @@ class EmbeddingTable:
     @property
     def rows(self) -> int:
         return self._store.size()
+
+    @property
+    def push_version(self) -> int:
+        """Monotonic per-table mutation counter. Read it BEFORE pulling
+        rows: apply-then-bump ordering means a concurrent push can only
+        make the tag too OLD (spurious cache invalidation — safe), never
+        too new (a stale row believed fresh)."""
+        return self._push_version
+
+    def _bump_version(self) -> None:
+        with self._version_mu:
+            self._push_version += 1
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         """ids of any shape -> float32 values of shape ``ids.shape + (dim,)``."""
@@ -352,6 +378,7 @@ class EmbeddingTable:
         flat = np.ascontiguousarray(ids.reshape(-1), np.int64)
         g = np.ascontiguousarray(grads.reshape(len(flat), self.spec.dim), np.float32)
         self._store.push(flat, g, scale)
+        self._bump_version()
 
     def export_rows(self) -> Tuple[np.ndarray, np.ndarray]:
         """(ids [n], rows [n, row_width]) — embedding values + optimizer slots."""
@@ -363,3 +390,6 @@ class EmbeddingTable:
                 f"rows width {rows.shape[1:]} != ({self.spec.row_width},)"
             )
         self._store.import_rows(ids, rows)
+        # A restore/migration rewrites row values too — cached copies of
+        # the pre-import rows are just as stale as after a push.
+        self._bump_version()

@@ -38,6 +38,7 @@ from easydl_tpu.core.train_loop import (
     cast_floating,
 )
 from easydl_tpu.ps.client import _PsClientBase, ps_lookup, register_lookup
+from easydl_tpu.ps.read_client import PsReadClient
 from easydl_tpu.ps.table import TableSpec
 from easydl_tpu.utils.logging import get_logger
 
@@ -148,6 +149,11 @@ class PsTrainer(Trainer):
         super().__init__(init_fn, loss_fn, optimizer, config, mesh=mesh,
                          mesh_spec=mesh_spec)
         self.client = client
+        # All pulls ride the shared read client (ps/read_client.py) — the
+        # same facade the serving tier uses, so trainer and server stay on
+        # ONE pull code path. No cache here: a training step must observe
+        # its own (and its peers') pushes, so it reads the tier directly.
+        self.reads = PsReadClient(client)
         self.table = table
         self.ids_key = ids_key
         self.emb_key = emb_key
@@ -223,7 +229,7 @@ class PsTrainer(Trainer):
 
     def train_step(self, state: TrainState, host_batch: Any):
         ids = np.asarray(host_batch[self.ids_key])
-        emb = self.client.pull(self.table.name, ids)
+        emb = self.reads.pull(self.table.name, ids)
         batch = {k: v for k, v in host_batch.items() if k != self.emb_key}
         state, metrics, gemb = self.step_fn(
             state, self.shard_batch(emb), self.shard_batch(batch)
@@ -255,7 +261,7 @@ class PsTrainer(Trainer):
         def fetch():
             b = next(data)
             ids = np.asarray(b[self.ids_key])
-            return b, ids, self.client.pull(self.table.name, ids)
+            return b, ids, self.reads.pull(self.table.name, ids)
 
         metrics = None
         fut = pool.submit(fetch)

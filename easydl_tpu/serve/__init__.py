@@ -1,0 +1,25 @@
+"""Online serving tier: stateless inference frontends over the live PS.
+
+The training side of this repo writes embedding tables through the PS
+push path; this package is the read side the north star promises
+("serve heavy traffic from millions of users"): a batched, jitted
+forward pass whose sparse rows are pulled READ-ONLY from the live PS
+tier through :class:`easydl_tpu.ps.read_client.PsReadClient` — the same
+pull code path the trainer rides, so every wire win (raw_ids, fp16,
+chunked concurrent transfers, stale-route handling) is inherited, never
+reimplemented.
+
+- :mod:`easydl_tpu.serve.cache` — the hot-id client-side embedding
+  cache (byte-bounded LRU, version/generation invalidated).
+- :mod:`easydl_tpu.serve.frontend` — micro-batching request queue with
+  deadline-based admission control, the jitted forward, the
+  ``easydl.Serve`` gRPC service, and the ``easydl_serve_*`` telemetry.
+"""
+
+from easydl_tpu.serve.cache import HotIdCache  # noqa: F401
+from easydl_tpu.serve.frontend import (  # noqa: F401
+    SERVE_SERVICE,
+    InferResult,
+    ServeConfig,
+    ServeFrontend,
+)

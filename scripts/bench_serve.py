@@ -1,0 +1,415 @@
+#!/usr/bin/env python
+"""Serving-tier benchmark: p50/p99 latency, QPS/replica, and the hot-id
+cache win on a Zipf(1.1) id stream — BENCH_SERVE.json, next to
+BENCH_PS.json.
+
+One run drives the SAME deterministic request stream through the full
+serving path (micro-batch queue -> admission control -> PsReadClient pull
+-> jitted DeepFM forward) twice: hot-id cache OFF (every request pays the
+PS pull) and ON (validated cache hits skip the pull; freshness probes are
+zero-id Pulls). Closed-loop driver threads measure end-to-end request
+latency; QPS is completed requests over the timed wall.
+
+Then the part unit tests cannot claim: **stale-read verification under an
+interleaved trainer push**. A trainer client pushes to the hottest ids
+(synchronously — the push is ACKED before we read), and the very next
+read through the serving cache path must be BIT-IDENTICAL to a direct
+cache-bypassing pull. Any mismatch means version invalidation failed and
+the bench exits non-zero.
+
+Shard servers run as subprocesses (like production pods) in the default
+mode; ``--smoke`` swaps in an in-process Local PS and CI-sized counts so
+the whole thing runs in seconds inside tier-1.
+
+    python scripts/bench_serve.py --out BENCH_SERVE.json
+    python scripts/bench_serve.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from easydl_tpu.ps.client import LocalPsClient, ShardedPsClient  # noqa: E402
+from easydl_tpu.ps.read_client import PsReadClient  # noqa: E402
+from easydl_tpu.ps.table import TableSpec  # noqa: E402
+from easydl_tpu.serve import HotIdCache, ServeConfig, ServeFrontend  # noqa: E402
+from easydl_tpu.serve.frontend import make_deepfm_forward  # noqa: E402
+
+TABLE = "serve_emb"
+
+_SERVE_SHARD = r"""
+import sys, time
+from easydl_tpu.ps.server import PsShard
+idx, n, addr_file = sys.argv[1:4]
+shard = PsShard(shard_index=int(idx), num_shards=int(n), backend="numpy")
+server = shard.serve()
+with open(addr_file + ".tmp", "w") as f:
+    f.write(server.address)
+import os as _os
+_os.replace(addr_file + ".tmp", addr_file)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn_shards(n: int, workdir: str):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs, addr_files = [], []
+    for i in range(n):
+        addr_file = os.path.join(workdir, f"shard-{i}.addr")
+        addr_files.append(addr_file)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _SERVE_SHARD, str(i), str(n), addr_file],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+    addrs = []
+    deadline = time.monotonic() + 60
+    for path in addr_files:
+        while not os.path.exists(path):
+            if time.monotonic() > deadline:
+                for p in procs:
+                    p.kill()
+                raise TimeoutError(f"ps shard never published {path}")
+            time.sleep(0.05)
+        with open(path) as f:
+            addrs.append(f.read().strip())
+    return procs, addrs
+
+
+def make_requests(n: int, rows: int, fields: int, dense_dim: int,
+                  vocab: int, zipf_a: float, seed: int):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        ids = (rng.zipf(zipf_a, rows * fields) % vocab).astype(
+            np.int64).reshape(rows, fields)
+        dense = rng.standard_normal((rows, dense_dim)).astype(np.float32)
+        out.append((ids, dense))
+    return out
+
+
+def drive(frontends, requests, threads: int):
+    """Closed-loop driver: `threads` workers pull request indices off one
+    shared counter; retriable sheds back off and re-send (counted), hard
+    errors abort the request (counted)."""
+    lock = threading.Lock()
+    state = {"i": 0, "shed": 0, "errors": 0}
+    latencies = []
+
+    def worker():
+        while True:
+            with lock:
+                i = state["i"]
+                if i >= len(requests):
+                    return
+                state["i"] = i + 1
+            ids, dense = requests[i]
+            fe = frontends[i % len(frontends)]
+            while True:
+                r = fe.infer(ids, dense)
+                if r.ok:
+                    with lock:
+                        latencies.append(r.latency_s)
+                    break
+                if r.retriable:
+                    with lock:
+                        state["shed"] += 1
+                    time.sleep(0.002)
+                    continue
+                with lock:
+                    state["errors"] += 1
+                break
+
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=worker, daemon=True)
+          for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    elapsed = time.monotonic() - t0
+    lat = sorted(latencies)
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
+
+    return {
+        "requests": len(lat),
+        "shed": state["shed"],
+        "errors": state["errors"],
+        "elapsed_s": round(elapsed, 3),
+        "qps": round(len(lat) / elapsed, 1) if elapsed else 0.0,
+        "p50_ms": round(1e3 * pct(0.50), 3),
+        "p99_ms": round(1e3 * pct(0.99), 3),
+    }
+
+
+def pull_path_bench(new_client, make_cache, table: str, vocab: int,
+                    zipf_a: float, ids_per_batch: int, batches: int,
+                    warm: int, seed: int):
+    """The read hot path in isolation: the SAME Zipf id stream through
+    PsReadClient with the cache on vs off, no queue, no forward. This is
+    the cell the ≥2x acceptance gate reads: it measures exactly what the
+    cache governs. (The end-to-end serving cells share one throttled CPU
+    core between driver, jitted forward, and the PS shard subprocesses —
+    common costs that dilute the ratio on this container but not on a
+    deployment where the dense tower runs on an accelerator.)"""
+    rng = np.random.default_rng(seed)
+    stream = [(rng.zipf(zipf_a, ids_per_batch) % vocab).astype(np.int64)
+              for _ in range(warm + batches)]
+    out = {}
+    for mode in ("off", "on"):
+        reads = PsReadClient(new_client(),
+                             cache=make_cache() if mode == "on" else None)
+        try:
+            for ids in stream[:warm]:
+                reads.pull(table, ids)
+            t0 = time.monotonic()
+            for ids in stream[warm:]:
+                reads.pull(table, ids)
+            elapsed = time.monotonic() - t0
+            out[f"cache_{mode}"] = {
+                "batches": batches,
+                "ids_per_batch": ids_per_batch,
+                "elapsed_s": round(elapsed, 3),
+                "batches_per_s": round(batches / elapsed, 1),
+                "ids_per_s": round(batches * ids_per_batch / elapsed, 0),
+            }
+            if mode == "on":
+                stats = reads.cache.stats()
+                out["cache_on"]["hit_ratio"] = round(stats["hit_ratio"], 4)
+        finally:
+            if hasattr(reads.client, "close"):
+                reads.client.close()
+    out["speedup"] = round(out["cache_on"]["batches_per_s"]
+                           / max(out["cache_off"]["batches_per_s"], 1e-9), 2)
+    return out
+
+
+def stale_check(reads, bypass, table: str, dim: int, hot_ids: np.ndarray,
+                pushes: int, seed: int):
+    """Interleaved trainer pushes vs the serving cache path: after each
+    ACKED push the cache path must return bit-identical rows to a direct
+    cache-bypassing pull. This is the bench-level proof of the version
+    invalidation contract."""
+    rng = np.random.default_rng(seed)
+    mismatches = 0
+    reads.pull(table, hot_ids)  # make sure the ids are cached (hot)
+    for _ in range(pushes):
+        grads = rng.standard_normal((len(hot_ids), dim)).astype(np.float32)
+        bypass.push(table, hot_ids, grads, scale=0.5)  # sync => acked
+        via_cache = reads.pull(table, hot_ids)
+        direct = bypass.pull(table, hot_ids)
+        if not np.array_equal(via_cache, direct):
+            mismatches += 1
+    return {"pushes": pushes, "ids_per_read": int(len(hot_ids)),
+            "mismatches": mismatches}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="serving-tier benchmark")
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="serving frontends (own read client + cache each)")
+    ap.add_argument("--threads", type=int, default=4,
+                    help="closed-loop driver threads")
+    ap.add_argument("--requests", type=int, default=1200,
+                    help="requests per cache mode")
+    ap.add_argument("--warm", type=int, default=120,
+                    help="untimed warm-up requests per mode")
+    ap.add_argument("--rows", type=int, default=32,
+                    help="examples per request")
+    ap.add_argument("--fields", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=256,
+                    help="embedding dim (production serving shape; the "
+                         "pull payload must be the bottleneck for the "
+                         "cache comparison to mean anything)")
+    ap.add_argument("--dense-dim", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=16_000,
+                    help="id universe; the hot set must fit the cache — "
+                         "that IS the serving scenario the cache exists "
+                         "for")
+    ap.add_argument("--zipf-a", type=float, default=1.1)
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--cache-mb", type=int, default=64)
+    ap.add_argument("--stale-pushes", type=int, default=5)
+    ap.add_argument("--pull-ids", type=int, default=4096,
+                    help="ids per batch in the isolated read-path cell "
+                         "(the coalesced server-side batch shape: several "
+                         "requests' worth)")
+    ap.add_argument("--fp16", action="store_true",
+                    help="per-client fp16 pulls on the serving clients "
+                         "(constructor opt-in; the trainer env is never "
+                         "touched)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized: in-process Local PS, seconds")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.shards = 2
+        args.requests = 80
+        args.warm = 16
+        args.rows = 16
+        args.fields = 8
+        args.dim = 16
+        args.vocab = 3000
+        args.threads = 2
+        args.stale_pushes = 3
+
+    workdir = tempfile.mkdtemp(prefix="bench-serve-")
+    procs, addrs = ([], [])
+    if not args.smoke:
+        procs, addrs = _spawn_shards(args.shards, workdir)
+
+    trainer_client = (LocalPsClient(num_shards=args.shards) if args.smoke
+                      else ShardedPsClient(addrs, timeout=30.0))
+
+    def new_client():
+        if args.smoke:
+            # One in-process PS tier, many clients: serving clients share
+            # the trainer's shard objects (a LocalPsClient owns its
+            # shards, and a second instance would be a different tier).
+            c = LocalPsClient(num_shards=args.shards)
+            c.shards = trainer_client.shards
+            return c
+        return ShardedPsClient(addrs, timeout=30.0, pull_fp16=args.fp16)
+
+    spec = TableSpec(name=TABLE, dim=args.dim, optimizer="adagrad",
+                     seed=3, lr=0.05)
+    trainer_client.create_table(spec)
+    # Seed the table so serving reads hit materialised rows.
+    seed_rng = np.random.default_rng(args.seed)
+    seed_ids = np.arange(args.vocab, dtype=np.int64)
+    trainer_client.push(
+        TABLE, seed_ids,
+        seed_rng.standard_normal((args.vocab, args.dim)).astype(np.float32),
+        scale=0.1)
+
+    forward = make_deepfm_forward(args.fields, args.dim, args.dense_dim,
+                                  hidden=(32,), max_batch=args.max_batch,
+                                  seed=args.seed)
+    requests = make_requests(args.requests, args.rows, args.fields,
+                             args.dense_dim, args.vocab, args.zipf_a,
+                             args.seed)
+    warm = requests[:args.warm]
+    cfg = ServeConfig(table=TABLE, fields=args.fields,
+                      dense_dim=args.dense_dim, max_batch=args.max_batch,
+                      max_wait_ms=args.max_wait_ms)
+
+    results = {}
+    stale = None
+    try:
+        for mode in ("cache_off", "cache_on"):
+            cache_on = mode == "cache_on"
+            frontends = []
+            for r in range(args.replicas):
+                reads = PsReadClient(
+                    new_client(),
+                    cache=(HotIdCache(args.cache_mb << 20)
+                           if cache_on else None))
+                frontends.append(ServeFrontend(
+                    reads, cfg, forward=forward, name=f"serve-{r}"))
+            try:
+                drive(frontends, warm, args.threads)  # warm (and compile)
+                res = drive(frontends, requests, args.threads)
+                res["qps_per_replica"] = round(
+                    res["qps"] / max(args.replicas, 1), 1)
+                if cache_on:
+                    stats = frontends[0].reads.cache.stats()
+                    res["cache"] = stats
+                    res["hit_ratio"] = round(stats["hit_ratio"], 4)
+                    hot = np.unique(np.concatenate(
+                        [ids.reshape(-1) for ids, _ in requests[:8]]))[:256]
+                    stale = stale_check(frontends[0].reads, trainer_client,
+                                        TABLE, args.dim, hot,
+                                        args.stale_pushes, args.seed + 1)
+                else:
+                    res["hit_ratio"] = 0.0
+                results[mode] = res
+            finally:
+                for fe in frontends:
+                    fe.stop()
+                    if fe.reads.client is not trainer_client:
+                        close = getattr(fe.reads.client, "close", None)
+                        if close:
+                            close()
+        results["pull_path"] = pull_path_bench(
+            new_client, lambda: HotIdCache(args.cache_mb << 20), TABLE,
+            args.vocab, args.zipf_a,
+            ids_per_batch=(512 if args.smoke else args.pull_ids),
+            batches=(30 if args.smoke else 200),
+            warm=(10 if args.smoke else 40), seed=args.seed + 2)
+    finally:
+        for p in procs:
+            p.kill()
+
+    e2e_speedup = (results["cache_on"]["qps"]
+                   / max(results["cache_off"]["qps"], 1e-9))
+    read_speedup = results["pull_path"]["speedup"]
+    doc = {
+        "bench": "serve",
+        "host": {
+            "platform": platform.platform(),
+            "python": sys.version.split()[0],
+            "cpus": os.cpu_count(),
+        },
+        "config": {
+            k: getattr(args, k) for k in (
+                "shards", "replicas", "threads", "requests", "rows",
+                "fields", "dim", "dense_dim", "vocab", "zipf_a",
+                "max_batch", "max_wait_ms", "cache_mb", "fp16", "smoke",
+                "seed")
+        },
+        "results": results,
+        "speedup_qps_e2e": round(e2e_speedup, 2),
+        "speedup_read_path": read_speedup,
+        "stale_check": stale,
+        "acceptance": {
+            # The gate reads the ISOLATED read path (what the cache
+            # governs); the e2e ratio is reported alongside — on this
+            # 1-core container the jitted forward and the PS shard
+            # subprocesses share the driver's core, a dilution a real
+            # deployment (accelerator-hosted tower) does not have.
+            "cache_speedup_ge_2x": read_speedup >= 2.0,
+            "e2e_speedup_qps": round(e2e_speedup, 2),
+            "zero_stale_reads": bool(stale and stale["mismatches"] == 0),
+            "zero_hard_errors": all(
+                r.get("errors", 0) == 0 for r in results.values()),
+        },
+    }
+    text = json.dumps(doc, indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.out}")
+    print(text)
+    if stale is None or stale["mismatches"]:
+        print("STALE READS DETECTED — version invalidation failed",
+              file=sys.stderr)
+        return 1
+    if any(r.get("errors", 0) for r in results.values()):
+        print("hard request errors during the bench", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,8 @@ trap 'rm -f "$LOG"' EXIT
 env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
     --scenario worker_kill --scenario master_crash \
     --scenario ps_shard_crash_zero_loss \
-    --scenario ps_reshard_under_fire --keep-workdir "$@" \
+    --scenario ps_reshard_under_fire \
+    --scenario serve_during_reshard --keep-workdir "$@" \
     2>&1 | tee "$LOG"
 
 # Verdict files from THIS run (chaos_run prints "PASS <name> ... -> <path>").
@@ -66,6 +67,28 @@ assert tail >= 1, (
     "tail-replay path was never exercised")
 print(f"reshard OK: {len(migrations)} migration(s), {rows} rows "
       f"migrated, {tail} tail pushes replayed")
+PY
+        ;;
+    *serve_during_reshard*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+sv = doc["zero_loss"]["serve"]
+stale = sv.get("stale_check") or {}
+assert sv.get("requests", 0) >= 50 and sv.get("ok", 0) >= 1, (
+    f"{sys.argv[1]}: serving replica answered {sv.get('requests', 0)} "
+    "requests — the tier was never under serving load, the pass is "
+    "vacuous")
+assert sv.get("hard_failures", -1) == 0, (
+    f"{sys.argv[1]}: {sv.get('hard_failures')} HARD request failures "
+    f"during the live split (samples: {sv.get('failure_samples')})")
+assert stale.get("ids_checked", 0) > 0 and stale.get("stale_rows", -1) == 0, (
+    f"{sys.argv[1]}: stale-read check examined "
+    f"{stale.get('ids_checked', 0)} ids and found "
+    f"{stale.get('stale_rows')} stale — the hot cache served rows the "
+    "migration or a trainer push had already replaced")
+print(f"serve OK: {sv['requests']} requests, 0 hard failures, "
+      f"{stale['ids_checked']} ids bit-verified post-split")
 PY
         ;;
     esac

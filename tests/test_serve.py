@@ -1,0 +1,574 @@
+"""Serving tier: hot-id cache invalidation contract, micro-batch queue
+semantics, the shared read client, the Serve gRPC surface, per-client
+fp16, the shared dims cache, and the replica scale policy.
+
+The invalidation tests are the tier-1 face of the `serve_during_reshard`
+chaos drill: same contract (a cached row is never served past a trainer
+push or a routing-generation flip), in-process servers instead of pods.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from easydl_tpu.controller.reconciler import serve_scale_decision
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps import registry, reshard
+from easydl_tpu.ps.client import LocalPsClient, PullVersions, ShardedPsClient
+from easydl_tpu.ps.read_client import PsReadClient
+from easydl_tpu.ps.server import PS_SERVICE, PsShard
+from easydl_tpu.ps.table import TableSpec, shard_of
+from easydl_tpu.serve import HotIdCache, ServeConfig, ServeFrontend
+from easydl_tpu.serve.frontend import SERVE_SERVICE, OVERLOADED
+from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, RpcClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spec(**kw):
+    kw.setdefault("name", "emb")
+    kw.setdefault("dim", 8)
+    kw.setdefault("optimizer", "sgd")
+    kw.setdefault("lr", 0.1)
+    kw.setdefault("seed", 3)
+    return TableSpec(**kw)
+
+
+def _ids(*vals):
+    return np.asarray(vals, np.int64)
+
+
+# ------------------------------------------------------------ hot-id cache
+class TestHotIdCache:
+    def _put(self, cache, ids, dim=8, shard=0, version=1, table="emb"):
+        ids = np.asarray(ids, np.int64)
+        cache.put(table, ids, np.ones((len(ids), dim), np.float32),
+                  np.full(len(ids), shard, np.int32),
+                  np.full(len(ids), version, np.uint64))
+
+    def test_byte_bound_holds_and_evicts_lru(self):
+        from easydl_tpu.serve.cache import ENTRY_OVERHEAD_BYTES
+
+        row_cost = 8 * 4 + ENTRY_OVERHEAD_BYTES
+        cache = HotIdCache(max_bytes=8 * row_cost)
+        cache.set_generation(0)
+        self._put(cache, range(8))
+        assert cache.entries == 8
+        # Touch ids 0..3 (newer tick), then overflow: the UNTOUCHED half
+        # must be the evicted half.
+        cache.lookup("emb", _ids(0, 1, 2, 3))
+        self._put(cache, range(100, 104))
+        assert cache.bytes <= 8 * row_cost
+        assert cache.evictions >= 4
+        slots, _, _ = cache.lookup("emb", _ids(0, 1, 2, 3))
+        assert (slots >= 0).all(), "recently-used entries were evicted"
+        slots, _, _ = cache.lookup("emb", _ids(4, 5, 6, 7))
+        assert (slots < 0).all(), "LRU entries survived the byte bound"
+
+    def test_generation_change_drops_everything(self):
+        cache = HotIdCache(max_bytes=1 << 20)
+        cache.set_generation(0)
+        self._put(cache, range(16))
+        assert not cache.set_generation(0)  # unchanged: keep
+        assert cache.entries == 16
+        assert cache.set_generation(1)      # reshard committed: drop all
+        assert cache.entries == 0
+        assert cache.invalidations == 16
+
+    def test_put_overwrites_in_place(self):
+        cache = HotIdCache(max_bytes=1 << 20)
+        cache.set_generation(0)
+        self._put(cache, [5], version=1)
+        self._put(cache, [5], version=2)
+        assert cache.entries == 1
+        _, _, versions = cache.lookup("emb", _ids(5))
+        assert versions[0] == 2
+
+    def test_demote_moves_hit_to_miss(self):
+        cache = HotIdCache(max_bytes=1 << 20)
+        cache.set_generation(0)
+        self._put(cache, [1, 2])
+        slots, _, _ = cache.lookup("emb", _ids(1, 2))
+        cache.demote("emb", _ids(1, 2), slots)
+        assert cache.hits == 0 and cache.misses == 2
+        assert cache.entries == 0
+
+
+# ----------------------------------------------- read client invalidation
+class TestReadClientInvalidation:
+    def _tier(self, shards=2, dim=8):
+        client = LocalPsClient(num_shards=shards)
+        client.create_table(spec(dim=dim))
+        reads = PsReadClient(client, cache=HotIdCache(1 << 20))
+        return client, reads
+
+    def test_push_epoch_invalidation(self):
+        """The contract the ISSUE names: a serving replica never returns
+        a stale row after a trainer push — the push bumps the shard's
+        table version and the next validated read re-pulls."""
+        client, reads = self._tier()
+        ids = np.arange(40, dtype=np.int64)
+        before = reads.pull("emb", ids)
+        assert np.array_equal(before, reads.pull("emb", ids))
+        assert reads.counters["hits"] == 40  # fully cache-served
+        client.push("emb", ids, np.ones((40, 8), np.float32))
+        after = reads.pull("emb", ids)
+        assert np.array_equal(after, client.pull("emb", ids))
+        assert not np.array_equal(after, before)
+        assert reads.counters["demoted"] == 40
+
+    def test_partial_shard_push_invalidates_only_that_shard(self):
+        client, reads = self._tier(shards=2)
+        ids = np.arange(64, dtype=np.int64)
+        owner = shard_of(ids, 2)
+        reads.pull("emb", ids)
+        # Push ONLY to shard-0-owned ids: shard 1's entries stay valid.
+        s0 = ids[owner == 0]
+        client.push("emb", s0, np.ones((len(s0), 8), np.float32))
+        reads.pull("emb", ids)
+        assert reads.counters["demoted"] == len(s0)
+        assert np.array_equal(reads.pull("emb", ids),
+                              client.pull("emb", ids))
+
+    def test_import_rows_invalidates(self):
+        """A restore/migration import rewrites values without a push —
+        the version must still move (the reshard drill depends on it)."""
+        client, reads = self._tier(shards=1)
+        ids = _ids(1, 2, 3)
+        reads.pull("emb", ids)
+        t = client.shards[0].table("emb")
+        t.import_rows(ids, np.full((3, 8), 7.0, np.float32))
+        got = reads.pull("emb", ids)
+        assert np.array_equal(got, np.full((3, 8), 7.0, np.float32))
+
+    def test_no_cache_is_passthrough(self):
+        client = LocalPsClient(num_shards=2)
+        client.create_table(spec())
+        reads = PsReadClient(client)
+        ids = np.arange(10, dtype=np.int64).reshape(2, 5)
+        assert np.array_equal(reads.pull("emb", ids),
+                              client.pull("emb", ids))
+        assert reads.counters["batches"] == 0
+
+    def test_probe_throttle_allows_bounded_staleness(self):
+        client = LocalPsClient(num_shards=1)
+        client.create_table(spec())
+        reads = PsReadClient(client, cache=HotIdCache(1 << 20),
+                             max_probe_age_s=30.0)
+        ids = _ids(1, 2, 3)
+        reads.pull("emb", ids)
+        reads.pull("emb", ids)
+        probes_before = reads.counters["probes"]
+        stale = reads.pull("emb", ids)
+        assert reads.counters["probes"] == probes_before
+        # Within the probe window a push MAY be missed (the documented
+        # trade) — strict mode (default 0) is what the drills verify.
+        client.push("emb", ids, np.ones((3, 8), np.float32))
+        assert np.array_equal(stale, reads.pull("emb", ids))
+
+
+# -------------------------------------- generation flip on a live reshard
+class _Cluster:
+    """In-process gRPC shard servers published to a real registry (the
+    test_ps_reshard idiom, trimmed to what the cache tests need)."""
+
+    def __init__(self, workdir: str):
+        self.workdir = workdir
+        self.live = []
+
+    def start_set(self, num_shards, generation=0, prefix="src"):
+        for i in range(num_shards):
+            epoch = registry.bump_epoch(self.workdir, i)
+            shard = PsShard(
+                shard_index=i, num_shards=num_shards, epoch=epoch,
+                wal_root=os.path.join(self.workdir, "ps-wal", f"shard-{i}"),
+                workdir=self.workdir,
+                rescue_dir=os.path.join(self.workdir, "ps-ckpt"),
+                route_generation=generation,
+            )
+            server = shard.serve()
+            registry.publish(self.workdir, f"{prefix}-{num_shards}-{i}", i,
+                             num_shards, server.address, epoch=epoch,
+                             generation=generation)
+            self.live.append((shard, server))
+
+    def ensure_destinations(self, plan):
+        self.start_set(int(plan["to_shards"]),
+                       generation=int(plan["generation"]),
+                       prefix=f"dst-g{plan['generation']}")
+
+    def stop(self):
+        for shard, _server in self.live:
+            shard.stop()
+        self.live.clear()
+
+
+def test_routing_generation_invalidation_across_live_reshard(tmp_path):
+    """A serving replica's cache rides a live 2→4 split: the committed
+    routing generation drops every entry, and post-split reads are
+    bit-identical to a fresh client on the new shard set — including
+    rows a trainer push changed mid-migration."""
+    w = str(tmp_path)
+    cluster = _Cluster(w)
+    cluster.start_set(2)
+    writer = ShardedPsClient.from_registry(w, 2, timeout=5.0,
+                                           drain_retry_s=60.0,
+                                           transient_retry_s=30.0)
+    serving = ShardedPsClient.from_registry(w, 2, timeout=5.0,
+                                            drain_retry_s=60.0,
+                                            transient_retry_s=30.0)
+    reads = PsReadClient(serving, cache=HotIdCache(1 << 20))
+    try:
+        writer.create_table(spec(optimizer="adagrad", lr=0.05))
+        rng = np.random.default_rng(11)
+        ids = np.arange(600, dtype=np.int64)
+        writer.push("emb", ids, rng.standard_normal((600, 8)).astype(
+            np.float32), scale=0.5)
+        writer.save(os.path.join(w, "ps-ckpt"), step=1)  # rescue lineage
+        before = reads.pull("emb", ids)
+        assert reads.cache.generation == 0
+        assert np.array_equal(before, reads.pull("emb", ids))
+
+        summary = reshard.run_reshard(
+            w, 4, "test-serve",
+            ensure_destinations=cluster.ensure_destinations,
+            rpc_timeout=5.0, phase_timeout_s=60.0, dest_wait_s=30.0)
+        assert summary["committed_routing"]["num_shards"] == 4
+        # A trainer push lands on the NEW shard set...
+        writer.push("emb", ids, rng.standard_normal((600, 8)).astype(
+            np.float32), scale=0.5)
+        # ...and the serving cache path must converge: generation flip
+        # drops the cache, the re-pull routes by the new partition.
+        after = reads.pull("emb", ids)
+        assert reads.cache.generation == 1
+        assert serving.num_shards == 4
+        fresh = ShardedPsClient.from_registry(w, timeout=5.0)
+        try:
+            assert np.array_equal(after, fresh.pull("emb", ids))
+        finally:
+            fresh.close()
+        assert not np.array_equal(after, before)
+    finally:
+        reads.client.close()
+        writer.close()
+        cluster.stop()
+
+
+# ----------------------------------------------------- micro-batch queue
+class TestBatchQueue:
+    def _frontend(self, forward=None, **cfg_kw):
+        client = LocalPsClient(num_shards=1)
+        client.create_table(spec(dim=4))
+        reads = PsReadClient(client, cache=HotIdCache(1 << 20))
+        cfg_kw.setdefault("table", "emb")
+        cfg_kw.setdefault("fields", 2)
+        cfg_kw.setdefault("dense_dim", 0)
+        fe = ServeFrontend(reads, ServeConfig(**cfg_kw), forward=forward)
+        return fe
+
+    def test_max_wait_deadline_honored(self):
+        """A lone request must leave the queue at ~max_wait, not wait for
+        a full batch."""
+        fe = self._frontend(max_batch=1024, max_wait_ms=40.0)
+        try:
+            t0 = time.monotonic()
+            r = fe.infer(np.arange(2, dtype=np.int64).reshape(1, 2))
+            elapsed = time.monotonic() - t0
+            assert r.ok
+            assert 0.02 <= elapsed < 2.0, elapsed
+            assert fe.recent_batches[-1] == (1,)
+        finally:
+            fe.stop()
+
+    def test_shed_past_depth_bound_is_retriable(self):
+        gate = threading.Event()
+
+        def slow_forward(emb, dense):
+            gate.wait(10.0)
+            return emb.reshape(len(emb), -1).sum(1)
+
+        fe = self._frontend(forward=slow_forward, max_batch=4,
+                            max_wait_ms=1.0, max_pending=8)
+        try:
+            results = []
+            threads = [
+                threading.Thread(target=lambda: results.append(
+                    fe.infer(np.arange(8, dtype=np.int64).reshape(4, 2))))
+                for _ in range(6)
+            ]
+            for t in threads:
+                t.start()
+                time.sleep(0.05)  # let the runner claim the first batch
+            gate.set()
+            for t in threads:
+                t.join(timeout=30.0)
+            shed = [r for r in results if not r.ok]
+            served = [r for r in results if r.ok]
+            assert shed, "queue never shed past the bound"
+            assert served, "everything shed — the bound is broken"
+            for r in shed:
+                assert r.retriable
+                assert r.verdict.startswith(OVERLOADED)
+        finally:
+            gate.set()
+            fe.stop()
+
+    def test_batch_order_deterministic_fifo(self):
+        gate = threading.Event()
+
+        def slow_forward(emb, dense):
+            gate.wait(10.0)
+            return emb.reshape(len(emb), -1).sum(1)
+
+        fe = self._frontend(forward=slow_forward, max_batch=4,
+                            max_wait_ms=1.0, max_pending=1024)
+        try:
+            threads = []
+            for _ in range(8):
+                t = threading.Thread(
+                    target=fe.infer,
+                    args=(np.arange(2, dtype=np.int64).reshape(1, 2),))
+                t.start()
+                time.sleep(0.03)  # serialize arrival order
+                threads.append(t)
+            gate.set()
+            for t in threads:
+                t.join(timeout=30.0)
+            order = [s for batch in fe.recent_batches for s in batch]
+            assert order == sorted(order), (
+                "requests ran out of arrival order: "
+                f"{list(fe.recent_batches)}")
+        finally:
+            gate.set()
+            fe.stop()
+
+    def test_scores_map_back_to_their_requests(self):
+        fe = self._frontend(max_batch=64, max_wait_ms=20.0)
+        try:
+            client = fe.reads.client
+            results = {}
+
+            def one(tag, ids):
+                results[tag] = (ids, fe.infer(ids))
+
+            threads = [
+                threading.Thread(target=one, args=(
+                    i, np.asarray([[2 * i, 2 * i + 1]], np.int64)))
+                for i in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30.0)
+            for tag, (ids, r) in results.items():
+                assert r.ok
+                expected = client.pull("emb", ids).reshape(1, -1).sum(1)
+                assert np.allclose(r.scores, expected), tag
+        finally:
+            fe.stop()
+
+
+# ------------------------------------------------------------ gRPC surface
+def test_frontend_grpc_infer_roundtrip():
+    client = LocalPsClient(num_shards=1)
+    client.create_table(spec(dim=4))
+    reads = PsReadClient(client, cache=HotIdCache(1 << 20))
+    fe = ServeFrontend(
+        reads, ServeConfig(table="emb", fields=3, dense_dim=2,
+                           max_batch=32, max_wait_ms=5.0))
+    server = fe.serve()
+    rpc = RpcClient(SERVE_SERVICE, f"localhost:{server.port}",
+                    timeout=30.0, options=GRPC_MSG_OPTIONS)
+    try:
+        ids = np.arange(6, dtype=np.int64)
+        dense = np.ones((2, 2), np.float32)
+        resp = rpc.Infer(pb.InferRequest(
+            raw_ids=ids.astype("<i8").tobytes(), fields=3,
+            dense=dense.tobytes(), dense_dim=2))
+        assert resp.ok, resp.verdict
+        scores = np.frombuffer(resp.scores, "<f4")
+        direct = client.pull("emb", ids.reshape(2, 3))
+        expected = direct.reshape(2, -1).sum(1) + dense.sum(1)
+        assert np.allclose(scores, expected)
+        # malformed: ids not divisible by fields — a verdict, not a crash
+        bad = rpc.Infer(pb.InferRequest(
+            raw_ids=ids[:5].astype("<i8").tobytes(), fields=3))
+        assert not bad.ok and bad.verdict.startswith("error")
+    finally:
+        rpc.close()
+        fe.stop()
+
+
+# ---------------------------------------------- wire version + per-client
+class _OneShard:
+    def __enter__(self):
+        self.shard = PsShard(shard_index=0, num_shards=1)
+        self.server = self.shard.serve()
+        self.addr = self.server.address
+        return self
+
+    def __exit__(self, *exc):
+        self.shard.stop()
+
+
+def test_pull_response_carries_push_version():
+    with _OneShard() as s:
+        s.shard.create_table(spec(dim=4))
+        rpc = RpcClient(PS_SERVICE, s.addr, timeout=10.0,
+                        options=GRPC_MSG_OPTIONS)
+        try:
+            ids = np.arange(3, dtype=np.int64)
+            r1 = rpc.Pull(pb.PullRequest(
+                table="emb", raw_ids=ids.astype("<i8").tobytes()))
+            assert r1.version == 1  # fresh table starts at 1 (0 = legacy)
+            probe = rpc.Pull(pb.PullRequest(table="emb"))  # zero-id probe
+            assert probe.version == r1.version
+            assert len(probe.values) == 0
+            s.shard.table("emb").push(ids, np.ones((3, 4), np.float32))
+            r2 = rpc.Pull(pb.PullRequest(
+                table="emb", raw_ids=ids.astype("<i8").tobytes()))
+            assert r2.version == r1.version + 1
+            st = rpc.Stats(pb.PsStatsRequest())
+            assert st.tables[0].version == r2.version
+        finally:
+            rpc.close()
+
+
+def test_fp16_is_a_per_client_opt_in(monkeypatch):
+    """The serving replica opts into fp16 pulls via the CONSTRUCTOR; the
+    process env (the trainer's) is never consulted or mutated."""
+    monkeypatch.delenv("EASYDL_PS_PULL_FP16", raising=False)
+    with _OneShard() as s:
+        s.shard.create_table(spec(dim=4))
+        ids = np.arange(8, dtype=np.int64)
+        s.shard.table("emb").push(
+            ids, np.random.default_rng(0).standard_normal(
+                (8, 4)).astype(np.float32))
+        c32 = ShardedPsClient([s.addr], timeout=10.0)
+        c16 = ShardedPsClient([s.addr], timeout=10.0, pull_fp16=True)
+        try:
+            full = c32.pull("emb", ids)
+            half = c16.pull("emb", ids)
+            assert c16.pull_fp16 and not c32.pull_fp16
+            assert "EASYDL_PS_PULL_FP16" not in os.environ
+            assert np.array_equal(
+                half, full.astype("<f2").astype(np.float32))
+        finally:
+            c32.close()
+            c16.close()
+
+
+def test_dims_cache_shared_across_clients_of_one_cluster(tmp_path):
+    """Satellite: a second client to the same registry-identified cluster
+    must not re-probe Stats for table dims — the process already knows
+    them. Registry-less clients keep PRIVATE dims (ephemeral ports can
+    recycle across cluster lifetimes in one process)."""
+    w = str(tmp_path)
+    cluster = _Cluster(w)
+    cluster.start_set(1)
+    first = ShardedPsClient.from_registry(w, 1, timeout=10.0)
+    try:
+        first.create_table(spec(dim=8))
+        second = ShardedPsClient.from_registry(w, 1, timeout=10.0)
+        try:
+            # Sever the probe path entirely: a shared-dims hit needs no
+            # Stats round trip.
+            second._lookup_dim = None  # type: ignore[assignment]
+            out = second.pull("emb", np.zeros((0,), np.int64))
+            assert out.shape == (0, 8)
+        finally:
+            second.close()
+        third = ShardedPsClient([cluster.live[0][1].address], timeout=10.0)
+        try:
+            assert third._dims == {}
+            assert third._dims is not first._dims
+        finally:
+            third.close()
+    finally:
+        first.close()
+        cluster.stop()
+
+
+def test_version_collector_records_per_shard_minimum():
+    v = PullVersions()
+    v.record(0, 5)
+    v.record(0, 3)   # older chunk wins: the only safe tag
+    v.record(1, 7)
+    v.record(1, 0)   # legacy server: never recorded
+    assert v.versions == {0: 3, 1: 7}
+    assert v.complete
+    v.invalidate()
+    assert not v.complete
+
+
+# ------------------------------------------------------- replica policy
+class TestServeScaleDecision:
+    def test_scales_up_on_qps_pressure(self):
+        got = serve_scale_decision({"a": 900.0, "b": 950.0},
+                                   {"a": 0.01, "b": 0.012},
+                                   target_qps=500.0)
+        assert got == 4  # ceil(1850/500)
+
+    def test_scales_up_on_p99_even_under_qps_target(self):
+        got = serve_scale_decision({"a": 100.0, "b": 100.0},
+                                   {"a": 0.02, "b": 0.30},
+                                   target_qps=500.0, p99_budget_s=0.05)
+        assert got == 3  # queueing started: +1 beats the qps math
+
+    def test_steady_state_returns_none(self):
+        assert serve_scale_decision({"a": 400.0}, {"a": 0.01},
+                                    target_qps=500.0) is None
+
+    def test_scale_down_needs_headroom_and_quiet_p99(self):
+        # 3 replicas at 100 qps total, p99 tiny: shrink by one.
+        assert serve_scale_decision(
+            {"a": 30.0, "b": 40.0, "c": 30.0},
+            {"a": 0.001, "b": 0.001, "c": 0.001},
+            target_qps=500.0) == 2
+        # same load but one replica's p99 is hot: DON'T shrink
+        assert serve_scale_decision(
+            {"a": 30.0, "b": 40.0, "c": 30.0},
+            {"a": 0.001, "b": 0.030, "c": 0.001},
+            target_qps=500.0, p99_budget_s=0.05) is None
+
+    def test_clamps_and_floors(self):
+        assert serve_scale_decision({"a": 1e9}, {"a": 1.0},
+                                    target_qps=500.0,
+                                    max_replicas=8) == 8
+        assert serve_scale_decision({"a": 0.0}, {"a": 0.0},
+                                    target_qps=500.0,
+                                    min_replicas=1) is None
+        assert serve_scale_decision({}, {}) is None
+
+
+# ---------------------------------------------------------- bench smoke
+def test_bench_serve_smoke(tmp_path):
+    """The CI face of BENCH_SERVE.json: in-process PS, tiny model, and —
+    non-negotiable even at smoke size — zero stale reads under the
+    interleaved trainer push."""
+    out = tmp_path / "bench_serve.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serve.py"),
+         "--smoke", "--out", str(out)],
+        cwd=REPO, capture_output=True, text=True, timeout=560,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-2000:]
+    import json
+
+    doc = json.loads(out.read_text())
+    for mode in ("cache_off", "cache_on"):
+        r = doc["results"][mode]
+        assert r["requests"] > 0 and r["errors"] == 0
+        assert r["p99_ms"] >= r["p50_ms"] > 0
+    assert doc["results"]["cache_on"]["hit_ratio"] > 0.2
+    assert doc["stale_check"]["mismatches"] == 0
+    assert doc["acceptance"]["zero_stale_reads"]
+    assert "pull_path" in doc["results"]
