@@ -1,0 +1,20 @@
+"""easylint: AST-based repo-invariant analysis for easydl_tpu.
+
+The framework's correctness disciplines — WAL-then-apply under the
+ordering lock (PR 6), RPCs only through the instrumented seam (PRs 1/5),
+declared EASYDL_* knobs, counted error swallows, virtual-clock-pure
+policy modules (PR 8), easydl_* metric conventions (PRs 1/9) — enforced
+mechanically instead of by review vigilance. See
+``docs/design/static-analysis.md`` for the rule catalog and
+``scripts/easylint.py`` for the CLI; the tier-1 gate lives in
+``tests/test_easylint.py``.
+"""
+
+from easydl_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Rule,
+    analyze_file,
+    analyze_paths,
+    collect_files,
+)
+from easydl_tpu.analysis.rules import all_rules  # noqa: F401

@@ -1,0 +1,125 @@
+"""easylint baseline: the committed allowlist for grandfathered findings.
+
+Format — one pipe-separated line per allowlisted finding, sorted, unique::
+
+    rule|path|scope|detail|reason
+
+The reason string is MANDATORY (docs/operations.md): an allowlist entry
+without a stated justification is indistinguishable from "we gave up", and
+the reviewer of a baseline diff must be able to judge the justification
+without archaeology. ``--update-baseline`` preserves existing reasons,
+stamps new entries with a TODO marker the gate rejects, and drops stale
+entries — so the committed file can only shrink unless a human writes a
+reason for the growth.
+
+Matching is a multiset over ``(rule, path, scope, detail)``: the driver
+already disambiguates repeated identities (core._disambiguate), so one
+baseline line consumes exactly one finding.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+from easydl_tpu.analysis.core import Finding
+
+#: Stamped on entries --update-baseline had no reason for; the gate fails
+#: while any entry still carries it — baselining requires a human reason.
+TODO_REASON = "TODO(easylint): justify this allowlist entry or fix the site"
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    path: str
+    scope: str
+    detail: str
+    reason: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.scope, self.detail)
+
+    def render(self) -> str:
+        return "|".join((self.rule, self.path, self.scope, self.detail,
+                         self.reason))
+
+
+def parse_line(line: str, lineno: int = 0) -> BaselineEntry:
+    parts = line.split("|", 4)
+    if len(parts) != 5 or not all(p.strip() for p in parts):
+        raise ValueError(
+            f"baseline line {lineno}: expected "
+            f"'rule|path|scope|detail|reason' with a non-empty reason, "
+            f"got {line!r}")
+    rule, path, scope, detail, reason = (p.strip() for p in parts)
+    return BaselineEntry(rule, path, scope, detail, reason)
+
+
+def load(path: str) -> List[BaselineEntry]:
+    """Missing file == empty baseline (a fresh checkout of a clean tree
+    needs no allowlist). Malformed lines raise — a corrupt allowlist must
+    not silently admit findings."""
+    if not os.path.exists(path):
+        return []
+    entries: List[BaselineEntry] = []
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            entries.append(parse_line(line, i))
+    return entries
+
+
+def save(path: str, entries: Sequence[BaselineEntry]) -> None:
+    """Sorted + deduped on write, so baseline diffs stay reviewable no
+    matter what order the entries were produced in."""
+    lines = sorted({e.render() for e in entries})
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# easylint baseline — grandfathered findings. One line per\n"
+                "# finding: rule|path|scope|detail|reason. The reason is\n"
+                "# mandatory; see docs/operations.md#easylint. Regenerate\n"
+                "# with: python scripts/easylint.py --update-baseline\n")
+        for line in lines:
+            f.write(line + "\n")
+
+
+def match(findings: Sequence[Finding], entries: Sequence[BaselineEntry],
+          ) -> Tuple[List[Finding], List[BaselineEntry]]:
+    """Split into (new findings, stale entries). Baselined findings are
+    consumed one-for-one; a stale entry means the violation it allowlisted
+    is gone and the line should be deleted (run --update-baseline)."""
+    budget: Dict[Tuple[str, str, str, str], int] = {}
+    for e in entries:
+        budget[e.key()] = budget.get(e.key(), 0) + 1
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            new.append(f)
+    stale: List[BaselineEntry] = []
+    for e in entries:  # leftover budget == entries no finding consumed
+        if budget.get(e.key(), 0) > 0:
+            budget[e.key()] -= 1
+            stale.append(e)
+    return new, stale
+
+
+def updated(findings: Sequence[Finding], entries: Sequence[BaselineEntry],
+            ) -> List[BaselineEntry]:
+    """The --update-baseline merge: every current finding gets an entry,
+    reasons carried over from the old baseline where the identity matches,
+    TODO-stamped where it does not; stale old entries are dropped."""
+    reasons: Dict[Tuple[str, str, str, str], List[str]] = {}
+    for e in entries:
+        reasons.setdefault(e.key(), []).append(e.reason)
+    out: List[BaselineEntry] = []
+    for f in findings:
+        pool = reasons.get(f.key())
+        reason = pool.pop(0) if pool else TODO_REASON
+        out.append(BaselineEntry(f.rule, f.path, f.scope, f.detail, reason))
+    return out

@@ -1,0 +1,236 @@
+"""easylint core: AST findings, the rule plugin contract, and the driver.
+
+The framework's correctness guarantees (WAL-then-apply under one ordering
+lock, epoch-stamped RPCs riding the instrumented channel, never-raise
+emission paths, virtual-clock-pure policy objects, ``easydl_*`` metric
+conventions) are *disciplines* — nothing in the runtime stops a new call
+site from silently violating them. easylint turns each discipline into a
+mechanical check: a per-rule ``ast.NodeVisitor`` plugin walks every source
+file and emits :class:`Finding` records, a committed baseline grandfathers
+the allowlisted sites (reason string mandatory — see
+``docs/design/static-analysis.md``), and anything new fails the tier-1
+gate (tests/test_easylint.py) and ``scripts/easylint.py`` in CI.
+
+Dependency-free on purpose: stdlib ``ast`` only, so the analyzer runs in
+any container the framework itself runs in — same constraint as the
+metrics registry (obs/registry.py).
+
+Finding identity deliberately excludes line numbers: baselines keyed on
+``rule|path|scope|detail`` survive unrelated edits above the site, so a
+refactor three functions up does not churn the allowlist. When one scope
+holds several identical findings, the driver suffixes ``detail`` with
+``#2``, ``#3`` … so every baseline line stays unique and the file stays
+sorted/deduped (reviewable diffs).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field, replace
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Generated or vendored sources the rules must not judge.
+EXCLUDED_SUFFIXES = (
+    os.path.join("proto", "easydl_pb2.py"),  # protoc output
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site.
+
+    ``scope`` is the dotted class/function path (``PsServer.Push``) or
+    ``<module>``; ``detail`` is the rule-specific discriminator (the
+    blocking call's name, the knob name, …). ``(rule, path, scope,
+    detail)`` is the baseline identity; ``line``/``message`` are for the
+    human report only.
+    """
+
+    rule: str
+    path: str
+    line: int
+    scope: str
+    detail: str
+    message: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.scope, self.detail)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message} "
+                f"(scope: {self.scope}, detail: {self.detail})")
+
+
+class Rule:
+    """A single invariant check. Subclasses set ``name``/``invariant`` and
+    implement :meth:`check` over one parsed module."""
+
+    #: kebab-case rule id — referenced by baseline lines and the docs.
+    name: str = "abstract"
+    #: one-line statement of the discipline the rule protects.
+    invariant: str = ""
+
+    def check(self, path: str, tree: ast.Module,
+              source: str) -> List[Finding]:
+        raise NotImplementedError
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the dotted class/function scope and offers
+    ``emit`` — the shared plumbing every rule plugin builds on."""
+
+    def __init__(self, rule: str, path: str):
+        self.rule = rule
+        self.path = path
+        self._stack: List[str] = []
+        self.findings: List[Finding] = []
+
+    # ------------------------------------------------------------ scope
+    @property
+    def scope(self) -> str:
+        return ".".join(self._stack) if self._stack else "<module>"
+
+    def _scoped(self, node) -> None:
+        self._stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scoped(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scoped(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scoped(node)
+
+    # ------------------------------------------------------------- emit
+    def emit(self, node: ast.AST, detail: str, message: str) -> None:
+        self.findings.append(Finding(
+            rule=self.rule, path=self.path,
+            line=getattr(node, "lineno", 0),
+            scope=self.scope, detail=detail, message=message,
+        ))
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``self._client.Pull`` → ``"self._client.Pull"``; None when the
+    expression is not a plain Name/Attribute chain (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_nodes_skipping_defs(body: Iterable[ast.AST]):
+    """Yield every node under ``body`` WITHOUT descending into nested
+    function/lambda definitions — a closure defined under a lock is
+    deferred work, not work done while holding it."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments — lets rules resolve
+    the repo's ``TRACE_ENV = "EASYDL_TRACE"`` style indirection without
+    cross-module analysis."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
+
+
+# ------------------------------------------------------------------ driver
+def collect_files(paths: Sequence[str],
+                  root: Optional[str] = None) -> List[str]:
+    """Expand files/directories into a sorted list of repo-relative ``.py``
+    paths (relative to ``root``, default cwd), minus generated sources."""
+    root = os.path.abspath(root or os.getcwd())
+    found: List[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            found.append(ap)
+            continue
+        if not os.path.isdir(ap):
+            # a typo'd path must fail the gate loudly, not analyze zero
+            # files and exit 0 — the silent-truncation failure mode
+            raise FileNotFoundError(f"easylint: no such file or "
+                                    f"directory: {p!r} (root {root})")
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    found.append(os.path.join(dirpath, f))
+    rel = []
+    for ap in found:
+        rp = os.path.relpath(ap, root).replace(os.sep, "/")
+        if any(rp.endswith(suf.replace(os.sep, "/"))
+               for suf in EXCLUDED_SUFFIXES):
+            continue
+        rel.append(rp)
+    return sorted(set(rel))
+
+
+def analyze_file(path: str, rules: Sequence[Rule],
+                 root: Optional[str] = None,
+                 source: Optional[str] = None) -> List[Finding]:
+    """Parse once, run every rule. A syntax error is itself a finding (the
+    analyzer must fail loudly, not skip the file it cannot read)."""
+    root = os.path.abspath(root or os.getcwd())
+    if source is None:
+        with open(os.path.join(root, path), encoding="utf-8") as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="parse", path=path, line=e.lineno or 0,
+                        scope="<module>", detail="syntax-error",
+                        message=f"cannot parse: {e.msg}")]
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(path, tree, source))
+    return findings
+
+
+def _disambiguate(findings: List[Finding]) -> List[Finding]:
+    """Suffix repeated identities with ``#2``/``#3`` so baseline lines are
+    unique; order within a file is source order, so the numbering is
+    stable across runs."""
+    seen: Dict[Tuple[str, str, str, str], int] = {}
+    out: List[Finding] = []
+    for f in findings:
+        n = seen.get(f.key(), 0) + 1
+        seen[f.key()] = n
+        out.append(f if n == 1
+                   else replace(f, detail=f"{f.detail}#{n}"))
+    return out
+
+
+def analyze_paths(paths: Sequence[str], rules: Sequence[Rule],
+                  root: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in collect_files(paths, root=root):
+        per_file = analyze_file(path, rules, root=root)
+        per_file.sort(key=lambda f: (f.line, f.rule, f.detail))
+        findings.extend(_disambiguate(per_file))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
+    return findings

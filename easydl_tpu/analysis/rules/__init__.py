@@ -1,0 +1,35 @@
+"""easylint rule registry: one instance of every repo-invariant rule.
+
+Import surface for the driver and the tier-1 gate — adding a rule means
+adding a module here plus fixtures under ``tests/fixtures/easylint/``
+proving it fires on known-bad input and stays quiet on known-good input
+(anti-vacuous, same style as the chaos invariants' negative controls).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from easydl_tpu.analysis.core import Rule
+from easydl_tpu.analysis.rules.knobs import KnobRegistry
+from easydl_tpu.analysis.rules.locks import BlockingCallUnderLock
+from easydl_tpu.analysis.rules.metric_names import MetricNameLint
+from easydl_tpu.analysis.rules.naked_rpc import NakedRpc
+from easydl_tpu.analysis.rules.purity import VirtualClockPurity
+from easydl_tpu.analysis.rules.swallow import CountedSwallow
+
+
+def all_rules() -> List[Rule]:
+    """Fresh instances (rules hold no cross-file state, but cheap anyway)."""
+    return [
+        BlockingCallUnderLock(),
+        NakedRpc(),
+        KnobRegistry(),
+        CountedSwallow(),
+        VirtualClockPurity(),
+        MetricNameLint(),
+    ]
+
+
+__all__ = ["all_rules", "BlockingCallUnderLock", "NakedRpc", "KnobRegistry",
+           "CountedSwallow", "VirtualClockPurity", "MetricNameLint"]

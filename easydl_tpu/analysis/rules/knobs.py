@@ -1,0 +1,138 @@
+"""knob-registry: every ``EASYDL_*`` environ read rides a declared knob.
+
+The discipline (new in this PR, motivated by PR 2's first chaos bug class):
+the fleet is steered by ``EASYDL_*`` environment knobs — WAL sync cadence,
+probe timeouts, autoscale targets, chaos arming — and an inline
+``os.environ.get("EASYDL_TYPO")`` silently reads nothing, defaults
+inconsistently between call sites, and never appears in the operator docs.
+``utils/env.py`` is the single registry: every knob is DECLARED there
+(name, type, default, help in ``KNOB_DECLS``), read through the typed
+accessors (``knob_str``/``knob_int``/``knob_float``/``knob_bool``/
+``knob_raw``), and mirrored into the ``docs/operations.md`` knob table by
+a doc-sync test. This rule closes the loop statically:
+
+* a raw ``os.environ[...]`` / ``os.environ.get`` / ``os.getenv`` read of
+  an ``EASYDL_*`` name outside ``utils/env.py`` is a finding — including
+  reads via a same-module ``NAME = "EASYDL_X"`` constant and reads off an
+  ``env``-named mapping parameter (the worker-spawn IPC idiom);
+* an accessor call whose literal name is NOT declared in ``KNOB_DECLS``
+  is a finding (``undeclared-knob:…``) — the typo fails in lint, not in
+  whatever reads the fleet's env at 3am.
+
+Family knobs (``EASYDL_METRICS_PORT_<COMPONENT>``) are declared with a
+trailing ``*`` and matched by prefix.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from easydl_tpu.analysis.core import (
+    Finding,
+    Rule,
+    ScopedVisitor,
+    dotted_name,
+    module_str_constants,
+)
+
+#: The registry module itself — the one place raw reads are the point.
+REGISTRY_PATH = "easydl_tpu/utils/env.py"
+
+#: Typed accessor names exported by utils/env.py (bare or attr calls).
+ACCESSORS = ("knob_str", "knob_int", "knob_float", "knob_bool", "knob_raw",
+             "env_flag")
+
+#: Receiver names treated as process-environment mappings. ``env`` covers
+#: the worker/agent IPC idiom (``def run_worker(env): env["EASYDL_RANK"]``).
+_ENV_RECEIVERS = ("os.environ", "environ", "env", "_env")
+
+
+def _declared_knobs() -> Sequence[str]:
+    from easydl_tpu.utils import env as env_mod
+
+    return tuple(env_mod.KNOBS)
+
+
+def _is_declared(name: str, declared: Sequence[str]) -> bool:
+    for d in declared:
+        if d.endswith("*"):
+            if name.startswith(d[:-1]):
+                return True
+        elif name == d:
+            return True
+    return False
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: str, path: str, consts, declared):
+        super().__init__(rule, path)
+        self._consts = consts
+        self._declared = declared
+
+    def _easydl_literal(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            v = node.value
+        elif isinstance(node, ast.Name):
+            v = self._consts.get(node.id, "")
+        else:
+            return None
+        return v if v.startswith("EASYDL_") else None
+
+    def _flag_raw(self, node: ast.AST, knob: str) -> None:
+        self.emit(node, knob,
+                  f"inline environ read of {knob} — declare it in "
+                  "utils/env.py KNOB_DECLS and read it through the typed "
+                  "accessors (knob_str/int/float/bool/raw)")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func) or ""
+        last = name.rsplit(".", 1)[-1]
+        if last in ACCESSORS:
+            if node.args:
+                knob = self._easydl_literal(node.args[0])
+                if knob and not _is_declared(knob, self._declared):
+                    self.emit(node, f"undeclared-knob:{knob}",
+                              f"{last}({knob!r}) reads a knob that is not "
+                              "declared in utils/env.py KNOB_DECLS")
+        elif name == "os.getenv" and node.args:
+            knob = self._easydl_literal(node.args[0])
+            if knob:
+                self._flag_raw(node, knob)
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and (dotted_name(node.func.value) or "") in _ENV_RECEIVERS
+                and node.args):
+            knob = self._easydl_literal(node.args[0])
+            if knob:
+                self._flag_raw(node, knob)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if (isinstance(node.ctx, ast.Load)
+                and (dotted_name(node.value) or "") in _ENV_RECEIVERS):
+            knob = self._easydl_literal(node.slice)
+            if knob:
+                self._flag_raw(node, knob)
+        self.generic_visit(node)
+
+
+class KnobRegistry(Rule):
+    name = "knob-registry"
+    invariant = ("Every EASYDL_* environment knob is declared once in "
+                 "utils/env.py (name, type, default) and read through its "
+                 "typed accessors; no inline os.environ literals.")
+
+    def __init__(self, declared: Optional[Sequence[str]] = None):
+        # injectable for fixture tests; default = the live registry
+        self._declared = declared
+
+    def check(self, path: str, tree: ast.Module,
+              source: str) -> List[Finding]:
+        if path == REGISTRY_PATH:
+            return []
+        declared = (self._declared if self._declared is not None
+                    else _declared_knobs())
+        v = _Visitor(self.name, path, module_str_constants(tree), declared)
+        v.visit(tree)
+        return v.findings

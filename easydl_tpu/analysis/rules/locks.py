@@ -1,0 +1,108 @@
+"""blocking-call-under-lock: no blocking I/O while holding a hot lock.
+
+The discipline (PR 6, docs/design/static-analysis.md): the PS ordering
+lock, the table locks, the serve-cache lock and their siblings sit on
+request hot paths — every pull/push/scrape serializes behind them. A
+``time.sleep``, a subprocess spawn, an fsync, a backoff-retried RPC or a
+raw gRPC stub call executed while holding one turns a concurrency
+primitive into a system-wide stall (the exact failure mode the PR-5 bench
+measured as superlinear collapse). The ONE sanctioned exception is the
+WAL append under the PS ordering lock — WAL-then-apply IS the discipline
+there (append order == apply order == replay order) — and it is
+grandfathered in the committed baseline with that reason, not hidden from
+the rule.
+
+"Designated hot lock" = a ``with`` context whose expression's final
+attribute matches ``_lock`` / ``*_mu`` / ``*_mutex`` / ``*_lock`` — the
+repo's universal naming for in-process mutexes (113 such blocks today).
+Work deferred from under the lock (a nested ``def``/``lambda``) is not
+flagged; it runs after release.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from easydl_tpu.analysis.core import (
+    Finding,
+    Rule,
+    ScopedVisitor,
+    dotted_name,
+    iter_nodes_skipping_defs,
+)
+
+#: Final-segment names that designate a hot lock in a `with` expression.
+HOT_LOCK_RE = re.compile(r"(^|_)(lock|mu|mutex)$")
+
+
+def _is_hot_lock(expr: ast.AST) -> bool:
+    name = dotted_name(expr)
+    if name is None:
+        return False
+    return bool(HOT_LOCK_RE.search(name.rsplit(".", 1)[-1]))
+
+
+def _blocking_detail(call: ast.Call) -> str:
+    """Classify a call as blocking; '' when it is not."""
+    name = dotted_name(call.func) or ""
+    last = name.rsplit(".", 1)[-1]
+    root = name.split(".", 1)[0]
+    if name in ("time.sleep",):
+        return "time.sleep"
+    if root == "subprocess":
+        return name
+    if name == "os.fsync" or last == "fsync":
+        return "fsync"
+    if last == "retry_transient":
+        return "retry_transient"
+    if last == "append" and isinstance(call.func, ast.Attribute):
+        recv = dotted_name(call.func.value) or ""
+        if "wal" in recv.rsplit(".", 1)[-1].lower():
+            return "wal-append"
+    # gRPC stub heuristic: a Capitalized method on a receiver named like a
+    # client/stub — the shape of every RpcClient method call in this repo.
+    if isinstance(call.func, ast.Attribute) and last[:1].isupper():
+        recv = (dotted_name(call.func.value) or "").lower()
+        if "client" in recv or "stub" in recv:
+            return f"rpc:{last}"
+    return ""
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: str, path: str):
+        super().__init__(rule, path)
+        # a call under nested hot locks is one finding, not one per lock
+        self._emitted: set = set()
+
+    def visit_With(self, node: ast.With) -> None:
+        hot = [it for it in node.items
+               if _is_hot_lock(it.context_expr)]
+        if hot:
+            lock = dotted_name(hot[0].context_expr)
+            for sub in iter_nodes_skipping_defs(node.body):
+                if isinstance(sub, ast.Call) and id(sub) not in self._emitted:
+                    detail = _blocking_detail(sub)
+                    if detail:
+                        self._emitted.add(id(sub))
+                        self.emit(
+                            sub, detail,
+                            f"blocking call {detail!r} while holding hot "
+                            f"lock {lock!r} — move it outside the hold or "
+                            "baseline with a reason",
+                        )
+        self.generic_visit(node)
+
+
+class BlockingCallUnderLock(Rule):
+    name = "blocking-call-under-lock"
+    invariant = ("Hot in-process locks serialize request hot paths; no "
+                 "sleep/subprocess/fsync/RPC may run under one (WAL append "
+                 "under the PS ordering lock is the baselined exception).")
+
+    def check(self, path: str, tree: ast.Module,
+              source: str) -> List[Finding]:
+        v = _Visitor(self.name, path)
+        v.visit(tree)
+        return v.findings

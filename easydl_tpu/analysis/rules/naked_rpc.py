@@ -1,0 +1,77 @@
+"""naked-rpc: raw gRPC plumbing may exist only inside the blessed seams.
+
+The discipline (PRs 1/2/5): every RPC in the system flows through
+``utils/rpc.py`` (servers via the method-table handler, clients via
+``RpcClient``) — that single seam is what makes the per-service
+request/error/latency metrics, the tracing propagation, the chaos
+injection hook and the epoch-stamping conventions *complete*. The PS data
+plane additionally owns its chunked client in ``ps/client.py``, which
+rides ``retry_transient`` for transient transport loss. A raw
+``grpc.insecure_channel`` / ``grpc.server`` / ``channel.unary_unary``
+anywhere else is an RPC the fleet cannot see, trace, chaos-test or fence
+— it would pass every runtime test and still be a production blind spot.
+
+Importing ``grpc`` elsewhere stays legal: error *classification*
+(``grpc.RpcError``/``grpc.StatusCode``) and servicer-context aborts are
+read-side uses that create no unobserved channel.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from easydl_tpu.analysis.core import (
+    Finding,
+    Rule,
+    ScopedVisitor,
+    dotted_name,
+)
+
+#: Modules allowed to build raw channels/servers/stub callables.
+ALLOWED_PATHS = (
+    "easydl_tpu/utils/rpc.py",
+    "easydl_tpu/ps/client.py",
+)
+
+#: Stub-factory method names on a channel object.
+_STUB_FACTORIES = ("unary_unary", "unary_stream", "stream_unary",
+                   "stream_stream")
+
+#: grpc.* attribute accesses that are classification/abort reads, fine
+#: anywhere. Everything else called off the grpc module is plumbing.
+_SAFE_GRPC_CALLS = ("grpc.RpcError",)
+
+
+class _Visitor(ScopedVisitor):
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func) or ""
+        if (name.startswith("grpc.") and name not in _SAFE_GRPC_CALLS
+                and not name.startswith("grpc.StatusCode")):
+            self.emit(node, name,
+                      f"raw gRPC plumbing call {name}() outside "
+                      "utils/rpc.py / ps/client.py — route it through "
+                      "ServiceDef/RpcClient so it is instrumented, traced "
+                      "and chaos-testable")
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _STUB_FACTORIES):
+            self.emit(node, f"stub-factory:{node.func.attr}",
+                      f"raw stub factory .{node.func.attr}() outside "
+                      "utils/rpc.py / ps/client.py — use RpcClient, which "
+                      "wraps every method with metrics/tracing/chaos")
+        self.generic_visit(node)
+
+
+class NakedRpc(Rule):
+    name = "naked-rpc"
+    invariant = ("All gRPC channels/servers/stubs are built inside "
+                 "utils/rpc.py or ps/client.py so every RPC rides the "
+                 "instrumented, epoch-stamped, chaos-injectable wrap.")
+
+    def check(self, path: str, tree: ast.Module,
+              source: str) -> List[Finding]:
+        if path in ALLOWED_PATHS:
+            return []
+        v = _Visitor(self.name, path)
+        v.visit(tree)
+        return v.findings

@@ -1,0 +1,115 @@
+"""counted-swallow: a broad except must log, count, or re-raise.
+
+The discipline (PRs 1/4, hardened here): the framework is full of
+deliberately never-raise paths — metric emission, tracing, best-effort
+cleanup — and the idiom for those is a broad ``except Exception``. The
+failure mode is the SILENT version: ``except Exception: pass`` swallows
+the evidence, and the 3am operator sees a healthy fleet with a dead
+subsystem. The rule: every broad handler (``except Exception``,
+``except BaseException``, bare ``except:``) in ``easydl_tpu/`` must do at
+least one observable thing — re-raise, log, count into a metric
+(``.inc()``/``.observe()``/``.set()`` or the
+:func:`easydl_tpu.obs.errors.count_swallowed` helper, which feeds
+``easydl_swallowed_errors_total{site=…}``), or abort the servicer
+context. Handlers that swallow without any of those are findings: fix
+them (count or narrow the except), or baseline them with a reason a
+reviewer can judge.
+
+``obs/errors.py`` itself is exempt — the counting helper's own last-line
+guard cannot count its way out of a broken registry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from easydl_tpu.analysis.core import (
+    Finding,
+    Rule,
+    ScopedVisitor,
+    dotted_name,
+)
+
+#: The counting helper's home — its internal guard is the sink itself.
+EXEMPT_PATHS = ("easydl_tpu/obs/errors.py",)
+
+_BROAD = ("Exception", "BaseException")
+_LOG_METHODS = ("debug", "info", "warning", "error", "exception",
+                "critical")
+_METRIC_METHODS = ("inc", "dec", "observe")
+# `.set()` alone would match threading.Event.set(); require a metric-ish
+# receiver (the repo's `self._m_*` / `*_gauge` / `*metric*` naming).
+_METRIC_RECV_HINT = ("_m_", "metric", "counter", "gauge", "hist")
+_EXIT_CALLS = ("os._exit", "sys.exit")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    if isinstance(t, ast.Name) and t.id in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _receiver_is_logger(recv: str) -> bool:
+    last = recv.rsplit(".", 1)[-1].lstrip("_")
+    return last in ("log", "logger", "logging") or last.endswith("log") \
+        or last.endswith("logger")
+
+
+def _observes(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Assert)):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        last = name.rsplit(".", 1)[-1]
+        # count_swallowed, count_fault, _count_listener_error, …: a
+        # counting helper by naming convention IS the discipline.
+        if name in _EXIT_CALLS or last.lstrip("_").startswith("count"):
+            return True
+        if isinstance(node.func, ast.Attribute):
+            recv = dotted_name(node.func.value) or ""
+            if last in _LOG_METHODS and _receiver_is_logger(recv):
+                return True
+            if last in _METRIC_METHODS and recv:
+                return True
+            if last == "set" and any(h in recv.lower()
+                                     for h in _METRIC_RECV_HINT):
+                return True
+            if last == "abort":  # servicer ctx.abort raises
+                return True
+    return False
+
+
+class _Visitor(ScopedVisitor):
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if _is_broad(node) and not _observes(node):
+            what = ("bare-except" if node.type is None else "silent-swallow")
+            self.emit(node, what,
+                      "broad except swallows without logging, counting, or "
+                      "re-raising — count it via obs.errors.count_swallowed"
+                      "(site), log it, narrow the exception type, or "
+                      "baseline with a reason")
+        self.generic_visit(node)
+
+
+class CountedSwallow(Rule):
+    name = "counted-swallow"
+    invariant = ("A broad `except Exception` inside easydl_tpu/ must log, "
+                 "count into a metric, or re-raise — silent swallows hide "
+                 "dead subsystems behind healthy dashboards.")
+
+    def check(self, path: str, tree: ast.Module,
+              source: str) -> List[Finding]:
+        if not path.startswith("easydl_tpu/") or path in EXEMPT_PATHS:
+            return []
+        v = _Visitor(self.name, path)
+        v.visit(tree)
+        return v.findings

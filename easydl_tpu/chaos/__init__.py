@@ -21,21 +21,20 @@ below without pulling grpc/jax-adjacent machinery.
 
 from __future__ import annotations
 
-import os
-
 from easydl_tpu.chaos.spec import (  # noqa: F401 (public API)
     ChaosSpec,
     FaultSpec,
     compile_schedule,
     schedule_bytes,
 )
+from easydl_tpu.utils.env import knob_raw
 
 ENV_VAR = "EASYDL_CHAOS_SPEC"
 
 
 def chaos_enabled() -> bool:
     """The one cheap flag check every hook point gates on."""
-    return bool(os.environ.get(ENV_VAR))
+    return bool(knob_raw(ENV_VAR))
 
 
 def banner(component: str) -> None:
@@ -48,5 +47,5 @@ def banner(component: str) -> None:
         get_logger("chaos", component).warning(
             "CHAOS FAULT INJECTION ARMED in %s (EASYDL_CHAOS_SPEC=%s) — "
             "this process may be injected with failures",
-            component, os.environ.get(ENV_VAR),
+            component, knob_raw(ENV_VAR),
         )

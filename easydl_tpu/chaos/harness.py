@@ -39,6 +39,7 @@ from easydl_tpu.chaos.spec import (
     ChaosSpec, FaultSpec, compile_schedule, process_events,
 )
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.env import knob_raw
 
 log = get_logger("chaos", "harness")
 
@@ -204,7 +205,7 @@ class ChaosHarness:
         # kernel XLA:CPU segfaults deserializing a persistent-compile-cache
         # entry another process wrote — run every drill with the cache off
         # (each respawn pays a clean test-scale compile, ~1s).
-        cache_before = os.environ.get("EASYDL_COMPILE_CACHE")
+        cache_before = knob_raw("EASYDL_COMPILE_CACHE")
         os.environ["EASYDL_COMPILE_CACHE"] = "off"
         # Arm tracing for the drill (worker/PS subprocesses inherit the
         # env): the verdict's workdir then carries a complete span record —
@@ -301,7 +302,7 @@ class ChaosHarness:
         # A SIGSTOP'd zombie keeps its listen socket open, so liveness
         # probes against it only fail by timeout — shrink it or the rescue
         # pod pays 2×5s per probe (and the drill its multiple).
-        probe_before = os.environ.get("EASYDL_PS_PROBE_TIMEOUT_S")
+        probe_before = knob_raw("EASYDL_PS_PROBE_TIMEOUT_S")
         os.environ["EASYDL_PS_PROBE_TIMEOUT_S"] = "1.0"
         from easydl_tpu.obs import tracing
 

@@ -30,6 +30,8 @@ from typing import Any, Dict, List, Mapping, Optional
 import grpc
 
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.obs.errors import count_swallowed
+from easydl_tpu.utils.env import knob_raw
 
 log = get_logger("chaos", "injectors")
 
@@ -124,7 +126,7 @@ def current_plan() -> Optional[ChaosPlan]:
     """The active plan, reloaded when the spec file changes (the harness
     stamps t0 in place). Unreadable/absent file → None: fault injection
     must degrade to 'no faults', never take the host process down."""
-    path = os.environ.get(ENV_VAR)
+    path = knob_raw(ENV_VAR)
     if not path:
         return None
     try:
@@ -171,8 +173,8 @@ def count_fault(kind: str) -> None:
         from easydl_tpu.obs import tracing
 
         tracing.instant(f"fault:{kind}", kind=kind)
-    except Exception:
-        pass
+    except Exception as e:
+        count_swallowed("chaos.injectors.fault_instant", e)
 
 
 FAULT_COUNTER_NAME = "easydl_chaos_faults_injected_total"

@@ -54,14 +54,27 @@ class LocalProcessPodApi(PodApi):
         self.extra_env = env or {}
         self.grace_s = grace_s
         self._procs: Dict[str, _Proc] = {}
+        self._pending: set = set()  # names being spawned outside the lock
+        self._doomed: set = set()   # pending names deleted mid-spawn
+        self._closed = False        # shutdown() ran; late spawns die
         self._lock = threading.RLock()
         os.makedirs(os.path.join(workdir, "pod-logs"), exist_ok=True)
 
     # ----------------------------------------------------------------- PodApi
     def create_pod(self, pod: Pod) -> None:
+        # The lock guards only the name-table transitions; the spawn itself
+        # (ready-file unlink, log open, fork/exec) runs OUTSIDE the hold —
+        # a slow exec under the table lock would stall every concurrent
+        # delete/list/poll (easylint: blocking-call-under-lock). The
+        # `_pending` reservation keeps the duplicate-name check airtight
+        # across the unlocked window.
         with self._lock:
-            if pod.name in self._procs:
+            if self._closed:
+                raise ValueError("pod api is shut down")
+            if pod.name in self._procs or pod.name in self._pending:
                 raise ValueError(f"pod {pod.name!r} already exists")
+            self._pending.add(pod.name)
+        try:
             # Substitute ONLY the known tokens (str.format would choke on
             # literal braces in commands, e.g. JSON model-args); quote the
             # workdir so paths with spaces survive shlex.split.
@@ -97,13 +110,31 @@ class LocalProcessPodApi(PodApi):
                     stdout=logf, stderr=subprocess.STDOUT,
                     env=env, start_new_session=True,  # own pgid: clean kill
                 )
-            self._procs[pod.name] = _Proc(pod, proc, log_path, ready_file)
-            log.info("launched pod %s (%s): pid=%d", pod.name, pod.role, proc.pid)
+            with self._lock:
+                # A shutdown()/delete_pod(name) that ran during the
+                # unlocked spawn window marked this name doomed: kill the
+                # just-born child instead of registering it (it must not
+                # outlive the teardown that thought it covered everything).
+                if self._closed or pod.name in self._doomed:
+                    self._doomed.discard(pod.name)
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+                    return
+                self._procs[pod.name] = _Proc(pod, proc, log_path, ready_file)
+        finally:
+            with self._lock:
+                self._pending.discard(pod.name)
+        log.info("launched pod %s (%s): pid=%d", pod.name, pod.role, proc.pid)
 
     def delete_pod(self, name: str) -> None:
         with self._lock:
             entry = self._procs.get(name)
             if entry is None:
+                if name in self._pending:
+                    # mid-spawn: create_pod will kill it on registration
+                    self._doomed.add(name)
                 return
             if entry.proc.poll() is None:
                 if entry.term_sent_at is None:
@@ -164,6 +195,7 @@ class LocalProcessPodApi(PodApi):
     def shutdown(self) -> None:
         """Kill everything (test teardown)."""
         with self._lock:
+            self._closed = True  # in-flight create_pods kill their child
             for e in self._procs.values():
                 if e.proc.poll() is None:
                     try:

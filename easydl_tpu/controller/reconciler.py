@@ -28,6 +28,8 @@ from easydl_tpu.api.job_spec import ResourceSpec
 from easydl_tpu.api.resource_plan import ResourcePlan
 from easydl_tpu.controller.pod_api import Pod
 from easydl_tpu.utils.native import load_native
+from easydl_tpu.utils.env import knob_float, knob_int
+from easydl_tpu.obs.errors import count_swallowed
 
 _SOURCE = os.path.join(os.path.dirname(__file__), "native", "reconciler_core.cc")
 
@@ -296,14 +298,14 @@ def maybe_split_ps(workdir: str,
     import re as _re
 
     if hot_ratio is None:
-        hot_ratio = float(os.environ.get("EASYDL_PS_SPLIT_HOT_RATIO",
-                                         PS_SPLIT_HOT_RATIO))
+        hot_ratio = knob_float("EASYDL_PS_SPLIT_HOT_RATIO",
+                               PS_SPLIT_HOT_RATIO)
     if min_total_rows is None:
-        min_total_rows = float(os.environ.get("EASYDL_PS_SPLIT_MIN_ROWS",
-                                              PS_SPLIT_MIN_ROWS))
+        min_total_rows = knob_float("EASYDL_PS_SPLIT_MIN_ROWS",
+                                    PS_SPLIT_MIN_ROWS)
     if max_shards is None:
-        max_shards = int(os.environ.get("EASYDL_PS_SPLIT_MAX_SHARDS",
-                                        PS_SPLIT_MAX_SHARDS))
+        max_shards = knob_int("EASYDL_PS_SPLIT_MAX_SHARDS",
+                              PS_SPLIT_MAX_SHARDS)
 
     from easydl_tpu.obs.scrape import merge_snapshot
     from easydl_tpu.ps import registry as ps_registry
@@ -319,7 +321,8 @@ def maybe_split_ps(workdir: str,
         num_shards = max(int(d["num_shards"]) for d in smap.values())
     try:
         snap = merge_snapshot(workdir=workdir)
-    except Exception:
+    except Exception as e:
+        count_swallowed("controller.split_snapshot", e)
         return None
     # Per-service, filtered to the COMMITTED generation's pods — not the
     # blind merge: after a reshard the superseded sources are gated but
@@ -417,23 +420,24 @@ def maybe_scale_serve(workdir: str,
     import re as _re
 
     if target_qps is None:
-        target_qps = float(os.environ.get("EASYDL_SERVE_TARGET_QPS",
-                                          SERVE_TARGET_QPS_PER_REPLICA))
+        target_qps = knob_float("EASYDL_SERVE_TARGET_QPS",
+                                SERVE_TARGET_QPS_PER_REPLICA)
     if p99_budget_s is None:
-        p99_budget_s = float(os.environ.get("EASYDL_SERVE_P99_BUDGET_S",
-                                            SERVE_P99_BUDGET_S))
+        p99_budget_s = knob_float("EASYDL_SERVE_P99_BUDGET_S",
+                                  SERVE_P99_BUDGET_S)
     if min_replicas is None:
-        min_replicas = int(os.environ.get("EASYDL_SERVE_MIN_REPLICAS",
-                                          SERVE_MIN_REPLICAS))
+        min_replicas = knob_int("EASYDL_SERVE_MIN_REPLICAS",
+                                SERVE_MIN_REPLICAS)
     if max_replicas is None:
-        max_replicas = int(os.environ.get("EASYDL_SERVE_MAX_REPLICAS",
-                                          SERVE_MAX_REPLICAS))
+        max_replicas = knob_int("EASYDL_SERVE_MAX_REPLICAS",
+                                SERVE_MAX_REPLICAS)
 
     from easydl_tpu.obs.scrape import merge_snapshot
 
     try:
         snap = merge_snapshot(workdir=workdir)
-    except Exception:
+    except Exception as e:
+        count_swallowed("controller.serve_snapshot", e)
         return None
     qps_re = _re.compile(r'^easydl_serve_qps_recent\{.*replica="([^"]+)"')
     p99_re = _re.compile(

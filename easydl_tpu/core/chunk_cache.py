@@ -35,6 +35,7 @@ from typing import Optional
 import numpy as np
 
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.env import knob_str
 
 log = get_logger("core", "chunk_cache")
 
@@ -61,7 +62,7 @@ class ChunkCache:
         should match the CheckpointManager's retention — a cache that keeps
         fewer tokens than the manager keeps checkpoints silently defeats
         the fast path for the older restorable steps."""
-        env = os.environ.get("EASYDL_CHUNK_CACHE", "")
+        env = knob_str("EASYDL_CHUNK_CACHE")
         if env.lower() in _DISABLED:
             return None
         base = env or "/dev/shm/easydl-chunk-cache"

@@ -43,6 +43,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.env import knob_raw, knob_str
 
 log = get_logger("core", "storage")
 
@@ -132,7 +133,7 @@ class PosixStorage(CheckpointStorage):
         # Chaos hook point: during a ckpt_corrupt_write window the
         # just-written file is truncated/bit-flipped in place — a host dying
         # mid-save, torn IO. One env lookup when unarmed.
-        if os.environ.get("EASYDL_CHAOS_SPEC"):
+        if knob_raw("EASYDL_CHAOS_SPEC"):
             from easydl_tpu.chaos.injectors import maybe_corrupt_written_file
 
             maybe_corrupt_written_file(full)
@@ -422,8 +423,7 @@ def get_storage(url: str) -> CheckpointStorage:
     proxy)."""
     parsed = urllib.parse.urlparse(url)
     if parsed.scheme == "gs":
-        base = os.environ.get("EASYDL_GCS_ENDPOINT",
-                              "https://storage.googleapis.com")
+        base = knob_str("EASYDL_GCS_ENDPOINT")
         return GcsStorage(parsed.netloc, parsed.path, base_url=base)
     if parsed.scheme == "file":
         return PosixStorage(parsed.path)

@@ -28,6 +28,8 @@ from easydl_tpu.utils.rpc import RpcClient
 
 from easydl_tpu.elastic import timeline
 from easydl_tpu.elastic.master import MASTER_SERVICE
+from easydl_tpu.obs.errors import count_swallowed
+from easydl_tpu.utils.env import knob_float, knob_raw
 
 log = get_logger("elastic", "agent")
 
@@ -357,8 +359,8 @@ class Agent:
                 tracing.instant(f"timeline:{phase}",
                                 parent=self._switch_ctx, t=t_wall,
                                 agent=self.agent_id, gen=rec.get("gen"))
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("agent.timeline_emit", e)
         self._tl_last = (phase, now)
         self._m_phase_total.inc(agent=self.agent_id, phase=phase)
 
@@ -446,7 +448,7 @@ class Agent:
             # agent hang / one-way partition — the loop (and the worker)
             # keep running, the master just hears nothing. One env lookup
             # when unarmed.
-            if os.environ.get("EASYDL_CHAOS_SPEC"):
+            if knob_raw("EASYDL_CHAOS_SPEC"):
                 from easydl_tpu.chaos.injectors import heartbeat_suppressed
 
                 if heartbeat_suppressed(self.agent_id):
@@ -486,8 +488,8 @@ class Agent:
                     fail_since = now
                     try:
                         self._m_outages.inc(agent=self.agent_id)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        count_swallowed("agent.outage_metric", e)
                 self._buffer_outage_metrics(metrics)
                 if now - fail_since > self.master_refresh_s:
                     refreshed = self._maybe_follow_master()
@@ -524,15 +526,15 @@ class Agent:
         try:
             self._m_outage_buffered.set(len(self._outage_buf),
                                         agent=self.agent_id)
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("agent.outage_metric", e)
 
     def _note_outage_end(self, fail_since: float) -> None:
         try:
             self._m_outage_seconds.set(time.monotonic() - fail_since,
                                        agent=self.agent_id)
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("agent.outage_metric", e)
         log.info("%s: master reachable again after %.1fs outage "
                  "(%d buffered step records)", self.agent_id,
                  time.monotonic() - fail_since, len(self._outage_buf))
@@ -560,8 +562,8 @@ class Agent:
                 break
         try:
             self._m_outage_buffered.set(0, agent=self.agent_id)
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("agent.outage_metric", e)
         return last
 
     def _note_heartbeat(self, metrics: Dict[str, Any]) -> None:
@@ -588,8 +590,8 @@ class Agent:
                 self._m_worker_step_time.set(
                     float(metrics.get("step_time_s", 0.0)),
                     agent=self.agent_id)
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("agent.heartbeat_gauges", e)
 
     # ------------------------------------------------------------------ state
     def _refresh_state(self) -> None:
@@ -988,7 +990,7 @@ def main() -> None:  # pragma: no cover - CLI entry
                         "(faster recovery/reshape at one idle process cost)")
     p.add_argument(
         "--master-wait", type=float,
-        default=float(os.environ.get("EASYDL_MASTER_WAIT_S", "600")),
+        default=knob_float("EASYDL_MASTER_WAIT_S"),
         help="seconds to poll --master-file before giving up (default 600 "
              "or $EASYDL_MASTER_WAIT_S; under load the trainer pod can take "
              "minutes to import jax and publish the master address)")

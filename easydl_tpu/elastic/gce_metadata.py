@@ -26,6 +26,7 @@ import urllib.request
 from typing import Callable, Optional
 
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.env import knob_str
 
 log = get_logger("elastic", "gce")
 
@@ -143,9 +144,7 @@ def maybe_start_watcher(
     ``base_url`` override (or the EASYDL_GCE_METADATA_URL env var) exists
     for tests and for metadata proxies.
     """
-    import os
-
-    url = base_url or os.environ.get("EASYDL_GCE_METADATA_URL") \
+    url = base_url or knob_str("EASYDL_GCE_METADATA_URL") \
         or DEFAULT_BASE_URL
     w = GceMaintenanceWatcher(on_notice, base_url=url)
     if not w.available():

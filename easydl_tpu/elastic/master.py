@@ -27,6 +27,7 @@ from easydl_tpu.obs import get_registry, start_exporter, tracing
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import RpcClient, ServiceDef, serve
+from easydl_tpu.obs.errors import count_swallowed
 
 log = get_logger("elastic", "master")
 
@@ -494,8 +495,8 @@ class Master:
                 "generation_switch", detached=True, job=self.job_name,
                 from_generation=self.rendezvous.generation)
             self._switch_span = span if span else None
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("master.trace_switch", e)
         return self._switch_span
 
     def _trace_phase(self, phase: JobPhase) -> None:
@@ -522,8 +523,8 @@ class Master:
             self._switch_phase_span = tracing.start_span(
                 f"phase:{phase.value}", parent=root,
                 generation=self.rendezvous.generation)
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("master.trace_phase", e)
 
     def _trace_maybe_close_switch(self, phase: JobPhase) -> None:
         """Close the switch tree once the new generation is live: every
@@ -537,8 +538,8 @@ class Master:
                 self._switch_span.end(generation=rdv.generation,
                                       members=list(rdv.members))
                 self._switch_span = None
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("master.trace_close_switch", e)
 
     # ------------------------------------------------------------------ plans
     def apply_plan(self, plan: ResourcePlan) -> None:

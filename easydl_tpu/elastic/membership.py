@@ -21,7 +21,7 @@ This makes the FSM replayable in unit tests (SURVEY.md §5.2).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -54,7 +54,11 @@ class AgentView:
     state: AgentState = AgentState.IDLE
     generation: int = -1
     step: int = 0
-    last_heartbeat: float = field(default_factory=time.monotonic)
+    # No wall-clock default: every constructor passes the rendezvous'
+    # injected clock (virtual under the PR-8 simulator — a real-clock
+    # default_factory here silently broke byte-identical replay for any
+    # path that omitted it). 0.0 = "never heard from".
+    last_heartbeat: float = 0.0
     preempting: bool = False
     #: rendezvous-clock time until which this agent is excluded from
     #: membership (straggler mitigation); -inf = not excluded
@@ -239,7 +243,8 @@ class Rendezvous:
         killed through the existing stale-worker path."""
         a = self.agents.get(agent_id)
         if a is None:
-            a = AgentView(agent_id=agent_id, host=host, slots=slots)
+            a = AgentView(agent_id=agent_id, host=host, slots=slots,
+                          last_heartbeat=self._clock())
             self.agents[agent_id] = a
             log.info(
                 "adopting agent %s presenting gen %d state %r (%d slots)",

@@ -25,6 +25,7 @@ import argparse
 import json
 import os
 import time
+from easydl_tpu.obs.errors import count_swallowed
 
 
 _RUNNER_PREFIX = "python -m easydl_tpu.models.run "
@@ -66,7 +67,8 @@ def extract_features(job, brain_pb):
         try:
             bundle = get_model(family, **kwargs)
             params = bundle.param_count_hint
-        except Exception:
+        except Exception as e:
+            count_swallowed("brain.extract_features", e)
             params = 0
         uses_ps = kwargs.get("embedding") == "ps" or family in ("deepfm", "widedeep")
     acc = brain_pb.TpuSpec()

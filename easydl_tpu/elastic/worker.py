@@ -32,6 +32,8 @@ import time
 from typing import Any, Dict
 
 import numpy as np
+from easydl_tpu.obs.errors import count_swallowed
+from easydl_tpu.utils.env import knob_int, knob_raw, knob_str
 
 
 _QUIESCE = {"flag": False}
@@ -96,17 +98,17 @@ def run_worker(env: Dict[str, str]) -> int:
     # process, and a baseline captured later would equal the reaper's pid
     # and never fire.
     parent_pid = os.getppid()
-    rank = int(env["EASYDL_RANK"])
-    world = int(env["EASYDL_WORLD"])
-    coordinator = env["EASYDL_COORD"]
-    generation = int(env["EASYDL_GEN"])
-    workdir = env["EASYDL_WORKDIR"]
-    metrics_path = env["EASYDL_METRICS"]
-    tl_path = env.get("EASYDL_TIMELINE")
+    rank = knob_int("EASYDL_RANK", env=env)
+    world = knob_int("EASYDL_WORLD", env=env)
+    coordinator = knob_str("EASYDL_COORD", env=env)
+    generation = knob_int("EASYDL_GEN", env=env)
+    workdir = knob_str("EASYDL_WORKDIR", env=env)
+    metrics_path = knob_str("EASYDL_METRICS", env=env)
+    tl_path = knob_raw("EASYDL_TIMELINE", env=env)
     # The host/agent id, for agent-targeted chaos windows. Set explicitly
     # by the agent; the filename fallback (metrics-<agent>.jsonl is the
     # agent's convention) only covers standalone/manual worker runs.
-    agent_id = env.get("EASYDL_AGENT_ID") or (
+    agent_id = knob_raw("EASYDL_AGENT_ID", env=env) or (
         os.path.basename(metrics_path)[len("metrics-"):-len(".jsonl")])
 
     from easydl_tpu.elastic import timeline
@@ -130,7 +132,7 @@ def run_worker(env: Dict[str, str]) -> int:
         generation=generation, rank=rank, world=world)
     try:
         trace_step_every = max(
-            1, int(env.get("EASYDL_TRACE_STEP_EVERY", "25") or 25))
+            1, int(knob_raw("EASYDL_TRACE_STEP_EVERY", env=env) or 25))
     except ValueError:  # a typo'd knob must not take the worker down
         trace_step_every = 25
 
@@ -150,7 +152,7 @@ def run_worker(env: Dict[str, str]) -> int:
     # container's 4.4 era) deserializing a cache entry another process
     # wrote segfaults XLA:CPU — the chaos harness runs drills with the
     # cache off so every respawn pays a clean compile instead of SIGSEGV.
-    cache_dir = os.environ.get(
+    cache_dir = knob_str(
         "EASYDL_COMPILE_CACHE", os.path.join(workdir, "jax_cache")
     )
     if cache_dir.strip().lower() not in ("", "off", "0", "none", "disabled"):
@@ -159,8 +161,8 @@ def run_worker(env: Dict[str, str]) -> int:
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", 0.0)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        except Exception:  # older jax without these knobs: best-effort
-            pass
+        except (AttributeError, KeyError, ValueError):
+            pass  # older jax without these knobs: best-effort
     timeline.emit(tl_path, "jax_imported", generation, rank=rank)
     if world > 1:
         with tracing.start_span("dist_init", parent=root_span,
@@ -272,7 +274,7 @@ def run_worker(env: Dict[str, str]) -> int:
     # decomposition names the binding term (VERDICT r3 weak 2/3 method).
     timeline.emit(tl_path, "trainer_built", generation, rank=rank)
 
-    go_file = env.get("EASYDL_GO_FILE")
+    go_file = knob_raw("EASYDL_GO_FILE", env=env)
     if go_file:
         # PREFLIGHT MODE: this process was spawned for a generation that
         # does not exist yet (the master's prepare hint) while the current
@@ -322,7 +324,7 @@ def run_worker(env: Dict[str, str]) -> int:
 
     # Chaos hook flag, read once: the straggler injector below costs one
     # None-check per step when a spec is armed, nothing when not.
-    chaos_armed = bool(os.environ.get("EASYDL_CHAOS_SPEC"))
+    chaos_armed = bool(knob_raw("EASYDL_CHAOS_SPEC"))
 
     # Restore through the quarantine-fallback loop (core/checkpoint.py):
     # a COMMITTED step with damaged bytes (truncated chunk, torn manifest)
@@ -648,8 +650,8 @@ def _warm_wait(warm_file: str) -> Dict[str, str]:
         from easydl_tpu.core import checkpoint  # noqa: F401
         from easydl_tpu.core import train_loop  # noqa: F401
         from easydl_tpu.models import registry  # noqa: F401
-    except Exception:  # pragma: no cover - pre-warm is best-effort
-        pass
+    except Exception as e:  # pragma: no cover - pre-warm is best-effort
+        count_swallowed("worker.standby_prewarm", e)
     # READY marker: lets the agent (and tests) see the standby is warm.
     try:
         with open(warm_file + ".ready", "w") as f:
@@ -658,7 +660,7 @@ def _warm_wait(warm_file: str) -> Dict[str, str]:
         pass
     from easydl_tpu.elastic import timeline
 
-    timeline.emit(os.environ.get("EASYDL_TIMELINE"), "standby_warm_ready", -1)
+    timeline.emit(knob_raw("EASYDL_TIMELINE"), "standby_warm_ready", -1)
     while True:
         if os.getppid() != parent_pid:  # agent died; don't linger as orphan
             raise SystemExit(0)
@@ -674,7 +676,7 @@ def _warm_wait(warm_file: str) -> Dict[str, str]:
 
 def main() -> None:
     env = dict(os.environ)
-    warm_file = env.get("EASYDL_WARM_FILE")
+    warm_file = knob_raw("EASYDL_WARM_FILE", env=env)
     if warm_file:
         # Install the quiesce handler before the long import (same reason
         # as run_worker's first line).

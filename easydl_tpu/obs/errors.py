@@ -1,0 +1,50 @@
+"""Counted error swallows: ``easydl_swallowed_errors_total{site}``.
+
+The framework's never-raise paths (metric emission, tracing, best-effort
+cleanup) all share one idiom — a broad ``except Exception`` — and easylint's
+``counted-swallow`` rule (analysis/rules/swallow.py) requires each of those
+handlers to log, count, or re-raise. This module is the COUNT option made
+one call: ``count_swallowed("obs.tracing.configure")`` increments a
+per-site counter on the process registry, so a dead subsystem that fails a
+thousand times an hour shows up as a climbing series on /metrics instead
+of as silence. The ``site`` label is a short dotted code location, stable
+across refactors (it names the seam, not the line number).
+
+``count_swallowed`` itself MUST never raise — it is called from inside the
+paths whose failures it records — so its last line is the one swallow in
+the tree that cannot count itself; easylint exempts this module for
+exactly that reason (swallow.EXEMPT_PATHS).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_counter = None
+
+
+def count_swallowed(site: str, error: Optional[BaseException] = None) -> None:
+    """Record one swallowed error at ``site``. Never raises.
+
+    ``error`` is accepted (and currently unused) so call sites can hand
+    over the exception without a conditional — a future debug mode can
+    sample it without touching every caller.
+    """
+    global _counter
+    try:
+        if _counter is None:
+            from easydl_tpu.obs.registry import get_registry
+
+            _counter = get_registry().counter(
+                "easydl_swallowed_errors_total",
+                "Errors swallowed on never-raise paths, by site. A "
+                "climbing series means a subsystem is failing silently "
+                "— triage the site before trusting its output.",
+                ("site",),
+            )
+        _counter.inc(site=site)
+    except Exception:
+        pass
+
+
+COUNTER_NAME = "easydl_swallowed_errors_total"

@@ -23,6 +23,8 @@ from typing import Callable, Dict, Optional
 
 from easydl_tpu.obs.registry import MetricsRegistry, get_registry
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.obs.errors import count_swallowed
+from easydl_tpu.utils.env import knob_str
 
 log = get_logger("obs", "exporter")
 
@@ -105,7 +107,7 @@ class MetricsExporter:
         multi-host job (shared-workdir deployments) set
         ``EASYDL_METRICS_HOST`` to this host's reachable name/IP (the pod
         backend's pod IP, a node hostname) so cross-host scrapes work."""
-        host = os.environ.get("EASYDL_METRICS_HOST", "").strip() or "localhost"
+        host = knob_str("EASYDL_METRICS_HOST").strip() or "localhost"
         return f"{host}:{self.port}"
 
     @staticmethod
@@ -179,8 +181,8 @@ class MetricsExporter:
         try:
             self._server.shutdown()
             self._server.server_close()
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("obs.exporter.stop", e)
         if self._published:
             # Retract only OUR publication: an exiting old process must not
             # delete the fresh file a same-component replacement already

@@ -34,6 +34,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from easydl_tpu.obs.errors import count_swallowed
+from easydl_tpu.utils.env import knob_raw, knob_str
+
 #: master switch for the whole layer (default off).
 TRACE_ENV = "EASYDL_TRACE"
 #: traceparent handed to worker subprocesses by the agent.
@@ -53,7 +56,7 @@ _HEX = set("0123456789abcdef")
 
 def enabled() -> bool:
     """One env lookup; the gate every hook point checks first."""
-    v = os.environ.get(TRACE_ENV, "")
+    v = knob_str(TRACE_ENV)
     return v not in ("", "0", "off", "false", "no", "disabled", "none")
 
 
@@ -102,14 +105,15 @@ def extract(header: Optional[str]) -> Optional[SpanContext]:
         if trace_id == "0" * 32 or span_id == "0" * 16:
             return None
         return SpanContext(trace_id, span_id)
-    except Exception:
+    except Exception as e:
+        count_swallowed("obs.tracing.extract", e)
         return None
 
 
 def from_env(environ: Optional[Dict[str, str]] = None) -> Optional[SpanContext]:
     """The subprocess half of propagation: the agent's EASYDL_TRACE_CONTEXT."""
     env = environ if environ is not None else os.environ
-    return extract(env.get(CTX_ENV, ""))
+    return extract(knob_str(CTX_ENV, env=env))
 
 
 # ------------------------------------------------------------------- sink
@@ -145,8 +149,8 @@ def configure(proc: str, workdir: Optional[str]) -> None:
             _state.update(proc=safe, dir=d,
                           path=os.path.join(d, f"spans-{safe}.jsonl"),
                           fd=None)
-    except Exception:
-        pass
+    except Exception as e:
+        count_swallowed("obs.tracing.configure", e)
 
 
 def sink_path() -> Optional[str]:
@@ -155,7 +159,7 @@ def sink_path() -> Optional[str]:
 
 def _max_bytes() -> int:
     try:
-        return int(os.environ.get(MAX_BYTES_ENV, "") or _DEFAULT_MAX_BYTES)
+        return int(knob_raw(MAX_BYTES_ENV) or _DEFAULT_MAX_BYTES)
     except ValueError:
         return _DEFAULT_MAX_BYTES
 
@@ -180,7 +184,8 @@ def _write(rec: Dict[str, Any]) -> None:
                 fd.close()
                 _state["fd"] = None
                 os.replace(path, path + ".1")
-    except Exception:
+    except Exception as e:
+        count_swallowed("obs.tracing.write_rotate", e)
         with _lock:
             _state["fd"] = None  # reopen on the next emit
 
@@ -219,8 +224,8 @@ class Span:
     def set_attr(self, key: str, value: Any) -> "Span":
         try:
             self.attrs[key] = value
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("obs.tracing.span.set_attr", e)
         return self
 
     def add_event(self, name: str, **attrs: Any) -> "Span":
@@ -229,8 +234,8 @@ class Span:
             if attrs:
                 ev["attrs"] = attrs
             self.events.append(ev)
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("obs.tracing.span.add_event", e)
         return self
 
     def end(self, **attrs: Any) -> None:
@@ -260,8 +265,8 @@ class Span:
             if self.events:
                 rec["events"] = self.events
             _write(rec)
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("obs.tracing.span.end", e)
 
     def __enter__(self) -> "Span":
         return self
@@ -308,7 +313,8 @@ NULL_SPAN = _NullSpan()
 def _tid() -> int:
     try:
         return threading.get_native_id()
-    except Exception:
+    except Exception as e:
+        count_swallowed("obs.tracing.tid", e)
         return 0
 
 
@@ -359,7 +365,8 @@ def start_span(name: str,
             rec["attrs"] = dict(attrs)
         _write(rec)
         return span
-    except Exception:
+    except Exception as e:
+        count_swallowed("obs.tracing.start_span", e)
         return NULL_SPAN
 
 
@@ -394,7 +401,8 @@ def record_span(name: str, t0: float, t1: float,
             rec["attrs"] = attrs
         _write(rec)
         return ctx
-    except Exception:
+    except Exception as e:
+        count_swallowed("obs.tracing.record_span", e)
         return None
 
 
@@ -422,8 +430,8 @@ def instant(name: str, parent: "SpanContext | Span | None" = None,
         if attrs:
             rec["attrs"] = attrs
         _write(rec)
-    except Exception:
-        pass
+    except Exception as e:
+        count_swallowed("obs.tracing.instant", e)
 
 
 def add_event(name: str, **attrs: Any) -> None:
@@ -450,7 +458,8 @@ def start_rpc_server_span(service: str, method: str, grpc_context):
             if key == METADATA_KEY:
                 parent = extract(value)
                 break
-    except Exception:
+    except Exception as e:
+        count_swallowed("obs.tracing.rpc_server_span", e)
         parent = None
     return start_span(f"rpc:{service}/{method}", parent=parent,
                       service=service, method=method)
@@ -469,8 +478,8 @@ def attach_reply_context(grpc_context,
         if header and grpc_context is not None \
                 and hasattr(grpc_context, "set_trailing_metadata"):
             grpc_context.set_trailing_metadata(((METADATA_KEY, header),))
-    except Exception:
-        pass
+    except Exception as e:
+        count_swallowed("obs.tracing.attach_reply_context", e)
 
 
 def note_reply_metadata(metadata) -> None:
@@ -483,7 +492,8 @@ def note_reply_metadata(metadata) -> None:
             if key == METADATA_KEY:
                 header = value
                 break
-    except Exception:
+    except Exception as e:
+        count_swallowed("obs.tracing.note_reply_metadata", e)
         header = None
     _tls.reply = header
 

@@ -40,6 +40,7 @@ from easydl_tpu.ps import registry
 from easydl_tpu.ps.server import PS_SERVICE, PsShard
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import RpcClient
+from easydl_tpu.utils.env import knob_bool, knob_float, knob_int, knob_str
 
 log = get_logger("ps", "main")
 
@@ -68,9 +69,8 @@ def probe_alive(address: str, timeout: float = 5.0, attempts: int = 2) -> bool:
     slow-rescue triage reads this line instead of attaching a debugger."""
     from easydl_tpu.proto import easydl_pb2 as pb
 
-    timeout = float(os.environ.get("EASYDL_PS_PROBE_TIMEOUT_S", timeout))
-    attempts = max(1, int(os.environ.get("EASYDL_PS_PROBE_RETRIES",
-                                         attempts)))
+    timeout = knob_float("EASYDL_PS_PROBE_TIMEOUT_S", timeout)
+    attempts = max(1, knob_int("EASYDL_PS_PROBE_RETRIES", attempts))
     t0 = time.monotonic()
     last = ""
     for attempt in range(attempts):
@@ -334,16 +334,16 @@ def run_handoff(old: dict, workdir: str, shard: PsShard) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser(description="easydl_tpu PS pod")
-    ap.add_argument("--name", default=os.environ.get("EASYDL_POD_NAME", ""))
-    ap.add_argument("--workdir", default=os.environ.get("EASYDL_WORKDIR", ""))
+    ap.add_argument("--name", default=knob_str("EASYDL_POD_NAME"))
+    ap.add_argument("--workdir", default=knob_str("EASYDL_WORKDIR", ""))
     ap.add_argument("--num-shards", type=int, required=True)
     ap.add_argument("--shard-index", type=int, default=-1,
                     help="default: trailing index of the pod name (fresh "
                          "pods) or inherited from the replaced pod")
     ap.add_argument("--replaces",
-                    default=os.environ.get("EASYDL_REPLACES", ""))
+                    default=knob_str("EASYDL_REPLACES"))
     ap.add_argument("--reshard-dest", action="store_true",
-                    default=bool(os.environ.get("EASYDL_RESHARD_DEST")),
+                    default=knob_bool("EASYDL_RESHARD_DEST"),
                     help="this pod is a DESTINATION shard of an in-flight "
                          "online reshard (ps/reshard.py): skip rescue/claim "
                          "discovery, publish under the migration plan's "

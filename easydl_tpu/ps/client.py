@@ -51,6 +51,8 @@ from easydl_tpu.utils.retry import (
     retry_transient,
 )
 from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, RpcClient
+from easydl_tpu.obs.errors import count_swallowed
+from easydl_tpu.utils.env import knob_int
 
 log = get_logger("ps", "client")
 
@@ -546,7 +548,7 @@ class ShardedPsClient(_PsClientBase):
         # transfers split into ~EASYDL_PS_CHUNK_BYTES value-payload chunks
         # issued concurrently over the shard's HTTP/2 channel. 0 disables.
         self.chunk_bytes = (
-            int(os.environ.get("EASYDL_PS_CHUNK_BYTES", str(1 << 20)))
+            knob_int("EASYDL_PS_CHUNK_BYTES")
             if chunk_bytes is None else chunk_bytes)
         self._chunk_pool: Optional[ThreadPoolExecutor] = None
         self._raw_capable = [False] * self.num_shards
@@ -811,8 +813,8 @@ class ShardedPsClient(_PsClientBase):
             for st in self._stats_shard(0).tables:
                 if st.name == table:
                     return st.dim
-        except Exception:
-            pass
+        except Exception as e:
+            count_swallowed("ps.client.lookup_dim", e)
         return 0
 
     def _wire_ids(self, s, ids) -> dict:

@@ -34,6 +34,8 @@ from easydl_tpu.ps.table import EmbeddingTable, TableSpec, shard_of
 from easydl_tpu.utils.env import env_flag as _env_flag
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, ServiceDef, serve
+from easydl_tpu.utils.env import knob_float
+from easydl_tpu.obs.errors import count_swallowed
 
 log = get_logger("ps", "server")
 
@@ -154,7 +156,7 @@ class PsShard:
         self._workdir = workdir
         self._fenced = False
         self._fence_check_at = 0.0
-        self._fence_check_s = float(os.environ.get(ENV_FENCE_CHECK_S, "0.5"))
+        self._fence_check_s = knob_float(ENV_FENCE_CHECK_S)
         # Push write-ahead log (ps/wal.py): enabled when the shard has a WAL
         # root (pod entrypoint wires <workdir>/ps-wal/shard-<i>) and
         # EASYDL_PS_WAL is not off. `_wal_mu` is the ordering lock: append
@@ -789,8 +791,10 @@ class PsShard:
             from easydl_tpu.ps import registry as _registry
 
             entry = _registry.shard_map(self._workdir).get(self.shard_index)
-        except Exception:
-            return  # registry unreadable: fencing stays client-epoch-driven
+        except Exception as e:
+            # registry unreadable: fencing stays client-epoch-driven
+            count_swallowed("ps.server.fence_check", e)
+            return
         if entry and int(entry.get("epoch", 0)) > self.epoch:
             self._fence(f"registry shows epoch {entry.get('epoch')} "
                         f"publication by {entry.get('pod')!r}")

@@ -56,6 +56,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.utils.env import knob_float, knob_int
 
 log = get_logger("ps", "wal")
 
@@ -301,10 +302,10 @@ class PsWal:
         self.dir = epoch_dir
         os.makedirs(epoch_dir, exist_ok=True)
         self.segment_bytes = int(
-            os.environ.get(ENV_SEGMENT_BYTES, DEFAULT_SEGMENT_BYTES)
+            knob_int(ENV_SEGMENT_BYTES, DEFAULT_SEGMENT_BYTES)
             if segment_bytes is None else segment_bytes)
         self.sync_s = float(
-            os.environ.get(ENV_SYNC_S, DEFAULT_SYNC_S)
+            knob_float(ENV_SYNC_S, DEFAULT_SYNC_S)
             if sync_s is None else sync_s)
         existing = _segments(epoch_dir)
         self._next_index = (int(existing[-1][4:-4]) + 1) if existing else 1

@@ -1,14 +1,242 @@
-"""Process-environment recipes shared across subprocess launchers."""
+"""The EASYDL_* knob registry + process-environment recipes.
+
+Every environment knob the fleet reads is DECLARED here — name, type,
+default, one-line purpose — and read through the typed accessors
+(:func:`knob_str` / :func:`knob_int` / :func:`knob_float` /
+:func:`knob_bool` / :func:`knob_raw`). The declaration is load-bearing
+three ways:
+
+* easylint's ``knob-registry`` rule (analysis/rules/knobs.py) rejects any
+  inline ``os.environ`` read of an ``EASYDL_*`` literal outside this
+  module, and rejects accessor calls whose name is not declared — a
+  typo'd knob fails in lint, not silently in production;
+* the doc-sync test (tests/test_easylint.py) asserts the
+  ``docs/operations.md`` knob table and ``KNOB_DECLS`` agree both ways,
+  so the operator docs cannot rot;
+* the accessors give every knob ONE parsing convention (booleans via the
+  flag grammar below, numbers via int()/float()) and one default,
+  instead of per-call-site drift.
+
+``KNOB_DECLS`` is a pure literal tuple on purpose: the static analyzer
+reads it with ``ast.literal_eval`` — no import side effects required. A
+trailing ``*`` declares a name FAMILY (``EASYDL_METRICS_PORT_<COMP>``).
+"""
 
 from __future__ import annotations
 
 import os
+from dataclasses import dataclass
 from typing import Dict, Mapping, Optional
+
+# --------------------------------------------------------------- registry
+#: (name, type, default, help). type ∈ {str,int,float,bool}; default None
+#: means REQUIRED — reading it when unset raises KeyError, matching the
+#: old `env["EASYDL_RANK"]` behavior for the agent→worker IPC variables.
+KNOB_DECLS = (
+    # -- identity / job wiring (set by launchers, read by services) ------
+    ("EASYDL_WORKDIR", "str", None,
+     "Job working directory: journals, WAL roots, checkpoints, metrics "
+     "files, timelines all live under it."),
+    ("EASYDL_JOB", "str", "",
+     "Job name a pod belongs to (controller process/kube backends)."),
+    ("EASYDL_POD_NAME", "str", "",
+     "Pod name injected by the controller backends (PS pod identity)."),
+    ("EASYDL_POD_ROLE", "str", "",
+     "Pod role (ps/master/agent/serve) injected by controller backends."),
+    ("EASYDL_AGENT_ID", "str", "",
+     "Agent identity passed to worker subprocesses (chaos windows, "
+     "metrics file naming)."),
+    ("EASYDL_REPLACES", "str", "",
+     "Pod name a rescue PS shard replaces (claims its WAL + shard slot)."),
+    ("EASYDL_RESHARD_DEST", "bool", False,
+     "Marks a PS pod as a live-reshard destination (skips rescue probe)."),
+    # -- agent -> worker IPC (required where read) -----------------------
+    ("EASYDL_RANK", "int", None,
+     "Worker rank within the generation (agent->worker spawn env)."),
+    ("EASYDL_WORLD", "int", None,
+     "World size of the generation (agent->worker spawn env)."),
+    ("EASYDL_COORD", "str", None,
+     "jax.distributed coordinator address (agent->worker spawn env)."),
+    ("EASYDL_GEN", "int", None,
+     "Membership generation the worker belongs to."),
+    ("EASYDL_METRICS", "str", None,
+     "Per-agent metrics JSONL path the worker appends step reports to."),
+    ("EASYDL_TIMELINE", "str", "",
+     "Recovery-timeline JSONL path (phase boundary events)."),
+    ("EASYDL_GO_FILE", "str", "",
+     "Rendezvous gate file: worker blocks until it appears."),
+    ("EASYDL_WARM_FILE", "str", "",
+     "Warm-standby gate file: standby imports+compiles, then blocks."),
+    ("EASYDL_MASTER_WAIT_S", "float", 600.0,
+     "How long an agent waits for a master before giving up."),
+    # -- logging / metrics exporter --------------------------------------
+    ("EASYDL_LOG_LEVEL", "str", "INFO",
+     "Root logger level for every easydl_tpu process."),
+    ("EASYDL_METRICS_HOST", "str", "",
+     "Bind host for /metrics exporters (default localhost)."),
+    ("EASYDL_METRICS_PORT", "int", 0,
+     "Exporter port for all components; 0 picks a free port; "
+     "off/disabled/negative disables."),
+    ("EASYDL_METRICS_PORT_*", "int", 0,
+     "Per-component exporter port override; wins over "
+     "EASYDL_METRICS_PORT."),
+    ("EASYDL_METRICS_PORT_MASTER", "int", 0,
+     "Exporter port for the elastic master."),
+    ("EASYDL_METRICS_PORT_AGENT", "int", 0,
+     "Exporter port for the elastic agent."),
+    ("EASYDL_METRICS_PORT_PS", "int", 0,
+     "Exporter port for PS shard pods."),
+    ("EASYDL_METRICS_PORT_BRAIN", "int", 0,
+     "Exporter port for the Brain service."),
+    ("EASYDL_METRICS_PORT_CONTROLLER", "int", 0,
+     "Exporter port for the controller/operator."),
+    ("EASYDL_METRICS_PORT_SERVE", "int", 0,
+     "Exporter port for serving replicas."),
+    # -- tracing ----------------------------------------------------------
+    ("EASYDL_TRACE", "str", "",
+     "Arms distributed tracing; ''/0/off/false/no/disabled/none = off."),
+    ("EASYDL_TRACE_CONTEXT", "str", "",
+     "Injected parent span context (subprocess hop of propagation)."),
+    ("EASYDL_TRACE_PROC", "str", "",
+     "Process name override for the flight recorder."),
+    ("EASYDL_TRACE_MAX_BYTES", "int", 8_388_608,  # 8 MiB
+     "Flight-recorder ring size per process."),
+    ("EASYDL_TRACE_STEP_EVERY", "int", 25,
+     "Worker traces every Nth train step."),
+    # -- parameter server -------------------------------------------------
+    ("EASYDL_PS_WAL", "bool", True,
+     "Push write-ahead log on/off (zero-loss recovery, PR 6)."),
+    ("EASYDL_PS_WAL_SEGMENT_BYTES", "int", 33_554_432,  # 32 MiB
+     "WAL segment roll size."),
+    ("EASYDL_PS_WAL_SYNC_S", "float", 0.2,
+     "WAL fsync cadence; 0 = fsync every append."),
+    ("EASYDL_PS_FENCE_CHECK_S", "float", 0.5,
+     "Zombie self-check cadence against the registry epoch."),
+    ("EASYDL_PS_PROBE_TIMEOUT_S", "float", 5.0,
+     "Rescue probe per-attempt timeout."),
+    ("EASYDL_PS_PROBE_RETRIES", "int", 2,
+     "Rescue probe attempts before declaring a shard dead."),
+    ("EASYDL_PS_CHUNK_BYTES", "int", 1_048_576,  # 1 MiB
+     "Client-side pull/push chunking target."),
+    ("EASYDL_PS_COALESCE", "bool", True,
+     "Duplicate-id coalescing on pull (trainer path defaults off)."),
+    ("EASYDL_PS_RAW_IDS", "bool", True,
+     "Zero-copy raw-bytes id wire format (falls back per shard)."),
+    ("EASYDL_PS_PULL_FP16", "bool", False,
+     "Negotiate fp16 pull payloads (halves the wire)."),
+    ("EASYDL_PS_STORE_LOOP", "bool", False,
+     "Force the python reference row-apply loop (bench comparisons)."),
+    ("EASYDL_PS_SPLIT_HOT_RATIO", "float", 1.5,
+     "Hot-shard split trigger: shard rows vs mean ratio."),
+    ("EASYDL_PS_SPLIT_MIN_ROWS", "float", 100_000.0,
+     "Minimum total rows before split decisions engage."),
+    ("EASYDL_PS_SPLIT_MAX_SHARDS", "int", 64,
+     "Upper bound on PS shard fan-out from auto-splits."),
+    # -- serving ----------------------------------------------------------
+    ("EASYDL_SERVE_TARGET_QPS", "float", 500.0,
+     "Per-replica QPS target for the autoscale policy."),
+    ("EASYDL_SERVE_P99_BUDGET_S", "float", 0.050,
+     "p99 latency budget for the autoscale policy."),
+    ("EASYDL_SERVE_MIN_REPLICAS", "int", 1,
+     "Autoscale floor for serving replicas."),
+    ("EASYDL_SERVE_MAX_REPLICAS", "int", 64,
+     "Autoscale ceiling for serving replicas."),
+    # -- storage / caches -------------------------------------------------
+    ("EASYDL_COMPILE_CACHE", "str", "",
+     "Persistent XLA compile cache dir; off disables; '' = workdir "
+     "default."),
+    ("EASYDL_CHUNK_CACHE", "str", "",
+     "Dataset chunk cache: 0/off disables, a path overrides the root."),
+    ("EASYDL_GCS_ENDPOINT", "str", "https://storage.googleapis.com",
+     "GCS base URL override (fake server / proxy)."),
+    ("EASYDL_GCE_METADATA_URL", "str", "",
+     "GCE metadata server override (tests, proxies)."),
+    # -- chaos / harness child markers ------------------------------------
+    ("EASYDL_CHAOS_SPEC", "str", "",
+     "Armed chaos scenario spec path; unset = every hook is one dict "
+     "lookup."),
+    ("EASYDL_CHAOS_CHILD", "str", "",
+     "Marks the re-exec'd forced-CPU chaos_run child ('1')."),
+    ("EASYDL_RECOVERY_CHILD", "str", "",
+     "Marks the re-exec'd measure_recovery child ('1')."),
+    ("EASYDL_PIPEBENCH_CHILD", "str", "",
+     "Marks the re-exec'd bench_pipeline child ('1')."),
+)
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str
+    default: object
+    help: str
+
+
+KNOBS: Dict[str, Knob] = {d[0]: Knob(*d) for d in KNOB_DECLS}
+
+_UNSET = object()
+
+
+def _declared(name: str) -> Knob:
+    k = KNOBS.get(name)
+    if k is not None:
+        return k
+    for fam, kf in KNOBS.items():  # family declarations: trailing *
+        if fam.endswith("*") and name.startswith(fam[:-1]):
+            return kf
+    raise KeyError(
+        f"{name} is not declared in easydl_tpu.utils.env.KNOB_DECLS — "
+        "declare it (name, type, default, help) and add it to the "
+        "docs/operations.md knob table")
+
+
+def knob_raw(name: str, env: Optional[Mapping[str, str]] = None,
+             ) -> Optional[str]:
+    """The declared-but-untyped read: raw value or None when unset. For
+    save/restore idioms and presence checks; typed reads use knob_*."""
+    _declared(name)
+    return (env if env is not None else os.environ).get(name)
+
+
+def _resolve(name: str, default, env) -> Optional[str]:
+    knob = _declared(name)
+    v = (env if env is not None else os.environ).get(name)
+    if v is not None:
+        return v
+    d = knob.default if default is _UNSET else default
+    if d is None:
+        raise KeyError(f"required knob {name} is not set")
+    return d
+
+
+def knob_str(name: str, default=_UNSET,
+             env: Optional[Mapping[str, str]] = None) -> str:
+    return str(_resolve(name, default, env))
+
+
+def knob_int(name: str, default=_UNSET,
+             env: Optional[Mapping[str, str]] = None) -> int:
+    return int(_resolve(name, default, env))
+
+
+def knob_float(name: str, default=_UNSET,
+               env: Optional[Mapping[str, str]] = None) -> float:
+    return float(_resolve(name, default, env))
+
+
+def knob_bool(name: str, default=_UNSET,
+              env: Optional[Mapping[str, str]] = None) -> bool:
+    v = _resolve(name, default, env)
+    if isinstance(v, bool):
+        return v
+    return v not in ("", "0", "false", "False")
 
 
 def env_flag(name: str, default: bool) -> bool:
     """Boolean EASYDL_* knob convention: unset → ``default``; ``"0"``,
-    ``"false"``/``"False"`` and empty mean off; anything else means on."""
+    ``"false"``/``"False"`` and empty mean off; anything else means on.
+    (Deliberately lenient about undeclared names — tests mint throwaway
+    flags; the knob-registry lint still checks literal in-tree uses.)"""
     v = os.environ.get(name)
     if v is None:
         return default

@@ -8,9 +8,9 @@ here so component/role/host are always attached.
 from __future__ import annotations
 
 import logging
-import os
 import sys
 import time
+from easydl_tpu.utils.env import knob_str
 from typing import Optional
 
 _FORMAT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
@@ -25,7 +25,7 @@ def _configure_root() -> None:
     handler.setFormatter(logging.Formatter(_FORMAT))
     root = logging.getLogger("easydl_tpu")
     root.addHandler(handler)
-    level = os.environ.get("EASYDL_LOG_LEVEL", "INFO").upper()
+    level = knob_str("EASYDL_LOG_LEVEL").upper()
     if level not in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
         level = "INFO"
     root.setLevel(level)

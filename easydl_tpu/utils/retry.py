@@ -22,6 +22,7 @@ import time
 from typing import Callable, Optional, TypeVar
 
 from easydl_tpu.utils.logging import get_logger
+from easydl_tpu.obs.errors import count_swallowed
 
 log = get_logger("utils", "retry")
 
@@ -97,8 +98,10 @@ def retry_transient(
 
                 tracing.add_event("retry", attempt=attempt + 1,
                                   what=describe, error=repr(e))
-            except Exception:
-                pass
+            except Exception as trace_err:
+                # `as e` here would UNBIND the outer retry exception on
+                # handler exit and NameError the on_retry/log lines below
+                count_swallowed("utils.retry.trace_event", trace_err)
             if on_retry is not None:
                 try:
                     on_retry(e)

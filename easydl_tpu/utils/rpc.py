@@ -31,6 +31,7 @@ import grpc
 
 from easydl_tpu.obs import get_registry
 from easydl_tpu.obs import tracing
+from easydl_tpu.utils.env import knob_raw
 
 
 @dataclass(frozen=True)
@@ -100,7 +101,7 @@ def _instrument(fn: Callable, side: str, service: str,
             # raise a handler-class error, per the scenario's scheduled
             # windows. Inside the try so injected faults land in the same
             # request/error/latency series as real ones.
-            if os.environ.get("EASYDL_CHAOS_SPEC"):
+            if knob_raw("EASYDL_CHAOS_SPEC"):
                 from easydl_tpu.chaos.injectors import (
                     ChaosUnavailable,
                     rpc_fault,

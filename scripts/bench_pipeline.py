@@ -29,6 +29,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+from easydl_tpu.utils.env import knob_raw  # noqa: E402
+
 
 def measure(steps: int) -> dict:
     import jax
@@ -110,7 +112,7 @@ def main() -> None:
     ap.add_argument("--out", default=os.path.join(REPO, "PROFILE.json"))
     args = ap.parse_args()
 
-    if os.environ.get("EASYDL_PIPEBENCH_CHILD") != "1":
+    if knob_raw("EASYDL_PIPEBENCH_CHILD") != "1":
         import subprocess
 
         from easydl_tpu.utils.env import cpu_subprocess_env

@@ -34,6 +34,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+from easydl_tpu.utils.env import knob_raw  # noqa: E402
+
 
 def next_round(out_dir: str) -> int:
     rounds = [0]
@@ -60,7 +62,7 @@ def main() -> None:
                     help="list scenarios and exit")
     args = ap.parse_args()
 
-    if os.environ.get("EASYDL_CHAOS_CHILD") != "1" and not args.list:
+    if knob_raw("EASYDL_CHAOS_CHILD") != "1" and not args.list:
         import jax
 
         if jax.default_backend() != "cpu":

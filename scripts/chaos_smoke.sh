@@ -22,6 +22,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# Repo-invariant gate FIRST (docs/design/static-analysis.md): the drills
+# below assume the disciplines easylint enforces (WAL-then-apply ordering,
+# instrumented RPCs, virtual-clock-pure policies) — if those rotted, fail
+# in seconds here, not after a seven-minute drill chases the symptom.
+python scripts/easylint.py
+
 LOG=$(mktemp)
 trap 'rm -f "$LOG"' EXIT
 

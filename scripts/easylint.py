@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""easylint CLI: run the repo-invariant analyzer and gate on the baseline.
+
+Usage::
+
+    python scripts/easylint.py                      # easydl_tpu/ scripts/
+    python scripts/easylint.py easydl_tpu/ps        # a subtree
+    python scripts/easylint.py --list-rules
+    python scripts/easylint.py --update-baseline    # regenerate allowlist
+
+Exit status is the gate: 0 when every finding is covered by the committed
+baseline (scripts/codestyle/easylint_baseline.txt) and no baseline entry
+still carries the TODO reason marker; 1 on any new finding, TODO-stamped
+entry, or malformed baseline. Stale entries (allowlisted violations that
+no longer exist) are reported as warnings here and rejected by the tier-1
+gate (tests/test_easylint.py) so the baseline can only shrink silently,
+never grow. ``--update-baseline`` rewrites the allowlist sorted/deduped,
+preserving existing reasons and stamping new entries with a TODO the gate
+refuses — baselining always requires a human-written reason
+(docs/operations.md#easylint).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from easydl_tpu.analysis import baseline as bl  # noqa: E402
+from easydl_tpu.analysis.core import analyze_paths  # noqa: E402
+from easydl_tpu.analysis.rules import all_rules  # noqa: E402
+
+DEFAULT_PATHS = ("easydl_tpu", "scripts")
+DEFAULT_BASELINE = os.path.join("scripts", "codestyle",
+                                "easylint_baseline.txt")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="easylint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to analyze (default: {DEFAULT_PATHS})")
+    ap.add_argument("--root", default=REPO,
+                    help="repo root paths are relative to (default: the "
+                         "checkout containing this script)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"allowlist file (default: <root>/{DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the allowlist; report every finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the allowlist from current findings "
+                         "(reasons preserved, new entries TODO-stamped)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name}: {r.invariant}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    paths = args.paths or list(DEFAULT_PATHS)
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+
+    try:
+        findings = analyze_paths(paths, rules, root=root)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+
+    if args.update_baseline:
+        entries = bl.load(baseline_path)
+        merged = bl.updated(findings, entries)
+        bl.save(baseline_path, merged)
+        todo = sum(1 for e in merged if e.reason == bl.TODO_REASON)
+        print(f"easylint: baseline rewritten: {len(merged)} entries "
+              f"({todo} need a reason) -> {baseline_path}")
+        if todo:
+            print("easylint: replace every TODO reason before committing — "
+                  "the gate rejects TODO-stamped entries")
+        return 0
+
+    if args.no_baseline:
+        entries = []
+    else:
+        try:
+            entries = bl.load(baseline_path)
+        except ValueError as e:
+            print(f"easylint: {e}", file=sys.stderr)
+            return 1
+
+    new, stale = bl.match(findings, entries)
+    todo = [e for e in entries if e.reason == bl.TODO_REASON]
+
+    for f in new:
+        print(f.render())
+    for e in stale:
+        print(f"easylint: WARNING stale baseline entry (violation is gone "
+              f"— delete the line or run --update-baseline): {e.render()}",
+              file=sys.stderr)
+    for e in todo:
+        print(f"easylint: baseline entry lacks a reason: {e.render()}",
+              file=sys.stderr)
+
+    n_rules = len(rules)
+    n_ok = len(findings) - len(new)
+    print(f"easylint: {n_rules} rules, {len(findings)} findings "
+          f"({n_ok} baselined, {len(new)} new, {len(stale)} stale, "
+          f"{len(todo)} TODO)", file=sys.stderr)
+    return 1 if (new or todo) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

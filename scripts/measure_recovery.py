@@ -44,6 +44,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+from easydl_tpu.utils.env import knob_raw  # noqa: E402
+
 
 def read_metrics(workdir: str, agent_id: str):
     path = os.path.join(workdir, f"metrics-{agent_id}.jsonl")
@@ -445,7 +447,7 @@ def main() -> None:
     ap.add_argument("--out", default=os.path.join(REPO, "RECOVERY.json"))
     args = ap.parse_args()
 
-    if os.environ.get("EASYDL_RECOVERY_CHILD") != "1":
+    if knob_raw("EASYDL_RECOVERY_CHILD") != "1":
         import jax
 
         if jax.default_backend() != "cpu":

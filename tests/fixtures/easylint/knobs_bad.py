@@ -1,0 +1,22 @@
+"""Known-bad fixture: inline EASYDL_* environ reads and an undeclared
+accessor name — the knob-registry rule MUST flag every marked site.
+
+The fixture test injects declared=("EASYDL_FIXTURE_KNOB",) so the names
+here are self-contained (no dependency on the live registry's contents).
+"""
+
+import os
+
+from easydl_tpu.utils.env import knob_str
+
+SPEC_VAR = "EASYDL_FIXTURE_KNOB"
+
+
+def read_everything(env):
+    a = os.environ.get("EASYDL_FIXTURE_KNOB")       # FLAG: inline .get
+    b = os.environ["EASYDL_FIXTURE_KNOB"]           # FLAG: inline subscript
+    c = os.getenv("EASYDL_FIXTURE_KNOB")            # FLAG: os.getenv
+    d = os.environ.get(SPEC_VAR)                    # FLAG: via constant
+    e = env.get("EASYDL_FIXTURE_KNOB")              # FLAG: mapping param
+    f = knob_str("EASYDL_FIXTURE_UNDECLARED")       # FLAG: undeclared knob
+    return a, b, c, d, e, f

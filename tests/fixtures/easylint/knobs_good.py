@@ -1,0 +1,24 @@
+"""Known-good fixture: declared typed-accessor reads, env WRITES, and
+non-EASYDL names — the knob-registry rule MUST stay quiet."""
+
+import os
+
+from easydl_tpu.utils.env import env_flag, knob_raw, knob_str
+
+
+def read_declared(env):
+    a = knob_str("EASYDL_FIXTURE_KNOB")             # declared accessor read
+    b = knob_raw("EASYDL_FIXTURE_KNOB", env=env)    # declared raw read
+    c = env_flag("EASYDL_FIXTURE_KNOB", False)      # declared flag read
+    return a, b, c
+
+
+def write_and_restore():
+    os.environ["EASYDL_FIXTURE_KNOB"] = "1"         # a WRITE: fine
+    os.environ.pop("EASYDL_FIXTURE_KNOB", None)     # restore idiom: fine
+
+
+def unrelated_namespaces(cfg):
+    jax = os.environ.get("JAX_PLATFORMS", "")       # not our namespace: fine
+    model = cfg.get("EASYDL_FIXTURE_KNOB")          # config dict, not env: fine
+    return jax, model

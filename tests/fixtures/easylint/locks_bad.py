@@ -1,0 +1,26 @@
+"""Known-bad fixture: blocking calls under a hot lock — easylint's
+blocking-call-under-lock rule MUST flag every marked site."""
+
+import subprocess
+import time
+
+
+class Shard:
+    def __init__(self, lock, client, wal):
+        self._lock = lock
+        self._wal_mu = lock
+        self._client = client
+        self._wal = wal
+
+    def stall_everyone(self):
+        with self._lock:
+            time.sleep(0.1)                  # FLAG: time.sleep
+            subprocess.run(["true"])         # FLAG: subprocess.run
+
+    def rpc_under_lock(self):
+        with self._lock:
+            return self._client.Pull(None)   # FLAG: rpc stub call
+
+    def append_under_ordering_lock(self):
+        with self._wal_mu:
+            self._wal.append(b"rec")         # FLAG: wal-append (baselinable)

@@ -1,0 +1,31 @@
+"""Known-good fixture: the blocking-call-under-lock rule MUST stay quiet —
+work outside the hold, deferred closures, and non-lock contexts."""
+
+import subprocess
+import time
+
+
+class Shard:
+    def __init__(self, lock, pool):
+        self._lock = lock
+        self._pool = pool
+
+    def sleep_outside(self):
+        with self._lock:
+            snapshot = dict()
+        time.sleep(0.1)  # outside the hold: fine
+        return snapshot
+
+    def deferred_under_lock(self):
+        with self._lock:
+            def later():
+                # defined under the lock but runs after release: fine
+                time.sleep(0.1)
+                subprocess.run(["true"])
+
+            self._pool.submit(later)
+
+    def non_lock_context(self, path):
+        with open(path) as f:  # a file, not a lock: fine
+            time.sleep(0.0)
+            return f.read()

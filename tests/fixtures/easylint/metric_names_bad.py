@@ -1,0 +1,16 @@
+"""Known-bad fixture: metric registrations breaking the easydl_*
+conventions — the metric-name rule MUST flag every marked site."""
+
+from easydl_tpu.obs.registry import get_registry
+
+reg = get_registry()
+
+C1 = reg.counter("easydl_serve_hits", "no _total")        # FLAG
+C2 = reg.counter("Easydl-Serve-Hits_total", "grammar")    # FLAG
+C3 = reg.counter("hits_total", "no easydl_ prefix")       # FLAG
+H1 = reg.histogram("easydl_serve_wait", "no unit")        # FLAG
+G1 = reg.gauge("easydl_serve_depth", "reserved", ("le",))           # FLAG
+G2 = reg.gauge("easydl_serve_depth2", "unknown", ("made_up_lbl",))  # FLAG
+
+_name = "easydl_" + "serve_dyn_total"
+C4 = reg.counter(_name, "unverifiable")                   # FLAG

@@ -1,0 +1,24 @@
+"""Known-good fixture: convention-following registrations — the
+metric-name rule MUST stay quiet, including on the f-string and the
+module-tuple-constant label forms utils/rpc.py uses."""
+
+from easydl_tpu.obs.registry import get_registry
+
+reg = get_registry()
+
+_RPC_LABELS = ("service", "method")
+
+C1 = reg.counter("easydl_serve_requests_total", "ok", ("verdict",))
+G1 = reg.gauge("easydl_serve_queue_examples", "ok", ("replica",))
+H1 = reg.histogram("easydl_serve_request_latency_seconds", "ok",
+                   labelnames=("replica",))
+
+
+def per_side(side: str):
+    return reg.counter(f"easydl_rpc_{side}_requests_total", "ok",
+                       _RPC_LABELS)
+
+
+def not_a_registry(pool):
+    # .counter() on a non-registry receiver is out of scope
+    return pool.counter("whatever")

@@ -1,0 +1,14 @@
+"""Known-bad fixture: raw gRPC plumbing outside the blessed seams — the
+naked-rpc rule MUST flag the channel build and the stub factory."""
+
+import grpc
+
+
+def connect(addr):
+    channel = grpc.insecure_channel(addr)            # FLAG: raw channel
+    call = channel.unary_unary("/easydl.Svc/Do")     # FLAG: stub factory
+    return call
+
+
+def host(service_impl):
+    return grpc.server(None)                         # FLAG: raw server

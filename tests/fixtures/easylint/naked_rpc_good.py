@@ -1,0 +1,24 @@
+"""Known-good fixture: classification/abort reads of grpc and the
+instrumented RpcClient wrap — the naked-rpc rule MUST stay quiet."""
+
+import grpc
+
+from easydl_tpu.utils.rpc import RpcClient
+
+
+def classify(e):
+    if isinstance(e, grpc.RpcError):          # read-side: fine
+        return e.code() == grpc.StatusCode.UNAVAILABLE
+    return False
+
+
+def refuse(ctx, msg):
+    ctx.abort(grpc.StatusCode.UNAVAILABLE, msg)  # servicer abort: fine
+
+
+def call(service, addr, req):
+    client = RpcClient(service, addr)         # the blessed wrap: fine
+    try:
+        return client.Do(req)
+    finally:
+        client.close()

@@ -1,0 +1,18 @@
+"""Known-bad fixture: wall-clock and global-RNG leaks in a module the
+simulator replays — the virtual-clock-purity rule MUST flag each one."""
+
+import random
+import time
+from dataclasses import field
+
+
+def observe():
+    now = time.time()                  # FLAG: wall clock
+    skew = random.random()             # FLAG: process-global RNG
+    return now + skew
+
+
+def latent_leak():
+    # reads the REAL clock at dataclass construction time — the exact
+    # membership.py bug this PR fixed
+    return field(default_factory=time.monotonic)   # FLAG: reference

@@ -1,0 +1,18 @@
+"""Known-good fixture: the injection seams the purity rule MUST allow —
+clock as a default-arg seam, seeded random.Random, injected use."""
+
+import random
+import time
+from typing import Callable
+
+
+class Policy:
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 rng=None):
+        # default-arg position is the sanctioned injection seam
+        self._clock = clock
+        self._rng = rng or random.Random(7)   # seeded instance: fine
+
+    def decide(self):
+        now = self._clock()                   # injected clock: fine
+        return now + self._rng.random()       # owned rng: fine

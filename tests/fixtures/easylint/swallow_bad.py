@@ -1,0 +1,23 @@
+"""Known-bad fixture: silent broad excepts — the counted-swallow rule
+MUST flag the silent pass, the bare except, and the silent return."""
+
+
+def silent_pass(conn):
+    try:
+        conn.close()
+    except Exception:
+        pass                       # FLAG: silent-swallow
+
+
+def bare_except(conn):
+    try:
+        conn.flush()
+    except:                        # FLAG: bare-except  # noqa: E722
+        return None
+
+
+def silent_return(payload):
+    try:
+        return payload.decode()
+    except Exception:
+        return ""                  # FLAG: swallows without observing

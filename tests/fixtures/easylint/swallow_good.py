@@ -1,0 +1,42 @@
+"""Known-good fixture: broad excepts that log, count, re-raise, or narrow
+— the counted-swallow rule MUST stay quiet on every handler here."""
+
+from easydl_tpu.obs.errors import count_swallowed
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("tests", "fixture")
+
+
+def logged(conn):
+    try:
+        conn.close()
+    except Exception as e:
+        log.warning("close failed: %s", e)       # logged: fine
+
+
+def counted(conn):
+    try:
+        conn.flush()
+    except Exception as e:
+        count_swallowed("fixture.flush", e)      # counted: fine
+
+
+def counted_metric(conn, metric):
+    try:
+        conn.sync()
+    except Exception:
+        metric.inc(site="fixture")               # metric: fine
+
+
+def reraised(payload):
+    try:
+        return payload.decode()
+    except Exception:
+        raise                                    # re-raised: fine
+
+
+def narrowed(tmp):
+    try:
+        tmp.unlink()
+    except OSError:
+        pass                                     # narrow type: fine

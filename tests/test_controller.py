@@ -700,3 +700,42 @@ def test_terminal_gc_grants_evaluator_grace():
     time.sleep(0.15)
     ctl2.reconcile_job("deepctr")
     assert api2.get_pod("deepctr-evaluator-0") is None
+
+
+def test_pod_api_shutdown_reaps_mid_spawn_creates(tmp_path, monkeypatch):
+    """Regression: create_pod spawns OUTSIDE the table lock (easylint's
+    blocking-call-under-lock fix); a shutdown()/delete_pod() landing in
+    that window must still cover the child — the late registration kills
+    it instead of leaking it past teardown."""
+    import subprocess as _subprocess
+    import time as _time
+
+    from easydl_tpu.controller.pod_api import Pod
+    from easydl_tpu.controller import process_pod_api as mod
+
+    api = mod.LocalProcessPodApi(str(tmp_path))
+    real_popen = _subprocess.Popen
+    spawned = {}
+
+    def popen_with_race(*args, **kwargs):
+        proc = real_popen(*args, **kwargs)
+        spawned["proc"] = proc
+        api.delete_pod("racer")  # lands while the name is only _pending
+        return proc
+
+    monkeypatch.setattr(mod.subprocess, "Popen", popen_with_race)
+    api.create_pod(Pod(name="racer", role="worker", job="j",
+                       command="sleep 30"))
+    # not registered, and the child did not leak
+    assert api.list_pods() == []
+    deadline = _time.monotonic() + 5
+    while spawned["proc"].poll() is None and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    assert spawned["proc"].poll() is not None, "mid-spawn child leaked"
+
+    # after shutdown(), create_pod refuses outright
+    monkeypatch.setattr(mod.subprocess, "Popen", real_popen)
+    api.shutdown()
+    with pytest.raises(ValueError):
+        api.create_pod(Pod(name="late", role="worker", job="j",
+                           command="sleep 30"))

@@ -1,0 +1,317 @@
+"""easylint: per-rule fixture proofs, baseline round-trip, the tier-1
+whole-tree gate, the CLI contract, and the knob doc-sync check.
+
+Anti-vacuous by construction (same style as the chaos invariants'
+negative controls): every rule must FIRE on its known-bad fixture —
+with the exact expected details — and stay QUIET on the adjacent
+known-good fixture, so a rule that silently stops matching cannot pass.
+"""
+
+import ast
+import os
+import subprocess
+import sys
+
+import pytest
+
+from easydl_tpu.analysis import baseline as bl
+from easydl_tpu.analysis.core import (
+    analyze_file,
+    analyze_paths,
+    collect_files,
+)
+from easydl_tpu.analysis.rules import (
+    BlockingCallUnderLock,
+    CountedSwallow,
+    KnobRegistry,
+    MetricNameLint,
+    NakedRpc,
+    VirtualClockPurity,
+    all_rules,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "easylint")
+BASELINE = os.path.join(REPO, "scripts", "codestyle",
+                        "easylint_baseline.txt")
+
+
+def run_rule(rule, fixture, fake_path):
+    """Run one rule over a fixture file under a pretend repo path (rules
+    scope by path: swallow to easydl_tpu/, purity to sim/, …)."""
+    with open(os.path.join(FIXTURES, fixture), encoding="utf-8") as f:
+        src = f.read()
+    return rule.check(fake_path, ast.parse(src), src)
+
+
+FIXTURE_KNOBS = ("EASYDL_FIXTURE_KNOB",)
+
+#: (rule factory, fixture stem, fake repo path, details the bad fixture
+#: MUST produce — a subset check, exact names).
+CASES = [
+    (BlockingCallUnderLock, "locks", "easydl_tpu/ps/fake.py",
+     {"time.sleep", "subprocess.run", "rpc:Pull", "wal-append"}),
+    (NakedRpc, "naked_rpc", "easydl_tpu/elastic/fake.py",
+     {"grpc.insecure_channel", "grpc.server", "stub-factory:unary_unary"}),
+    (lambda: KnobRegistry(declared=FIXTURE_KNOBS), "knobs",
+     "easydl_tpu/ps/fake.py",
+     {"EASYDL_FIXTURE_KNOB",
+      "undeclared-knob:EASYDL_FIXTURE_UNDECLARED"}),
+    (CountedSwallow, "swallow", "easydl_tpu/ps/fake.py",
+     {"silent-swallow", "bare-except"}),
+    (VirtualClockPurity, "purity", "easydl_tpu/sim/fake.py",
+     {"time.time", "random.random", "time.monotonic"}),
+    (MetricNameLint, "metric_names", "easydl_tpu/serve/fake.py",
+     {"counter-no-total:easydl_serve_hits",
+      "bad-name:Easydl-Serve-Hits_total",
+      "bad-name:hits_total",
+      "histogram-no-unit:easydl_serve_wait",
+      "bad-label:le",
+      "unknown-label:made_up_lbl",
+      "unverifiable-name"}),
+]
+
+
+@pytest.mark.parametrize(
+    "make_rule,stem,path,expected",
+    CASES, ids=[c[1] for c in CASES])
+def test_rule_fires_on_bad_fixture(make_rule, stem, path, expected):
+    findings = run_rule(make_rule(), f"{stem}_bad.py", path)
+    details = {f.detail for f in findings}
+    missing = expected - details
+    assert not missing, (
+        f"{stem}: rule failed to flag known-bad sites {missing}; "
+        f"got {sorted(details)}")
+
+
+@pytest.mark.parametrize(
+    "make_rule,stem,path,expected",
+    CASES, ids=[c[1] for c in CASES])
+def test_rule_quiet_on_good_fixture(make_rule, stem, path, expected):
+    findings = run_rule(make_rule(), f"{stem}_good.py", path)
+    assert findings == [], (
+        f"{stem}: rule flagged known-good code: "
+        f"{[f.render() for f in findings]}")
+
+
+def test_knob_bad_fixture_flags_every_inline_read_form():
+    rule = KnobRegistry(declared=FIXTURE_KNOBS)
+    findings = run_rule(rule, "knobs_bad.py", "easydl_tpu/ps/fake.py")
+    # .get / [subscript] / os.getenv / constant / mapping-param + the
+    # undeclared accessor: six distinct sites
+    assert len(findings) == 6, [f.render() for f in findings]
+
+
+def test_swallow_rule_scoped_to_easydl_tpu():
+    # the same bad code outside easydl_tpu/ is out of the rule's scope
+    assert run_rule(CountedSwallow(), "swallow_bad.py",
+                    "scripts/fake.py") == []
+
+
+def test_purity_rule_scoped_to_replayed_modules():
+    assert run_rule(VirtualClockPurity(), "purity_bad.py",
+                    "easydl_tpu/elastic/agent_like.py") == []
+
+
+def test_naked_rpc_allowed_inside_blessed_seams():
+    assert run_rule(NakedRpc(), "naked_rpc_bad.py",
+                    "easydl_tpu/utils/rpc.py") == []
+
+
+# ------------------------------------------------------------------ baseline
+def test_baseline_round_trip(tmp_path):
+    path = str(tmp_path / "base.txt")
+    entries = [
+        bl.BaselineEntry("r", "a.py", "f", "d", "because reasons"),
+        bl.BaselineEntry("r", "a.py", "f", "d#2", "also reasons"),
+    ]
+    bl.save(path, entries)
+    loaded = bl.load(path)
+    assert sorted(e.render() for e in loaded) == \
+        sorted(e.render() for e in entries)
+    # save() sorts and dedupes
+    bl.save(path, entries + entries)
+    assert len(bl.load(path)) == 2
+
+
+def test_baseline_rejects_missing_reason(tmp_path):
+    path = tmp_path / "base.txt"
+    path.write_text("rule|p.py|scope|detail|   \n")
+    with pytest.raises(ValueError):
+        bl.load(str(path))
+
+
+def test_baseline_match_multiset_and_stale():
+    from easydl_tpu.analysis.core import Finding
+
+    f = Finding("r", "a.py", 1, "f", "d", "m")
+    have = [bl.BaselineEntry("r", "a.py", "f", "d", "why"),
+            bl.BaselineEntry("r", "b.py", "g", "d", "why")]
+    new, stale = bl.match([f, f], have)
+    # one consumed, one finding new, one entry stale
+    assert len(new) == 1 and new[0].key() == f.key()
+    assert [e.path for e in stale] == ["b.py"]
+
+
+def test_update_preserves_reasons_and_stamps_new():
+    from easydl_tpu.analysis.core import Finding
+
+    old = [bl.BaselineEntry("r", "a.py", "f", "d", "human reason")]
+    findings = [Finding("r", "a.py", 1, "f", "d", "m"),
+                Finding("r", "a.py", 9, "g", "d", "m")]
+    merged = bl.updated(findings, old)
+    reasons = {(e.scope): e.reason for e in merged}
+    assert reasons["f"] == "human reason"
+    assert reasons["g"] == bl.TODO_REASON
+
+
+# ------------------------------------------------------------------ CLI
+def _run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "easylint.py")]
+        + args, capture_output=True, text=True, cwd=cwd)
+
+
+def test_cli_gate_and_update_baseline(tmp_path):
+    root = tmp_path / "repo"
+    (root / "easydl_tpu").mkdir(parents=True)
+    bad = root / "easydl_tpu" / "mod.py"
+    bad.write_text('"""Doc."""\n\n\ndef f(c):\n    try:\n        c()\n'
+                   "    except Exception:\n        pass\n")
+    base = str(root / "base.txt")
+
+    # new finding → exit 1, reported on stdout
+    r = _run_cli(["--root", str(root), "--baseline", base, "easydl_tpu"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "counted-swallow" in r.stdout
+
+    # --update-baseline writes TODO-stamped entries and exits 0 …
+    r = _run_cli(["--root", str(root), "--baseline", base,
+                  "--update-baseline", "easydl_tpu"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert bl.TODO_REASON in open(base).read()
+
+    # … but the gate refuses TODO reasons until a human writes one
+    r = _run_cli(["--root", str(root), "--baseline", base, "easydl_tpu"])
+    assert r.returncode == 1
+    assert "lacks a reason" in r.stderr
+
+    content = open(base).read().replace(bl.TODO_REASON, "fixture says so")
+    open(base, "w").write(content)
+    r = _run_cli(["--root", str(root), "--baseline", base, "easydl_tpu"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # fixing the violation turns the entry stale (warned, exit still 0)
+    bad.write_text('"""Doc."""\n\n\ndef f(c):\n    c()\n')
+    r = _run_cli(["--root", str(root), "--baseline", base, "easydl_tpu"])
+    assert r.returncode == 0
+    assert "stale" in r.stderr
+
+
+# ------------------------------------------------------------- tier-1 gate
+def test_tree_is_clean_against_committed_baseline():
+    """THE gate: zero un-baselined findings over easydl_tpu/ + scripts/,
+    zero stale allowlist entries, zero TODO reasons — the committed
+    baseline can only shrink unless a reviewed reason is added."""
+    findings = analyze_paths(["easydl_tpu", "scripts"], all_rules(),
+                             root=REPO)
+    entries = bl.load(BASELINE)
+    new, stale = bl.match(findings, entries)
+    assert new == [], "un-baselined findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert stale == [], (
+        "stale baseline entries (violation fixed — delete the line / run "
+        "--update-baseline):\n" + "\n".join(e.render() for e in stale))
+    todo = [e for e in entries if e.reason == bl.TODO_REASON]
+    assert todo == [], "baseline entries lack a human reason"
+
+
+def test_generated_proto_is_excluded():
+    files = collect_files(["easydl_tpu"], root=REPO)
+    assert "easydl_tpu/proto/easydl_pb2.py" not in files
+    assert "easydl_tpu/analysis/core.py" in files  # analyzer lints itself
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = analyze_file(str(p), all_rules(), root=str(tmp_path))
+    assert [f.rule for f in findings] == ["parse"]
+
+
+# ------------------------------------------------------------- knob docs
+def _declared_knob_names():
+    env_py = os.path.join(REPO, "easydl_tpu", "utils", "env.py")
+    tree = ast.parse(open(env_py, encoding="utf-8").read())
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "KNOB_DECLS"):
+            decls = ast.literal_eval(stmt.value)
+            return [d[0] for d in decls]
+    raise AssertionError("KNOB_DECLS literal not found in utils/env.py")
+
+
+def test_knob_decls_is_a_pure_literal_with_valid_shape():
+    names = _declared_knob_names()
+    assert len(names) == len(set(names)), "duplicate knob declarations"
+    from easydl_tpu.utils.env import KNOBS
+
+    assert set(KNOBS) == set(names)
+    for name in names:
+        assert name.startswith("EASYDL_"), name
+    types = {k.type for k in KNOBS.values()}
+    assert types <= {"str", "int", "float", "bool"}
+
+
+def test_knob_doc_sync():
+    """Every declared knob appears in the docs/operations.md knob table
+    and every EASYDL_* table row is declared — both directions, so the
+    operator docs cannot rot."""
+    import re
+
+    declared = set(_declared_knob_names())
+    doc = open(os.path.join(REPO, "docs", "operations.md"),
+               encoding="utf-8").read()
+    rows = set(re.findall(r"^\| *`(EASYDL_[A-Z0-9_*]+)`", doc,
+                          flags=re.M))
+    missing_doc = declared - rows
+    assert not missing_doc, (
+        f"knobs declared in utils/env.py but missing from the "
+        f"docs/operations.md knob table: {sorted(missing_doc)}")
+    undeclared = rows - declared
+    assert not undeclared, (
+        f"knob table rows in docs/operations.md not declared in "
+        f"utils/env.py KNOB_DECLS: {sorted(undeclared)}")
+
+
+def test_typed_accessors(monkeypatch):
+    from easydl_tpu.utils import env
+
+    monkeypatch.setenv("EASYDL_PS_WAL_SYNC_S", "1.5")
+    assert env.knob_float("EASYDL_PS_WAL_SYNC_S") == 1.5
+    monkeypatch.delenv("EASYDL_PS_WAL_SYNC_S", raising=False)
+    assert env.knob_float("EASYDL_PS_WAL_SYNC_S") == 0.2  # declared default
+    assert env.knob_float("EASYDL_PS_WAL_SYNC_S", 9.0) == 9.0  # override
+    # bool grammar matches env_flag
+    monkeypatch.setenv("EASYDL_PS_WAL", "0")
+    assert env.knob_bool("EASYDL_PS_WAL") is False
+    monkeypatch.setenv("EASYDL_PS_WAL", "yes")
+    assert env.knob_bool("EASYDL_PS_WAL") is True
+    # mapping-parameter reads (the agent->worker IPC idiom)
+    assert env.knob_int("EASYDL_RANK", env={"EASYDL_RANK": "3"}) == 3
+    with pytest.raises(KeyError):
+        env.knob_int("EASYDL_RANK", env={})  # required knob
+    # family declarations resolve by prefix
+    assert env.knob_raw("EASYDL_METRICS_PORT_PS_0",
+                        env={"EASYDL_METRICS_PORT_PS_0": "1"}) == "1"
+    with pytest.raises(KeyError):
+        env.knob_raw("EASYDL_NOT_DECLARED_ANYWHERE")
+
+
+def test_cli_fails_loudly_on_missing_path(tmp_path):
+    """Regression: a typo'd path must not analyze zero files and exit 0."""
+    r = _run_cli(["--root", str(tmp_path), "--baseline",
+                  str(tmp_path / "b.txt"), "no_such_dir"])
+    assert r.returncode == 1
+    assert "no such file or directory" in r.stderr

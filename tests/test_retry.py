@@ -100,3 +100,30 @@ def test_retry_transient_on_retry_hook_runs_and_may_fail():
     assert retry_transient(flaky, max_elapsed_s=10.0, on_retry=hook,
                            sleep=lambda s: None) == 2
     assert calls["hook"] == 1
+
+
+def test_retry_survives_a_raising_trace_hook(monkeypatch):
+    """Regression: the tracing guard's `except Exception as e` used to
+    SHADOW-and-unbind the outer retry exception, so a failing trace hook
+    NameError'd the very retry loop that must survive it."""
+    from easydl_tpu.obs import tracing
+
+    def boom(*a, **k):
+        raise RuntimeError("flight recorder is broken")
+
+    monkeypatch.setattr(tracing, "add_event", boom)
+    calls = {"n": 0, "hook": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+        return calls["n"]
+
+    def hook(err):  # touches the outer exception binding
+        calls["hook"] += 1
+        assert isinstance(err, FakeRpcError)
+
+    assert retry_transient(flaky, max_elapsed_s=10.0, on_retry=hook,
+                           sleep=lambda s: None) == 2
+    assert calls["hook"] == 1
