@@ -33,44 +33,25 @@ import subprocess
 import sys
 import time
 
-# Peak dense bf16 FLOP/s per chip by device kind (public Cloud TPU specs).
-# MFU denominators only — unknown kinds fall back to v4's 275 TFLOP/s.
-_PEAK_FLOPS = {
-    "v6": 918e12,   # Trillium
-    "v5p": 459e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
+# MFU definitions (peak table + EASYDL_CHIP_PEAK_TFLOPS knob + the PaLM
+# appendix-B numerator) moved to easydl_tpu/core/mfu.py in PR 12 — ONE
+# copy shared with the live worker's easydl_worker_mfu gauge, so the
+# bench artifact and the Brain's mesh-shape policy read the same number.
+# Imported lazily (child-side only): the parent stays pure-stdlib.
 
 
-def peak_flops_per_chip(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for key, val in _PEAK_FLOPS.items():
-        if key in kind:
-            return val
-    return 275e12
-
-
-def model_flops_per_token(n_params: int, n_layers: int, d_model: int,
-                          seq_len: int) -> float:
-    """Training FLOPs per token: 6N for the parameter matmuls (fwd+bwd)
-    plus 12·L·d·s for the attention score/context matmuls (PaLM appendix B
-    accounting — the standard MFU numerator)."""
-    return 6.0 * n_params + 12.0 * n_layers * d_model * seq_len
-
-
-def _measure() -> dict:
+def _measure(mesh_key: str = "") -> dict:
     """Child-mode measurement: imports jax, runs the real train loop, and
     returns the result record. Only ever runs in a subprocess whose wall
-    clock the parent bounds."""
+    clock the parent bounds. ``mesh_key`` ("dp=2,fsdp=2,tp=2") shards the
+    step over that factorization instead of pure DP — the per-shape cell
+    of the ``--mesh-sweep`` MFU table."""
     import jax
 
     import optax
 
     from easydl_tpu.core.mesh import MeshSpec
+    from easydl_tpu.core.mfu import model_flops_per_token, peak_flops_per_chip
     from easydl_tpu.core.train_loop import TrainConfig, Trainer
     from easydl_tpu.models.registry import get_model
 
@@ -112,13 +93,18 @@ def _measure() -> dict:
         bundle = get_model("gpt", size=size, seq_len=seq_len, vocab=512)
 
     accum_unroll = int(os.environ.get("EASYDL_BENCH_ACCUM_UNROLL", "1"))
+    mesh_spec = MeshSpec.parse(mesh_key) if mesh_key else MeshSpec(dp=n_chips)
+    if mesh_spec.size != n_chips:
+        raise SystemExit(
+            f"--mesh {mesh_key} needs {mesh_spec.size} devices, have "
+            f"{n_chips}")
     trainer = Trainer(
         init_fn=bundle.init_fn,
         loss_fn=bundle.loss_fn,
         optimizer=optax.adamw(2e-4, weight_decay=0.01),
         config=TrainConfig(global_batch=global_batch, grad_accum=grad_accum,
                            accum_unroll=accum_unroll),
-        mesh_spec=MeshSpec(dp=n_chips),
+        mesh_spec=mesh_spec,
     )
     state = trainer.init_state()
     data = iter(bundle.make_data(global_batch))
@@ -142,7 +128,9 @@ def _measure() -> dict:
     tokens_per_sec = samples_per_sec * seq_len
 
     # MFU: achieved model FLOP/s over the chip's peak (the denominator the
-    # round-1 verdict asked for — "matching-or-beating needs a denominator").
+    # round-1 verdict asked for — "matching-or-beating needs a denominator";
+    # core/mfu.py: unknown chips warn loudly, EASYDL_CHIP_PEAK_TFLOPS
+    # overrides).
     from easydl_tpu.models.gpt import SIZES
 
     n_layers, d_model, _ = SIZES[size]
@@ -170,21 +158,24 @@ def _measure() -> dict:
         "vs_baseline": round(vs_baseline, 3),
         "tokens_per_sec": round(tokens_per_sec, 1),
         "step_time_s": round(dt / steps, 4),
-        "mfu": round(mfu, 4),
-        "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
+        "mfu": round(mfu, 8),
+        "model_tflops_per_sec_per_chip": round(achieved / 1e12, 6),
         "peak_tflops_per_chip": round(peak / 1e12, 1),
         "device_kind": jax.devices()[0].device_kind,
+        "n_chips": n_chips,
+        "mesh": mesh_spec.key(),
     }
 
 
-def _run_child(env: dict, timeout_s: float):
-    """Run ``bench.py --child`` bounded by ``timeout_s``.
+def _run_child(env: dict, timeout_s: float, extra_argv=()):
+    """Run ``bench.py --child [extra_argv]`` bounded by ``timeout_s``.
 
     Returns ``(record_or_None, failure_reason_or_None)``.
     """
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
+            [sys.executable, os.path.abspath(__file__), "--child",
+             *extra_argv],
             env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
             capture_output=True,
@@ -203,6 +194,74 @@ def _run_child(env: dict, timeout_s: float):
     if record is None:
         return None, "bench child produced no JSON result line"
     return record, None
+
+
+def mesh_sweep(out_path: str) -> int:
+    """``--mesh-sweep``: MFU per mesh factorization at 1 and 8 devices —
+    the MULTICHIP_r06.json artifact (ISSUE 12).
+
+    Same self-bootstrap contract as dryrun_multichip: the parent never
+    touches a JAX API; every cell runs ``bench.py --child --mesh <key>``
+    in a forced-CPU subprocess with N virtual devices (the same worlds
+    the 8-device MULTICHIP legs ride), so the artifact exists regardless
+    of tunnel health. Candidate shapes come from the REAL elastic
+    enumeration (core/mesh_shapes.py, tp<=2 / fsdp<=2 — the constraints a
+    GPT job would declare), and every cell's MFU is the shared
+    core/mfu.py definition.
+
+    Acceptance gate (the stable signal on a cpu-shares-throttled box):
+    the best 8-device shape's MFU >= the 1D dp=8 baseline's — a RATIO,
+    not an absolute number. Returns a process exit code.
+    """
+    from easydl_tpu.core.mesh_shapes import MeshConstraints, enumerate_shapes
+    from easydl_tpu.utils.env import cpu_subprocess_env
+    from easydl_tpu.utils.probe import env_float
+
+    constraints = MeshConstraints(max_tp=2, max_fsdp=2)
+    timeout = env_float("EASYDL_BENCH_CHILD_TIMEOUT_S", 1800.0)
+    cells, failures = [], []
+    for n in (1, 8):
+        for spec in enumerate_shapes(n, constraints):
+            key = spec.key()
+            record, why = _run_child(cpu_subprocess_env(n), timeout,
+                                     extra_argv=("--mesh", key))
+            if record is None:
+                failures.append({"devices": n, "mesh": key, "error": why})
+                print(f"CELL {n}dev {key}: FAILED {why}", file=sys.stderr)
+                continue
+            cells.append(record)
+            print(f"CELL {n}dev {key}: mfu={record['mfu']} "
+                  f"({record['value']} samples/s/chip)", file=sys.stderr)
+
+    eight = [c for c in cells if c.get("n_chips") == 8]
+    best8 = max(eight, key=lambda c: c["mfu"]) if eight else None
+    dp8 = next((c for c in eight if c["mesh"] == "dp=8"), None)
+    ratio = (best8["mfu"] / dp8["mfu"]
+             if best8 and dp8 and dp8["mfu"] > 0 else 0.0)
+    ok = bool(best8 and dp8 and not failures and ratio >= 1.0)
+    doc = {
+        "kind": "mesh_mfu_sweep",
+        "ok": ok,
+        "gate": "best 8-device shape MFU >= 1D dp=8 baseline MFU "
+                "(ratio, not absolute — this box is cpu-shares throttled)",
+        "best8_over_dp8_mfu_ratio": round(ratio, 4),
+        "best_8dev_mesh": best8["mesh"] if best8 else None,
+        "constraints": {"max_tp": 2, "max_fsdp": 2},
+        "cells": cells,
+        "failures": failures,
+        "note": "forced-CPU virtual-device worlds (same contract as the "
+                "MULTICHIP dryruns); MFU denominator rides "
+                "EASYDL_CHIP_PEAK_TFLOPS / the core/mfu.py table",
+    }
+    payload = json.dumps(doc, indent=2, sort_keys=True) + "\n"
+    if out_path == "-":
+        sys.stdout.write(payload)
+    else:
+        with open(out_path, "w") as f:
+            f.write(payload)
+        print(f"mesh sweep -> {out_path} (ok={ok}, "
+              f"best8={doc['best_8dev_mesh']}, ratio={doc['best8_over_dp8_mfu_ratio']})")
+    return 0 if ok else 1
 
 
 def main() -> None:
@@ -250,8 +309,18 @@ def main() -> None:
     }))
 
 
+def _argv_value(flag: str) -> str:
+    if flag in sys.argv:
+        i = sys.argv.index(flag)
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return ""
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
-        print(json.dumps(_measure()))
+        print(json.dumps(_measure(mesh_key=_argv_value("--mesh"))))
+    elif "--mesh-sweep" in sys.argv:
+        sys.exit(mesh_sweep(_argv_value("--out") or "MULTICHIP_r06.json"))
     else:
         main()

@@ -49,7 +49,7 @@ HISTOGRAM_UNITS = ("_seconds", "_bytes", "_examples", "_records", "_rows",
 #: declaring a new fleet-wide series dimension; every registration site
 #: must draw from it.
 KNOWN_LABELS = frozenset((
-    "agent", "component", "fault", "generation", "has_plan", "job",
+    "agent", "axis", "component", "fault", "generation", "has_plan", "job",
     "kind", "method", "op", "phase", "reason", "replica", "result", "role",
     "scenario", "service", "shard", "site", "table", "verb", "verdict",
 ))

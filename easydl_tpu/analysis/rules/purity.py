@@ -37,8 +37,10 @@ from easydl_tpu.analysis.core import (
 #: Modules the PR-8 simulator replays — the byte-identical set.
 PURE_PREFIXES = ("easydl_tpu/sim/",)
 PURE_PATHS = (
+    "easydl_tpu/brain/mesh_policy.py",
     "easydl_tpu/brain/policy.py",
     "easydl_tpu/brain/straggler.py",
+    "easydl_tpu/core/mesh_shapes.py",
     "easydl_tpu/elastic/membership.py",
 )
 

@@ -7,12 +7,20 @@ performance (docs/design/elastic-training-operator.md:106-112). The TPU-native
 rebuild consumes XLA step-time metrics and plans in *chips* over pod slices.
 """
 
+from easydl_tpu.brain.mesh_policy import (
+    MeshPolicyConfig,
+    MeshShapePolicy,
+    mesh_shape_decision,
+)
 from easydl_tpu.brain.policy import Autoscaler, AutoscalerConfig, startup_plan
 from easydl_tpu.brain.service import BRAIN_SERVICE, Brain
 
 __all__ = [
     "Autoscaler",
     "AutoscalerConfig",
+    "MeshPolicyConfig",
+    "MeshShapePolicy",
+    "mesh_shape_decision",
     "startup_plan",
     "BRAIN_SERVICE",
     "Brain",

@@ -15,67 +15,25 @@ nearest-neighbour ICI links on real TPU topologies
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-#: Canonical axis order, outermost (DCN-friendly) → innermost (ICI-hungry).
-AXES: Tuple[str, ...] = ("pp", "dp", "fsdp", "ep", "sp", "tp")
-
-#: Axes a batch dimension is sharded over (pure data parallelism axes).
-BATCH_AXES: Tuple[str, ...] = ("dp", "fsdp")
-
-
-@dataclass(frozen=True)
-class MeshSpec:
-    """Logical mesh shape. Unset axes default to 1 and collapse away in the
-    physical mesh only if every axis is 1 (we keep all names so PartitionSpecs
-    stay valid regardless of shape)."""
-
-    dp: int = 1
-    fsdp: int = 1
-    tp: int = 1
-    sp: int = 1
-    ep: int = 1
-    pp: int = 1
-
-    @property
-    def size(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp * self.ep * self.pp
-
-    def axis_sizes(self) -> Tuple[int, ...]:
-        m = {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp, "ep": self.ep,
-             "sp": self.sp, "tp": self.tp}
-        return tuple(m[a] for a in AXES)
-
-    @classmethod
-    def from_world(
-        cls,
-        world: int,
-        *,
-        tp: int = 1,
-        sp: int = 1,
-        ep: int = 1,
-        pp: int = 1,
-        fsdp: int = 1,
-    ) -> "MeshSpec":
-        """Fill the ``dp`` axis with whatever ``world`` leaves after the model
-        axes — the elastic master uses this to rebuild the mesh at a new world
-        size without touching the model-parallel layout."""
-        denom = tp * sp * ep * pp * fsdp
-        if world % denom:
-            raise ValueError(
-                f"world={world} not divisible by tp*sp*ep*pp*fsdp={denom}"
-            )
-        return cls(dp=world // denom, fsdp=fsdp, tp=tp, sp=sp, ep=ep, pp=pp)
-
-    def describe(self) -> str:
-        parts = [f"{a}={s}" for a, s in zip(AXES, self.axis_sizes()) if s > 1]
-        return "x".join(parts) if parts else "single-device"
+# The logical-shape algebra (MeshSpec, constraints, enumeration) lives in
+# the jax-free twin module so the membership FSM / Brain policy / offline
+# simulator can import it without dragging jax in; re-exported here so
+# `from easydl_tpu.core.mesh import MeshSpec` keeps working.
+from easydl_tpu.core.mesh_shapes import (  # noqa: F401
+    AXES,
+    BATCH_AXES,
+    MeshConstraints,
+    MeshSpec,
+    enumerate_shapes,
+    validate_shape,
+)
 
 
 def build_mesh(

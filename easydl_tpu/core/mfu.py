@@ -1,0 +1,84 @@
+"""Model-FLOP-utilisation: ONE definition shared by the bench and the
+live fleet.
+
+MFU = achieved model FLOP/s / the chip's peak dense FLOP/s. The numerator
+uses the PaLM appendix-B accounting (:func:`model_flops_per_token`); the
+denominator comes from :func:`peak_flops_per_chip`. Both ``bench.py`` and
+the elastic worker (which stamps ``mfu`` into its step-metrics records,
+surfaced live as the ``easydl_worker_mfu`` gauge) read THESE functions, so
+the number the Brain's mesh-shape policy sees and the number the bench
+artifact reports can never silently diverge.
+
+The denominator is no longer allowed to be quietly wrong on new hardware:
+an unknown ``device_kind`` used to fall back to v4's 275 TFLOP/s in
+silence — now the fallback logs a loud warning naming the assumed peak,
+and ``EASYDL_CHIP_PEAK_TFLOPS`` overrides the table outright (the knob
+for chips the table has never heard of, declared in utils/env.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from easydl_tpu.utils.env import knob_raw
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("core", "mfu")
+
+#: Peak dense bf16 FLOP/s per chip by device kind (public Cloud TPU specs).
+PEAK_FLOPS: Dict[str, float] = {
+    "v6": 918e12,   # Trillium
+    "v5p": 459e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+#: The fallback peak an unknown chip is assumed to have (v4) — always
+#: announced loudly, never silent.
+FALLBACK_PEAK = 275e12
+
+
+def peak_flops_per_chip(device_kind: str) -> float:
+    """Peak dense FLOP/s for ``device_kind``.
+
+    Resolution order: the ``EASYDL_CHIP_PEAK_TFLOPS`` knob (an explicit
+    operator statement — wins even for known chips, e.g. to model an
+    fp8-rated peak), then the spec table, then the v4 fallback with a
+    WARNING naming the assumed number — a multi-chip MFU headline must
+    never be quietly normalised by the wrong denominator."""
+    override = knob_raw("EASYDL_CHIP_PEAK_TFLOPS")
+    if override:
+        try:
+            return float(override) * 1e12
+        except ValueError:
+            log.warning(
+                "EASYDL_CHIP_PEAK_TFLOPS=%r is not a number; ignoring the "
+                "override", override)
+    kind = (device_kind or "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    log.warning(
+        "unknown device kind %r: assuming v4 peak %.0f TFLOP/s for the MFU "
+        "denominator — set EASYDL_CHIP_PEAK_TFLOPS to this chip's real peak "
+        "or the reported MFU is meaningless", device_kind,
+        FALLBACK_PEAK / 1e12)
+    return FALLBACK_PEAK
+
+
+def model_flops_per_token(n_params: int, n_layers: int, d_model: int,
+                          seq_len: int) -> float:
+    """Training FLOPs per token: 6N for the parameter matmuls (fwd+bwd)
+    plus 12·L·d·s for the attention score/context matmuls (PaLM appendix B
+    accounting — the standard MFU numerator)."""
+    return 6.0 * n_params + 12.0 * n_layers * d_model * seq_len
+
+
+def mfu(achieved_flops_per_sec: float, n_chips: int,
+        device_kind: str) -> float:
+    """Fleet MFU: achieved model FLOP/s over ``n_chips`` x peak."""
+    denom = max(n_chips, 1) * peak_flops_per_chip(device_kind)
+    return achieved_flops_per_sec / denom if denom > 0 else 0.0

@@ -148,6 +148,19 @@ class Agent:
         self._m_worker_step_time = reg.gauge(
             "easydl_agent_worker_step_time_seconds", "Worker-reported step "
             "wall time.", ("agent",))
+        # One MFU definition, three readers (core/mfu.py): the worker
+        # stamps "mfu" into its step records, this gauge surfaces it live,
+        # and bench.py --mesh-sweep reports the same formula — the Brain's
+        # mesh-shape policy and the bench artifact can never diverge.
+        self._m_worker_mfu = reg.gauge(
+            "easydl_worker_mfu", "Worker-reported model-FLOP utilisation "
+            "(achieved model FLOP/s over n_chips x peak; 0 when the model "
+            "publishes no FLOP hint).", ("agent",))
+        self._m_worker_mesh_axis = reg.gauge(
+            "easydl_worker_mesh_axis", "Axis size of the mesh shape this "
+            "agent's worker runs (from the RUN directive's decided shape), "
+            "by axis; all axes 0 while the generation runs the static "
+            "config mesh (no decided shape).", ("agent", "axis"))
         self._m_phase_seconds = reg.gauge(
             "easydl_agent_phase_seconds", "Time from the previous timeline "
             "phase boundary to this one (generation-switch decomposition).",
@@ -270,6 +283,12 @@ class Agent:
                 samples_per_sec=float(metrics.get("samples_per_sec", 0.0)),
                 loss=float(metrics.get("loss", 0.0)),
                 world_size=int(metrics.get("world_size", 0)),
+                # The shape AND generation the record was MEASURED on —
+                # the master's mesh intake keys on them, never on
+                # "whatever is current now" (a post-reshape tail line is
+                # the old worker's)
+                mesh=str(metrics.get("mesh", "")),
+                generation=int(metrics.get("generation", 0)),
             ),
             preemption_notice="preempt" if self._preempting.is_set() else "",
             host=self.host,
@@ -590,6 +609,9 @@ class Agent:
                 self._m_worker_step_time.set(
                     float(metrics.get("step_time_s", 0.0)),
                     agent=self.agent_id)
+                if "mfu" in metrics:
+                    self._m_worker_mfu.set(float(metrics.get("mfu", 0.0)),
+                                           agent=self.agent_id)
         except Exception as e:
             count_swallowed("agent.heartbeat_gauges", e)
 
@@ -733,6 +755,10 @@ class Agent:
             "EASYDL_METRICS": self.metrics_path,
             "EASYDL_GO_FILE": go_file,
         }
+        if prep.mesh:
+            # The preflight compiles the PREPARED generation's decided
+            # shape — the whole point of overlapping the compile.
+            preflight_env["EASYDL_MESH"] = prep.mesh
         trace_ctx = tracing.inject(self._switch_ctx)
         if trace_ctx:
             preflight_env[tracing.CTX_ENV] = trace_ctx
@@ -793,6 +819,26 @@ class Agent:
         except OSError:
             pass
 
+    def _set_mesh_gauge(self, mesh_key: str) -> None:
+        """Export the applied generation's mesh shape as
+        easydl_worker_mesh_axis{axis} (every axis, including the 1s, so a
+        reshape from dp=2,tp=4 to dp=8 reads as tp dropping to 1 instead
+        of a stale 4). A generation with NO decided shape (policy off, or
+        the static-config fallback after a policy failure) zeroes every
+        axis — the gauges must never keep reporting a shape the fleet
+        stopped running. Best-effort: telemetry must never block a
+        spawn."""
+        try:
+            from easydl_tpu.core.mesh_shapes import MeshSpec
+
+            spec = MeshSpec.parse(mesh_key) if mesh_key else None
+            for axis in ("dp", "fsdp", "tp", "sp", "ep", "pp"):
+                self._m_worker_mesh_axis.set(
+                    getattr(spec, axis) if spec is not None else 0,
+                    agent=self.agent_id, axis=axis)
+        except Exception as e:
+            count_swallowed("agent.mesh_gauge", e)
+
     def _warm_rearm_ready(self, metrics: dict) -> bool:
         """Should the deferred standby re-arm fire now?
 
@@ -839,6 +885,12 @@ class Agent:
             "EASYDL_METRICS": self.metrics_path,
             "EASYDL_TIMELINE": self.timeline_path,
         }
+        if m.mesh:
+            # The master's mesh-shape policy decided this generation's
+            # factorization; the worker builds its mesh from it instead of
+            # the static job config ("" = legacy master / policy off).
+            payload["EASYDL_MESH"] = m.mesh
+        self._set_mesh_gauge(m.mesh)
         # Subprocess-env hop of trace propagation: the worker of this
         # generation roots its spans under the master's switch context. In
         # the payload (not just the base env) so a warm-standby promotion —

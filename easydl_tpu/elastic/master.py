@@ -18,9 +18,11 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from easydl_tpu.api.resource_plan import ResourcePlan
+from easydl_tpu.brain.mesh_policy import policy_from_job_config
 from easydl_tpu.brain.straggler import (
     StragglerConfig, StragglerDetector, actuate_eviction,
 )
+from easydl_tpu.utils.env import knob_raw
 from easydl_tpu.chaos import banner as chaos_banner
 from easydl_tpu.elastic.membership import Directive, JobPhase, Rendezvous
 from easydl_tpu.obs import get_registry, start_exporter, tracing
@@ -72,6 +74,7 @@ class _Servicer:
             # before the directive leaves the master.
             self._m._persist_if_epoch_advanced()
             self._m._drain_reshape_log()
+            self._m._drain_mesh_log()
             tracing.attach_reply_context(ctx, sw)
             return self._m._to_proto(d)
 
@@ -122,6 +125,7 @@ class _Servicer:
             self._m._count_directive(req.agent_id, d.kind)
             self._m._persist_if_epoch_advanced()
             self._m._drain_reshape_log()
+            self._m._drain_mesh_log()
             tracing.attach_reply_context(ctx, sw)
             return self._m._to_proto(d)
 
@@ -166,6 +170,25 @@ class Master:
         self._state_path = os.path.join(workdir, "master-state.json")
         self._events_path = os.path.join(workdir, "events.jsonl")
         persisted = self._load_state()
+        # Mesh-shape policy (PR 12): opted in via a "mesh_policy" mapping
+        # in the job config; the EASYDL_MESH_PIN knob is the operator's
+        # runbook override (docs/operations.md §15). None = static mesh,
+        # directives carry mesh "" and workers use job.json verbatim.
+        # A FAILED-OVER master is constructed without worker_config (the
+        # workdir's job.json already exists for the workers) — re-read it,
+        # or the restart would silently drop the policy and the next
+        # reshape would revert the fleet to the static mesh.
+        cfg_for_policy = worker_config
+        if cfg_for_policy is None:
+            try:
+                with open(os.path.join(workdir, "job.json")) as f:
+                    cfg_for_policy = json.load(f)
+            except (OSError, ValueError):
+                cfg_for_policy = None
+        self._mesh_policy = policy_from_job_config(cfg_for_policy)
+        pin = knob_raw("EASYDL_MESH_PIN")
+        if self._mesh_policy is not None and pin:
+            self._mesh_policy.pinned = pin
         self.rendezvous = Rendezvous(
             # Persisted desired_workers wins over the constructor's startup
             # count: the applied plan's effect must survive the restart too —
@@ -183,6 +206,8 @@ class Master:
             prepare_min_uptime_s=prepare_min_uptime_s,
             preempt_prepare_timeout_s=preempt_prepare_timeout_s,
             standing_preflight=standing_preflight,
+            mesh_select=(self._mesh_policy.decide
+                         if self._mesh_policy is not None else None),
         )
         # Durable membership journal: rebuild who was registered, what
         # directive cohort was in force, and any armed prepare — so a master
@@ -289,6 +314,12 @@ class Master:
         self._straggler = StragglerDetector(straggler or StragglerConfig())
         #: reshape_log entries already drained into counters + the WAL
         self._reshape_seen = 0
+        #: mesh_log entries already stamped into the WAL
+        self._mesh_seen = 0
+        #: per-agent (generation, step) last fed to the mesh policy — the
+        #: heartbeat loop re-reads the same JSONL tail every iteration,
+        #: and duplicate samples would triple-weight one step
+        self._mesh_obs_last: Dict[str, Tuple[int, int]] = {}
         if worker_config is not None:
             with open(os.path.join(workdir, "job.json"), "w") as f:
                 json.dump(worker_config, f)
@@ -434,7 +465,9 @@ class Master:
             with self._lock:
                 self.rendezvous.tick()
                 self._maybe_evict_straggler()
+                self._maybe_mesh_reshape()
                 self._drain_reshape_log()
+                self._drain_mesh_log()
                 phase = self.rendezvous.phase
                 if phase != last_phase:
                     self._trace_phase(phase)
@@ -613,6 +646,45 @@ class Master:
             generation=rdv.generation,
         )
 
+    # ------------------------------------------------------ mesh-shape policy
+    def _maybe_mesh_reshape(self) -> None:
+        """Actuate the mesh-shape policy's refinement (lock held): when it
+        wants to probe an unmeasured factorization or adopt a measured-
+        better one, initiate a PLANNED reshape of the unchanged membership
+        — members quiesce at a step boundary and the next formation
+        re-asks the policy. Gated on a fully-running STABLE generation so
+        a switch in flight is never preempted by its own refinement."""
+        if self._mesh_policy is None:
+            return
+        rdv = self.rendezvous
+        if rdv.phase != JobPhase.STABLE or not self._members_all_running():
+            return
+        # The SAME chips formula the rendezvous' decide() keys the policy
+        # history on — an inline copy could drift and split the per-world
+        # history/probe budget across two keys.
+        chips = rdv._chips_of(rdv.members)
+        now = time.monotonic()
+        if not self._mesh_policy.want_reshape(chips, now):
+            return
+        if rdv.request_mesh_reshape():
+            self._mesh_policy.note_reshape(now)
+
+    def _drain_mesh_log(self) -> None:
+        """Stamp newly-formed generations' mesh decisions — chosen shape
+        AND the decision inputs (candidates, measured means, probe/pin
+        rationale) — into the events WAL (lock held, idempotent via the
+        seen-cursor), so drill forensics can reconstruct WHY a shape was
+        picked."""
+        entries = self.rendezvous.mesh_log
+        while self._mesh_seen < len(entries):
+            e = entries[self._mesh_seen]
+            self._mesh_seen += 1
+            self._event(
+                "mesh_shape", generation=int(e["generation"]),
+                world=int(e["world"]), chips=int(e["chips"]),
+                mesh=str(e["mesh"]), inputs=e.get("inputs"),
+            )
+
     def _drain_reshape_log(self) -> None:
         """Fold newly-initiated reshapes (rendezvous reshape_log) into
         easydl_master_reshapes_total{reason} and the events WAL (lock
@@ -643,6 +715,34 @@ class Master:
             self._straggler.observe(agent_id, float(m.step_time_s),
                                     int(m.step), time.monotonic(),
                                     generation=gen)
+        # Mesh-shape intake: per-shape throughput history for the Brain's
+        # factorization policy. The LEAD member only — every rank reports
+        # the same global rate, and world duplicated copies of one step
+        # would satisfy min_samples from a single (possibly compile-
+        # skewed) step; this matches the simulator's intake exactly. The
+        # record must be TAGGED with the current generation's decided
+        # shape (StepMetrics.mesh, stamped by the worker that measured
+        # it): right after a reshape the heartbeat still carries the old
+        # worker's final record, and crediting it to the new shape would
+        # poison the adoption comparison. Deduped on the RECORD's own
+        # advanced (generation, step) — receipt-time generation would
+        # stamp a pre-reshape tail record with the NEW generation's
+        # number and starve a rolled-back worker's genuine samples until
+        # its step counter re-passed the stale cursor.
+        if (
+            self._mesh_policy is not None
+            and self.rendezvous.members
+            and agent_id == self.rendezvous.members[0]
+            and self.rendezvous.mesh
+            and m.mesh == self.rendezvous.mesh
+            and m.samples_per_sec > 0
+            and (int(m.generation), int(m.step))
+            > self._mesh_obs_last.get(agent_id, (-1, -1))
+        ):
+            self._mesh_obs_last[agent_id] = (int(m.generation), int(m.step))
+            self._mesh_policy.observe(
+                max(int(m.world_size), 1), self.rendezvous.mesh,
+                float(m.samples_per_sec))
         # Without a Brain the aggregate exists only to feed three gauges —
         # don't pay the O(members log members) median under the master lock
         # on EVERY heartbeat of a brainless fleet; once a second is plenty
@@ -786,11 +886,13 @@ class Master:
             out.membership.world_size = d.world_size
             out.membership.hosts.extend(d.hosts)
             out.membership.coordinator = d.coordinator
+            out.membership.mesh = d.mesh
         if d.prepare_world:
             out.prepare.generation = d.prepare_generation
             out.prepare.world_size = d.prepare_world
             out.prepare.hosts.extend(d.prepare_hosts)
             out.prepare.coordinator = d.prepare_coordinator
+            out.prepare.mesh = d.prepare_mesh
         return out
 
     # ------------------------------------------------------------------ status
@@ -807,6 +909,8 @@ class Master:
                 for aid, (_, m) in self._last_metrics.items()
             }
             s["straggler"] = self._straggler.status()
+            if self._mesh_policy is not None:
+                s["mesh_policy"] = self._mesh_policy.status()
         s["plan_version"] = self.plan_version
         s["job"] = self.job_name
         return s

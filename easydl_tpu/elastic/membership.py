@@ -80,12 +80,16 @@ class Directive:
     world_size: int = 0
     hosts: Tuple[str, ...] = ()
     coordinator: str = ""
+    #: mesh shape key ("dp=2,fsdp=2,tp=2") the master decided for this
+    #: generation; "" = no mesh policy, workers use static job config
+    mesh: str = ""
     # Piggybacked prepare hint (tentative NEXT generation) — see
     # :class:`PrepareState`. world_size 0 = no prepare in force.
     prepare_generation: int = 0
     prepare_world: int = 0
     prepare_hosts: Tuple[str, ...] = ()
     prepare_coordinator: str = ""
+    prepare_mesh: str = ""
 
 
 @dataclass
@@ -107,6 +111,13 @@ class PrepareState:
     members: Tuple[str, ...]
     coordinator: str
     deadline: float
+    #: mesh shape key the prepared generation will run — the preflight
+    #: workers COMPILE this shape, so a formation that adopts the
+    #: preflight coordinator must adopt this mesh with it
+    mesh: str = ""
+    #: the mesh decision inputs captured at arm time (WAL forensics for
+    #: the adopted-preflight formation path)
+    mesh_inputs: Optional[Dict[str, Any]] = None
     #: the wall-clock budget the deadline was derived from (for diagnostics)
     window_s: float = 0.0
     #: when this prepare was armed (rendezvous clock) — a STANDING prepare
@@ -136,6 +147,8 @@ class Rendezvous:
         standing_preflight: bool = False,
         standing_preflight_grace_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
+        mesh_select: Optional[
+            Callable[[int], Tuple[str, Dict[str, Any]]]] = None,
     ):
         self.desired_workers = desired_workers
         self.min_workers = min_workers
@@ -194,8 +207,21 @@ class Rendezvous:
         #: "from_generation"}. The master drains it into
         #: easydl_master_reshapes_total{reason} and the events WAL; the
         #: simulator reads it directly. Reasons: plan-change | member-lost
-        #: | preemption | straggler.
+        #: | preemption | straggler | mesh-shape.
         self.reshape_log: List[Dict[str, Any]] = []
+        #: injected mesh-shape decider (the Brain's MeshShapePolicy.decide
+        #: or any callable chips -> (shape key, decision-inputs dict));
+        #: None = static job-config mesh, directives carry mesh "".
+        self._mesh_select = mesh_select
+        #: mesh shape key of the CURRENT generation ("" = undecided)
+        self.mesh = ""
+        #: every mesh decision at generation formation: {"t", "generation",
+        #: "world", "chips", "mesh", "inputs"} — the master drains it into
+        #: the events WAL (drill forensics: WHY was this shape picked).
+        self.mesh_log: List[Dict[str, Any]] = []
+        #: a pending policy-initiated reshape whose only purpose is a mesh
+        #: shape change (same membership, new factorization)
+        self._mesh_reshape_pending = False
 
     # ------------------------------------------------------------------ events
     def register(self, agent_id: str, host: str, slots: int, preempting: bool = False) -> Directive:
@@ -351,6 +377,23 @@ class Rendezvous:
         self._evaluate()
         return True
 
+    def request_mesh_reshape(self) -> bool:
+        """Initiate a PLANNED reshape whose only purpose is a mesh-shape
+        change (membership unchanged; the next formation re-asks the mesh
+        policy). The Brain's mesh-shape policy actuates through this when
+        it wants to probe an unmeasured factorization or adopt a
+        measured-better one. No-op (False) without a running generation
+        or a mesh selector."""
+        if self._mesh_select is None or not self.members:
+            return False
+        if self.phase not in (JobPhase.STABLE, JobPhase.PREPARING):
+            return False
+        self._mesh_reshape_pending = True
+        log.info("mesh-shape reshape requested (generation %d, mesh %s)",
+                 self.generation, self.mesh or "unset")
+        self._evaluate()
+        return True
+
     def shutdown(self) -> None:
         self.phase = JobPhase.DONE
         self._evaluate()
@@ -423,6 +466,12 @@ class Rendezvous:
             return True, True, (
                 "straggler" if member_excluded else "plan-change"
             )
+        if self._mesh_reshape_pending:
+            # Same membership, new mesh factorization: a PLANNED reshape
+            # (members quiesce at a step boundary, restore resharded onto
+            # the new shape — checkpoint bit-parity across shapes is the
+            # MULTICHIP dry-run's standing proof).
+            return True, True, "mesh-shape"
         return False, True, "plan-change"
 
     def _evaluate(self) -> None:
@@ -514,6 +563,11 @@ class Rendezvous:
                                 f"{self._port_alloc()}"
                             ),
                             deadline=float("inf"),  # standing: gates nothing
+                            # same members, same chips: the standing group
+                            # compiles the shape already running (no policy
+                            # re-ask, which could consume a probe for a
+                            # generation that may never form)
+                            mesh=self.mesh,
                             armed_at=self._clock(),
                         )
                         log.info(
@@ -560,6 +614,12 @@ class Rendezvous:
                     if any(a.preempting for a in self._member_views())
                     else self.prepare_timeout_s
                 )
+                # The preflight compiles the NEXT generation's mesh shape,
+                # so the shape is decided now, at arm time, and rides the
+                # prepare hint to the agents (EASYDL_MESH in the preflight
+                # env). Formation adopting this coordinator adopts this
+                # mesh with it.
+                prep_mesh, prep_inputs, _chips = self._decide_mesh(target)
                 self.prepare = PrepareState(
                     generation=self.generation + 1,
                     members=target,
@@ -568,6 +628,8 @@ class Rendezvous:
                         f"{self._port_alloc()}"
                     ),
                     deadline=self._clock() + window,
+                    mesh=prep_mesh,
+                    mesh_inputs=prep_inputs,
                     window_s=window,
                     armed_at=self._clock(),
                 )
@@ -666,6 +728,28 @@ class Rendezvous:
             if not pending:
                 self._form_generation()
 
+    def _chips_of(self, members) -> int:
+        """Devices a membership spans (sum of member slots) — the world
+        size the mesh-shape policy factorizes."""
+        return sum(max(self.agents[m].slots, 1) for m in members
+                   if m in self.agents)
+
+    def _decide_mesh(self, members):
+        """Ask the injected mesh policy for the shape this membership
+        should run: ``(key, inputs, chips)``. A selector failure falls
+        back to the static job-config mesh (key "") — the mesh policy
+        must never be the reason a generation cannot form."""
+        if self._mesh_select is None:
+            return "", None, 0
+        chips = self._chips_of(members)
+        try:
+            key, inputs = self._mesh_select(chips)
+            return str(key), dict(inputs or {}), chips
+        except Exception as e:
+            log.warning("mesh_select failed for %d chips: %s — falling "
+                        "back to the static job-config mesh", chips, e)
+            return "", None, chips
+
     def _form_generation(self) -> None:
         target = [self.agents[i] for i in self._target()]
         if len(target) < self.min_workers:
@@ -677,6 +761,7 @@ class Rendezvous:
             return
         self.generation += 1
         self.members = [a.agent_id for a in target]
+        self._mesh_reshape_pending = False
         # Reuse the preflighted coordinator ONLY when the formed generation
         # is exactly the prepared one — same number, same members in the
         # same rank order — and every member's preflight reported ready
@@ -693,17 +778,39 @@ class Rendezvous:
             )
         ):
             self._coordinator = prep.coordinator
-            log.info("generation %d adopts preflight coordinator %s",
-                     self.generation, prep.coordinator)
+            # The preflight workers dist-joined AND compiled prep.mesh —
+            # adopting their coordinator while deciding a different shape
+            # would promote workers jitted for the wrong factorization.
+            mesh = prep.mesh
+            chips = self._chips_of(self.members)
+            inputs = dict(prep.mesh_inputs or {})
+            inputs["adopted_preflight"] = True
+            if self._mesh_select is None:
+                mesh, inputs = "", None
+            log.info("generation %d adopts preflight coordinator %s "
+                     "(mesh %s)", self.generation, prep.coordinator,
+                     prep.mesh or "static")
         else:
             port = self._port_alloc()
             self._coordinator = f"{target[0].host}:{port}"
+            mesh, inputs, chips = self._decide_mesh(self.members)
+        self.mesh = mesh
+        if self._mesh_select is not None:
+            self.mesh_log.append({
+                "t": self._clock(),
+                "generation": self.generation,
+                "world": len(self.members),
+                "chips": chips,
+                "mesh": mesh,
+                "inputs": inputs,
+            })
         self.prepare = None
         self.phase = JobPhase.STABLE
         self._formed_at = self._clock()
         log.info(
-            "generation %d: world=%d members=%s coordinator=%s",
-            self.generation, len(self.members), self.members, self._coordinator,
+            "generation %d: world=%d members=%s coordinator=%s mesh=%s",
+            self.generation, len(self.members), self.members,
+            self._coordinator, self.mesh or "static",
         )
 
     # -------------------------------------------------------------- directives
@@ -715,6 +822,7 @@ class Rendezvous:
             d.prepare_world = len(prep.members)
             d.prepare_hosts = prep.members
             d.prepare_coordinator = prep.coordinator
+            d.prepare_mesh = prep.mesh
         return d
 
     def directive_for(self, agent_id: str) -> Directive:
@@ -753,6 +861,7 @@ class Rendezvous:
                     world_size=len(self.members),
                     hosts=tuple(self.members),
                     coordinator=self._coordinator,
+                    mesh=self.mesh,
                 )
             # Steady state: the standing-preflight hint rides the noop.
             return self._attach_prepare(Directive(kind="noop"), agent_id)
@@ -773,6 +882,11 @@ class Rendezvous:
                 "generation": p.generation,
                 "members": list(p.members),
                 "coordinator": p.coordinator,
+                "mesh": p.mesh,
+                # plain-JSON decision inputs ride the journal so an
+                # adopted-preflight formation AFTER a master failover
+                # still stamps the full WAL forensics record
+                "mesh_inputs": p.mesh_inputs,
                 "remaining_s": (
                     None if p.deadline == float("inf")
                     else max(0.0, p.deadline - self._clock())
@@ -784,6 +898,7 @@ class Rendezvous:
             "generation": self.generation,
             "members": list(self.members),
             "coordinator": self._coordinator,
+            "mesh": self.mesh,
             "drain_planned": self._drain_planned,
             "directive_epoch": self.directive_epoch,
             "desired_workers": self.desired_workers,
@@ -829,6 +944,11 @@ class Rendezvous:
         self.generation = int(snap.get("generation", self.generation))
         self.members = [str(m) for m in snap.get("members", [])]
         self._coordinator = str(snap.get("coordinator", ""))
+        # The decided mesh shape must survive a master restart: workers of
+        # the restored generation are RUNNING that shape, and a restarted
+        # master re-issuing RUN with a different (or empty) mesh would
+        # respawn them onto a conflicting factorization mid-generation.
+        self.mesh = str(snap.get("mesh", ""))
         self._drain_planned = bool(snap.get("drain_planned", True))
         self.directive_epoch = int(snap.get("directive_epoch", 0))
         self.desired_workers = int(
@@ -870,6 +990,10 @@ class Rendezvous:
                     float("inf") if remaining is None
                     else self._clock() + float(remaining)
                 ),
+                mesh=str(prep.get("mesh", "")),
+                mesh_inputs=(dict(prep["mesh_inputs"])
+                             if isinstance(prep.get("mesh_inputs"), dict)
+                             else None),
                 window_s=float(prep.get("window_s", 0.0)),
                 armed_at=self._clock(),
             )
@@ -893,6 +1017,7 @@ class Rendezvous:
             "phase": self.phase.value,
             "generation": self.generation,
             "members": list(self.members),
+            "mesh": self.mesh,
             "desired_workers": self.desired_workers,
             "directive_epoch": self.directive_epoch,
             "reconciling": self.reconciling,

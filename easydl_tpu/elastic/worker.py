@@ -189,8 +189,24 @@ def run_worker(env: Dict[str, str]) -> int:
     log = get_logger("elastic", f"worker-r{rank}")
 
     devices = jax.device_count()
-    mesh_axes = dict(cfg.get("mesh", {}))
-    mesh = build_mesh(MeshSpec.from_world(devices, **mesh_axes))
+    mesh_key = knob_raw("EASYDL_MESH", env=env)
+    if mesh_key:
+        # The master's mesh-shape policy decided this generation's
+        # factorization (it rode the RUN directive); the static job-config
+        # mesh applies only when no policy is in force. A size mismatch is
+        # a control-plane bug (membership factorizes the sum of member
+        # slots, which IS this world's device count) — fail loudly, the
+        # master reshapes with a fresh decision, rather than silently
+        # training on a shape nobody decided.
+        mesh_spec = MeshSpec.parse(mesh_key)
+        if mesh_spec.size != devices:
+            raise RuntimeError(
+                f"decided mesh {mesh_key!r} needs {mesh_spec.size} devices "
+                f"but this world has {devices}")
+    else:
+        mesh_axes = dict(cfg.get("mesh", {}))
+        mesh_spec = MeshSpec.from_world(devices, **mesh_axes)
+    mesh = build_mesh(mesh_spec)
     model_kwargs = dict(cfg.get("model_kwargs", {}))
     ps_mode = model_kwargs.get("embedding") == "ps"
     if ps_mode and mesh.shape.get("pp", 1) > 1:
@@ -492,16 +508,37 @@ def run_worker(env: Dict[str, str]) -> int:
         return ({"data_state": data_source.state()}
                 if data_source is not None else None)
 
+    # Live MFU (core/mfu.py — the SAME definition bench.py reports): the
+    # per-step record carries it when the model publishes a FLOP hint, the
+    # agent bridges it to the easydl_worker_mfu gauge, and the Brain's
+    # mesh-shape policy reads the throughput it normalises. Peak resolved
+    # once — unknown chips warn loudly here, at worker start, not once per
+    # step.
+    from easydl_tpu.core.mfu import peak_flops_per_chip
+
+    flops_per_sample = float(getattr(bundle, "flops_per_sample_hint", 0.0))
+    mfu_denom = (
+        devices * peak_flops_per_chip(jax.devices()[0].device_kind)
+        if flops_per_sample > 0 else 0.0
+    )
+    mesh_key_out = mesh_spec.key()
+
     def append_metrics(step: int, loss: float, dt: float) -> None:
+        rate = (global_batch / dt) if dt > 0 else 0.0
         rec = {
             "step": step,
             "loss": loss,
             "step_time_s": dt,
-            "samples_per_sec": (global_batch / dt) if dt > 0 else 0.0,
+            "samples_per_sec": rate,
             "world_size": devices,
             "generation": generation,
+            "mesh": mesh_key_out,
             "t": time.time(),
         }
+        if mfu_denom > 0:
+            # 8 decimals, matching bench.py: CPU-smoke MFUs are ~1e-5 and
+            # a 6-decimal round quantizes the compile step to a flat 0.0
+            rec["mfu"] = round(rate * flops_per_sample / mfu_denom, 8)
         with open(metrics_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
 

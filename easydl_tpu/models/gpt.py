@@ -149,6 +149,8 @@ def make_gpt(
     def make_data(global_batch: int, seed: int = 0):
         return SyntheticTokens(global_batch, seq_len=seq_len, vocab=vocab, seed=seed)
 
+    from easydl_tpu.core.mfu import model_flops_per_token
+
     return ModelBundle(
         name=f"gpt-{size}" + (f"-moe{moe_experts}" if moe_experts else ""),
         init_fn=init_fn,
@@ -156,6 +158,8 @@ def make_gpt(
         make_data=make_data,
         eval_fn=eval_fn,
         param_count_hint=cfg.param_count,
+        flops_per_sample_hint=model_flops_per_token(
+            cfg.param_count, n_layers, d_model, seq_len) * seq_len,
     )
 
 

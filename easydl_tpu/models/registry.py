@@ -23,6 +23,9 @@ class ModelBundle:
     make_data: Callable  # (global_batch, seed) -> host batch iterator
     eval_fn: Optional[Callable] = None
     param_count_hint: int = 0
+    #: training FLOPs per example (fwd+bwd, PaLM appendix-B accounting) —
+    #: the MFU numerator (core/mfu.py); 0 = unknown, MFU not reported
+    flops_per_sample_hint: float = 0.0
 
 
 def register_model(name: str):

@@ -15,9 +15,10 @@ Entry points: :func:`easydl_tpu.sim.simulator.simulate` in-process, or
 """
 
 from easydl_tpu.sim.simulator import (  # noqa: F401
-    ControlPlaneSimulator, SimPolicy, simulate,
+    ControlPlaneSimulator, MeshSimConfig, SimPolicy, simulate,
 )
 from easydl_tpu.sim.timeline import (  # noqa: F401
     load_fixture, load_workdir, make_timeline, save_fixture,
-    synthetic_autoscale, synthetic_preempt, synthetic_straggler,
+    synthetic_autoscale, synthetic_mesh_autoscale, synthetic_preempt,
+    synthetic_straggler,
 )

@@ -156,6 +156,46 @@ def check(result: Mapping[str, Any], expect: Dict[str, Any],
                 "races": evidence,
             }
 
+    # -------------------------------------------------- mesh_shape_converged
+    mc = expect.get("mesh_converged")
+    if mc is not None:
+        mc = dict(mc) if isinstance(mc, Mapping) else {}
+        tol = float(mc.get("tolerance", 0.05))
+        mesh = dict(result.get("mesh") or {})
+        final_shape = str(mesh.get("final_shape", ""))
+        final_world = int(mesh.get("final_world", 0))
+        prof = dict(timeline.get("meta", {}).get("shape_profile", {}))
+        cells = {str(k): float(v[1])
+                 for k, v in dict(prof.get(str(final_world), {})).items()}
+        doc: Dict[str, Any] = {
+            "final_world": final_world, "final_shape": final_shape,
+            "tolerance": tol,
+        }
+        if not cells or not final_shape:
+            # A convergence claim with no performance surface (or no mesh
+            # decision at all) can only pass vacuously — refuse it.
+            doc.update(ok=False, reason=(
+                "no shape_profile cells for the final world, or no mesh "
+                "decision in the result (vacuous)"))
+        else:
+            # The static-pod oracle: the best factorization at the final
+            # world, run from t0 with no reshapes. Converged = the chosen
+            # shape's steady-state throughput is within `tolerance` of it.
+            oracle_shape = max(cells, key=lambda k: (cells[k], k))
+            oracle = cells[oracle_shape]
+            chosen = cells.get(final_shape)
+            loss = None if chosen is None else 1.0 - chosen / oracle
+            doc.update(
+                ok=(chosen is not None and loss is not None
+                    and loss <= tol),
+                oracle_shape=oracle_shape,
+                oracle_samples_per_sec=oracle,
+                chosen_samples_per_sec=chosen,
+                throughput_loss=(None if loss is None
+                                 else round(loss, 6)),
+            )
+        checks["mesh_shape_converged"] = doc
+
     # ------------------------------------------------------- autoscaler path
     min_ups = expect.get("min_scale_ups")
     if min_ups is not None:

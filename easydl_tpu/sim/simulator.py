@@ -37,15 +37,33 @@ import statistics
 from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
+from easydl_tpu.brain.mesh_policy import MeshPolicyConfig, MeshShapePolicy
 from easydl_tpu.brain.policy import Autoscaler, AutoscalerConfig
 from easydl_tpu.brain.straggler import (
     StragglerConfig, StragglerDetector, actuate_eviction,
 )
+from easydl_tpu.core.mesh_shapes import MeshConstraints
 from easydl_tpu.elastic.membership import JobPhase, Rendezvous
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.utils.logging import get_logger
 
 log = get_logger("sim", "simulator")
+
+
+@dataclass
+class MeshSimConfig:
+    """Mesh-shape mode: replay the REAL MeshShapePolicy — candidates from
+    the real enumeration, probes/adoption actuated through the real
+    ``Rendezvous.request_mesh_reshape`` path. The timeline's
+    ``meta.shape_profile`` supplies per-(world, shape) step time /
+    throughput, the simulated analogue of the ``easydl_worker_mfu``
+    signal the live policy consumes."""
+
+    constraints: MeshConstraints = field(default_factory=MeshConstraints)
+    policy: MeshPolicyConfig = field(default_factory=MeshPolicyConfig)
+    #: operator pin (the runbook override / the negative control's
+    #: deliberately pathological shape)
+    pinned: str = ""
 
 
 @dataclass
@@ -70,6 +88,9 @@ class SimPolicy:
     #: feed the real Autoscaler and actuate its decisions as desired-worker
     #: changes when set (None = hold desired_workers fixed)
     autoscaler: Optional[AutoscalerConfig] = None
+    #: feed the real MeshShapePolicy and actuate its probes/adoptions as
+    #: mesh-shape reshapes when set (None = static mesh, the legacy path)
+    mesh: Optional[MeshSimConfig] = None
 
 
 @dataclass
@@ -92,6 +113,9 @@ class _SimAgent:
     #: reports (the live agent reads only the metrics-JSONL tail too)
     last_sample: Optional[List[float]] = None
     last_observed_step: int = -1
+    #: the applied RUN directive's decided mesh shape + world (mesh mode)
+    mesh: str = ""
+    world: int = 0
 
 
 def _median(vals: List[float]) -> float:
@@ -113,6 +137,11 @@ class ControlPlaneSimulator:
         self.now = 0.0
         p = self.policy
         ports = itertools.count(50000)
+        self.mesh_policy: Optional[MeshShapePolicy] = (
+            MeshShapePolicy(p.mesh.constraints, p.mesh.policy,
+                            pinned=p.mesh.pinned)
+            if p.mesh is not None else None
+        )
         self.rdv = Rendezvous(
             desired_workers=p.desired_workers,
             min_workers=p.min_workers,
@@ -122,6 +151,8 @@ class ControlPlaneSimulator:
             prepare_min_uptime_s=0.0,
             preempt_prepare_timeout_s=p.preempt_prepare_timeout_s,
             clock=lambda: self.now,
+            mesh_select=(self.mesh_policy.decide
+                         if self.mesh_policy is not None else None),
         )
         self.detector = StragglerDetector(p.straggler)
         self.autoscaler = (
@@ -134,6 +165,13 @@ class ControlPlaneSimulator:
         self.ckpt_interval = int(meta.get("ckpt_interval", 100) or 100)
         self.world_profile: Dict[str, List[float]] = dict(
             meta.get("world_profile", {}))
+        #: world -> shape key -> [step_time_s, global samples_per_sec]:
+        #: the per-factorization performance surface mesh-mode agents step
+        #: at (what the fleet would measure on real chips)
+        self.shape_profile: Dict[str, Dict[str, List[float]]] = {
+            str(w): dict(shapes)
+            for w, shapes in dict(meta.get("shape_profile", {})).items()
+        }
         self.agents: Dict[str, _SimAgent] = {}
         for i, (aid, stream) in enumerate(
                 sorted(timeline.get("agents", {}).items())):
@@ -158,6 +196,8 @@ class ControlPlaneSimulator:
         self._gen_max_step: Dict[int, int] = {}
         self._gen_seen: set = set()
         self._as_last_fed: Tuple[int, int] = (-1, -1)
+        self._mesh_last_fed: Tuple[int, int] = (-1, -1)
+        self.mesh_reshapes: List[Dict[str, Any]] = []
         # ---- evidence the invariants judge
         self.evictions: List[Dict[str, Any]] = []
         self.switches: List[Dict[str, Any]] = []
@@ -251,8 +291,17 @@ class ControlPlaneSimulator:
                 a.step_done_t = None
 
     def _dt_for(self, a: _SimAgent) -> Tuple[float, float, int]:
+        shaped = (
+            self.shape_profile.get(str(a.world), {}).get(a.mesh)
+            if a.mesh else None
+        )
         profile = self.world_profile.get(str(len(self.rdv.members)))
-        if profile is not None:
+        if shaped is not None:
+            # Mesh mode: the agent steps at the (world, factorization)
+            # cell of the performance surface its applied RUN decided.
+            dt, rate = float(shaped[0]), float(shaped[1])
+            world = a.world
+        elif profile is not None:
             dt, rate = float(profile[0]), float(profile[1])
             world = len(self.rdv.members)
         elif a.idx < len(a.stream):
@@ -334,6 +383,17 @@ class ControlPlaneSimulator:
                     step=a.step, step_time_s=dt, samples_per_sec=rate,
                     world_size=max(world, 1),
                 ))
+        # Mesh-shape intake mirrors the live master's: the CURRENT
+        # generation's decided shape, per advanced (generation, step),
+        # one reporting member (the aggregate the live master forwards).
+        if self.mesh_policy is not None and rate > 0 \
+                and self.rdv.mesh and a.mesh == self.rdv.mesh \
+                and a.agent_id == (self.rdv.members or [""])[0]:
+            gen = self.rdv.generation
+            if (gen, a.step) > self._mesh_last_fed:
+                self._mesh_last_fed = (gen, a.step)
+                self.mesh_policy.observe(max(world, 1), self.rdv.mesh,
+                                         rate)
 
     def _apply_directive(self, a: _SimAgent, d) -> None:
         if d.kind == "run":
@@ -342,6 +402,8 @@ class ControlPlaneSimulator:
                 return
             a.generation = d.generation
             a.coordinator = d.coordinator
+            a.mesh = d.mesh
+            a.world = d.world_size
             a.state = "running"
             a.quiesce_pending = False
             if d.generation not in self._gen_seen:
@@ -352,6 +414,7 @@ class ControlPlaneSimulator:
                 self.switches.append({
                     "t": self.now, "generation": d.generation,
                     "members": list(d.hosts),
+                    "mesh": d.mesh,
                     "resumed_from_step": self.job_ckpt_step,
                     "steps_lost": max(0, prev_max - self.job_ckpt_step),
                 })
@@ -390,6 +453,26 @@ class ControlPlaneSimulator:
                     "to_workers": target,
                 })
                 self.rdv.set_desired_workers(target)
+        # Mesh-shape refinement, actuated exactly like the live master's
+        # tick: only over a fully-running STABLE generation, through the
+        # real request_mesh_reshape path.
+        if (
+            self.mesh_policy is not None
+            and self.rdv.phase == JobPhase.STABLE and self.rdv.members
+            and all(
+                self.agents[m].state == "running"
+                and self.agents[m].generation == self.rdv.generation
+                for m in self.rdv.members if m in self.agents
+            )
+        ):
+            world = len(self.rdv.members)
+            if self.mesh_policy.want_reshape(world, self.now):
+                if self.rdv.request_mesh_reshape():
+                    self.mesh_policy.note_reshape(self.now)
+                    self.mesh_reshapes.append({
+                        "t": self.now, "world": world,
+                        "from_mesh": self.rdv.mesh,
+                    })
 
     # ------------------------------------------------------------- result
     def _result(self) -> Dict[str, Any]:
@@ -407,6 +490,17 @@ class ControlPlaneSimulator:
             return out
 
         pol = asdict(self.policy)
+        mesh_doc = None
+        if self.mesh_policy is not None:
+            mesh_doc = {
+                "final_shape": self.rdv.mesh,
+                "final_world": len(self.rdv.members),
+                "log": stamp([
+                    {k: v for k, v in e.items()} for e in self.rdv.mesh_log
+                ]),
+                "reshapes": stamp(self.mesh_reshapes),
+                "policy": self.mesh_policy.status(),
+            }
         det = self.detector.status()
         hu = det.get("holddown_until")
         det["holddown_until"] = None if hu is None else r6(float(hu))
@@ -435,6 +529,7 @@ class ControlPlaneSimulator:
             "kills": stamp(self.kills),
             "preempts": stamp(self.preempts),
             "scale_decisions": stamp(self.scale_decisions),
+            "mesh": mesh_doc,
             "detector": det,
             "events_simulated": self.events_simulated,
             "sim_end_t": r6(self.now),

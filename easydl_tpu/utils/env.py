@@ -61,6 +61,9 @@ KNOB_DECLS = (
      "Membership generation the worker belongs to."),
     ("EASYDL_METRICS", "str", None,
      "Per-agent metrics JSONL path the worker appends step reports to."),
+    ("EASYDL_MESH", "str", "",
+     "Mesh shape key ('dp=2,fsdp=2,tp=2') the elastic master decided for "
+     "this generation; '' = take the static job-config mesh."),
     ("EASYDL_TIMELINE", "str", "",
      "Recovery-timeline JSONL path (phase boundary events)."),
     ("EASYDL_GO_FILE", "str", "",
@@ -141,6 +144,14 @@ KNOB_DECLS = (
      "Autoscale floor for serving replicas."),
     ("EASYDL_SERVE_MAX_REPLICAS", "int", 64,
      "Autoscale ceiling for serving replicas."),
+    # -- mesh-shape policy / MFU ------------------------------------------
+    ("EASYDL_MESH_PIN", "str", "",
+     "Operator override: pin the elastic mesh-shape policy to this shape "
+     "key ('dp=8'); invalid-for-world pins fall back to the policy."),
+    ("EASYDL_CHIP_PEAK_TFLOPS", "float", 0.0,
+     "MFU denominator override: this chip's peak dense TFLOP/s (wins over "
+     "the built-in device-kind table; unset+unknown chip = loud v4 "
+     "fallback)."),
     # -- storage / caches -------------------------------------------------
     ("EASYDL_COMPILE_CACHE", "str", "",
      "Persistent XLA compile cache dir; off disables; '' = workdir "

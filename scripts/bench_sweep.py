@@ -8,14 +8,48 @@ Usage: python scripts/bench_sweep.py [--steps 20]
 from __future__ import annotations
 
 import argparse
+import json
 import time
+
+
+def mesh_table(paths) -> None:
+    """Aggregate per-shape MFU cells (``bench.py --mesh-sweep`` output,
+    MULTICHIP_r06-style docs) into one table: devices x shape -> MFU /
+    samples/s/chip. Multiple docs merge (e.g. a CPU sweep + a later real-
+    TPU sweep); later files win on (devices, mesh) collisions."""
+    cells = {}
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        for c in doc.get("cells", []):
+            cells[(int(c.get("n_chips", 0)), str(c.get("mesh", "")))] = c
+    if not cells:
+        raise SystemExit("no mesh MFU cells in the given files")
+    print(f"{'devices':>7}  {'mesh':24s} {'mfu':>12} "
+          f"{'samples/s/chip':>15} {'step_ms':>9}")
+    best = {}
+    for (n, mesh), c in sorted(cells.items()):
+        best.setdefault(n, (0.0, ""))
+        if c.get("mfu", 0.0) > best[n][0]:
+            best[n] = (c["mfu"], mesh)
+        print(f"{n:>7}  {mesh:24s} {c.get('mfu', 0.0):>12.8f} "
+              f"{c.get('value', 0.0):>15.3f} "
+              f"{1000 * c.get('step_time_s', 0.0):>9.1f}")
+    for n, (m, mesh) in sorted(best.items()):
+        print(f"BEST {n}dev: {mesh} (mfu {m:.8f})")
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=15)
     ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--mesh-table", nargs="+", metavar="JSON",
+                    help="aggregate bench.py --mesh-sweep docs into one "
+                         "per-shape MFU table and exit (no jax import)")
     args = ap.parse_args()
+    if args.mesh_table:
+        mesh_table(args.mesh_table)
+        return
 
     import jax
     import optax

@@ -113,10 +113,14 @@ PY
 done
 
 # Offline policy replay gate: every committed simulator fixture (recorded
-# chaos timelines) plus the synthetic catalog (incl. the mis-tuned
-# negative controls) must pass its policy invariants, and each fixture
-# replay must be byte-identical across back-to-back runs — the simulator's
-# determinism contract, checked where the drills that feed it live.
+# chaos timelines AND the mesh-shape autoscale surface — fixtures with a
+# meta.shape_profile replay through the real MeshShapePolicy with the
+# mesh_shape_converged invariant) plus the synthetic catalog (incl. the
+# mis-tuned negative controls: hair-trigger straggler, too-short preempt
+# grace, pinned-pathological mesh shape) must pass its policy invariants,
+# and each fixture replay must be byte-identical across back-to-back runs
+# — the simulator's determinism contract, checked where the drills that
+# feed it live.
 SIMDIR=$(mktemp -d)
 trap 'rm -f "$LOG"; rm -rf "$SIMDIR"' EXIT
 

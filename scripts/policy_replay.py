@@ -41,11 +41,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+from easydl_tpu.brain.mesh_policy import MeshPolicyConfig  # noqa: E402
 from easydl_tpu.brain.policy import AutoscalerConfig  # noqa: E402
 from easydl_tpu.brain.straggler import StragglerConfig  # noqa: E402
+from easydl_tpu.core.mesh_shapes import MeshConstraints  # noqa: E402
 from easydl_tpu.sim import (  # noqa: E402
-    SimPolicy, load_fixture, load_workdir, save_fixture, simulate,
-    synthetic_autoscale, synthetic_preempt, synthetic_straggler,
+    MeshSimConfig, SimPolicy, load_fixture, load_workdir, save_fixture,
+    simulate, synthetic_autoscale, synthetic_mesh_autoscale,
+    synthetic_preempt, synthetic_straggler,
 )
 
 #: the default drill policy for replays: matches the live chaos drills'
@@ -57,6 +60,33 @@ def _drill_policy() -> SimPolicy:
         straggler=StragglerConfig(ratio=8.0, consecutive=6, min_samples=6,
                                   holddown_s=10.0, allow_self_skew=True),
     )
+
+
+def _mesh_policy(pinned: str = "") -> SimPolicy:
+    """The mesh-shape replay policy (ISSUE 12): autoscale 8->16->32 with
+    the real Autoscaler while the real MeshShapePolicy probes/adopts
+    factorizations — constraints match the scenario's performance surface
+    (tp<=2, fsdp<=2, no pp)."""
+    return SimPolicy(
+        desired_workers=8, min_workers=8,
+        autoscaler=AutoscalerConfig(max_workers=32, cooldown_s=20.0,
+                                    min_samples=5),
+        mesh=MeshSimConfig(
+            constraints=MeshConstraints(max_tp=2, max_fsdp=2),
+            policy=MeshPolicyConfig(min_samples=3, probe_cooldown_s=8.0),
+            pinned=pinned,
+        ),
+    )
+
+
+#: expectations for the mesh-shape scenario/fixture: preemption survived
+#: with a proactive drain, the ramp reached 32 workers, and the chosen
+#: factorization is within 5% of the static-pod oracle's throughput.
+_MESH_EXPECT: Dict[str, Any] = {
+    "final_workers": 32, "final_desired_workers": 32, "min_scale_ups": 2,
+    "proactive_drain": True, "max_reshapes": 18,
+    "mesh_converged": {"tolerance": 0.05},
+}
 
 
 def _scenarios() -> Dict[str, Tuple[Any, SimPolicy, Dict[str, Any]]]:
@@ -103,7 +133,31 @@ def _scenarios() -> Dict[str, Tuple[Any, SimPolicy, Dict[str, Any]]]:
             {"min_scale_ups": 2, "final_desired_workers": 4,
              "final_workers": 4, "max_reshapes": 3, "target_step": 1500},
         ),
+        "mesh_autoscale": (
+            synthetic_mesh_autoscale(),
+            _mesh_policy(),
+            dict(_MESH_EXPECT),
+        ),
+        # Negative control: the policy nailed to a pathological
+        # factorization for the final world (dp=16,tp=2 is ~23% off the
+        # 32-chip oracle) — the convergence invariant must CATCH it.
+        "mesh_autoscale_pinned_negative": (
+            synthetic_mesh_autoscale(),
+            _mesh_policy(pinned="dp=16,tp=2"),
+            dict(_MESH_EXPECT, max_reshapes=6),
+        ),
     }
+
+
+def _policy_and_expect_for(timeline: Dict[str, Any]
+                           ) -> Tuple[SimPolicy, Dict[str, Any]]:
+    """Policy + expectations for a fixture/workdir replay. A timeline
+    whose meta carries a ``shape_profile`` is a mesh-shape fixture and
+    replays through the mesh policy with the convergence invariant;
+    anything else gets the drill policy + fault-derived expectations."""
+    if dict(timeline.get("meta", {})).get("shape_profile"):
+        return _mesh_policy(), dict(_MESH_EXPECT)
+    return _drill_policy(), _recorded_expect(timeline)
 
 
 #: expectations used when replaying a RECORDED timeline, keyed by the
@@ -177,12 +231,10 @@ def main() -> None:
         if args.save_fixture:
             save_fixture(tl, args.save_fixture)
             print(f"fixture saved -> {args.save_fixture}")
-        jobs.append((tl["name"], tl, _drill_policy(),
-                     _recorded_expect(tl), False))
+        jobs.append((tl["name"], tl, *_policy_and_expect_for(tl), False))
     for path in args.fixture or []:
         tl = load_fixture(path)
-        jobs.append((tl["name"], tl, _drill_policy(),
-                     _recorded_expect(tl), False))
+        jobs.append((tl["name"], tl, *_policy_and_expect_for(tl), False))
     if not args.workdir and not args.fixture:
         names = args.scenario or list(catalog)
         unknown = [n for n in names if n not in catalog]

@@ -313,3 +313,93 @@ def test_committed_fixture_replays_deterministically(fixture, invariant):
     assert r1["passed"], json.dumps(r1["invariants"], indent=2)
     assert invariant in r1["invariants"]["checks"]
     assert json.dumps(r1, sort_keys=True) == json.dumps(r2, sort_keys=True)
+
+
+# ------------------------------------------------------- mesh-shape mode
+def _policy_replay_module():
+    """Import scripts/policy_replay.py so the tier-1 tests validate the
+    EXACT policy + expectations the chaos_smoke replay gate runs — a
+    local copy could silently drift from the gate."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(FIXTURE_DIR)),
+                        "..", "scripts", "policy_replay.py")
+    spec = importlib.util.spec_from_file_location(
+        "policy_replay_under_test", os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_PR = _policy_replay_module()
+
+
+def _mesh_sim_policy(pinned=""):
+    return _PR._mesh_policy(pinned=pinned)
+
+
+_MESH_EXPECT = _PR._MESH_EXPECT
+
+
+def test_sim_mesh_autoscale_converges_within_5pct_of_oracle():
+    """ISSUE 12 acceptance (offline): a preemption + an 8->32 autoscale
+    ramp over a shape-dependent performance surface — the REAL
+    MeshShapePolicy probes factorizations through the real
+    request_mesh_reshape path and converges on a shape within 5%
+    simulated throughput of the static-pod oracle (here: ON it), and the
+    committed fixture replays byte-identically."""
+    from easydl_tpu.sim import synthetic_mesh_autoscale
+
+    path = os.path.join(FIXTURE_DIR, "mesh_autoscale.json")
+    tl = load_fixture(path)
+    # the committed fixture IS the synthetic generator's output
+    assert tl["agents"] == synthetic_mesh_autoscale()["agents"]
+    r1 = simulate(tl, _mesh_sim_policy(), dict(_MESH_EXPECT))
+    assert r1["passed"], json.dumps(r1["invariants"], indent=2)
+    conv = r1["invariants"]["checks"]["mesh_shape_converged"]
+    assert conv["final_shape"] == "dp=8,fsdp=2,tp=2"
+    assert conv["throughput_loss"] <= 0.05
+    # every probe/adoption went through a PLANNED mesh-shape reshape
+    assert any(e["reason"] == "mesh-shape" for e in r1["reshapes"])
+    assert all(e["planned"] for e in r1["reshapes"]
+               if e["reason"] == "mesh-shape")
+    # the decision inputs ride the mesh log (WAL forensics contract)
+    probe_logs = [e for e in r1["mesh"]["log"]
+                  if (e["inputs"] or {}).get("reason") == "probe"]
+    assert probe_logs and all("candidates" in (e["inputs"] or {})
+                              for e in probe_logs)
+    r2 = simulate(load_fixture(path), _mesh_sim_policy(),
+                  dict(_MESH_EXPECT))
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2, sort_keys=True)
+
+
+def test_sim_mesh_pinned_pathological_shape_is_caught():
+    """Negative control: the policy nailed to a valid-but-pathological
+    factorization for the final world must FAIL the convergence
+    invariant (vacuous passes refused) — and the pin must actually BIND
+    (the final shape IS the pinned one, not a fallback)."""
+    from easydl_tpu.sim import synthetic_mesh_autoscale
+
+    res = simulate(synthetic_mesh_autoscale(),
+                   _mesh_sim_policy(pinned="dp=16,tp=2"),
+                   dict(_MESH_EXPECT, max_reshapes=6))
+    assert not res["passed"]
+    conv = res["invariants"]["checks"]["mesh_shape_converged"]
+    assert conv["ok"] is False
+    assert conv["final_shape"] == "dp=16,tp=2"
+    assert conv["throughput_loss"] > 0.05
+    # everything else about the run stayed healthy: ONLY the mesh check
+    # caught the mis-pin
+    others = {k: v["ok"] for k, v in res["invariants"]["checks"].items()
+              if k != "mesh_shape_converged"}
+    assert all(others.values()), others
+
+
+def test_sim_mesh_convergence_check_refuses_vacuous_pass():
+    """A mesh_converged expectation against a timeline with no
+    shape_profile (or a run that never decided a shape) must FAIL, not
+    pass by absence of evidence."""
+    res = simulate(synthetic_straggler(), SimPolicy(desired_workers=2),
+                   {"mesh_converged": {"tolerance": 0.05}})
+    check = res["invariants"]["checks"]["mesh_shape_converged"]
+    assert check["ok"] is False and "vacuous" in check["reason"]
