@@ -42,6 +42,7 @@ PURE_PATHS = (
     "easydl_tpu/brain/straggler.py",
     "easydl_tpu/core/mesh_shapes.py",
     "easydl_tpu/elastic/membership.py",
+    "easydl_tpu/loop/rollout.py",
 )
 
 _CLOCK_NAMES = frozenset((

@@ -102,6 +102,19 @@ class Scenario:
     #: restore phase for ``pause_s`` seconds (the tail-replay retry loop
     #: must ride it out).
     ps_storm: Optional[Dict[str, Any]] = None
+    #: Production-loop drill mode (ISSUE 13). ``kind`` selects the drill:
+    #: "trainer_crash" — a real ``python -m easydl_tpu.loop.continuous``
+    #: subprocess tails a harness-driven feedback spool against live PS
+    #: pods, is SIGKILLed mid-loop, resumes from its joint
+    #: cursor+dense+sparse checkpoint, and the final tier + dense state
+    #: must digest-match a fault-free exactly-once reference replay of
+    #: the same spool; "rollout_half_update" — a serving replica under
+    #: gRPC load rides a publication sequence with a torn (crash before
+    #: COMMITTED) and a corrupt (bad CRC) version injected: neither may
+    #: ever be served, a complete version hot-swaps under load, a canary
+    #: arm splits sessions consistently, and ONE Rollout RPC rolls back
+    #: instantly.
+    loop_drill: Optional[Dict[str, Any]] = None
 
     @property
     def name(self) -> str:
@@ -191,6 +204,8 @@ class ChaosHarness:
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> Dict[str, Any]:
+        if self.scenario.loop_drill is not None:
+            return self._run_loop_drill()
         if self.scenario.ps_storm is not None:
             return self._run_ps_storm()
         return self._run_job()
@@ -861,6 +876,484 @@ class ChaosHarness:
             excess += over
         return {"excess_wal_bytes": excess, "wal_segments": segments,
                 "replay_caps_found": bool(caps)}
+
+    # ------------------------------------------------------ production loop
+    def _run_loop_drill(self) -> Dict[str, Any]:
+        """Wrapper for the ISSUE-13 loop drills: arm tracing, account
+        fault counters as deltas, run the drill driver, judge invariants
+        over the evidence file it writes."""
+        sc = self.scenario
+        from easydl_tpu.obs import tracing
+
+        trace_before = os.environ.get(tracing.TRACE_ENV)
+        os.environ[tracing.TRACE_ENV] = "1"
+        # The rollout drill runs wholly in THIS process (no pods): point
+        # the harness' own span sink at the drill workdir, or the smoke's
+        # trace-export gate would find an empty trace.
+        tracing.configure("chaos-harness", self.workdir)
+        cache_before = knob_raw("EASYDL_COMPILE_CACHE")
+        os.environ["EASYDL_COMPILE_CACHE"] = "off"
+        t_start = time.monotonic()
+        counts_before = injectors.injected_fault_counts()
+        evidence: Dict[str, Any] = {}
+        try:
+            kind = str((sc.loop_drill or {}).get("kind"))
+            if kind == "trainer_crash":
+                evidence = self._drive_trainer_crash_loop()
+            elif kind == "rollout_half_update":
+                evidence = self._drive_rollout_half_update()
+            else:
+                raise ValueError(f"unknown loop drill kind {kind!r}")
+        finally:
+            self._teardown()
+            if trace_before is None:
+                os.environ.pop(tracing.TRACE_ENV, None)
+            else:
+                os.environ[tracing.TRACE_ENV] = trace_before
+            if cache_before is None:
+                os.environ.pop("EASYDL_COMPILE_CACHE", None)
+            else:
+                os.environ["EASYDL_COMPILE_CACHE"] = cache_before
+        fault_counts = {
+            kind_: count - counts_before.get(kind_, 0.0)
+            for kind_, count in injectors.injected_fault_counts().items()
+            if count - counts_before.get(kind_, 0.0) > 0
+        }
+        verdict = invariants.check_scenario(
+            self.workdir, sc.expect, status={}, fault_counts=fault_counts,
+            outages=self.outages,
+        )
+        _scenario_counter().inc(scenario=sc.name,
+                                result="pass" if verdict["passed"]
+                                else "fail")
+        return {
+            "scenario": sc.name,
+            "seed": sc.chaos.seed,
+            "notes": sc.chaos.notes,
+            "workdir": self.workdir,
+            "wall_s": round(time.monotonic() - t_start, 2),
+            "expect": dict(sc.expect),
+            "faults_injected": fault_counts,
+            "loop": evidence,
+            "final_status": {},
+            "invariants": verdict,
+            "passed": verdict["passed"],
+        }
+
+    def _loop_trainer_pod(self, idx: int, cfg: Mapping[str, Any],
+                          spool: str) -> str:
+        from easydl_tpu.controller.pod_api import Pod
+
+        sc = self.scenario
+        name = f"{sc.name}-trainer-{idx}"
+        self._pod_api.create_pod(Pod(
+            name=name, job=sc.name, role="trainer",
+            command=(
+                f"{sys.executable} -m easydl_tpu.loop.continuous"
+                f" --workdir {self.workdir} --spool {spool}"
+                f" --shards {sc.ps_shards}"
+                f" --table {cfg.get('table', 'loop_emb')}"
+                f" --dim {int(cfg.get('dim', 8))}"
+                f" --batch-events {int(cfg.get('batch_events', 8))}"
+                f" --ckpt-every {int(cfg.get('ckpt_every', 5))}"
+                f" --publish-every {int(cfg.get('publish_every', 2))}"
+                f" --publish-dir {os.path.join(self.workdir, 'models')}"
+                f" --lr {float(cfg.get('lr', 0.05))}"
+                f" --stop-file {os.path.join(self.workdir, 'STOP')}"
+                f" --status-file "
+                f"{os.path.join(self.workdir, 'loop-status.jsonl')}"
+            ),
+        ))
+        return name
+
+    def _drive_trainer_crash_loop(self) -> Dict[str, Any]:
+        """The exactly-once drill: a deterministic feedback stream is
+        spooled while a REAL continuous-trainer subprocess consumes it
+        against live PS pods; the trainer is SIGKILLed mid-loop after a
+        joint checkpoint committed, resumed, and at the end the live
+        tier + dense state must be bit-identical to a fault-free
+        reference that trained each event exactly once."""
+        import numpy as np
+
+        from easydl_tpu.loop import continuous as loop_continuous
+        from easydl_tpu.loop.feedback import FeedbackWriter
+        from easydl_tpu.ps.client import ShardedPsClient
+        from easydl_tpu.ps.table import TableSpec
+
+        sc = self.scenario
+        cfg = dict(sc.loop_drill or {})
+        n_events = int(cfg.get("events", 600))
+        rows = int(cfg.get("rows", 2))
+        fields = int(cfg.get("fields", 3))
+        vocab = int(cfg.get("vocab", 2000))
+        dim = int(cfg.get("dim", 8))
+        pace_s = float(cfg.get("pace_s", 0.004))
+        kill_at = int(cfg.get("kill_at_event", n_events // 2))
+        resume_after_s = float(cfg.get("resume_after_s", 0.5))
+        self._launch_ps()
+        spool = os.path.join(self.workdir, "feedback", "serve-0")
+        writer = FeedbackWriter(spool, replica="serve-0",
+                                max_bytes=1 << 30,
+                                segment_bytes=int(cfg.get(
+                                    "segment_bytes", 1 << 16)),
+                                sync_s=0.05)
+        # The whole stream up front from the scenario seed: the live
+        # trainer and the exactly-once reference read byte-identical
+        # events, so any digest divergence is the resume path's fault.
+        rng = np.random.default_rng(int(cfg.get("seed", sc.chaos.seed)))
+        stream = []
+        for i in range(n_events):
+            ids = (rng.zipf(1.1, rows * fields) % vocab).astype(
+                np.int64).reshape(rows, fields)
+            scores = rng.standard_normal(rows).astype(np.float32)
+            labels = (rng.random(rows) < 0.3).astype(np.float32)
+            stream.append((ids, scores, labels))
+        pointer = os.path.join(self.workdir, "loop-state", "latest.json")
+        status_path = os.path.join(self.workdir, "loop-status.jsonl")
+        pod = self._loop_trainer_pod(1, cfg, spool)
+        kill_mark: Dict[str, Any] = {}
+        for i, (ids, scores, labels) in enumerate(stream):
+            writer.emit_serve(f"r{i:06d}", f"sess{i % 17}", "control", 0,
+                              ids, scores)
+            writer.emit_labels(f"r{i:06d}", labels)
+            if i == kill_at:
+                # The kill is only meaningful after a joint checkpoint
+                # committed — otherwise "resume" would be a cold start
+                # and the drill vacuous. Emission pauses; the trainer
+                # catches up and checkpoints.
+                _wait_for(lambda: os.path.exists(pointer), 90.0,
+                          "first joint trainer checkpoint")
+                entry = self._pod_api._procs.get(pod)
+                if entry is None or entry.proc.poll() is not None:
+                    raise RuntimeError("loop trainer pod not running at "
+                                       "the kill point")
+                entry.proc.kill()
+                entry.proc.wait()
+                injectors.count_fault("trainer_kill")
+                kill_mark = {"t": time.time(), "at_event": i,
+                             "trainer_alive": True}
+                self._pod_api.poll()
+                self._pod_api.delete_pod(pod)
+                time.sleep(resume_after_s)
+                pod = self._loop_trainer_pod(2, cfg, spool)
+                log.info("loop trainer SIGKILLed at event %d and "
+                         "relaunched", i)
+            time.sleep(pace_s)
+        writer.sync()
+        with open(os.path.join(self.workdir, "STOP"), "w") as f:
+            f.write("1")
+
+        def done() -> bool:
+            try:
+                with open(status_path) as f:
+                    return any('"phase": "done"' in ln for ln in f)
+            except OSError:
+                return False
+
+        _wait_for(done, 180.0, "trainer to drain the spool and finish")
+        status_lines = []
+        with open(status_path) as f:
+            for ln in f:
+                try:
+                    status_lines.append(json.loads(ln))
+                except ValueError:
+                    continue
+        starts = [d for d in status_lines if d.get("phase") == "started"]
+        dones = [d for d in status_lines if d.get("phase") == "done"]
+        with open(pointer) as f:
+            final_pointer = json.load(f)
+        final_events = sum(
+            int((c or {}).get("events", 0))
+            for c in final_pointer.get("cursors", {}).values())
+        # --- the exactly-once oracle: fault-free reference replay
+        spec = TableSpec(name=str(cfg.get("table", "loop_emb")), dim=dim,
+                         optimizer="adagrad", seed=11, lr=0.05)
+        ref_client, ref_trainer = loop_continuous.reference_replay(
+            [spool], spec, sc.ps_shards,
+            int(cfg.get("batch_events", 8)), dim,
+            float(cfg.get("lr", 0.05)))
+        verify_step = 999999
+        live_dir = os.path.join(self.workdir, "loop-verify-live")
+        ref_dir = os.path.join(self.workdir, "loop-verify-ref")
+        live_client = ShardedPsClient.from_registry(
+            self.workdir, sc.ps_shards, timeout=5.0,
+            drain_retry_s=60.0, transient_retry_s=30.0)
+        try:
+            live_client.save(live_dir, verify_step)
+        finally:
+            live_client.close()
+        ref_client.save(ref_dir, verify_step)
+        live_digests = _table_digests(live_dir, verify_step)
+        ref_digests = _table_digests(ref_dir, verify_step)
+        dense_ref = loop_continuous.dense_digest(ref_trainer.dense)
+        restored = starts[1] if len(starts) > 1 else {}
+        restored_events = sum(
+            int(v) for v in (restored.get(
+                "restored_cursor_events") or {}).values())
+        evidence = {
+            "events_emitted": n_events,
+            "kill": kill_mark,
+            "restarts": max(0, len(starts) - 1),
+            "restored_step": int(restored.get("restored_step", -1)),
+            "restored_cursor_events": restored_events,
+            "replayed_window": (
+                kill_mark.get("at_event", 0) - restored_events
+                if restored else 0),
+            "final_cursor_events": final_events,
+            "dense_digest_live": str(final_pointer.get("dense_digest")),
+            "dense_digest_reference": dense_ref,
+            "dense_match":
+                str(final_pointer.get("dense_digest")) == dense_ref,
+            "live_digests": live_digests,
+            "reference_digests": ref_digests,
+            "digests_match": bool(live_digests)
+                and live_digests == ref_digests,
+            "published": (dones[-1].get("published", [])
+                          if dones else []),
+            "spool": dict(writer.stats),
+            "reference_batcher": dict(ref_trainer.batcher.stats),
+        }
+        writer.close()
+        path = os.path.join(self.workdir, "loop-evidence.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(evidence, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return evidence
+
+    def _drive_rollout_half_update(self) -> Dict[str, Any]:
+        """The commit-gated rollout drill: a serving replica under real
+        gRPC load rides publish → torn publish → corrupt publish →
+        complete publish → canary A/B → promote → one-RPC rollback.
+        Neither the torn nor the corrupt version may EVER be served; the
+        hot-swap and the rollback may not hard-fail a single request."""
+        import numpy as np
+
+        from easydl_tpu.loop import publish as model_publish
+        from easydl_tpu.loop.feedback import (
+            REC_SERVE, SPOOL_SUFFIX, FeedbackWriter, decode_serve_event,
+        )
+        from easydl_tpu.loop.spool import SpoolCursor, SpoolReader
+        from easydl_tpu.proto import easydl_pb2 as pb
+        from easydl_tpu.ps.client import LocalPsClient
+        from easydl_tpu.ps.read_client import PsReadClient
+        from easydl_tpu.ps.table import TableSpec
+        from easydl_tpu.serve import ServeConfig, ServeFrontend
+        from easydl_tpu.serve.frontend import SERVE_SERVICE
+        from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, RpcClient
+
+        sc = self.scenario
+        cfg = dict(sc.loop_drill or {})
+        rows = int(cfg.get("rows", 4))
+        fields = int(cfg.get("fields", 3))
+        vocab = int(cfg.get("vocab", 500))
+        dim = int(cfg.get("dim", 4))
+        pace_s = float(cfg.get("pace_s", 0.005))
+        n_sessions = int(cfg.get("sessions", 24))
+        models = os.path.join(self.workdir, "models")
+        spool = os.path.join(self.workdir, "feedback", "serve-0")
+        client = LocalPsClient(num_shards=2, coalesce=False)
+        client.create_table(TableSpec(name="t", dim=dim, optimizer="sgd",
+                                      seed=1, lr=0.1))
+        reads = PsReadClient(client)
+        writer = FeedbackWriter(spool, replica="serve-0",
+                                max_bytes=1 << 28, sync_s=0.1)
+        frontend = ServeFrontend(
+            reads, ServeConfig(table="t", fields=fields, dense_dim=0,
+                               max_wait_ms=1.0, request_timeout_s=60.0),
+            name="serve-0", feedback=writer,
+            canary_fraction=0.5, rollout_salt="drill")
+
+        def loader(manifest, arrays):
+            w = np.asarray(arrays["w"], np.float32)
+
+            def fwd(emb, dense):
+                s = emb.reshape(len(emb), -1).sum(axis=1)
+                return (s * np.float32(w.sum())).astype(np.float32)
+
+            return fwd
+
+        swap_log: list = []
+
+        def on_swap(version, fwd):
+            swap_log.append({"t": time.time(), "version": int(version)})
+            frontend.set_model(version, fwd)
+
+        watcher = model_publish.ModelVersionWatcher(
+            models, loader, on_swap=on_swap, replica="serve-0",
+            poll_s=0.1)
+        frontend.attach_rollout(watcher)
+        server = frontend.serve(obs_workdir=self.workdir,
+                                obs_name="serve-0")
+        watcher.start()
+        counts = {"requests": 0, "ok": 0, "shed": 0, "hard_failures": 0,
+                  "failure_samples": []}
+        stop = threading.Event()
+        rng = np.random.default_rng(sc.chaos.seed)
+
+        def drive() -> None:
+            cl = RpcClient(SERVE_SERVICE, f"localhost:{server.port}",
+                           timeout=30.0, options=GRPC_MSG_OPTIONS)
+            i = 0
+            while not stop.is_set():
+                ids = (rng.integers(0, vocab, rows * fields)
+                       .astype("<i8"))
+                req = pb.InferRequest(
+                    raw_ids=ids.tobytes(), fields=fields,
+                    session_id=f"sess-{i % n_sessions}")
+                counts["requests"] += 1
+                try:
+                    resp = cl.Infer(req)
+                except Exception as e:
+                    log.warning("rollout drill request failed: %r", e)
+                    counts["hard_failures"] += 1
+                    if len(counts["failure_samples"]) < 5:
+                        counts["failure_samples"].append(repr(e))
+                else:
+                    if resp.ok:
+                        counts["ok"] += 1
+                    elif resp.verdict.startswith("overloaded"):
+                        counts["shed"] += 1
+                    else:
+                        counts["hard_failures"] += 1
+                        if len(counts["failure_samples"]) < 5:
+                            counts["failure_samples"].append(
+                                str(resp.verdict))
+                i += 1
+                stop.wait(pace_s)
+
+        driver = threading.Thread(target=drive, name="rollout-drive",
+                                  daemon=True)
+        driver.start()
+
+        def wait_control(v: int, desc: str) -> None:
+            _wait_for(lambda: frontend.model_versions().get(
+                "control") == v, 30.0, desc)
+
+        evidence: Dict[str, Any] = {}
+        errors: list = []
+        v1 = v2 = v3 = v4 = v5 = 0
+        promote_ok = False
+        rollback: Dict[str, Any] = {}
+        try:
+            time.sleep(0.3)  # load on the static version-0 forward first
+            v1 = model_publish.publish_version(
+                models, {"w": np.ones(dim, np.float32)}, keep=16)
+            wait_control(v1, "adoption of v1 under load")
+            # --- torn publication: crash BEFORE the commit marker
+            v2 = model_publish.publish_version(
+                models, {"w": np.full(dim, 9.0, np.float32)}, keep=16,
+                _crash_before_commit=True)
+            injectors.count_fault("publish_crash")
+            time.sleep(0.8)  # several watcher polls
+            # --- corrupt publication: bad payload CRC, valid marker
+            v3 = model_publish.publish_version(
+                models, {"w": np.full(dim, 7.0, np.float32)}, keep=16,
+                _crash_before_commit=True)
+            p = os.path.join(models, f"v_{v3:08d}", "w.npy")
+            data = bytearray(open(p, "rb").read())
+            data[-1] ^= 0xFF
+            with open(p, "wb") as f:
+                f.write(bytes(data))
+            with open(os.path.join(models, f"v_{v3:08d}", "COMMITTED"),
+                      "w") as f:
+                f.write(str(v3))
+                f.flush()
+                os.fsync(f.fileno())
+            injectors.count_fault("publish_corrupt")
+            _wait_for(lambda: v3 in watcher.quarantined, 30.0,
+                      "corrupt version to be quarantined")
+            assert frontend.model_versions().get("control") == v1
+            # --- a complete publish hot-swaps under load
+            v4 = model_publish.publish_version(
+                models, {"w": np.full(dim, 2.0, np.float32)}, keep=16)
+            wait_control(v4, "hot-swap to v4 under load")
+            # --- canary arm: session-consistent A/B split. The rollback
+            # pin doubles as the pacing gate (the production shape): v5
+            # stays invisible to the CONTROL arm while canaried, so the
+            # split is a real cross-version A/B (control=v4, canary=v5).
+            model_publish.set_rollback(models, v4)
+            v5 = model_publish.publish_version(
+                models, {"w": np.full(dim, 3.0, np.float32)}, keep=16)
+            manifest, arrays = model_publish.load_version(models, v5)
+            frontend.set_model(v5, loader(manifest, arrays), arm="canary")
+            time.sleep(1.0)
+            assert frontend.model_versions().get("control") == v4, \
+                "canary leaked into the control arm"
+            # promote = lift the pin; the watcher adopts v5 to control
+            model_publish.clear_rollback(models)
+            frontend.clear_canary()
+            wait_control(v5, "canary promotion to control")
+            promote_ok = True
+            # --- ONE RPC instant rollback
+            cl = RpcClient(SERVE_SERVICE, f"localhost:{server.port}",
+                           timeout=30.0, options=GRPC_MSG_OPTIONS)
+            resp = cl.Rollout(pb.RolloutRequest(action="rollback"))
+            rollback = {"ok": bool(resp.ok), "message": str(resp.message),
+                        "active_after": int(resp.active_version),
+                        "swaps_reported": int(resp.swaps)}
+            assert frontend.model_versions().get("control") == v4
+            time.sleep(0.5)  # load keeps flowing on the rolled-back model
+        except Exception as e:
+            # A torn sequence is a FAILED verdict via the invariant (the
+            # evidence below records the error), never a harness crash.
+            log.exception("rollout drill sequence failed")
+            errors.append(repr(e))
+        finally:
+            stop.set()
+            driver.join(timeout=10.0)
+        # Session→arm consistency, judged against the PURE assignment
+        # function (the same one every replica computes): every canary-
+        # scored event must belong to a canary-assigned session, and the
+        # split must be real (some sessions canary, some control).
+        from easydl_tpu.loop.rollout import assign_arm as _assign
+
+        reader = SpoolReader(spool, SPOOL_SUFFIX)
+        payloads, _cur, _st = reader.read_from(
+            SpoolCursor(), known_kinds=(REC_SERVE,))
+        canary_sessions: set = set()
+        canary_events = 0
+        misassigned = 0
+        for pl in payloads:
+            ev = decode_serve_event(pl)
+            if ev.arm == "canary":
+                canary_events += 1
+                canary_sessions.add(ev.session_id)
+                if _assign(ev.session_id, frontend.canary_fraction,
+                           frontend.rollout_salt) != "canary":
+                    misassigned += 1
+        evidence = {
+            **counts,
+            "swaps": swap_log,
+            "torn_version": v2,
+            "torn_served": any(s["version"] == v2 for s in swap_log),
+            "corrupt_version": v3,
+            "corrupt_served": any(s["version"] == v3 for s in swap_log),
+            "quarantined": list(watcher.quarantined),
+            "canary": {
+                "version": v5,
+                "events": canary_events,
+                "sessions": sorted(canary_sessions),
+                "misassigned_events": misassigned,
+                "total_sessions": n_sessions,
+            },
+            "promote_ok": bool(promote_ok),
+            "rollback": rollback,
+            "final_versions": frontend.model_versions(),
+            "feedback": dict(writer.stats),
+            "errors": errors,
+        }
+        try:
+            frontend.stop()
+        except Exception as e:
+            log.warning("frontend stop failed: %s", e)
+        watcher.stop()
+        path = os.path.join(self.workdir, "rollout-evidence.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(evidence, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return evidence
 
     # --------------------------------------------------------------- helpers
     def _launch_ps(self) -> None:
@@ -1655,6 +2148,74 @@ def scenario_serve_during_reshard(seed: int = 59) -> Scenario:
     )
 
 
+def scenario_trainer_crash_mid_loop(seed: int = 61) -> Scenario:
+    """The production loop's exactly-once drill (ISSUE 13 / CHAOS_r17):
+    a REAL continuous-trainer subprocess tails a deterministic feedback
+    spool against live PS pods, is SIGKILLed mid-loop AFTER a joint
+    cursor+dense+sparse checkpoint committed, resumes from it (rolling
+    the sparse tier back to the snapshot via client.restore), and drains
+    the rest of the stream. Verdict: the final tier (optimizer rows
+    included) AND the dense state digest-match a fault-free reference
+    that trained each event exactly once — no event trained twice, none
+    dropped — with anti-vacuous gates on the resume actually replaying a
+    non-empty window."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="trainer_crash_mid_loop", seed=seed,
+            notes="SIGKILL the continuous trainer mid-loop after a joint "
+                  "checkpoint; resume must be exactly-once (digest "
+                  "parity vs a fault-free reference replay)",
+            faults=(),  # the kill fires at an event index, not a wall offset
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=2,
+        loop_drill={"kind": "trainer_crash", "events": 600, "rows": 2,
+                    "fields": 3, "vocab": 2000, "dim": 8,
+                    "batch_events": 8, "ckpt_every": 5,
+                    "publish_every": 2, "pace_s": 0.004,
+                    "kill_at_event": 250, "resume_after_s": 0.5},
+        expect={
+            "loop_exactly_once": True,
+            "min_loop_events": 100,   # vacuous-pass refusal
+            "min_faults": 1,          # the trainer kill
+        },
+    )
+
+
+def scenario_rollout_half_update(seed: int = 67) -> Scenario:
+    """The commit-gated rollout drill (ISSUE 13 / CHAOS_r17): a serving
+    replica under continuous gRPC load rides publish → TORN publish
+    (crash before the COMMITTED marker) → CORRUPT publish (bad payload
+    CRC under a valid marker) → complete publish (hot-swap under load)
+    → canary A/B arm → promote → ONE-RPC instant rollback. The torn and
+    corrupt versions must never be served (gated on the commit marker /
+    quarantined on CRC), no request may hard-fail across any swap, the
+    canary split must match the pure session-hash assignment, and the
+    rollback must land in the same RPC that asked for it."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="rollout_half_update", seed=seed,
+            notes="torn + corrupt model publications under serving load; "
+                  "neither may ever be served; hot-swap + canary + "
+                  "one-RPC rollback with zero hard request failures",
+            faults=(),  # injected at publication protocol points
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=0,
+        loop_drill={"kind": "rollout_half_update", "rows": 4,
+                    "fields": 3, "vocab": 500, "dim": 4,
+                    "pace_s": 0.005, "sessions": 24},
+        expect={
+            "rollout_commit_gated": True,
+            "min_rollout_requests": 50,   # vacuous-pass refusal
+            "min_version_swaps": 2,       # adoption + post-promote swap
+            "min_faults": 2,              # publish_crash + publish_corrupt
+        },
+    )
+
+
 def scenario_straggler_mitigation(seed: int = 47) -> Scenario:
     """Straggler detection + damped eviction (ROADMAP item 3's first named
     invariant): 2s after steady state the member's worker starts sleeping
@@ -1765,6 +2326,8 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "ps_zombie_writer": scenario_ps_zombie_writer,
     "ps_reshard_under_fire": scenario_ps_reshard_under_fire,
     "serve_during_reshard": scenario_serve_during_reshard,
+    "trainer_crash_mid_loop": scenario_trainer_crash_mid_loop,
+    "rollout_half_update": scenario_rollout_half_update,
     "straggler_mitigation": scenario_straggler_mitigation,
     "preempt_race": scenario_preempt_race,
 }

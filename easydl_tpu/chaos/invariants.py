@@ -632,6 +632,113 @@ def check_scenario(
                                    ("shard", "pod", "epoch", "address")},
                     }
 
+    # ------------------------------------------------- production loop (r17)
+    if expect.get("loop_exactly_once"):
+        ev: Dict[str, Any] = {}
+        try:
+            with open(os.path.join(workdir, "loop-evidence.json")) as f:
+                ev = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if not ev:
+            checks["loop_exactly_once"] = {
+                "ok": False,
+                "reason": "no loop-evidence.json in the workdir (drill "
+                          "crashed before writing evidence)",
+            }
+        else:
+            emitted = int(ev.get("events_emitted", 0))
+            min_events = int(expect.get("min_loop_events", 1))
+            restored_events = int(ev.get("restored_cursor_events", -1))
+            # Anti-vacuous, three ways: enough events flowed; the trainer
+            # really died and resumed from a REAL joint checkpoint (not a
+            # cold start); and the resume re-trained a non-empty window
+            # (a kill that landed exactly on a checkpoint boundary would
+            # prove nothing about the replay path).
+            ok = (bool(ev.get("digests_match"))
+                  and bool(ev.get("dense_match"))
+                  and emitted >= min_events
+                  and int(ev.get("final_cursor_events", -1)) == emitted
+                  and int(ev.get("restarts", 0)) >= 1
+                  and int(ev.get("restored_step", -1)) >= 1
+                  and 1 <= restored_events < emitted
+                  and int(ev.get("replayed_window", 0)) >= 1)
+            checks["loop_exactly_once"] = {
+                "ok": ok,
+                "events_emitted": emitted,
+                "min_loop_events": min_events,
+                "final_cursor_events": ev.get("final_cursor_events"),
+                "digests_match": ev.get("digests_match"),
+                "dense_match": ev.get("dense_match"),
+                "restarts": ev.get("restarts"),
+                "restored_step": ev.get("restored_step"),
+                "restored_cursor_events": restored_events,
+                "replayed_window": ev.get("replayed_window"),
+                "live_digests": ev.get("live_digests", {}),
+                "reference_digests": ev.get("reference_digests", {}),
+            }
+
+    if expect.get("rollout_commit_gated"):
+        ev = {}
+        try:
+            with open(os.path.join(workdir, "rollout-evidence.json")) as f:
+                ev = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if not ev:
+            checks["rollout_commit_gated"] = {
+                "ok": False,
+                "reason": "no rollout-evidence.json in the workdir "
+                          "(drill crashed before writing evidence)",
+            }
+        else:
+            swaps = ev.get("swaps", []) or []
+            canary = ev.get("canary", {}) or {}
+            rollback = ev.get("rollback", {}) or {}
+            fb = ev.get("feedback", {}) or {}
+            min_req = int(expect.get("min_rollout_requests", 1))
+            min_swaps = int(expect.get("min_version_swaps", 2))
+            ok = (not ev.get("errors")
+                  and int(ev.get("requests", 0)) >= min_req
+                  and int(ev.get("hard_failures", -1)) == 0
+                  # Anti-vacuous: swaps really happened under load, AND
+                  # a torn + a corrupt publication were really attempted
+                  # — a run that never tore a publish proves nothing
+                  # about the commit gate.
+                  and len(swaps) >= min_swaps
+                  and int(ev.get("torn_version", 0)) > 0
+                  and not ev.get("torn_served", True)
+                  and int(ev.get("corrupt_version", 0)) > 0
+                  and not ev.get("corrupt_served", True)
+                  and int(ev.get("corrupt_version", 0))
+                  in (ev.get("quarantined") or [])
+                  and bool(ev.get("promote_ok"))
+                  and bool(rollback.get("ok"))
+                  and int(canary.get("events", 0)) >= 1
+                  and int(canary.get("misassigned_events", 1)) == 0
+                  and 1 <= len(canary.get("sessions", []))
+                  < int(canary.get("total_sessions", 0) or 1 << 30)
+                  and int(fb.get("serve_events", 0)) >= 1)
+            checks["rollout_commit_gated"] = {
+                "ok": ok,
+                "requests": ev.get("requests"),
+                "hard_failures": ev.get("hard_failures"),
+                "failure_samples": ev.get("failure_samples"),
+                "version_swaps": len(swaps),
+                "min_version_swaps": min_swaps,
+                "torn_version": ev.get("torn_version"),
+                "torn_served": ev.get("torn_served"),
+                "corrupt_version": ev.get("corrupt_version"),
+                "corrupt_served": ev.get("corrupt_served"),
+                "quarantined": ev.get("quarantined"),
+                "canary": canary,
+                "promote_ok": ev.get("promote_ok"),
+                "rollback": rollback,
+                "feedback_serve_events": fb.get("serve_events"),
+                "errors": ev.get("errors"),
+                "min_rollout_requests": min_req,
+            }
+
     # ----------------------------------------------------- faults cross-check
     min_faults = expect.get("min_faults")
     if min_faults is not None:

@@ -456,7 +456,35 @@ def run_worker(env: Dict[str, str]) -> int:
     next_sync = start_step
     per_process_batch = global_batch // max(world, 1)
     data_source = None
-    if cfg.get("data_dir"):
+    if cfg.get("feedback_spools"):
+        # Continuous-training mode (the production loop, ROADMAP item 3):
+        # instead of a finite file dataset, tail serving replicas'
+        # feedback spools. The FeedbackDataset wears the same contract as
+        # the file datasets — {sparse_ids, dense, label} batches and a
+        # state()/restore_state() cursor that rides the checkpoint
+        # metadata — so the spool cursors commit ATOMICALLY with the
+        # dense checkpoint and a worker crash resumes the stream
+        # exactly-once. Exhausted spools block-with-timeout inside the
+        # iterator; the worker's loop is unchanged.
+        from easydl_tpu.loop.feedback import FeedbackDataset
+
+        data_source = FeedbackDataset(
+            [str(d) for d in cfg["feedback_spools"]],
+            batch_size=per_process_batch,
+            dense_dim=int(cfg.get("feedback_dense_dim", 0)),
+            batch_timeout_s=float(cfg.get("feedback_batch_timeout_s",
+                                          30.0)),
+        )
+        if latest >= 0:
+            data_state = ckpt.metadata(latest).get("metadata", {}).get(
+                "data_state"
+            )
+            if data_state:
+                data_source.restore_state(data_state)
+        log.info("gen %d: continuous feedback data from %s (rank %d/%d)",
+                 generation, cfg["feedback_spools"], rank, world)
+        data = iter(data_source)
+    elif cfg.get("data_dir"):
         from easydl_tpu.data import (
             ArrayImageDataset,
             ClickLogDataset,

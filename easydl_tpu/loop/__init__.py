@@ -1,0 +1,41 @@
+"""The production loop: streaming feedback, continuous training, and
+versioned model rollout (ROADMAP item 3).
+
+The train and serve tiers were both live but disconnected; production
+recommenders are a *loop* (serve → feedback → continuous train → rollout
+→ serve, per Monolith's real-time recommendation shape). This package
+closes it, riding existing primitives end to end:
+
+- :mod:`easydl_tpu.loop.spool` — the shared CRC-framed, torn-tail-safe
+  record spool: the PR-6 WAL framing generalized into one reusable core
+  (size-rotated segments, consumed-offset markers, cursor tailing) that
+  ``ps/wal.py`` now imports too, so WAL and spool can never drift;
+- :mod:`easydl_tpu.loop.feedback` — serving replicas emit a bounded
+  on-disk feedback spool (request id, served ids, scores, delayed label
+  join); the emit hook never blocks or fails a serve request;
+- :mod:`easydl_tpu.loop.continuous` — the continuous trainer: tails
+  one-or-more replica spools (exhausted spools block-with-timeout),
+  converts events to training batches, and checkpoints its spool
+  cursors atomically with the dense/sparse checkpoint so a trainer
+  crash resumes exactly-once — the WAL/replay discipline applied to
+  input data;
+- :mod:`easydl_tpu.loop.publish` — dense checkpoints published as
+  immutable versioned artifacts (manifest + CRC, COMMITTED-marker last,
+  quarantine on corruption), watched by serve replicas that hot-swap
+  the jitted forward between batches — version visibility is
+  commit-marker-gated exactly like reshard cutover, and rollback is one
+  RPC that can never serve a half-updated model;
+- :mod:`easydl_tpu.loop.rollout` — the PURE policy half: session→arm
+  assignment (hash(session_id), stable across requests) and the
+  canary-pacing decision, virtual-clock replayable through the PR-8
+  simulator (easylint rule-5 scope).
+"""
+
+from easydl_tpu.loop.spool import (  # noqa: F401
+    SegmentWriter,
+    SpoolCursor,
+    SpoolError,
+    SpoolReader,
+    frame,
+    read_segment,
+)

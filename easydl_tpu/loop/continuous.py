@@ -1,0 +1,393 @@
+"""The continuous trainer: tail feedback spools, train, checkpoint
+cursors atomically with the model, resume exactly-once.
+
+The WAL/replay discipline applied to *input data*: the spool is the log,
+the trainer's cursor is the replay boundary, and the joint checkpoint —
+sparse tier snapshot (``client.save``) + dense arrays + spool cursors,
+committed by ONE atomic pointer rename — is the cut. A SIGKILLed trainer
+resumes by restoring all three halves of that cut (``client.restore``
+rolls the PS tables back to the snapshot; the dense arrays and cursors
+come from the pointer), then re-tails the spool from the cursor: every
+event between the cut and the crash re-trains exactly once on top of
+exactly the state it originally trained on, and nothing after the cut is
+double-applied or dropped. The chaos drill proves it the strongest way
+the repo knows: final table digests (optimizer rows included) and dense
+digests bit-identical to a fault-free reference that consumed the same
+stream once.
+
+Training math lives in module functions (:func:`event_grads`,
+:func:`dense_update`) shared VERBATIM by the live trainer and the
+drill's reference replay — the two sides cannot drift.
+
+Also runnable as a process (the drill's SIGKILL target)::
+
+    python -m easydl_tpu.loop.continuous --workdir W --spool S \
+        --shards 2 --table loop_emb --publish-dir W/models
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from easydl_tpu.loop.feedback import FeedbackBatcher, FeedbackEvent
+from easydl_tpu.loop import publish as model_publish
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("loop", "continuous")
+
+_POINTER = "latest.json"
+
+
+_metrics_cache: Optional[tuple] = None
+
+
+def _metrics():
+    global _metrics_cache
+    if _metrics_cache is None:
+        from easydl_tpu.obs import get_registry
+
+        reg = get_registry()
+        _metrics_cache = (
+            reg.gauge(
+                "easydl_loop_lag_seconds",
+                "Freshness lag of the most recent trained batch: serve-"
+                "time event emission → trained into the live tier. THE "
+                "loop SLO signal (BENCH_LOOP.json gates its p99).",
+                ("replica",)),
+            reg.counter(
+                "easydl_loop_trained_events_total",
+                "Feedback events trained into the model.", ("replica",)),
+            reg.counter(
+                "easydl_loop_checkpoints_total",
+                "Joint cursor+dense+sparse checkpoints committed.",
+                ("replica",)),
+        )
+    return _metrics_cache
+
+
+# ------------------------------------------------------------ training math
+def event_grads(ev: FeedbackEvent, dim: int):
+    """Deterministic sparse gradient for one feedback event: one f32 row
+    per (row, field) id, a pure function of the event's bytes — the live
+    trainer and the drill's fault-free reference compute the identical
+    update from the identical spool record."""
+    flat = np.ascontiguousarray(ev.ids.reshape(-1), np.int64)
+    fields = ev.ids.shape[1] if ev.ids.ndim == 2 else 1
+    labels = np.asarray(ev.labels, np.float32)
+    lab = np.repeat(labels - np.float32(0.5), fields)
+    base = ((flat % 1009).astype(np.float32) / np.float32(1009.0)
+            - np.float32(0.5))
+    col = ((np.arange(dim, dtype=np.float32) + np.float32(1.0))
+           / np.float32(dim))
+    g = (lab + base)[:, None] * col[None, :]
+    return flat, np.ascontiguousarray(g, np.float32)
+
+
+def fresh_dense(dim: int) -> Dict[str, np.ndarray]:
+    return {"w": np.zeros(dim, np.float32), "b": np.zeros((), np.float32)}
+
+
+def dense_update(dense: Dict[str, np.ndarray], ev: FeedbackEvent,
+                 lr: float) -> None:
+    """Deterministic in-place dense step for one event (sequential f32
+    accumulation: a double-trained event provably moves the digest)."""
+    labels = np.asarray(ev.labels, np.float32)
+    err = np.float32(labels.mean(dtype=np.float32) - np.float32(0.5))
+    feat = ((ev.ids.reshape(-1)[: len(dense["w"])] % 257)
+            .astype(np.float32) / np.float32(257.0))
+    if len(feat) < len(dense["w"]):
+        feat = np.pad(feat, (0, len(dense["w"]) - len(feat)))
+    dense["w"] += np.float32(lr) * err * feat
+    dense["b"] += np.float32(lr) * err
+
+
+def dense_digest(dense: Dict[str, np.ndarray]) -> str:
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for k in sorted(dense):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(dense[k], "<f4").tobytes())
+    return h.hexdigest()
+
+
+# --------------------------------------------------------------- the trainer
+class ContinuousTrainer:
+    """Tail spools → train → jointly checkpoint → publish.
+
+    ``client`` is any PS client (ShardedPsClient against live pods, or
+    LocalPsClient for the in-process reference/bench). The joint
+    checkpoint commit order is the whole correctness story:
+
+    1. ``client.save(ps_ckpt_dir, step)`` — the sparse half (every
+       shard's ``.done`` markers make a torn save invisible);
+    2. dense arrays → ``dense-<step>.npz`` (tmp + rename);
+    3. the POINTER (``latest.json``: step, npz name, spool cursors,
+       accounting) — tmp + fsync + rename: THIS is the commit;
+    4. only then ``mark_consumed()`` — the spool writer may now retire
+       segments, because the durable cursor covers them.
+
+    A crash between any two steps resumes from the previous pointer; a
+    pointer always names a sparse step and an npz that exist."""
+
+    def __init__(self, client, table_spec, spool_dirs: List[str],
+                 state_dir: str, ps_ckpt_dir: str,
+                 publish_dir: Optional[str] = None,
+                 batch_events: int = 8, ckpt_every_batches: int = 10,
+                 publish_every_ckpts: int = 2, dense_dim: int = 8,
+                 lr: float = 0.05, name: str = "loop-trainer",
+                 label_horizon_s: Optional[float] = None):
+        self.client = client
+        self.table = table_spec
+        self.state_dir = state_dir
+        self.ps_ckpt_dir = ps_ckpt_dir
+        self.publish_dir = publish_dir
+        self.batch_events = int(batch_events)
+        self.ckpt_every = int(ckpt_every_batches)
+        self.publish_every = int(publish_every_ckpts)
+        self.lr = float(lr)
+        self.name = name
+        os.makedirs(state_dir, exist_ok=True)
+        self.batcher = FeedbackBatcher(spool_dirs,
+                                       label_horizon_s=label_horizon_s)
+        self.dense = fresh_dense(int(dense_dim))
+        self.step = 0                 # committed checkpoint step (batches)
+        self.batches = 0              # batches trained this lineage
+        self.events_trained = 0       # events trained since last restore
+        self.ckpts = 0
+        self.published: List[int] = []
+        client.create_table(table_spec)
+
+    # ------------------------------------------------------------- restore
+    def restore(self) -> Dict[str, Any]:
+        """Resume from the last committed joint checkpoint (no-op on a
+        fresh state dir). Returns evidence for the drill verdict."""
+        pointer = os.path.join(self.state_dir, _POINTER)
+        try:
+            with open(pointer) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return {"restored": False}
+        with np.load(os.path.join(self.state_dir, doc["npz"])) as z:
+            self.dense = {k: np.array(z[k]) for k in z.files}
+        self.step = int(doc["step"])
+        self.batches = self.step
+        self.batcher.restore_state(doc.get("cursors", {}))
+        if self.step > 0:
+            # Roll the sparse tier back to the snapshot the cursor names:
+            # events after it re-train on exactly the state they first
+            # trained on — the exactly-once half the cursor alone can't
+            # give (the tier kept the crashed run's extra pushes).
+            self.client.restore(self.ps_ckpt_dir, self.step)
+        cursors = doc.get("cursors", {})
+        evidence = {
+            "restored": True,
+            "restored_step": self.step,
+            "restored_cursor_events": {
+                d: int((c or {}).get("events", 0))
+                for d, c in cursors.items()},
+            "published": list(doc.get("published", [])),
+        }
+        self.published = list(doc.get("published", []))
+        log.info("continuous trainer resumed at step %d (cursors: %s)",
+                 self.step, cursors)
+        return evidence
+
+    # ------------------------------------------------------------ training
+    def train_batch(self, events: List[FeedbackEvent]) -> None:
+        m = _metrics()
+        now = time.time()
+        for ev in events:
+            flat, g = event_grads(ev, self.table.dim)
+            self.client.push(self.table.name, flat, g, scale=self.lr)
+            dense_update(self.dense, ev, self.lr)
+        self.events_trained += len(events)
+        self.batches += 1
+        lag = max(0.0, now - min(ev.t for ev in events))
+        m[0].set(lag, replica=self.name)
+        m[1].inc(len(events), replica=self.name)
+
+    def checkpoint(self) -> None:
+        step = self.batches
+        self.client.save(self.ps_ckpt_dir, step)
+        npz = f"dense-{step:010d}.npz"
+        tmp = os.path.join(self.state_dir, npz + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **self.dense)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.state_dir, npz))
+        doc = {
+            "step": step,
+            "npz": npz,
+            "cursors": self.batcher.state(),
+            "events_trained": self.events_trained,
+            "published": list(self.published),
+            "dense_digest": dense_digest(self.dense),
+        }
+        pointer = os.path.join(self.state_dir, _POINTER)
+        tmp = pointer + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, pointer)        # <- the commit
+        self.step = step
+        self.ckpts += 1
+        self.batcher.mark_consumed()    # retirement only past the commit
+        self._prune_checkpoints()
+        _metrics()[2].inc(replica=self.name)
+        if self.publish_dir and self.ckpts % self.publish_every == 0:
+            v = model_publish.publish_version(
+                self.publish_dir, self.dense,
+                meta={"step": step, "events": self.events_trained,
+                      "trainer": self.name})
+            self.published.append(v)
+
+    def _prune_checkpoints(self, keep: int = 3) -> None:
+        """A continuous trainer never terminates: without retention the
+        per-checkpoint dense npz files and sparse step dirs would grow
+        without bound. Keep the newest ``keep`` of each, and NEVER
+        anything at/above the committed pointer step backwards — only
+        strictly older state the pointer can no longer name."""
+        import glob as _glob
+        import re as _re
+        import shutil as _shutil
+
+        npzs = sorted(_glob.glob(os.path.join(self.state_dir,
+                                              "dense-*.npz")))
+        for p in npzs[:-keep]:
+            m = _re.search(r"dense-(\d+)\.npz$", p)
+            if m and int(m.group(1)) < self.step:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        steps = sorted(_glob.glob(os.path.join(self.ps_ckpt_dir,
+                                               "step_*")))
+        for d in steps[:-keep]:
+            m = _re.search(r"step_(\d+)$", d)
+            if m and int(m.group(1)) < self.step:
+                _shutil.rmtree(d, ignore_errors=True)
+
+    def run(self, stop_check: Callable[[], bool],
+            batch_timeout_s: float = 2.0) -> Dict[str, Any]:
+        """Tail-train until ``stop_check()`` AND the spools are drained;
+        exhausted spools block-with-timeout, they never terminate the
+        loop. Ends with a final joint checkpoint."""
+        while True:
+            batch = self.batcher.next_batch(
+                self.batch_events, timeout_s=batch_timeout_s,
+                allow_partial=stop_check())
+            if batch:
+                self.train_batch(batch)
+                if self.batches % self.ckpt_every == 0:
+                    self.checkpoint()
+                continue
+            if stop_check():
+                break
+        if self.batches > self.step:
+            self.checkpoint()
+        return {
+            "step": self.step,
+            "events_trained": self.events_trained,
+            "published": list(self.published),
+            "dense_digest": dense_digest(self.dense),
+            "batcher": dict(self.batcher.stats),
+        }
+
+
+# --------------------------------------------------------- reference replay
+def reference_replay(spool_dirs: List[str], table_spec, num_shards: int,
+                     batch_events: int, dense_dim: int, lr: float,
+                     ckpt_every_batches: int = 10**9):
+    """Fault-free in-process replay of the same spool stream, exactly
+    once, through the SAME math — the drill's digest oracle. Returns the
+    (LocalPsClient, trainer) pair after consuming everything readable."""
+    from easydl_tpu.ps.client import LocalPsClient
+
+    client = LocalPsClient(num_shards=num_shards, coalesce=False)
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="loop-ref-")
+    trainer = ContinuousTrainer(
+        client, table_spec, spool_dirs,
+        state_dir=os.path.join(tmp, "state"),
+        ps_ckpt_dir=os.path.join(tmp, "ps-ckpt"),
+        publish_dir=None, batch_events=batch_events,
+        ckpt_every_batches=ckpt_every_batches, dense_dim=dense_dim,
+        lr=lr, name="loop-reference", label_horizon_s=3600.0)
+    while True:
+        batch = trainer.batcher.next_batch(batch_events, timeout_s=0.0,
+                                           allow_partial=True)
+        if not batch:
+            break
+        trainer.train_batch(batch)
+    return client, trainer
+
+
+# ------------------------------------------------------------------ process
+def main(argv: Optional[List[str]] = None) -> int:
+    """The SIGKILL-able process shape of the trainer (the chaos drill's
+    target): connects to the registry-backed PS tier, restores the joint
+    checkpoint if one exists, and tail-trains until ``--stop-file``
+    appears and the spools drain."""
+    ap = argparse.ArgumentParser(description="continuous feedback trainer")
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--spool", action="append", required=True,
+                    help="feedback spool dir (repeatable)")
+    ap.add_argument("--table", default="loop_emb")
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--optimizer", default="adagrad")
+    ap.add_argument("--shards", type=int, required=True)
+    ap.add_argument("--batch-events", type=int, default=8)
+    ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--publish-every", type=int, default=2)
+    ap.add_argument("--publish-dir", default=None)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--stop-file", required=True)
+    ap.add_argument("--status-file", required=True)
+    ap.add_argument("--name", default="loop-trainer")
+    args = ap.parse_args(argv)
+
+    from easydl_tpu.ps.client import ShardedPsClient
+    from easydl_tpu.ps.table import TableSpec
+
+    def status(doc: Dict[str, Any]) -> None:
+        with open(args.status_file, "a") as f:
+            f.write(json.dumps(dict(doc, pid=os.getpid(),
+                                    t=time.time())) + "\n")
+
+    spec = TableSpec(name=args.table, dim=args.dim,
+                     optimizer=args.optimizer, seed=11, lr=0.05)
+    client = ShardedPsClient.from_registry(
+        args.workdir, args.shards, timeout=5.0,
+        drain_retry_s=120.0, transient_retry_s=60.0)
+    try:
+        trainer = ContinuousTrainer(
+            client, spec, list(args.spool),
+            state_dir=os.path.join(args.workdir, "loop-state"),
+            ps_ckpt_dir=os.path.join(args.workdir, "loop-ps-ckpt"),
+            publish_dir=args.publish_dir,
+            batch_events=args.batch_events,
+            ckpt_every_batches=args.ckpt_every,
+            publish_every_ckpts=args.publish_every,
+            dense_dim=args.dim, lr=args.lr, name=args.name)
+        evidence = trainer.restore()
+        status(dict(evidence, phase="started"))
+        summary = trainer.run(
+            stop_check=lambda: os.path.exists(args.stop_file))
+        status(dict(summary, phase="done"))
+    finally:
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,542 @@
+"""The feedback stream: serve → bounded on-disk spool → training batches.
+
+Writer side (one per serving replica): :class:`FeedbackWriter` appends
+CRC-framed serve events (request id, session, arm, model version, served
+ids, scores) and delayed label records to a size-rotated spool under
+``<dir>/feedback/<replica>/`` — the PR-6 WAL framing via the shared
+loop/spool.py core. The emit hook NEVER blocks or fails a serve request:
+a broken or over-budget spool drops the event with a counted reason
+(``easydl_feedback_dropped_total{reason}``), it never raises into the
+request path. The byte bound is enforced against the trainer's durable
+consumed marker (CONSUMED.json — the REPLAYED.json pattern): segments the
+trainer has checkpointed past are retired, and only when retirement can't
+free room does the writer shed.
+
+Reader side (the continuous trainer): :class:`FeedbackBatcher` tails
+one-or-more replica spools from checkpointable cursors, joins delayed
+labels to their serve events IN SPOOL ORDER (the watermark discipline:
+an event is released only when labeled or past the join horizon — the
+horizon fallback trains it with the implicit negative label, the classic
+CTR treatment for labels that never arrive), and yields training batches.
+Exhausted spools block-with-timeout, never terminate. The batcher's
+cursor state is what the trainer checkpoints atomically with its
+dense/sparse checkpoint: restore re-reads from the watermark, re-forms
+the same batches, and trains each event exactly once relative to the
+restored model — labels re-read for already-trained events are orphans,
+dropped with a count.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+from collections import deque
+
+from easydl_tpu.loop.spool import (
+    CONSUMED_MARKER,
+    SegmentWriter,
+    SpoolCursor,
+    SpoolError,
+    SpoolReader,
+    read_offset_marker,
+    resident_bytes,
+    retire_consumed,
+    write_offset_marker,
+)
+from easydl_tpu.utils.env import knob_float, knob_int
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("loop", "feedback")
+
+#: frame kinds (0/1 are the PS WAL's; a reader that meets a kind it does
+#: not know skips it with a count — loop/spool.py contract)
+REC_SERVE = 2
+REC_LABEL = 3
+
+SPOOL_SUFFIX = ".spool"
+
+ENV_SPOOL_BYTES = "EASYDL_FEEDBACK_SPOOL_BYTES"
+ENV_SEGMENT_BYTES = "EASYDL_FEEDBACK_SEGMENT_BYTES"
+ENV_SYNC_S = "EASYDL_FEEDBACK_SYNC_S"
+ENV_POLL_S = "EASYDL_FEEDBACK_POLL_S"
+ENV_LABEL_HORIZON_S = "EASYDL_FEEDBACK_LABEL_HORIZON_S"
+
+# kind, rid_len, sid_len, arm, fields, rows, model_version, t
+_SERVE_HEAD = struct.Struct("<BHHBHIqd")
+# kind, rid_len, rows, t
+_LABEL_HEAD = struct.Struct("<BHId")
+
+ARM_CONTROL = 0
+ARM_CANARY = 1
+_ARM_NAMES = {ARM_CONTROL: "control", ARM_CANARY: "canary"}
+_ARM_CODES = {v: k for k, v in _ARM_NAMES.items()}
+
+
+@dataclass
+class FeedbackEvent:
+    """One served request's feedback: what was scored, by which model,
+    and (once joined) the delayed labels."""
+
+    request_id: str
+    session_id: str
+    arm: str                      # "control" | "canary"
+    model_version: int
+    t: float                      # emit wall time (loop-lag anchor)
+    ids: np.ndarray               # (rows, fields) int64
+    scores: np.ndarray            # (rows,) float32
+    labels: Optional[np.ndarray] = None  # (rows,) float32 once joined
+    #: how the labels got here: "joined" | "horizon" (implicit negative)
+    label_source: str = ""
+
+    @property
+    def rows(self) -> int:
+        return len(self.ids)
+
+
+# ------------------------------------------------------------------ codecs
+def encode_serve_event(request_id: str, session_id: str, arm: str,
+                       model_version: int, ids: np.ndarray,
+                       scores: np.ndarray, t: float) -> List[bytes]:
+    """Scatter-gather parts for one serve event (same zero-join discipline
+    as the WAL's push codec)."""
+    rid = request_id.encode()
+    sid = session_id.encode()
+    ids = np.ascontiguousarray(ids, "<i8")
+    if ids.ndim != 2:
+        raise ValueError(f"ids must be (rows, fields), got {ids.shape}")
+    scores = np.ascontiguousarray(scores, "<f4")
+    return [
+        _SERVE_HEAD.pack(REC_SERVE, len(rid), len(sid),
+                         _ARM_CODES.get(arm, ARM_CONTROL),
+                         ids.shape[1], ids.shape[0],
+                         int(model_version), float(t)),
+        rid, sid, ids.tobytes(), scores.tobytes(),
+    ]
+
+
+def decode_serve_event(payload: bytes) -> FeedbackEvent:
+    kind, rid_len, sid_len, arm, fields, rows, version, t = \
+        _SERVE_HEAD.unpack_from(payload, 0)
+    if kind != REC_SERVE:
+        raise ValueError(f"not a serve event (kind={kind})")
+    off = _SERVE_HEAD.size
+    rid = payload[off:off + rid_len].decode()
+    off += rid_len
+    sid = payload[off:off + sid_len].decode()
+    off += sid_len
+    ids = np.frombuffer(payload, "<i8", count=rows * fields,
+                        offset=off).reshape(rows, fields)
+    off += 8 * rows * fields
+    scores = np.frombuffer(payload, "<f4", count=rows, offset=off)
+    return FeedbackEvent(rid, sid, _ARM_NAMES.get(arm, "control"),
+                         version, t, ids, scores)
+
+
+def encode_label(request_id: str, labels: np.ndarray,
+                 t: float) -> List[bytes]:
+    rid = request_id.encode()
+    labels = np.ascontiguousarray(labels, "<f4")
+    return [
+        _LABEL_HEAD.pack(REC_LABEL, len(rid), len(labels), float(t)),
+        rid, labels.tobytes(),
+    ]
+
+
+def decode_label(payload: bytes) -> Tuple[str, np.ndarray, float]:
+    kind, rid_len, rows, t = _LABEL_HEAD.unpack_from(payload, 0)
+    if kind != REC_LABEL:
+        raise ValueError(f"not a label record (kind={kind})")
+    off = _LABEL_HEAD.size
+    rid = payload[off:off + rid_len].decode()
+    labels = np.frombuffer(payload, "<f4", count=rows, offset=off + rid_len)
+    return rid, labels, t
+
+
+# ----------------------------------------------------------------- metrics
+_metrics_cache: Optional[tuple] = None
+
+
+def _metrics():
+    global _metrics_cache
+    if _metrics_cache is None:
+        from easydl_tpu.obs import get_registry
+
+        reg = get_registry()
+        _metrics_cache = (
+            reg.counter(
+                "easydl_feedback_events_total",
+                "Feedback records spooled, by replica and kind "
+                "(serve | label).", ("replica", "kind")),
+            reg.counter(
+                "easydl_feedback_bytes_total",
+                "Feedback spool bytes appended (framed).", ("replica",)),
+            reg.counter(
+                "easydl_feedback_dropped_total",
+                "Feedback records DROPPED instead of spooled, by reason "
+                "(bound = byte budget exhausted even after retirement; "
+                "error = spool unappendable). The emit hook never blocks "
+                "or fails a serve request — drops are the pressure "
+                "valve, and this counter is its only trace.",
+                ("replica", "reason")),
+        )
+    return _metrics_cache
+
+
+# ------------------------------------------------------------------ writer
+class FeedbackWriter:
+    """The serve-side emit hook: bounded, lossy-with-count, never raises.
+
+    Thread-safe (the frontend's batch runner emits from one thread, label
+    producers may be another). ``max_bytes`` bounds the spool's on-disk
+    footprint: before shedding, the writer retires segments the trainer's
+    CONSUMED.json marker durably covers; if that frees nothing, the event
+    is dropped and counted — backpressure must never reach the request
+    path."""
+
+    def __init__(self, directory: str, replica: str = "serve-0",
+                 max_bytes: Optional[int] = None,
+                 segment_bytes: Optional[int] = None,
+                 sync_s: Optional[float] = None):
+        self.dir = directory
+        self.replica = replica
+        self.max_bytes = int(
+            knob_int(ENV_SPOOL_BYTES) if max_bytes is None else max_bytes)
+        self._mu = threading.Lock()
+        self._writer = SegmentWriter(
+            directory,
+            segment_bytes=int(knob_int(ENV_SEGMENT_BYTES)
+                              if segment_bytes is None else segment_bytes),
+            sync_s=float(knob_float(ENV_SYNC_S)
+                         if sync_s is None else sync_s),
+            suffix=SPOOL_SUFFIX,
+            error_cls=SpoolError,
+        )
+        self._resident = resident_bytes(directory, SPOOL_SUFFIX)
+        #: local accounting mirror of the counters (drill/test evidence
+        #: without a registry scrape)
+        self.stats: Dict[str, int] = {
+            "serve_events": 0, "label_events": 0, "bytes": 0,
+            "dropped_bound": 0, "dropped_error": 0,
+        }
+
+    def emit_serve(self, request_id: str, session_id: str, arm: str,
+                   model_version: int, ids: np.ndarray, scores: np.ndarray,
+                   t: Optional[float] = None) -> bool:
+        try:
+            parts = encode_serve_event(
+                request_id, session_id, arm, model_version, ids, scores,
+                time.time() if t is None else t)
+        except Exception as e:  # malformed event: drop, never raise
+            self._count_drop("error", repr(e))
+            return False
+        return self._append(parts, "serve")
+
+    def emit_labels(self, request_id: str, labels: np.ndarray,
+                    t: Optional[float] = None) -> bool:
+        """Append delayed labels for a previously-emitted serve event.
+
+        ORDERING CONTRACT: a label must land in the spool AFTER its serve
+        record. Request ids are minted by the serve path (``<replica>-
+        <seq>``) and only become known to a label producer once the serve
+        event exists, so the API naturally satisfies this — but a
+        producer that somehow wrote a label first would race the
+        trainer's restore watermark: a label behind the checkpointed
+        cursor whose serve record is ahead of it re-reads as an orphan,
+        and the event would train with the implicit negative label
+        instead of the real one."""
+        try:
+            parts = encode_label(request_id, labels,
+                                 time.time() if t is None else t)
+        except Exception as e:
+            self._count_drop("error", repr(e))
+            return False
+        return self._append(parts, "label")
+
+    def _append(self, parts: List[bytes], kind: str) -> bool:
+        m = _metrics()
+        with self._mu:
+            need = sum(len(p) for p in parts) + 8
+            if self._resident + need > self.max_bytes:
+                # Try to free durably-consumed segments before shedding.
+                retire_consumed(self.dir, SPOOL_SUFFIX)
+                self._resident = resident_bytes(self.dir, SPOOL_SUFFIX)
+                if self._resident + need > self.max_bytes:
+                    self._count_drop_locked("bound", None)
+                    return False
+            try:
+                n = self._writer.append(parts)
+            except Exception as e:  # SpoolError or anything else: drop
+                self._count_drop_locked("error", repr(e))
+                return False
+            self._resident += n
+            self.stats[f"{kind}_events"] += 1
+            self.stats["bytes"] += n
+        m[0].inc(replica=self.replica, kind=kind)
+        m[1].inc(n, replica=self.replica)
+        return True
+
+    def _count_drop(self, reason: str, detail) -> None:
+        with self._mu:
+            self._count_drop_locked(reason, detail)
+
+    def _count_drop_locked(self, reason: str, detail) -> None:
+        self.stats[f"dropped_{reason}"] += 1
+        _metrics()[2].inc(replica=self.replica, reason=reason)
+        if detail:
+            log.warning("feedback event dropped (%s): %s", reason, detail)
+
+    def sync(self) -> None:
+        self._writer.sync()
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+# ------------------------------------------------------------------ reader
+@dataclass
+class _PendingEvent:
+    event: FeedbackEvent
+    #: cursor just past this event's SERVE record — the watermark the
+    #: batcher's state() reports once the event is released + handed out
+    cursor: SpoolCursor
+    read_t: float  # trainer-side wall time the record was read (horizon)
+
+
+@dataclass
+class _SpoolState:
+    reader: SpoolReader
+    cursor: SpoolCursor = field(default_factory=SpoolCursor)
+    pending: Deque[_PendingEvent] = field(default_factory=deque)
+    labels: Dict[str, np.ndarray] = field(default_factory=dict)
+    released: Deque[Tuple[FeedbackEvent, SpoolCursor]] = \
+        field(default_factory=deque)
+    read_cursor: SpoolCursor = field(default_factory=SpoolCursor)
+    #: EVENTS handed out up to the durable cursor (the cursor's own
+    #: ``records`` field counts raw spool records — serve AND label —
+    #: so exactly-once accounting needs this separately)
+    events: int = 0
+
+
+class FeedbackBatcher:
+    """Tail replica spools → label-joined training batches, exactly-once.
+
+    ``state()`` returns the per-spool watermarks covering every event in
+    every batch HANDED OUT so far — checkpoint it atomically with the
+    model and, on restore, ``restore_state()`` + re-reading reproduces
+    the same remaining stream. In-order release (the watermark
+    discipline) is what makes a single cursor per spool sufficient: an
+    event is released only after every event before it, so "cursor past
+    event i" means events ≤ i are consumed, > i are not."""
+
+    def __init__(self, spool_dirs: List[str],
+                 label_horizon_s: Optional[float] = None,
+                 clock=time.time):
+        if not spool_dirs:
+            raise ValueError("FeedbackBatcher needs at least one spool dir")
+        self.horizon_s = float(
+            knob_float(ENV_LABEL_HORIZON_S)
+            if label_horizon_s is None else label_horizon_s)
+        self._clock = clock
+        self._spools: Dict[str, _SpoolState] = {
+            d: _SpoolState(reader=SpoolReader(d, SPOOL_SUFFIX))
+            for d in spool_dirs
+        }
+        self.stats: Dict[str, int] = {
+            "events": 0, "orphan_labels": 0, "horizon_released": 0,
+            "unknown_kinds": 0, "torn_segments": 0,
+        }
+        #: max event-emit→read lag seen in the last poll (loop-lag input)
+        self.last_read_lag_s: float = 0.0
+
+    # ------------------------------------------------------------- cursors
+    def state(self) -> Dict[str, Any]:
+        return {d: dict(s.cursor.to_dict(), events=s.events)
+                for d, s in self._spools.items()}
+
+    def restore_state(self, doc: Dict[str, Any]) -> None:
+        for d, s in self._spools.items():
+            entry = (doc or {}).get(d)
+            cur = SpoolCursor.from_dict(entry)
+            s.cursor = cur
+            s.read_cursor = cur
+            s.events = int(dict(entry or {}).get("events", 0))
+            s.pending.clear()
+            s.labels.clear()
+            s.released.clear()
+
+    def mark_consumed(self) -> None:
+        """Write each spool's CONSUMED.json at the current checkpointed
+        cursor — the writer-side retirement signal. Call ONLY after the
+        cursor state has been durably checkpointed: a marker past the
+        durable cursor would let the writer retire a segment a crash
+        restore still needs."""
+        from easydl_tpu.loop.spool import list_segments
+
+        for d, s in self._spools.items():
+            if s.cursor.segment:
+                caps = dict(read_offset_marker(d, CONSUMED_MARKER))
+                # every segment before the cursor's is wholly consumed
+                for name in list_segments(d, SPOOL_SUFFIX):
+                    if name < s.cursor.segment:
+                        caps[name] = max(caps.get(name, 0), 1 << 62)
+                caps[s.cursor.segment] = max(
+                    caps.get(s.cursor.segment, 0), s.cursor.offset)
+                write_offset_marker(d, caps, CONSUMED_MARKER,
+                                    shrink_only=False)
+
+    # -------------------------------------------------------------- tailing
+    def _poll_spool(self, s: _SpoolState) -> None:
+        recs, new_cursor, st = s.reader.read_records(
+            s.read_cursor, known_kinds=(REC_SERVE, REC_LABEL))
+        self.stats["torn_segments"] += st["torn"]
+        now = self._clock()
+        for payload, pos in recs:
+            kind = payload[0]
+            if kind == REC_SERVE:
+                try:
+                    ev = decode_serve_event(payload)
+                except Exception as e:
+                    log.warning("undecodable serve event skipped: %r", e)
+                    self.stats["unknown_kinds"] += 1
+                    continue
+                self.last_read_lag_s = max(0.0, now - ev.t)
+                pending = _PendingEvent(ev, pos, now)
+                lbl = s.labels.pop(ev.request_id, None)
+                if lbl is not None and len(lbl) == ev.rows:
+                    ev.labels = np.asarray(lbl, np.float32)
+                    ev.label_source = "joined"
+                s.pending.append(pending)
+            elif kind == REC_LABEL:
+                try:
+                    rid, labels, _t = decode_label(payload)
+                except Exception as e:
+                    log.warning("undecodable label skipped: %r", e)
+                    self.stats["unknown_kinds"] += 1
+                    continue
+                hit = False
+                for pe in s.pending:
+                    if pe.event.request_id == rid \
+                            and pe.event.labels is None:
+                        if len(labels) == pe.event.rows:
+                            pe.event.labels = np.asarray(labels, np.float32)
+                            pe.event.label_source = "joined"
+                        hit = True
+                        break
+                if not hit:
+                    # Label for an event not pending: either already
+                    # trained (post-restore re-read) or ahead of its serve
+                    # record from a parallel writer thread — buffer it;
+                    # buffered labels that never match age out with their
+                    # spool-order position (bounded by pending flow).
+                    if rid in s.labels:
+                        self.stats["orphan_labels"] += 1
+                    s.labels[rid] = labels
+            s.read_cursor = pos
+        # release head-of-line events: labeled, or past the join horizon
+        while s.pending:
+            head = s.pending[0]
+            if head.event.labels is None:
+                if now - head.read_t < self.horizon_s:
+                    break
+                head.event.labels = np.zeros(head.event.rows, np.float32)
+                head.event.label_source = "horizon"
+                self.stats["horizon_released"] += 1
+            s.pending.popleft()
+            s.labels.pop(head.event.request_id, None)
+            s.released.append((head.event, head.cursor))
+        # drop label buffer entries that can never match (their serve
+        # record is behind the cursor): bounded memory
+        if len(s.labels) > 4096:
+            overflow = len(s.labels) - 4096
+            for rid in list(s.labels)[:overflow]:
+                s.labels.pop(rid, None)
+                self.stats["orphan_labels"] += 1
+
+    def next_batch(self, batch_size: int, timeout_s: float = 10.0,
+                   poll_s: Optional[float] = None,
+                   allow_partial: bool = False
+                   ) -> List[FeedbackEvent]:
+        """Up to ``batch_size`` released events, round-robin across
+        spools in a deterministic spool order. Blocks-with-timeout when
+        exhausted: returns ``[]`` (or a partial batch when
+        ``allow_partial``) after ``timeout_s`` — a tailing trainer loops,
+        it never terminates on an empty spool."""
+        poll = float(knob_float(ENV_POLL_S) if poll_s is None else poll_s)
+        deadline = self._clock() + timeout_s
+        batch: List[FeedbackEvent] = []
+        taken: List[Tuple[str, SpoolCursor]] = []
+        while True:
+            progressed = True
+            while len(batch) < batch_size and progressed:
+                progressed = False
+                for d in sorted(self._spools):
+                    s = self._spools[d]
+                    if not s.released:
+                        self._poll_spool(s)
+                    if s.released and len(batch) < batch_size:
+                        ev, cur = s.released.popleft()
+                        batch.append(ev)
+                        taken.append((d, cur))
+                        progressed = True
+            if len(batch) >= batch_size:
+                break
+            if self._clock() >= deadline:
+                if not allow_partial and batch:
+                    # put partials back in order for the next call
+                    for (d, cur), ev in zip(reversed(taken),
+                                            reversed(batch)):
+                        self._spools[d].released.appendleft((ev, cur))
+                    batch, taken = [], []
+                break
+            time.sleep(min(poll, max(0.0, deadline - self._clock())))
+        # advance the durable watermark over everything handed out
+        for d, cur in taken:
+            self._spools[d].cursor = cur
+            self._spools[d].events += 1
+        self.stats["events"] += len(batch)
+        return batch
+
+
+class FeedbackDataset:
+    """The elastic worker's feedback data source: FeedbackBatcher wearing
+    the ClickLogDataset contract ({sparse_ids, dense, label} batches,
+    ``state()``/``restore_state()`` riding the checkpoint metadata) — the
+    spool cursors checkpoint atomically with the dense model exactly like
+    the file datasets' cursor does."""
+
+    def __init__(self, spool_dirs: List[str], batch_size: int,
+                 dense_dim: int = 0, batch_timeout_s: float = 30.0,
+                 label_horizon_s: Optional[float] = None):
+        self.batcher = FeedbackBatcher(spool_dirs,
+                                       label_horizon_s=label_horizon_s)
+        self.batch_size = int(batch_size)
+        self.dense_dim = int(dense_dim)
+        self.batch_timeout_s = float(batch_timeout_s)
+        #: nominal — a feedback stream has no epochs; the worker only logs
+        #: this, scheduling never depends on it
+        self.batches_per_epoch = 1 << 30
+
+    def state(self) -> Dict[str, Any]:
+        return {"spool_cursors": self.batcher.state()}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self.batcher.restore_state((state or {}).get("spool_cursors", {}))
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            batch = self.batcher.next_batch(
+                self.batch_size, timeout_s=self.batch_timeout_s)
+            if not batch:
+                continue  # exhausted spool: keep tailing, never terminate
+            yield {
+                "sparse_ids": np.concatenate([e.ids for e in batch]),
+                "dense": np.zeros(
+                    (sum(e.rows for e in batch), self.dense_dim),
+                    np.float32),
+                "label": np.concatenate([e.labels for e in batch]),
+            }

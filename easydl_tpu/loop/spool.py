@@ -1,0 +1,465 @@
+"""The shared CRC-framed record-spool core: one framing, two disciplines.
+
+The PR-6 push WAL (ps/wal.py) proved the shape — ``u32 len | u32
+crc32(payload) | payload`` frames appended to size-rotated segment files,
+readers that validate every checksum and truncate torn tails, and
+consumed-offset markers so a record is never replayed past where a
+consumer durably acknowledged it. The production loop needs the exact
+same contract for its feedback stream (serve → spool → continuous
+trainer), so the generic halves live HERE and ``ps/wal.py`` imports them:
+the WAL and the spool share one frame codec, one segment walker, one
+offset-marker schema — they cannot drift.
+
+Two consumers, two durability stances, one core:
+
+- the WAL is write-side durable (an append failure FAILS the push);
+- the feedback spool is read-side durable (the *trainer's* checkpointed
+  cursor is the exactly-once boundary; the writer is bounded and
+  lossy-with-count under pressure, because a spool must never block or
+  fail a serve request).
+
+Payloads lead with a kind byte. Kinds 0/1 are the WAL's (push /
+create_table); the feedback stream uses 2/3 (serve event / label). A
+reader that meets a kind it does not know must SKIP it with a count —
+never crash the replayer — so a newer writer's records degrade to a
+counter on an older reader (:meth:`SpoolReader.read_from` returns the
+skip count when given ``known_kinds``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple, Type
+
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("loop", "spool")
+
+_HEADER = struct.Struct("<II")  # payload_len, crc32(payload)
+
+#: offset-marker filename the feedback spool uses (the WAL's REPLAYED.json
+#: pattern under its own name: same schema, different consumer semantics —
+#: "the trainer's durable cursor covers these bytes; the writer may retire
+#: fully-consumed segments").
+CONSUMED_MARKER = "CONSUMED.json"
+
+
+class SpoolError(RuntimeError):
+    """The spool could not be appended (disk full, closed fd, ...)."""
+
+
+def record_kind(payload: bytes) -> int:
+    return payload[0] if payload else -1
+
+
+def frame(payload: bytes) -> bytes:
+    """One framed record: header + payload (the wire/disk unit)."""
+    return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def read_segment(path: str, limit: Optional[int] = None,
+                 start: int = 0) -> Tuple[List[bytes], int, bool]:
+    """Parse one segment: ``(payloads, bytes_consumed, clean)``.
+
+    Stops at the first short or checksum-failing frame — everything from
+    there on is treated as a torn tail and excluded (``clean`` False).
+    ``limit`` caps the bytes considered (a consumer's recorded offset
+    marker: bytes appended past it must stay invisible to later
+    replays/reads that honor the marker). ``start`` is an ABSOLUTE byte
+    offset at a frame boundary (a tailing consumer's cursor): the read
+    seeks there instead of re-reading and re-checksumming everything it
+    already consumed — what keeps a spool poll O(new bytes), not
+    O(segment). ``consumed`` stays absolute either way."""
+    payloads: List[bytes] = []
+    consumed = start
+    clean = True
+    try:
+        with open(path, "rb") as f:
+            if start:
+                f.seek(start)
+            data = f.read()
+    except OSError:
+        return payloads, start, False
+    if limit is not None:
+        data = data[:max(0, limit - start)]
+    off = 0
+    while off + _HEADER.size <= len(data):
+        length, crc = _HEADER.unpack_from(data, off)
+        body = off + _HEADER.size
+        end = body + length
+        if end > len(data):
+            clean = False  # torn tail: killed mid-append
+            break
+        payload = data[body:end]
+        if zlib.crc32(payload) != crc:
+            clean = False  # corrupt record: stop, never consume past it
+            break
+        payloads.append(payload)
+        consumed = start + end
+        off = end
+    if off + _HEADER.size > len(data) and off != len(data):
+        clean = False  # trailing partial header
+    return payloads, consumed, clean
+
+
+def list_segments(d: str, suffix: str) -> List[str]:
+    """Sorted segment filenames (``seg-NNNNNNNN<suffix>``) under ``d``."""
+    try:
+        return sorted(
+            n for n in os.listdir(d)
+            if n.startswith("seg-") and n.endswith(suffix)
+        )
+    except OSError:
+        return []
+
+
+# ----------------------------------------------------------- offset markers
+def read_offset_marker(d: str, marker: str) -> Dict[str, int]:
+    """Per-segment consumed-byte caps recorded by a consumer (empty when
+    absent/unreadable). One schema for the WAL's REPLAYED.json and the
+    spool's CONSUMED.json — both go through here."""
+    try:
+        with open(os.path.join(d, marker)) as f:
+            return {str(k): int(v)
+                    for k, v in json.load(f).get("segments", {}).items()}
+    except (OSError, ValueError):
+        return {}
+
+
+def write_offset_marker(d: str, consumed: Dict[str, int], marker: str,
+                        shrink_only: bool = True) -> None:
+    """Record how far a consumer got in each segment, atomically
+    (tmp+fsync+rename). With ``shrink_only`` (the WAL's replay-cap
+    semantics) an existing cap never grows; the spool's consumed marker
+    passes False — the trainer's durable cursor only ever advances."""
+    path = os.path.join(d, marker)
+    merged = dict(consumed)
+    try:
+        with open(path) as f:
+            for k, v in json.load(f).get("segments", {}).items():
+                if shrink_only:
+                    merged[str(k)] = min(int(v), merged.get(str(k), int(v)))
+                else:
+                    merged.setdefault(str(k), int(v))
+    except (OSError, ValueError):
+        pass
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"segments": merged}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------- appending
+class SegmentWriter:
+    """The append side: one open segment, size-rotated, background-fsynced.
+
+    The exact PR-6 PsWal mechanics, parameterized: incremental-CRC
+    scatter-gather ``os.writev`` appends (no joined-buffer copy),
+    rotate-BEFORE-write so :meth:`rollback` is a plain ftruncate of the
+    open segment, a background fsync cadence (``sync_s``; 0 = fsync every
+    append, negative = never), and a ``_broken`` latch that surfaces any
+    IO error on the next append instead of silently degrading.
+
+    NOT thread-safe by itself — callers serialize appends (the WAL under
+    its ordering lock; the feedback writer under its own mutex)."""
+
+    def __init__(self, directory: str, segment_bytes: int,
+                 sync_s: float, suffix: str,
+                 error_cls: Type[Exception] = SpoolError):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.segment_bytes = int(segment_bytes)
+        self.sync_s = float(sync_s)
+        self.suffix = suffix
+        self._error_cls = error_cls
+        existing = list_segments(directory, suffix)
+        self._next_index = (
+            int(existing[-1][4:-len(suffix)]) + 1) if existing else 1
+        self._fd: Optional[int] = None
+        self._size = 0
+        self._path = ""
+        self._dirty = False
+        self._broken: Optional[Exception] = None
+        # Guards fd close/reassign against the background syncer: without
+        # it, cut() closing the segment between the syncer's fd check and
+        # its fsync raises EBADF (or fsyncs an unrelated reused fd) and
+        # permanently bricks the log via _broken.
+        self._fdmu = threading.Lock()
+        self._open_segment()
+        self._stop = threading.Event()
+        self._syncer: Optional[threading.Thread] = None
+        if self.sync_s > 0:
+            self._syncer = threading.Thread(
+                target=self._sync_loop, name="spool-sync", daemon=True)
+            self._syncer.start()
+
+    # ------------------------------------------------------------ internals
+    def _open_segment(self) -> None:
+        self._path = os.path.join(
+            self.dir, f"seg-{self._next_index:08d}{self.suffix}")
+        self._next_index += 1
+        self._fd = os.open(self._path,
+                           os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+        self._size = 0
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self.sync_s):
+            try:
+                self.sync()
+            except OSError as e:  # surfaces on the next append
+                self._broken = e
+
+    # ----------------------------------------------------------------- api
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def broken(self) -> Optional[Exception]:
+        return self._broken
+
+    def append(self, payload) -> int:
+        """Frame + write one record; returns the framed byte count.
+        Accepts the payload joined or as scatter-gather parts (checksummed
+        incrementally, landed via one ``os.writev``). Raises the writer's
+        ``error_cls`` when the log is unappendable."""
+        if self._broken is not None:
+            raise self._error_cls(
+                f"spool {self.dir} broken: {self._broken}")
+        # Rotate BEFORE the write, not after: the frame just appended is
+        # then always wholly inside the OPEN segment, which is what makes
+        # :meth:`rollback` a plain ftruncate when the apply it was logged
+        # for fails.
+        if self._size >= self.segment_bytes:
+            self.cut()
+        parts = [payload] if isinstance(payload, bytes) else list(payload)
+        length = sum(len(p) for p in parts)
+        crc = 0
+        for p in parts:
+            crc = zlib.crc32(p, crc)
+        total = _HEADER.size + length
+        try:
+            written = os.writev(self._fd,
+                                [_HEADER.pack(length, crc)] + parts)
+            if written < total:  # partial writev: finish the frame plainly
+                rest = (_HEADER.pack(length, crc)
+                        + b"".join(parts))[written:]
+                while rest:
+                    rest = rest[os.write(self._fd, rest):]
+            if self.sync_s == 0:
+                os.fsync(self._fd)
+        except OSError as e:
+            self._broken = e
+            raise self._error_cls(
+                f"spool append to {self._path} failed: {e}")
+        self._size += total
+        self._dirty = True
+        return total
+
+    def rollback(self, n_bytes: int) -> None:
+        """Truncate the last ``n_bytes`` (one just-appended frame) off the
+        open segment. Only valid immediately after the append, under the
+        caller's serialization (append rotates first, so the frame is
+        always in the open segment). A failed truncate marks the log
+        broken — later appends then fail loudly rather than diverge."""
+        with self._fdmu:
+            if self._fd is None:
+                return
+            self._size = max(0, self._size - n_bytes)
+            try:
+                os.ftruncate(self._fd, self._size)
+            except OSError as e:
+                self._broken = e
+
+    def sync(self) -> None:
+        with self._fdmu:
+            if self._dirty and self._fd is not None:
+                self._dirty = False
+                os.fsync(self._fd)
+
+    def cut(self) -> List[str]:
+        """Close the open segment and start a fresh one; returns the paths
+        of every COMPLETED segment (retirement candidates once a consumer
+        durably covers them)."""
+        with self._fdmu:
+            if self._fd is not None:
+                try:
+                    os.fsync(self._fd)
+                except OSError:
+                    pass
+                os.close(self._fd)
+            done = self._path
+            self._open_segment()
+            self._dirty = False
+        older = [os.path.join(self.dir, n)
+                 for n in list_segments(self.dir, self.suffix)]
+        return [p for p in older if p != self._path and p <= done]
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._syncer is not None:
+            # A still-running syncer (join timeout) is why the fd close
+            # below must also happen under _fdmu.
+            self._syncer.join(timeout=2.0)
+        try:
+            self.sync()
+        except OSError:
+            pass
+        with self._fdmu:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+
+# ------------------------------------------------------------------ reading
+@dataclass(frozen=True)
+class SpoolCursor:
+    """Durable read position in one spool directory: everything before
+    ``segment`` plus the first ``offset`` bytes of it are consumed. The
+    continuous trainer checkpoints this ATOMICALLY with its dense/sparse
+    checkpoint — the exactly-once boundary."""
+
+    segment: str = ""
+    offset: int = 0
+    #: events consumed up to this cursor (accounting, not correctness)
+    records: int = 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"segment": self.segment, "offset": int(self.offset),
+                "records": int(self.records)}
+
+    @staticmethod
+    def from_dict(doc) -> "SpoolCursor":
+        doc = dict(doc or {})
+        return SpoolCursor(segment=str(doc.get("segment", "")),
+                           offset=int(doc.get("offset", 0)),
+                           records=int(doc.get("records", 0)))
+
+
+class SpoolReader:
+    """Tail one spool directory from a cursor.
+
+    Torn-tail policy mirrors the rescue replay, adapted to tailing: a
+    short/corrupt frame in the NEWEST segment is *pending* (the writer may
+    be mid-append — stop there, the cursor stays at the consumed
+    boundary); the same damage in an older segment is a dead writer's torn
+    tail — counted and skipped, the read moves to the next segment.
+    Unknown frame kinds are skipped with a count, never raised: an old
+    replayer meeting a newer writer's records must degrade to a counter,
+    not crash (the generic-framing contract)."""
+
+    def __init__(self, directory: str, suffix: str = ".spool"):
+        self.dir = directory
+        self.suffix = suffix
+
+    def read_records(self, cursor: SpoolCursor,
+                     known_kinds: Optional[Tuple[int, ...]] = None,
+                     max_records: Optional[int] = None
+                     ) -> Tuple[List[Tuple[bytes, SpoolCursor]],
+                                SpoolCursor, Dict[str, int]]:
+        """Read records past ``cursor``; returns ``(records, new_cursor,
+        stats)`` where each record is ``(payload, cursor_after_it)`` — the
+        per-record position is what lets a consumer checkpoint a watermark
+        mid-stream (the label-join release point) — and stats counts
+        ``torn`` segments skipped and ``unknown_kinds`` records dropped.
+        An empty record list with an unchanged cursor means the spool is
+        exhausted (block-with-timeout at the caller, never terminate)."""
+        segments = list_segments(self.dir, self.suffix)
+        stats = {"torn": 0, "unknown_kinds": 0}
+        out: List[Tuple[bytes, SpoolCursor]] = []
+        seg, off, nrec = cursor.segment, cursor.offset, cursor.records
+        for i, name in enumerate(segments):
+            if cursor.segment and name < cursor.segment:
+                continue
+            start = cursor.offset if name == cursor.segment else 0
+            path = os.path.join(self.dir, name)
+            # seek straight to the cursor: a poll pays for NEW bytes
+            # only, never a re-read/re-CRC of what it already consumed
+            recs, consumed, clean = read_segment(path, start=start)
+            newest = i == len(segments) - 1
+            pos = start
+            for p in recs:
+                end = pos + _HEADER.size + len(p)
+                pos = end
+                seg, off = name, end
+                nrec += 1
+                if known_kinds is not None \
+                        and record_kind(p) not in known_kinds:
+                    stats["unknown_kinds"] += 1
+                else:
+                    out.append((p, SpoolCursor(seg, off, nrec)))
+                if max_records is not None and len(out) >= max_records:
+                    return out, SpoolCursor(seg, off, nrec), stats
+            if not clean and newest:
+                # possibly mid-append: stop at the consumed boundary
+                break
+            if not clean:
+                stats["torn"] += 1
+                log.warning("spool %s: torn tail in non-newest segment %s "
+                            "(skipping to next)", self.dir, name)
+            if newest:
+                break
+            # Moving past a finished (possibly empty/torn) segment: park
+            # the cursor at its clean end so the next call starts at the
+            # following segment — never behind where this read got to.
+            seg, off = name, max(consumed, start)
+        return out, SpoolCursor(seg, off, nrec), stats
+
+    def read_from(self, cursor: SpoolCursor,
+                  known_kinds: Optional[Tuple[int, ...]] = None,
+                  max_records: Optional[int] = None
+                  ) -> Tuple[List[bytes], SpoolCursor, Dict[str, int]]:
+        """:meth:`read_records` without the per-record positions."""
+        recs, cur, stats = self.read_records(cursor, known_kinds,
+                                             max_records)
+        return [p for p, _ in recs], cur, stats
+
+    def end_cursor(self) -> SpoolCursor:
+        """Cursor at the current clean end of the spool (everything
+        readable now is 'consumed' at this cursor)."""
+        payloads, cur, _ = self.read_from(SpoolCursor())
+        return cur
+
+
+def retire_consumed(directory: str, suffix: str = ".spool",
+                    marker: str = CONSUMED_MARKER) -> int:
+    """Writer-side retirement: delete segments wholly covered by the
+    consumer's offset marker (and not the newest — the open one). Returns
+    files removed. Safe against a resumed consumer: the marker is only
+    written at the consumer's CHECKPOINT commit, so a crash-restored
+    cursor can never point into a retired segment."""
+    caps = read_offset_marker(directory, marker)
+    segments = list_segments(directory, suffix)
+    removed = 0
+    for name in segments[:-1]:  # never the open segment
+        path = os.path.join(directory, name)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        if caps.get(name, -1) >= size:
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+def resident_bytes(directory: str, suffix: str = ".spool") -> int:
+    """Total on-disk bytes of the spool's segments (the writer's bound
+    reads this against its budget)."""
+    total = 0
+    for name in list_segments(directory, suffix):
+        try:
+            total += os.path.getsize(os.path.join(directory, name))
+        except OSError:
+            continue
+    return total

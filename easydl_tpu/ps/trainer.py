@@ -239,6 +239,38 @@ class PsTrainer(Trainer):
         )
         return state, metrics
 
+    def train_continuous(self, state: TrainState, feedback_data,
+                         steps_per_round: int, rounds: int,
+                         on_round=None, on_metrics=None):
+        """Continuous-training mode: consume a feedback stream
+        (loop/feedback.py ``FeedbackDataset`` — spool-tailing, label-
+        joined, block-with-timeout on exhaustion) in checkpointable
+        rounds.
+
+        Each round trains ``steps_per_round`` STRICT steps (the
+        synchronous pull→step→push path — no prefetch, no write-behind),
+        then calls ``on_round(state, data_state, metrics)`` with the
+        stream's cursor state. Strictness is the exactly-once contract:
+        when ``on_round`` commits ``data_state`` atomically with the
+        model checkpoint, every event the cursors cover has been pushed
+        and stepped, and nothing beyond them has been consumed — the
+        pipelined ``train_steps`` would have prefetched (and so consumed)
+        one batch past the cut. The elastic worker gets the same
+        guarantee for free (``feedback_spools`` job config): its data
+        cursor already rides the checkpoint metadata."""
+        it = iter(feedback_data)
+        metrics = None
+        for _ in range(rounds):
+            for _ in range(steps_per_round):
+                state, metrics = self.train_step(state, next(it))
+                if on_metrics is not None:
+                    on_metrics(metrics)
+            if on_round is not None:
+                data_state = (feedback_data.state()
+                              if hasattr(feedback_data, "state") else None)
+                on_round(state, data_state, metrics)
+        return state, metrics
+
     def train_steps(self, state: TrainState, data, n: int,
                     on_metrics=None):
         """Pipelined loop: the NEXT batch's embedding pull overlaps the

@@ -46,15 +46,26 @@ append, negative = never fsync).
 from __future__ import annotations
 
 import hashlib
-import json
 import os
 import struct
-import threading
-import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+# The generic framing/segment/offset-marker core is SHARED with the
+# feedback spool (loop/spool.py): one frame codec, one segment walker,
+# one marker schema — the WAL and the spool cannot drift. This module
+# keeps the PS-specific halves: push/create payload codecs, epoch-dir
+# layout, replay iteration, and the WAL durability stance (append
+# failure FAILS the push).
+from easydl_tpu.loop.spool import (
+    SegmentWriter,
+    frame,  # noqa: F401  (re-export: pre-existing public API)
+    list_segments,
+    read_offset_marker,
+    read_segment,  # noqa: F401  (re-export: pre-existing public API)
+    write_offset_marker,
+)
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.env import knob_float, knob_int
 
@@ -70,7 +81,6 @@ DEFAULT_SYNC_S = 0.2
 REC_PUSH = 0
 REC_CREATE = 1
 
-_HEADER = struct.Struct("<II")  # payload_len, crc32(payload)
 _PUSH_HEAD = struct.Struct("<BHdII")  # kind, table_len, scale, n_ids, dim
 
 REPLAYED_MARKER = "REPLAYED.json"
@@ -136,10 +146,6 @@ def record_kind(payload: bytes) -> int:
     return payload[0] if payload else -1
 
 
-def frame(payload: bytes) -> bytes:
-    return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
-
-
 def push_digest(payload) -> bytes:
     """Identity of one applied push, for replay-vs-retry dedupe: a client
     that never saw the ack of a push the dead shard DID apply (and WAL)
@@ -155,53 +161,8 @@ def push_digest(payload) -> bytes:
 
 
 # ------------------------------------------------------------------- reading
-def read_segment(path: str, limit: Optional[int] = None
-                 ) -> Tuple[List[bytes], int, bool]:
-    """Parse one segment: ``(payloads, bytes_consumed, clean)``.
-
-    Stops at the first short or checksum-failing frame — everything from
-    there on is treated as a torn tail and excluded (``clean`` False).
-    ``limit`` caps the bytes considered (a rescuer's recorded replay
-    offset: appends a zombie made after that rescue must stay invisible
-    to later rescues — they were re-acked by the successor)."""
-    payloads: List[bytes] = []
-    consumed = 0
-    clean = True
-    try:
-        with open(path, "rb") as f:
-            data = f.read()
-    except OSError:
-        return payloads, 0, False
-    if limit is not None:
-        data = data[:limit]
-    off = 0
-    while off + _HEADER.size <= len(data):
-        length, crc = _HEADER.unpack_from(data, off)
-        start = off + _HEADER.size
-        end = start + length
-        if end > len(data):
-            clean = False  # torn tail: killed mid-append
-            break
-        payload = data[start:end]
-        if zlib.crc32(payload) != crc:
-            clean = False  # corrupt record: stop, never apply past it
-            break
-        payloads.append(payload)
-        consumed = end
-        off = end
-    if off + _HEADER.size > len(data) and off != len(data):
-        clean = False  # trailing partial header
-    return payloads, consumed, clean
-
-
 def _segments(d: str) -> List[str]:
-    try:
-        return sorted(
-            n for n in os.listdir(d)
-            if n.startswith("seg-") and n.endswith(".wal")
-        )
-    except OSError:
-        return []
+    return list_segments(d, ".wal")
 
 
 def epoch_dirs(root: str) -> List[Tuple[int, str]]:
@@ -224,15 +185,10 @@ def epoch_dirs(root: str) -> List[Tuple[int, str]]:
 
 def read_replay_caps(epoch_dir: str) -> Dict[str, int]:
     """Parse an incarnation dir's ``REPLAYED.json`` consumed-offset caps
-    (empty when absent/unreadable). The one reader of the marker format —
-    replay and the chaos zombie-fence check both go through here, so the
-    schema lives in exactly one place."""
-    try:
-        with open(os.path.join(epoch_dir, REPLAYED_MARKER)) as f:
-            return {str(k): int(v)
-                    for k, v in json.load(f).get("segments", {}).items()}
-    except (OSError, ValueError):
-        return {}
+    (empty when absent/unreadable). One marker schema, shared with the
+    feedback spool's CONSUMED.json via loop/spool.py — replay and the
+    chaos zombie-fence check both go through here."""
+    return read_offset_marker(epoch_dir, REPLAYED_MARKER)
 
 
 def iter_replay(root: str, before_epoch: int,
@@ -272,25 +228,16 @@ def write_replay_marker(epoch_dir: str, consumed: Dict[str, int]) -> None:
     the SUCCESSOR when the client retried them) are never replayed by a
     later rescue. Merges over an existing marker: a cap, once written,
     never grows."""
-    path = os.path.join(epoch_dir, REPLAYED_MARKER)
-    merged = dict(consumed)
-    try:
-        with open(path) as f:
-            for k, v in json.load(f).get("segments", {}).items():
-                merged[str(k)] = min(int(v), merged.get(str(k), int(v)))
-    except (OSError, ValueError):
-        pass
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump({"segments": merged}, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    write_offset_marker(epoch_dir, dict(consumed), REPLAYED_MARKER,
+                        shrink_only=True)
 
 
 # ------------------------------------------------------------------- writing
-class PsWal:
-    """The append side: one open segment, size-rotated, background-fsynced.
+class PsWal(SegmentWriter):
+    """The append side: one open segment, size-rotated, background-fsynced
+    — the shared :class:`easydl_tpu.loop.spool.SegmentWriter` under the
+    WAL's knobs and error class (an unappendable log raises
+    :class:`WalError`, and the push that triggered it must FAIL).
 
     NOT thread-safe by itself — the shard serializes appends (and the
     append→store-apply pair) under its WAL ordering lock, which is what
@@ -299,151 +246,17 @@ class PsWal:
     def __init__(self, epoch_dir: str,
                  segment_bytes: Optional[int] = None,
                  sync_s: Optional[float] = None):
-        self.dir = epoch_dir
-        os.makedirs(epoch_dir, exist_ok=True)
-        self.segment_bytes = int(
-            knob_int(ENV_SEGMENT_BYTES, DEFAULT_SEGMENT_BYTES)
-            if segment_bytes is None else segment_bytes)
-        self.sync_s = float(
-            knob_float(ENV_SYNC_S, DEFAULT_SYNC_S)
-            if sync_s is None else sync_s)
-        existing = _segments(epoch_dir)
-        self._next_index = (int(existing[-1][4:-4]) + 1) if existing else 1
-        self._fd: Optional[int] = None
-        self._size = 0
-        self._path = ""
-        self._dirty = False
-        self._broken: Optional[Exception] = None
-        # Guards fd close/reassign against the background syncer: without
-        # it, cut() closing the segment between the syncer's fd check and
-        # its fsync raises EBADF (or fsyncs an unrelated reused fd) and
-        # permanently bricks the log via _broken.
-        self._fdmu = threading.Lock()
-        self._open_segment()
-        self._stop = threading.Event()
-        self._syncer: Optional[threading.Thread] = None
-        if self.sync_s > 0:
-            self._syncer = threading.Thread(
-                target=self._sync_loop, name="ps-wal-sync", daemon=True)
-            self._syncer.start()
-
-    # ------------------------------------------------------------ internals
-    def _open_segment(self) -> None:
-        self._path = os.path.join(
-            self.dir, f"seg-{self._next_index:08d}.wal")
-        self._next_index += 1
-        self._fd = os.open(self._path,
-                           os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
-        self._size = 0
-
-    def _sync_loop(self) -> None:
-        while not self._stop.wait(self.sync_s):
-            try:
-                self.sync()
-            except OSError as e:  # surfaces on the next append
-                self._broken = e
-
-    # ----------------------------------------------------------------- api
-    @property
-    def path(self) -> str:
-        return self._path
-
-    def append(self, payload) -> int:
-        """Frame + write one record; returns the framed byte count. Caller
-        holds the shard's WAL ordering lock. Raises :class:`WalError` if
-        the log is unappendable (the push must then fail — see class
-        docstring).
-
-        Accepts the payload either joined or as scatter-gather parts
-        (:func:`encode_push_parts`): the parts form checksums incrementally
-        and lands via one ``os.writev`` — no joined-buffer copy, which is
-        most of a multi-MB append's cost on the push hot path."""
-        if self._broken is not None:
-            raise WalError(f"ps wal {self.dir} broken: {self._broken}")
-        # Rotate BEFORE the write, not after: the frame just appended is
-        # then always wholly inside the OPEN segment, which is what makes
-        # :meth:`rollback` a plain ftruncate when the store apply it was
-        # logged for fails.
-        if self._size >= self.segment_bytes:
-            self.cut()
-        parts = [payload] if isinstance(payload, bytes) else list(payload)
-        length = sum(len(p) for p in parts)
-        crc = 0
-        for p in parts:
-            crc = zlib.crc32(p, crc)
-        total = _HEADER.size + length
-        try:
-            written = os.writev(self._fd,
-                                [_HEADER.pack(length, crc)] + parts)
-            if written < total:  # partial writev: finish the frame plainly
-                rest = (_HEADER.pack(length, crc)
-                        + b"".join(parts))[written:]
-                while rest:
-                    rest = rest[os.write(self._fd, rest):]
-            if self.sync_s == 0:
-                os.fsync(self._fd)
-        except OSError as e:
-            self._broken = e
-            raise WalError(f"ps wal append to {self._path} failed: {e}")
-        self._size += total
-        self._dirty = True
-        return total
-
-    def rollback(self, n_bytes: int) -> None:
-        """Truncate the last ``n_bytes`` (one just-appended frame) off the
-        open segment: the store apply it logged never happened, and leaving
-        the record would make a rescue replay an update the acked history
-        does not contain. Only valid immediately after the append, under
-        the same ordering lock (append rotates first, so the frame is
-        always in the open segment). A failed truncate marks the log
-        broken — subsequent pushes then fail loudly rather than diverge."""
-        with self._fdmu:
-            if self._fd is None:
-                return
-            self._size = max(0, self._size - n_bytes)
-            try:
-                os.ftruncate(self._fd, self._size)
-            except OSError as e:
-                self._broken = e
-
-    def sync(self) -> None:
-        with self._fdmu:
-            if self._dirty and self._fd is not None:
-                self._dirty = False
-                os.fsync(self._fd)
-
-    def cut(self) -> List[str]:
-        """Close the open segment and start a fresh one; returns the paths
-        of every COMPLETED segment (candidates for retirement once a
-        snapshot covering them commits). Caller holds the ordering lock,
-        so the cut is an exact partition of the record stream."""
-        with self._fdmu:
-            if self._fd is not None:
-                try:
-                    os.fsync(self._fd)
-                except OSError:
-                    pass
-                os.close(self._fd)
-            done = self._path
-            self._open_segment()
-            self._dirty = False
-        older = [os.path.join(self.dir, n) for n in _segments(self.dir)]
-        return [p for p in older if p != self._path and p <= done]
-
-    def close(self) -> None:
-        self._stop.set()
-        if self._syncer is not None:
-            # A still-running syncer (join timeout) is why the fd close
-            # below must also happen under _fdmu.
-            self._syncer.join(timeout=2.0)
-        try:
-            self.sync()
-        except OSError:
-            pass
-        with self._fdmu:
-            if self._fd is not None:
-                os.close(self._fd)
-                self._fd = None
+        super().__init__(
+            epoch_dir,
+            segment_bytes=int(
+                knob_int(ENV_SEGMENT_BYTES, DEFAULT_SEGMENT_BYTES)
+                if segment_bytes is None else segment_bytes),
+            sync_s=float(
+                knob_float(ENV_SYNC_S, DEFAULT_SYNC_S)
+                if sync_s is None else sync_s),
+            suffix=".wal",
+            error_cls=WalError,
+        )
 
 
 def retire_segments(paths, root: Optional[str] = None,

@@ -43,9 +43,11 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from easydl_tpu.loop.rollout import CANARY, CONTROL, assign_arm
 from easydl_tpu.obs import get_registry, start_exporter, tracing
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.ps.read_client import PsReadClient
+from easydl_tpu.utils.env import knob_float, knob_str
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, ServiceDef, serve
 
@@ -55,8 +57,12 @@ SERVE_SERVICE = ServiceDef(
     "easydl.Serve",
     {
         "Infer": (pb.InferRequest, pb.InferResponse),
+        "Rollout": (pb.RolloutRequest, pb.RolloutResponse),
     },
 )
+
+ENV_CANARY_FRACTION = "EASYDL_ROLLOUT_CANARY_FRACTION"
+ENV_ROLLOUT_SALT = "EASYDL_ROLLOUT_SALT"
 
 #: InferResponse.verdict prefix for a shed request — the RETRIABLE class
 #: (back off and re-send); anything else non-empty is a hard failure.
@@ -98,6 +104,8 @@ class _Work:
     ids: np.ndarray                # (rows, fields) int64
     dense: np.ndarray              # (rows, dense_dim) float32
     t_enq: float
+    session_id: str = ""
+    arm: str = CONTROL             # session-consistent A/B assignment
     future: "Future[InferResult]" = field(default_factory=Future)
 
     @property
@@ -223,6 +231,13 @@ def _serve_metrics():
                 "easydl_serve_p99_seconds_recent",
                 f"p99 request latency over the last {QPS_WINDOW_S:.0f}s "
                 "window (completed requests only).", ("replica",)),
+            reg.gauge(
+                "easydl_serve_model_version",
+                "Published model version this replica currently serves, "
+                "per arm (0 = the static constructor-supplied forward; "
+                "version visibility is commit-marker-gated, so a half-"
+                "published model can never appear here).",
+                ("replica", "arm")),
         )
     return _serve_metrics_cache
 
@@ -236,11 +251,36 @@ class ServeFrontend:
     """
 
     def __init__(self, reads: PsReadClient, config: ServeConfig,
-                 forward: Optional[Callable] = None, name: str = "serve-0"):
+                 forward: Optional[Callable] = None, name: str = "serve-0",
+                 feedback=None, canary_fraction: Optional[float] = None,
+                 rollout_salt: Optional[str] = None):
         self.reads = reads
         self.config = config
         self.forward = forward or _numpy_forward
         self.name = name
+        #: per-arm (version, forward) bank. Version 0 = the static
+        #: constructor forward; hot-swaps replace the CONTROL entry
+        #: between batches (a batch snapshots the bank once under the
+        #: lock and runs wholly on it — a swap can never split a batch
+        #: across model versions).
+        self._models: Dict[str, Tuple[int, Callable]] = {
+            CONTROL: (0, self.forward)}
+        #: loop/feedback.py FeedbackWriter (optional): the emit hook.
+        #: Contract: emission NEVER blocks or fails a request — the
+        #: writer itself is lossy-with-count, and emission runs on the
+        #: batch runner thread, after futures resolve.
+        self.feedback = feedback
+        self.canary_fraction = float(
+            knob_float(ENV_CANARY_FRACTION)
+            if canary_fraction is None else canary_fraction)
+        self.rollout_salt = str(
+            knob_str(ENV_ROLLOUT_SALT)
+            if rollout_salt is None else rollout_salt)
+        #: loop/publish.py ModelVersionWatcher, attached by the caller —
+        #: the Rollout RPC's actuation target
+        self.rollout_watcher = None
+        #: optional loop/rollout.py RolloutPacer fed per-request outcomes
+        self.pacer = None
         self._mu = threading.Condition()
         self._queue: Deque[_Work] = deque()
         self._pending_examples = 0
@@ -259,12 +299,60 @@ class ServeFrontend:
             target=self._run_loop, name=f"serve-batch-{name}", daemon=True)
         self._runner.start()
 
+    # ----------------------------------------------------------- model bank
+    def set_model(self, version: int, forward: Callable,
+                  arm: str = CONTROL) -> None:
+        """Install a fully-built forward for ``arm`` — the hot-swap. The
+        bank entry flips atomically under the lock; in-flight batches
+        finish on the snapshot they took, the NEXT batch runs the new
+        version (swap lands between batches, never inside one)."""
+        with self._mu:
+            self._models[arm] = (int(version), forward)
+        _serve_metrics()[12].set(int(version), replica=self.name, arm=arm)
+        if arm == CANARY and self.pacer is not None:
+            self.pacer.start_canary(int(version), time.monotonic())
+
+    def clear_canary(self) -> None:
+        with self._mu:
+            self._models.pop(CANARY, None)
+        _serve_metrics()[12].set(0, replica=self.name, arm=CANARY)
+        if self.pacer is not None:
+            self.pacer.end_canary()
+
+    def promote_canary(self) -> bool:
+        """Canary → control (the pacing policy's PROMOTE actuation)."""
+        with self._mu:
+            entry = self._models.get(CANARY)
+            if entry is None:
+                return False
+            self._models[CONTROL] = entry
+            self._models.pop(CANARY, None)
+        _serve_metrics()[12].set(entry[0], replica=self.name, arm=CONTROL)
+        _serve_metrics()[12].set(0, replica=self.name, arm=CANARY)
+        if self.pacer is not None:
+            self.pacer.end_canary()
+        return True
+
+    def model_versions(self) -> Dict[str, int]:
+        with self._mu:
+            return {arm: v for arm, (v, _f) in self._models.items()}
+
+    def _assign_arm(self, session_id: str) -> str:
+        with self._mu:
+            has_canary = CANARY in self._models
+        if not has_canary or not session_id:
+            return CONTROL
+        return assign_arm(session_id, self.canary_fraction,
+                          self.rollout_salt)
+
     # --------------------------------------------------------------- submit
-    def infer(self, ids: np.ndarray, dense: Optional[np.ndarray] = None
-              ) -> InferResult:
+    def infer(self, ids: np.ndarray, dense: Optional[np.ndarray] = None,
+              session_id: str = "") -> InferResult:
         """Score ``rows`` examples. Blocks until the micro-batch containing
         them ran (bounded by max_wait + forward time), or sheds
-        immediately when the queue is past the admission bound."""
+        immediately when the queue is past the admission bound.
+        ``session_id`` picks the A/B arm session-consistently (hash, not
+        state — every replica assigns the same arm)."""
         cfg = self.config
         ids = np.asarray(ids, np.int64)
         if ids.ndim != 2 or ids.shape[1] != cfg.fields:
@@ -311,7 +399,9 @@ class ServeFrontend:
                     self._observe_latency(None)
                     return result
                 self._seq += 1
-                work = _Work(self._seq, ids, dense, t0)
+                work = _Work(self._seq, ids, dense, t0,
+                             session_id=session_id,
+                             arm=self._assign_arm(session_id))
                 self._queue.append(work)
                 self._pending_examples += len(ids)
                 m[9].set(self._pending_examples, replica=self.name)
@@ -320,11 +410,12 @@ class ServeFrontend:
                 result = work.future.result(timeout=cfg.request_timeout_s)
             except Exception as e:  # timeout or runner crash
                 result = InferResult(False, f"error: {e!r}")
-            return self._finish(result, t0, span)
+            return self._finish(result, t0, span, arm=work.arm)
         finally:
             span.end()
 
-    def _finish(self, result: InferResult, t0: float, span) -> InferResult:
+    def _finish(self, result: InferResult, t0: float, span,
+                arm: str = CONTROL) -> InferResult:
         m = _serve_metrics()
         result.latency_s = time.monotonic() - t0
         if result.ok:
@@ -335,6 +426,11 @@ class ServeFrontend:
             span.add_event("error", verdict=result.verdict)
         m[2].observe(result.latency_s, replica=self.name)
         self._observe_latency(result.latency_s)
+        if self.pacer is not None and (result.ok or not result.retriable):
+            # Completed outcomes only: sheds say nothing about either
+            # model's quality, and counting them would starve the canary
+            # gates exactly when the replica is busiest.
+            self.pacer.observe(arm, result.ok)
         return result
 
     # --------------------------------------------------------- batch runner
@@ -383,20 +479,40 @@ class ServeFrontend:
         m = _serve_metrics()
         span = tracing.start_span("serve_batch", replica=self.name,
                                   requests=len(works), examples=total)
+        # One bank snapshot per batch: the whole batch scores on it, a
+        # concurrent hot-swap/rollback lands on the NEXT batch — a request
+        # can never see a half-updated model mid-batch.
+        with self._mu:
+            bank = dict(self._models)
+        versions: Dict[int, int] = {}   # work seq -> scoring model version
         try:
             ids = np.concatenate([w.ids for w in works])
             dense = np.concatenate([w.dense for w in works])
             emb = self.reads.pull(cfg.table, ids)
-            scores = np.asarray(self.forward(emb, dense), np.float32)
-            if scores.shape != (total,):
-                raise ValueError(
-                    f"forward returned {scores.shape}, want ({total},)")
-            off = 0
-            for w in works:
+            scores = np.empty(total, np.float32)
+            offs = np.cumsum([0] + [w.rows for w in works])
+            arms = sorted({w.arm for w in works})
+            for arm in arms:
+                idx = np.concatenate([
+                    np.arange(offs[i], offs[i + 1])
+                    for i, w in enumerate(works) if w.arm == arm
+                ])
+                version, fwd = bank.get(arm) or bank[CONTROL]
+                s = np.asarray(fwd(emb[idx], dense[idx]), np.float32)
+                if s.shape != (len(idx),):
+                    raise ValueError(
+                        f"forward({arm}) returned {s.shape}, "
+                        f"want ({len(idx)},)")
+                scores[idx] = s
+                for i, w in enumerate(works):
+                    if w.arm == arm:
+                        versions[w.seq] = version
+            for i, w in enumerate(works):
                 w.future.set_result(
-                    InferResult(True, "", scores[off:off + w.rows]))
-                off += w.rows
+                    InferResult(True, "", scores[offs[i]:offs[i + 1]]))
+            batch_ok = True
         except Exception as e:
+            batch_ok = False
             log.warning("serve batch failed (%d requests): %s",
                         len(works), e)
             span.add_event("batch-error", error=repr(e))
@@ -409,6 +525,15 @@ class ServeFrontend:
         self.recent_batches.append(tuple(w.seq for w in works))
         m[3].observe(total, replica=self.name)
         self._drain_cache_metrics()
+        if self.feedback is not None and batch_ok:
+            # The emit hook: after futures resolve, off the request path.
+            # FeedbackWriter is lossy-with-count and never raises — a
+            # broken spool costs a counter, never a request.
+            for i, w in enumerate(works):
+                if w.seq in versions:
+                    self.feedback.emit_serve(
+                        f"{self.name}-{w.seq}", w.session_id, w.arm,
+                        versions[w.seq], w.ids, scores[offs[i]:offs[i + 1]])
 
     def _drain_cache_metrics(self) -> None:
         cache = getattr(self.reads, "cache", None)
@@ -492,7 +617,8 @@ class ServeFrontend:
             return pb.InferResponse(
                 ok=False, verdict="error: dense payload shape mismatch")
         try:
-            result = self.infer(ids.reshape(rows, fields), dense)
+            result = self.infer(ids.reshape(rows, fields), dense,
+                                session_id=str(req.session_id))
         except ValueError as e:
             # Shape/config mismatch is a client error, not a server crash:
             # answer with a verdict (an exception here would surface as a
@@ -503,6 +629,49 @@ class ServeFrontend:
             scores=(result.scores.astype("<f4").tobytes()
                     if result.scores is not None else b""),
         )
+
+    def attach_rollout(self, watcher) -> None:
+        """Wire a loop/publish.py ModelVersionWatcher: its swaps land via
+        :meth:`set_model`, and the Rollout RPC actuates it."""
+        self.rollout_watcher = watcher
+
+    def Rollout(self, req: pb.RolloutRequest, ctx) -> pb.RolloutResponse:
+        """One-RPC rollout control. ``rollback`` pins publication
+        visibility AND swaps this replica to an already-validated older
+        version in the same call — instant, and by construction never a
+        half-updated model (only CRC-validated, commit-marked versions
+        ever enter the bank)."""
+        versions = self.model_versions()
+        w = self.rollout_watcher
+        base = dict(
+            active_version=int(versions.get(CONTROL, 0)),
+            canary_version=int(versions.get(CANARY, 0)),
+            swaps=int(w.swaps) if w is not None else 0,
+        )
+        action = str(req.action or "status")
+        if action == "status":
+            return pb.RolloutResponse(ok=True, message="", **base)
+        if w is None:
+            return pb.RolloutResponse(
+                ok=False, message="error: no rollout watcher attached",
+                **base)
+        if action == "rollback":
+            ok, msg = w.rollback(int(req.version) or None)
+        elif action == "clear":
+            from easydl_tpu.loop.publish import clear_rollback
+
+            clear_rollback(w.dir)
+            w.poll_once()
+            ok, msg = True, "rollback pin cleared"
+        else:
+            return pb.RolloutResponse(
+                ok=False, message=f"error: unknown action {action!r}",
+                **base)
+        versions = self.model_versions()
+        base.update(active_version=int(versions.get(CONTROL, 0)),
+                    canary_version=int(versions.get(CANARY, 0)),
+                    swaps=int(w.swaps))
+        return pb.RolloutResponse(ok=ok, message=msg, **base)
 
     # --------------------------------------------------------------- serve
     def serve(self, port: int = 0, obs_workdir: Optional[str] = None,
@@ -518,6 +687,7 @@ class ServeFrontend:
                 "queued_examples": self._pending_examples,
                 "batches_run": self.batches_run,
                 "cache": cache.stats() if cache is not None else None,
+                "model_versions": self.model_versions(),
             },
         )
         log.info("serve replica %s on :%d (table %s, max_batch %d, "
@@ -546,3 +716,8 @@ class ServeFrontend:
         if self._exporter is not None:
             self._exporter.stop()
             self._exporter = None
+        if self.feedback is not None:
+            try:
+                self.feedback.close()
+            except Exception as e:  # teardown hygiene, never a crash
+                log.warning("feedback writer close failed: %s", e)

@@ -14,6 +14,9 @@ Entry points: :func:`easydl_tpu.sim.simulator.simulate` in-process, or
 ``python scripts/policy_replay.py`` from a shell / chaos_smoke.sh.
 """
 
+from easydl_tpu.sim.rollout import (  # noqa: F401
+    simulate_rollout, synthetic_rollout_pacing,
+)
 from easydl_tpu.sim.simulator import (  # noqa: F401
     ControlPlaneSimulator, MeshSimConfig, SimPolicy, simulate,
 )

@@ -1,0 +1,189 @@
+"""Offline replay of the rollout pacing policy — the PR-8 discipline
+applied to the production loop's promote/rollback decision.
+
+A rollout timeline carries ``meta.rollout_profile``: a recorded (or
+synthesized) stream of per-arm observation batches ``[t, arm, n,
+errors]`` plus the pacing config under test. :func:`simulate_rollout`
+drives the REAL :class:`easydl_tpu.loop.rollout.RolloutPacer` through it
+on a virtual clock — no wall time, no RNG — and judges the decisions:
+
+- ``rollout_promoted`` — the healthy canary eventually promoted
+  (vacuous-pass refused: zero observations fed fails loudly);
+- ``rollout_paced`` — every PROMOTE decision fired with at least the
+  EXPECTATION's observation floor and soak floor behind it. The floor is
+  judged against the expectation, not the policy's own config — that is
+  what lets the negative control (a config that promotes on too-few
+  observations) be CAUGHT instead of trivially self-consistent;
+- ``rollout_rolled_back`` — when the profile encodes a regression, the
+  policy must roll the canary back, and must do it before promoting.
+
+Same timeline + same config ⇒ byte-identical verdict (chaos_smoke.sh
+replays the committed fixture twice and compares bytes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+from easydl_tpu.loop.rollout import (
+    CANARY,
+    PROMOTE,
+    ROLLBACK,
+    RolloutPacer,
+    RolloutPacingConfig,
+)
+
+
+def _r6(x: float) -> float:
+    return round(float(x), 6)
+
+
+def synthetic_rollout_pacing(duration_s: float = 120.0,
+                             canary_per_s: int = 5,
+                             control_per_s: int = 45,
+                             canary_err_every: int = 100,
+                             control_err_every: int = 100,
+                             regress_after_s: Optional[float] = None,
+                             regressed_err_every: int = 4,
+                             decide_every_s: float = 5.0,
+                             config: Optional[Dict[str, Any]] = None
+                             ) -> Dict[str, Any]:
+    """A deterministic canary observation stream: per-second batches for
+    both arms at fixed rates and error cadences. With
+    ``regress_after_s`` the canary's error rate degrades from that point
+    — the rollback scenario. Returns a timeline document (the committed
+    fixture format: empty agent streams, the profile in meta)."""
+    from easydl_tpu.sim.timeline import make_timeline
+
+    observations: List[List[float]] = []
+    canary_seen = 0
+    control_seen = 0
+    t = 1.0
+    while t <= duration_s:
+        c_err_every = canary_err_every
+        if regress_after_s is not None and t > regress_after_s:
+            c_err_every = regressed_err_every
+        c_errs = ((canary_seen + canary_per_s) // c_err_every
+                  - canary_seen // c_err_every)
+        k_errs = ((control_seen + control_per_s) // control_err_every
+                  - control_seen // control_err_every)
+        observations.append([_r6(t), CANARY, canary_per_s, int(c_errs)])
+        observations.append([_r6(t), "control", control_per_s,
+                             int(k_errs)])
+        canary_seen += canary_per_s
+        control_seen += control_per_s
+        t += 1.0
+    profile = {
+        "canary_version": 2,
+        "canary_start_t": 0.0,
+        "decide_every_s": _r6(decide_every_s),
+        "duration_s": _r6(duration_s),
+        "config": dict(config or {}),
+        "observations": observations,
+    }
+    return make_timeline("rollout_pacing", agents={}, faults=[],
+                         meta={"rollout_profile": profile})
+
+
+def simulate_rollout(timeline: Mapping[str, Any],
+                     config_override: Optional[Mapping[str, Any]] = None,
+                     expect: Optional[Mapping[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Replay the profile through the real pacer; judge the decisions.
+
+    ``config_override`` (the negative control's lever) wins over the
+    profile's own config. The first PROMOTE/ROLLBACK decision is the
+    actuation point — the replay records it and stops deciding, exactly
+    like a live controller would hand off to the watcher."""
+    profile = dict(dict(timeline.get("meta", {})).get(
+        "rollout_profile") or {})
+    if not profile:
+        raise ValueError("timeline has no meta.rollout_profile")
+    cfg_doc = dict(profile.get("config") or {})
+    if config_override:
+        cfg_doc.update(dict(config_override))
+    known = {f for f in RolloutPacingConfig.__dataclass_fields__}
+    config = RolloutPacingConfig(
+        **{k: v for k, v in cfg_doc.items() if k in known})
+    pacer = RolloutPacer(config=config)
+    pacer.start_canary(int(profile.get("canary_version", 1)),
+                       float(profile.get("canary_start_t", 0.0)))
+    observations = sorted(
+        (list(o) for o in profile.get("observations", [])),
+        key=lambda o: (float(o[0]), str(o[1])))
+    decide_every = float(profile.get("decide_every_s", 5.0))
+    duration = float(profile.get("duration_s",
+                                 observations[-1][0] if observations
+                                 else 0.0))
+    decisions: List[Dict[str, Any]] = []
+    fed = 0
+    final = None
+    next_decide = float(profile.get("canary_start_t", 0.0)) + decide_every
+    i = 0
+    now = float(profile.get("canary_start_t", 0.0))
+    while now <= duration and final is None:
+        now = next_decide
+        while i < len(observations) and float(observations[i][0]) <= now:
+            t_o, arm, n, errors = observations[i]
+            n, errors = int(n), int(errors)
+            pacer.observe(str(arm), ok=True, n=n - errors)
+            if errors:
+                pacer.observe(str(arm), ok=False, n=errors)
+            fed += n
+            i += 1
+        doc = pacer.decide(now)
+        decisions.append(dict(doc, t=_r6(now)))
+        if doc["decision"] in (PROMOTE, ROLLBACK):
+            final = dict(doc, t=_r6(now))
+        next_decide = _r6(next_decide + decide_every)
+
+    expect = dict(expect or {})
+    checks: Dict[str, Dict[str, Any]] = {}
+    promotes = [d for d in decisions if d["decision"] == PROMOTE]
+    rollbacks = [d for d in decisions if d["decision"] == ROLLBACK]
+    if expect.get("promoted"):
+        checks["rollout_promoted"] = {
+            "ok": fed > 0 and len(promotes) >= 1,
+            "observations_fed": fed,
+            "promotes": len(promotes),
+            "reason": (None if fed > 0 else
+                       "zero observations fed — vacuous"),
+        }
+    floor = expect.get("min_observations_floor")
+    if floor is not None:
+        premature = [d for d in promotes
+                     if int(d.get("canary_observations", 0)) < int(floor)]
+        soak_floor = float(expect.get("min_soak_floor_s", 0.0))
+        under_soaked = [d for d in promotes
+                        if float(d.get("soak_s", 0.0)) < soak_floor]
+        checks["rollout_paced"] = {
+            "ok": not premature and not under_soaked,
+            "min_observations_floor": int(floor),
+            "min_soak_floor_s": soak_floor,
+            "premature_promotes": premature,
+            "under_soaked_promotes": under_soaked,
+        }
+    if expect.get("rolled_back"):
+        promoted_first = bool(
+            promotes and (not rollbacks
+                          or promotes[0]["t"] < rollbacks[0]["t"]))
+        checks["rollout_rolled_back"] = {
+            "ok": fed > 0 and len(rollbacks) >= 1 and not promoted_first,
+            "observations_fed": fed,
+            "rollbacks": len(rollbacks),
+            "promoted_before_rollback": promoted_first,
+        }
+    passed = all(c["ok"] for c in checks.values()) if checks else False
+    return {
+        "name": str(timeline.get("name", "rollout")),
+        "kind": "rollout_replay",
+        "config": {f: getattr(config, f) for f in sorted(known)},
+        "observations_fed": fed,
+        "decisions": decisions,
+        "final_decision": final,
+        "events_simulated": len(decisions),
+        "sim_end_t": _r6(now),
+        "reshapes": [],
+        "invariants": {"passed": passed, "checks": checks},
+        "passed": passed,
+    }

@@ -144,6 +144,30 @@ KNOB_DECLS = (
      "Autoscale floor for serving replicas."),
     ("EASYDL_SERVE_MAX_REPLICAS", "int", 64,
      "Autoscale ceiling for serving replicas."),
+    # -- production loop: feedback stream + rollout -----------------------
+    ("EASYDL_FEEDBACK_SPOOL_BYTES", "int", 268_435_456,  # 256 MiB
+     "Per-replica feedback spool byte bound; past it (after retiring "
+     "trainer-consumed segments) new events DROP with a count — the "
+     "spool never blocks or fails a serve request."),
+    ("EASYDL_FEEDBACK_SEGMENT_BYTES", "int", 8_388_608,  # 8 MiB
+     "Feedback spool segment roll size."),
+    ("EASYDL_FEEDBACK_SYNC_S", "float", 0.2,
+     "Feedback spool fsync cadence; 0 = every append, negative = never."),
+    ("EASYDL_FEEDBACK_POLL_S", "float", 0.2,
+     "Continuous-trainer poll cadence on an exhausted spool "
+     "(block-with-timeout, never terminate)."),
+    ("EASYDL_FEEDBACK_LABEL_HORIZON_S", "float", 60.0,
+     "Delayed-label join horizon: a serve event unlabeled past it trains "
+     "with the implicit negative label."),
+    ("EASYDL_ROLLOUT_POLL_S", "float", 0.5,
+     "Serve-side model-publication watcher poll cadence."),
+    ("EASYDL_ROLLOUT_KEEP", "int", 4,
+     "Committed model versions the publisher keeps on disk."),
+    ("EASYDL_ROLLOUT_CANARY_FRACTION", "float", 0.1,
+     "Session-hash fraction routed to the canary arm while one is "
+     "active (sessions without an id always serve control)."),
+    ("EASYDL_ROLLOUT_SALT", "str", "",
+     "Session->arm hash salt; rotate to reshuffle the A/B population."),
     # -- mesh-shape policy / MFU ------------------------------------------
     ("EASYDL_MESH_PIN", "str", "",
      "Operator override: pin the elastic mesh-shape policy to this shape "

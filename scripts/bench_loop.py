@@ -1,0 +1,337 @@
+"""End-to-end production-loop freshness SLO bench → BENCH_LOOP.json.
+
+Measures the loop the subsystem exists for: an event observed at SERVE
+time (the request itself, emitted into the feedback spool by the
+frontend's hook) → trained by the continuous trainer → pushed into the
+live PS tier → REFLECTED IN SERVED SCORES. Each probe scores a fresh set
+of sentinel ids, then re-scores them until the result changes — the
+elapsed time is one loop-lag sample, taken under concurrent request load
+with the trainer tailing the same spool the load feeds.
+
+Second half: hot-swap overhead. Two model versions are published while
+the load keeps flowing; the serving replica must adopt each between
+batches with ZERO hard request failures — the commit-marker-gated swap
+may never surface to a client.
+
+Gates (explicit in the artifact, non-zero exit on violation):
+- ``p99_loop_lag_s`` ≤ ``--budget-s`` (this box is cpu-shares throttled;
+  the gate, not the absolute number, is the stable signal);
+- ``swap_hard_failures`` == 0 and ≥ 2 version swaps observed.
+
+Default mode runs real subprocess gRPC PS shards (registry-free
+address-list clients, the bench_serve.py pattern); ``--smoke`` swaps in
+an in-process Local PS and CI-sized counts so the e2e path rides tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+_SHARD = r"""
+import sys, time
+from easydl_tpu.ps.server import PsShard
+idx, n, addr_file = sys.argv[1:4]
+shard = PsShard(shard_index=int(idx), num_shards=int(n), backend="numpy")
+server = shard.serve()
+with open(addr_file + ".tmp", "w") as f:
+    f.write(server.address)
+import os as _os
+_os.replace(addr_file + ".tmp", addr_file)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn_shards(n: int, workdir: str):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs, addr_files = [], []
+    for i in range(n):
+        addr_file = os.path.join(workdir, f"shard-{i}.addr")
+        addr_files.append(addr_file)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _SHARD, str(i), str(n), addr_file],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+    addrs = []
+    deadline = time.monotonic() + 60
+    for path in addr_files:
+        while not os.path.exists(path):
+            if time.monotonic() > deadline:
+                for p in procs:
+                    p.kill()
+                raise TimeoutError(f"ps shard never published {path}")
+            time.sleep(0.05)
+        with open(path) as f:
+            addrs.append(f.read().strip())
+    return procs, addrs
+
+
+def _pct(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(p * len(sorted_vals)))]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="production-loop freshness "
+                                             "SLO benchmark")
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--rows", type=int, default=4)
+    ap.add_argument("--fields", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=4000)
+    ap.add_argument("--sessions", type=int, default=16)
+    ap.add_argument("--load-pace-s", type=float, default=0.01,
+                    help="background request pace (~1/QPS)")
+    ap.add_argument("--probes", type=int, default=40,
+                    help="loop-lag samples")
+    ap.add_argument("--probe-timeout-s", type=float, default=30.0)
+    ap.add_argument("--budget-s", type=float, default=5.0,
+                    help="p99 loop-lag gate")
+    ap.add_argument("--swap-requests", type=int, default=300,
+                    help="requests driven across the hot-swap window")
+    ap.add_argument("--batch-events", type=int, default=4)
+    ap.add_argument("--ckpt-every", type=int, default=50)
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_LOOP.json"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="in-process Local PS + CI-sized counts")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.probes = min(args.probes, 8)
+        args.swap_requests = min(args.swap_requests, 60)
+        args.budget_s = max(args.budget_s, 10.0)
+
+    from easydl_tpu.loop import publish as model_publish
+    from easydl_tpu.loop.continuous import ContinuousTrainer
+    from easydl_tpu.loop.feedback import FeedbackWriter
+    from easydl_tpu.ps.client import LocalPsClient, ShardedPsClient
+    from easydl_tpu.ps.read_client import PsReadClient
+    from easydl_tpu.ps.table import TableSpec
+    from easydl_tpu.serve import HotIdCache, ServeConfig, ServeFrontend
+
+    workdir = tempfile.mkdtemp(prefix="bench-loop-")
+    procs: list = []
+    spec = TableSpec(name="loop_emb", dim=args.dim, optimizer="adagrad",
+                     seed=7, lr=0.05)
+    try:
+        if args.smoke:
+            trainer_client = LocalPsClient(num_shards=args.shards,
+                                           coalesce=False)
+            reads = PsReadClient(trainer_client)
+        else:
+            procs, addrs = _spawn_shards(args.shards, workdir)
+            trainer_client = ShardedPsClient(addrs, timeout=30.0)
+            reads = PsReadClient(ShardedPsClient(addrs, timeout=30.0),
+                                 cache=HotIdCache(32 << 20))
+        spool = os.path.join(workdir, "feedback", "serve-0")
+        models = os.path.join(workdir, "models")
+        writer = FeedbackWriter(spool, replica="serve-0", sync_s=0.05)
+        frontend = ServeFrontend(
+            reads,
+            ServeConfig(table=spec.name, fields=args.fields, dense_dim=0,
+                        max_wait_ms=1.0, request_timeout_s=60.0),
+            name="serve-0", feedback=writer, canary_fraction=0.0)
+        trainer = ContinuousTrainer(
+            trainer_client, spec, [spool],
+            state_dir=os.path.join(workdir, "loop-state"),
+            ps_ckpt_dir=os.path.join(workdir, "loop-ps-ckpt"),
+            publish_dir=None, batch_events=args.batch_events,
+            ckpt_every_batches=args.ckpt_every, dense_dim=args.dim,
+            lr=0.05, name="loop-bench",
+            label_horizon_s=0.0)  # serve events train immediately
+        stop = threading.Event()
+        trainer_thread = threading.Thread(
+            target=trainer.run,
+            kwargs={"stop_check": stop.is_set, "batch_timeout_s": 0.1},
+            daemon=True, name="bench-loop-trainer")
+        trainer_thread.start()
+
+        load_counts = {"requests": 0, "ok": 0, "hard_failures": 0,
+                      "samples": []}
+        rng = np.random.default_rng(11)
+
+        def load() -> None:
+            i = 0
+            while not stop.is_set():
+                ids = (rng.zipf(1.1, args.rows * args.fields)
+                       % args.vocab).astype(np.int64).reshape(
+                           args.rows, args.fields)
+                r = frontend.infer(ids,
+                                   session_id=f"s{i % args.sessions}")
+                load_counts["requests"] += 1
+                if r.ok:
+                    load_counts["ok"] += 1
+                elif not r.retriable:
+                    load_counts["hard_failures"] += 1
+                    if len(load_counts["samples"]) < 5:
+                        load_counts["samples"].append(r.verdict)
+                i += 1
+                stop.wait(args.load_pace_s)
+
+        loader_thread = threading.Thread(target=load, daemon=True,
+                                         name="bench-loop-load")
+        loader_thread.start()
+        time.sleep(1.0)  # loop warm: trainer tailing, load flowing
+
+        # ---- phase 1: loop-lag probes under load
+        lags: List[float] = []
+        probe_failures = 0
+        base = 10_000_000  # sentinel id space disjoint from the load's
+        for k in range(args.probes):
+            ids = (base + np.arange(args.rows * args.fields,
+                                    dtype=np.int64)
+                   + k * 1000).reshape(args.rows, args.fields)
+            t0 = time.monotonic()
+            r0 = frontend.infer(ids, session_id="probe")
+            if not r0.ok:
+                probe_failures += 1
+                continue
+            deadline = t0 + args.probe_timeout_s
+            lag = None
+            while time.monotonic() < deadline:
+                r = frontend.infer(ids, session_id="probe")
+                if r.ok and not np.array_equal(r.scores, r0.scores):
+                    lag = time.monotonic() - t0
+                    break
+                time.sleep(0.01)
+            if lag is None:
+                probe_failures += 1
+            else:
+                lags.append(lag)
+        lags.sort()
+
+        # ---- phase 2: hot-swap under load, zero hard failures
+        def loader_fwd(manifest, arrays):
+            scale = np.float32(1.0 + float(np.asarray(
+                arrays["w"]).sum()))
+
+            def fwd(emb, dense):
+                s = emb.reshape(len(emb), -1).sum(axis=1)
+                if dense.size:
+                    s = s + dense.sum(axis=1)
+                return (s * scale).astype(np.float32)
+
+            return fwd
+
+        watcher = model_publish.ModelVersionWatcher(
+            models, loader_fwd, on_swap=frontend.set_model,
+            replica="serve-0", poll_s=0.1)
+        frontend.attach_rollout(watcher)
+        watcher.start()
+        hard_before = load_counts["hard_failures"]
+        req_before = load_counts["requests"]
+        v1 = model_publish.publish_version(
+            models, {"w": np.full(args.dim, 0.25, np.float32)}, keep=8)
+        deadline = time.monotonic() + 30
+        while frontend.model_versions().get("control") != v1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        v2 = model_publish.publish_version(
+            models, {"w": np.full(args.dim, 0.5, np.float32)}, keep=8)
+        deadline = time.monotonic() + 30
+        while frontend.model_versions().get("control") != v2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        # keep driving through the post-swap window
+        while load_counts["requests"] - req_before < args.swap_requests \
+                and time.monotonic() < deadline + 30:
+            time.sleep(0.05)
+        swaps = watcher.swaps
+        swap_requests = load_counts["requests"] - req_before
+        swap_hard = load_counts["hard_failures"] - hard_before
+
+        stop.set()
+        loader_thread.join(timeout=10.0)
+        trainer_thread.join(timeout=30.0)
+        watcher.stop()
+        frontend.stop()
+
+        gates = {
+            "p99_loop_lag_s": {
+                "limit": args.budget_s,
+                "value": round(_pct(lags, 0.99), 4),
+                "pass": bool(lags) and _pct(lags, 0.99) <= args.budget_s,
+            },
+            "probe_failures": {
+                "limit": 0, "value": probe_failures,
+                "pass": probe_failures == 0,
+            },
+            "swap_hard_failures": {
+                "limit": 0, "value": swap_hard,
+                "pass": swap_hard == 0 and swap_requests > 0,
+            },
+            "version_swaps": {
+                "limit": 2, "value": swaps, "pass": swaps >= 2,
+            },
+        }
+        doc: Dict[str, Any] = {
+            "bench": "production-loop freshness SLO",
+            "mode": "smoke" if args.smoke else "grpc-shards",
+            "config": {
+                "shards": args.shards, "rows": args.rows,
+                "fields": args.fields, "dim": args.dim,
+                "vocab": args.vocab, "load_pace_s": args.load_pace_s,
+                "probes": args.probes, "batch_events": args.batch_events,
+            },
+            "loop_lag_s": {
+                "samples": len(lags),
+                "p50": round(_pct(lags, 0.50), 4),
+                "p90": round(_pct(lags, 0.90), 4),
+                "p99": round(_pct(lags, 0.99), 4),
+                "max": round(lags[-1], 4) if lags else None,
+            },
+            "load": {
+                "requests": load_counts["requests"],
+                "ok": load_counts["ok"],
+                "hard_failures": load_counts["hard_failures"],
+            },
+            "swap": {
+                "versions_published": 2,
+                "swaps_observed": swaps,
+                "requests_in_window": swap_requests,
+                "hard_failures_in_window": swap_hard,
+            },
+            "trainer": {
+                "events_trained": trainer.events_trained,
+                "checkpoints": trainer.ckpts,
+                "batcher": dict(trainer.batcher.stats),
+            },
+            "feedback": dict(writer.stats),
+            "gates": gates,
+            "pass": all(g["pass"] for g in gates.values()),
+            "note": "this box is cpu-shares throttled; the gates, not "
+                    "the absolute lag numbers, are the stable signal",
+        }
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(doc["loop_lag_s"]))
+        print(json.dumps(doc["swap"]))
+        print(f"bench_loop: {'PASS' if doc['pass'] else 'FAIL'} "
+              f"-> {args.out}")
+        return 0 if doc["pass"] else 1
+    finally:
+        for p in procs:
+            p.kill()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

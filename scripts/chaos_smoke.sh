@@ -35,7 +35,9 @@ env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
     --scenario worker_kill --scenario master_crash \
     --scenario ps_shard_crash_zero_loss \
     --scenario ps_reshard_under_fire \
-    --scenario serve_during_reshard --keep-workdir "$@" \
+    --scenario serve_during_reshard \
+    --scenario trainer_crash_mid_loop \
+    --scenario rollout_half_update --keep-workdir "$@" \
     2>&1 | tee "$LOG"
 
 # Verdict files from THIS run (chaos_run prints "PASS <name> ... -> <path>").
@@ -95,6 +97,42 @@ assert stale.get("ids_checked", 0) > 0 and stale.get("stale_rows", -1) == 0, (
     "migration or a trainer push had already replaced")
 print(f"serve OK: {sv['requests']} requests, 0 hard failures, "
       f"{stale['ids_checked']} ids bit-verified post-split")
+PY
+        ;;
+    *trainer_crash_mid_loop*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+lp = doc["loop"]
+trained = lp.get("final_cursor_events", 0)
+assert trained >= 1, (
+    f"{sys.argv[1]}: ZERO feedback events trained — the continuous "
+    "trainer never consumed the spool, the pass is vacuous")
+assert lp.get("replayed_window", 0) >= 1, (
+    f"{sys.argv[1]}: the resumed trainer replayed an EMPTY window — the "
+    "kill landed on a checkpoint boundary and the exactly-once resume "
+    "path was never exercised")
+print(f"loop OK: {trained} events trained exactly-once, "
+      f"{lp['replayed_window']} replayed after the kill, digests match")
+PY
+        ;;
+    *rollout_half_update*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+lp = doc["loop"]
+swaps = lp.get("swaps", [])
+assert len(swaps) >= 2, (
+    f"{sys.argv[1]}: {len(swaps)} version swap(s) observed — the serving "
+    "replica never hot-swapped under load, the pass is vacuous")
+assert lp.get("torn_version", 0) and not lp.get("torn_served", True), (
+    f"{sys.argv[1]}: torn publication missing or SERVED")
+assert lp.get("feedback", {}).get("serve_events", 0) >= 1, (
+    f"{sys.argv[1]}: zero feedback events spooled — the emit hook never "
+    "fired under load")
+print(f"rollout OK: {len(swaps)} swaps, torn v{lp['torn_version']} and "
+      f"corrupt v{lp['corrupt_version']} never served, "
+      f"{lp['feedback']['serve_events']} feedback events spooled")
 PY
         ;;
     esac

@@ -47,8 +47,9 @@ from easydl_tpu.brain.straggler import StragglerConfig  # noqa: E402
 from easydl_tpu.core.mesh_shapes import MeshConstraints  # noqa: E402
 from easydl_tpu.sim import (  # noqa: E402
     MeshSimConfig, SimPolicy, load_fixture, load_workdir, save_fixture,
-    simulate, synthetic_autoscale, synthetic_mesh_autoscale,
-    synthetic_preempt, synthetic_straggler,
+    simulate, simulate_rollout, synthetic_autoscale,
+    synthetic_mesh_autoscale, synthetic_preempt, synthetic_rollout_pacing,
+    synthetic_straggler,
 )
 
 #: the default drill policy for replays: matches the live chaos drills'
@@ -87,6 +88,29 @@ _MESH_EXPECT: Dict[str, Any] = {
     "proactive_drain": True, "max_reshapes": 18,
     "mesh_converged": {"tolerance": 0.05},
 }
+
+#: the rollout-pacing config the fixture/catalog replays through the REAL
+#: loop/rollout.py pacer (ISSUE 13): promote only after 200 canary
+#: observations AND a 30s soak.
+_ROLLOUT_CONFIG: Dict[str, Any] = {
+    "min_observations": 200, "min_soak_s": 30.0,
+    "min_control_observations": 50, "max_regression": 0.02,
+    "rollback_regression": 0.10,
+}
+
+#: expectations for the rollout-pacing scenario/fixture: the canary
+#: promotes, and NO promote fires below the declared observation/soak
+#: floors — the floors live in the EXPECTATION, so a mis-tuned config
+#: (the negative control promotes on 2 observations) is CAUGHT rather
+#: than judged against itself.
+_ROLLOUT_EXPECT: Dict[str, Any] = {
+    "promoted": True, "min_observations_floor": 200,
+    "min_soak_floor_s": 30.0,
+}
+
+
+def _is_rollout(timeline: Dict[str, Any]) -> bool:
+    return bool(dict(timeline.get("meta", {})).get("rollout_profile"))
 
 
 def _scenarios() -> Dict[str, Tuple[Any, SimPolicy, Dict[str, Any]]]:
@@ -146,15 +170,47 @@ def _scenarios() -> Dict[str, Tuple[Any, SimPolicy, Dict[str, Any]]]:
             _mesh_policy(pinned="dp=16,tp=2"),
             dict(_MESH_EXPECT, max_reshapes=6),
         ),
+        # Rollout pacing (ISSUE 13): a healthy canary promotes, but only
+        # after the declared observation + soak floors. The policy slot
+        # carries a CONFIG OVERRIDE dict (not a SimPolicy): rollout
+        # timelines replay through simulate_rollout, not the control-
+        # plane engine.
+        "rollout_pacing": (
+            synthetic_rollout_pacing(config=dict(_ROLLOUT_CONFIG)),
+            None,
+            dict(_ROLLOUT_EXPECT),
+        ),
+        # Negative control: a canary policy that promotes on too-few
+        # observations (2, no soak) — rollout_paced must CATCH the
+        # premature promote.
+        "rollout_pacing_negative": (
+            synthetic_rollout_pacing(config=dict(_ROLLOUT_CONFIG)),
+            {"min_observations": 2, "min_soak_s": 0.0},
+            dict(_ROLLOUT_EXPECT),
+        ),
+        # The regression shape: the canary's error rate degrades mid-
+        # stream; the policy must ROLL BACK, never promote.
+        "rollout_regression": (
+            synthetic_rollout_pacing(config=dict(_ROLLOUT_CONFIG),
+                                     regress_after_s=20.0,
+                                     duration_s=90.0),
+            None,
+            {"rolled_back": True},
+        ),
     }
 
 
 def _policy_and_expect_for(timeline: Dict[str, Any]
-                           ) -> Tuple[SimPolicy, Dict[str, Any]]:
+                           ) -> Tuple[Any, Dict[str, Any]]:
     """Policy + expectations for a fixture/workdir replay. A timeline
     whose meta carries a ``shape_profile`` is a mesh-shape fixture and
-    replays through the mesh policy with the convergence invariant;
-    anything else gets the drill policy + fault-derived expectations."""
+    replays through the mesh policy with the convergence invariant; one
+    with a ``rollout_profile`` replays through the REAL rollout pacer
+    (the policy slot is then a config-override dict, or None for the
+    profile's own config); anything else gets the drill policy +
+    fault-derived expectations."""
+    if _is_rollout(timeline):
+        return None, dict(_ROLLOUT_EXPECT)
     if dict(timeline.get("meta", {})).get("shape_profile"):
         return _mesh_policy(), dict(_MESH_EXPECT)
     return _drill_policy(), _recorded_expect(timeline)
@@ -251,7 +307,10 @@ def main() -> None:
     rnd = args.round if args.round is not None else next_round(args.out_dir)
     failed = []
     for name, tl, pol, expect, invert in jobs:
-        result = simulate(tl, pol, expect)
+        if _is_rollout(tl):
+            result = simulate_rollout(tl, pol, expect)
+        else:
+            result = simulate(tl, pol, expect)
         ok = (not result["passed"]) if invert else result["passed"]
         if invert:
             result["negative_control"] = True

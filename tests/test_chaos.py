@@ -495,6 +495,11 @@ def test_scenario_catalog_compiles_deterministically():
             # push-storm drills run no training job: their goal invariant
             # is digest parity, not a step target
             assert sc.expect.get("ps_zero_loss")
+        elif sc.loop_drill is not None:
+            # production-loop drills: the goal invariant is exactly-once
+            # resume or commit-gated rollout, not a step target
+            assert sc.expect.get("loop_exactly_once") \
+                or sc.expect.get("rollout_commit_gated")
         else:
             assert sc.expect.get("target_step") is not None
 

@@ -1,0 +1,606 @@
+"""The production loop (easydl_tpu/loop/): feedback stream, continuous
+trainer exactly-once resume, versioned rollout, pure pacing policy, and
+the serve-tier wiring (arms, hot-swap, Rollout RPC, emit hook)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from easydl_tpu.loop import publish as pub
+from easydl_tpu.loop import rollout
+from easydl_tpu.loop.continuous import (
+    ContinuousTrainer,
+    dense_digest,
+    reference_replay,
+)
+from easydl_tpu.loop.feedback import (
+    FeedbackBatcher,
+    FeedbackDataset,
+    FeedbackWriter,
+    decode_label,
+    decode_serve_event,
+    encode_label,
+    encode_serve_event,
+)
+from easydl_tpu.ps.client import LocalPsClient
+from easydl_tpu.ps.read_client import PsReadClient
+from easydl_tpu.ps.table import TableSpec
+from easydl_tpu.serve import ServeConfig, ServeFrontend
+
+
+# ------------------------------------------------------------------ codecs
+def test_serve_event_codec_roundtrip():
+    ids = np.arange(6, dtype=np.int64).reshape(2, 3)
+    scores = np.array([0.5, -1.25], np.float32)
+    parts = encode_serve_event("req-1", "sess-9", "canary", 7, ids,
+                               scores, 123.5)
+    ev = decode_serve_event(b"".join(parts))
+    assert ev.request_id == "req-1"
+    assert ev.session_id == "sess-9"
+    assert ev.arm == "canary"
+    assert ev.model_version == 7
+    assert ev.t == 123.5
+    assert np.array_equal(ev.ids, ids)
+    assert np.array_equal(ev.scores, scores)
+    assert ev.labels is None
+
+
+def test_label_codec_roundtrip():
+    rid, labels, t = decode_label(
+        b"".join(encode_label("req-2", np.array([1.0, 0.0], np.float32),
+                              9.0)))
+    assert rid == "req-2"
+    assert np.array_equal(labels, [1.0, 0.0])
+    assert t == 9.0
+
+
+# ------------------------------------------------------------------ writer
+def _emit_n(w, n, rows=2, fields=3, label=True, t0=0.0):
+    for i in range(n):
+        ids = (np.arange(rows * fields, dtype=np.int64) + i).reshape(
+            rows, fields)
+        w.emit_serve(f"r{i}", f"s{i % 5}", "control", 0, ids,
+                     np.zeros(rows, np.float32), t=t0 + i)
+        if label:
+            w.emit_labels(f"r{i}", np.full(rows, i % 2, np.float32),
+                          t=t0 + i)
+
+
+def test_writer_bound_drops_with_count_never_raises(tmp_path):
+    w = FeedbackWriter(str(tmp_path), max_bytes=400, segment_bytes=128,
+                       sync_s=-1)
+    _emit_n(w, 50)
+    assert w.stats["dropped_bound"] > 0
+    assert w.stats["serve_events"] + w.stats["dropped_bound"] >= 50
+    w.close()
+
+
+def test_writer_broken_spool_drops_with_count(tmp_path):
+    w = FeedbackWriter(str(tmp_path), max_bytes=1 << 20, sync_s=-1)
+    w._writer._broken = OSError("disk gone")
+    ok = w.emit_serve("r", "s", "control", 0,
+                      np.zeros((1, 2), np.int64), np.zeros(1, np.float32))
+    assert ok is False
+    assert w.stats["dropped_error"] == 1
+    w.close()
+
+
+def test_writer_retires_consumed_segments_before_shedding(tmp_path):
+    w = FeedbackWriter(str(tmp_path), max_bytes=1200, segment_bytes=256,
+                       sync_s=-1)
+    _emit_n(w, 8, label=False)
+    from easydl_tpu.loop import spool as sp
+
+    # consumer durably covered every closed segment
+    segs = sp.list_segments(str(tmp_path), ".spool")
+    caps = {s: os.path.getsize(os.path.join(str(tmp_path), s))
+            for s in segs[:-1]}
+    sp.write_offset_marker(str(tmp_path), caps, sp.CONSUMED_MARKER,
+                          shrink_only=False)
+    before = w.stats["dropped_bound"]
+    _emit_n(w, 4, label=False)  # retirement frees room: no new drops
+    assert w.stats["serve_events"] >= 10
+    w.close()
+
+
+# ----------------------------------------------------------------- batcher
+def test_batcher_joins_labels_in_spool_order(tmp_path):
+    w = FeedbackWriter(str(tmp_path), sync_s=-1)
+    _emit_n(w, 10)
+    w.sync()
+    b = FeedbackBatcher([str(tmp_path)], label_horizon_s=3600.0)
+    batch = b.next_batch(10, timeout_s=0.0, allow_partial=True)
+    assert len(batch) == 10
+    assert [e.request_id for e in batch] == [f"r{i}" for i in range(10)]
+    assert all(e.label_source == "joined" for e in batch)
+    assert np.array_equal(batch[3].labels, [1.0, 1.0])
+    w.close()
+
+
+def test_batcher_horizon_releases_with_implicit_negative(tmp_path):
+    clock = [1000.0]
+    w = FeedbackWriter(str(tmp_path), sync_s=-1)
+    _emit_n(w, 3, label=False)
+    w.sync()
+    b = FeedbackBatcher([str(tmp_path)], label_horizon_s=5.0,
+                        clock=lambda: clock[0])
+    assert b.next_batch(3, timeout_s=0.0, allow_partial=True) == []
+    clock[0] += 10.0  # past the join horizon
+    batch = b.next_batch(3, timeout_s=0.0, allow_partial=True)
+    assert len(batch) == 3
+    assert all(e.label_source == "horizon" for e in batch)
+    assert all(np.array_equal(e.labels, [0.0, 0.0]) for e in batch)
+    assert b.stats["horizon_released"] == 3
+    w.close()
+
+
+def test_batcher_state_restore_redelivers_unconsumed(tmp_path):
+    """The exactly-once contract at the batcher level: restoring the
+    checkpointed state re-delivers exactly the events past it."""
+    w = FeedbackWriter(str(tmp_path), sync_s=-1)
+    _emit_n(w, 12)
+    w.sync()
+    b = FeedbackBatcher([str(tmp_path)], label_horizon_s=3600.0)
+    first = b.next_batch(5, timeout_s=0.0, allow_partial=True)
+    snapshot = b.state()
+    rest_a = b.next_batch(20, timeout_s=0.0, allow_partial=True)
+    b2 = FeedbackBatcher([str(tmp_path)], label_horizon_s=3600.0)
+    b2.restore_state(snapshot)
+    rest_b = b2.next_batch(20, timeout_s=0.0, allow_partial=True)
+    assert [e.request_id for e in rest_a] == \
+        [e.request_id for e in rest_b] == [f"r{i}" for i in range(5, 12)]
+    # the label for the last already-consumed event sits AFTER the
+    # cursor: it re-reads as an unmatched label and is buffered (bounded)
+    # without crashing or re-training anything
+    assert "r4" in b2._spools[str(tmp_path)].labels
+    assert len(first) == 5
+    w.close()
+
+
+def test_feedback_dataset_contract(tmp_path):
+    w = FeedbackWriter(str(tmp_path), sync_s=-1)
+    _emit_n(w, 8, rows=2, fields=3)
+    w.sync()
+    ds = FeedbackDataset([str(tmp_path)], batch_size=4, dense_dim=2,
+                         batch_timeout_s=1.0, label_horizon_s=3600.0)
+    it = iter(ds)
+    batch = next(it)
+    assert set(batch) == {"sparse_ids", "dense", "label"}
+    assert batch["sparse_ids"].shape == (8, 3)   # 4 events x 2 rows
+    assert batch["dense"].shape == (8, 2)
+    assert batch["label"].shape == (8,)
+    state = ds.state()
+    assert state["spool_cursors"][str(tmp_path)]["events"] == 4
+    ds2 = FeedbackDataset([str(tmp_path)], batch_size=4, dense_dim=2,
+                          batch_timeout_s=1.0, label_horizon_s=3600.0)
+    ds2.restore_state(state)
+    batch2 = next(iter(ds2))
+    assert batch2["sparse_ids"][0, 0] == 4  # resumed at event #4
+    w.close()
+
+
+# ----------------------------------------------------- continuous trainer
+def _spec(dim=4):
+    return TableSpec(name="loop_emb", dim=dim, optimizer="adagrad",
+                     seed=3, lr=0.05)
+
+
+def test_continuous_trainer_crash_resume_exactly_once(tmp_path):
+    """Kill-and-resume in process: a second trainer restoring the joint
+    checkpoint (dense + cursors + sparse snapshot) must end bit-identical
+    to a fault-free reference that trained each event once."""
+    spool_dir = str(tmp_path / "spool")
+    w = FeedbackWriter(spool_dir, sync_s=-1)
+    _emit_n(w, 40)
+    w.sync()
+    spec = _spec()
+
+    def make_trainer(client):
+        return ContinuousTrainer(
+            client, spec, [spool_dir],
+            state_dir=str(tmp_path / "state"),
+            ps_ckpt_dir=str(tmp_path / "ps-ckpt"),
+            batch_events=4, ckpt_every_batches=2, dense_dim=4,
+            lr=0.05, label_horizon_s=3600.0)
+
+    c1 = LocalPsClient(num_shards=2, coalesce=False)
+    t1 = make_trainer(c1)
+    # train 6 batches (24 events): checkpoints at batches 2/4/6, then
+    # 1 more batch that is NOT checkpointed — then "crash" (drop t1)
+    for _ in range(7):
+        batch = t1.batcher.next_batch(4, timeout_s=0.0,
+                                      allow_partial=True)
+        t1.train_batch(batch)
+        if t1.batches % 2 == 0:
+            t1.checkpoint()
+    assert t1.step == 24 // 4  # 6 batches committed, the 7th in flight
+
+    # resume on a FRESH client (the sparse tier is rolled back to the
+    # snapshot by restore()) and drain the rest
+    c2 = LocalPsClient(num_shards=2, coalesce=False)
+    t2 = make_trainer(c2)
+    evidence = t2.restore()
+    assert evidence["restored"] and evidence["restored_step"] == 6
+    assert sum(evidence["restored_cursor_events"].values()) == 24
+    summary = t2.run(stop_check=lambda: True, batch_timeout_s=0.0)
+    assert sum(
+        int(c["events"])
+        for c in json.load(open(
+            str(tmp_path / "state" / "latest.json")))["cursors"].values()
+    ) == 40
+
+    ref_client, ref_trainer = reference_replay(
+        [spool_dir], spec, 2, 4, 4, 0.05)
+    assert dense_digest(t2.dense) == dense_digest(ref_trainer.dense)
+    ids = np.arange(200, dtype=np.int64)
+    assert np.array_equal(c2.pull("loop_emb", ids),
+                          ref_client.pull("loop_emb", ids))
+    w.close()
+
+
+def test_train_continuous_mode_checkpoints_cursors(tmp_path):
+    """PsTrainer.train_continuous: strict steps, on_round sees the
+    cursor state covering exactly the trained events."""
+    jax = pytest.importorskip("jax")
+    import optax
+
+    from easydl_tpu.core.train_loop import TrainConfig
+    from easydl_tpu.ps.trainer import PsTrainer
+
+    spool_dir = str(tmp_path / "spool")
+    w = FeedbackWriter(spool_dir, sync_s=-1)
+    _emit_n(w, 12, rows=1, fields=4)
+    w.sync()
+
+    import jax.numpy as jnp
+
+    def init_fn(rng):
+        return {"w": jnp.zeros((4,), jnp.float32)}
+
+    def loss_fn(params, batch, rng):
+        emb = batch["sparse_emb"]            # (B, fields, dim)
+        pred = emb.sum(axis=(1, 2)) + params["w"].sum()
+        loss = jnp.mean((pred - batch["label"]) ** 2)
+        return loss, {}
+
+    trainer = PsTrainer(
+        init_fn, loss_fn, optax.sgd(0.01),
+        TrainConfig(global_batch=2, donate_state=False),
+        client=LocalPsClient(num_shards=1, coalesce=False),
+        table=TableSpec(name="emb", dim=3, optimizer="sgd", seed=0,
+                        lr=0.1),
+    )
+    ds = FeedbackDataset([spool_dir], batch_size=2, dense_dim=0,
+                         batch_timeout_s=5.0, label_horizon_s=3600.0)
+    state = trainer.init_state()
+    rounds = []
+    state, _metrics = trainer.train_continuous(
+        state, ds, steps_per_round=3, rounds=2,
+        on_round=lambda s, data_state, m: rounds.append(data_state))
+    assert len(rounds) == 2
+    events = [sum(int(c["events"]) for c in r["spool_cursors"].values())
+              for r in rounds]
+    assert events == [6, 12]  # 3 steps x 2 events, twice
+    w.close()
+
+
+# --------------------------------------------------------------- publish
+def test_publish_commit_gate_and_quarantine_order(tmp_path):
+    d = str(tmp_path)
+    v1 = pub.publish_version(d, {"w": np.ones(3, np.float32)}, keep=8)
+    torn = pub.publish_version(d, {"w": np.zeros(3, np.float32)},
+                               keep=8, _crash_before_commit=True)
+    assert pub.list_versions(d) == [v1]       # torn publish invisible
+    assert pub.active_version(d) == v1
+    manifest, arrays = pub.load_version(d, v1)
+    assert np.array_equal(arrays["w"], np.ones(3))
+    # corrupt bytes under a valid marker: load raises, quarantine demotes
+    v2 = pub.publish_version(d, {"w": np.full(3, 2.0, np.float32)},
+                             keep=8)
+    p = os.path.join(d, f"v_{v2:08d}", "w.npy")
+    data = bytearray(open(p, "rb").read())
+    data[-1] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    with pytest.raises(pub.VersionCorrupt):
+        pub.load_version(d, v2)
+    pub.quarantine_version(d, v2)
+    assert os.path.exists(os.path.join(d, f"v_{v2:08d}", "CORRUPT"))
+    assert not os.path.exists(os.path.join(d, f"v_{v2:08d}", "COMMITTED"))
+    assert pub.active_version(d) == v1
+
+
+def test_rollback_pin_caps_visibility(tmp_path):
+    d = str(tmp_path)
+    v1 = pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=8)
+    v2 = pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=8)
+    assert pub.active_version(d) == v2
+    pub.set_rollback(d, v1)
+    assert pub.active_version(d) == v1
+    v3 = pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=8)
+    assert pub.active_version(d) == v1   # new publishes stay invisible
+    pub.clear_rollback(d)
+    assert pub.active_version(d) == v3
+
+
+def test_retire_versions_keeps_newest(tmp_path):
+    d = str(tmp_path)
+    for _ in range(5):
+        pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=3)
+    assert pub.list_versions(d) == [3, 4, 5]
+
+
+def test_retire_never_deletes_the_pinned_active_version(tmp_path):
+    """A continuous publisher churning past the keep bound must not
+    delete the version an operator just rolled the fleet back to."""
+    d = str(tmp_path)
+    v1 = pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=3)
+    pub.set_rollback(d, v1)
+    for _ in range(6):
+        pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=3)
+    assert pub.active_version(d) == v1          # still restorable
+    assert v1 in pub.list_versions(d)
+    pub.clear_rollback(d)
+    assert pub.active_version(d) == 7
+
+
+def test_retire_sweeps_torn_publish_debris(tmp_path):
+    d = str(tmp_path)
+    pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=3)
+    torn = pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=3,
+                               _crash_before_commit=True)
+    newest_torn = None
+    for _ in range(3):
+        pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=3)
+    pub.retire_versions(d, 3)
+    # the old torn dir is swept; committed retention is unchanged
+    assert not os.path.isdir(os.path.join(d, f"v_{torn:08d}"))
+    assert pub.list_versions(d) == [3, 4, 5]
+    # a torn dir NEWER than every committed version is spared (it may be
+    # another publisher mid-write)
+    inflight = pub.publish_version(d, {"w": np.ones(2, np.float32)},
+                                   keep=3, _crash_before_commit=True)
+    pub.retire_versions(d, 3)
+    assert os.path.isdir(os.path.join(d, f"v_{inflight:08d}"))
+
+
+def test_failed_rollback_leaves_no_pin(tmp_path):
+    d = str(tmp_path)
+    v1 = pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=8)
+    v2 = pub.publish_version(d, {"w": np.ones(2, np.float32)}, keep=8)
+    loads = []
+
+    def loader(manifest, arrays):
+        loads.append(manifest["version"])
+        return lambda emb, dense: np.zeros(len(emb), np.float32)
+
+    w = pub.ModelVersionWatcher(d, loader, on_swap=lambda v, f: None,
+                                replica="x", poll_s=9.0)
+    w.poll_once()
+    # corrupt the rollback target's bytes: the RPC must FAIL and must
+    # NOT install the fleet-visible visibility pin as a side effect
+    p = os.path.join(d, f"v_{v1:08d}", "w.npy")
+    data = bytearray(open(p, "rb").read())
+    data[0] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    ok, msg = w.rollback(v1)
+    assert not ok and "corrupt" in msg
+    assert pub.read_rollback(d) is None
+    assert pub.active_version(d) == v2
+
+
+# --------------------------------------------------------- rollout policy
+def test_assign_arm_is_stable_and_splits():
+    arms = {s: rollout.assign_arm(s, 0.5, "salt")
+            for s in (f"sess-{i}" for i in range(200))}
+    assert all(rollout.assign_arm(s, 0.5, "salt") == a
+               for s, a in arms.items())   # deterministic
+    canary = sum(1 for a in arms.values() if a == "canary")
+    assert 50 < canary < 150               # a real split
+    assert rollout.assign_arm("x", 0.0) == "control"
+    assert rollout.assign_arm("x", 1.0) == "canary"
+    # rotating the salt reshuffles the population
+    assert any(rollout.assign_arm(s, 0.5, "other") != a
+               for s, a in arms.items())
+
+
+def test_rollout_decision_cells():
+    cfg = rollout.RolloutPacingConfig(
+        min_observations=100, min_soak_s=10.0,
+        min_control_observations=10, max_regression=0.02,
+        rollback_regression=0.10)
+    mk = lambda obs, err: rollout.ArmStats(observations=obs, errors=err)
+    d = rollout.rollout_decision(5.0, None, 0.0, mk(0, 0), mk(0, 0), cfg)
+    assert (d["decision"], d["reason"]) == ("hold", "no-canary")
+    d = rollout.rollout_decision(50.0, 2, 0.0, mk(50, 0), mk(500, 0), cfg)
+    assert (d["decision"], d["reason"]) == ("hold", "under-observed")
+    d = rollout.rollout_decision(5.0, 2, 0.0, mk(150, 0), mk(500, 0), cfg)
+    assert (d["decision"], d["reason"]) == ("hold", "soaking")
+    d = rollout.rollout_decision(50.0, 2, 0.0, mk(150, 8), mk(500, 5),
+                                 cfg)
+    assert (d["decision"], d["reason"]) == ("hold", "regressing")
+    d = rollout.rollout_decision(50.0, 2, 0.0, mk(150, 30), mk(500, 5),
+                                 cfg)
+    assert (d["decision"], d["reason"]) == ("rollback", "hard-regression")
+    d = rollout.rollout_decision(50.0, 2, 0.0, mk(150, 1), mk(500, 5),
+                                 cfg)
+    assert (d["decision"], d["reason"]) == ("promote", "gates-passed")
+
+
+def test_sim_rollout_fixture_and_negative_control():
+    """Tier-1 and the chaos_smoke replay gate must validate the SAME
+    policy against the same fixture — config and expectations are
+    imported FROM scripts/policy_replay.py (the PR-12 pattern)."""
+    from scripts.policy_replay import _ROLLOUT_CONFIG, _ROLLOUT_EXPECT
+    from easydl_tpu.sim import load_fixture, simulate_rollout
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures", "sim",
+                           "rollout_pacing.json")
+    tl = load_fixture(fixture)
+    assert dict(tl["meta"]["rollout_profile"]["config"]) == \
+        _ROLLOUT_CONFIG
+    r1 = simulate_rollout(tl, None, _ROLLOUT_EXPECT)
+    assert r1["passed"], r1["invariants"]
+    assert r1["final_decision"]["decision"] == "promote"
+    # byte-identical across runs (the smoke gate's determinism contract)
+    r2 = simulate_rollout(tl, None, _ROLLOUT_EXPECT)
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2,
+                                                        sort_keys=True)
+    # negative control: promotes on 2 observations — must be CAUGHT
+    bad = simulate_rollout(tl, {"min_observations": 2,
+                                "min_soak_s": 0.0}, _ROLLOUT_EXPECT)
+    assert not bad["passed"]
+    assert not bad["invariants"]["checks"]["rollout_paced"]["ok"]
+
+
+# ------------------------------------------------------------- serve tier
+def _frontend(tmp_path, **kw):
+    client = LocalPsClient(num_shards=1, coalesce=False)
+    client.create_table(TableSpec(name="t", dim=4, optimizer="sgd",
+                                  seed=1, lr=0.1))
+    reads = PsReadClient(client)
+    return ServeFrontend(
+        reads, ServeConfig(table="t", fields=2, dense_dim=0,
+                           max_wait_ms=1.0), **kw)
+
+
+def test_frontend_hot_swap_between_batches(tmp_path):
+    fe = _frontend(tmp_path, name="swap-test")
+    ids = np.arange(4, dtype=np.int64).reshape(2, 2)
+    r0 = fe.infer(ids)
+    assert r0.ok and fe.model_versions() == {"control": 0}
+    fe.set_model(3, lambda emb, dense: np.full(len(emb), 42.0,
+                                               np.float32))
+    r1 = fe.infer(ids)
+    assert np.array_equal(r1.scores, [42.0, 42.0])
+    assert fe.model_versions() == {"control": 3}
+    fe.stop()
+
+
+def test_frontend_session_consistent_arms(tmp_path):
+    fe = _frontend(tmp_path, name="ab-test", canary_fraction=0.5,
+                   rollout_salt="s")
+    fe.set_model(9, lambda emb, dense: np.full(len(emb), 9.0, np.float32),
+                 arm="canary")
+    ids = np.arange(2, dtype=np.int64).reshape(1, 2)
+    sessions = [f"u{i}" for i in range(30)]
+    first = {}
+    for _ in range(3):
+        for s in sessions:
+            r = fe.infer(ids, session_id=s)
+            assert r.ok
+            is_canary = bool(np.array_equal(r.scores, [9.0]))
+            if s in first:
+                assert first[s] == is_canary, \
+                    f"session {s} flapped between arms"
+            first[s] = is_canary
+    assert 0 < sum(first.values()) < len(sessions)  # a real split
+    # promote: canary becomes control for everyone
+    assert fe.promote_canary()
+    assert fe.model_versions() == {"control": 9}
+    r = fe.infer(ids, session_id="u0")
+    assert np.array_equal(r.scores, [9.0])
+    fe.stop()
+
+
+def test_frontend_emit_hook_spools_events(tmp_path):
+    w = FeedbackWriter(str(tmp_path / "fb"), sync_s=-1)
+    fe = _frontend(tmp_path, name="emit-test", feedback=w)
+    ids = np.arange(4, dtype=np.int64).reshape(2, 2)
+    r = fe.infer(ids, session_id="sess-1")
+    assert r.ok
+    deadline = time.monotonic() + 5
+    while w.stats["serve_events"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    w.sync()
+    b = FeedbackBatcher([str(tmp_path / "fb")], label_horizon_s=0.0)
+    batch = b.next_batch(1, timeout_s=1.0, allow_partial=True)
+    assert len(batch) == 1
+    ev = batch[0]
+    assert ev.session_id == "sess-1"
+    assert ev.arm == "control"
+    assert ev.model_version == 0
+    assert np.array_equal(ev.ids, ids)
+    assert np.array_equal(ev.scores, r.scores)
+    fe.stop()
+
+
+def test_frontend_rollout_rpc_status_and_rollback(tmp_path):
+    from easydl_tpu.proto import easydl_pb2 as pb
+
+    models = str(tmp_path / "models")
+    fe = _frontend(tmp_path, name="rpc-test")
+
+    def loader(manifest, arrays):
+        v = float(np.asarray(arrays["w"]).sum())
+        return lambda emb, dense: np.full(len(emb), v, np.float32)
+
+    watcher = pub.ModelVersionWatcher(models, loader,
+                                      on_swap=fe.set_model,
+                                      replica="rpc-test", poll_s=0.05)
+    fe.attach_rollout(watcher)
+    v1 = pub.publish_version(models, {"w": np.ones(1, np.float32)},
+                             keep=8)
+    v2 = pub.publish_version(models, {"w": np.full(1, 2.0, np.float32)},
+                             keep=8)
+    watcher.poll_once()
+    assert fe.model_versions()["control"] == v2
+    resp = fe.Rollout(pb.RolloutRequest(action="status"), None)
+    assert resp.ok and resp.active_version == v2
+    resp = fe.Rollout(pb.RolloutRequest(action="rollback"), None)
+    assert resp.ok and resp.active_version == v1
+    assert fe.model_versions()["control"] == v1
+    # the pin holds against the watcher's next poll
+    assert watcher.poll_once() is None
+    resp = fe.Rollout(pb.RolloutRequest(action="clear"), None)
+    assert resp.ok and resp.active_version == v2
+    resp = fe.Rollout(pb.RolloutRequest(action="bogus"), None)
+    assert not resp.ok and "unknown action" in resp.message
+    fe.stop()
+    watcher.stop()
+
+
+def test_watcher_never_adopts_torn_or_corrupt(tmp_path):
+    models = str(tmp_path / "models")
+    swaps = []
+
+    def loader(manifest, arrays):
+        return lambda emb, dense: np.zeros(len(emb), np.float32)
+
+    watcher = pub.ModelVersionWatcher(
+        models, loader, on_swap=lambda v, f: swaps.append(v),
+        replica="gate-test", poll_s=0.05)
+    v1 = pub.publish_version(models, {"w": np.ones(1, np.float32)},
+                             keep=8)
+    watcher.poll_once()
+    pub.publish_version(models, {"w": np.ones(1, np.float32)}, keep=8,
+                        _crash_before_commit=True)
+    assert watcher.poll_once() is None          # torn: invisible
+    v3 = pub.publish_version(models, {"w": np.ones(1, np.float32)},
+                             keep=8)
+    p = os.path.join(models, f"v_{v3:08d}", "w.npy")
+    data = bytearray(open(p, "rb").read())
+    data[0] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    assert watcher.poll_once() is None          # corrupt: quarantined
+    assert watcher.quarantined == [v3]
+    assert swaps == [v1]                        # only the good version
+
+
+# ------------------------------------------------------------------ bench
+def test_bench_loop_smoke(tmp_path):
+    """The freshness-SLO bench's e2e path rides tier-1: in-process PS,
+    real spool, real continuous trainer, real hot-swap — gates enforced."""
+    from scripts.bench_loop import main as bench_main
+
+    out = str(tmp_path / "BENCH_LOOP.json")
+    assert bench_main(["--smoke", "--probes", "3", "--swap-requests",
+                       "20", "--out", out]) == 0
+    doc = json.load(open(out))
+    assert doc["pass"]
+    assert doc["loop_lag_s"]["samples"] == 3
+    assert doc["swap"]["hard_failures_in_window"] == 0
+    assert doc["gates"]["version_swaps"]["value"] >= 2

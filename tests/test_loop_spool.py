@@ -1,0 +1,287 @@
+"""The shared spool core (loop/spool.py): generic framing, rotation,
+torn tails, offset markers, cursor tailing — and the no-drift guarantee
+that ps/wal.py rides the SAME core.
+
+The satellite contract (ISSUE 13): unknown frame kinds must
+skip-with-count, never crash a replayer; torn/corrupt tails truncate;
+a consumer's offset marker caps what later reads may consume.
+"""
+
+import os
+import struct
+
+import pytest
+
+from easydl_tpu.loop import spool
+
+
+def _write(w, kind, body=b"x"):
+    return w.append(bytes([kind]) + body)
+
+
+def test_frame_roundtrip_and_read_segment(tmp_path):
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    payloads = [bytes([2]) + bytes(range(i)) for i in range(1, 6)]
+    for p in payloads:
+        w.append(p)
+    w.close()
+    got, consumed, clean = spool.read_segment(w.path)
+    assert got == payloads
+    assert clean
+    assert consumed == os.path.getsize(w.path)
+
+
+def test_scatter_gather_append_matches_joined(tmp_path):
+    a = spool.SegmentWriter(str(tmp_path / "a"), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    b = spool.SegmentWriter(str(tmp_path / "b"), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    parts = [b"\x02head", b"middle", b"tail"]
+    a.append(parts)
+    b.append(b"".join(parts))
+    a.close()
+    b.close()
+    assert open(a.path, "rb").read() == open(b.path, "rb").read()
+
+
+def test_torn_tail_truncates(tmp_path):
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    w.append(b"\x02first")
+    w.append(b"\x02second-longer-record")
+    w.close()
+    data = open(w.path, "rb").read()
+    # cut into the last record's payload
+    open(w.path, "wb").write(data[:-5])
+    got, consumed, clean = spool.read_segment(w.path)
+    assert got == [b"\x02first"]
+    assert not clean
+    assert consumed == 8 + len(b"\x02first")
+
+
+def test_corrupt_crc_stops_consumption(tmp_path):
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    w.append(b"\x02aaaa")
+    w.append(b"\x02bbbb")
+    w.append(b"\x02cccc")
+    w.close()
+    data = bytearray(open(w.path, "rb").read())
+    # flip one byte inside the SECOND record's payload
+    second_off = (8 + 5) + 8 + 2
+    data[second_off] ^= 0xFF
+    open(w.path, "wb").write(bytes(data))
+    got, _consumed, clean = spool.read_segment(w.path)
+    assert got == [b"\x02aaaa"]  # nothing past the corruption applies
+    assert not clean
+
+
+def test_rotation_and_reader_walks_segments(tmp_path):
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=64,
+                            sync_s=-1, suffix=".spool")
+    payloads = [bytes([2]) + b"r%03d" % i + b"x" * 20 for i in range(12)]
+    for p in payloads:
+        w.append(p)
+    w.close()
+    assert len(spool.list_segments(str(tmp_path), ".spool")) > 1
+    reader = spool.SpoolReader(str(tmp_path))
+    got, cur, stats = reader.read_from(spool.SpoolCursor())
+    assert got == payloads
+    assert cur.records == len(payloads)
+    assert stats == {"torn": 0, "unknown_kinds": 0}
+
+
+def test_unknown_kinds_skip_with_count(tmp_path):
+    """A replayer meeting a kind it does not know must SKIP it with a
+    count — never crash — and keep consuming records past it."""
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    w.append(b"\x02known-1")
+    w.append(b"\x09future-kind")
+    w.append(b"\x02known-2")
+    w.close()
+    reader = spool.SpoolReader(str(tmp_path))
+    got, cur, stats = reader.read_from(spool.SpoolCursor(),
+                                       known_kinds=(2, 3))
+    assert got == [b"\x02known-1", b"\x02known-2"]
+    assert stats["unknown_kinds"] == 1
+    assert cur.records == 3  # the cursor advanced PAST the unknown record
+
+
+def test_cursor_tailing_reads_only_new(tmp_path):
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    w.append(b"\x02one")
+    w.sync()
+    reader = spool.SpoolReader(str(tmp_path))
+    got1, cur1, _ = reader.read_from(spool.SpoolCursor())
+    assert got1 == [b"\x02one"]
+    got_empty, cur_same, _ = reader.read_from(cur1)
+    assert got_empty == [] and cur_same == cur1  # exhausted: unchanged
+    w.append(b"\x02two")
+    w.sync()
+    got2, cur2, _ = reader.read_from(cur1)
+    assert got2 == [b"\x02two"]
+    assert cur2.records == 2
+    w.close()
+
+
+def test_pending_tail_in_newest_segment_is_not_torn(tmp_path):
+    """A half-written frame in the NEWEST segment is a writer mid-append:
+    the reader stops at the consumed boundary and a later read — after
+    the frame completes — picks it up."""
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    w.append(b"\x02whole")
+    w.sync()
+    # simulate a mid-append: a partial header at the tail
+    with open(w.path, "ab") as f:
+        f.write(struct.pack("<I", 99))
+    reader = spool.SpoolReader(str(tmp_path))
+    got, cur, stats = reader.read_from(spool.SpoolCursor())
+    assert got == [b"\x02whole"]
+    assert stats["torn"] == 0
+    # complete the frame out-of-band and re-read from the cursor
+    os.truncate(w.path, os.path.getsize(w.path) - 4)
+    w._size = os.path.getsize(w.path)
+    w.append(b"\x02later")
+    w.close()
+    got2, _cur2, _ = reader.read_from(cur)
+    assert got2 == [b"\x02later"]
+
+
+def test_torn_middle_segment_skips_to_next(tmp_path):
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=32,
+                            sync_s=-1, suffix=".spool")
+    w.append(b"\x02seg1-record-aaaaaaaaaaaaaaaaaaaa")
+    w.append(b"\x02seg2-record-bbbbbbbbbbbbbbbbbbbb")  # forces rotation
+    w.close()
+    segs = spool.list_segments(str(tmp_path), ".spool")
+    assert len(segs) >= 2
+    first = os.path.join(str(tmp_path), segs[0])
+    os.truncate(first, os.path.getsize(first) - 3)
+    reader = spool.SpoolReader(str(tmp_path))
+    got, cur, stats = reader.read_from(spool.SpoolCursor())
+    # the torn record is gone and counted — but the read did NOT crash
+    # and continued into the next segment's records
+    assert got == [b"\x02seg2-record-bbbbbbbbbbbbbbbbbbbb"]
+    assert stats["torn"] == 1
+    assert cur.records == 1
+
+
+def test_offset_marker_roundtrip_and_semantics(tmp_path):
+    d = str(tmp_path)
+    spool.write_offset_marker(d, {"seg-1": 100}, "M.json")
+    assert spool.read_offset_marker(d, "M.json") == {"seg-1": 100}
+    # shrink-only (the WAL's replay-cap stance): a cap never grows
+    spool.write_offset_marker(d, {"seg-1": 200}, "M.json",
+                              shrink_only=True)
+    assert spool.read_offset_marker(d, "M.json") == {"seg-1": 100}
+    spool.write_offset_marker(d, {"seg-1": 50}, "M.json",
+                              shrink_only=True)
+    assert spool.read_offset_marker(d, "M.json") == {"seg-1": 50}
+    # grow-allowed (the spool's consumed stance): the cursor only advances
+    spool.write_offset_marker(d, {"seg-1": 300}, "C.json",
+                              shrink_only=False)
+    spool.write_offset_marker(d, {"seg-1": 400}, "C.json",
+                              shrink_only=False)
+    assert spool.read_offset_marker(d, "C.json") == {"seg-1": 400}
+
+
+def test_read_segment_seeks_to_start_offset(tmp_path):
+    """A tailing poll pays for NEW bytes only: reading from the cursor's
+    absolute offset yields exactly the records past it, with absolute
+    ``consumed``."""
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    w.append(b"\x02one")
+    boundary = os.path.getsize(w.path)
+    w.append(b"\x02two")
+    w.append(b"\x02three")
+    w.close()
+    got, consumed, clean = spool.read_segment(w.path, start=boundary)
+    assert got == [b"\x02two", b"\x02three"]
+    assert clean and consumed == os.path.getsize(w.path)
+    # and read_records hands back identical positions either way
+    reader = spool.SpoolReader(str(tmp_path))
+    full, cur_full, _ = reader.read_records(spool.SpoolCursor())
+    seg = os.path.basename(w.path)
+    tail, cur_tail, _ = reader.read_records(
+        spool.SpoolCursor(segment=seg, offset=boundary, records=1))
+    assert [p for p, _ in tail] == [p for p, _ in full][1:]
+    assert cur_tail.offset == cur_full.offset == os.path.getsize(w.path)
+
+
+def test_read_segment_honors_limit(tmp_path):
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    w.append(b"\x02one")
+    n1 = os.path.getsize(w.path)
+    w.append(b"\x02two")
+    w.close()
+    got, consumed, _clean = spool.read_segment(w.path, limit=n1)
+    assert got == [b"\x02one"]
+    assert consumed == n1
+
+
+def test_retire_consumed_never_touches_open_segment(tmp_path):
+    d = str(tmp_path)
+    w = spool.SegmentWriter(d, segment_bytes=32, sync_s=-1,
+                            suffix=".spool")
+    for i in range(6):
+        w.append(bytes([2]) + b"payload-%d-" % i + b"z" * 24)
+    w.sync()
+    segs = spool.list_segments(d, ".spool")
+    assert len(segs) >= 3
+    # consumer covered the first two segments wholly
+    caps = {segs[0]: os.path.getsize(os.path.join(d, segs[0])),
+            segs[1]: os.path.getsize(os.path.join(d, segs[1]))}
+    spool.write_offset_marker(d, caps, spool.CONSUMED_MARKER,
+                              shrink_only=False)
+    removed = spool.retire_consumed(d)
+    assert removed == 2
+    left = spool.list_segments(d, ".spool")
+    assert segs[-1] in left and segs[0] not in left
+    w.close()
+
+
+def test_rollback_truncates_last_frame(tmp_path):
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool")
+    w.append(b"\x02keep")
+    n = w.append(b"\x02drop-me")
+    w.rollback(n)
+    w.close()
+    got, _c, clean = spool.read_segment(w.path)
+    assert got == [b"\x02keep"] and clean
+
+
+def test_broken_writer_raises_error_cls(tmp_path):
+    class Boom(RuntimeError):
+        pass
+
+    w = spool.SegmentWriter(str(tmp_path), segment_bytes=1 << 20,
+                            sync_s=-1, suffix=".spool", error_cls=Boom)
+    w._broken = OSError("disk gone")
+    with pytest.raises(Boom):
+        w.append(b"\x02x")
+
+
+def test_wal_rides_the_shared_core():
+    """The no-drift guarantee is structural: ps/wal.py's frame codec,
+    segment reader, and offset-marker schema ARE loop/spool.py's — the
+    same objects, not copies."""
+    from easydl_tpu.ps import wal
+
+    assert wal.frame is spool.frame
+    assert wal.read_segment is spool.read_segment
+    assert issubclass(wal.PsWal, spool.SegmentWriter)
+    # and the marker schema is written/read through the shared helpers
+    assert wal.read_replay_caps.__module__ == "easydl_tpu.ps.wal"
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        wal.write_replay_marker(d, {"seg-00000001.wal": 42})
+        assert spool.read_offset_marker(d, wal.REPLAYED_MARKER) == {
+            "seg-00000001.wal": 42}
