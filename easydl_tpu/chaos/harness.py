@@ -115,6 +115,17 @@ class Scenario:
     #: arm splits sessions consistently, and ONE Rollout RPC rolls back
     #: instantly.
     loop_drill: Optional[Dict[str, Any]] = None
+    #: Serve-fleet drill mode (ISSUE 14, ``serve_replica_death_mid_flood``):
+    #: N replica SUBPROCESSES (python -m easydl_tpu.serve, shm pulls armed)
+    #: behind an in-process ServeRouter ride a flash-crowd flood; one
+    #: replica is SIGKILLed mid-flood; the router must eject it and keep
+    #: the stream hard-failure-free with a bounded p99 spike, hedges must
+    #: demonstrably rescue requests, and EVERY recorded score is
+    #: re-derived bit-exactly from a cache-bypassing client (per phase —
+    #: acked trainer pushes split the flood into freshness epochs). Keys:
+    #: replicas, rows, fields, vocab, dim, device_ms, rps, phase_s,
+    #: pushes, kill_replica.
+    fleet_drill: Optional[Dict[str, Any]] = None
 
     @property
     def name(self) -> str:
@@ -204,11 +215,312 @@ class ChaosHarness:
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> Dict[str, Any]:
+        if self.scenario.fleet_drill is not None:
+            return self._run_fleet_drill()
         if self.scenario.loop_drill is not None:
             return self._run_loop_drill()
         if self.scenario.ps_storm is not None:
             return self._run_ps_storm()
         return self._run_job()
+
+    # ------------------------------------------------------- serve fleet
+    def _run_fleet_drill(self) -> Dict[str, Any]:
+        sc = self.scenario
+        plan_path = os.path.join(self.workdir, "chaos-plan.json")
+        _write_plan(plan_path, self.schedule)
+        saved_env: Dict[str, Optional[str]] = {}
+        from easydl_tpu.obs import tracing
+
+        for key, val in ((injectors.ENV_VAR, plan_path),
+                         (tracing.TRACE_ENV, "1"),
+                         ("EASYDL_PS_SHM", "1"),
+                         ("EASYDL_PS_PROBE_TIMEOUT_S", "1.0")):
+            saved_env[key] = os.environ.get(key)
+            os.environ[key] = val
+        t_start = time.monotonic()
+        counts_before = injectors.injected_fault_counts()
+        evidence: Dict[str, Any] = {}
+        try:
+            self._launch_ps()
+            evidence = self._drive_fleet_flood()
+        finally:
+            self._teardown()
+            for key, val in saved_env.items():
+                if val is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = val
+        # The invariant checker reads the evidence from the workdir, like
+        # the loop drills.
+        with open(os.path.join(self.workdir, "fleet-evidence.json"),
+                  "w") as f:
+            json.dump(evidence, f, indent=2, default=str)
+        fault_counts = {
+            kind: count - counts_before.get(kind, 0.0)
+            for kind, count in injectors.injected_fault_counts().items()
+            if count - counts_before.get(kind, 0.0) > 0
+        }
+        verdict = invariants.check_scenario(
+            self.workdir, sc.expect, status={}, fault_counts=fault_counts,
+            outages=self.outages,
+        )
+        _scenario_counter().inc(scenario=sc.name,
+                                result="pass" if verdict["passed"]
+                                else "fail")
+        return {
+            "scenario": sc.name,
+            "seed": sc.chaos.seed,
+            "notes": sc.chaos.notes,
+            "workdir": self.workdir,
+            "wall_s": round(time.monotonic() - t_start, 2),
+            "schedule": self.schedule,
+            "expect": dict(sc.expect),
+            "faults_injected": fault_counts,
+            "fleet": evidence,
+            "final_status": {},
+            "invariants": verdict,
+            "passed": verdict["passed"],
+        }
+
+    def _spawn_serve_replicas(self, n: int, cfg: Dict[str, Any],
+                              table: str) -> Dict[str, Any]:
+        from easydl_tpu.serve.launch import spawn_replicas
+
+        return spawn_replicas(
+            n, self.workdir, table, int(cfg.get("fields", 4)),
+            device_ms=float(cfg.get("device_ms", 25.0)),
+            max_batch=int(cfg.get("rows", 8)), max_wait_ms=2.0,
+            max_pending=int(cfg.get("max_pending", 64)), cache_mb=16)
+
+    def _drive_fleet_flood(self) -> Dict[str, Any]:
+        import numpy as np
+
+        from easydl_tpu.obs import scrape as obs_scrape
+        from easydl_tpu.ps.client import ShardedPsClient
+        from easydl_tpu.ps.table import TableSpec
+        from easydl_tpu.serve.frontend import _numpy_forward
+        from easydl_tpu.serve.router import ServeRouter
+
+        sc = self.scenario
+        cfg = dict(sc.fleet_drill or {})
+        n_replicas = int(cfg.get("replicas", 3))
+        rows = int(cfg.get("rows", 8))
+        fields = int(cfg.get("fields", 4))
+        vocab = int(cfg.get("vocab", 2000))
+        dim = int(cfg.get("dim", 8))
+        rps = float(cfg.get("rps", 60.0))
+        phase_s = float(cfg.get("phase_s", 4.0))
+        pushes = int(cfg.get("pushes", 3))
+        kill_name = str(cfg.get("kill_replica", "serve-1"))
+        rng = np.random.default_rng(sc.chaos.seed)
+        table = "fleet_emb"
+
+        # pull_shm=False on BOTH harness-side clients: the drill's armed
+        # EASYDL_PS_SHM env must not leak into the reference path — the
+        # bypass client is the independent WIRE witness the stale check
+        # compares against (only the replicas ride the shm mirror).
+        seeder = ShardedPsClient.from_registry(
+            self.workdir, sc.ps_shards, timeout=10.0,
+            drain_retry_s=60.0, transient_retry_s=30.0, pull_shm=False)
+        spec = TableSpec(name=table, dim=dim, optimizer="sgd", seed=11)
+        seeder.create_table(spec)
+        seed_ids = np.arange(vocab, dtype=np.int64)
+        seeder.push(table, seed_ids,
+                    rng.standard_normal((vocab, dim)).astype(np.float32),
+                    scale=0.2)
+        procs = self._spawn_serve_replicas(n_replicas, cfg, table)
+        router = ServeRouter(
+            workdir=self.workdir, name="fleet-router",
+            hedge_budget=0.3, hedge_min_ms=15.0, hedge_max_ms=120.0,
+            holddown_s=1.0, eject_fails=2, refresh_s=0.5, timeout_s=20.0)
+        # Deterministic request pool: the same (ids, session) mix both
+        # phases, so expected scores are a pure function of phase state.
+        pool = []
+        for i in range(48):
+            ids = (rng.zipf(1.1, rows * fields) % vocab).astype(
+                np.int64).reshape(rows, fields)
+            pool.append((ids, f"sess-{i % 12}" if i % 3 else ""))
+        records: list = []
+        rec_mu = threading.Lock()
+        kill_mark: Dict[str, Any] = {}
+
+        def flood(phase: str, duration: float, kill_at: Optional[float]):
+            """Closed-loop paced flood on a few driver threads; records
+            (pool index, phase, ok, latency, wall t, scores bytes)."""
+            stop_at = time.monotonic() + duration
+            idx = {"i": 0}
+
+            def worker():
+                while True:
+                    now = time.monotonic()
+                    if now >= stop_at:
+                        return
+                    with rec_mu:
+                        i = idx["i"]
+                        idx["i"] += 1
+                    ids, session = pool[i % len(pool)]
+                    t0 = time.monotonic()
+                    r = router.infer(ids, session_id=session)
+                    with rec_mu:
+                        records.append({
+                            "pool": i % len(pool), "phase": phase,
+                            "ok": bool(r.ok),
+                            "retriable": bool(r.retriable),
+                            "verdict": r.verdict,
+                            "t": t0, "lat": r.latency_s
+                            if r.latency_s else time.monotonic() - t0,
+                            "scores": (r.scores.tobytes()
+                                       if r.scores is not None else b""),
+                        })
+                    # pace: the flood is arrival-shaped, not CPU-bound
+                    time.sleep(max(0.0, threads / rps
+                                   - (time.monotonic() - now)))
+
+            threads = 6
+            ts = [threading.Thread(target=worker, daemon=True)
+                  for _ in range(threads)]
+            killer = None
+            if kill_at is not None:
+                def kill():
+                    import signal as _signal
+
+                    p = procs.get(kill_name)
+                    if p is None:
+                        return
+                    kill_mark.update(t=time.monotonic(),
+                                     replica=kill_name, pid=p.pid)
+                    # SIGSTOP first: a dying replica usually HANGS before
+                    # it dies (GC storm, OOM thrash, network brownout) —
+                    # its in-flight requests stall past the hedge delay,
+                    # and the hedges must RESCUE them (first answer
+                    # wins). Then the SIGKILL: transport death, which
+                    # ejection + reroute must absorb.
+                    os.kill(p.pid, _signal.SIGSTOP)
+                    injectors.count_fault("serve_replica_stall")
+                    log.info("fleet drill: SIGSTOPped %s (pid %d) "
+                             "mid-flood", kill_name, p.pid)
+                    time.sleep(float(cfg.get("stall_s", 1.0)))
+                    p.kill()
+                    injectors.count_fault("serve_replica_kill")
+                    log.info("fleet drill: SIGKILLed %s (pid %d)",
+                             kill_name, p.pid)
+
+                killer = threading.Timer(kill_at, kill)
+                killer.start()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if killer is not None:
+                killer.join()
+
+        evidence: Dict[str, Any] = {"replicas": n_replicas,
+                                    "kill_replica": kill_name}
+        bypass = ShardedPsClient.from_registry(
+            self.workdir, sc.ps_shards, timeout=10.0,
+            drain_retry_s=60.0, transient_retry_s=30.0, pull_shm=False)
+        try:
+            # warm (negotiation + caches), then phase A with the mid-
+            # flood kill, then ACKED pushes, then phase B (freshness
+            # under the post-kill fleet).
+            for i in range(8):
+                router.infer(pool[i][0], session_id=pool[i][1])
+            expected_a = {
+                i: _numpy_forward(
+                    bypass.pull(table, ids), np.zeros((rows, 0),
+                                                      np.float32))
+                for i, (ids, _s) in enumerate(pool)
+            }
+            flood("a", phase_s, kill_at=phase_s * 0.4)
+            hot = np.unique(pool[0][0].reshape(-1))
+            for k in range(pushes):
+                seeder.push(
+                    table, hot,
+                    rng.standard_normal((len(hot), dim)).astype(
+                        np.float32), scale=0.5)
+            expected_b = {
+                i: _numpy_forward(
+                    bypass.pull(table, ids), np.zeros((rows, 0),
+                                                      np.float32))
+                for i, (ids, _s) in enumerate(pool)
+            }
+            flood("b", phase_s, kill_at=None)
+            # ---- stale/score check: EVERY recorded ok answer re-derived
+            # from the bypass client's rows, bit-exactly, per phase.
+            checked = 0
+            mismatches = 0
+            hard_failures = 0
+            failure_samples: list = []
+            lat_pre: list = []
+            lat_post: list = []
+            for r in records:
+                if not r["ok"]:
+                    if not r["retriable"]:
+                        hard_failures += 1
+                        if len(failure_samples) < 5:
+                            failure_samples.append(r["verdict"])
+                    continue
+                want = (expected_a if r["phase"] == "a"
+                        else expected_b)[r["pool"]]
+                got = np.frombuffer(r["scores"], "<f4")
+                checked += 1
+                if not np.array_equal(got,
+                                      want.astype(np.float32)):
+                    mismatches += 1
+                if kill_mark and r["t"] >= kill_mark["t"]:
+                    lat_post.append(r["lat"])
+                elif kill_mark:
+                    lat_pre.append(r["lat"])
+
+            def p99(xs):
+                xs = sorted(xs)
+                return (xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+                        if xs else 0.0)
+
+            # shm transport evidence from the SURVIVING replicas'
+            # exporters (the killed one's discovery file is swept).
+            shm_pulls = 0.0
+            try:
+                snap = obs_scrape.merge_snapshot(workdir=self.workdir)
+                for _c, svc in (snap.get("services") or {}).items():
+                    for series, value in (svc.get("metrics")
+                                          or {}).items():
+                        if series.startswith(
+                                "easydl_ps_shm_client_pulls_total"):
+                            shm_pulls += float(value)
+            except Exception as e:
+                # evidence degrades (the invariant then fails on zero shm
+                # pulls) — recorded, never fatal mid-teardown
+                log.warning("fleet drill: exporter scrape failed: %s", e)
+                evidence["scrape_error"] = repr(e)
+            evidence.update({
+                "requests": len(records),
+                "ok": sum(1 for r in records if r["ok"]),
+                "shed": sum(1 for r in records
+                            if not r["ok"] and r["retriable"]),
+                "hard_failures": hard_failures,
+                "failure_samples": failure_samples,
+                "stale_check": {"scores_checked": checked,
+                                "mismatches": mismatches,
+                                "push_phases": pushes},
+                "p99_pre_kill_s": round(p99(lat_pre), 4),
+                "p99_post_kill_s": round(p99(lat_post), 4),
+                "kill": {k: (round(v, 3) if isinstance(v, float) else v)
+                         for k, v in kill_mark.items()},
+                "router": dict(router.counters),
+                "replica_view": router.replicas(),
+                "shm_client_pulls": shm_pulls,
+            })
+            return evidence
+        finally:
+            router.stop()
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            for p in procs.values():
+                p.wait()
+            bypass.close()
+            seeder.close()
 
     def _run_job(self) -> Dict[str, Any]:
         sc = self.scenario
@@ -2148,6 +2460,46 @@ def scenario_serve_during_reshard(seed: int = 59) -> Scenario:
     )
 
 
+def scenario_serve_replica_death_mid_flood(seed: int = 71) -> Scenario:
+    """The serve-fleet drill (ISSUE 14): three REAL replica subprocesses
+    (shm pulls armed, deterministic scorer) behind the fleet router ride
+    a flash-crowd flood; one replica is SIGKILLed mid-flood. The router
+    must eject it (hold-down + re-probe) and keep the stream free of
+    hard failures with a bounded p99 spike; hedges must fire AND
+    demonstrably rescue requests (first-answer-wins against a slow or
+    dead primary); and every served score — across acked trainer pushes
+    that split the flood into freshness phases — must re-derive
+    BIT-EXACTLY from a cache-bypassing client, so neither the hot-id
+    cache, the shm mirror, nor the rerouting may ever serve a stale row.
+    The invariant refuses zero-hedge / zero-ejection / zero-shm-pull
+    passes as vacuous."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="serve_replica_death_mid_flood", seed=seed,
+            notes="SIGSTOP-then-SIGKILL a serving replica mid-flash-"
+                  "crowd (the stall is where hedges must rescue, the "
+                  "kill is what ejection must absorb); post-drill stale "
+                  "check is bit-exact vs a bypass wire client",
+            faults=(),  # the kill fires at a flood offset, not a wall one
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=2,
+        fleet_drill={"replicas": 3, "rows": 8, "fields": 4,
+                     "vocab": 2000, "dim": 8, "device_ms": 30.0,
+                     "rps": 60.0, "phase_s": 4.0, "pushes": 3,
+                     "stall_s": 1.0, "kill_replica": "serve-1"},
+        expect={
+            "fleet_resilient": True,
+            "min_fleet_requests": 80,   # vacuous-pass refusal
+            "max_p99_s": 5.0,           # bounded spike (vs the 20s
+                                        # router timeout; this box is
+                                        # cpu-shares throttled)
+            "min_faults": 2,            # the stall AND the kill
+        },
+    )
+
+
 def scenario_trainer_crash_mid_loop(seed: int = 61) -> Scenario:
     """The production loop's exactly-once drill (ISSUE 13 / CHAOS_r17):
     a REAL continuous-trainer subprocess tails a deterministic feedback
@@ -2326,6 +2678,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "ps_zombie_writer": scenario_ps_zombie_writer,
     "ps_reshard_under_fire": scenario_ps_reshard_under_fire,
     "serve_during_reshard": scenario_serve_during_reshard,
+    "serve_replica_death_mid_flood": scenario_serve_replica_death_mid_flood,
     "trainer_crash_mid_loop": scenario_trainer_crash_mid_loop,
     "rollout_half_update": scenario_rollout_half_update,
     "straggler_mitigation": scenario_straggler_mitigation,

@@ -632,6 +632,69 @@ def check_scenario(
                                    ("shard", "pod", "epoch", "address")},
                     }
 
+    # ---------------------------------------------------- serve fleet (r19)
+    if expect.get("fleet_resilient"):
+        ev: Dict[str, Any] = {}
+        try:
+            with open(os.path.join(workdir, "fleet-evidence.json")) as f:
+                ev = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if not ev:
+            checks["serve_fleet_resilient"] = {
+                "ok": False,
+                "reason": "no fleet-evidence.json in the workdir (drill "
+                          "crashed before writing evidence)",
+            }
+        else:
+            router = ev.get("router") or {}
+            stale = ev.get("stale_check") or {}
+            min_req = int(expect.get("min_fleet_requests", 1))
+            max_p99 = float(expect.get("max_p99_s", 5.0))
+            hedges = int(router.get("hedges_fired", 0))
+            rescued = (int(router.get("hedges_won", 0))
+                       + int(router.get("hedges_rescued", 0)))
+            p99_post = float(ev.get("p99_post_kill_s", -1.0))
+            # Anti-vacuous: a pass REQUIRES a real kill, a real ejection,
+            # hedges that fired AND won/rescued, served traffic past the
+            # floor, post-kill latency evidence, at least one shm pull
+            # observed, and a non-empty bit-exact stale check spanning
+            # acked pushes. Zero-hedge or zero-ejection runs fail — they
+            # prove the flood missed the fault, not that the fleet rode
+            # it out.
+            ok = (int(ev.get("requests", 0)) >= min_req
+                  and int(ev.get("hard_failures", -1)) == 0
+                  and bool(ev.get("kill"))
+                  and int(router.get("ejections", 0)) >= 1
+                  and hedges >= 1
+                  and rescued >= 1
+                  and int(stale.get("scores_checked", 0)) > 0
+                  and int(stale.get("mismatches", -1)) == 0
+                  and int(stale.get("push_phases", 0)) >= 1
+                  and 0.0 < p99_post <= max_p99
+                  and float(ev.get("shm_client_pulls", 0.0)) >= 1.0)
+            checks["serve_fleet_resilient"] = {
+                "ok": ok,
+                "requests": ev.get("requests"),
+                "ok_requests": ev.get("ok"),
+                "shed": ev.get("shed"),
+                "hard_failures": ev.get("hard_failures"),
+                "failure_samples": ev.get("failure_samples"),
+                "kill": ev.get("kill"),
+                "ejections": router.get("ejections"),
+                "readmissions": router.get("readmissions"),
+                "hedges_fired": hedges,
+                "hedges_won": router.get("hedges_won"),
+                "hedges_rescued": router.get("hedges_rescued"),
+                "reroutes": router.get("reroutes"),
+                "stale_check": stale,
+                "p99_pre_kill_s": ev.get("p99_pre_kill_s"),
+                "p99_post_kill_s": p99_post,
+                "max_p99_s": max_p99,
+                "shm_client_pulls": ev.get("shm_client_pulls"),
+                "min_fleet_requests": min_req,
+            }
+
     # ------------------------------------------------- production loop (r17)
     if expect.get("loop_exactly_once"):
         ev: Dict[str, Any] = {}

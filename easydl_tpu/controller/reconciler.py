@@ -368,7 +368,11 @@ def serve_scale_decision(replica_qps: Dict[str, float],
                          min_replicas: int = SERVE_MIN_REPLICAS,
                          max_replicas: int = SERVE_MAX_REPLICAS,
                          scale_down_fraction: float =
-                         SERVE_SCALE_DOWN_FRACTION) -> Optional[int]:
+                         SERVE_SCALE_DOWN_FRACTION,
+                         router_offered_qps: Optional[float] = None,
+                         router_replicas: Optional[int] = None,
+                         router_p99_s: Optional[float] = None
+                         ) -> Optional[int]:
     """Pure decision: observed per-replica QPS and p99 → target replica
     count, or None for "leave it alone". Same shape as
     :func:`ps_split_decision`: pure inputs → pure verdict, unit-testable
@@ -380,12 +384,26 @@ def serve_scale_decision(replica_qps: Dict[str, float],
     - **hysteresis**: scale down only when total QPS would keep even the
       SHRUNK fleet under ``scale_down_fraction`` × target per replica and
       every p99 is under half the budget.
-    """
-    replicas = len(replica_qps)
+
+    ``router_*`` are the fleet router's door-side observations, and when
+    present they are AUTHORITATIVE for what they measure: offered load
+    (completed AND shed AND requests routed to replicas whose exporters
+    this scrape cannot see — remote hosts, mid-crash replicas) and the
+    true fleet size. Summing whichever replica gauges happened to get
+    scraped UNDER-counts both: a 3-replica fleet at 60% each whose
+    router answered the scrape but whose replicas didn't would otherwise
+    read as one idle replica and scale to the floor."""
+    replicas = max(len(replica_qps), int(router_replicas or 0))
     if replicas <= 0 or target_qps <= 0:
         return None
     total_qps = float(sum(replica_qps.values()))
+    if router_offered_qps is not None:
+        # The door sees every request; replicas see only what reached
+        # them. max(): a stale router gauge must not hide replica load.
+        total_qps = max(total_qps, float(router_offered_qps))
     worst_p99 = max(replica_p99.values(), default=0.0)
+    if router_p99_s is not None:
+        worst_p99 = max(worst_p99, float(router_p99_s))
     need_capacity = max(1, math.ceil(total_qps / target_qps))
     want = replicas
     if worst_p99 > p99_budget_s:
@@ -442,20 +460,37 @@ def maybe_scale_serve(workdir: str,
     qps_re = _re.compile(r'^easydl_serve_qps_recent\{.*replica="([^"]+)"')
     p99_re = _re.compile(
         r'^easydl_serve_p99_seconds_recent\{.*replica="([^"]+)"')
+    # Fleet router gauges (easydl_tpu/serve/router.py): door-side offered
+    # load + true rotation size. Summed / maxed across routers.
+    r_qps_re = _re.compile(
+        r'^easydl_serve_router_offered_qps_recent\{.*replica="([^"]+)"')
+    r_live_re = _re.compile(
+        r'^easydl_serve_router_live_replicas\{.*replica="([^"]+)"')
+    r_p99_re = _re.compile(
+        r'^easydl_serve_router_p99_seconds_recent\{.*replica="([^"]+)"')
     replica_qps: Dict[str, float] = {}
     replica_p99: Dict[str, float] = {}
+    router_offered: Dict[str, float] = {}
+    router_live: Dict[str, float] = {}
+    router_p99: Dict[str, float] = {}
     for _component, svc in (snap.get("services") or {}).items():
         for series, value in (svc.get("metrics") or {}).items():
-            m = qps_re.match(series)
-            if m:
-                replica_qps[m.group(1)] = float(value)
-                continue
-            m = p99_re.match(series)
-            if m:
-                replica_p99[m.group(1)] = float(value)
-    if not replica_qps:
+            for rx, sink in ((qps_re, replica_qps), (p99_re, replica_p99),
+                             (r_qps_re, router_offered),
+                             (r_live_re, router_live),
+                             (r_p99_re, router_p99)):
+                m = rx.match(series)
+                if m:
+                    sink[m.group(1)] = float(value)
+                    break
+    if not replica_qps and not router_offered:
         return None
     return serve_scale_decision(
         replica_qps, replica_p99, target_qps=target_qps,
         p99_budget_s=p99_budget_s, min_replicas=min_replicas,
-        max_replicas=max_replicas)
+        max_replicas=max_replicas,
+        router_offered_qps=(sum(router_offered.values())
+                            if router_offered else None),
+        router_replicas=(int(max(router_live.values()))
+                         if router_live else None),
+        router_p99_s=(max(router_p99.values()) if router_p99 else None))

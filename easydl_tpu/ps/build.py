@@ -34,6 +34,29 @@ def _bind(lib: ctypes.CDLL) -> None:
     ]
     lib.eds_export_snapshot.restype = ctypes.c_int64
     lib.eds_import.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
+    # Shared-memory mirror (zero-copy pull transport, PR 14): server side
+    # export/version/revoke on the store handle, client side open/gather
+    # on a read-only mapping of the named segment.
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.eds_shm_export.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int64,
+    ]
+    lib.eds_shm_export.restype = ctypes.c_int
+    lib.eds_shm_set_version.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.eds_shm_revoke.argtypes = [ctypes.c_void_p]
+    lib.eds_shm_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.eds_shm_open.restype = ctypes.c_void_p
+    lib.eds_shm_close.argtypes = [ctypes.c_void_p]
+    lib.eds_shm_reader_dim.argtypes = [ctypes.c_void_p]
+    lib.eds_shm_reader_dim.restype = ctypes.c_int64
+    lib.eds_shm_reader_meta.argtypes = [
+        ctypes.c_void_p, u64p, ctypes.POINTER(ctypes.c_float), u64p,
+    ]
+    lib.eds_shm_gather.argtypes = [
+        ctypes.c_void_p, i64p, ctypes.c_int64, f32p, u8p, u64p,
+    ]
+    lib.eds_shm_gather.restype = ctypes.c_int64
 
 
 def load_native() -> Optional[ctypes.CDLL]:

@@ -34,6 +34,7 @@ import time
 
 from easydl_tpu.obs import get_registry, tracing
 from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps import quant as _quant
 from easydl_tpu.ps.server import (
     DRAINING,
     PS_SERVICE,
@@ -112,6 +113,34 @@ def _client_metrics():
             ),
         )
     return _client_metrics_cache
+
+
+_shm_metrics_cache: Optional[tuple] = None
+
+
+def _shm_metrics():
+    global _shm_metrics_cache
+    if _shm_metrics_cache is None:
+        reg = get_registry()
+        _shm_metrics_cache = (
+            reg.counter(
+                "easydl_ps_shm_client_pulls_total",
+                "Shard pulls served from the shared-memory mirror "
+                "(zero gRPC).", ("table",),
+            ),
+            reg.counter(
+                "easydl_ps_shm_client_ids_total",
+                "Embedding ids gathered through the shm transport.",
+                ("table",),
+            ),
+            reg.counter(
+                "easydl_ps_shm_client_fallbacks_total",
+                "shm attempts that fell back to the wire (open-failed = "
+                "remote shard; revoked = cutover/fence/restore/overflow; "
+                "contention = persistent seqlock conflict).", ("reason",),
+            ),
+        )
+    return _shm_metrics_cache
 
 
 class _PsClientBase:
@@ -527,6 +556,8 @@ class ShardedPsClient(_PsClientBase):
                  coalesce: Optional[bool] = None,
                  raw_ids: Optional[bool] = None,
                  pull_fp16: Optional[bool] = None,
+                 pull_i8: Optional[bool] = None,
+                 pull_shm: Optional[bool] = None,
                  chunk_bytes: Optional[int] = None):
         self.addresses = list(addresses)
         self.num_shards = len(self.addresses)
@@ -543,6 +574,28 @@ class ShardedPsClient(_PsClientBase):
                         if raw_ids is None else raw_ids)
         self.pull_fp16 = (_env_flag("EASYDL_PS_PULL_FP16", False)
                           if pull_fp16 is None else pull_fp16)
+        # Third rung of the payload ladder (ps/quant.py): int8 + per-row
+        # scale, ~0.25x the f32 wire. Requested per pull; the SERVER
+        # decides what it can answer (a legacy shard replies f32/f16 and
+        # the decode below follows the response's dtype, so a reroute to
+        # an older replacement degrades without a hard failure). i8 wins
+        # over fp16 when both are set.
+        self.pull_i8 = (_env_flag("EASYDL_PS_PULL_I8", False)
+                        if pull_i8 is None else pull_i8)
+        # Zero-copy shared-memory pulls (EASYDL_PS_SHM / constructor
+        # opt-in): when a PullResponse advertises a shm segment this
+        # client can actually open (co-located shard, native store), the
+        # shard's reads leave gRPC entirely. Negotiated per (shard,
+        # table); any mismatch falls back silently to the wire.
+        self.pull_shm = (_env_flag("EASYDL_PS_SHM", False)
+                         if pull_shm is None else pull_shm)
+        #: (shard, table) -> live shm reader; values None = negotiation
+        #: failed for the advertised segment (don't retry until the shard
+        #: advertises a different name). Guarded by _routing_lock siblings
+        #: via _shm_mu (readers are processwide mmaps, cheap to share).
+        self._shm_readers: Dict[tuple, object] = {}
+        self._shm_failed: Dict[tuple, str] = {}
+        self._shm_mu = threading.Lock()
         # Large unary messages are superlinearly slow through python gRPC
         # (measured: one 2 MB pull costs ~2.5x two 1 MB pulls), so per-shard
         # transfers split into ~EASYDL_PS_CHUNK_BYTES value-payload chunks
@@ -676,6 +729,10 @@ class ShardedPsClient(_PsClientBase):
             # "did routing change under me" check on it, and must only see
             # it move once the new shard set is fully in place.
             self._route_generation = gen
+        # Shard indices renumber under the new generation: every shm
+        # reader is bound to an OLD index and must re-negotiate against
+        # whatever the new shard set advertises.
+        self._shm_reset()
         if old_pool is not None:
             old_pool.shutdown(wait=False)
         for c in old_clients:
@@ -764,6 +821,7 @@ class ShardedPsClient(_PsClientBase):
             pool.shutdown(wait=False)
         if self._chunk_pool is not None:
             self._chunk_pool.shutdown(wait=False)
+        self._shm_reset()
         for c in self._clients:
             c.close()
 
@@ -831,6 +889,10 @@ class ShardedPsClient(_PsClientBase):
     def _pull_shard(self, s, table, ids, route_gen=None, vout=None):
         if ids.size == 0:
             return np.zeros((0, self._table_dim(table)), np.float32)
+        if self.pull_shm:
+            rows = self._shm_pull(s, table, ids, route_gen, vout)
+            if rows is not None:
+                return rows
         ranges = self._chunks(len(ids), self._table_dim(table))
         parts = self._chunk_fan(
             [lambda lo=lo, hi=hi: self._pull_chunk(s, table, ids[lo:hi],
@@ -838,6 +900,87 @@ class ShardedPsClient(_PsClientBase):
              for lo, hi in ranges]
         )
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    # ------------------------------------------------------- shm transport
+    def _shm_pull(self, s, table, ids, route_gen=None, vout=None):
+        """Serve this shard slice straight from the shard's shm mirror, or
+        None to take the wire. The mirror rides every server-side
+        consistency gate by REVOCATION: a cutover/fenced/restored shard
+        revokes its segments, the gather fails `revoked`, and the client
+        silently returns to gRPC — where stale-route/stale-epoch handling
+        lives. A rebuilt routing drops all readers outright (shard
+        indices renumber), and a stale in-flight generation skips shm so
+        the wire's RoutingChanged re-dispatch stays authoritative."""
+        if route_gen is not None and self._route_generation != route_gen:
+            return None
+        with self._shm_mu:
+            reader = self._shm_readers.get((s, table))
+        if reader is None:
+            return None
+        from easydl_tpu.ps import shm as _shm
+
+        m = _shm_metrics()
+        try:
+            rows, version = reader.pull(ids)
+        except _shm.ShmUnavailable as e:
+            m[2].inc(reason="revoked" if e.revoked else "contention")
+            if e.revoked:
+                with self._shm_mu:
+                    if self._shm_readers.get((s, table)) is reader:
+                        self._shm_readers.pop((s, table), None)
+                reader.close()
+            return None
+        if vout is not None:
+            vout.record(s, version)
+        m[0].inc(table=table)
+        m[1].inc(int(ids.size), table=table)
+        return rows
+
+    def _shm_negotiate(self, s, table, name, nonce) -> None:
+        """Adopt a PullResponse's shm advertisement: open+verify the
+        segment once per (shard, table, name); an un-openable name (a
+        REMOTE shard — this is the co-location test) is remembered so the
+        hot path never re-pays the open."""
+        key = (s, table)
+        with self._shm_mu:
+            cur = self._shm_readers.get(key)
+            if cur is not None and cur.name == name and cur.nonce == nonce:
+                return
+            if self._shm_failed.get(key) == name:
+                return
+        from easydl_tpu.ps import shm as _shm
+
+        reader = _shm.open_reader(name, int(nonce))
+        old = None
+        with self._shm_mu:
+            if reader is None:
+                self._shm_failed[key] = name
+            else:
+                old = self._shm_readers.pop(key, None)
+                self._shm_readers[key] = reader
+                self._shm_failed.pop(key, None)
+        if old is not None:
+            old.close()
+        if reader is None:
+            _shm_metrics()[2].inc(reason="open-failed")
+        else:
+            log.info("ps shard %d: table %r pulls via shm segment %s",
+                     s, table, name)
+
+    def _shm_reset(self, shard: Optional[int] = None) -> None:
+        """Drop shm readers (all, or one shard's) — routing rebuilds and
+        reroutes renumber/replace shards, so their segments mean nothing."""
+        with self._shm_mu:
+            keys = [k for k in self._shm_readers
+                    if shard is None or k[0] == shard]
+            dropped = [self._shm_readers.pop(k) for k in keys]
+            if shard is None:
+                self._shm_failed.clear()
+            else:
+                for k in [k for k in self._shm_failed if k[0] == shard]:
+                    self._shm_failed.pop(k, None)
+        for r in dropped:
+            r.close()
 
     def probe_versions(self, table, shards):
         """Zero-id Pull per shard: the response carries the table's
@@ -916,7 +1059,7 @@ class ShardedPsClient(_PsClientBase):
                     state["epoch"] = self._reroute_epoch[s]
                     req = pb.PullRequest(
                         table=table,
-                        value_dtype="f16" if self.pull_fp16 else "",
+                        value_dtype=self._value_dtype(),
                         **self._wire_ids(s, ids),
                     )
                     client = self._clients[s]
@@ -976,11 +1119,24 @@ class ShardedPsClient(_PsClientBase):
             # A dtype-bearing response is the raw-capability handshake:
             # later requests to this shard drop the duplicate legacy list.
             self._raw_capable[s] = True
+        if self.pull_shm and resp.shm_segment:
+            self._shm_negotiate(s, table, resp.shm_segment, resp.shm_nonce)
+        # Decode follows the RESPONSE's dtype, not the request's: the
+        # serving shard answers the best encoding it supports, so a legacy
+        # server (or an older replacement after a reroute) degrades an i8
+        # request to f16/f32 without any hard failure.
         if resp.dtype == "f16":
             vals = np.frombuffer(resp.values, "<f2").astype(np.float32)
+        elif resp.dtype == _quant.I8:
+            return _quant.decode_payload(resp.values, resp.row_scales,
+                                         resp.dim)
         else:
             vals = np.frombuffer(resp.values, "<f4")
         return vals.reshape(len(ids), resp.dim)
+
+    def _value_dtype(self) -> str:
+        return _quant.I8 if self.pull_i8 else ("f16" if self.pull_fp16
+                                               else "")
 
     def _push_shard(self, s, table, ids, grads, scale, route_gen=None):
         if ids.size == 0:
@@ -1160,6 +1316,9 @@ class ShardedPsClient(_PsClientBase):
         # still in flight to the OLD server, so they cannot re-arm it.
         self._reroute_epoch[shard] += 1
         self._raw_capable[shard] = False
+        # The replacement is a different process: its mirror (if any) will
+        # be advertised on its own first response.
+        self._shm_reset(shard)
         old.close()
         log.info("ps shard %d rerouted to %s", shard, address)
 

@@ -22,11 +22,28 @@
 // Exposed as a C ABI (eds_*) consumed via ctypes from
 // easydl_tpu/ps/table.py; no pybind11 in this image.
 
+//   * zero-copy shared-memory export (PR 14): eds_shm_export publishes a
+//     seqlock-guarded mirror of the table (value rows only) into a named
+//     shm_open segment; pushes/imports write through under the seqlock, and
+//     a CO-LOCATED client gathers rows straight out of the mapping via
+//     eds_shm_open/eds_shm_gather — no gRPC, no serialization, no copy but
+//     the row memcpy itself. A concurrent push is detected by the seq
+//     check and the gather retried; persistent contention or a revoked
+//     segment returns a sentinel and the caller falls back to the wire.
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <shared_mutex>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -52,6 +69,316 @@ inline int stripe_of(int64_t id) {
 
 // Optimizer kinds (keep in sync with easydl_tpu/ps/table.py).
 enum Optimizer : int { kSgd = 0, kAdagrad = 1 };
+
+// ------------------------------------------------------------ shm mirror
+//
+// Segment layout (8-byte aligned):
+//   ShmHeader | int64 slot_id[nslots] | int32 slot_row[nslots]
+//             | float rows[capacity_rows * dim]
+// The index is insertion-only open addressing (hash = splitmix64(id),
+// linear probe; slot_row == -1 marks a free slot, so any int64 — negative
+// ids included — is a valid key). Only the VALUE half of each row is
+// mirrored: readers are serving pulls, optimizer slots never ride this
+// path. Consistency is one segment-wide seqlock: writers (serialized by
+// the store's shm mutex) bump `seq` odd before touching the index/rows
+// and even after; a reader that observes an odd or changed seq retries.
+// Every shared word is accessed through __atomic builtins so the
+// TSan-instrumented stress driver sees no data race — the seqlock makes
+// the RESULT consistent, the atomics make the bytes well-defined.
+
+constexpr uint64_t kShmMagic = 0x4544535348'4d3031ULL;  // "EDSSHM01"
+
+struct ShmHeader {
+  uint64_t magic;
+  uint64_t nonce;        // creation nonce, echoed on the wire handshake
+  uint64_t seq;          // seqlock: odd = mutation in progress
+  uint64_t push_version; // table push-version the mirror content is at
+  uint64_t valid;        // 1 = live; 0 = revoked (overflow / shutdown)
+  int64_t dim;
+  int64_t capacity_rows;
+  int64_t nslots;        // power of two
+  int64_t nrows;
+  uint64_t seed;         // TableSpec seed — client-side lazy init
+  float init_std;        //   "      init_std
+  float pad_;
+};
+
+inline uint64_t a_load(const uint64_t* p) {
+  return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+}
+inline void a_store(uint64_t* p, uint64_t v) {
+  __atomic_store_n(p, v, __ATOMIC_RELEASE);
+}
+inline int64_t a_load64(const int64_t* p) {
+  return __atomic_load_n(p, __ATOMIC_RELAXED);
+}
+inline void a_store64(int64_t* p, int64_t v) {
+  __atomic_store_n(p, v, __ATOMIC_RELAXED);
+}
+inline int32_t a_load32(const int32_t* p) {
+  return __atomic_load_n(p, __ATOMIC_RELAXED);
+}
+inline void a_store32(int32_t* p, int32_t v) {
+  __atomic_store_n(p, v, __ATOMIC_RELAXED);
+}
+// float rows move as relaxed 32-bit words (seqlock provides the ordering).
+inline void row_copy_in(float* dst_shm, const float* src, int64_t n) {
+  uint32_t* d = reinterpret_cast<uint32_t*>(dst_shm);
+  const uint32_t* s = reinterpret_cast<const uint32_t*>(src);
+  for (int64_t i = 0; i < n; ++i)
+    __atomic_store_n(d + i, s[i], __ATOMIC_RELAXED);
+}
+inline void row_copy_out(float* dst, const float* src_shm, int64_t n) {
+  uint32_t* d = reinterpret_cast<uint32_t*>(dst);
+  const uint32_t* s = reinterpret_cast<const uint32_t*>(src_shm);
+  for (int64_t i = 0; i < n; ++i)
+    d[i] = __atomic_load_n(s + i, __ATOMIC_RELAXED);
+}
+
+struct ShmLayout {
+  ShmHeader* h;
+  int64_t* slot_id;
+  int32_t* slot_row;
+  float* rows;
+};
+
+inline size_t shm_bytes(int64_t dim, int64_t capacity, int64_t nslots) {
+  return sizeof(ShmHeader) + static_cast<size_t>(nslots) * 12 +
+         static_cast<size_t>(capacity) * dim * sizeof(float);
+}
+
+inline ShmLayout shm_layout(void* base) {
+  ShmLayout l;
+  l.h = static_cast<ShmHeader*>(base);
+  char* p = static_cast<char*>(base) + sizeof(ShmHeader);
+  l.slot_id = reinterpret_cast<int64_t*>(p);
+  p += static_cast<size_t>(l.h->nslots) * sizeof(int64_t);
+  l.slot_row = reinterpret_cast<int32_t*>(p);
+  p += static_cast<size_t>(l.h->nslots) * sizeof(int32_t);
+  l.rows = reinterpret_cast<float*>(p);
+  return l;
+}
+
+// Writer-side view. All mutations run under the owning store's shm mutex,
+// so the seqlock only has ONE writer at a time by construction.
+class ShmMirror {
+ public:
+  ShmMirror(const std::string& name, uint64_t nonce, int64_t dim,
+            int64_t capacity, uint64_t seed, float init_std)
+      : name_(name), dim_(dim), capacity_(capacity) {
+    nslots_ = 64;
+    while (nslots_ < 2 * capacity) nslots_ *= 2;
+    size_t bytes = shm_bytes(dim, capacity, nslots_);
+    shm_unlink(name.c_str());  // stale leftover from a crashed predecessor
+    int fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return;
+    if (ftruncate(fd, static_cast<off_t>(bytes)) != 0) {
+      close(fd);
+      shm_unlink(name.c_str());
+      return;
+    }
+    base_ = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      shm_unlink(name.c_str());
+      return;
+    }
+    bytes_ = bytes;
+    ShmHeader* h = static_cast<ShmHeader*>(base_);
+    h->nonce = nonce;
+    h->seq = 0;
+    h->push_version = 0;
+    h->dim = dim;
+    h->capacity_rows = capacity;
+    h->nslots = nslots_;
+    h->nrows = 0;
+    h->seed = seed;
+    h->init_std = init_std;
+    h->valid = 1;
+    l_ = shm_layout(base_);
+    // ftruncate zero-fills, but 0 is a VALID row index: free slots are
+    // marked -1 in slot_row, so the whole index must be initialised.
+    std::memset(l_.slot_row, 0xff,
+                static_cast<size_t>(nslots_) * sizeof(int32_t));
+    // magic LAST with release: a concurrent opener either sees no magic
+    // (open fails, falls back to the wire) or a fully-initialised header.
+    a_store(&h->magic, kShmMagic);
+    live_ = true;
+  }
+
+  ~ShmMirror() {
+    Revoke();
+    if (base_ != nullptr) {
+      munmap(base_, bytes_);
+      base_ = nullptr;
+    }
+  }
+
+  bool ok() const { return live_; }
+
+  void Revoke() {
+    if (base_ != nullptr && live_) {
+      a_store(&l_.h->valid, 0);
+      shm_unlink(name_.c_str());
+      live_ = false;
+    }
+  }
+
+  void SetVersion(uint64_t v) {
+    if (live_) a_store(&l_.h->push_version, v);
+  }
+
+  // One seqlock critical section for a whole batch of row upserts.
+  // Returns false (and revokes) on overflow — the caller stops mirroring.
+  bool WriteBatch(const int64_t* ids, const float* rows, int64_t n,
+                  int64_t stride) {
+    if (!live_) return false;
+    ShmHeader* h = l_.h;
+    __atomic_fetch_add(&h->seq, 1, __ATOMIC_ACQ_REL);  // odd: writing
+    bool fit = true;
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t row = FindOrInsert(ids[i]);
+      if (row < 0) {
+        fit = false;
+        break;
+      }
+      row_copy_in(l_.rows + static_cast<size_t>(row) * dim_,
+                  rows + i * stride, dim_);
+    }
+    __atomic_fetch_add(&h->seq, 1, __ATOMIC_ACQ_REL);  // even: consistent
+    if (!fit) Revoke();
+    return fit;
+  }
+
+ private:
+  int32_t FindOrInsert(int64_t id) {
+    const uint64_t mask = static_cast<uint64_t>(nslots_ - 1);
+    uint64_t slot = splitmix64(static_cast<uint64_t>(id)) & mask;
+    for (int64_t probes = 0; probes < nslots_; ++probes) {
+      int32_t row = a_load32(l_.slot_row + slot);
+      if (row >= 0) {
+        if (a_load64(l_.slot_id + slot) == id) return row;
+        slot = (slot + 1) & mask;
+        continue;
+      }
+      // free slot: claim it (single writer — no CAS needed)
+      int64_t nrows = l_.h->nrows;
+      if (nrows >= capacity_) return -1;
+      a_store64(l_.slot_id + slot, id);
+      a_store32(l_.slot_row + slot, static_cast<int32_t>(nrows));
+      l_.h->nrows = nrows + 1;
+      return static_cast<int32_t>(nrows);
+    }
+    return -1;
+  }
+
+  std::string name_;
+  int64_t dim_;
+  int64_t capacity_;
+  int64_t nslots_ = 0;
+  void* base_ = nullptr;
+  size_t bytes_ = 0;
+  ShmLayout l_{};
+  bool live_ = false;
+};
+
+// Reader-side view (the co-located CLIENT process): read-only mapping,
+// seqlock-validated gathers, bounded retry.
+class ShmReaderView {
+ public:
+  static ShmReaderView* Open(const char* name, uint64_t expect_nonce) {
+    int fd = shm_open(name, O_RDONLY, 0);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size <
+        static_cast<off_t>(sizeof(ShmHeader))) {
+      close(fd);
+      return nullptr;
+    }
+    void* base = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                      MAP_SHARED, fd, 0);
+    close(fd);
+    if (base == MAP_FAILED) return nullptr;
+    const ShmHeader* h = static_cast<const ShmHeader*>(base);
+    if (a_load(const_cast<uint64_t*>(&h->magic)) != kShmMagic ||
+        (expect_nonce != 0 && h->nonce != expect_nonce) ||
+        shm_bytes(h->dim, h->capacity_rows, h->nslots) >
+            static_cast<size_t>(st.st_size)) {
+      munmap(base, static_cast<size_t>(st.st_size));
+      return nullptr;
+    }
+    ShmReaderView* r = new ShmReaderView();
+    r->base_ = base;
+    r->bytes_ = static_cast<size_t>(st.st_size);
+    r->l_ = shm_layout(base);
+    return r;
+  }
+
+  ~ShmReaderView() {
+    if (base_ != nullptr) munmap(const_cast<void*>(base_), bytes_);
+  }
+
+  int64_t dim() const { return l_.h->dim; }
+  uint64_t seed() const { return l_.h->seed; }
+  float init_std() const { return l_.h->init_std; }
+  uint64_t nonce() const { return l_.h->nonce; }
+
+  // Gather rows for `ids` into `out` ([n, dim]); found[i] = 1 when the id
+  // is mirrored, 0 when absent (caller materialises the deterministic
+  // lazy init — identical bits to what the server would answer).
+  // *version_out = the table push-version the gather is consistent at
+  // (read INSIDE the seqlock window, so it can only be too old — the
+  // safe direction for the caching contract). Returns the found count,
+  // -1 on persistent seqlock contention, -2 when the segment is revoked.
+  int64_t Gather(const int64_t* ids, int64_t n, float* out, uint8_t* found,
+                 uint64_t* version_out) {
+    const ShmHeader* h = l_.h;
+    uint64_t* seq_p = const_cast<uint64_t*>(&h->seq);
+    for (int attempt = 0; attempt < 16; ++attempt) {
+      uint64_t s1 = a_load(seq_p);
+      if (s1 & 1) continue;  // mutation in progress
+      if (a_load(const_cast<uint64_t*>(&h->valid)) != 1) return -2;
+      uint64_t version = a_load(const_cast<uint64_t*>(&h->push_version));
+      int64_t nfound = 0;
+      const uint64_t mask = static_cast<uint64_t>(h->nslots - 1);
+      for (int64_t i = 0; i < n; ++i) {
+        int32_t row = -1;
+        uint64_t slot =
+            splitmix64(static_cast<uint64_t>(ids[i])) & mask;
+        for (int64_t probes = 0; probes < h->nslots; ++probes) {
+          int32_t r = a_load32(l_.slot_row + slot);
+          if (r < 0) break;  // free slot terminates the probe chain
+          if (a_load64(l_.slot_id + slot) == ids[i]) {
+            row = r;
+            break;
+          }
+          slot = (slot + 1) & mask;
+        }
+        if (row >= 0) {
+          row_copy_out(out + i * h->dim,
+                       l_.rows + static_cast<size_t>(row) * h->dim,
+                       h->dim);
+          found[i] = 1;
+          ++nfound;
+        } else {
+          found[i] = 0;
+        }
+      }
+      __atomic_thread_fence(__ATOMIC_ACQUIRE);
+      if (a_load(seq_p) == s1) {
+        if (version_out != nullptr) *version_out = version;
+        return nfound;
+      }
+    }
+    return -1;
+  }
+
+ private:
+  const void* base_ = nullptr;
+  size_t bytes_ = 0;
+  ShmLayout l_{};
+};
 
 struct Stripe {
   std::mutex mu;
@@ -108,13 +435,24 @@ class EmbeddingStore {
         for (int d = 0; d < dim_; ++d) dst[d] += src[d];
       }
     }
+    // shm write-through: post-update value rows are copied to scratch
+    // INSIDE the stripe lock (consistent row bytes) and mirrored in one
+    // seqlock critical section after the optimizer loop.
+    const bool mirror = mirror_on_.load(std::memory_order_acquire);
+    std::vector<float> mrows;
+    if (mirror) mrows.resize(uniq.size() * static_cast<size_t>(dim_));
     for (size_t u = 0; u < uniq.size(); ++u) {
       Stripe& s = stripes_[stripe_of(uniq[u])];
       std::lock_guard<std::mutex> lock(s.mu);
       float* row = FindOrInit(&s, uniq[u]);
       const float* g = acc.data() + u * dim_;
       ApplyUpdate(row, g, scale);
+      if (mirror)
+        std::memcpy(mrows.data() + u * dim_, row, sizeof(float) * dim_);
     }
+    if (mirror)
+      MirrorBatch(uniq.data(), mrows.data(),
+                  static_cast<int64_t>(uniq.size()), dim_);
   }
 
   int64_t Size() {
@@ -178,6 +516,54 @@ class EmbeddingStore {
       float* row = FindOrAlloc(&s, ids[i]);
       std::memcpy(row, rows + i * row_width_, sizeof(float) * row_width_);
     }
+    if (mirror_on_.load(std::memory_order_acquire))
+      MirrorBatch(ids, rows, n, row_width_);  // value half of each row
+  }
+
+  // ------------------------------------------------------------ shm export
+  // Publish a named seqlock-guarded mirror of this table's VALUE rows.
+  // Point-in-time under the exclusive barrier (mutators drained), then
+  // pushes/imports write through. Returns 0 on success.
+  int ShmExport(const char* name, uint64_t nonce, int64_t capacity_rows) {
+    ExclusiveBarrier snap(this);
+    std::lock_guard<std::mutex> lk(shm_mu_);
+    if (shm_) return -1;  // one export per store
+    shm_.reset(new ShmMirror(name, nonce, dim_, capacity_rows, seed_,
+                             init_std_));
+    if (!shm_->ok()) {
+      shm_.reset();
+      return -1;
+    }
+    std::vector<int64_t> sids;
+    std::vector<float> srows;
+    for (auto& s : stripes_) {
+      sids.clear();
+      srows.clear();
+      for (const auto& kv : s.index) {
+        sids.push_back(kv.first);
+        const float* row = s.arena.data() + kv.second;
+        srows.insert(srows.end(), row, row + dim_);
+      }
+      if (!sids.empty() &&
+          !shm_->WriteBatch(sids.data(), srows.data(),
+                            static_cast<int64_t>(sids.size()), dim_)) {
+        shm_.reset();  // capacity too small for the existing table
+        return -1;
+      }
+    }
+    mirror_on_.store(true, std::memory_order_release);
+    return 0;
+  }
+
+  void ShmSetVersion(uint64_t v) {
+    std::lock_guard<std::mutex> lk(shm_mu_);
+    if (shm_) shm_->SetVersion(v);
+  }
+
+  void ShmRevoke() {
+    mirror_on_.store(false, std::memory_order_release);
+    std::lock_guard<std::mutex> lk(shm_mu_);
+    if (shm_) shm_->Revoke();
   }
 
  private:
@@ -265,9 +651,20 @@ class EmbeddingStore {
     EmbeddingStore* s_;
   };
 
+  void MirrorBatch(const int64_t* ids, const float* rows, int64_t n,
+                   int64_t stride) {
+    std::lock_guard<std::mutex> lk(shm_mu_);
+    if (!shm_) return;
+    if (!shm_->WriteBatch(ids, rows, n, stride))
+      mirror_on_.store(false, std::memory_order_release);  // revoked
+  }
+
   const int row_width_;
   std::shared_mutex snapshot_mu_;
   std::mutex export_gate_;
+  std::mutex shm_mu_;
+  std::unique_ptr<ShmMirror> shm_;
+  std::atomic<bool> mirror_on_{false};
   Stripe stripes_[kNumStripes];
 };
 
@@ -310,6 +707,47 @@ int64_t eds_export_snapshot(void* h, int64_t* ids_out, float* rows_out,
 
 void eds_import(void* h, const int64_t* ids, const float* rows, int64_t n) {
   static_cast<EmbeddingStore*>(h)->Import(ids, rows, n);
+}
+
+// ------------------------------------------------------- shm entry points
+// Server side (store handle): export / version write-through / revoke.
+int eds_shm_export(void* h, const char* name, uint64_t nonce,
+                   int64_t capacity_rows) {
+  return static_cast<EmbeddingStore*>(h)->ShmExport(name, nonce,
+                                                    capacity_rows);
+}
+
+void eds_shm_set_version(void* h, uint64_t version) {
+  static_cast<EmbeddingStore*>(h)->ShmSetVersion(version);
+}
+
+void eds_shm_revoke(void* h) {
+  static_cast<EmbeddingStore*>(h)->ShmRevoke();
+}
+
+// Client side (reader handle over the mapped segment, no store needed).
+void* eds_shm_open(const char* name, uint64_t expect_nonce) {
+  return ShmReaderView::Open(name, expect_nonce);
+}
+
+void eds_shm_close(void* r) { delete static_cast<ShmReaderView*>(r); }
+
+int64_t eds_shm_reader_dim(void* r) {
+  return static_cast<ShmReaderView*>(r)->dim();
+}
+
+void eds_shm_reader_meta(void* r, uint64_t* seed, float* init_std,
+                         uint64_t* nonce) {
+  ShmReaderView* v = static_cast<ShmReaderView*>(r);
+  if (seed != nullptr) *seed = v->seed();
+  if (init_std != nullptr) *init_std = v->init_std();
+  if (nonce != nullptr) *nonce = v->nonce();
+}
+
+int64_t eds_shm_gather(void* r, const int64_t* ids, int64_t n, float* out,
+                       uint8_t* found, uint64_t* version_out) {
+  return static_cast<ShmReaderView*>(r)->Gather(ids, n, out, found,
+                                                version_out);
 }
 
 }  // extern "C"

@@ -54,6 +54,36 @@ void exporter(void* store, std::atomic<bool>* stop) {
 
 }  // namespace
 
+// Shared-memory mirror stress: many pushers mutate overlapping ids (the
+// write-through path bumps the seqlock) while reader threads gather the
+// same ids through eds_shm_open/eds_shm_gather — the concurrent surface
+// the zero-copy pull transport exposes. Asserts: every successful gather
+// is seqlock-consistent (found rows match SOME committed state — spot-
+// checked via a quiesced final compare), contention/revocation surface as
+// the documented sentinels, and nothing TSan/ASan-visible races.
+void shm_reader(const char* name, std::atomic<bool>* stop,
+                std::atomic<int64_t>* gathers) {
+  void* r = nullptr;
+  while (r == nullptr && !stop->load()) r = eds_shm_open(name, 0);
+  std::vector<int64_t> ids(48);
+  std::vector<float> out(ids.size() * kDim);
+  std::vector<uint8_t> found(ids.size());
+  uint64_t rng = 0x5eed;
+  uint64_t version = 0;
+  while (!stop->load()) {
+    for (auto& id : ids) {
+      rng = splitmix64(rng);
+      id = static_cast<int64_t>(rng % kIds);
+    }
+    int64_t n = eds_shm_gather(r, ids.data(),
+                               static_cast<int64_t>(ids.size()), out.data(),
+                               found.data(), &version);
+    if (n >= 0) gathers->fetch_add(1);
+    assert(n >= -2);
+  }
+  eds_shm_close(r);
+}
+
 int main() {
   void* store = eds_create(kDim, 0.01f, 7, /*adagrad=*/1, 0.05f, 1e-8f);
   std::atomic<bool> stop{false};
@@ -67,7 +97,62 @@ int main() {
   threads[0].join();
   const int64_t rows = eds_size(store);
   assert(rows > 0 && rows <= kIds);
-  std::printf("stress OK: %lld rows\n", static_cast<long long>(rows));
+
+  // ---- phase 2: push vs shm-gather under the seqlock ----
+  const char* kSeg = "/eds-stress-shm";
+  assert(eds_shm_export(store, kSeg, /*nonce=*/0xabcdef, kIds * 2) == 0);
+  stop.store(false);
+  std::atomic<int64_t> gathers{0};
+  std::vector<std::thread> phase2;
+  for (int t = 0; t < 3; ++t) {
+    phase2.emplace_back(shm_reader, kSeg, &stop, &gathers);
+  }
+  for (int t = 0; t < kThreads; ++t) {
+    phase2.emplace_back(worker, store, 100 + t, &stop);
+  }
+  for (size_t t = phase2.size() - kThreads; t < phase2.size(); ++t) {
+    phase2[t].join();  // pushers run their kIters then exit
+  }
+  stop.store(true);
+  for (int t = 0; t < 3; ++t) phase2[t].join();
+  assert(gathers.load() > 0);
+
+  // quiesced consistency: a post-storm gather must match eds_pull bitwise
+  {
+    void* r = eds_shm_open(kSeg, 0xabcdef);
+    assert(r != nullptr);
+    std::vector<int64_t> ids(kIds);
+    for (int64_t i = 0; i < kIds; ++i) ids[i] = i;
+    std::vector<float> via_shm(kIds * kDim), direct(kIds * kDim);
+    std::vector<uint8_t> found(kIds);
+    uint64_t version = 0;
+    int64_t n = eds_shm_gather(r, ids.data(), kIds, via_shm.data(),
+                               found.data(), &version);
+    assert(n >= 0);
+    eds_pull(store, ids.data(), kIds, direct.data());
+    for (int64_t i = 0; i < kIds; ++i) {
+      if (!found[i]) continue;  // never pushed: mirror has no row
+      assert(std::memcmp(via_shm.data() + i * kDim,
+                         direct.data() + i * kDim,
+                         sizeof(float) * kDim) == 0);
+    }
+    eds_shm_close(r);
+  }
+
+  // revocation: destroy unlinks + invalidates; a held reader sees -2
+  void* r = eds_shm_open(kSeg, 0xabcdef);
+  assert(r != nullptr);
+  std::printf("stress OK: %lld rows, %lld shm gathers\n",
+              static_cast<long long>(rows),
+              static_cast<long long>(gathers.load()));
   eds_destroy(store);
+  {
+    int64_t id = 1;
+    float out[kDim];
+    uint8_t found1;
+    uint64_t version = 0;
+    assert(eds_shm_gather(r, &id, 1, out, &found1, &version) == -2);
+  }
+  eds_shm_close(r);
   return 0;
 }

@@ -1,0 +1,77 @@
+"""Per-row symmetric int8 quantization for embedding pulls.
+
+The third rung of the pull-payload negotiation ladder (f32 → f16 → i8,
+``PullRequest.value_dtype``): a serving replica that opted in via
+``EASYDL_PS_PULL_I8`` (or the client constructor) receives each row as
+``dim`` int8 codes plus ONE float32 scale — ~0.26x the f32 wire at
+dim=16, asymptoting to 0.25x — while the trainer path keeps pulling f32
+untouched (quantized reads are a SERVING trade: scores tolerate ~1/254
+relative row error, optimizer math does not).
+
+One deterministic codec, used by BOTH the server encode (ps/server.py
+Pull) and the client decode (ps/client.py) and shared with the tests and
+benches that pin its error bound: for a row ``r``,
+
+    scale = max(|r|) / 127          (0 -> scale 1.0: an all-zero row
+                                     quantizes to zeros exactly)
+    q     = clip(rint(r / scale), -127, 127)   int8
+    r'    = q * scale
+
+so ``|r' - r| <= scale / 2 = max(|r|) / 254`` element-wise — the pinned
+bound — and the decode is a pure function of the wire bytes: the same
+(codes, scales) payload dequantizes bit-identically everywhere, which is
+what lets the stale-read checks compare an i8 read against a local
+re-quantization of a fresh f32 pull EXACTLY, not within a tolerance.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+#: PullRequest.value_dtype / PullResponse.dtype token for this codec.
+I8 = "i8"
+
+#: Element-wise dequantization error bound as a fraction of the row's
+#: max-abs value: |dequant - original| <= row_max_abs * I8_ERROR_BOUND.
+I8_ERROR_BOUND = 0.5 / 127.0
+
+
+def quantize_rows(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """``(rows, dim) float32 -> (int8 codes, float32 per-row scales)``."""
+    values = np.asarray(values, np.float32)
+    if values.ndim != 2:
+        raise ValueError(f"quantize_rows wants (rows, dim), got "
+                         f"{values.shape}")
+    scales = np.max(np.abs(values), axis=1) / np.float32(127.0)
+    # All-zero rows: any scale reproduces them exactly; 1.0 avoids the
+    # divide and keeps the scale finite for the client's multiply.
+    scales = np.where(scales > 0, scales, np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.rint(values / scales[:, None]), -127, 127).astype(np.int8)
+    return q, scales
+
+
+def dequantize_rows(codes: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`quantize_rows` — pure function of the wire bytes."""
+    codes = np.asarray(codes, np.int8)
+    scales = np.asarray(scales, np.float32)
+    return codes.astype(np.float32) * scales[:, None]
+
+
+def encode_payload(values: np.ndarray) -> Tuple[bytes, bytes]:
+    """Server-side encode: ``(values bytes, row_scales bytes)`` for the
+    ``dtype="i8"`` PullResponse."""
+    q, scales = quantize_rows(values)
+    return q.tobytes(), scales.astype("<f4").tobytes()
+
+
+def decode_payload(values: bytes, row_scales: bytes, dim: int) -> np.ndarray:
+    """Client-side decode of a ``dtype="i8"`` response -> (rows, dim) f32."""
+    codes = np.frombuffer(values, np.int8)
+    scales = np.frombuffer(row_scales, "<f4")
+    if dim <= 0 or len(codes) != len(scales) * dim:
+        raise ValueError(
+            f"i8 payload shape mismatch: {len(codes)} codes, "
+            f"{len(scales)} scales, dim {dim}")
+    return dequantize_rows(codes.reshape(len(scales), dim), scales)

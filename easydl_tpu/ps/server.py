@@ -29,12 +29,13 @@ import numpy as np
 
 from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps import quant as _quant
 from easydl_tpu.ps import wal as _wal
 from easydl_tpu.ps.table import EmbeddingTable, TableSpec, shard_of
 from easydl_tpu.utils.env import env_flag as _env_flag
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, ServiceDef, serve
-from easydl_tpu.utils.env import knob_float
+from easydl_tpu.utils.env import knob_float, knob_int
 from easydl_tpu.obs.errors import count_swallowed
 
 log = get_logger("ps", "server")
@@ -93,6 +94,10 @@ STALE_ROUTE = "stale-route"
 #: paused-then-resumed process has always exceeded this by wakeup time, so
 #: its first post-resume push triggers the check before anything is applied.
 ENV_FENCE_CHECK_S = "EASYDL_PS_FENCE_CHECK_S"
+
+#: Arms the zero-copy shared-memory pull transport (native store mirrors
+#: each table into a named shm segment, advertised on every PullResponse).
+ENV_SHM = "EASYDL_PS_SHM"
 
 
 def request_ids(req) -> np.ndarray:
@@ -316,6 +321,19 @@ class PsShard:
                 t = EmbeddingTable(spec, backend=self._backend,
                                    version_base=max(self.epoch, 0) << 32)
                 self._tables[spec.name] = t
+            if _env_flag(ENV_SHM, False):
+                # Arm the zero-copy mirror (native backend only —
+                # shm_export is a no-op on numpy). Never load-bearing: a
+                # failed export just means every client stays on the
+                # wire, so it must not fail table creation.
+                try:
+                    if t.shm_export(
+                            knob_int("EASYDL_PS_SHM_MAX_MB") << 20):
+                        log.info("ps shard %d: table %r mirrored to shm "
+                                 "segment %s", self.shard_index, spec.name,
+                                 t.shm_info()[0])
+                except Exception as e:
+                    count_swallowed("ps.server.shm_export", e)
             if self._wal is not None and not self._replaying:
                 self._wal.append(_wal.encode_create(_spec_json(spec)))
             return t
@@ -470,6 +488,11 @@ class PsShard:
             self._reshard_active = True
             while self._inflight_pushes > 0:
                 self._drain_cv.wait(timeout=0.1)
+        # The shm mirrors go with the pushes: a co-located reader must not
+        # keep gathering rows the new shard set is already updating. The
+        # revoked gather falls back to the wire, which answers the
+        # retriable stale-route the routing rebuild keys on.
+        self._shm_revoke_all()
         if self._wal is not None:
             with self._wal_mu:
                 self._wal.sync()
@@ -699,8 +722,13 @@ class PsShard:
                 spec = TableSpec(**json.loads(str(z["spec"])))
             # Drop any warm in-memory table first: rows touched after the
             # checkpoint must re-init lazily, identically to a fresh shard.
+            # Its shm mirror is revoked EXPLICITLY (not left to GC): a
+            # co-located reader must re-negotiate onto the restored
+            # table's fresh segment, never gather pre-restore rows.
             with self._lock:
-                self._tables.pop(name, None)
+                old = self._tables.pop(name, None)
+            if old is not None:
+                old.shm_revoke()
             t = self.create_table(spec)
             for path in paths:
                 with np.load(path) as z:
@@ -765,9 +793,18 @@ class PsShard:
         return stats
 
     # -------------------------------------------------------------- fencing
+    def _shm_revoke_all(self) -> None:
+        with self._lock:
+            tables = list(self._tables.values())
+        for t in tables:
+            t.shm_revoke()
+
     def _fence(self, why: str) -> None:
         if not self._fenced:
             self._fenced = True
+            # A fenced zombie's rows freeze while pushes land on the
+            # rescuer — its shm mirrors must die with its right to serve.
+            self._shm_revoke_all()
             log.warning("ps shard %d (epoch %d) FENCED: %s — all further "
                         "pushes rejected retriably", self.shard_index,
                         self.epoch, why)
@@ -850,17 +887,33 @@ class PsShard:
         version = t.push_version
         ids = request_ids(req)
         values = t.pull(ids)
+        scales = b""
         if req.value_dtype == "f16":
             # Opt-in half-precision response (EASYDL_PS_PULL_FP16 on the
             # client): halves pull bytes; the client re-widens to float32.
             payload, dtype = values.astype("<f2").tobytes(), "f16"
+        elif req.value_dtype == _quant.I8:
+            # Opt-in int8 response (EASYDL_PS_PULL_I8 on the client):
+            # per-row symmetric quantization, ~0.25x the f32 wire. A
+            # legacy server never reaches this branch (unknown dtypes fall
+            # through to f32 below), which is exactly the negotiation: the
+            # client decodes whatever dtype the response declares.
+            payload, scales = _quant.encode_payload(values)
+            dtype = _quant.I8
         else:
             payload, dtype = values.astype("<f4", copy=False).tobytes(), "f32"
         # dtype is ALWAYS set: besides naming the encoding it is the
         # capability signal that lets new clients drop the duplicate legacy
         # ids list from every later request to this shard.
         resp = pb.PullResponse(values=payload, dim=t.dim, dtype=dtype,
-                               version=version)
+                               version=version, row_scales=scales)
+        seg = t.shm_info()
+        if seg is not None:
+            # Advertise the shm mirror on every response (probe pulls
+            # included): a co-located client opens the segment and moves
+            # its reads off gRPC entirely; a remote one fails shm_open and
+            # stays on this wire. ~40 bytes per response when armed.
+            resp.shm_segment, resp.shm_nonce = seg
         self._m_pulls.inc(len(ids), shard=self._shard_label, table=req.table)
         self._m_pull_bytes.inc(req.ByteSize() + resp.ByteSize(),
                                shard=self._shard_label, table=req.table)
@@ -1094,6 +1147,15 @@ class PsShard:
         from easydl_tpu.chaos import banner as chaos_banner
 
         chaos_banner(obs_name or f"ps-{self.shard_index}")
+        if _env_flag(ENV_SHM, False):
+            # Startup sweep: a SIGKILLed predecessor could not unlink its
+            # mirror segments; dead-pid leftovers are held RAM.
+            from easydl_tpu.ps import shm as _shm
+
+            n = _shm.sweep_stale_segments()
+            if n:
+                log.info("ps shard %d swept %d stale shm segment(s)",
+                         self.shard_index, n)
         self._server = serve(PS_SERVICE, self, port=port,
                              options=GRPC_MSG_OPTIONS)
         self._exporter = start_exporter(
@@ -1115,6 +1177,7 @@ class PsShard:
         return self._server
 
     def stop(self) -> None:
+        self._shm_revoke_all()  # unlink segments; readers see `revoked`
         if self._server is not None:
             self._server.stop()
             self._server = None

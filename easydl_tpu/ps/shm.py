@@ -1,0 +1,175 @@
+"""Client side of the zero-copy shared-memory pull transport.
+
+A PS shard whose native store exported a shm mirror advertises the
+segment's ``(name, nonce)`` on every ``PullResponse`` (the same additive
+capability-handshake shape as the raw-ids negotiation, architecture.md
+§6). A client that can ``shm_open`` the name AND sees the nonce in the
+mapped header is by construction co-located with the shard — this module
+is what it then pulls through: rows gather straight out of the mapping
+(``eds_shm_gather``, seqlock-validated against concurrent pushes), ids
+absent from the mirror materialise via the deterministic lazy init
+(:func:`easydl_tpu.ps.table.init_rows` — bit-identical to what the shard
+would answer), and the header's table push-version rides back exactly
+like ``PullResponse.version`` would. No gRPC, no proto, no serialization
+on the read hot path.
+
+Fallback is the contract, not the exception: a remote shard (open
+fails), a revoked segment (restore/overflow/shutdown), persistent
+seqlock contention, or a missing native toolchain all surface as
+``None``/:class:`ShmUnavailable` and the caller silently returns to the
+wire — correctness never depends on the mirror existing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from easydl_tpu.obs.errors import count_swallowed
+from easydl_tpu.ps import build as _build
+from easydl_tpu.ps.table import init_rows
+
+
+class ShmUnavailable(Exception):
+    """The segment cannot serve this gather; fall back to the wire.
+    ``revoked`` distinguishes a dead segment (drop the reader, re-open
+    only on a fresh advertisement) from transient seqlock contention
+    (the reader stays usable)."""
+
+    def __init__(self, reason: str, revoked: bool):
+        super().__init__(reason)
+        self.reason = reason
+        self.revoked = revoked
+
+
+class ShmReader:
+    """One mapped (shard, table) mirror segment, read-only.
+
+    ``close()`` is pin-counted against in-flight :meth:`pull` calls: the
+    client's reset paths (reroute, routing rebuild, revocation) may close
+    a reader WHILE another thread is mid-gather, and an immediate munmap
+    would turn that gather into a use-after-free segfault — so close only
+    marks the reader dead, and the LAST in-flight pull performs the real
+    unmap. New pulls after close fail ``revoked`` (the silent-fallback
+    class)."""
+
+    def __init__(self, lib: ctypes.CDLL, handle: int, name: str,
+                 nonce: int):
+        self._lib = lib
+        self._h = handle
+        self._mu = threading.Lock()
+        self._pins = 0
+        self._closed = False
+        self.name = name
+        self.nonce = nonce
+        self.dim = int(lib.eds_shm_reader_dim(handle))
+        seed = np.zeros(1, np.uint64)
+        std = ctypes.c_float(0.0)
+        lib.eds_shm_reader_meta(
+            handle, seed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            ctypes.byref(std), None)
+        self.seed = int(seed[0])
+        self.init_std = float(std.value)
+
+    def _release(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._lib.eds_shm_close(h)
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+            if self._pins:
+                return  # the last in-flight pull unmaps
+            self._release()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception as e:  # interpreter teardown: lib may be gone
+            count_swallowed("ps.shm.reader_del", e)
+
+    def pull(self, ids: np.ndarray) -> Tuple[np.ndarray, int]:
+        """``ids (n,) int64 -> ((n, dim) float32, push_version)``.
+
+        Mirrored rows copy out under the seqlock; absent rows ARE the
+        deterministic lazy init (an id never pushed/imported has exactly
+        that value on the shard too). Raises :class:`ShmUnavailable` on a
+        revoked segment or persistent write contention."""
+        with self._mu:
+            if self._closed or not self._h:
+                raise ShmUnavailable("reader closed", revoked=True)
+            self._pins += 1
+        try:
+            return self._pull_pinned(ids)
+        finally:
+            with self._mu:
+                self._pins -= 1
+                if self._closed and self._pins == 0:
+                    self._release()
+
+    def _pull_pinned(self, ids: np.ndarray) -> Tuple[np.ndarray, int]:
+        ids = np.ascontiguousarray(ids, np.int64)
+        n = len(ids)
+        out = np.empty((n, self.dim), np.float32)
+        found = np.empty(n, np.uint8)
+        version = np.zeros(1, np.uint64)
+        rc = self._lib.eds_shm_gather(
+            self._h,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            version.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        if rc == -2:
+            raise ShmUnavailable("segment revoked", revoked=True)
+        if rc < 0:
+            raise ShmUnavailable("seqlock contention", revoked=False)
+        if rc < n:
+            miss = found == 0
+            out[miss] = init_rows(ids[miss], self.dim, self.dim,
+                                  self.seed, self.init_std)[:, :self.dim]
+        return out, int(version[0])
+
+
+def sweep_stale_segments(root: str = "/dev/shm") -> int:
+    """Unlink ``eds-<pid>-*`` segments whose owning pid is gone — a
+    SIGKILLed shard cannot unlink its own mirror, and leaked segments
+    are held RAM. Called at shard startup when the transport is armed
+    (the same dead-pid sweep discipline as the registry and the obs
+    exporter discovery files). Returns the number removed."""
+    import re
+
+    removed = 0
+    if not os.path.isdir(root):
+        return 0
+    for name in os.listdir(root):
+        m = re.fullmatch(r"eds-(\d+)-[0-9a-f]+", name)
+        if not m:
+            continue
+        try:
+            os.kill(int(m.group(1)), 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(os.path.join(root, name))
+                removed += 1
+            except OSError:
+                continue
+        except OSError:
+            continue
+    return removed
+
+
+def open_reader(name: str, nonce: int) -> Optional[ShmReader]:
+    """Map an advertised segment; None when it cannot serve (remote host,
+    revoked, nonce mismatch, no native lib) — the caller stays on gRPC."""
+    lib = _build.load_native()
+    if lib is None or not name:
+        return None
+    handle = lib.eds_shm_open(name.encode(), ctypes.c_uint64(nonce))
+    if not handle:
+        return None
+    return ShmReader(lib, handle, name, int(nonce))

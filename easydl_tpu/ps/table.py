@@ -19,7 +19,7 @@ import ctypes
 import os
 import threading
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -51,6 +51,28 @@ def shard_of(ids: np.ndarray, num_shards: int) -> np.ndarray:
     """Which PS shard owns each id. Hash-based (not modulo on the raw id) so
     skewed id spaces still balance."""
     return (splitmix64(ids) % np.uint64(num_shards)).astype(np.int64)
+
+
+def init_rows(ids: np.ndarray, dim: int, row_width: int, seed: int,
+              init_std: float) -> np.ndarray:
+    """The deterministic lazy row init, as a pure function of (id, spec):
+    identical bits to the C++ store's InitRow and to what any shard would
+    materialise for an untouched id. Shared by the numpy store AND the
+    shared-memory pull client (ps/shm.py), which computes rows absent from
+    a shard's shm mirror locally instead of paying a per-miss RPC — an id
+    missing from the mirror has never been pushed/imported, so its value
+    IS this init."""
+    ids = np.asarray(ids, np.int64)
+    base = splitmix64(np.uint64(seed) ^ ids.astype(np.uint64))
+    with np.errstate(over="ignore"):
+        bits = splitmix64(
+            base[:, None] + np.arange(dim, dtype=np.uint64)[None, :]
+        )
+    u = (bits >> np.uint64(40)).astype(np.float32) * _U24
+    a = np.float32(init_std) * _SQRT3
+    rows = np.zeros((len(ids), row_width), np.float32)
+    rows[:, :dim] = (np.float32(2.0) * u - np.float32(1.0)) * a
+    return rows
 
 
 @dataclass(frozen=True)
@@ -102,17 +124,8 @@ class _NumpyStore:
     def _init_rows(self, ids: np.ndarray) -> np.ndarray:
         """Vectorized lazy init for a batch of ids — identical bits to the
         old one-id-at-a-time loop (same splitmix64 stream per id)."""
-        dim = self.spec.dim
-        base = splitmix64(np.uint64(self.spec.seed) ^ ids.astype(np.uint64))
-        with np.errstate(over="ignore"):
-            bits = splitmix64(
-                base[:, None] + np.arange(dim, dtype=np.uint64)[None, :]
-            )
-        u = (bits >> np.uint64(40)).astype(np.float32) * _U24
-        a = np.float32(self.spec.init_std) * _SQRT3
-        rows = np.zeros((len(ids), self.spec.row_width), np.float32)
-        rows[:, :dim] = (np.float32(2.0) * u - np.float32(1.0)) * a
-        return rows
+        return init_rows(ids, self.spec.dim, self.spec.row_width,
+                         self.spec.seed, self.spec.init_std)
 
     def _init_row(self, id_: int) -> np.ndarray:
         return self._init_rows(np.asarray([id_], np.int64))[0]
@@ -307,6 +320,18 @@ class _NativeStore:
         rows = np.ascontiguousarray(rows, np.float32)
         self._lib.eds_import(self._h, self._i64p(ids), self._f32p(rows), len(ids))
 
+    # ------------------------------------------------------------ shm mirror
+    def shm_export(self, name: str, nonce: int, capacity_rows: int) -> bool:
+        return self._lib.eds_shm_export(
+            self._h, name.encode(), ctypes.c_uint64(nonce),
+            int(capacity_rows)) == 0
+
+    def shm_set_version(self, version: int) -> None:
+        self._lib.eds_shm_set_version(self._h, ctypes.c_uint64(version))
+
+    def shm_revoke(self) -> None:
+        self._lib.eds_shm_revoke(self._h)
+
 
 class EmbeddingTable:
     """One named table. ``backend`` is ``"auto"`` (native if buildable),
@@ -333,6 +358,9 @@ class EmbeddingTable:
         # check would bless a stale cache entry.
         self._push_version = int(version_base) + 1
         self._version_mu = threading.Lock()
+        #: (segment name, nonce) once the native store mirrors this table
+        #: into a named shm segment (see shm_export); None otherwise.
+        self._shm: Optional[Tuple[str, int]] = None
 
     @property
     def name(self) -> str:
@@ -357,6 +385,57 @@ class EmbeddingTable:
     def _bump_version(self) -> None:
         with self._version_mu:
             self._push_version += 1
+            if self._shm is not None:
+                # Header write-through AFTER the python counter moves,
+                # inside the version lock: the mirror's advertised version
+                # is therefore always <= the version the wire would report
+                # — a shm row can never be believed FRESHER than a gRPC
+                # pull of the same instant (the safe direction: at worst a
+                # caching client spuriously revalidates).
+                self._store.shm_set_version(self._push_version)
+
+    # ------------------------------------------------------------ shm mirror
+    def shm_export(self, max_bytes: int) -> bool:
+        """Mirror this table into a named shm segment (native store only).
+        ``max_bytes`` caps the segment; a table outgrowing it revokes the
+        mirror and clients fall back to the wire. Returns True when the
+        segment is live; False (numpy backend, creation failure, already
+        exported) leaves the wire path untouched."""
+        if self.backend != "native" or self._shm is not None:
+            return False
+        # Capacity from the REAL segment layout, so max_bytes is an
+        # honest cap: header + nslots*(8+4) index (nslots = next power
+        # of two >= 2*capacity, i.e. up to 4*capacity -> 48 bytes/row
+        # worst case) + dim*4 row bytes.
+        capacity = (int(max_bytes) - 4096) // (self.spec.dim * 4 + 48)
+        if capacity <= 0:
+            return False
+        # Name + nonce minted HERE so the server can advertise them on the
+        # wire handshake. The nonce (verified inside the segment header)
+        # is what makes a same-named segment on a DIFFERENT host — or a
+        # stale predecessor's leftover — unopenable.
+        nonce = int.from_bytes(os.urandom(8), "little") | 1
+        name = f"/eds-{os.getpid()}-{nonce & 0xFFFFFFFF:08x}"
+        if not self._store.shm_export(name, nonce, capacity):
+            return False
+        with self._version_mu:
+            self._store.shm_set_version(self._push_version)
+            self._shm = (name, nonce)
+        return True
+
+    def shm_info(self) -> Optional[Tuple[str, int]]:
+        """(segment name, nonce) advertised on PullResponse, or None."""
+        return self._shm
+
+    def shm_revoke(self) -> None:
+        """Kill the mirror and stop advertising it. Every server-side
+        consistency gate routes through here: a cut-over reshard source,
+        a fenced zombie, and a restore all revoke, so a co-located reader
+        falls back to the wire — where stale-route / stale-epoch handling
+        lives — instead of gathering frozen rows forever."""
+        if self._shm is not None:
+            self._shm = None
+            self._store.shm_revoke()
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         """ids of any shape -> float32 values of shape ``ids.shape + (dim,)``."""

@@ -14,6 +14,10 @@ reimplemented.
 - :mod:`easydl_tpu.serve.frontend` — micro-batching request queue with
   deadline-based admission control, the jitted forward, the
   ``easydl.Serve`` gRPC service, and the ``easydl_serve_*`` telemetry.
+- :mod:`easydl_tpu.serve.routing` / :mod:`easydl_tpu.serve.router` —
+  the fleet layer: pure least-loaded + session-affinity dispatch policy,
+  and the router that actuates it over every discovered replica with
+  request hedging, ejection + hold-down, and fleet-wide load gauges.
 """
 
 from easydl_tpu.serve.cache import HotIdCache  # noqa: F401
@@ -23,3 +27,5 @@ from easydl_tpu.serve.frontend import (  # noqa: F401
     ServeConfig,
     ServeFrontend,
 )
+from easydl_tpu.serve.router import ServeRouter  # noqa: F401
+from easydl_tpu.serve.routing import ReplicaView, route_decision  # noqa: F401

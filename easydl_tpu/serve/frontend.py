@@ -34,6 +34,7 @@ via the PR-4 layer, no-ops unless ``EASYDL_TRACE`` is armed.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -294,6 +295,9 @@ class ServeFrontend:
         self.batches_run = 0
         self._lat_window: Deque[Tuple[float, float]] = deque()
         self._gauges_at = 0.0
+        self._qps_recent = 0.0
+        self._p99_recent = 0.0
+        self._discovery_file: Optional[str] = None
         self._cache_last: Dict[str, float] = {}
         self._runner = threading.Thread(
             target=self._run_loop, name=f"serve-batch-{name}", daemon=True)
@@ -577,6 +581,8 @@ class ServeFrontend:
             window = list(self._lat_window)
         m = _serve_metrics()
         if not window:
+            self._qps_recent = 0.0
+            self._p99_recent = 0.0
             m[10].set(0.0, replica=self.name)
             m[11].set(0.0, replica=self.name)
             return
@@ -584,8 +590,18 @@ class ServeFrontend:
         lats = sorted(l for _, l in window if l is not None)
         p99 = (lats[min(len(lats) - 1, int(0.99 * len(lats)))]
                if lats else 0.0)
-        m[10].set(len(window) / span_s, replica=self.name)
+        # Cached for the InferResponse piggyback (the router's least-
+        # loaded signal) — the gauges are recomputed at most 4×/s, the
+        # piggyback must not add a sort per answer.
+        self._qps_recent = len(window) / span_s
+        self._p99_recent = p99
+        m[10].set(self._qps_recent, replica=self.name)
         m[11].set(p99, replica=self.name)
+
+    def recent_gauges(self) -> Tuple[float, float]:
+        """(qps_recent, p99_seconds_recent) as last computed — what the
+        rolling gauges show and what every InferResponse piggybacks."""
+        return self._qps_recent, self._p99_recent
 
     # ----------------------------------------------------------------- rpc
     def Infer(self, req: pb.InferRequest, ctx) -> pb.InferResponse:
@@ -624,10 +640,14 @@ class ServeFrontend:
             # answer with a verdict (an exception here would surface as a
             # retry-proof UNKNOWN RPC status with no explanation).
             return pb.InferResponse(ok=False, verdict=f"error: {e}")
+        qps, p99 = self.recent_gauges()
         return pb.InferResponse(
             ok=result.ok, verdict=result.verdict,
             scores=(result.scores.astype("<f4").tobytes()
                     if result.scores is not None else b""),
+            # Piggybacked rolling gauges: the fleet router's least-loaded
+            # dispatch reads load off every answer instead of scraping.
+            qps_recent=qps, p99_seconds_recent=p99,
         )
 
     def attach_rollout(self, watcher) -> None:
@@ -690,6 +710,26 @@ class ServeFrontend:
                 "model_versions": self.model_versions(),
             },
         )
+        if obs_workdir:
+            # Fleet discovery: one JSON per replica under <workdir>/serve/
+            # (atomic rename, removed on clean stop; the router sweeps
+            # dead-pid leftovers). This is how a replica joins the
+            # router's rotation — same pattern as the obs/ exporter
+            # discovery files.
+            import json
+
+            d = os.path.join(obs_workdir, "serve")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"{obs_name or self.name}.json")
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"replica": self.name,
+                           "address": self._server.address,
+                           "pid": os.getpid(),
+                           "host": self._server.address.rsplit(":", 1)[0]},
+                          f)
+            os.replace(tmp, path)
+            self._discovery_file = path
         log.info("serve replica %s on :%d (table %s, max_batch %d, "
                  "max_wait %.1fms, admission bound %d)", self.name,
                  self._server.port, self.config.table,
@@ -710,6 +750,12 @@ class ServeFrontend:
             if not w.future.done():
                 w.future.set_result(
                     InferResult(False, "error: frontend stopped"))
+        if self._discovery_file is not None:
+            try:
+                os.unlink(self._discovery_file)
+            except OSError:
+                pass
+            self._discovery_file = None
         if self._server is not None:
             self._server.stop()
             self._server = None

@@ -1,0 +1,62 @@
+"""Spawn serving-replica subprocesses and wait for their discovery files.
+
+The ONE copy of the launch-and-wait idiom shared by the fleet bench
+(``scripts/bench_serve.py --fleet``) and the chaos fleet drill
+(``serve_replica_death_mid_flood``): both start N
+``python -m easydl_tpu.serve`` processes against a job workdir and block
+until every replica has published ``<workdir>/serve/<name>.json`` — the
+same files the router's discovery scans. A CLI-flag or discovery-
+convention change lands here once, not in two drifting copies.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def spawn_replicas(n: int, workdir: str, table: str, fields: int,
+                   device_ms: float = 0.0, max_batch: int = 256,
+                   max_wait_ms: float = 2.0, max_pending: int = 2048,
+                   cache_mb: int = 32,
+                   extra_env: Optional[Dict[str, str]] = None,
+                   wait_s: float = 90.0,
+                   name_prefix: str = "serve-") -> Dict[str, object]:
+    """Launch ``n`` replica processes; returns {name: Popen} once every
+    one has published its discovery file (kills them all and raises on
+    timeout)."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               **(extra_env or {}))
+    procs: Dict[str, object] = {}
+    for i in range(n):
+        name = f"{name_prefix}{i}"
+        procs[name] = subprocess.Popen(
+            [sys.executable, "-m", "easydl_tpu.serve",
+             "--workdir", workdir, "--name", name,
+             "--table", table, "--fields", str(int(fields)),
+             "--max-batch", str(int(max_batch)),
+             "--max-wait-ms", str(float(max_wait_ms)),
+             "--max-pending", str(int(max_pending)),
+             "--cache-mb", str(int(cache_mb)),
+             "--device-ms", str(float(device_ms))],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    serve_dir = os.path.join(workdir, "serve")
+    deadline = time.monotonic() + wait_s
+    want = set(procs)
+    while time.monotonic() < deadline:
+        seen = ({os.path.splitext(f)[0] for f in os.listdir(serve_dir)
+                 if f.endswith(".json")}
+                if os.path.isdir(serve_dir) else set())
+        if want <= seen:
+            return procs
+        time.sleep(0.1)
+    for p in procs.values():
+        p.kill()
+    raise TimeoutError("serve replicas never published discovery files")

@@ -127,6 +127,17 @@ KNOB_DECLS = (
      "Zero-copy raw-bytes id wire format (falls back per shard)."),
     ("EASYDL_PS_PULL_FP16", "bool", False,
      "Negotiate fp16 pull payloads (halves the wire)."),
+    ("EASYDL_PS_PULL_I8", "bool", False,
+     "Negotiate int8 pull payloads (per-row symmetric quantization, "
+     "~0.25x the f32 wire; serving replicas only — the trainer keeps "
+     "f32)."),
+    ("EASYDL_PS_SHM", "bool", False,
+     "Zero-copy shared-memory pull transport: shards mirror tables into "
+     "named shm segments, co-located clients gather rows directly "
+     "(seqlock-validated) and fall back to gRPC on any mismatch."),
+    ("EASYDL_PS_SHM_MAX_MB", "int", 256,
+     "Per-table shm mirror capacity cap; a table outgrowing it revokes "
+     "the mirror (clients fall back to the wire)."),
     ("EASYDL_PS_STORE_LOOP", "bool", False,
      "Force the python reference row-apply loop (bench comparisons)."),
     ("EASYDL_PS_SPLIT_HOT_RATIO", "float", 1.5,
@@ -144,6 +155,22 @@ KNOB_DECLS = (
      "Autoscale floor for serving replicas."),
     ("EASYDL_SERVE_MAX_REPLICAS", "int", 64,
      "Autoscale ceiling for serving replicas."),
+    # -- serve fleet router ------------------------------------------------
+    ("EASYDL_SERVE_HEDGE_BUDGET", "float", 0.1,
+     "Hedged-request budget: max fraction of recent routed requests that "
+     "may carry a hedge (a sick fleet must not double its own load); "
+     "<= 0 disables hedging."),
+    ("EASYDL_SERVE_HEDGE_MIN_MS", "float", 5.0,
+     "Floor for the p95-derived hedge delay."),
+    ("EASYDL_SERVE_HEDGE_MAX_MS", "float", 200.0,
+     "Ceiling for the p95-derived hedge delay."),
+    ("EASYDL_SERVE_ROUTER_HOLDDOWN_S", "float", 2.0,
+     "Hold-down before an ejected replica is re-probed for rotation."),
+    ("EASYDL_SERVE_ROUTER_EJECT_FAILS", "int", 3,
+     "Consecutive transport failures (or hard sheds) that eject a "
+     "replica from rotation."),
+    ("EASYDL_SERVE_ROUTER_REFRESH_S", "float", 1.0,
+     "Replica discovery refresh cadence (workdir serve/ registry scan)."),
     # -- production loop: feedback stream + rollout -----------------------
     ("EASYDL_FEEDBACK_SPOOL_BYTES", "int", 268_435_456,  # 256 MiB
      "Per-replica feedback spool byte bound; past it (after retiring "

@@ -23,6 +23,10 @@ from easydl_tpu.utils.logging import get_logger
 log = get_logger("utils", "native")
 
 CXXFLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-Wall"]
+#: Link libs, placed AFTER the source on the command line. librt is the
+#: shm_open/shm_unlink home on this image's glibc (2.31 — merged into libc
+#: only from 2.34); linking it elsewhere is a no-op.
+LDLIBS = ["-lpthread", "-lrt"]
 
 _cache: Dict[str, Optional[ctypes.CDLL]] = {}
 
@@ -33,7 +37,7 @@ def _compile(source: str, target: str) -> None:
     os.close(fd)
     try:
         subprocess.run(
-            ["g++", *CXXFLAGS, "-o", tmp, source],
+            ["g++", *CXXFLAGS, "-o", tmp, source, *LDLIBS],
             check=True, capture_output=True, text=True,
         )
         os.replace(tmp, target)  # atomic; last concurrent builder wins
@@ -61,7 +65,7 @@ def load_native(source: str, bind: Callable[[ctypes.CDLL], None]) -> Optional[ct
         try:
             with open(source, "rb") as f:
                 digest = hashlib.sha256(
-                    f.read() + " ".join(CXXFLAGS).encode()
+                    f.read() + " ".join(CXXFLAGS + LDLIBS).encode()
                 ).hexdigest()[:16]
             base = os.path.splitext(os.path.basename(source))[0]
             path = os.path.join(

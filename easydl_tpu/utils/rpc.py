@@ -263,6 +263,17 @@ class RpcClient:
 
         return _instrument(invoke, "client", service, method)
 
+    def call_future(self, method: str, request,
+                    timeout_s: Optional[float] = None):
+        """Issue a unary RPC WITHOUT blocking: returns the grpc future
+        (``.result(timeout)`` / ``.cancel()`` / ``.add_done_callback``).
+        The seam the serve router's request hedging needs — two in-flight
+        calls, first answer wins, loser cancelled. Deliberately outside
+        the instrumented sync path: the caller owns completion, so it
+        owns the accounting too."""
+        return self._call(method).future(request,
+                                         timeout=timeout_s or self._timeout)
+
     def wait_ready(self, timeout: float = 10.0) -> None:
         grpc.channel_ready_future(self._channel).result(timeout=timeout)
 

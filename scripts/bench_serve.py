@@ -221,6 +221,485 @@ def stale_check(reads, bypass, table: str, dim: int, hot_ids: np.ndarray,
             "mismatches": mismatches}
 
 
+# ===================================================================== fleet
+#
+# `--fleet` (BENCH_FLEET.json): the PR-14 scale-out cells. N replica
+# SUBPROCESSES (python -m easydl_tpu.serve — real gRPC, real processes,
+# own GILs) behind one in-process ServeRouter, driven with shaped
+# arrival-rate traffic (diurnal sine + flash crowd), plus two isolated
+# transport cells: shm-vs-gRPC-loopback pull throughput and i8-vs-f32
+# wire bytes / score error / staleness.
+#
+# Box-normalization note (same spirit as BENCH_SERVE's): this container
+# is cpu-shares throttled with ~1 visible core and no accelerator, so a
+# CPU-bound forward cannot scale past one core no matter how many
+# processes serve it. The fleet cells therefore give every replica a
+# fixed per-batch DEVICE-TIME floor (--device-ms, disclosed in the
+# artifact) standing in for the accelerator-bound forward a real
+# deployment has; the cells measure what the router fabric adds — fan-
+# out, hedging, admission — as RATIO gates against the single-replica
+# run on the same box. The shm/i8 cells carry the real (un-simulated)
+# transport measurements.
+
+_FLEET_PS_SHARD = r"""
+import sys, time
+from easydl_tpu.ps.server import PsShard
+from easydl_tpu.ps import registry
+idx, n, workdir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+shard = PsShard(shard_index=idx, num_shards=n, workdir=workdir)
+server = shard.serve(obs_workdir=workdir, obs_name=f"ps-fleet-{idx}")
+registry.publish(workdir, f"fleet-{idx}", idx, n, server.address)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn_registry_shards(n: int, workdir: str, extra_env=None):
+    from easydl_tpu.ps import registry
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               **(extra_env or {}))
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _FLEET_PS_SHARD, str(i), str(n), workdir],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for i in range(n)]
+    num, addrs = registry.discover(workdir, timeout=60.0)
+    assert num == n
+    return procs, list(addrs)
+
+
+def _spawn_replicas(n: int, workdir: str, table: str, fields: int,
+                    device_ms: float, max_batch: int, max_wait_ms: float,
+                    max_pending: int, extra_env=None):
+    # one shared launch-and-wait helper with the chaos fleet drill
+    from easydl_tpu.serve.launch import spawn_replicas
+
+    return list(spawn_replicas(
+        n, workdir, table, fields, device_ms=device_ms,
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_pending=max_pending, extra_env=extra_env).values())
+
+
+def traffic_multiplier(shape: str, t: float, duration: float) -> float:
+    """Arrival-rate multiplier in (0, 1]: `diurnal` = trough→peak→trough
+    sine; `flash_crowd` = low base with a 5x step spike in the middle
+    fifth — the two shapes the acceptance criteria name."""
+    import math
+
+    x = t / max(duration, 1e-9)
+    if shape == "diurnal":
+        return 0.55 + 0.45 * math.sin(2 * math.pi * x - math.pi / 2)
+    if shape == "flash_crowd":
+        return 1.0 if 0.4 <= x < 0.6 else 0.2
+    if shape == "saturation":
+        # constant peak: the capacity cell — both fleet sizes driven
+        # past their ceiling, so completed QPS measures capacity and the
+        # fleet/single ratio measures SCALE-OUT (a shaped cell cannot:
+        # its 10x offered dynamic range spans both regimes and the
+        # completed ratio lands wherever the shape does)
+        return 1.0
+    raise ValueError(f"unknown traffic shape {shape!r}")
+
+
+def drive_shaped(router, requests_pool, shape: str, duration_s: float,
+                 peak_rps: float, workers: int, session_fraction: float,
+                 seed: int):
+    """Open-loop shaped arrival driver: a scheduler emits requests at
+    lambda(t) = peak_rps * multiplier(shape, t) into a bounded worker
+    pool; completed/shed/error are counted, ok latencies recorded.
+    Saturation shows up as sheds (admission control working), NEVER as
+    hard failures."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    rng = np.random.default_rng(seed)
+    lock = threading.Lock()
+    lat = []
+    counts = {"offered": 0, "ok": 0, "shed": 0, "errors": 0,
+              "error_samples": []}
+
+    def one(i):
+        ids, dense = requests_pool[i % len(requests_pool)]
+        session = (f"sess-{i % 64}"
+                   if (i % 100) < session_fraction * 100 else "")
+        t0 = time.monotonic()
+        r = router.infer(ids, dense, session_id=session)
+        dt = time.monotonic() - t0
+        with lock:
+            if r.ok:
+                counts["ok"] += 1
+                lat.append(dt)
+            elif r.retriable:
+                counts["shed"] += 1
+            else:
+                counts["errors"] += 1
+                if len(counts["error_samples"]) < 5:
+                    counts["error_samples"].append(r.verdict)
+
+    pool = ThreadPoolExecutor(max_workers=workers)
+    t_start = time.monotonic()
+    i = 0
+    inflight = []
+    try:
+        # Credit-based emission: the scheduler tracks the next DUE time
+        # and emits every request that is due on each wake, so sleep
+        # granularity and submit overhead cannot silently shave the
+        # offered rate (a sleep-per-request loop undershoots badly past
+        # ~50 rps on this box).
+        next_due = 0.0
+        while True:
+            t = time.monotonic() - t_start
+            if t >= duration_s:
+                break
+            while next_due <= t < duration_s:
+                counts["offered"] += 1
+                inflight.append(pool.submit(one, i))
+                i += 1
+                rate = max(
+                    peak_rps * traffic_multiplier(shape, next_due,
+                                                  duration_s), 1e-3)
+                next_due += 1.0 / rate
+                t = time.monotonic() - t_start
+            if len(inflight) > 4 * workers:
+                inflight = [f for f in inflight if not f.done()]
+            time.sleep(min(max(next_due - t, 0.0), 0.005))
+        for f in inflight:
+            f.result()
+    finally:
+        pool.shutdown(wait=True)
+    elapsed = time.monotonic() - t_start
+    lat.sort()
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
+
+    return {
+        "shape": shape,
+        "duration_s": round(elapsed, 2),
+        "offered": counts["offered"],
+        "offered_rps": round(counts["offered"] / elapsed, 1),
+        "completed": counts["ok"],
+        "qps": round(counts["ok"] / elapsed, 1),
+        "shed": counts["shed"],
+        "errors": counts["errors"],
+        "error_samples": counts["error_samples"],
+        "p50_ms": round(1e3 * pct(0.5), 2),
+        "p99_ms": round(1e3 * pct(0.99), 2),
+    }
+
+
+def fleet_cell(workdir: str, table: str, n_replicas: int, args,
+               requests_pool, seed: int, shapes):
+    from easydl_tpu.serve.router import ServeRouter
+
+    procs = _spawn_replicas(
+        n_replicas, workdir, table, args.fields,
+        device_ms=args.device_ms, max_batch=args.fleet_max_batch,
+        max_wait_ms=5.0, max_pending=args.fleet_max_pending,
+        extra_env={"EASYDL_PS_SHM": "1"})
+    router = ServeRouter(workdir=workdir, name=f"router-x{n_replicas}",
+                         timeout_s=30.0)
+    out = {"replicas": n_replicas, "shapes": {}}
+    try:
+        # warm: negotiation, jit-free numpy scorer, cache fill
+        for i in range(8):
+            router.infer(*requests_pool[i % len(requests_pool)])
+        for shape in shapes:
+            out["shapes"][shape] = drive_shaped(
+                router, requests_pool, shape, args.fleet_seconds,
+                args.peak_rps, workers=args.fleet_workers,
+                session_fraction=0.25, seed=seed)
+        out["router_counters"] = dict(router.counters)
+        out["replica_view"] = router.replicas()
+        out["aggregate_qps"] = round(sum(
+            s["qps"] for s in out["shapes"].values()), 1)
+        out["hard_errors"] = sum(
+            s["errors"] for s in out["shapes"].values())
+    finally:
+        router.stop()
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait()
+        # clean discovery leftovers (killed replicas can't remove theirs)
+        for f in glob_serve_files(workdir):
+            try:
+                os.remove(f)
+            except OSError:
+                pass
+    return out
+
+
+def glob_serve_files(workdir: str):
+    import glob as _glob
+
+    return _glob.glob(os.path.join(workdir, "serve", "*.json"))
+
+
+def shm_pull_cell(args, seed: int):
+    """Isolated transport cell: the SAME Zipf pull stream against one
+    co-located native-store shard, over gRPC loopback vs the shm mirror.
+    This is the real (un-simulated) zero-copy measurement the >=2x gate
+    reads."""
+    workdir = tempfile.mkdtemp(prefix="bench-shm-")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               EASYDL_PS_SHM="1")
+    addr_file = os.path.join(workdir, "shard-0.addr")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVE_SHARD.replace(
+            'backend="numpy"', 'backend="auto"'),
+         "0", "1", addr_file],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(addr_file):
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise TimeoutError("shm-cell shard never came up")
+        time.sleep(0.05)
+    with open(addr_file) as f:
+        addr = f.read().strip()
+    dim = args.shm_dim
+    vocab = args.shm_vocab
+    batches = args.shm_batches
+    ids_per_batch = args.shm_ids
+    table = "shm_bench"
+    try:
+        seeder = ShardedPsClient([addr], timeout=30.0)
+        seeder.create_table(TableSpec(name=table, dim=dim,
+                                      optimizer="sgd", seed=5))
+        rng = np.random.default_rng(seed)
+        seed_ids = np.arange(vocab, dtype=np.int64)
+        seeder.push(table, seed_ids,
+                    rng.standard_normal((vocab, dim)).astype(np.float32),
+                    scale=0.1)
+        stream = [(rng.zipf(1.1, ids_per_batch) % vocab).astype(np.int64)
+                  for _ in range(batches + 8)]
+        out = {"dim": dim, "ids_per_batch": ids_per_batch,
+               "batches": batches}
+        for mode, shm in (("grpc_loopback", False), ("shm", True)):
+            client = ShardedPsClient([addr], timeout=30.0, pull_shm=shm)
+            try:
+                for ids in stream[:8]:
+                    client.pull(table, ids)  # warm + negotiate
+                t0 = time.monotonic()
+                for ids in stream[8:]:
+                    client.pull(table, ids)
+                dt = time.monotonic() - t0
+                out[mode] = {
+                    "elapsed_s": round(dt, 3),
+                    "ids_per_s": round(batches * ids_per_batch / dt, 0),
+                    "batches_per_s": round(batches / dt, 1),
+                }
+            finally:
+                client.close()
+        # bit-parity of the two transports on one fresh batch
+        a = ShardedPsClient([addr], timeout=30.0, pull_shm=True)
+        b = ShardedPsClient([addr], timeout=30.0)
+        try:
+            ids = stream[0]
+            a.pull(table, ids)  # negotiate
+            out["bit_identical"] = bool(np.array_equal(
+                a.pull(table, ids), b.pull(table, ids)))
+        finally:
+            a.close()
+            b.close()
+        out["speedup_ids_per_s"] = round(
+            out["shm"]["ids_per_s"]
+            / max(out["grpc_loopback"]["ids_per_s"], 1e-9), 2)
+        return out
+    finally:
+        seeder.close()
+        proc.kill()
+        proc.wait()
+
+
+def i8_cell(args, seed: int):
+    """Isolated quantization cell: i8 vs f32 wire bytes on a REAL Pull
+    response, serve-score error against the pinned per-row bound, and
+    the stale-read check under interleaved acked pushes (bit-exact
+    against a local requantization of a fresh f32 pull)."""
+    from easydl_tpu.ps import quant
+    from easydl_tpu.ps.server import PsShard
+    from easydl_tpu.proto import easydl_pb2 as pb
+    from easydl_tpu.serve.frontend import _numpy_forward
+
+    dim = args.i8_dim
+    rows = 512
+    fields = args.fields
+    shard = PsShard(shard_index=0, num_shards=1, backend="numpy")
+    rng = np.random.default_rng(seed)
+    spec = TableSpec(name="i8_bench", dim=dim, optimizer="sgd", seed=9)
+    shard.create_table(spec)
+    ids = np.arange(rows, dtype=np.int64)
+    shard.table("i8_bench").push(
+        ids, rng.standard_normal((rows, dim)).astype(np.float32), 1.0)
+    raw = np.ascontiguousarray(ids, "<i8").tobytes()
+    r32 = shard.Pull(pb.PullRequest(table="i8_bench", raw_ids=raw), None)
+    r8 = shard.Pull(pb.PullRequest(table="i8_bench", raw_ids=raw,
+                                   value_dtype="i8"), None)
+    wire_ratio = r8.ByteSize() / r32.ByteSize()
+    f32 = np.frombuffer(r32.values, "<f4").reshape(rows, dim)
+    deq = quant.decode_payload(r8.values, r8.row_scales, dim)
+    row_err = np.abs(deq - f32).max(axis=1)
+    row_bound = np.abs(f32).max(axis=1) * quant.I8_ERROR_BOUND + 1e-7
+    # serve-score error: the deterministic scorer over F pulled rows per
+    # example — bound is the sum of the per-row element bounds.
+    n_ex = rows // fields
+    emb32 = f32[: n_ex * fields].reshape(n_ex, fields, dim)
+    emb8 = deq[: n_ex * fields].reshape(n_ex, fields, dim)
+    dense = np.zeros((n_ex, 0), np.float32)
+    s32 = _numpy_forward(emb32, dense)
+    s8 = _numpy_forward(emb8, dense)
+    score_bound = (np.abs(emb32).max(axis=2) * dim
+                   * quant.I8_ERROR_BOUND).sum(axis=1) + 1e-5
+    score_err = np.abs(s8 - s32)
+    # stale-read check: after each ACKED push the i8 read must equal the
+    # requantization of a fresh f32 read BIT-EXACTLY (deterministic
+    # codec) — an equal-to-PRE-push answer is a stale read.
+    stale = 0
+    changed = 0
+    hot = ids[:64]
+    for _ in range(args.stale_pushes):
+        pre = shard.table("i8_bench").pull(hot)
+        shard.table("i8_bench").push(
+            hot, rng.standard_normal((len(hot), dim)).astype(np.float32),
+            0.5)
+        r = shard.Pull(pb.PullRequest(table="i8_bench",
+                                      raw_ids=hot.tobytes(),
+                                      value_dtype="i8"), None)
+        got = quant.decode_payload(r.values, r.row_scales, dim)
+        fresh = shard.table("i8_bench").pull(hot)
+        q, s = quant.quantize_rows(fresh)
+        want = quant.dequantize_rows(q, s)
+        if not np.array_equal(got, want):
+            stale += 1
+        qp, sp = quant.quantize_rows(pre)
+        if not np.array_equal(want, quant.dequantize_rows(qp, sp)):
+            changed += 1
+    return {
+        "dim": dim,
+        "wire_bytes_ratio": round(wire_ratio, 3),
+        "f32_bytes": r32.ByteSize(),
+        "i8_bytes": r8.ByteSize(),
+        "row_err_within_bound": bool((row_err <= row_bound).all()),
+        "max_row_err": float(row_err.max()),
+        "score_err_within_bound": bool((score_err <= score_bound).all()),
+        "max_score_err": float(score_err.max()),
+        "max_score_bound": float(score_bound.max()),
+        "stale_pushes": args.stale_pushes,
+        "stale_reads": stale,
+        "pushes_that_changed_rows": changed,
+    }
+
+
+def fleet_main(args) -> int:
+    workdir = tempfile.mkdtemp(prefix="bench-fleet-")
+    rng = np.random.default_rng(args.seed)
+    requests_pool = []
+    for _ in range(128):
+        ids = (rng.zipf(args.zipf_a, args.rows * args.fields)
+               % args.vocab).astype(np.int64).reshape(args.rows,
+                                                      args.fields)
+        requests_pool.append((ids, None))
+
+    ps_procs, _addrs = _spawn_registry_shards(
+        args.shards, workdir, extra_env={"EASYDL_PS_SHM": "1"})
+    results = {}
+    try:
+        seeder = ShardedPsClient.from_registry(workdir, args.shards,
+                                               timeout=30.0)
+        seeder.create_table(TableSpec(name=TABLE, dim=args.dim,
+                                      optimizer="adagrad", seed=3))
+        seed_ids = np.arange(args.vocab, dtype=np.int64)
+        seeder.push(
+            TABLE, seed_ids,
+            rng.standard_normal((args.vocab, args.dim)).astype(np.float32),
+            scale=0.1)
+        seeder.close()
+        # single replica: the saturation (capacity) cell only; the fleet
+        # additionally rides both traffic shapes (behavior cells: sheds
+        # bounded to the spike, zero hard failures, hedges live).
+        results["fleet_1"] = fleet_cell(workdir, TABLE, 1, args,
+                                        requests_pool, args.seed + 1,
+                                        shapes=("saturation",))
+        results["fleet_n"] = fleet_cell(
+            workdir, TABLE, args.fleet_replicas, args, requests_pool,
+            args.seed + 2,
+            shapes=("diurnal", "flash_crowd", "saturation"))
+    finally:
+        for p in ps_procs:
+            p.kill()
+        for p in ps_procs:
+            p.wait()
+    results["shm_pull"] = shm_pull_cell(args, args.seed + 3)
+    results["i8_pull"] = i8_cell(args, args.seed + 4)
+
+    # capacity ratio: saturation cell vs saturation cell — both driven
+    # past their ceiling, so this is scale-out, not shape arithmetic
+    agg1 = results["fleet_1"]["shapes"]["saturation"]["qps"]
+    aggn = results["fleet_n"]["shapes"]["saturation"]["qps"]
+    ratio = round(aggn / max(agg1, 1e-9), 2)
+    hedges = results["fleet_n"]["router_counters"]["hedges_fired"]
+    doc = {
+        "bench": "serve_fleet",
+        "host": {
+            "platform": platform.platform(),
+            "python": sys.version.split()[0],
+            "cpus": os.cpu_count(),
+            "note": "cpu-shares throttled, no accelerator: fleet cells "
+                    "run the numpy scorer under a fixed per-batch "
+                    f"device-time floor of {args.device_ms}ms (disclosed "
+                    "stand-in for an accelerator-bound forward); the "
+                    "ratio gates, not absolute QPS, are the signal. The "
+                    "shm/i8 cells are real transport measurements.",
+        },
+        "config": {
+            k: getattr(args, k) for k in (
+                "shards", "fleet_replicas", "fleet_seconds", "peak_rps",
+                "fleet_workers", "device_ms", "fleet_max_batch",
+                "fleet_max_pending", "rows", "fields", "dim", "vocab",
+                "zipf_a", "shm_dim", "shm_vocab", "shm_ids",
+                "shm_batches", "i8_dim", "stale_pushes", "smoke", "seed")
+        },
+        "results": results,
+        "acceptance": {
+            "aggregate_qps_ratio": ratio,
+            "fleet_qps_ge_3x_single": ratio >= 3.0,
+            "zero_hard_failures": (
+                results["fleet_1"]["hard_errors"] == 0
+                and results["fleet_n"]["hard_errors"] == 0),
+            "hedges_fired": hedges,
+            "shm_speedup_ids_per_s":
+                results["shm_pull"]["speedup_ids_per_s"],
+            "shm_ge_2x_grpc_loopback":
+                results["shm_pull"]["speedup_ids_per_s"] >= 2.0,
+            "shm_bit_identical": results["shm_pull"]["bit_identical"],
+            "i8_wire_ratio": results["i8_pull"]["wire_bytes_ratio"],
+            "i8_wire_le_0p55x": (
+                results["i8_pull"]["wire_bytes_ratio"] <= 0.55),
+            "i8_score_err_bounded":
+                results["i8_pull"]["score_err_within_bound"],
+            "i8_zero_stale_reads": (
+                results["i8_pull"]["stale_reads"] == 0
+                and results["i8_pull"]["pushes_that_changed_rows"] > 0),
+        },
+    }
+    text = json.dumps(doc, indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.out}")
+    print(text)
+    gates = doc["acceptance"]
+    failed = [k for k, v in gates.items()
+              if isinstance(v, bool) and not v]
+    if failed:
+        print(f"FLEET BENCH GATES FAILED: {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description="serving-tier benchmark")
     ap.add_argument("--shards", type=int, default=2)
@@ -261,7 +740,55 @@ def main() -> int:
                     help="CI-sized: in-process Local PS, seconds")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--out", default="")
+    # ------------------------------------------------------------- fleet
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet scale-out cells -> BENCH_FLEET.json "
+                         "(router over N replica subprocesses, shaped "
+                         "traffic, shm + i8 isolated cells)")
+    ap.add_argument("--fleet-replicas", type=int, default=4)
+    ap.add_argument("--fleet-seconds", type=float, default=20.0,
+                    help="drive duration per traffic shape")
+    ap.add_argument("--peak-rps", type=float, default=160.0,
+                    help="peak arrival rate of the shaped driver (sized "
+                         "so ONE replica saturates and the fleet does "
+                         "not — the scale-out ratio needs both regimes)")
+    ap.add_argument("--fleet-workers", type=int, default=48,
+                    help="driver pool concurrency")
+    ap.add_argument("--device-ms", type=float, default=80.0,
+                    help="per-batch device-time floor on each replica "
+                         "(accelerator stand-in; disclosed in the "
+                         "artifact)")
+    ap.add_argument("--fleet-max-batch", type=int, default=32,
+                    help="replica micro-batch bound; kept == rows so one "
+                         "batch serves one request and replica capacity "
+                         "is the device floor, not this box's CPU")
+    ap.add_argument("--fleet-max-pending", type=int, default=128)
+    ap.add_argument("--shm-dim", type=int, default=64)
+    ap.add_argument("--shm-vocab", type=int, default=20_000)
+    ap.add_argument("--shm-ids", type=int, default=4096)
+    ap.add_argument("--shm-batches", type=int, default=150)
+    ap.add_argument("--i8-dim", type=int, default=64)
     args = ap.parse_args()
+
+    if args.fleet:
+        args.rows = 16
+        args.fields = 4
+        args.fleet_max_batch = args.rows
+        if args.smoke:
+            args.fleet_seconds = 6.0
+            args.peak_rps = 200.0
+            args.fleet_workers = 32
+            args.device_ms = 60.0
+            args.shards = 2
+            args.dim = 16
+            args.vocab = 3000
+            args.shm_dim = 32
+            args.shm_vocab = 4000
+            args.shm_ids = 1024
+            args.shm_batches = 40
+            args.i8_dim = 32
+            args.stale_pushes = 3
+        return fleet_main(args)
 
     if args.smoke:
         args.shards = 2

@@ -36,6 +36,7 @@ env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
     --scenario ps_shard_crash_zero_loss \
     --scenario ps_reshard_under_fire \
     --scenario serve_during_reshard \
+    --scenario serve_replica_death_mid_flood \
     --scenario trainer_crash_mid_loop \
     --scenario rollout_half_update --keep-workdir "$@" \
     2>&1 | tee "$LOG"
@@ -97,6 +98,28 @@ assert stale.get("ids_checked", 0) > 0 and stale.get("stale_rows", -1) == 0, (
     "migration or a trainer push had already replaced")
 print(f"serve OK: {sv['requests']} requests, 0 hard failures, "
       f"{stale['ids_checked']} ids bit-verified post-split")
+PY
+        ;;
+    *serve_replica_death_mid_flood*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+fl = doc["fleet"]
+router = fl.get("router") or {}
+hedges = router.get("hedges_fired", 0)
+shm = fl.get("shm_client_pulls", 0)
+assert hedges >= 1, (
+    f"{sys.argv[1]}: ZERO hedges fired — the flood never pushed a "
+    "request past the hedge delay, the hedging path was never exercised")
+assert shm >= 1, (
+    f"{sys.argv[1]}: ZERO shm pulls observed — the replicas never rode "
+    "the shared-memory transport, the zero-copy path was never exercised")
+assert router.get("ejections", 0) >= 1, (
+    f"{sys.argv[1]}: the killed replica was never ejected")
+print(f"fleet OK: {fl['requests']} requests, 0 hard failures, "
+      f"{hedges} hedges ({router.get('hedges_won', 0)} won), "
+      f"{int(shm)} shm pulls, "
+      f"{fl['stale_check']['scores_checked']} scores bit-verified")
 PY
         ;;
     *trainer_crash_mid_loop*)

@@ -9,9 +9,13 @@ mode="${1:-all}"
 build() {  # $1 sanitizer flag, $2 tag
   local flag="$1" tag="$2" out
   out="$(mktemp -d)"
+  # -lrt: shm_open/shm_unlink (the zero-copy pull mirror) live in librt
+  # on this image's glibc. The stress driver hammers concurrent pushes
+  # against shm gathers, so the seqlock protocol itself is under the
+  # sanitizer here.
   g++ -O1 -g -std=c++17 -fsanitize="$flag" -fno-omit-frame-pointer -Wall \
     -o "$out/eds_stress" \
-    easydl_tpu/ps/native/embedding_store_stress.cc -lpthread
+    easydl_tpu/ps/native/embedding_store_stress.cc -lpthread -lrt
   "$out/eds_stress"
   echo "embedding store: $tag clean"
   g++ -O1 -g -std=c++17 -fsanitize="$flag" -fno-omit-frame-pointer -Wall \

@@ -500,6 +500,11 @@ def test_scenario_catalog_compiles_deterministically():
             # resume or commit-gated rollout, not a step target
             assert sc.expect.get("loop_exactly_once") \
                 or sc.expect.get("rollout_commit_gated")
+        elif sc.fleet_drill is not None:
+            # serve-fleet drills: the goal invariant is router resilience
+            # (ejection + hedging + bit-exact freshness), not a step
+            # target
+            assert sc.expect.get("fleet_resilient")
         else:
             assert sc.expect.get("target_step") is not None
 

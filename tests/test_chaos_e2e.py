@@ -163,6 +163,27 @@ def test_chaos_ps_zero_loss_scenario(tmp_path):
 
 @pytest.mark.slow
 @pytest.mark.chaos
+def test_chaos_serve_replica_death_mid_flood_scenario(tmp_path):
+    """ISSUE 14 acceptance: a serving replica is SIGKILLed mid-flash-crowd
+    behind the fleet router — ejection + hold-down, ≥1 hedge fired AND
+    won/rescued, zero hard failures, a bounded p99 spike, every served
+    score bit-exact vs a cache-bypassing wire client across acked
+    pushes, and ≥1 shm pull observed (the anti-vacuous gates live in the
+    serve_fleet_resilient invariant)."""
+    verdict = _run("serve_replica_death_mid_flood", tmp_path)
+    assert verdict["faults_injected"].get("serve_replica_kill", 0) >= 1
+    checks = verdict["invariants"]["checks"]
+    fleet = checks["serve_fleet_resilient"]
+    assert fleet["ok"]
+    assert fleet["hard_failures"] == 0
+    assert fleet["ejections"] >= 1
+    assert fleet["hedges_fired"] >= 1
+    assert fleet["stale_check"]["mismatches"] == 0
+    assert (tmp_path / "fleet-evidence.json").exists()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
 def test_chaos_ps_zombie_writer_scenario(tmp_path):
     """The partition variant: SIGSTOP the shard's pod, rescue with a
     higher epoch, SIGCONT — the resumed zombie must fence itself (reject

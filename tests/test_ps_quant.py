@@ -1,0 +1,217 @@
+"""int8 quantized pulls: codec bounds, the dtype-negotiation matrix, and
+freshness under interleaved pushes.
+
+The negotiation contract under test (architecture.md §6): the client
+REQUESTS an encoding via ``PullRequest.value_dtype``; the server answers
+the best one it knows and names it in ``PullResponse.dtype``; the client
+decodes by the RESPONSE — so every (old client, new client) × (old
+server, new server) × {f16, i8} cell works with no version handshake,
+and a reroute onto an older replacement degrades to f32 instead of hard-
+failing. The error bound is PINNED: per element,
+``|dequant - f32| <= row_max_abs / 254`` (ps/quant.py I8_ERROR_BOUND).
+"""
+
+import numpy as np
+import pytest
+
+from easydl_tpu.proto import easydl_pb2 as pb
+from easydl_tpu.ps import PsShard, ShardedPsClient, TableSpec
+from easydl_tpu.ps import quant
+
+
+def spec(**kw):
+    base = dict(name="emb", dim=8, init_std=0.01, seed=7,
+                optimizer="sgd", lr=0.05)
+    base.update(kw)
+    return TableSpec(**base)
+
+
+class LegacyShard(PsShard):
+    """Pre-negotiation server: ignores value_dtype, answers bare f32."""
+
+    def Pull(self, req, ctx):
+        t = self.table(req.table)
+        ids = (np.frombuffer(req.raw_ids, "<i8") if req.raw_ids
+               else np.asarray(req.ids, np.int64))
+        return pb.PullResponse(values=t.pull(ids).tobytes(), dim=t.dim)
+
+
+# ------------------------------------------------------------------ codec
+def test_codec_round_trip_error_bound_pinned():
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((200, 16)).astype(np.float32) * \
+        rng.uniform(0.01, 100.0, size=(200, 1)).astype(np.float32)
+    q, s = quant.quantize_rows(rows)
+    deq = quant.dequantize_rows(q, s)
+    bound = np.abs(rows).max(axis=1, keepdims=True) * quant.I8_ERROR_BOUND
+    assert (np.abs(deq - rows) <= bound + 1e-7).all()
+    assert q.dtype == np.int8 and s.dtype == np.float32
+
+
+def test_codec_zero_rows_exact_and_deterministic():
+    rows = np.zeros((3, 4), np.float32)
+    q, s = quant.quantize_rows(rows)
+    assert (q == 0).all() and (s == 1.0).all()
+    assert np.array_equal(quant.dequantize_rows(q, s), rows)
+    # wire decode is a pure function of the bytes
+    payload, scales = quant.encode_payload(rows)
+    assert np.array_equal(quant.decode_payload(payload, scales, 4), rows)
+
+
+def test_decode_payload_shape_mismatch_raises():
+    with pytest.raises(ValueError):
+        quant.decode_payload(b"\x01\x02\x03", b"\x00" * 4, 2)
+
+
+# ------------------------------------------------------- negotiation matrix
+def _seeded_pair(server_cls, **client_kw):
+    shard = server_cls(shard_index=0, num_shards=1, backend="numpy")
+    server = shard.serve()
+    client = ShardedPsClient([server.address], **client_kw)
+    ref = ShardedPsClient([server.address])
+    if server_cls is PsShard:
+        client.create_table(spec())
+    else:
+        shard.create_table(spec())
+    ids = np.arange(120, dtype=np.int64)
+    rng = np.random.default_rng(1)
+    shard.table("emb").push(
+        ids, rng.standard_normal((120, 8)).astype(np.float32), 1.0)
+    return shard, server, client, ref, ids
+
+
+def test_i8_client_new_server_bounded_and_deterministic():
+    shard, server, client, ref, ids = _seeded_pair(PsShard, pull_i8=True)
+    try:
+        f32 = ref.pull("emb", ids)
+        got = client.pull("emb", ids)
+        bound = np.abs(f32).max(axis=1, keepdims=True) * \
+            quant.I8_ERROR_BOUND + 1e-7
+        assert (np.abs(got - f32) <= bound).all()
+        # bit-exact vs a local requantization: the codec is deterministic
+        q, s = quant.quantize_rows(f32.reshape(-1, 8))
+        assert np.array_equal(got.reshape(-1, 8),
+                              quant.dequantize_rows(q, s))
+    finally:
+        client.close()
+        ref.close()
+        server.stop()
+
+
+def test_i8_client_legacy_server_degrades_to_f32():
+    """An i8 request against a pre-negotiation server answers plain f32
+    (no dtype field) — the client must decode it as f32, bit-exact, with
+    no hard failure."""
+    shard, server, client, ref, ids = _seeded_pair(LegacyShard,
+                                                   pull_i8=True)
+    try:
+        np.testing.assert_array_equal(client.pull("emb", ids),
+                                      ref.pull("emb", ids))
+    finally:
+        client.close()
+        ref.close()
+        server.stop()
+
+
+def test_mixed_dtype_shards_in_one_pull():
+    """A 2-shard pull where one shard answers i8 and the other is a
+    legacy f32 server: the per-shard decode follows each RESPONSE, and
+    the concatenated batch is correct per-shard."""
+    new = PsShard(shard_index=0, num_shards=2, backend="numpy")
+    old = LegacyShard(shard_index=1, num_shards=2, backend="numpy")
+    s0, s1 = new.serve(), old.serve()
+    client = ShardedPsClient([s0.address, s1.address], pull_i8=True)
+    ref = ShardedPsClient([s0.address, s1.address])
+    try:
+        for sh in (new, old):
+            sh.create_table(spec())
+        ids = np.arange(200, dtype=np.int64)
+        rng = np.random.default_rng(2)
+        from easydl_tpu.ps.table import shard_of
+
+        owner = shard_of(ids, 2)
+        grads = rng.standard_normal((200, 8)).astype(np.float32)
+        new.table("emb").push(ids[owner == 0], grads[owner == 0], 1.0)
+        old.table("emb").push(ids[owner == 1], grads[owner == 1], 1.0)
+        f32 = ref.pull("emb", ids)
+        got = client.pull("emb", ids)
+        # legacy shard's rows: bit-exact f32; new shard's rows: within
+        # the pinned quantization bound
+        np.testing.assert_array_equal(got[owner == 1], f32[owner == 1])
+        sub, ref_sub = got[owner == 0], f32[owner == 0]
+        bound = np.abs(ref_sub).max(axis=1, keepdims=True) * \
+            quant.I8_ERROR_BOUND + 1e-7
+        assert (np.abs(sub - ref_sub) <= bound).all()
+        assert not np.array_equal(sub, ref_sub)  # i8 really engaged
+    finally:
+        client.close()
+        ref.close()
+        s0.stop()
+        s1.stop()
+
+
+def test_reroute_to_legacy_replacement_renegotiates_down(tmp_path):
+    """An i8 client rerouted onto an older replacement keeps working:
+    the replacement answers f32 and the client follows the response —
+    no version skew, no hard failure."""
+    modern = PsShard(shard_index=0, num_shards=1, backend="numpy")
+    legacy = LegacyShard(shard_index=0, num_shards=1, backend="numpy")
+    s_new, s_old = modern.serve(), legacy.serve()
+    client = ShardedPsClient([s_new.address], pull_i8=True)
+    try:
+        client.create_table(spec())
+        ids = np.arange(50, dtype=np.int64)
+        rng = np.random.default_rng(3)
+        modern.table("emb").push(
+            ids, rng.standard_normal((50, 8)).astype(np.float32), 1.0)
+        assert client.pull("emb", ids) is not None
+        modern.drain(str(tmp_path / "mig"), step=0)
+        legacy.restore(str(tmp_path / "mig"))
+        client.reroute(0, s_old.address)
+        ref = ShardedPsClient([s_old.address])
+        try:
+            np.testing.assert_array_equal(client.pull("emb", ids),
+                                          ref.pull("emb", ids))
+        finally:
+            ref.close()
+    finally:
+        client.close()
+        s_new.stop()
+        s_old.stop()
+
+
+def test_i8_freshness_under_interleaved_pushes():
+    """After every ACKED push the i8 read reflects the post-push rows —
+    bit-exact against requantizing a fresh f32 pull (a stale mirror or
+    cache would reproduce the PRE-push quantization instead)."""
+    shard, server, client, ref, ids = _seeded_pair(PsShard, pull_i8=True)
+    try:
+        rng = np.random.default_rng(4)
+        hot = ids[:32]
+        for _ in range(3):
+            ref.push("emb", hot,
+                     rng.standard_normal((32, 8)).astype(np.float32),
+                     scale=0.5)
+            got = client.pull("emb", hot)
+            fresh = ref.pull("emb", hot)
+            q, s = quant.quantize_rows(fresh)
+            assert np.array_equal(got, quant.dequantize_rows(q, s))
+    finally:
+        client.close()
+        ref.close()
+        server.stop()
+
+
+def test_i8_wire_bytes_ratio_under_gate():
+    shard = PsShard(shard_index=0, num_shards=1, backend="numpy")
+    shard.create_table(spec(dim=32))
+    ids = np.arange(256, dtype=np.int64)
+    rng = np.random.default_rng(5)
+    shard.table("emb").push(
+        ids, rng.standard_normal((256, 32)).astype(np.float32), 1.0)
+    raw = ids.tobytes()
+    r32 = shard.Pull(pb.PullRequest(table="emb", raw_ids=raw), None)
+    r8 = shard.Pull(pb.PullRequest(table="emb", raw_ids=raw,
+                                   value_dtype="i8"), None)
+    assert r8.dtype == "i8" and r8.row_scales
+    assert r8.ByteSize() / r32.ByteSize() <= 0.55

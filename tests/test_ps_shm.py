@@ -1,0 +1,305 @@
+"""Zero-copy shared-memory pull transport: mirror parity, the
+co-location handshake, freshness, and every fallback edge.
+
+The transport's contract (architecture.md §6): shm serves EXACTLY what
+the wire would — bit-identical rows (mirrored ones from the segment,
+absent ones via the shared deterministic lazy init), a push-version tag
+never fresher than the wire's, and a silent return to gRPC on ANY
+mismatch (remote host, revoked segment, numpy backend, capacity
+overflow, consistency gates: cutover / fence / restore). Skipped
+wholesale when the native toolchain is unavailable (the numpy fallback
+has no mirror — and advertises none)."""
+
+import numpy as np
+import pytest
+
+from easydl_tpu.ps import PsShard, ShardedPsClient, TableSpec
+from easydl_tpu.ps import build as ps_build
+from easydl_tpu.ps import shm as ps_shm
+from easydl_tpu.ps.read_client import PsReadClient
+from easydl_tpu.ps.table import EmbeddingTable
+from easydl_tpu.serve import HotIdCache
+
+pytestmark = pytest.mark.skipif(
+    ps_build.load_native() is None,
+    reason="native embedding store unavailable (no toolchain)")
+
+
+def spec(**kw):
+    base = dict(name="emb", dim=8, init_std=0.01, seed=7,
+                optimizer="adagrad", lr=0.05)
+    base.update(kw)
+    return TableSpec(**base)
+
+
+def seeded_table(n=200, dim=8, **kw):
+    t = EmbeddingTable(spec(dim=dim, **kw), backend="native")
+    rng = np.random.default_rng(1)
+    ids = np.arange(n, dtype=np.int64)
+    t.push(ids, rng.standard_normal((n, dim)).astype(np.float32))
+    return t, ids
+
+
+# ------------------------------------------------------------- table level
+def test_export_gather_parity_and_version():
+    t, ids = seeded_table()
+    assert t.shm_export(8 << 20)
+    name, nonce = t.shm_info()
+    r = ps_shm.open_reader(name, nonce)
+    assert r is not None
+    rows, version = r.pull(ids)
+    np.testing.assert_array_equal(rows, t.pull(ids))
+    assert version == t.push_version
+    r.close()
+
+
+def test_wrong_nonce_and_missing_segment_refuse():
+    t, _ids = seeded_table()
+    assert t.shm_export(8 << 20)
+    name, nonce = t.shm_info()
+    assert ps_shm.open_reader(name, nonce + 2) is None
+    assert ps_shm.open_reader("/eds-no-such-segment", 1) is None
+
+
+def test_missing_ids_materialise_via_shared_lazy_init():
+    """Ids never pushed are absent from the mirror; the reader computes
+    the deterministic init locally — bit-identical to a server pull."""
+    t, _ids = seeded_table()
+    assert t.shm_export(8 << 20)
+    r = ps_shm.open_reader(*t.shm_info())
+    fresh = np.arange(50_000, 50_040, dtype=np.int64)
+    rows, _v = r.pull(fresh)
+    np.testing.assert_array_equal(rows, t.pull(fresh))
+    r.close()
+
+
+def test_push_write_through_and_version_monotone():
+    t, ids = seeded_table()
+    assert t.shm_export(8 << 20)
+    r = ps_shm.open_reader(*t.shm_info())
+    _rows, v0 = r.pull(ids[:16])
+    rng = np.random.default_rng(2)
+    t.push(ids[:16], rng.standard_normal((16, 8)).astype(np.float32))
+    rows, v1 = r.pull(ids[:16])
+    np.testing.assert_array_equal(rows, t.pull(ids[:16]))
+    assert v1 > v0 and v1 == t.push_version
+    # import rewrites rows too (restore/migration path)
+    t.import_rows(ids[:4], np.ones((4, t.spec.row_width), np.float32))
+    rows, v2 = r.pull(ids[:4])
+    np.testing.assert_array_equal(rows, np.ones((4, 8), np.float32))
+    assert v2 > v1
+    r.close()
+
+
+def test_revoke_raises_and_overflow_revokes():
+    t, ids = seeded_table()
+    assert t.shm_export(8 << 20)
+    r = ps_shm.open_reader(*t.shm_info())
+    t.shm_revoke()
+    assert t.shm_info() is None
+    with pytest.raises(ps_shm.ShmUnavailable) as ei:
+        r.pull(ids[:4])
+    assert ei.value.revoked
+    r.close()
+    # overflow: a mirror sized for ~64 rows dies when the table outgrows
+    # it — write-through revokes, the table itself keeps working.
+    # (sizing mirrors the worst-case layout math in shm_export: header
+    # + 48 index bytes/row + dim*4 row bytes)
+    t2, ids2 = seeded_table(n=32, dim=8)
+    assert t2.shm_export(4096 + 64 * (8 * 4 + 48))
+    r2 = ps_shm.open_reader(*t2.shm_info())
+    big = np.arange(1000, 1400, dtype=np.int64)
+    t2.push(big, np.ones((400, 8), np.float32))
+    with pytest.raises(ps_shm.ShmUnavailable):
+        r2.pull(ids2)
+    r2.close()
+
+
+def test_numpy_backend_exports_nothing():
+    t = EmbeddingTable(spec(), backend="numpy")
+    assert not t.shm_export(8 << 20)
+    assert t.shm_info() is None
+
+
+# ---------------------------------------------------------- client + server
+def _cluster(n_shards=2, monkeypatch=None):
+    assert monkeypatch is not None
+    monkeypatch.setenv("EASYDL_PS_SHM", "1")
+    shards = [PsShard(shard_index=i, num_shards=n_shards)
+              for i in range(n_shards)]
+    servers = [s.serve() for s in shards]
+    addrs = [sv.address for sv in servers]
+    return shards, servers, addrs
+
+
+def test_grpc_negotiation_and_bit_parity(monkeypatch):
+    shards, servers, addrs = _cluster(monkeypatch=monkeypatch)
+    client = ShardedPsClient(addrs, pull_shm=True)
+    plain = ShardedPsClient(addrs, pull_shm=False)
+    try:
+        client.create_table(spec())
+        ids = np.arange(300, dtype=np.int64)
+        rng = np.random.default_rng(3)
+        client.push("emb", ids,
+                    rng.standard_normal((300, 8)).astype(np.float32), 0.5)
+        client.pull("emb", ids)  # first pull negotiates
+        assert client._shm_readers  # segments adopted
+        np.testing.assert_array_equal(client.pull("emb", ids),
+                                      plain.pull("emb", ids))
+        # push-then-read freshness straight through the mirror
+        plain.push("emb", ids[:40],
+                   rng.standard_normal((40, 8)).astype(np.float32), 0.5)
+        np.testing.assert_array_equal(client.pull("emb", ids[:40]),
+                                      plain.pull("emb", ids[:40]))
+    finally:
+        client.close()
+        plain.close()
+        for sv in servers:
+            sv.stop()
+
+
+def test_cached_read_client_freshness_over_shm(monkeypatch):
+    """The PR-9 cache contract holds over the shm transport: a cached
+    row tagged with the mirror's version is demoted + re-pulled the
+    moment a push bumps it — never served stale."""
+    shards, servers, addrs = _cluster(monkeypatch=monkeypatch)
+    client = ShardedPsClient(addrs, pull_shm=True)
+    plain = ShardedPsClient(addrs, pull_shm=False)
+    try:
+        client.create_table(spec())
+        ids = np.arange(120, dtype=np.int64)
+        rng = np.random.default_rng(4)
+        plain.push("emb", ids,
+                   rng.standard_normal((120, 8)).astype(np.float32), 0.5)
+        reads = PsReadClient(client, cache=HotIdCache(4 << 20))
+        reads.pull("emb", ids)
+        for _ in range(3):
+            plain.push("emb", ids[:30],
+                       rng.standard_normal((30, 8)).astype(np.float32),
+                       0.25)
+            np.testing.assert_array_equal(reads.pull("emb", ids),
+                                          plain.pull("emb", ids))
+        assert reads.counters["demoted"] > 0  # pushes really invalidated
+        # quiescent batches: now the cache serves validated hits
+        reads.pull("emb", ids)
+        reads.pull("emb", ids)
+        assert reads.counters["hits"] > 0
+    finally:
+        client.close()
+        plain.close()
+        for sv in servers:
+            sv.stop()
+
+
+def test_cutover_fence_and_restore_revoke_mirrors(monkeypatch, tmp_path):
+    """Every server-side consistency gate kills the mirror: a cut-over
+    reshard source, a restore, and an explicit revoke all force readers
+    back to the wire (where stale-route/stale-epoch semantics live)."""
+    monkeypatch.setenv("EASYDL_PS_SHM", "1")
+    shard = PsShard(shard_index=0, num_shards=1)
+    shard.create_table(spec())
+    t = shard.table("emb")
+    assert t.shm_info() is not None
+    reader = ps_shm.open_reader(*t.shm_info())
+    shard.cutover()
+    assert t.shm_info() is None
+    with pytest.raises(ps_shm.ShmUnavailable) as ei:
+        reader.pull(np.arange(4, dtype=np.int64))
+    assert ei.value.revoked
+    reader.close()
+    shard.reshard_resume()
+    # restore: the fresh table re-exports under a NEW segment; the old
+    # one (if any) is revoked explicitly, not left to GC
+    shard2 = PsShard(shard_index=0, num_shards=1)
+    shard2.create_table(spec())
+    shard2.table("emb").push(np.arange(8, dtype=np.int64),
+                             np.ones((8, 8), np.float32))
+    shard2.save(str(tmp_path / "ck"), step=1)
+    old_info = shard2.table("emb").shm_info()
+    shard2.restore(str(tmp_path / "ck"))
+    new_info = shard2.table("emb").shm_info()
+    assert new_info is not None and new_info != old_info
+
+
+def test_remote_advertisement_falls_back_silently(monkeypatch):
+    """A segment name this host cannot open (the remote-shard case) is
+    remembered as failed — the client stays on gRPC and keeps working."""
+    shards, servers, addrs = _cluster(n_shards=1,
+                                      monkeypatch=monkeypatch)
+    client = ShardedPsClient(addrs, pull_shm=True)
+    try:
+        client.create_table(spec())
+        ids = np.arange(40, dtype=np.int64)
+        client.push("emb", ids, np.ones((40, 8), np.float32), 0.5)
+        # sabotage: pretend the shard advertised an alien segment
+        t = shards[0].table("emb")
+        t._shm = ("/eds-alien-host-segment", 12345)
+        out = client.pull("emb", ids)
+        assert out.shape == (40, 8)
+        assert client._shm_failed  # negotiation failure remembered
+        out2 = client.pull("emb", ids)  # still on the wire, still fine
+        np.testing.assert_array_equal(out, out2)
+    finally:
+        client.close()
+        for sv in servers:
+            sv.stop()
+
+
+def test_sweep_stale_segments_unlinks_dead_pid_leftovers(tmp_path):
+    """A SIGKILLed shard cannot unlink its own mirror — the startup
+    sweep removes dead-pid segments and spares live-pid ones."""
+    import os
+
+    root = tmp_path / "shm"
+    root.mkdir()
+    (root / "eds-999999999-deadbeef").write_bytes(b"x")     # dead pid
+    (root / f"eds-{os.getpid()}-cafecafe").write_bytes(b"x")  # us: live
+    (root / "unrelated-file").write_bytes(b"x")
+    assert ps_shm.sweep_stale_segments(str(root)) == 1
+    assert sorted(p.name for p in root.iterdir()) == [
+        f"eds-{os.getpid()}-cafecafe", "unrelated-file"]
+
+
+def test_concurrent_push_vs_gather_never_tears(monkeypatch):
+    """Seqlock validation: rows imported as all-A or all-B patterns must
+    never gather mixed — a torn row would mean the seqlock let a reader
+    observe a half-written mirror."""
+    import threading
+
+    t, ids = seeded_table(n=64, dim=16)
+    assert t.shm_export(8 << 20)
+    r = ps_shm.open_reader(*t.shm_info())
+    stop = threading.Event()
+    patterns = [np.full((64, t.spec.row_width), v, np.float32)
+                for v in (1.0, 2.0)]
+    t.import_rows(ids, patterns[0])  # start from a known uniform state
+
+    def writer():
+        k = 0
+        while not stop.is_set():
+            t.import_rows(ids, patterns[k % 2])
+            k += 1
+
+    w = threading.Thread(target=writer, daemon=True)
+    w.start()
+    torn = 0
+    gathers = 0
+    try:
+        for _ in range(300):
+            try:
+                rows, _v = r.pull(ids)
+            except ps_shm.ShmUnavailable as e:
+                assert not e.revoked
+                continue
+            gathers += 1
+            per_row = rows[:, 0:1]
+            uniform = np.all(rows == per_row, axis=1)
+            values_ok = np.isin(per_row[:, 0], (1.0, 2.0))
+            if not (uniform & values_ok).all():
+                torn += 1
+    finally:
+        stop.set()
+        w.join(timeout=10)
+    assert gathers > 0
+    assert torn == 0
+    r.close()

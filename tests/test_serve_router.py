@@ -1,0 +1,404 @@
+"""Serve fleet router: the pure routing policy, hedging, ejection +
+re-admission, discovery, and the fleet-aware autoscale decision.
+
+The pure half (serve/routing.py, easylint rule-5 scope) is table-tested;
+the e2e half runs real gRPC frontends behind a real ServeRouter on
+aggressive timers — a slow replica must lose the hedge race, a killed
+one must be ejected and re-admitted only through a post-hold-down probe,
+and the fleet-level answers (reroute-then-shed) must match the
+per-replica contracts PR 9 pinned.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from easydl_tpu.controller.reconciler import serve_scale_decision
+from easydl_tpu.ps.client import LocalPsClient
+from easydl_tpu.ps.read_client import PsReadClient
+from easydl_tpu.ps.table import TableSpec
+from easydl_tpu.serve import ServeConfig, ServeFrontend, ServeRouter
+from easydl_tpu.serve.routing import (
+    ReplicaView,
+    hedge_decision,
+    hedge_delay_s,
+    probe_due,
+    route_decision,
+    session_weight,
+)
+
+FIELDS = 4
+
+
+# ------------------------------------------------------------- pure policy
+def V(name, out=0, qps=0.0, p99=0.0, healthy=True):
+    return ReplicaView(name=name, outstanding=out, qps_recent=qps,
+                       p99_recent_s=p99, healthy=healthy)
+
+
+class TestRouteDecision:
+    def test_empty_and_all_unhealthy(self):
+        assert route_decision([]) is None
+        assert route_decision([V("a", healthy=False)]) is None
+
+    def test_least_loaded_by_outstanding_then_gauges(self):
+        got = route_decision([V("a", out=3), V("b", out=1), V("c", out=1,
+                                                              qps=9.0)])
+        assert got == "b"
+        # equal load: deterministic tie-break by name
+        assert route_decision([V("b"), V("a")]) == "a"
+
+    def test_exclude_and_unhealthy_skipped(self):
+        views = [V("a"), V("b", out=5), V("c", healthy=False)]
+        assert route_decision(views, exclude=("a",)) == "b"
+        assert route_decision(views, exclude=("a", "b")) is None
+
+    def test_session_affinity_stable_and_minimally_disruptive(self):
+        views = [V(f"r{i}", out=i) for i in range(5)]
+        owner = route_decision(views, session_id="sess-42")
+        # stable across calls and load changes (affinity beats load)
+        for _ in range(5):
+            assert route_decision(views, session_id="sess-42") == owner
+        # HRW: removing a NON-owner moves nothing
+        rest = [v for v in views if v.name != owner]
+        other = rest[0].name
+        survivors = [v for v in views if v.name != other]
+        assert route_decision(survivors, session_id="sess-42") == owner
+        # removing the owner moves the session to the second-highest hash
+        weights = {v.name: session_weight("sess-42", v.name)
+                   for v in views}
+        second = max((n for n in weights if n != owner),
+                     key=lambda n: weights[n])
+        assert route_decision(rest, session_id="sess-42") == second
+
+    def test_excluded_owner_falls_through_to_least_loaded(self):
+        views = [V("a", out=9), V("b", out=0)]
+        owner = route_decision(views, session_id="s")
+        got = route_decision(views, session_id="s", exclude=(owner,))
+        assert got is not None and got != owner
+
+
+class TestHedgePolicy:
+    def test_delay_clamped(self):
+        assert hedge_delay_s(0.0, 0.005, 0.2) == 0.005
+        assert hedge_delay_s(0.05, 0.005, 0.2) == 0.05
+        assert hedge_delay_s(3.0, 0.005, 0.2) == 0.2
+
+    def test_budget_cap_and_target_excludes_primary(self):
+        views = [V("a"), V("b", out=2)]
+        assert hedge_decision(views, "a", hedges_recent=0,
+                              requests_recent=100, budget=0.1) == "b"
+        # budget spent: a sick fleet must not double its own load
+        assert hedge_decision(views, "a", hedges_recent=10,
+                              requests_recent=100, budget=0.1) is None
+        assert hedge_decision(views, "a", 0, 100, budget=0.0) is None
+        # nowhere to hedge: one replica
+        assert hedge_decision([V("a")], "a", 0, 100, 0.5) is None
+
+    def test_probe_due(self):
+        assert not probe_due(10.0, 9.5, 1.0)
+        assert probe_due(10.6, 9.5, 1.0)
+
+
+# ----------------------------------------------------------------- fixtures
+def _ps():
+    ps = LocalPsClient(num_shards=1)
+    ps.create_table(TableSpec(name="t", dim=8, optimizer="sgd", seed=1))
+    return ps
+
+
+def _replica(ps, name, slow_ms=0.0, max_pending=2048, port=0):
+    c = LocalPsClient(num_shards=1)
+    c.shards = ps.shards
+    fwd = None
+    if slow_ms:
+        def fwd(emb, dense, _ms=slow_ms):
+            time.sleep(_ms / 1000.0)
+            s = emb.reshape(len(emb), -1).sum(axis=1)
+            if dense.size:
+                s = s + dense.sum(axis=1)
+            return s.astype(np.float32)
+    fe = ServeFrontend(
+        PsReadClient(c),
+        ServeConfig(table="t", fields=FIELDS, max_pending=max_pending),
+        forward=fwd, name=name)
+    return fe, fe.serve(port=port)
+
+
+def _ids(rows=2):
+    return np.arange(rows * FIELDS, dtype=np.int64).reshape(rows, FIELDS)
+
+
+# ------------------------------------------------------------------ router
+def test_router_parity_and_counters():
+    ps = _ps()
+    fe, sv = _replica(ps, "r1")
+    router = ServeRouter(addresses={"r1": sv.address}, timeout_s=10.0)
+    try:
+        r = router.infer(_ids())
+        assert r.ok
+        direct = fe.infer(_ids())
+        np.testing.assert_array_equal(r.scores, direct.scores)
+        assert router.counters["ok"] == 1
+    finally:
+        router.stop()
+        sv.stop()
+        fe.stop()
+
+
+def test_router_hedges_win_against_slow_replica():
+    """A session pinned to the slow replica outlives the hedge delay; the
+    duplicate fires at the fast replica and wins the race — first answer
+    wins, scores identical either way (same PS rows)."""
+    ps = _ps()
+    fe1, sv1 = _replica(ps, "r1")
+    fe2, sv2 = _replica(ps, "r2", slow_ms=150.0)
+    router = ServeRouter(addresses={"r1": sv1.address, "r2": sv2.address},
+                         hedge_min_ms=20.0, hedge_max_ms=40.0,
+                         hedge_budget=0.9, timeout_s=10.0)
+    try:
+        sess = next(s for s in (f"s{i}" for i in range(200))
+                    if session_weight(s, "r2") > session_weight(s, "r1"))
+        for _ in range(4):
+            r = router.infer(_ids(), session_id=sess)
+            assert r.ok
+        assert router.counters["hedges_fired"] >= 1
+        assert router.counters["hedges_won"] >= 1
+    finally:
+        router.stop()
+        sv1.stop()
+        fe1.stop()
+        sv2.stop()
+        fe2.stop()
+
+
+def test_router_hedge_budget_denies():
+    ps = _ps()
+    fe, sv = _replica(ps, "r1", slow_ms=60.0)
+    fe2, sv2 = _replica(ps, "r2", slow_ms=60.0)
+    router = ServeRouter(addresses={"r1": sv.address, "r2": sv2.address},
+                         hedge_min_ms=5.0, hedge_max_ms=10.0,
+                         hedge_budget=0.0, timeout_s=10.0)
+    try:
+        for _ in range(3):
+            assert router.infer(_ids()).ok
+        assert router.counters["hedges_fired"] == 0
+    finally:
+        router.stop()
+        sv.stop()
+        fe.stop()
+        sv2.stop()
+        fe2.stop()
+
+
+def test_router_ejects_dead_replica_and_readmits_after_probe():
+    ps = _ps()
+    fe1, sv1 = _replica(ps, "r1")
+    port = sv1.port
+    fe2, sv2 = _replica(ps, "r2")
+    router = ServeRouter(addresses={"r1": sv1.address, "r2": sv2.address},
+                         eject_fails=2, holddown_s=0.3, timeout_s=8.0)
+    try:
+        sv1.stop()
+        fe1.stop()
+        for _ in range(8):
+            assert router.infer(_ids()).ok  # rerouted, never hard-fails
+        assert router.counters["ejections"] >= 1
+        assert router.replicas()["r1"]["ejected"]
+        # resurrection at the SAME port: the post-hold-down probe must
+        # re-admit it — ejection is a rotation state, not a tombstone
+        fe1b, sv1b = _replica(ps, "r1", port=port)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            router.infer(_ids())
+            if not router.replicas()["r1"]["ejected"]:
+                break
+            time.sleep(0.1)
+        assert not router.replicas()["r1"]["ejected"]
+        assert router.counters["readmissions"] >= 1
+        sv1b.stop()
+        fe1b.stop()
+    finally:
+        router.stop()
+        sv2.stop()
+        fe2.stop()
+
+
+def test_router_reroutes_sheds_then_sheds_fleet_wide():
+    """One replica past its admission bound sheds; the router must try
+    the other replica (reroute) and only shed to the caller when EVERY
+    healthy replica shed. With both tiny, the caller sees the retriable
+    fleet-level shed — never a hard failure."""
+    ps = _ps()
+    # max_pending=1 example: a 2-row request can never be admitted...
+    # no — that would be the HARD error class. Use a bound of 2 with a
+    # 2-row request: admitted only when idle, shed under any overlap.
+    fe1, sv1 = _replica(ps, "r1", slow_ms=80.0, max_pending=2)
+    fe2, sv2 = _replica(ps, "r2", slow_ms=80.0, max_pending=2)
+    router = ServeRouter(addresses={"r1": sv1.address, "r2": sv2.address},
+                         hedge_budget=0.0, timeout_s=6.0)
+    import threading
+
+    results = []
+    lock = threading.Lock()
+
+    def fire():
+        r = router.infer(_ids())
+        with lock:
+            results.append(r)
+
+    try:
+        ts = [threading.Thread(target=fire) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(r.ok or r.retriable for r in results)  # no hard fails
+        assert any(r.ok for r in results)
+    finally:
+        router.stop()
+        sv1.stop()
+        fe1.stop()
+        sv2.stop()
+        fe2.stop()
+
+
+def test_router_discovery_and_dead_pid_sweep(tmp_path):
+    ps = _ps()
+    fe, sv = _replica(ps, "r1")
+    # a real replica publishes via serve(); fake the discovery file the
+    # way ServeFrontend.serve does, plus a dead-pid leftover
+    d = tmp_path / "serve"
+    d.mkdir()
+    (d / "r1.json").write_text(json.dumps(
+        {"replica": "r1", "address": sv.address, "pid": os.getpid(),
+         "host": "localhost"}))
+    (d / "ghost.json").write_text(json.dumps(
+        {"replica": "ghost", "address": "localhost:1", "pid": 999999999,
+         "host": "localhost"}))
+    router = ServeRouter(workdir=str(tmp_path), refresh_s=0.0,
+                         timeout_s=8.0)
+    try:
+        assert set(router.replicas()) == {"r1"}
+        assert not (d / "ghost.json").exists()  # swept
+        assert router.infer(_ids()).ok
+        # clean shutdown removes the file -> next refresh drops the
+        # replica from rotation
+        (d / "r1.json").unlink()
+        router._refresh_replicas(force=True)
+        assert router.replicas() == {}
+    finally:
+        router.stop()
+        sv.stop()
+        fe.stop()
+
+
+def test_frontend_publishes_discovery_file(tmp_path):
+    ps = _ps()
+    c = LocalPsClient(num_shards=1)
+    c.shards = ps.shards
+    fe = ServeFrontend(PsReadClient(c),
+                       ServeConfig(table="t", fields=FIELDS), name="rX")
+    sv = fe.serve(obs_workdir=str(tmp_path))
+    try:
+        doc = json.loads((tmp_path / "serve" / "rX.json").read_text())
+        assert doc["replica"] == "rX" and doc["address"] == sv.address
+        assert doc["pid"] == os.getpid()
+    finally:
+        fe.stop()
+    assert not (tmp_path / "serve" / "rX.json").exists()  # removed
+
+
+def test_infer_response_piggybacks_rolling_gauges():
+    ps = _ps()
+    fe, sv = _replica(ps, "r1")
+    from easydl_tpu.proto import easydl_pb2 as pb
+    from easydl_tpu.serve.frontend import SERVE_SERVICE
+    from easydl_tpu.utils.rpc import RpcClient
+
+    client = RpcClient(SERVE_SERVICE, sv.address)
+    try:
+        req = pb.InferRequest(raw_ids=_ids().tobytes(), fields=FIELDS)
+        # the rolling gauges recompute at most 4x/s — spread the
+        # requests across the throttle window
+        deadline = time.monotonic() + 5.0
+        resp = client.Infer(req)
+        while resp.qps_recent == 0.0 and time.monotonic() < deadline:
+            time.sleep(0.3)
+            resp = client.Infer(req)
+        assert resp.ok
+        assert resp.qps_recent > 0.0  # the router's least-loaded signal
+    finally:
+        client.close()
+        sv.stop()
+        fe.stop()
+
+
+# --------------------------------------------- fleet-aware scale decision
+class TestFleetScaleDecision:
+    def test_router_replicas_override_scraped_count(self):
+        """The regression the satellite names: a 3-replica fleet at 60%
+        of target each, with only ONE replica's exporter reachable by
+        the scrape — without the router gauges this read as one idle
+        replica (scale to the floor); with them the decision sees the
+        true offered load and fleet size."""
+        naive = serve_scale_decision({"a": 300.0}, {"a": 0.001},
+                                     target_qps=500.0)
+        assert naive == 1 or naive is None  # the old failure mode
+        got = serve_scale_decision(
+            {"a": 300.0}, {"a": 0.001}, target_qps=500.0,
+            router_offered_qps=900.0, router_replicas=3)
+        assert got is None  # 3 replicas at 60% each: leave it alone
+
+    def test_router_offered_load_triggers_scale_up(self):
+        # replicas report nothing (none scraped); the door sees 2100 qps
+        got = serve_scale_decision(
+            {}, {}, target_qps=500.0,
+            router_offered_qps=2100.0, router_replicas=3)
+        assert got == 5
+
+    def test_router_p99_breach_adds_a_replica(self):
+        got = serve_scale_decision(
+            {"a": 100.0}, {"a": 0.001}, target_qps=500.0,
+            p99_budget_s=0.05, router_offered_qps=100.0,
+            router_replicas=2, router_p99_s=0.2)
+        assert got == 3
+
+    def test_stale_router_gauge_cannot_hide_replica_load(self):
+        got = serve_scale_decision(
+            {"a": 900.0, "b": 950.0}, {"a": 0.001, "b": 0.001},
+            target_qps=500.0, router_offered_qps=10.0,
+            router_replicas=2)
+        assert got == 4  # max(sum, router): replica gauges win here
+
+    def test_maybe_scale_serve_reads_router_gauges(self, monkeypatch):
+        from easydl_tpu.controller import reconciler
+
+        snap = {"services": {
+            "router-0": {"metrics": {
+                'easydl_serve_router_offered_qps_recent'
+                '{replica="router-0"}': 900.0,
+                'easydl_serve_router_live_replicas'
+                '{replica="router-0"}': 3.0,
+                'easydl_serve_router_p99_seconds_recent'
+                '{replica="router-0"}': 0.002,
+            }},
+            "serve-0": {"metrics": {
+                'easydl_serve_qps_recent{replica="serve-0"}': 300.0,
+                'easydl_serve_p99_seconds_recent'
+                '{replica="serve-0"}': 0.001,
+            }},
+        }}
+        monkeypatch.setattr(reconciler, "maybe_scale_serve",
+                            reconciler.maybe_scale_serve)
+        import easydl_tpu.obs.scrape as scrape
+
+        monkeypatch.setattr(scrape, "merge_snapshot",
+                            lambda workdir=None: snap)
+        # 3 replicas, 900 offered at target 500: need 2, under the
+        # 3-replica hysteresis bar -> leave alone (None), NOT scale-to-1
+        assert reconciler.maybe_scale_serve("/nonexistent",
+                                            target_qps=500.0) is None
