@@ -131,6 +131,56 @@ class ResourceSpec:
 
 
 @dataclass
+class SchedulingSpec:
+    """Multi-tenant scheduling block (ISSUE 15): how this job stands in
+    the GLOBAL chip arbitration when N ElasticJobs share one substrate.
+
+    ``priority`` — larger is more important (k8s PriorityClass
+    semantics); a higher-priority job's scale-up may preempt a lower-
+    priority job's chips through the drain path. ``min_replicas`` — the
+    no-starvation floor: arbitration never takes the job below it.
+    ``max_replicas`` — cap on what the job may hold (0 = uncapped)."""
+
+    priority: int = 0
+    min_replicas: int = 0
+    max_replicas: int = 0
+
+    def validate(self) -> None:
+        if self.min_replicas < 0:
+            raise SpecError(
+                f"scheduling.minReplicas must be >= 0, got {self.min_replicas}")
+        if self.max_replicas < 0:
+            raise SpecError(
+                f"scheduling.maxReplicas must be >= 0, got {self.max_replicas}")
+        if self.max_replicas and self.min_replicas > self.max_replicas:
+            raise SpecError(
+                f"scheduling.minReplicas {self.min_replicas} > "
+                f"maxReplicas {self.max_replicas}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"priority": self.priority,
+                "minReplicas": self.min_replicas,
+                "maxReplicas": self.max_replicas}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "SchedulingSpec":
+        d = d or {}
+        # Strict, unlike the resource blocks: a typoed key here
+        # (min_replicas / minreplicas) would silently drop the job's
+        # no-starvation floor to 0 — and the first higher-priority
+        # scale-up would preempt it to zero chips, the exact outcome the
+        # floor is documented to prevent.
+        unknown = sorted(set(d) - {"priority", "minReplicas", "maxReplicas"})
+        if unknown:
+            raise SpecError(
+                f"unknown scheduling key(s) {unknown}; valid: "
+                "priority, minReplicas, maxReplicas")
+        return cls(priority=int(d.get("priority", 0)),
+                   min_replicas=int(d.get("minReplicas", 0)),
+                   max_replicas=int(d.get("maxReplicas", 0)))
+
+
+@dataclass
 class RoleSpec:
     """Per-role section of a JobSpec: image + optional command override.
 
@@ -171,6 +221,9 @@ class JobSpec:
     # TPU-native extensions (absent in the reference CRD):
     accelerator: Optional[TpuSpec] = None  # preferred accelerator family/topology
     labels: Dict[str, str] = field(default_factory=dict)
+    # Multi-tenant arbitration standing (ISSUE 15); None = the default
+    # SchedulingSpec (priority 0, no floor, no cap).
+    scheduling: Optional[SchedulingSpec] = None
 
     def validate(self) -> None:
         if not self.name:
@@ -182,6 +235,8 @@ class JobSpec:
                 raise SpecError(f"unknown role {role!r}; valid roles: {ROLES}")
         if self.accelerator is not None:
             self.accelerator.validate()
+        if self.scheduling is not None:
+            self.scheduling.validate()
 
     #: command a bare ``evaluator: {}`` role runs. Falling back to
     #: ``spec.command`` (the TRAINING entry) would make the evaluator pod
@@ -217,6 +272,8 @@ class JobSpec:
             spec[role] = rs.to_dict()
         if self.accelerator is not None:
             spec["accelerator"] = self.accelerator.to_dict()
+        if self.scheduling is not None:
+            spec["scheduling"] = self.scheduling.to_dict()
         return {
             "apiVersion": API_VERSION,
             "kind": JOB_KIND,
@@ -232,7 +289,7 @@ class JobSpec:
             raise SpecError(f"expected kind {JOB_KIND}, got {doc.get('kind')!r}")
         meta = doc.get("metadata") or {}
         spec = doc.get("spec") or {}
-        known = set(ROLES) | {"image", "command", "accelerator"}
+        known = set(ROLES) | {"image", "command", "accelerator", "scheduling"}
         unknown = sorted(k for k in spec if k not in known)
         if unknown:
             raise SpecError(
@@ -249,6 +306,7 @@ class JobSpec:
                 )
             roles[role] = RoleSpec.from_dict(spec[role])
         acc = spec.get("accelerator")
+        sched = spec.get("scheduling")
         js = cls(
             name=str(meta.get("name", "")),
             image=str(spec.get("image", "")),
@@ -256,6 +314,7 @@ class JobSpec:
             roles=roles,
             accelerator=TpuSpec.from_dict(acc) if acc else None,
             labels=dict(meta.get("labels") or {}),
+            scheduling=SchedulingSpec.from_dict(sched) if sched else None,
         )
         js.validate()
         return js

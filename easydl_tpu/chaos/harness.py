@@ -126,6 +126,22 @@ class Scenario:
     #: replicas, rows, fields, vocab, dim, device_ms, rps, phase_s,
     #: pushes, kill_replica.
     fleet_drill: Optional[Dict[str, Any]] = None
+    #: Multi-tenant drill mode (ISSUE 15, ``multi_tenant_contention``):
+    #: N real ElasticJob masters + agent pools share ONE PS substrate
+    #: (per-job table namespaces) under a TenantFleet running the global
+    #: chip arbiter. Each job drives a deterministic namespaced push
+    #: storm; a declared scale-up exhausts the supply so the arbiter must
+    #: PREEMPT (notice → drain → stop → re-grant, the drill's
+    #: drain-before-kill evidence), while scheduled faults (a worker kill,
+    #: a PS shard crash + rescue) land mid-contention. Verdict: priorities
+    #: honored / no starvation / no thrash over the recorded decisions,
+    #: every job's tables digest-identical to its fault-free reference,
+    #: and the decision log byte-replayed through the pure arbiter. Keys:
+    #: total_chips, holddown_s, max_preemptions, drain_timeout_s,
+    #: save_after_s, settle_s, jobs [{name, priority, min_chips,
+    #: max_chips, demand, scale_up{at_s, demand}}], traffic {steps, batch,
+    #: vocab, dim, zipf_a, pace_s}.
+    tenant_drill: Optional[Dict[str, Any]] = None
 
     @property
     def name(self) -> str:
@@ -215,6 +231,8 @@ class ChaosHarness:
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> Dict[str, Any]:
+        if self.scenario.tenant_drill is not None:
+            return self._run_tenant_drill()
         if self.scenario.fleet_drill is not None:
             return self._run_fleet_drill()
         if self.scenario.loop_drill is not None:
@@ -222,6 +240,347 @@ class ChaosHarness:
         if self.scenario.ps_storm is not None:
             return self._run_ps_storm()
         return self._run_job()
+
+    # ------------------------------------------------------ multi-tenant
+    def _run_tenant_drill(self) -> Dict[str, Any]:
+        sc = self.scenario
+        plan_path = os.path.join(self.workdir, "chaos-plan.json")
+        _write_plan(plan_path, self.schedule)
+        saved_env: Dict[str, Optional[str]] = {}
+        from easydl_tpu.obs import tracing
+
+        for key, val in ((injectors.ENV_VAR, plan_path),
+                         (tracing.TRACE_ENV, "1"),
+                         ("EASYDL_COMPILE_CACHE", "off"),
+                         ("EASYDL_PS_PROBE_TIMEOUT_S", "1.0")):
+            saved_env[key] = os.environ.get(key)
+            os.environ[key] = val
+        t_start = time.monotonic()
+        counts_before = injectors.injected_fault_counts()
+        evidence: Dict[str, Any] = {}
+        try:
+            self._launch_ps()
+            evidence = self._drive_tenant_contention(plan_path)
+        finally:
+            self._teardown()
+            for key, val in saved_env.items():
+                if val is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = val
+        path = os.path.join(self.workdir, "tenant-evidence.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(evidence, f, indent=2, sort_keys=True, default=str)
+        os.replace(tmp, path)
+        fault_counts = {
+            kind: count - counts_before.get(kind, 0.0)
+            for kind, count in injectors.injected_fault_counts().items()
+            if count - counts_before.get(kind, 0.0) > 0
+        }
+        for kind, count in self._scrape_subprocess_faults().items():
+            fault_counts[kind] = fault_counts.get(kind, 0.0) + count
+        verdict = invariants.check_scenario(
+            self.workdir, sc.expect, status={}, fault_counts=fault_counts,
+            outages=self.outages,
+        )
+        _scenario_counter().inc(scenario=sc.name,
+                                result="pass" if verdict["passed"]
+                                else "fail")
+        return {
+            "scenario": sc.name,
+            "seed": sc.chaos.seed,
+            "notes": sc.chaos.notes,
+            "workdir": self.workdir,
+            "wall_s": round(time.monotonic() - t_start, 2),
+            "schedule": self.schedule,
+            "expect": dict(sc.expect),
+            "faults_injected": fault_counts,
+            "tenant": {k: v for k, v in evidence.items()
+                       if k != "decision_log"},
+            "decision_log_decisions": len(evidence.get("decision_log", [])),
+            "final_status": {},
+            "invariants": verdict,
+            "passed": verdict["passed"],
+        }
+
+    def _drive_tenant_contention(self, plan_path: str) -> Dict[str, Any]:
+        import numpy as np
+
+        from easydl_tpu.brain.arbiter import ArbiterConfig
+        from easydl_tpu.controller.fleet import (
+            TenantFleet, TenantJob, run_fleet_loop,
+        )
+        from easydl_tpu.elastic.agent import Agent
+        from easydl_tpu.elastic.master import Master
+        from easydl_tpu.ps.client import LocalPsClient, ShardedPsClient
+        from easydl_tpu.ps.table import NAMESPACE_SEP, TableSpec
+
+        sc = self.scenario
+        cfg = dict(sc.tenant_drill or {})
+        traffic = dict(cfg.get("traffic", {}))
+        steps = int(traffic.get("steps", 260))
+        batch = int(traffic.get("batch", 96))
+        vocab = int(traffic.get("vocab", 2000))
+        dim = int(traffic.get("dim", 8))
+        zipf_a = float(traffic.get("zipf_a", 1.1))
+        pace_s = float(traffic.get("pace_s", 0.08))
+        job_cfg = dict(_MLP_CFG, total_steps=500_000, ckpt_interval=500)
+        job_cfg.update(dict(cfg.get("job_cfg", {})))
+
+        masters: Dict[str, Master] = {}
+        self._tenant_masters = masters  # torn down in _teardown
+
+        def factory(aid: str, master: Master, job: TenantJob) -> Agent:
+            return Agent(aid, master.address, job.workdir, slots=1,
+                         heartbeat_interval=0.3).start()
+
+        fleet = TenantFleet(
+            int(cfg.get("total_chips", 5)), factory,
+            ArbiterConfig(
+                holddown_s=float(cfg.get("holddown_s", 6.0)),
+                max_preemptions_per_decision=int(
+                    cfg.get("max_preemptions", 1)),
+            ),
+            drain_timeout_s=float(cfg.get("drain_timeout_s", 25.0)),
+        )
+        for j in cfg.get("jobs", []):
+            name = str(j["name"])
+            jobdir = os.path.join(self.workdir, "jobs", name)
+            os.makedirs(jobdir, exist_ok=True)
+            masters[name] = Master(
+                job_name=name, workdir=jobdir, desired_workers=1,
+                min_workers=1, heartbeat_timeout=2.0,
+                prepare_timeout_s=0.0, worker_config=job_cfg,
+            ).start()
+            fleet.add_job(TenantJob(
+                name=name, master=masters[name], workdir=jobdir,
+                priority=int(j.get("priority", 0)),
+                min_chips=int(j.get("min_chips", 0)),
+                max_chips=int(j.get("max_chips", 1)),
+                demand=int(j.get("demand", 1)),
+            ))
+        stop = threading.Event()
+        ticker = run_fleet_loop(fleet, stop, interval_s=0.25)
+
+        def steady() -> bool:
+            for name, m in masters.items():
+                st = m.status()
+                if not st["members"]:
+                    return False
+                if not all(st["agents"].get(mm, {}).get("step", 0) >= 5
+                           for mm in st["members"]):
+                    return False
+            return True
+
+        storms: Dict[str, Dict[str, Any]] = {}
+        clients: list = []
+        try:
+            _wait_for(steady, sc.steady_timeout_s,
+                      "every tenant job past step 5")
+            # Arm the timeline now that every tenant trains.
+            t0 = time.time()
+            self.schedule = dict(self.schedule, t0=t0)
+            _write_plan(plan_path, self.schedule)
+            log.info("tenant drill armed at t0=%.3f", t0)
+            # Per-job namespaced storms: byte-identical streams live vs
+            # the fault-free in-process references.
+            threads = []
+            for i, j in enumerate(cfg.get("jobs", [])):
+                name = str(j["name"])
+                client = ShardedPsClient.from_registry(
+                    self.workdir, sc.ps_shards, timeout=2.0,
+                    drain_retry_s=120.0, transient_retry_s=60.0,
+                    namespace=name)
+                ref = LocalPsClient(num_shards=sc.ps_shards,
+                                    coalesce=False, namespace=name)
+                clients.append(client)
+                spec = TableSpec(name="emb", dim=dim, optimizer="adagrad",
+                                 seed=100 + i, lr=0.05)
+                client.create_table(spec)
+                ref.create_table(spec)
+                rng = np.random.default_rng(sc.chaos.seed + i)
+                stream = [
+                    ((rng.zipf(zipf_a, batch) % vocab).astype(np.int64),
+                     rng.standard_normal((batch, dim)).astype(np.float32))
+                    for _ in range(steps)
+                ]
+                out = storms[name] = {
+                    "pushes": 0, "hard_failures": 0, "errors": [],
+                    "_ref": ref, "_stream": stream,
+                }
+
+                def storm(client=client, ref=ref, stream=stream, out=out,
+                          name=name):
+                    for ids, g in stream:
+                        try:
+                            client.push("emb", ids, g, scale=0.1)
+                        except Exception as e:
+                            out["hard_failures"] += 1
+                            if len(out["errors"]) < 5:
+                                out["errors"].append(repr(e))
+                            log.warning("tenant storm %s push failed: %r",
+                                        name, e)
+                            continue
+                        ref.push("emb", ids, g, scale=0.1)
+                        out["pushes"] += 1
+                        time.sleep(pace_s)
+
+                th = threading.Thread(target=storm, daemon=True,
+                                      name=f"storm-{name}")
+                threads.append(th)
+                th.start()
+            # Mid-storm SUBSTRATE snapshot: the rescue anchor for the
+            # scheduled PS shard kill (restore + WAL tail replay — the
+            # real rescue shape, exactly like the zero-loss drills).
+            substrate = ShardedPsClient.from_registry(
+                self.workdir, sc.ps_shards, timeout=5.0,
+                drain_retry_s=60.0, transient_retry_s=30.0)
+            clients.append(substrate)
+            save_timer = threading.Timer(
+                float(cfg.get("save_after_s", 2.0)),
+                lambda: substrate.save(
+                    os.path.join(self.workdir, "ps-ckpt"), 1))
+            save_timer.daemon = True
+            save_timer.start()
+            self._timers.append(save_timer)
+            # Declared scale-ups (the contention trigger).
+            for j in cfg.get("jobs", []):
+                su = j.get("scale_up")
+                if su:
+                    t = threading.Timer(
+                        float(su["at_s"]),
+                        fleet.set_demand, args=(str(j["name"]),
+                                                int(su["demand"])))
+                    t.daemon = True
+                    t.start()
+                    self._timers.append(t)
+            # Scheduled process faults, tenant-aware dispatch.
+            events_thread = threading.Thread(
+                target=self._execute_tenant_events, args=(t0, fleet),
+                daemon=True, name="chaos-tenant-events")
+            events_thread.start()
+            for th in threads:
+                th.join(timeout=600.0)
+            events_thread.join(timeout=120.0)
+
+            def converged() -> bool:
+                if fleet._pending:
+                    return False
+                want = {str(j["name"]): None for j in cfg.get("jobs", [])}
+                alloc = fleet.allocations()
+                target = fleet.arbiter.log[-1]["verdict"]["target"] \
+                    if fleet.arbiter.log else {}
+                return all(alloc.get(n) == target.get(n) for n in want)
+
+            _wait_for(converged, float(cfg.get("settle_s", 30.0)),
+                      "fleet to converge on the arbiter target")
+            # Quiesce the control loop BEFORE evidence: the samples,
+            # moves, and decision log must be final while we copy them.
+            stop.set()
+            ticker.join(timeout=5.0)
+            # ---- evidence: fleet doc + per-job digest parity + drains
+            evidence = fleet.evidence()
+            from easydl_tpu.brain.arbiter import replay_decision_log
+
+            evidence["replay"] = replay_decision_log(
+                evidence["decision_log"])
+            verify_step = 999999
+            live_dir = os.path.join(self.workdir, "tenant-verify-live")
+            # FRESH registry-resolved client for the verify save: the
+            # long-lived substrate client's save path never pushed after
+            # the shard kill, so its routing may still point at the dead
+            # pod.
+            verifier = ShardedPsClient.from_registry(
+                self.workdir, sc.ps_shards, timeout=10.0,
+                drain_retry_s=60.0, transient_retry_s=30.0)
+            clients.append(verifier)
+            verifier.save(live_dir, verify_step)
+            live_digests = _table_digests(live_dir, verify_step)
+            jobs_ev: Dict[str, Any] = {}
+            for name, st in storms.items():
+                ref = st.pop("_ref")
+                st.pop("_stream")
+                ref_dir = os.path.join(self.workdir,
+                                       f"tenant-verify-{name}")
+                ref.save(ref_dir, verify_step)
+                ref_digests = _table_digests(ref_dir, verify_step)
+                prefix = f"{name}{NAMESPACE_SEP}"
+                mine = {t: d for t, d in live_digests.items()
+                        if t.startswith(prefix)}
+                jobs_ev[name] = {
+                    "storm": dict(st),
+                    "live_digests": mine,
+                    "reference_digests": ref_digests,
+                    "digests_match": bool(mine) and mine == ref_digests,
+                }
+            evidence["jobs"] = jobs_ev
+            evidence["preempt_drains"] = [
+                dict(d, quiesce_exits=[
+                    float(r.get("t", 0.0))
+                    for r in invariants.read_timeline(
+                        fleet.jobs[d["job"]].workdir, d["agent"])
+                    if r.get("phase") == "quiesce_exit"
+                ])
+                for d in evidence["preempt_drains"]
+            ]
+            return evidence
+        finally:
+            # Idempotent, and the ONLY cleanup on a failure anywhere
+            # above (steady timeout, storm crash, verify-save failure):
+            # leaked fleet agents would keep worker subprocesses training
+            # under a workdir the runner is about to rmtree.
+            stop.set()
+            ticker.join(timeout=5.0)
+            for c in clients:
+                try:
+                    c.close()
+                except Exception as e:
+                    log.warning("tenant client close failed: %s", e)
+            fleet.stop()
+
+    def _execute_tenant_events(self, t0: float, fleet) -> None:
+        """Tenant-aware process-event executor: ``worker_kill`` targets a
+        JOB (its current member's worker dies with no notice — the
+        unplanned-preemption shape), ``ps_kill`` hits the SHARED
+        substrate. Undeliverable faults log and count nothing — the
+        faults_observed invariant then fails the verdict."""
+        for ev in process_events(self.schedule):
+            delay = (t0 + ev["start_s"]) - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            kind, target = ev["kind"], ev.get("target", {})
+            params = ev.get("params", {})
+            log.info("tenant chaos event %s: %s target=%s", ev["id"], kind,
+                     target)
+            try:
+                if kind == "worker_kill":
+                    job = fleet.jobs[str(target["job"])]
+                    aid = fleet._victim_agent(job)
+                    agent = job.agents.get(aid) if aid else None
+                    alive = (agent is not None
+                             and agent.worker_pid is not None)
+                    self.kill_marks.append({
+                        "t": time.time(), "agent": str(aid),
+                        "job": str(target["job"]), "worker_alive": alive,
+                        "tolerate_dead": bool(params.get("tolerate_dead")),
+                    })
+                    if not alive:
+                        raise RuntimeError(
+                            f"worker_kill: no live worker in job "
+                            f"{target['job']}")
+                    agent.kill_worker_hard()
+                    injectors.count_fault(kind)
+                elif kind == "ps_kill":
+                    self._ps_crash_and_rescue(
+                        int(target["shard"]),
+                        float(params.get("respawn_after_s", 0.5)))
+                else:
+                    raise ValueError(
+                        f"unsupported tenant event kind {kind!r}")
+            except Exception as e:
+                log.warning("tenant event %s (%s) failed: %s", ev["id"],
+                            kind, e)
 
     # ------------------------------------------------------- serve fleet
     def _run_fleet_drill(self) -> Dict[str, Any]:
@@ -1803,6 +2162,11 @@ class ChaosHarness:
                 pass
         if self._master is not None:
             self._master.stop()
+        for m in getattr(self, "_tenant_masters", {}).values():
+            try:
+                m.stop()
+            except Exception as e:
+                log.warning("tenant master stop failed: %s", e)
         if self._pod_api is not None:
             self._pod_api.shutdown()
 
@@ -2568,6 +2932,43 @@ def scenario_rollout_half_update(seed: int = 67) -> Scenario:
     )
 
 
+def scenario_multi_tenant_contention(seed: int = 101) -> Scenario:
+    """The scenario-fleet headline (ISSUE 15): THREE ElasticJobs with
+    priorities 2/1/0 share one PS substrate and a 5-chip agent pool with
+    demand exceeding supply. At t0+4s the high-priority job's demand
+    jumps 1→3: the global arbiter must satisfy it by PREEMPTION — paced
+    one chip per decision with hold-down between moves, donors poorest-
+    priority-first, never below any job's floor, every preempted chip
+    draining (notice → quiesce checkpoint → worker exit) strictly before
+    its agent is killed. Mid-contention a worker SIGKILL hits the
+    high-priority job (unplanned recovery on its own standby) and a PS
+    shard is SIGKILLed + rescued (snapshot + WAL replay) under all three
+    jobs' push storms. Verdict: priorities honored / zero starvation /
+    zero thrash over the recorded decision log, the log re-derived
+    BYTE-IDENTICALLY by the pure arbiter offline, and every job's tables
+    (optimizer rows included) digest-identical to its own fault-free
+    reference — contention, preemption, and faults composed without any
+    tenant losing a row.
+
+    The scenario is DEFINED declaratively — this entry loads
+    scenarios/multi_tenant_contention.yaml through the validating loader
+    (chaos/scenario.py), so the YAML is the single source of truth and a
+    Python twin can never drift from it."""
+    return _yaml_scenario("multi_tenant_contention.yaml", seed)
+
+
+def _yaml_scenario(filename: str, seed: int) -> Scenario:
+    """Catalog entries whose definition lives in scenarios/*.yaml. A seed
+    override re-seeds the compiled fault timeline (chaos_run --seed)."""
+    from easydl_tpu.chaos.scenario import SCENARIOS_DIR, load_scenario_file
+
+    sc = load_scenario_file(os.path.join(SCENARIOS_DIR, filename))
+    if seed != sc.chaos.seed:
+        sc.chaos = ChaosSpec(name=sc.chaos.name, seed=seed,
+                             notes=sc.chaos.notes, faults=sc.chaos.faults)
+    return sc
+
+
 def scenario_straggler_mitigation(seed: int = 47) -> Scenario:
     """Straggler detection + damped eviction (ROADMAP item 3's first named
     invariant): 2s after steady state the member's worker starts sleeping
@@ -2681,6 +3082,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "serve_replica_death_mid_flood": scenario_serve_replica_death_mid_flood,
     "trainer_crash_mid_loop": scenario_trainer_crash_mid_loop,
     "rollout_half_update": scenario_rollout_half_update,
+    "multi_tenant_contention": scenario_multi_tenant_contention,
     "straggler_mitigation": scenario_straggler_mitigation,
     "preempt_race": scenario_preempt_race,
 }

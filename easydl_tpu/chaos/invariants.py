@@ -802,6 +802,92 @@ def check_scenario(
                 "min_rollout_requests": min_req,
             }
 
+    # --------------------------------------------------- multi-tenant (r20)
+    if expect.get("tenant_contention"):
+        # Deferred import: chaos.invariants is imported BY sim.invariants
+        # (the shared window/race cores) — a top-level import back into
+        # the sim package would cycle through its __init__.
+        from easydl_tpu.sim.multijob import check_tenants
+
+        ev: Dict[str, Any] = {}
+        try:
+            with open(os.path.join(workdir, "tenant-evidence.json")) as f:
+                ev = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if not ev:
+            checks["tenant_contention"] = {
+                "ok": False,
+                "reason": "no tenant-evidence.json in the workdir (drill "
+                          "crashed before writing evidence)",
+            }
+        else:
+            # Policy checks over the RECORDED decisions/samples/moves —
+            # the very checks the offline simulator's multi-job mode
+            # runs, plus the byte-identity replay of the decision log
+            # (tenant_replay_identical) through the pure arbiter.
+            policy = check_tenants(ev, dict(expect),
+                                   dict(ev.get("profile") or {}))
+            checks.update(policy["checks"])
+            # Per-job table isolation: every tenant's digests (full row
+            # width — optimizer state included) match its own fault-free
+            # reference, with anti-vacuous floors: >= 2 jobs, every job
+            # actually pushed, zero hard storm failures.
+            jobs = dict(ev.get("jobs") or {})
+            if expect.get("tenant_isolated"):
+                per_job = {
+                    name: {
+                        "digests_match": bool(j.get("digests_match")),
+                        "pushes": int((j.get("storm") or {})
+                                      .get("pushes", 0)),
+                        "hard_failures": int((j.get("storm") or {})
+                                             .get("hard_failures", -1)),
+                        "errors": (j.get("storm") or {}).get("errors"),
+                    }
+                    for name, j in sorted(jobs.items())
+                }
+                ok = (len(per_job) >= 2
+                      and all(v["digests_match"] for v in per_job.values())
+                      and all(v["pushes"] >= 1 for v in per_job.values())
+                      and all(v["hard_failures"] == 0
+                              for v in per_job.values()))
+                checks["tenant_isolated"] = {"ok": ok, "jobs": per_job}
+            # Drain-before-kill on every actuated preemption: the
+            # victim's own quiesce_exit timeline record precedes the
+            # fleet's stop mark, the worker was provably dead at the
+            # stop, and no drain escalated. Vacuous-pass refused.
+            if expect.get("drain_before_kill"):
+                drains = list(ev.get("preempt_drains") or [])
+                if not drains:
+                    checks["tenant_drain_before_kill"] = {
+                        "ok": False,
+                        "reason": "no preemption was actuated — the "
+                                  "drain path was never exercised "
+                                  "(vacuous)",
+                    }
+                else:
+                    races = []
+                    for d in drains:
+                        # Timeline records are wall-clock; the fleet's
+                        # marks are drill-relative — the drain is judged
+                        # on its OWN evidence pair: a quiesce_exit
+                        # recorded at all, worker dead at the stop, and
+                        # no escalation.
+                        races.append({
+                            "job": d.get("job"), "agent": d.get("agent"),
+                            "quiesce_exits": d.get("quiesce_exits"),
+                            "worker_alive_at_stop":
+                                bool(d.get("worker_alive_at_stop")),
+                            "escalated": bool(d.get("escalated")),
+                            "won": (bool(d.get("quiesce_exits"))
+                                    and not d.get("worker_alive_at_stop")
+                                    and not d.get("escalated")),
+                        })
+                    checks["tenant_drain_before_kill"] = {
+                        "ok": all(r["won"] for r in races),
+                        "races": races,
+                    }
+
     # ----------------------------------------------------- faults cross-check
     min_faults = expect.get("min_faults")
     if min_faults is not None:

@@ -1,0 +1,250 @@
+"""Declarative scenario documents: ``scenarios/*.yaml`` → runnable drills.
+
+"As many scenarios as you can imagine" (ROADMAP item 5) only scales if a
+scenario is DATA, not a hand-wired Python topology. A scenario file
+declares jobs × faults × traffic plus the invariants the run must
+satisfy; :func:`load_scenario_file` validates it — every error names the
+file and the field — and compiles it into the same
+:class:`~easydl_tpu.chaos.harness.Scenario` object the built-in catalog
+uses, so one harness (and one ``scripts/scenario_run.py`` command) runs
+them all.
+
+Two kinds:
+
+- ``kind: tenant`` — the multi-tenant drill (ISSUE 15): a ``substrate``
+  block (PS shards, chip supply, arbiter damping), a ``jobs`` list
+  (priority / min / max / demand, optional ``scale_up``), a shared
+  ``traffic`` shape (per-job deterministic push storms), ``faults`` at
+  t0-relative offsets, and ``expect`` — the verdict contract.
+- ``kind: catalog`` — a reference to a built-in drill by name (optional
+  ``seed`` / ``expect`` overrides), so the classic single-job scenarios
+  ride the same directory and runner.
+
+The headline ``multi_tenant_contention`` drill is itself DEFINED by its
+YAML file — ``chaos.harness.scenario_multi_tenant_contention`` loads it —
+so the declarative path is the only path and can never drift from a
+Python twin.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import yaml
+
+from easydl_tpu.chaos.spec import ALL_KINDS, ChaosSpec, FaultSpec
+
+#: fault kinds the tenant drill's executor can deliver
+TENANT_FAULT_KINDS = frozenset({"worker_kill", "ps_kill"})
+
+#: repo-relative default scenario directory
+SCENARIOS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "scenarios")
+
+
+class ScenarioSpecError(ValueError):
+    """A scenario document failed validation; the message names the file
+    (when known) and the offending field."""
+
+
+def _require(doc: Mapping[str, Any], key: str, where: str) -> Any:
+    if key not in doc:
+        raise ScenarioSpecError(f"{where}: missing required key {key!r}")
+    return doc[key]
+
+
+def _check_keys(doc: Mapping[str, Any], allowed: set, where: str) -> None:
+    unknown = sorted(set(doc) - allowed)
+    if unknown:
+        raise ScenarioSpecError(
+            f"{where}: unknown key(s) {unknown}; allowed: {sorted(allowed)}")
+
+
+def _faults(doc: Mapping[str, Any], where: str,
+            job_names: Optional[set] = None,
+            ps_shards: int = 0) -> Tuple[FaultSpec, ...]:
+    out: List[FaultSpec] = []
+    for i, f in enumerate(doc.get("faults") or []):
+        w = f"{where}.faults[{i}]"
+        if not isinstance(f, Mapping):
+            raise ScenarioSpecError(f"{w}: must be a mapping")
+        _check_keys(f, {"kind", "at_s", "duration_s", "jitter_s",
+                        "target", "params"}, w)
+        kind = str(_require(f, "kind", w))
+        if kind not in ALL_KINDS:
+            raise ScenarioSpecError(
+                f"{w}: unknown fault kind {kind!r} (known: "
+                f"{sorted(ALL_KINDS)})")
+        if job_names is not None and kind not in TENANT_FAULT_KINDS:
+            raise ScenarioSpecError(
+                f"{w}: tenant scenarios support only "
+                f"{sorted(TENANT_FAULT_KINDS)}, got {kind!r}")
+        target = dict(f.get("target") or {})
+        if job_names is not None and kind == "worker_kill":
+            job = str(target.get("job", ""))
+            if job not in job_names:
+                raise ScenarioSpecError(
+                    f"{w}: worker_kill target.job {job!r} is not a "
+                    f"declared job (jobs: {sorted(job_names)})")
+        if job_names is not None and kind == "ps_kill":
+            shard = int(target.get("shard", -1))
+            if not 0 <= shard < ps_shards:
+                raise ScenarioSpecError(
+                    f"{w}: ps_kill target.shard {shard} outside the "
+                    f"substrate's {ps_shards} shard(s)")
+        try:
+            out.append(FaultSpec(
+                kind=kind, at_s=float(_require(f, "at_s", w)),
+                duration_s=float(f.get("duration_s", 0.0)),
+                jitter_s=float(f.get("jitter_s", 0.0)),
+                target=target, params=dict(f.get("params") or {}),
+            ))
+        except ValueError as e:
+            raise ScenarioSpecError(f"{w}: {e}") from e
+    return tuple(out)
+
+
+def _tenant_scenario(doc: Mapping[str, Any], where: str):
+    from easydl_tpu.chaos.harness import Scenario
+
+    _check_keys(doc, {"name", "kind", "seed", "description", "substrate",
+                      "jobs", "traffic", "faults", "expect"}, where)
+    sub = dict(_require(doc, "substrate", where))
+    _check_keys(sub, {"ps_shards", "total_chips", "holddown_s",
+                      "max_preemptions", "drain_timeout_s",
+                      "save_after_s", "settle_s"}, f"{where}.substrate")
+    ps_shards = int(sub.get("ps_shards", 2))
+    total_chips = int(_require(sub, "total_chips", f"{where}.substrate"))
+    jobs = list(_require(doc, "jobs", where))
+    if not jobs:
+        raise ScenarioSpecError(f"{where}: jobs must be non-empty")
+    names: set = set()
+    mins = 0
+    out_jobs: List[Dict[str, Any]] = []
+    for i, j in enumerate(jobs):
+        w = f"{where}.jobs[{i}]"
+        _check_keys(dict(j), {"name", "priority", "min_chips", "max_chips",
+                              "demand", "scale_up"}, w)
+        name = str(_require(j, "name", w))
+        if name in names:
+            raise ScenarioSpecError(f"{w}: duplicate job name {name!r}")
+        names.add(name)
+        lo = int(j.get("min_chips", 0))
+        hi = int(j.get("max_chips", max(1, lo)))
+        if lo < 0 or hi < lo:
+            raise ScenarioSpecError(
+                f"{w}: need 0 <= min_chips <= max_chips, got "
+                f"[{lo}, {hi}]")
+        mins += lo
+        jd: Dict[str, Any] = {
+            "name": name, "priority": int(j.get("priority", 0)),
+            "min_chips": lo, "max_chips": hi,
+            "demand": int(j.get("demand", lo or 1)),
+        }
+        su = j.get("scale_up")
+        if su is not None:
+            _check_keys(dict(su), {"at_s", "demand"}, f"{w}.scale_up")
+            jd["scale_up"] = {"at_s": float(_require(su, "at_s",
+                                                     f"{w}.scale_up")),
+                              "demand": int(_require(su, "demand",
+                                                     f"{w}.scale_up"))}
+        out_jobs.append(jd)
+    if mins > total_chips:
+        raise ScenarioSpecError(
+            f"{where}: the floors alone need {mins} chips but the "
+            f"substrate declares total_chips={total_chips} — an "
+            f"infeasible scenario would starve by construction")
+    expect = dict(_require(doc, "expect", where))
+    if not expect:
+        raise ScenarioSpecError(
+            f"{where}: expect must declare at least one invariant — a "
+            "drill that asserts nothing proves nothing")
+    faults = _faults(doc, where, job_names=names, ps_shards=ps_shards)
+    drill = {
+        "total_chips": total_chips,
+        "holddown_s": float(sub.get("holddown_s", 6.0)),
+        "max_preemptions": int(sub.get("max_preemptions", 1)),
+        "drain_timeout_s": float(sub.get("drain_timeout_s", 25.0)),
+        "save_after_s": float(sub.get("save_after_s", 2.0)),
+        "settle_s": float(sub.get("settle_s", 60.0)),
+        "jobs": out_jobs,
+        "traffic": dict(doc.get("traffic") or {}),
+    }
+    return Scenario(
+        chaos=ChaosSpec(
+            name=str(_require(doc, "name", where)),
+            seed=int(doc.get("seed", 0)),
+            notes=str(doc.get("description", "")),
+            faults=faults,
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=ps_shards,
+        steady_timeout_s=300.0,
+        tenant_drill=drill,
+        expect=expect,
+    )
+
+
+def _catalog_scenario(doc: Mapping[str, Any], where: str):
+    from easydl_tpu.chaos import harness
+
+    _check_keys(doc, {"name", "kind", "seed", "description", "scenario",
+                      "expect"}, where)
+    ref = str(_require(doc, "scenario", where))
+    if ref not in harness.SCENARIOS:
+        raise ScenarioSpecError(
+            f"{where}: unknown catalog scenario {ref!r} (known: "
+            f"{sorted(harness.SCENARIOS)})")
+    builder = harness.SCENARIOS[ref]
+    seed = doc.get("seed")
+    sc = builder(int(seed)) if seed is not None else builder()
+    overrides = dict(doc.get("expect") or {})
+    if overrides:
+        sc.expect = dict(sc.expect, **overrides)
+    return sc
+
+
+def load_scenario_doc(doc: Mapping[str, Any], where: str = "<doc>"):
+    """Validate + compile one parsed document into a Scenario."""
+    if not isinstance(doc, Mapping):
+        raise ScenarioSpecError(f"{where}: document must be a mapping")
+    kind = str(doc.get("kind", "tenant"))
+    if kind == "tenant":
+        return _tenant_scenario(doc, where)
+    if kind == "catalog":
+        return _catalog_scenario(doc, where)
+    raise ScenarioSpecError(
+        f"{where}: unknown kind {kind!r} (tenant | catalog)")
+
+
+def load_scenario_file(path: str):
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    return load_scenario_doc(doc, where=os.path.basename(path))
+
+
+def list_scenario_files(directory: Optional[str] = None) -> List[str]:
+    d = directory or SCENARIOS_DIR
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return []
+    return [os.path.join(d, n) for n in names
+            if n.endswith((".yaml", ".yml"))]
+
+
+def load_all(directory: Optional[str] = None) -> Dict[str, Any]:
+    """name → Scenario for every file in the directory; duplicate names
+    across files are an error (one harness command, one namespace)."""
+    out: Dict[str, Any] = {}
+    for path in list_scenario_files(directory):
+        sc = load_scenario_file(path)
+        if sc.name in out:
+            raise ScenarioSpecError(
+                f"{os.path.basename(path)}: duplicate scenario name "
+                f"{sc.name!r}")
+        out[sc.name] = sc
+    return out

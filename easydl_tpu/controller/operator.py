@@ -26,8 +26,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
-from easydl_tpu.api.job_spec import JobSpec, ResourceSpec
+from easydl_tpu.api.job_spec import JobSpec, ResourceSpec, SchedulingSpec
 from easydl_tpu.api.resource_plan import ResourcePlan
+from easydl_tpu.brain.arbiter import (
+    ArbiterConfig,
+    GlobalChipArbiter,
+    JobClaim,
+)
 from easydl_tpu.controller.pod_api import Pod, PodApi
 from easydl_tpu.controller.reconciler import (
     _trailing_index,
@@ -249,10 +254,34 @@ class ElasticJobController:
                  restart_backoff_reset: float = 60.0,
                  trainer_backoff_limit: Optional[int] = None,
                  gc_on_completion: bool = True,
-                 evaluator_gc_grace_s: float = 300.0):
+                 evaluator_gc_grace_s: float = 300.0,
+                 chip_budget: Optional[int] = None,
+                 arbiter_config: Optional[ArbiterConfig] = None):
         self.store = store
         self.pods = pod_api
         self._force_py = force_python_core
+        # Multi-tenant chip arbitration (ISSUE 15): with a chip_budget,
+        # worker replicas are no longer each plan's private ask — the
+        # global arbiter (brain/arbiter.py) levels every job's worker
+        # count against the shared supply by CR priority/min/max, and a
+        # higher-priority scale-up preempts a lower-priority job's pods
+        # (scale_down DELETE → SIGTERM → the agent's preempt-notice
+        # drain), paced by the arbiter's hold-down. None = the classic
+        # single-tenant behavior, untouched.
+        self._chip_budget = chip_budget
+        self._arbiter = (GlobalChipArbiter(arbiter_config)
+                         if chip_budget is not None else None)
+        # One arbitration per SWEEP, not per job: building claims lists
+        # every job's pods, so deciding inside each per-job reconcile
+        # would cost O(jobs^2) pod listings per sweep — and a single
+        # decision leveling every job from one consistent snapshot is
+        # also the correct semantics. Cached briefly; the level-triggered
+        # resync re-decides as pod counts converge.
+        # (expires_at, demand fingerprint, allocations): the fingerprint
+        # — every job's applied plan version — invalidates instantly on
+        # any plan change (a fresh scale-up must never wait out the TTL),
+        # while the TTL bounds pod-count staleness between resyncs.
+        self._arb_cache: Tuple[float, tuple, Dict[str, int]] = (0.0, (), {})
         # k8s Job backoffLimit analogue: None = restart the trainer forever
         # (reference elasticity semantics); an int latches the job Failed
         # after that many CONSECUTIVE trainer failures.
@@ -326,6 +355,57 @@ class ElasticJobController:
         level-triggered resync retries them once the backoff expires)."""
         entry = self._backoff.get((job, role))
         return entry is not None and time.monotonic() < entry[2]
+
+    # ---------------------------------------------------- chip arbitration
+    def _arbitrated_workers(self, job_name: str) -> Optional[int]:
+        """One global arbitration round over every live job's claim;
+        returns ``job_name``'s post-move worker allocation (None when the
+        job has no claim — e.g. no plan yet). Every job's claim is built
+        from its CR scheduling block + its plan's worker ask + its LIVE
+        pod count, so the same decision levels every tenant consistently
+        no matter which job's event triggered this pass."""
+        fingerprint = tuple(sorted(
+            (jn, getattr(self.store.plan(jn), "version", -1))
+            for jn in self.store.jobs()
+        ))
+        expires, key, cached = self._arb_cache
+        if time.monotonic() < expires and key == fingerprint:
+            return cached.get(job_name)
+        claims = []
+        for jn in self.store.jobs():
+            job = self.store.job(jn)
+            plan = self.store.plan(jn)
+            if job is None or plan is None or "worker" not in plan.roles:
+                continue
+            status = self.store.job_status(jn) or {}
+            if status.get("phase") in TERMINAL_PHASES:
+                continue  # a finished job holds no chips
+            sched = job.scheduling or SchedulingSpec()
+            demand = plan.replicas("worker")
+            allocated = sum(
+                1 for p in self.pods.list_pods(jn)
+                if p.role == "worker" and p.phase in ("Pending", "Running")
+            )
+            claims.append(JobClaim(
+                name=jn, priority=sched.priority,
+                min_chips=sched.min_replicas,
+                # maxReplicas 0 = uncapped: the envelope must not clamp
+                # the ask below what the plan demands
+                max_chips=(sched.max_replicas
+                           or max(demand, sched.min_replicas)),
+                demand=demand, allocated=allocated,
+            ))
+        if not any(c.name == job_name for c in claims):
+            return None
+        decision = self._arbiter.decide(claims, self._chip_budget,
+                                        time.monotonic())
+        # The operator is long-lived; the decision log is for forensics,
+        # not unbounded growth.
+        del self._arbiter.log[:-256]
+        allocations = {str(k): int(v)
+                       for k, v in decision["allocations"].items()}
+        self._arb_cache = (time.monotonic() + 0.5, fingerprint, allocations)
+        return allocations.get(job_name)
 
     # ------------------------------------------------------------- reconcile
     def reconcile_job(self, job_name: str) -> JobStatus:
@@ -463,6 +543,17 @@ class ElasticJobController:
                     name=plan.name, job_name=plan.job_name, roles=roles,
                     resource_updation=plan.resource_updation, version=plan.version,
                 )
+            if self._arbiter is not None and "worker" in plan_for_diff.roles:
+                workers = self._arbitrated_workers(job_name)
+                if workers is not None \
+                        and workers != plan_for_diff.replicas("worker"):
+                    log.info(
+                        "%s: chip arbitration levels workers %d -> %d "
+                        "(budget %s)", job_name,
+                        plan_for_diff.replicas("worker"), workers,
+                        self._chip_budget,
+                    )
+                    plan_for_diff = plan_for_diff.with_role("worker", workers)
             ops, sigs = reconcile(
                 job_name, plan_for_diff, observed, force_python=self._force_py
             )

@@ -251,15 +251,23 @@ def run_worker(env: Dict[str, str]) -> int:
                 "embedding='ps' requires model_kwargs['dim'] so the PS "
                 "table matches the model's embedding dim"
             )
+        # Shared-substrate knobs (ROADMAP item 5): `ps_workdir` points at
+        # a PS fleet OUTSIDE this job's workdir (N jobs, one shard
+        # fleet), and `ps_namespace` prefixes every table name so the
+        # tenants can never touch each other's rows. Defaults preserve
+        # the single-tenant shape exactly.
+        ps_dir = str(cfg.get("ps_workdir", "")) or workdir
         try:
-            num_shards, addrs = ps_registry.discover(workdir, timeout=120)
+            num_shards, addrs = ps_registry.discover(ps_dir, timeout=120)
         except TimeoutError as e:
             raise RuntimeError(
-                f"embedding='ps' but the PS registry under {workdir}/ps "
+                f"embedding='ps' but the PS registry under {ps_dir}/ps "
                 f"never completed — is the parameter_server role running? "
                 f"({e})"
             ) from e
-        ps_client = ShardedPsClient(addrs, registry_workdir=workdir)
+        ps_client = ShardedPsClient(
+            addrs, registry_workdir=ps_dir,
+            namespace=str(cfg.get("ps_namespace", "")))
         trainer = PsTrainer(
             init_fn=bundle.init_fn,
             loss_fn=bundle.loss_fn,
@@ -411,15 +419,29 @@ def run_worker(env: Dict[str, str]) -> int:
     if latest >= 0:
         start_step = latest
         if ps_mode and rank == 0:
-            try:
-                trainer.client.restore(ps_ckpt_dir, step=latest)
-                log.info("gen %d: ps tier restored to step %d", generation,
-                         latest)
-            except FileNotFoundError:
+            if getattr(trainer.client, "namespace", ""):
+                # Shared multi-job tier (ps_namespace set): a tier-wide
+                # rollback would drag every OTHER tenant's tables back to
+                # this job's snapshot — tenant isolation outranks
+                # single-job exactly-once, so the redone window re-pushes
+                # on top of the live rows instead (the classic async-PS
+                # recovery semantics; docs/operations.md §18).
                 log.warning(
-                    "gen %d: no ps snapshot for step %d — sparse rows keep "
-                    "their live (post-checkpoint) values", generation, latest,
+                    "gen %d: namespaced PS tier — skipping sparse rollback "
+                    "to step %d; redone steps re-apply onto live rows",
+                    generation, latest,
                 )
+            else:
+                try:
+                    trainer.client.restore(ps_ckpt_dir, step=latest)
+                    log.info("gen %d: ps tier restored to step %d",
+                             generation, latest)
+                except FileNotFoundError:
+                    log.warning(
+                        "gen %d: no ps snapshot for step %d — sparse rows "
+                        "keep their live (post-checkpoint) values",
+                        generation, latest,
+                    )
         if ps_mode and world > 1:
             # every rank must observe the restored rows before training
             multihost_utils.sync_global_devices(f"ps_restore_{generation}")

@@ -159,6 +159,14 @@ class _PsClientBase:
 
     num_shards: int
     coalesce: bool = True
+    #: per-job table namespace (ROADMAP item 5): when set, every PUBLIC
+    #: table-name argument is prefixed ``<namespace>::`` before it touches
+    #: routing, the wire, or the store — N jobs share one shard fleet with
+    #: zero overlap, and the WAL / rescue / reshard / shm paths (all keyed
+    #: on the full table name) isolate unchanged. ``save``/``restore``/
+    #: ``stats`` stay TIER-wide by design: the substrate snapshots every
+    #: tenant's tables together (per-job views filter on the prefix).
+    namespace: str = ""
     # Guards lazy pool creation (class-level: trivially race-free; contended
     # only during the one-time init).
     _pool_lock = threading.Lock()
@@ -300,7 +308,16 @@ class _PsClientBase:
         raise NotImplementedError
 
     # ------------------------------------------------------------------- api
+    def _ns(self, table: str) -> str:
+        from easydl_tpu.ps.table import namespaced
+
+        return namespaced(self.namespace, table) if self.namespace else table
+
     def create_table(self, spec: TableSpec) -> None:
+        if self.namespace:
+            import dataclasses
+
+            spec = dataclasses.replace(spec, name=self._ns(spec.name))
         self._for_all(lambda s: self._create_shard(s, spec))
         self._dims[spec.name] = spec.dim
 
@@ -310,6 +327,7 @@ class _PsClientBase:
         (optional) collects the per-shard table push-versions the rows
         were read under — the caching layer's invalidation meta; plain
         callers never pay for it."""
+        table = self._ns(table)
         ids = np.asarray(ids)
         flat = ids.reshape(-1).astype(np.int64)
         if flat.size == 0:
@@ -377,6 +395,7 @@ class _PsClientBase:
 
     def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
              scale: float = 1.0) -> None:
+        table = self._ns(table)
         ids = np.asarray(ids)
         flat = ids.reshape(-1).astype(np.int64)
         g = np.ascontiguousarray(grads, np.float32).reshape(len(flat), -1)
@@ -429,15 +448,38 @@ class _PsClientBase:
         )
 
     def save(self, directory: str, step: int) -> None:
-        self._for_all(lambda s: self._save_shard(s, directory, step))
+        """Snapshot to ``directory``. A namespaced client saves ONLY its
+        own tables (wire ``prefix`` scoping): a tenant's checkpoint on a
+        shared tier must never contain — let alone later roll back —
+        another job's rows."""
+        prefix = ""
+        if self.namespace:
+            from easydl_tpu.ps.table import NAMESPACE_SEP
+
+            prefix = self.namespace + NAMESPACE_SEP
+        self._for_all(lambda s: self._save_shard(s, directory, step,
+                                                 prefix=prefix))
 
     def restore(self, directory: str, step: int = -1) -> None:
+        if self.namespace:
+            # A tier-wide restore from a namespaced client would roll
+            # EVERY tenant's tables back to this job's snapshot, and a
+            # scoped in-place rollback is not WAL-logged yet — a shard
+            # rescue after it would replay the pre-restore pushes on top
+            # and silently diverge. Refuse loudly until a logged scoped
+            # import exists; tenant shard faults recover through the
+            # substrate's own WAL rescue instead (drill-proven).
+            raise RuntimeError(
+                "restore() is tier-wide and this client is namespaced "
+                f"({self.namespace!r}); a shared multi-job tier cannot "
+                "be rolled back by one tenant")
         self._for_all(lambda s: self._restore_shard(s, directory, step))
 
     def stats(self) -> List[pb.PsStatsResponse]:
         return self._for_all(self._stats_shard)
 
     def total_rows(self, table: str) -> int:
+        table = self._ns(table)
         return sum(
             t.rows for st in self.stats() for t in st.tables if t.name == table
         )
@@ -461,10 +503,11 @@ class LocalPsClient(_PsClientBase):
     """
 
     def __init__(self, num_shards: int = 1, backend: str = "auto",
-                 coalesce: Optional[bool] = None):
+                 coalesce: Optional[bool] = None, namespace: str = ""):
         self.num_shards = num_shards
         self.coalesce = (_env_flag("EASYDL_PS_COALESCE", False)
                         if coalesce is None else coalesce)
+        self.namespace = namespace
         self._dims: Dict[str, int] = {}
         self.shards = [
             PsShard(shard_index=i, num_shards=num_shards, backend=backend)
@@ -487,6 +530,7 @@ class LocalPsClient(_PsClientBase):
         return t.pull(ids)
 
     def probe_versions(self, table, shards):
+        table = self._ns(table)
         out = {}
         for s in shards:
             try:
@@ -502,8 +546,8 @@ class LocalPsClient(_PsClientBase):
     def _create_shard(self, s, spec):
         self.shards[s].create_table(spec)
 
-    def _save_shard(self, s, directory, step):
-        self.shards[s].save(directory, step)
+    def _save_shard(self, s, directory, step, prefix=""):
+        self.shards[s].save(directory, step, prefix=prefix)
 
     def _restore_shard(self, s, directory, step):
         self.shards[s].restore(directory, step)
@@ -558,8 +602,10 @@ class ShardedPsClient(_PsClientBase):
                  pull_fp16: Optional[bool] = None,
                  pull_i8: Optional[bool] = None,
                  pull_shm: Optional[bool] = None,
-                 chunk_bytes: Optional[int] = None):
+                 chunk_bytes: Optional[int] = None,
+                 namespace: str = ""):
         self.addresses = list(addresses)
+        self.namespace = namespace
         self.num_shards = len(self.addresses)
         self._timeout = timeout
         self.coalesce = (_env_flag("EASYDL_PS_COALESCE", True)
@@ -992,6 +1038,8 @@ class ShardedPsClient(_PsClientBase):
         the shard: the caller's cached rows for it count as unvalidated,
         which degrades to a plain re-pull — the retriable path — never
         to serving a possibly-stale row."""
+        table = self._ns(table)
+
         def probe(s):
             try:
                 with self._routing_lock:
@@ -1359,8 +1407,9 @@ class ShardedPsClient(_PsClientBase):
         if not ack.ok:
             raise RuntimeError(f"ps shard {s} create_table failed: {ack.message}")
 
-    def _save_shard(self, s, directory, step):
-        ack = self._clients[s].Save(pb.PsSaveRequest(directory=directory, step=step))
+    def _save_shard(self, s, directory, step, prefix=""):
+        ack = self._clients[s].Save(pb.PsSaveRequest(
+            directory=directory, step=step, prefix=prefix))
         if not ack.ok:
             raise RuntimeError(f"ps shard {s} save failed: {ack.message}")
 

@@ -347,11 +347,28 @@ class PsShard:
     # ------------------------------------------------------------ checkpoint
     def save(self, directory: str, step: int,
              marker_expected: int | None = None,
-             retire_wal: bool = True) -> None:
+             retire_wal: bool = True, prefix: str = "") -> None:
         """``marker_expected`` overrides the completeness count written to
         the done marker (default: the cluster's shard count). A migration
         save (one shard alone in its own directory) passes 1 so the
         replacement's restore sees it as complete.
+
+        ``prefix`` (ISSUE 15) scopes the snapshot to one tenant of a
+        shared multi-job tier: only tables whose name starts with it are
+        exported, and — critically — NONE of the WAL bookkeeping runs
+        (no segment cut, no cut marker, no retirement, no replay-digest
+        clear): the log and its markers are the SHARD's durability
+        anchor and keep covering every other tenant's rows. A tenant
+        snapshot is a read-only export, never a recovery boundary — so
+        it also writes NO ``.done`` completeness markers: a scoped step
+        with markers in the shard's rescue dir (the shared-workdir
+        topology puts tenant ps-ckpt saves exactly there) would register
+        as the newest restorable step, and the next rescue would restore
+        a PARTIAL tier with no cut marker and then replay the whole
+        surviving WAL on top of pushes the snapshot already contains —
+        permanent divergence. ``saved_steps()`` requiring markers is what
+        makes scoped exports structurally invisible to every restore
+        path (tenant-scoped restore is refused client-side anyway).
 
         WAL interplay: the segment cut and the row export happen under one
         hold of the ordering lock, so the snapshot contains exactly the
@@ -376,7 +393,12 @@ class PsShard:
         os.makedirs(d, exist_ok=True)
         retired_segments: list = []
         cut_first_live = None
-        if self._wal is not None:
+        if prefix:
+            with self._wal_mu if self._wal is not None else self._lock:
+                exports = [(name, t.spec, *t.export_rows())
+                           for name, t in list(self._tables.items())
+                           if name.startswith(prefix)]
+        elif self._wal is not None:
             with self._wal_mu:
                 retired_segments = self._wal.cut()
                 cut_first_live = os.path.basename(self._wal.path)
@@ -418,16 +440,19 @@ class PsShard:
                            "first_live_segment": cut_first_live}, f)
             os.replace(tmp, cut_path)
         # done marker lets restorers skip torn saves; the content records the
-        # shard count so completeness = all n markers present.
+        # shard count so completeness = all n markers present. Prefix
+        # (tenant-scoped) saves write NONE: they must never become a
+        # restorable step in any rescue lineage (see the docstring).
         expected = (marker_expected if marker_expected is not None
                     else self.num_shards)
-        with open(os.path.join(d, f".done-{self.shard_index}"), "w") as f:
-            f.write(str(expected))
+        if not prefix:
+            with open(os.path.join(d, f".done-{self.shard_index}"), "w") as f:
+                f.write(str(expected))
         # `_reshard_active` blocks retirement outright: once this shard cut
         # its export boundary, records past it belong to the destinations'
         # tail replay — a concurrent trainer ps-ckpt save must not garbage-
         # collect them out from under the migration.
-        if (self._wal is not None and retire_wal
+        if (self._wal is not None and retire_wal and not prefix
                 and not self._reshard_active
                 and self._covers_rescue(directory)
                 and len(glob.glob(os.path.join(d, ".done-*"))) >= expected):
@@ -1063,7 +1088,7 @@ class PsShard:
 
     def Save(self, req: pb.PsSaveRequest, ctx) -> pb.Ack:
         try:
-            self.save(req.directory, req.step)
+            self.save(req.directory, req.step, prefix=req.prefix)
             return pb.Ack(ok=True)
         except OSError as e:
             return pb.Ack(ok=False, message=str(e))

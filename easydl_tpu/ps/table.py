@@ -28,6 +28,42 @@ from easydl_tpu.utils.env import env_flag as _env_flag
 
 OPTIMIZERS = {"sgd": 0, "adagrad": 1}
 
+#: Separator between a job namespace and the table's own name. Chosen to
+#: be filename-safe (shard snapshots are ``<table>.shard-i-of-n.npz``) and
+#: impossible in a valid namespace, so :func:`split_namespace` is
+#: unambiguous.
+NAMESPACE_SEP = "::"
+
+_NS_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+
+
+def namespaced(namespace: str, table: str) -> str:
+    """Prefix ``table`` with a job namespace — the multi-tenancy seam
+    (ROADMAP item 5): N jobs share one shard fleet, and every path keyed
+    on the table NAME (store maps, WAL records, snapshot files, reshard
+    exports, shm segments, metric labels) isolates for free because the
+    namespace rides inside the name. Raises on a namespace that could
+    break a filename or make the split ambiguous."""
+    if not namespace:
+        raise ValueError("namespace must be non-empty")
+    if not set(namespace) <= _NS_OK:
+        raise ValueError(
+            f"namespace {namespace!r} has characters outside [A-Za-z0-9._-]"
+        )
+    if NAMESPACE_SEP in table:
+        raise ValueError(
+            f"table {table!r} already carries a namespace separator"
+        )
+    return f"{namespace}{NAMESPACE_SEP}{table}"
+
+
+def split_namespace(table: str) -> Tuple[str, str]:
+    """Inverse of :func:`namespaced`: ``(namespace, base_name)`` — with
+    ``("", table)`` for un-namespaced tables."""
+    head, sep, tail = table.partition(NAMESPACE_SEP)
+    return (head, tail) if sep else ("", table)
+
 #: Debug/benchmark escape hatch: force the pre-vectorization per-id python
 #: loops in _NumpyStore (the pre-PR hot path). Parity tests compare the two;
 #: scripts/bench_ps.py uses it for honest before/after numbers.

@@ -14,6 +14,10 @@ Entry points: :func:`easydl_tpu.sim.simulator.simulate` in-process, or
 ``python scripts/policy_replay.py`` from a shell / chaos_smoke.sh.
 """
 
+from easydl_tpu.sim.multijob import (  # noqa: F401
+    simulate_tenants, synthetic_tenant_contention,
+    synthetic_tenant_starvation,
+)
 from easydl_tpu.sim.rollout import (  # noqa: F401
     simulate_rollout, synthetic_rollout_pacing,
 )

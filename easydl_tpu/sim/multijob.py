@@ -1,0 +1,324 @@
+"""Offline replay of the global chip arbiter — the multi-job mode of the
+PR-8 simulator (ROADMAP item 5).
+
+A tenant timeline carries ``meta.tenant_profile``: the chip supply, the
+arbiter config under test, and per-job claims (priority, min/max) with a
+demand timeline (scale-up asks at virtual timestamps).
+:func:`simulate_tenants` drives the REAL
+:class:`easydl_tpu.brain.arbiter.GlobalChipArbiter` through it on a
+virtual clock — no wall time, no RNG — actuating every grant/preemption
+instantly and judging the fleet-level invariants the live drill asserts
+over hours in milliseconds:
+
+- ``tenant_priorities_honored`` — in every feasible decision's target, no
+  job sits below its clamped demand while a strictly-lower-priority job
+  holds above its floor;
+- ``tenant_no_starvation`` — no job with live demand holds ZERO chips for
+  longer than the grace window (a claims-set whose floors permit
+  starvation — ``min_chips=0`` under a saturating high-priority demand —
+  is the negative control this check must CATCH);
+- ``tenant_no_thrash`` — no chip ping-pong: a move A→B followed by B→A
+  inside one hold-down window is flapping, wherever it came from;
+- ``tenant_converged`` — the final allocations equal the scenario's
+  declared outcome (and anti-vacuous floors: the contention scenario must
+  actually have preempted);
+- ``tenant_replay_identical`` — every decision's recorded inputs
+  re-derive the identical verdict bytes through the pure function (the
+  same gate the live drill's decision log rides).
+
+Same timeline + same config ⇒ byte-identical verdict (chaos_smoke.sh
+replays the committed fixture twice and compares bytes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+from easydl_tpu.brain.arbiter import (
+    ArbiterConfig,
+    GlobalChipArbiter,
+    JobClaim,
+    replay_decision_log,
+)
+
+
+def _r6(x: float) -> float:
+    return round(float(x), 6)
+
+
+def synthetic_tenant_contention(total_chips: int = 5,
+                                scale_up_at_s: float = 30.0,
+                                duration_s: float = 90.0,
+                                decide_every_s: float = 2.0,
+                                holddown_s: float = 10.0) -> Dict[str, Any]:
+    """The 3-job contention shape the headline drill runs live: priorities
+    2/1/0 over ``total_chips`` with floors of 1 each; at ``scale_up_at_s``
+    the high-priority job's demand jumps 1→3 with the supply exhausted, so
+    satisfying it REQUIRES preemption — paced one chip per decision by the
+    cap and the hold-down, never below any job's floor."""
+    from easydl_tpu.sim.timeline import make_timeline
+
+    profile = {
+        "total_chips": int(total_chips),
+        "config": {"holddown_s": _r6(holddown_s),
+                   "max_preemptions_per_decision": 1},
+        "decide_every_s": _r6(decide_every_s),
+        "duration_s": _r6(duration_s),
+        "jobs": [
+            {"name": "hi", "priority": 2, "min_chips": 1, "max_chips": 3,
+             "demand": [[0.0, 1], [_r6(scale_up_at_s), 3]]},
+            {"name": "mid", "priority": 1, "min_chips": 1, "max_chips": 2,
+             "demand": [[0.0, 2]]},
+            {"name": "lo", "priority": 0, "min_chips": 1, "max_chips": 2,
+             "demand": [[0.0, 2]]},
+        ],
+    }
+    return make_timeline("tenant_contention", agents={}, faults=[],
+                         meta={"tenant_profile": profile})
+
+
+def synthetic_tenant_starvation(total_chips: int = 4,
+                                duration_s: float = 90.0) -> Dict[str, Any]:
+    """The starvation-prone configuration (negative control): the low-
+    priority job declares NO floor (``min_chips=0``) while the high-
+    priority job's demand saturates the whole supply — the arbiter,
+    honoring priorities exactly as specified, starves the low job forever.
+    ``tenant_no_starvation`` must CATCH this."""
+    from easydl_tpu.sim.timeline import make_timeline
+
+    profile = {
+        "total_chips": int(total_chips),
+        "config": {"holddown_s": 10.0, "max_preemptions_per_decision": 1},
+        "decide_every_s": 2.0,
+        "duration_s": _r6(duration_s),
+        "jobs": [
+            {"name": "hi", "priority": 2, "min_chips": 0,
+             "max_chips": int(total_chips), "demand": [[0.0, total_chips]]},
+            {"name": "lo", "priority": 0, "min_chips": 0, "max_chips": 2,
+             "demand": [[0.0, 2]]},
+        ],
+    }
+    return make_timeline("tenant_starvation", agents={}, faults=[],
+                         meta={"tenant_profile": profile})
+
+
+def _demand_at(timeline: List[List[float]], t: float) -> int:
+    d = 0
+    for ev_t, ev_d in timeline:
+        if float(ev_t) <= t:
+            d = int(ev_d)
+    return d
+
+
+def thrash_violations(moves: List[Mapping[str, Any]],
+                      holddown_s: float) -> List[Dict[str, Any]]:
+    """ONE copy of the no-thrash rule (live drill checker + sim): a chip
+    moving A→B and then B→A with both moves inside one hold-down window
+    is a ping-pong, whatever reasons each leg claimed."""
+    out: List[Dict[str, Any]] = []
+    for i, m in enumerate(moves):
+        src, dst = str(m.get("from", "")), str(m.get("to", ""))
+        if not src:
+            continue  # free-pool grant: nothing to bounce back to
+        for later in moves[i + 1:]:
+            if float(later.get("t", 0.0)) - float(m.get("t", 0.0)) \
+                    > holddown_s:
+                break
+            if (str(later.get("from", "")) == dst
+                    and str(later.get("to", "")) == src):
+                out.append({"first": dict(m), "reverse": dict(later)})
+    return out
+
+
+def check_tenants(result: Mapping[str, Any], expect: Dict[str, Any],
+                  profile: Mapping[str, Any]) -> Dict[str, Any]:
+    """The invariant half, shared by the synthetic catalog and the live
+    drill's offline cross-check (chaos/invariants.py feeds the drill's
+    recorded samples/moves through the same checks)."""
+    checks: Dict[str, Dict[str, Any]] = {}
+    samples = list(result.get("allocation_samples", []))
+    moves = list(result.get("moves", []))
+    decisions = list(result.get("decision_log", []))
+    jobs = {str(j["name"]): j for j in profile.get("jobs", [])}
+    holddown = float(dict(profile.get("config", {})).get("holddown_s", 30.0))
+
+    if expect.get("priorities_honored"):
+        violations: List[Dict[str, Any]] = []
+        for rec in decisions:
+            verdict = dict(rec.get("verdict") or {})
+            if not verdict.get("feasible", True):
+                continue
+            target = {str(k): int(v)
+                      for k, v in dict(verdict.get("target", {})).items()}
+            claims = {str(c["name"]): c
+                      for c in dict(rec.get("inputs", {})).get("claims", [])}
+            for a, ca in claims.items():
+                want_a = JobClaim(**{k: ca[k] for k in (
+                    "name", "priority", "min_chips", "max_chips",
+                    "demand", "allocated")}).clamped_demand()
+                if target.get(a, 0) >= want_a:
+                    continue
+                for b, cb in claims.items():
+                    if int(cb["priority"]) < int(ca["priority"]) \
+                            and target.get(b, 0) > int(cb["min_chips"]):
+                        violations.append({
+                            "t": verdict.get("now"), "starved": a,
+                            "above_floor": b, "target": target,
+                        })
+        checks["tenant_priorities_honored"] = {
+            "ok": bool(decisions) and not violations,
+            "decisions": len(decisions),
+            "violations": violations[:5],
+        }
+
+    if expect.get("no_starvation"):
+        grace = float(expect.get("starvation_grace_s", 3 * holddown))
+        starved: List[Dict[str, Any]] = []
+        for name, job in sorted(jobs.items()):
+            run_start: Optional[float] = None
+            worst = 0.0
+            for s in samples:
+                t = float(s["t"])
+                demand = _demand_at(list(job.get("demand", [])), t)
+                alloc = int(dict(s.get("allocations", {})).get(name, 0))
+                if demand >= 1 and alloc == 0:
+                    run_start = t if run_start is None else run_start
+                    worst = max(worst, t - run_start)
+                else:
+                    run_start = None
+            if worst >= grace:
+                starved.append({"job": name, "starved_for_s": _r6(worst)})
+        checks["tenant_no_starvation"] = {
+            "ok": bool(samples) and not starved,
+            "grace_s": _r6(grace),
+            "samples": len(samples),
+            "starved": starved,
+        }
+
+    if expect.get("no_thrash"):
+        violations = thrash_violations(moves, holddown)
+        checks["tenant_no_thrash"] = {
+            "ok": not violations,
+            "moves": len(moves),
+            "holddown_s": _r6(holddown),
+            "violations": violations,
+        }
+
+    want_final = expect.get("final_allocations")
+    if want_final is not None:
+        got = dict(samples[-1]["allocations"]) if samples else {}
+        checks["tenant_converged"] = {
+            "ok": got == {str(k): int(v) for k, v in want_final.items()},
+            "final_allocations": got,
+            "want": dict(want_final),
+        }
+
+    min_preempt = expect.get("min_preemptions")
+    if min_preempt is not None:
+        n = sum(1 for m in moves if m.get("from"))
+        checks["tenant_preempted"] = {
+            "ok": n >= int(min_preempt),
+            "preemptions": n, "min_preemptions": int(min_preempt),
+        }
+    max_moves = expect.get("max_moves")
+    if max_moves is not None:
+        checks["tenant_moves_bounded"] = {
+            "ok": len(moves) <= int(max_moves),
+            "moves": len(moves), "max_moves": int(max_moves),
+        }
+
+    rep = replay_decision_log(decisions)
+    checks["tenant_replay_identical"] = {
+        "ok": bool(rep["identical"]),
+        "decisions": rep["decisions"],
+        "mismatches": rep["mismatches"],
+    }
+
+    return {"passed": all(c["ok"] for c in checks.values()) and bool(checks),
+            "checks": checks}
+
+
+def simulate_tenants(timeline: Mapping[str, Any],
+                     config_override: Optional[Mapping[str, Any]] = None,
+                     expect: Optional[Mapping[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Replay the profile through the real arbiter on the virtual clock.
+    ``config_override`` (the negative controls' lever) wins over the
+    profile's own arbiter config. Moves actuate instantly — the live
+    fleet pays a drain per preempted chip; the subject here is the
+    DECISION sequence, which the drill's decision log ties back to the
+    live run byte-for-byte."""
+    profile = dict(dict(timeline.get("meta", {})).get(
+        "tenant_profile") or {})
+    if not profile:
+        raise ValueError("timeline has no meta.tenant_profile")
+    cfg_doc = dict(profile.get("config") or {})
+    if config_override:
+        cfg_doc.update(dict(config_override))
+    config = ArbiterConfig(
+        holddown_s=float(cfg_doc.get("holddown_s", 30.0)),
+        max_preemptions_per_decision=int(
+            cfg_doc.get("max_preemptions_per_decision", 1)),
+    )
+    arbiter = GlobalChipArbiter(config)
+    jobs = [dict(j) for j in profile.get("jobs", [])]
+    total = int(profile.get("total_chips", 0))
+    decide_every = float(profile.get("decide_every_s", 1.0))
+    duration = float(profile.get("duration_s", 60.0))
+    allocations: Dict[str, int] = {str(j["name"]): 0 for j in jobs}
+    samples: List[Dict[str, Any]] = []
+    moves: List[Dict[str, Any]] = []
+
+    now = 0.0
+    while now <= duration:
+        claims = [
+            JobClaim(
+                name=str(j["name"]), priority=int(j.get("priority", 0)),
+                min_chips=int(j.get("min_chips", 0)),
+                max_chips=int(j.get("max_chips", 1)),
+                demand=_demand_at(list(j.get("demand", [])), now),
+                allocated=allocations[str(j["name"])],
+            )
+            for j in jobs
+        ]
+        decision = arbiter.decide(claims, total, now)
+        for g in decision["grants"]:
+            allocations[str(g["to"])] += int(g["chips"])
+            moves.append({"t": _r6(now), "from": "", "to": str(g["to"]),
+                          "chips": int(g["chips"])})
+        for p in decision["preemptions"]:
+            allocations[str(p["from"])] -= int(p["chips"])
+            allocations[str(p["to"])] += int(p["chips"])
+            moves.append({"t": _r6(now), "from": str(p["from"]),
+                          "to": str(p["to"]), "chips": int(p["chips"])})
+        for r in decision.get("reclaims", []):
+            # Overcommit shed (unreachable under the sim's instant
+            # actuation, actuated anyway so a future move-latency model
+            # can't silently desync holdings from the decisions).
+            allocations[str(r["from"])] -= int(r["chips"])
+            moves.append({"t": _r6(now), "from": str(r["from"]),
+                          "to": "", "chips": int(r["chips"])})
+        samples.append({"t": _r6(now),
+                        "allocations": dict(sorted(allocations.items()))})
+        now = _r6(now + decide_every)
+
+    result: Dict[str, Any] = {
+        "name": str(timeline.get("name", "tenants")),
+        "kind": "tenant_replay",
+        "config": config.to_dict(),
+        "total_chips": total,
+        "decision_log": arbiter.log,
+        "decisions": len(arbiter.log),
+        "moves": moves,
+        "allocation_samples": samples,
+        "final_allocations": dict(sorted(allocations.items())),
+        "events_simulated": len(arbiter.log),
+        "sim_end_t": _r6(min(now, duration)),
+        "reshapes": [],
+    }
+    if expect is not None:
+        verdict = check_tenants(result, dict(expect), profile)
+        result["expect"] = dict(expect)
+        result["invariants"] = verdict
+        result["passed"] = verdict["passed"]
+    return result

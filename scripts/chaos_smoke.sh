@@ -28,6 +28,13 @@ cd "$(dirname "$0")/.."
 # in seconds here, not after a seven-minute drill chases the symptom.
 python scripts/easylint.py
 
+# Scenario-directory gate (docs/scenarios.md): every scenarios/*.yaml must
+# load + validate — a malformed spec fails here in milliseconds, not
+# mid-drill. The headline multi_tenant_contention drill below RUNS from
+# its YAML (the catalog entry loads it), so this also guards the drill's
+# own definition.
+python scripts/scenario_run.py --list
+
 LOG=$(mktemp)
 trap 'rm -f "$LOG"' EXIT
 
@@ -38,7 +45,8 @@ env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
     --scenario serve_during_reshard \
     --scenario serve_replica_death_mid_flood \
     --scenario trainer_crash_mid_loop \
-    --scenario rollout_half_update --keep-workdir "$@" \
+    --scenario rollout_half_update \
+    --scenario multi_tenant_contention --keep-workdir "$@" \
     2>&1 | tee "$LOG"
 
 # Verdict files from THIS run (chaos_run prints "PASS <name> ... -> <path>").
@@ -120,6 +128,34 @@ print(f"fleet OK: {fl['requests']} requests, 0 hard failures, "
       f"{hedges} hedges ({router.get('hedges_won', 0)} won), "
       f"{int(shm)} shm pulls, "
       f"{fl['stale_check']['scores_checked']} scores bit-verified")
+PY
+        ;;
+    *multi_tenant_contention*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+t = doc["tenant"]
+preempts = [m for m in t["moves"] if m.get("from")]
+assert len(preempts) >= 2, (
+    f"{sys.argv[1]}: {len(preempts)} preemption(s) actuated — the "
+    "contention never forced the arbiter's hand, the pass is vacuous")
+drains = t["preempt_drains"]
+assert drains and all(not d["worker_alive_at_stop"] and not d["escalated"]
+                      for d in drains), (
+    f"{sys.argv[1]}: a preempted chip was killed before its drain "
+    f"completed (or escalated): {drains}")
+assert t["replay"]["identical"], (
+    f"{sys.argv[1]}: the arbiter decision log did NOT byte-replay "
+    f"offline: {t['replay']['mismatches']}")
+jobs = t["jobs"]
+assert len(jobs) >= 3 and all(j["digests_match"] for j in jobs.values()), (
+    f"{sys.argv[1]}: a tenant's tables diverged from its fault-free "
+    f"reference: { {n: j['digests_match'] for n, j in jobs.items()} }")
+pushes = sum(j["storm"]["pushes"] for j in jobs.values())
+print(f"tenant OK: {len(preempts)} preemptions (all drained first), "
+      f"{t['replay']['decisions']} decisions byte-replayed, "
+      f"{len(jobs)} jobs x digest parity, {pushes} pushes, 0 hard "
+      "failures")
 PY
         ;;
     *trainer_crash_mid_loop*)

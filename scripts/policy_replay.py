@@ -47,9 +47,10 @@ from easydl_tpu.brain.straggler import StragglerConfig  # noqa: E402
 from easydl_tpu.core.mesh_shapes import MeshConstraints  # noqa: E402
 from easydl_tpu.sim import (  # noqa: E402
     MeshSimConfig, SimPolicy, load_fixture, load_workdir, save_fixture,
-    simulate, simulate_rollout, synthetic_autoscale,
+    simulate, simulate_rollout, simulate_tenants, synthetic_autoscale,
     synthetic_mesh_autoscale, synthetic_preempt, synthetic_rollout_pacing,
-    synthetic_straggler,
+    synthetic_straggler, synthetic_tenant_contention,
+    synthetic_tenant_starvation,
 )
 
 #: the default drill policy for replays: matches the live chaos drills'
@@ -111,6 +112,21 @@ _ROLLOUT_EXPECT: Dict[str, Any] = {
 
 def _is_rollout(timeline: Dict[str, Any]) -> bool:
     return bool(dict(timeline.get("meta", {})).get("rollout_profile"))
+
+
+def _is_tenant(timeline: Dict[str, Any]) -> bool:
+    return bool(dict(timeline.get("meta", {})).get("tenant_profile"))
+
+
+#: expectations for the multi-tenant contention scenario/fixture: the
+#: high-priority scale-up is satisfied BY preemption (anti-vacuous floor),
+#: every floor holds throughout, no chip ping-pongs, and the decision log
+#: replays byte-identically through the pure arbiter.
+_TENANT_EXPECT: Dict[str, Any] = {
+    "priorities_honored": True, "no_starvation": True, "no_thrash": True,
+    "final_allocations": {"hi": 3, "mid": 1, "lo": 1},
+    "min_preemptions": 2, "max_moves": 5,
+}
 
 
 def _scenarios() -> Dict[str, Tuple[Any, SimPolicy, Dict[str, Any]]]:
@@ -197,6 +213,24 @@ def _scenarios() -> Dict[str, Tuple[Any, SimPolicy, Dict[str, Any]]]:
             None,
             {"rolled_back": True},
         ),
+        # Multi-tenant chip arbitration (ISSUE 15): the 3-job contention
+        # shape — a high-priority scale-up over an exhausted supply must
+        # be satisfied by PACED preemption, floors held, no thrash, and
+        # the decision log byte-replayable.
+        "tenant_contention": (
+            synthetic_tenant_contention(),
+            None,
+            dict(_TENANT_EXPECT),
+        ),
+        # Negative control: a claims-set whose floors PERMIT starvation
+        # (min_chips=0 under a saturating high-priority demand) — the
+        # no-starvation invariant must CATCH the starved job.
+        "tenant_starvation_negative": (
+            synthetic_tenant_starvation(),
+            None,
+            {"priorities_honored": True, "no_starvation": True,
+             "no_thrash": True},
+        ),
     }
 
 
@@ -211,6 +245,8 @@ def _policy_and_expect_for(timeline: Dict[str, Any]
     fault-derived expectations."""
     if _is_rollout(timeline):
         return None, dict(_ROLLOUT_EXPECT)
+    if _is_tenant(timeline):
+        return None, dict(_TENANT_EXPECT)
     if dict(timeline.get("meta", {})).get("shape_profile"):
         return _mesh_policy(), dict(_MESH_EXPECT)
     return _drill_policy(), _recorded_expect(timeline)
@@ -309,6 +345,8 @@ def main() -> None:
     for name, tl, pol, expect, invert in jobs:
         if _is_rollout(tl):
             result = simulate_rollout(tl, pol, expect)
+        elif _is_tenant(tl):
+            result = simulate_tenants(tl, pol, expect)
         else:
             result = simulate(tl, pol, expect)
         ok = (not result["passed"]) if invert else result["passed"]

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Run declarative scenarios (scenarios/*.yaml) through the chaos harness
+and write ``CHAOS_r*_<name>.json`` verdicts — the scenario-fleet runner
+(ISSUE 15 / docs/scenarios.md).
+
+One harness command for the whole directory: a scenario file declares
+jobs × faults × traffic plus the invariants the run must satisfy
+(easydl_tpu/chaos/scenario.py validates the schema); ``kind: tenant``
+runs the multi-tenant drill, ``kind: catalog`` references a built-in
+drill by name. Exit code is non-zero when any scenario's invariants fail
+— a gate, not a report.
+
+Usage::
+
+    python scripts/scenario_run.py --list           # validate + describe
+    python scripts/scenario_run.py --scenario multi_tenant_contention
+    python scripts/scenario_run.py --all            # the whole directory
+    python scripts/scenario_run.py --dir my/scenarios --all
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+# ONE copy of the CHAOS_r* round-numbering rule: both runners write into
+# the same namespace, and two drifting copies would assign colliding
+# rounds and silently overwrite each other's committed verdicts.
+from chaos_run import next_round  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="declarative scenario runner")
+    ap.add_argument("--dir", default=None,
+                    help="scenario directory (default: <repo>/scenarios)")
+    ap.add_argument("--scenario", action="append", default=None,
+                    help="scenario name from the directory (repeatable)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every scenario in the directory")
+    ap.add_argument("--list", action="store_true",
+                    help="validate every file and describe it (the CI "
+                         "smoke: a malformed spec fails here, in "
+                         "milliseconds, not mid-drill)")
+    ap.add_argument("--out-dir", default=REPO,
+                    help="where CHAOS_r*.json verdicts land")
+    ap.add_argument("--round", type=int, default=None)
+    ap.add_argument("--keep-workdir", action="store_true")
+    args = ap.parse_args()
+
+    from easydl_tpu.chaos.scenario import (
+        ScenarioSpecError, list_scenario_files, load_scenario_file,
+    )
+
+    directory = args.dir
+    files = list_scenario_files(directory)
+    if not files:
+        raise SystemExit(f"no scenario files under "
+                         f"{directory or 'scenarios/'}")
+    scenarios = {}
+    errors = []
+    for path in files:
+        try:
+            sc = load_scenario_file(path)
+        except (ScenarioSpecError, OSError, ValueError) as e:
+            errors.append(f"{os.path.basename(path)}: {e}")
+            continue
+        if sc.name in scenarios:
+            errors.append(f"{os.path.basename(path)}: duplicate scenario "
+                          f"name {sc.name!r}")
+            continue
+        scenarios[sc.name] = (path, sc)
+
+    if args.list:
+        for name, (path, sc) in sorted(scenarios.items()):
+            kind = "tenant" if sc.tenant_drill is not None else "catalog"
+            jobs = (len(sc.tenant_drill["jobs"])
+                    if sc.tenant_drill is not None else 1)
+            print(f"{name:28s} kind={kind:8s} seed={sc.chaos.seed:<6d} "
+                  f"jobs={jobs} faults={len(sc.chaos.faults)} "
+                  f"checks={sorted(sc.expect)}  [{os.path.basename(path)}]")
+        if errors:
+            for e in errors:
+                print(f"INVALID {e}", file=sys.stderr)
+            raise SystemExit(f"{len(errors)} invalid scenario file(s)")
+        print(f"{len(scenarios)} scenario(s) valid")
+        return
+
+    if errors:
+        raise SystemExit("invalid scenario file(s): " + "; ".join(errors))
+    names = args.scenario or (sorted(scenarios) if args.all else [])
+    if not names:
+        raise SystemExit("pick --scenario NAME (repeatable), --all, "
+                         "or --list")
+    unknown = [n for n in names if n not in scenarios]
+    if unknown:
+        raise SystemExit(f"unknown scenario(s) {unknown}; known: "
+                         f"{sorted(scenarios)}")
+
+    # Drills need a CPU jax platform (the catalog drills spawn workers).
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    from easydl_tpu.chaos.harness import ChaosHarness
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    rnd = args.round if args.round is not None else next_round(args.out_dir)
+    failed = []
+    for name in names:
+        _path, sc = scenarios[name]
+        harness = ChaosHarness(sc)
+        try:
+            verdict = harness.run()
+        finally:
+            if not args.keep_workdir:
+                shutil.rmtree(harness.workdir, ignore_errors=True)
+        out = os.path.join(args.out_dir, f"CHAOS_r{rnd:02d}_{name}.json")
+        with open(out, "w") as f:
+            json.dump(verdict, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+        status = "PASS" if verdict["passed"] else "FAIL"
+        print(f"{status} {name} in {verdict['wall_s']}s -> {out}",
+              flush=True)
+        for check, doc in verdict["invariants"]["checks"].items():
+            print(f"  [{'ok' if doc['ok'] else 'VIOLATED'}] {check}")
+        if not verdict["passed"]:
+            failed.append(name)
+    if failed:
+        raise SystemExit(f"scenarios FAILED: {failed}")
+
+
+if __name__ == "__main__":
+    main()

@@ -505,6 +505,11 @@ def test_scenario_catalog_compiles_deterministically():
             # (ejection + hedging + bit-exact freshness), not a step
             # target
             assert sc.expect.get("fleet_resilient")
+        elif sc.tenant_drill is not None:
+            # multi-tenant drills: the goal invariants are the arbitration
+            # family (priorities/starvation/thrash/isolation), not a step
+            # target
+            assert sc.expect.get("tenant_contention")
         else:
             assert sc.expect.get("target_step") is not None
 

@@ -1,0 +1,162 @@
+"""Declarative scenario layer (ISSUE 15): schema validation with errors
+that name the field, the committed scenarios/ catalog loading clean, the
+headline drill defined BY its YAML, and the runner's --list gate."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from easydl_tpu.chaos.scenario import (
+    SCENARIOS_DIR,
+    ScenarioSpecError,
+    list_scenario_files,
+    load_all,
+    load_scenario_doc,
+    load_scenario_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tenant_doc(**over):
+    doc = {
+        "name": "t", "kind": "tenant", "seed": 1,
+        "substrate": {"ps_shards": 2, "total_chips": 3},
+        "jobs": [
+            {"name": "a", "priority": 1, "min_chips": 1, "max_chips": 2,
+             "demand": 2},
+            {"name": "b", "priority": 0, "min_chips": 1, "max_chips": 2,
+             "demand": 2},
+        ],
+        "traffic": {"steps": 10},
+        "faults": [],
+        "expect": {"tenant_contention": True, "no_starvation": True},
+    }
+    doc.update(over)
+    return doc
+
+
+# ------------------------------------------------------------- validation
+def test_tenant_doc_compiles_to_a_runnable_scenario():
+    sc = load_scenario_doc(tenant_doc())
+    assert sc.name == "t" and sc.ps_shards == 2
+    assert sc.tenant_drill["total_chips"] == 3
+    assert [j["name"] for j in sc.tenant_drill["jobs"]] == ["a", "b"]
+    assert sc.expect["tenant_contention"] is True
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda d: d.pop("jobs"), "missing required key 'jobs'"),
+    (lambda d: d.update(jobs=[]), "jobs must be non-empty"),
+    (lambda d: d.update(expect={}), "at least one invariant"),
+    (lambda d: d["substrate"].pop("total_chips"), "total_chips"),
+    (lambda d: d.update(kind="nope"), "unknown kind"),
+    (lambda d: d.update(bogus=1), "unknown key"),
+    (lambda d: d["jobs"].append(dict(d["jobs"][0])), "duplicate job"),
+    (lambda d: d["jobs"][0].update(min_chips=3, max_chips=1),
+     "min_chips <= max_chips"),
+    (lambda d: d.update(faults=[{"kind": "worker_kill", "at_s": 1.0,
+                                 "target": {"job": "ghost"}}]),
+     "not a declared job"),
+    (lambda d: d.update(faults=[{"kind": "ps_kill", "at_s": 1.0,
+                                 "target": {"shard": 7}}]),
+     "outside the substrate"),
+    (lambda d: d.update(faults=[{"kind": "master_crash", "at_s": 1.0}]),
+     "tenant scenarios support only"),
+    (lambda d: d.update(faults=[{"kind": "nonsense", "at_s": 1.0}]),
+     "unknown fault kind"),
+])
+def test_malformed_docs_fail_with_field_named(mutate, match):
+    doc = tenant_doc()
+    mutate(doc)
+    with pytest.raises(ScenarioSpecError, match=match):
+        load_scenario_doc(doc)
+
+
+def test_infeasible_floors_rejected_at_load_time():
+    doc = tenant_doc()
+    doc["jobs"][0]["min_chips"] = 2
+    doc["jobs"][1]["min_chips"] = 2
+    with pytest.raises(ScenarioSpecError, match="starve by construction"):
+        load_scenario_doc(doc)
+
+
+def test_catalog_reference_resolves_with_overrides():
+    sc = load_scenario_doc({
+        "name": "wk", "kind": "catalog", "scenario": "worker_kill",
+        "seed": 99, "expect": {"min_faults": 3},
+    })
+    assert sc.name == "worker_kill" and sc.chaos.seed == 99
+    assert sc.expect["min_faults"] == 3  # override merged over defaults
+    assert sc.expect["target_step"] == 3000  # base expectations kept
+    with pytest.raises(ScenarioSpecError, match="unknown catalog"):
+        load_scenario_doc({"name": "x", "kind": "catalog",
+                           "scenario": "no_such_drill"})
+
+
+# ------------------------------------------------------ committed catalog
+def test_committed_scenarios_all_load_and_validate():
+    files = list_scenario_files()
+    assert len(files) >= 4, files  # the acceptance floor
+    catalog = load_all()
+    assert "multi_tenant_contention" in catalog
+    for name, sc in catalog.items():
+        assert sc.expect, f"{name} asserts nothing"
+
+
+def test_headline_catalog_entry_is_the_yaml():
+    """scenario_multi_tenant_contention() must BE the YAML file — drill
+    config and expectations byte-equal to what the loader compiles, so
+    chaos_run and scenario_run can never run two different drills under
+    one name."""
+    from easydl_tpu.chaos.harness import SCENARIOS
+
+    from_yaml = load_scenario_file(
+        os.path.join(SCENARIOS_DIR, "multi_tenant_contention.yaml"))
+    from_catalog = SCENARIOS["multi_tenant_contention"]()
+    assert from_catalog.tenant_drill == from_yaml.tenant_drill
+    assert from_catalog.expect == from_yaml.expect
+    assert from_catalog.chaos == from_yaml.chaos
+    # seed override re-seeds without touching the drill definition
+    reseeded = SCENARIOS["multi_tenant_contention"](31337)
+    assert reseeded.chaos.seed == 31337
+    assert reseeded.tenant_drill == from_yaml.tenant_drill
+
+
+def test_yaml_files_are_clean_yaml():
+    for path in list_scenario_files():
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        assert isinstance(doc, dict) and doc.get("name"), path
+
+
+# ------------------------------------------------------------- the runner
+def test_scenario_run_list_smoke():
+    """The chaos_smoke gate: --list validates the whole directory and
+    exits 0; a malformed file flips the exit code."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "scenario_run.py"),
+         "--list"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert "multi_tenant_contention" in out.stdout
+    assert "valid" in out.stdout
+
+
+def test_scenario_run_list_fails_on_malformed_file(tmp_path):
+    good = tenant_doc()
+    with open(tmp_path / "ok.yaml", "w") as f:
+        yaml.safe_dump(good, f)
+    bad = tenant_doc(name="bad")
+    bad.pop("expect")
+    with open(tmp_path / "bad.yaml", "w") as f:
+        yaml.safe_dump(bad, f)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "scenario_run.py"),
+         "--list", "--dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode != 0
+    assert "bad.yaml" in out.stderr

@@ -403,3 +403,81 @@ def test_sim_mesh_convergence_check_refuses_vacuous_pass():
                    {"mesh_converged": {"tolerance": 0.05}})
     check = res["invariants"]["checks"]["mesh_shape_converged"]
     assert check["ok"] is False and "vacuous" in check["reason"]
+
+
+# ----------------------------------------------------- multi-tenant mode
+_TENANT_EXPECT = _PR._TENANT_EXPECT
+
+
+def test_sim_tenant_contention_preempts_paced_and_converges():
+    """ISSUE 15 acceptance (offline): the 3-job contention shape — a
+    high-priority scale-up over an exhausted supply is satisfied by
+    PACED preemption (one chip per decision, hold-down between moves),
+    floors hold throughout, no chip ping-pongs, the fleet converges on
+    the water-fill target, and the decision log byte-replays through
+    the pure arbiter. Byte-identical across runs."""
+    from easydl_tpu.sim import simulate_tenants, synthetic_tenant_contention
+
+    r1 = simulate_tenants(synthetic_tenant_contention(), None,
+                          dict(_TENANT_EXPECT))
+    assert r1["passed"], json.dumps(r1["invariants"], indent=2)
+    preempts = [m for m in r1["moves"] if m["from"]]
+    assert len(preempts) == 2
+    assert [p["from"] for p in preempts] == ["lo", "mid"]  # poorest first
+    holddown = r1["config"]["holddown_s"]
+    assert preempts[1]["t"] - preempts[0]["t"] >= holddown  # paced
+    assert r1["final_allocations"] == {"hi": 3, "lo": 1, "mid": 1}
+    r2 = simulate_tenants(synthetic_tenant_contention(), None,
+                          dict(_TENANT_EXPECT))
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2, sort_keys=True)
+
+
+def test_sim_tenant_starvation_negative_is_caught():
+    """The starvation-prone claims-set (min_chips=0 under a saturating
+    high-priority demand) must FAIL the no-starvation check — and ONLY
+    it: the arbiter honored priorities exactly as configured."""
+    from easydl_tpu.sim import simulate_tenants, synthetic_tenant_starvation
+
+    res = simulate_tenants(
+        synthetic_tenant_starvation(), None,
+        {"priorities_honored": True, "no_starvation": True,
+         "no_thrash": True})
+    assert not res["passed"]
+    checks = res["invariants"]["checks"]
+    assert checks["tenant_no_starvation"]["ok"] is False
+    assert checks["tenant_no_starvation"]["starved"][0]["job"] == "lo"
+    others = {k: v["ok"] for k, v in checks.items()
+              if k != "tenant_no_starvation"}
+    assert all(others.values()), others
+
+
+def test_sim_tenant_checks_refuse_vacuous_passes():
+    """Empty evidence never passes: no samples fails no_starvation, no
+    decisions fails priorities_honored and the replay identity."""
+    from easydl_tpu.sim.multijob import check_tenants
+
+    verdict = check_tenants(
+        {"allocation_samples": [], "moves": [], "decision_log": []},
+        {"priorities_honored": True, "no_starvation": True},
+        {"jobs": [], "config": {"holddown_s": 10.0}})
+    checks = verdict["checks"]
+    assert checks["tenant_no_starvation"]["ok"] is False
+    assert checks["tenant_priorities_honored"]["ok"] is False
+    assert checks["tenant_replay_identical"]["ok"] is False
+
+
+def test_committed_tenant_fixture_replays_deterministically():
+    """The committed tenant fixture rides the same replay gate as every
+    other sim fixture: the policy_replay dispatch picks the tenant
+    engine + expectations, the invariants hold, and two replays are
+    byte-identical."""
+    from easydl_tpu.sim import simulate_tenants
+
+    path = os.path.join(FIXTURE_DIR, "tenant_contention.json")
+    tl = load_fixture(path)
+    pol, expect = _PR._policy_and_expect_for(tl)
+    assert pol is None and expect == _TENANT_EXPECT
+    r1 = simulate_tenants(tl, pol, expect)
+    r2 = simulate_tenants(load_fixture(path), pol, expect)
+    assert r1["passed"], json.dumps(r1["invariants"], indent=2)
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2, sort_keys=True)

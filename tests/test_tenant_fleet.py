@@ -1,0 +1,179 @@
+"""TenantFleet unit tests (no subprocesses): grant bootstrap, the
+drain-then-kill preemption contract, escalation accounting, and the
+evidence document's offline byte-replay."""
+
+from easydl_tpu.brain.arbiter import ArbiterConfig, replay_decision_log
+from easydl_tpu.controller.fleet import TenantFleet, TenantJob
+
+
+class FakeAgent:
+    def __init__(self, aid, master, drain_after_ticks=1):
+        self.aid = aid
+        self.master = master
+        self.noticed = False
+        self.stopped = False
+        self._drain_after = drain_after_ticks
+        self._worker = True
+
+    @property
+    def worker_pid(self):
+        return 1234 if self._worker else None
+
+    def notify_preemption(self):
+        self.noticed = True
+
+    def stop(self):
+        assert not self._worker, \
+            "fleet stopped an agent whose worker was still alive"
+        self.stopped = True
+
+    def tick(self):
+        """Harness-driven drain progress: the worker exits some ticks
+        after the notice (the quiesce walk)."""
+        if self.noticed and self._worker:
+            self._drain_after -= 1
+            if self._drain_after <= 0:
+                self._worker = False
+                self.master.members = [
+                    m for m in self.master.members if m != self.aid]
+
+
+class FakeMaster:
+    def __init__(self):
+        self.members = []
+
+    def status(self):
+        return {"members": list(self.members)}
+
+
+def build_fleet(total=3, holddown=0.0, drain_timeout=100.0):
+    agents = {}
+
+    def factory(aid, master, job):
+        a = FakeAgent(aid, master)
+        agents[aid] = a
+        master.members = master.members or [aid]  # first agent = member
+        return a
+
+    fleet = TenantFleet(
+        total, factory,
+        ArbiterConfig(holddown_s=holddown, max_preemptions_per_decision=1),
+        drain_timeout_s=drain_timeout, epoch=0.0)
+    return fleet, agents
+
+
+def test_bootstrap_grants_spawn_agents_immediately():
+    fleet, agents = build_fleet(total=3)
+    for name, pri, demand in (("hi", 2, 2), ("lo", 0, 2)):
+        fleet.add_job(TenantJob(name=name, master=FakeMaster(), workdir=".",
+                                priority=pri, min_chips=1, max_chips=2,
+                                demand=demand))
+    fleet.tick(now=0.0)
+    assert fleet.allocations() == {"hi": 2, "lo": 1}
+    assert len(agents) == 3
+
+
+def test_preemption_drains_before_kill_and_regrants():
+    fleet, agents = build_fleet(total=2)
+    hi_m, lo_m = FakeMaster(), FakeMaster()
+    fleet.add_job(TenantJob(name="hi", master=hi_m, workdir=".",
+                            priority=2, min_chips=0, max_chips=2, demand=0))
+    fleet.add_job(TenantJob(name="lo", master=lo_m, workdir=".",
+                            priority=0, min_chips=0, max_chips=2, demand=2))
+    fleet.tick(now=0.0)
+    assert fleet.allocations() == {"hi": 0, "lo": 2}
+    victim_pool = dict(agents)
+    fleet.set_demand("hi", 2)
+    d = fleet.tick(now=1.0)
+    assert d["preemptions"]  # notice delivered, chip NOT yet moved
+    assert fleet.allocations() == {"hi": 0, "lo": 2}
+    victim = next(a for a in victim_pool.values() if a.noticed)
+    # While the drain is pending the fleet must NOT decide again (the
+    # mid-flight chip would read as free supply).
+    assert fleet.tick(now=1.2) is None
+    assert fleet.allocations() == {"hi": 0, "lo": 2}
+    victim.tick()  # worker exits at its step boundary
+    fleet.tick(now=1.5)
+    assert victim.stopped  # stop() asserts the worker was already dead
+    assert fleet.allocations() == {"hi": 1, "lo": 1}
+    mark = fleet.preempt_drains[0]
+    assert mark["job"] == "lo" and mark["to_job"] == "hi"
+    assert mark["worker_alive_at_stop"] is False
+    assert mark["escalated"] is False
+
+
+def test_drain_escalation_is_recorded_never_silent():
+    fleet, agents = build_fleet(total=2, drain_timeout=5.0)
+    fleet.add_job(TenantJob(name="hi", master=FakeMaster(), workdir=".",
+                            priority=2, min_chips=0, max_chips=2, demand=0))
+    fleet.add_job(TenantJob(name="lo", master=FakeMaster(), workdir=".",
+                            priority=0, min_chips=0, max_chips=2, demand=2))
+    fleet.tick(now=0.0)
+    fleet.set_demand("hi", 2)
+    fleet.tick(now=1.0)
+    victim = next(a for a in agents.values() if a.noticed)
+    victim._worker = False  # wedge: worker dies but master never dropped it
+    victim.master.members = [victim.aid]
+
+    def never_drained():  # master still counts it a member -> not drained
+        fleet.tick(now=3.0)
+        return fleet._pending
+
+    assert never_drained()
+    fleet.tick(now=7.0)  # past the deadline: escalate, record, move on
+    assert fleet.preempt_drains[0]["escalated"] is True
+    assert fleet.allocations()["hi"] == 1
+
+
+def test_evidence_decision_log_replays_byte_identical():
+    fleet, _ = build_fleet(total=3)
+    fleet.add_job(TenantJob(name="a", master=FakeMaster(), workdir=".",
+                            priority=1, min_chips=1, max_chips=3, demand=3))
+    fleet.add_job(TenantJob(name="b", master=FakeMaster(), workdir=".",
+                            priority=0, min_chips=1, max_chips=3, demand=3))
+    for t in (0.0, 1.0, 2.0):
+        fleet.tick(now=t)
+    ev = fleet.evidence()
+    rep = replay_decision_log(ev["decision_log"])
+    assert rep["identical"] and rep["decisions"] == 3
+    assert ev["final_allocations"] == {"a": 2, "b": 1}
+    # demand history rides the profile for the offline checks
+    assert ev["profile"]["jobs"][0]["demand"] == [[0.0, 3]]
+
+
+def test_two_preemptions_one_decision_take_two_different_victims():
+    """Review finding (r20): with max_preemptions >= 2, one decision can
+    take two chips from one donor — the fleet must drain two DIFFERENT
+    agents, never queue the same victim twice (which recorded a drain
+    that never happened and granted a phantom chip)."""
+    agents = {}
+
+    def factory(aid, master, job):
+        a = FakeAgent(aid, master)
+        agents[aid] = a
+        master.members = master.members or [aid]
+        return a
+
+    fleet = TenantFleet(
+        3, factory,
+        ArbiterConfig(holddown_s=0.0, max_preemptions_per_decision=2),
+        drain_timeout_s=100.0, epoch=0.0)
+    fleet.add_job(TenantJob(name="hi", master=FakeMaster(), workdir=".",
+                            priority=2, min_chips=0, max_chips=3, demand=0))
+    fleet.add_job(TenantJob(name="lo", master=FakeMaster(), workdir=".",
+                            priority=0, min_chips=1, max_chips=3, demand=3))
+    fleet.tick(now=0.0)
+    assert fleet.allocations() == {"hi": 0, "lo": 3}
+    fleet.set_demand("hi", 2)
+    d = fleet.tick(now=1.0)
+    assert len(d["preemptions"]) == 2
+    victims = {p.agent_id for p in fleet._pending}
+    assert len(victims) == 2  # two DIFFERENT agents mid-drain
+    for a in agents.values():
+        if a.noticed:
+            a.tick()
+    fleet.tick(now=2.0)
+    assert fleet.allocations() == {"hi": 2, "lo": 1}
+    assert len(agents) == 5  # 3 bootstrap + 2 re-grants, no phantom
+    assert len(fleet.preempt_drains) == 2
+    assert {m["agent"] for m in fleet.preempt_drains} == victims

@@ -1,0 +1,163 @@
+"""Multi-tenant operator (ISSUE 15): N ElasticJobs on one pod substrate
+with a global chip budget — the reconciler levels every job's worker
+replicas to the arbiter's allocation, priorities preempt through the
+ordinary scale_down path (DELETE → SIGTERM → the agent's preempt-notice
+drain), floors hold, and the pacing knobs damp the churn."""
+
+from easydl_tpu.api.job_spec import JobSpec, RoleSpec, SchedulingSpec
+from easydl_tpu.api.resource_plan import ResourcePlan, RolePlan
+from easydl_tpu.api.job_spec import ResourceSpec
+from easydl_tpu.brain.arbiter import ArbiterConfig
+from easydl_tpu.controller import CrStore, ElasticJobController, InMemoryPodApi
+
+
+def job(name, priority=0, lo=0, hi=0):
+    return JobSpec(
+        name=name, image="img", command="python -m trainer",
+        roles={"worker": RoleSpec()},
+        scheduling=SchedulingSpec(priority=priority, min_replicas=lo,
+                                  max_replicas=hi),
+    )
+
+
+def plan(name, workers, version=1):
+    return ResourcePlan(
+        name=f"{name}-plan", job_name=name, version=version,
+        roles={"worker": RolePlan(workers, ResourceSpec(cpu=1))},
+    )
+
+
+def workers_of(api, name):
+    return sorted(p.name for p in api.list_pods(name)
+                  if p.role == "worker" and p.phase in ("Pending", "Running"))
+
+
+def settle(ctl, api, rounds=4):
+    for _ in range(rounds):
+        ctl.reconcile_all()
+        api.tick()
+
+
+def test_budget_levels_concurrent_jobs_by_priority():
+    """Two jobs both ask for 3 workers on a 4-chip budget: floors first,
+    then the remaining supply to the HIGHER priority job — concurrently,
+    from one store, on one pod substrate."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api, chip_budget=4,
+                               arbiter_config=ArbiterConfig(holddown_s=0.0))
+    store.submit_job(job("hi", priority=2, lo=1, hi=3))
+    store.submit_job(job("lo", priority=0, lo=1, hi=3))
+    store.apply_plan(plan("hi", 3))
+    store.apply_plan(plan("lo", 3))
+    settle(ctl, api)
+    assert len(workers_of(api, "hi")) == 3
+    assert len(workers_of(api, "lo")) == 1
+
+
+def test_high_priority_scale_up_preempts_low_priority_pods():
+    """The preemption path: with the budget saturated, a high-priority
+    scale-up drains the low-priority job's pods — via the SAME scale_down
+    DELETE every plan change uses (SIGTERM → preempt-notice drain in the
+    process pod api) — and never below the victim's floor."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(
+        store, api, chip_budget=4,
+        arbiter_config=ArbiterConfig(holddown_s=0.0,
+                                     max_preemptions_per_decision=4))
+    store.submit_job(job("hi", priority=2, lo=1, hi=4))
+    store.submit_job(job("lo", priority=0, lo=1, hi=3))
+    store.apply_plan(plan("hi", 1))
+    store.apply_plan(plan("lo", 3))
+    settle(ctl, api)
+    assert len(workers_of(api, "hi")) == 1
+    assert len(workers_of(api, "lo")) == 3
+    # The scale-up: hi now wants everything it may hold.
+    store.apply_plan(plan("hi", 4, version=2))
+    settle(ctl, api)
+    assert len(workers_of(api, "hi")) == 3   # 4 - lo's floor
+    assert len(workers_of(api, "lo")) == 1   # preempted DOWN TO its floor
+
+
+def test_preemption_paced_by_holddown():
+    """With a real hold-down, one reconcile burst preempts at most
+    max_preemptions_per_decision chips and then freezes the pair — the
+    low job keeps the rest of its pods until the window expires (pacing,
+    not an instant fleet-wide drain)."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(
+        store, api, chip_budget=4,
+        arbiter_config=ArbiterConfig(holddown_s=3600.0,
+                                     max_preemptions_per_decision=1))
+    store.submit_job(job("hi", priority=2, lo=1, hi=4))
+    store.submit_job(job("lo", priority=0, lo=1, hi=3))
+    store.apply_plan(plan("hi", 1))
+    store.apply_plan(plan("lo", 3))
+    settle(ctl, api)
+    store.apply_plan(plan("hi", 4, version=2))
+    settle(ctl, api, rounds=6)
+    # One chip moved; the pair is now frozen for the hold-down window.
+    assert len(workers_of(api, "hi")) == 2
+    assert len(workers_of(api, "lo")) == 2
+
+
+def test_no_budget_means_classic_single_tenant_behavior():
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api)  # no chip_budget
+    store.submit_job(job("solo"))
+    store.apply_plan(plan("solo", 3))
+    settle(ctl, api)
+    assert len(workers_of(api, "solo")) == 3
+
+
+def test_job_without_scheduling_block_defaults_to_priority_zero():
+    """A legacy CR (no scheduling block) arbitrates at priority 0 with no
+    floor — it coexists, it just never preempts anyone."""
+    store, api = CrStore(), InMemoryPodApi()
+    ctl = ElasticJobController(store, api, chip_budget=3,
+                               arbiter_config=ArbiterConfig(holddown_s=0.0))
+    store.submit_job(JobSpec(name="legacy", image="i", command="c",
+                             roles={"worker": RoleSpec()}))
+    store.submit_job(job("vip", priority=5, lo=1, hi=2))
+    store.apply_plan(plan("legacy", 3))
+    store.apply_plan(plan("vip", 2))
+    settle(ctl, api)
+    assert len(workers_of(api, "vip")) == 2
+    assert len(workers_of(api, "legacy")) == 1
+
+
+def test_scheduling_block_round_trips_through_the_crd():
+    doc = job("j", priority=3, lo=1, hi=4).to_crd()
+    assert doc["spec"]["scheduling"] == {
+        "priority": 3, "minReplicas": 1, "maxReplicas": 4}
+    back = JobSpec.from_crd(doc)
+    assert back.scheduling.priority == 3
+    assert back.scheduling.min_replicas == 1
+    assert back.scheduling.max_replicas == 4
+    # absent block stays absent (legacy CRs round-trip unchanged)
+    legacy = JobSpec(name="l", image="i", command="c").to_crd()
+    assert "scheduling" not in legacy["spec"]
+    assert JobSpec.from_crd(legacy).scheduling is None
+
+
+def test_scheduling_validation_rejects_inverted_envelope():
+    import pytest
+
+    from easydl_tpu.api.job_spec import SpecError
+
+    bad = job("b", priority=0, lo=3, hi=1)
+    with pytest.raises(SpecError):
+        bad.validate()
+
+
+def test_scheduling_block_rejects_typoed_keys():
+    """A typoed floor key (min_replicas / minreplicas) must FAIL loudly,
+    not silently arbitrate the job with no floor — that would hand the
+    first higher-priority scale-up a license to starve it."""
+    import pytest
+
+    from easydl_tpu.api.job_spec import SpecError
+
+    doc = job("j", priority=1, lo=2, hi=4).to_crd()
+    doc["spec"]["scheduling"] = {"priority": 1, "min_replicas": 2}
+    with pytest.raises(SpecError, match="min_replicas"):
+        JobSpec.from_crd(doc)
