@@ -51,8 +51,8 @@ HISTOGRAM_UNITS = ("_seconds", "_bytes", "_examples", "_records", "_rows",
 KNOWN_LABELS = frozenset((
     "agent", "arm", "axis", "component", "fault", "generation", "has_plan",
     "job", "kind", "method", "op", "phase", "reason", "replica", "result",
-    "role", "scenario", "service", "shard", "site", "table", "target",
-    "verb", "verdict",
+    "role", "scenario", "service", "shard", "site", "source", "table",
+    "target", "verb", "verdict",
 ))
 
 _RESERVED_LABELS = frozenset(("le", "quantile"))

@@ -44,6 +44,7 @@ PURE_PATHS = (
     "easydl_tpu/core/mesh_shapes.py",
     "easydl_tpu/elastic/membership.py",
     "easydl_tpu/loop/rollout.py",
+    "easydl_tpu/retrieval/policy.py",
     "easydl_tpu/serve/routing.py",
 )
 

@@ -1573,6 +1573,8 @@ class ChaosHarness:
                 evidence = self._drive_trainer_crash_loop()
             elif kind == "rollout_half_update":
                 evidence = self._drive_rollout_half_update()
+            elif kind == "retrieval":
+                evidence = self._drive_retrieval_drill()
             else:
                 raise ValueError(f"unknown loop drill kind {kind!r}")
         finally:
@@ -2020,6 +2022,414 @@ class ChaosHarness:
             log.warning("frontend stop failed: %s", e)
         watcher.stop()
         path = os.path.join(self.workdir, "rollout-evidence.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(evidence, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return evidence
+
+    def _retrieval_builder_pod(self, idx: int, cfg: Mapping[str, Any]) -> str:
+        from easydl_tpu.controller.pod_api import Pod
+
+        sc = self.scenario
+        name = f"{sc.name}-index-{idx}"
+        self._pod_api.create_pod(Pod(
+            name=name, job=sc.name, role="index_builder",
+            command=(
+                f"{sys.executable} -m easydl_tpu.retrieval.index"
+                f" --workdir {self.workdir}"
+                f" --table {cfg.get('item_table', 'tt_item')}"
+                f" --dim {int(cfg.get('dim', 8))}"
+                f" --state-dir {os.path.join(self.workdir, 'retrieval-state')}"
+                f" --publish-dir "
+                f"{os.path.join(self.workdir, 'retrieval-index')}"
+                f" --shards {sc.ps_shards}"
+                f" --poll-s {float(cfg.get('poll_s', 0.05))}"
+                f" --ckpt-every 1"
+                f" --nlist {int(cfg.get('nlist', 8))}"
+                f" --retired-file {os.path.join(self.workdir, 'retired.json')}"
+                f" --stop-file {os.path.join(self.workdir, 'RSTOP')}"
+                f" --status-file "
+                f"{os.path.join(self.workdir, 'retrieval-status.jsonl')}"
+                f" --name index-{idx}"
+            ),
+        ))
+        return name
+
+    def _drive_retrieval_drill(self) -> Dict[str, Any]:
+        """The incremental-freshness drill family (ISSUE 17): a REAL
+        index-builder subprocess tails the PS push WAL against live PS
+        pods and publishes incremental snapshots that a serving frontend
+        hot-adopts under continuous gRPC Retrieve load. Variants by cfg:
+        ``kill_builder`` SIGKILLs the builder mid-update (restore must
+        re-tail exactly-once from the committed cursor); ``churn``
+        retires catalog ids mid-run (they must vanish from candidates and
+        never leak back on replay); ``flash`` pushes a brand-new item and
+        measures push-ack → first-retrieval against the freshness SLO.
+        The verdict anchor for all of them: the served candidate sets
+        must digest-match a brute-force witness computed over rows pulled
+        through the plain client path, BYPASSING the index entirely."""
+        import hashlib
+
+        import numpy as np
+
+        from easydl_tpu.loop import publish as model_publish
+        from easydl_tpu.proto import easydl_pb2 as pb
+        from easydl_tpu.ps.client import ShardedPsClient
+        from easydl_tpu.ps.read_client import PsReadClient
+        from easydl_tpu.ps.table import TableSpec
+        from easydl_tpu.retrieval.index import AnnIndex, brute_force_topk
+        from easydl_tpu.serve import ServeConfig, ServeFrontend
+        from easydl_tpu.serve.frontend import SERVE_SERVICE
+        from easydl_tpu.utils.env import knob_float
+        from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, RpcClient
+
+        sc = self.scenario
+        cfg = dict(sc.loop_drill or {})
+        dim = int(cfg.get("dim", 8))
+        fields = int(cfg.get("fields", 3))
+        k = int(cfg.get("k", 5))
+        n_items = int(cfg.get("items", 48))
+        n_users = int(cfg.get("users", 12))
+        incr_batches = int(cfg.get("incr_batches", 6))
+        incr_items = int(cfg.get("incr_items", 6))
+        pace_s = float(cfg.get("pace_s", 0.01))
+        kill_builder = bool(cfg.get("kill_builder", False))
+        churn = bool(cfg.get("churn", False))
+        flash = bool(cfg.get("flash", False))
+        item_table = str(cfg.get("item_table", "tt_item"))
+        user_table = str(cfg.get("user_table", "tt_user"))
+        status_path = os.path.join(self.workdir, "retrieval-status.jsonl")
+        publish_dir = os.path.join(self.workdir, "retrieval-index")
+
+        self._launch_ps()
+        client = ShardedPsClient.from_registry(
+            self.workdir, sc.ps_shards, timeout=5.0,
+            drain_retry_s=60.0, transient_retry_s=30.0)
+        # sgd / lr=1.0 / init_std=0 turns push(ids, shadow - target) into
+        # "write exactly these vectors" — the drill controls every stored
+        # row bit-for-bit, so the witness below is exact, not statistical.
+        for tname in (item_table, user_table):
+            client.create_table(TableSpec(
+                name=tname, dim=dim, optimizer="sgd", lr=1.0,
+                init_std=0.0, seed=3))
+        rng = np.random.default_rng(sc.chaos.seed)
+        shadow: Dict[str, Dict[int, np.ndarray]] = {item_table: {},
+                                                    user_table: {}}
+
+        def set_rows(table: str, ids: np.ndarray, vecs: np.ndarray) -> None:
+            vecs = np.asarray(vecs, np.float32)
+            zero = np.zeros(dim, np.float32)
+            prev = np.stack([shadow[table].get(int(i), zero) for i in ids])
+            client.push(table, np.asarray(ids, np.int64), prev - vecs,
+                        scale=1.0)
+            for i, v in zip(ids, vecs):
+                shadow[table][int(i)] = v.copy()
+
+        item_ids = np.arange(1, n_items + 1, dtype=np.int64)
+        set_rows(item_table, item_ids,
+                 rng.standard_normal((n_items, dim)).astype(np.float32))
+        user_ctx = rng.integers(
+            10_000, 10_000 + 4 * n_users,
+            size=(n_users, fields)).astype(np.int64)
+        ctx_ids = np.unique(user_ctx)
+        set_rows(user_table, ctx_ids,
+                 rng.standard_normal((len(ctx_ids), dim))
+                 .astype(np.float32))
+
+        builder_pod = self._retrieval_builder_pod(1, cfg)
+
+        reads = PsReadClient(client)
+        frontend = ServeFrontend(
+            reads, ServeConfig(table=user_table, fields=fields,
+                               dense_dim=0, max_wait_ms=1.0,
+                               request_timeout_s=60.0),
+            name="serve-0")
+        frontend.attach_retrieval(user_table)
+        swap_log: list = []
+
+        def on_swap(version, index) -> None:
+            swap_log.append({"t": time.time(), "version": int(version),
+                             "rows": len(index)})
+            frontend.set_index(version, index)
+
+        watcher = model_publish.ModelVersionWatcher(
+            publish_dir, lambda m, a: AnnIndex.from_arrays(m, a),
+            on_swap=on_swap, replica="serve-0", poll_s=0.05)
+        server = frontend.serve(obs_workdir=self.workdir,
+                                obs_name="serve-0")
+        watcher.start()
+
+        counts = {"requests": 0, "ok": 0, "hard_failures": 0,
+                  "retrievals_during_update": 0, "failure_samples": []}
+        stop = threading.Event()
+        window_open = threading.Event()
+        drive_rng = np.random.default_rng(sc.chaos.seed + 1)
+
+        def drive() -> None:
+            cl = RpcClient(SERVE_SERVICE, f"localhost:{server.port}",
+                           timeout=30.0, options=GRPC_MSG_OPTIONS)
+            i = 0
+            while not stop.is_set():
+                u = int(drive_rng.integers(0, n_users))
+                req = pb.RetrieveRequest(
+                    raw_user_ids=user_ctx[u].astype("<i8").tobytes(),
+                    user_fields=fields, k=k,
+                    session_id=f"sess-{i % (2 * n_users)}")
+                counts["requests"] += 1
+                try:
+                    resp = cl.Retrieve(req)
+                except Exception as e:
+                    log.warning("retrieval drill request failed: %r", e)
+                    counts["hard_failures"] += 1
+                    if len(counts["failure_samples"]) < 5:
+                        counts["failure_samples"].append(repr(e))
+                else:
+                    if resp.ok:
+                        counts["ok"] += 1
+                        if window_open.is_set():
+                            counts["retrievals_during_update"] += 1
+                    else:
+                        counts["hard_failures"] += 1
+                        if len(counts["failure_samples"]) < 5:
+                            counts["failure_samples"].append(
+                                str(resp.verdict))
+                i += 1
+                stop.wait(pace_s)
+
+        def read_status() -> list:
+            lines = []
+            try:
+                with open(status_path) as f:
+                    for ln in f:
+                        try:
+                            lines.append(json.loads(ln))
+                        except ValueError:
+                            continue
+            except OSError:
+                pass
+            return lines
+
+        def snapshots() -> list:
+            return [d for d in read_status() if d.get("phase") == "snapshot"]
+
+        def _digest(parts) -> str:
+            h = hashlib.blake2b(digest_size=16)
+            for ids_, scores_ in parts:
+                h.update(np.ascontiguousarray(ids_, "<i8").tobytes())
+                h.update(np.ascontiguousarray(scores_, "<f4").tobytes())
+            return h.hexdigest()
+
+        def served_candidates():
+            cl = RpcClient(SERVE_SERVICE, f"localhost:{server.port}",
+                           timeout=30.0, options=GRPC_MSG_OPTIONS)
+            out = []
+            for u in range(n_users):
+                resp = cl.Retrieve(pb.RetrieveRequest(
+                    raw_user_ids=user_ctx[u].astype("<i8").tobytes(),
+                    user_fields=fields, k=k, session_id=f"verify-{u}"))
+                if not resp.ok:
+                    return None
+                out.append((
+                    np.frombuffer(resp.candidate_ids, "<i8").reshape(-1, k),
+                    np.frombuffer(resp.scores, "<f4").reshape(-1, k)))
+            return out
+
+        retired: list = []
+
+        def witness_candidates():
+            """The bypass oracle: rows pulled straight through the plain
+            client (never the index), scored brute-force."""
+            live = np.asarray(
+                sorted(set(shadow[item_table]) - set(retired)), np.int64)
+            vecs = client.pull(item_table, live)
+            out = []
+            for u in range(n_users):
+                rows = client.pull(user_table, user_ctx[u])
+                q = rows.mean(axis=0, dtype=np.float32)[None, :]
+                out.append(brute_force_topk(live, vecs, q, k))
+            return out
+
+        def parity() -> bool:
+            served = served_candidates()
+            if served is None:
+                return False
+            want = witness_candidates()
+            return all(np.array_equal(s[0], w[0]) for s, w in
+                       zip(served, want))
+
+        errors: list = []
+        kill_mark: Dict[str, Any] = {}
+        flash_mark: Dict[str, Any] = {}
+        next_id = n_items + 1
+        snaps_before: Optional[int] = None
+        driver = threading.Thread(target=drive, name="retrieval-drive",
+                                  daemon=True)
+        try:
+            _wait_for(lambda: len(snapshots()) >= 1, 60.0,
+                      "first index snapshot from the builder")
+            _wait_for(lambda: bool(frontend.index_versions()), 30.0,
+                      "frontend adoption of the first index version")
+            driver.start()
+            time.sleep(0.3)  # load on the initial catalog first
+            snaps_before = len(snapshots())
+            window_open.set()
+            for b in range(incr_batches):
+                ids = np.arange(next_id, next_id + incr_items,
+                                dtype=np.int64)
+                next_id += incr_items
+                # half fresh ids, half in-place updates of existing rows:
+                # an incremental index must handle both without a rebuild
+                upd = rng.choice(item_ids, size=max(1, incr_items // 2),
+                                 replace=False)
+                set_rows(item_table, np.concatenate([ids, upd]),
+                         rng.standard_normal(
+                             (len(ids) + len(upd), dim))
+                         .astype(np.float32))
+                if kill_builder and b == incr_batches // 2:
+                    _wait_for(
+                        lambda: len(snapshots()) > snaps_before, 60.0,
+                        "an incremental snapshot before the kill")
+                    entry = self._pod_api._procs.get(builder_pod)
+                    if entry is None or entry.proc.poll() is not None:
+                        raise RuntimeError("index builder pod not "
+                                           "running at the kill point")
+                    entry.proc.kill()
+                    entry.proc.wait()
+                    injectors.count_fault("index_builder_kill")
+                    kill_mark = {"t": time.time(), "at_batch": b,
+                                 "builder_alive": True}
+                    self._pod_api.poll()
+                    self._pod_api.delete_pod(builder_pod)
+                    builder_pod = self._retrieval_builder_pod(2, cfg)
+                    log.info("index builder SIGKILLed at batch %d and "
+                             "relaunched", b)
+                time.sleep(pace_s)
+            if churn:
+                retired = [int(i) for i in
+                           rng.choice(item_ids, size=max(2, n_items // 8),
+                                      replace=False)]
+                rpath = os.path.join(self.workdir, "retired.json")
+                tmp = rpath + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(retired, f)
+                os.replace(tmp, rpath)
+            if flash:
+                # A distinctive new item plus a user context aimed right
+                # at it: push-ack → first-retrieval is the freshness SLO.
+                flash_id = int(next_id)
+                next_id += 1
+                fvec = rng.standard_normal(dim).astype(np.float32)
+                fvec *= np.float32(10.0 / max(1e-6,
+                                              float(np.linalg.norm(fvec))))
+                flash_ctx = np.arange(90_001, 90_001 + fields,
+                                      dtype=np.int64)
+                set_rows(user_table, flash_ctx,
+                         np.repeat(fvec[None, :], fields, axis=0))
+                set_rows(item_table, np.asarray([flash_id], np.int64),
+                         fvec[None, :])
+                t_push = time.time()
+                cl = RpcClient(SERVE_SERVICE, f"localhost:{server.port}",
+                               timeout=30.0, options=GRPC_MSG_OPTIONS)
+
+                def flash_served() -> bool:
+                    resp = cl.Retrieve(pb.RetrieveRequest(
+                        raw_user_ids=flash_ctx.astype("<i8").tobytes(),
+                        user_fields=fields, k=k, session_id="flash"))
+                    if not resp.ok:
+                        return False
+                    cand = np.frombuffer(resp.candidate_ids, "<i8")
+                    return flash_id in cand
+
+                slo_s = float(cfg.get(
+                    "freshness_slo_s",
+                    knob_float("EASYDL_RETRIEVAL_FRESHNESS_SLO_S")))
+                _wait_for(flash_served, max(30.0, 2 * slo_s),
+                          "flash item to become retrievable")
+                flash_mark = {
+                    "item": flash_id,
+                    "first_retrievable_s": round(time.time() - t_push, 4),
+                    "slo_s": slo_s,
+                    "within_slo": (time.time() - t_push) <= slo_s,
+                }
+            _wait_for(parity, 90.0,
+                      "served candidates to converge on the bypass "
+                      "witness")
+            window_open.clear()
+        except Exception as e:
+            log.exception("retrieval drill sequence failed")
+            errors.append(repr(e))
+        finally:
+            stop.set()
+            if driver.is_alive():
+                driver.join(timeout=10.0)
+        # Drain the builder through its stop file so the final cursor
+        # state + snapshot commit before the verdict digests are taken.
+        with open(os.path.join(self.workdir, "RSTOP"), "w") as f:
+            f.write("1")
+
+        def builder_done() -> bool:
+            return any(d.get("phase") == "done" for d in read_status())
+
+        final_served = final_witness = None
+        try:
+            _wait_for(builder_done, 60.0, "index builder to drain")
+            final_served = served_candidates()
+            final_witness = witness_candidates()
+        except Exception as e:
+            log.exception("retrieval drill verification failed")
+            errors.append(repr(e))
+        status_lines = read_status()
+        starts = [d for d in status_lines if d.get("phase") == "started"]
+        snaps = [d for d in status_lines if d.get("phase") == "snapshot"]
+        dones = [d for d in status_lines if d.get("phase") == "done"]
+        restored = starts[1] if len(starts) > 1 else {}
+        digest_served = (_digest(final_served)
+                         if final_served is not None else "")
+        digest_witness = (_digest(final_witness)
+                          if final_witness is not None else "")
+        retired_leaked = 0
+        if final_served is not None and retired:
+            rset = set(retired)
+            for ids_, _scores in final_served:
+                retired_leaked += sum(1 for i in ids_.ravel()
+                                      if int(i) in rset)
+        evidence = {
+            **counts,
+            "swaps": swap_log,
+            "index_updates": len(snaps),
+            # snapshots committed AFTER live traffic opened the update
+            # window — the anti-vacuous "the index really moved under
+            # load" count (0 when the drill died before the window)
+            "incremental_updates": (max(0, len(snaps) - snaps_before)
+                                    if snaps_before is not None else 0),
+            "kill": kill_mark,
+            "restarts": max(0, len(starts) - 1),
+            "restored_version": int(restored.get("restored_version", 0)),
+            "restored_cursor_records": int(
+                restored.get("restored_cursor_records", 0)),
+            "digest_served": digest_served,
+            "digest_witness": digest_witness,
+            "digests_match": bool(digest_served)
+                and digest_served == digest_witness,
+            "catalog": {"items": len(shadow[item_table]),
+                        "incr_batches": incr_batches},
+            "final_index_versions": frontend.index_versions(),
+            "builder_counters": (dones[-1].get("counters", {})
+                                 if dones else {}),
+            "churn": ({"retired": sorted(retired),
+                       "retired_leaked": retired_leaked}
+                      if churn else {}),
+            "flash": flash_mark,
+            "errors": errors,
+        }
+        try:
+            frontend.stop()
+        except Exception as e:
+            log.warning("frontend stop failed: %s", e)
+        watcher.stop()
+        client.close()
+        path = os.path.join(self.workdir, "retrieval-evidence.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(evidence, f, indent=2, sort_keys=True)
@@ -2957,6 +3367,114 @@ def scenario_multi_tenant_contention(seed: int = 101) -> Scenario:
     return _yaml_scenario("multi_tenant_contention.yaml", seed)
 
 
+def scenario_retrieval_replica_death_mid_index_update(
+        seed: int = 71) -> Scenario:
+    """The retrieval tier's freshness-under-failure drill (ISSUE 17): a
+    REAL index-builder subprocess tails the PS push WAL against live PS
+    pods, publishing incremental snapshots that a serving frontend
+    hot-adopts under continuous gRPC Retrieve load. Mid-update — after
+    at least one incremental snapshot committed, with more catalog
+    pushes in flight — the builder is SIGKILLed and relaunched: the
+    restore must resume from the committed (snapshot, cursor) pair and
+    re-tail the WAL exactly-once, serving never hard-fails a request
+    (the frontend keeps answering from the last adopted snapshot), and
+    the drill converges to DIGEST PARITY between served candidates and
+    a brute-force witness computed over rows pulled through the plain
+    client path, bypassing the index entirely."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="retrieval_replica_death_mid_index_update", seed=seed,
+            notes="SIGKILL the ANN index builder mid-incremental-update "
+                  "under Retrieve load; restore re-tails exactly-once "
+                  "and served candidates digest-match the brute-force "
+                  "bypass witness",
+            faults=(),  # the kill fires at a batch index, not a wall offset
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=2,
+        loop_drill={"kind": "retrieval", "items": 48, "users": 12,
+                    "dim": 8, "fields": 3, "k": 5, "nlist": 8,
+                    "incr_batches": 6, "incr_items": 6, "pace_s": 0.01,
+                    "kill_builder": True},
+        expect={
+            "retrieval_consistent": True,
+            "min_retrieval_requests": 30,       # vacuous-pass refusal
+            "min_incremental_updates": 1,       # the index really moved
+            "min_retrievals_during_update": 1,  # ... under live traffic
+            "require_kill": True,
+            "min_faults": 1,                    # the builder kill
+        },
+    )
+
+
+def scenario_catalog_churn(seed: int = 79) -> Scenario:
+    """Catalog churn (ISSUE 17 scenario family): items are added AND
+    retired while the index builder streams WAL updates under Retrieve
+    load. Retirement is pinned — retired ids must vanish from served
+    candidates and may never leak back when later WAL records (or a
+    restore replay) mention them — and the run still converges to digest
+    parity against the brute-force bypass witness over the LIVE set.
+    scenarios/catalog_churn.yaml pins this entry in the declarative
+    catalog (the validating loader proves the reference resolves)."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="catalog_churn", seed=seed,
+            notes="add + retire catalog items under Retrieve load; "
+                  "retired ids vanish from candidates and never leak "
+                  "back; digest parity vs the bypass witness",
+            faults=(),  # churn is a data-plane event, not a process fault
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=2,
+        loop_drill={"kind": "retrieval", "items": 48, "users": 12,
+                    "dim": 8, "fields": 3, "k": 5, "nlist": 8,
+                    "incr_batches": 4, "incr_items": 6, "pace_s": 0.01,
+                    "churn": True},
+        expect={
+            "retrieval_consistent": True,
+            "min_retrieval_requests": 30,
+            "min_incremental_updates": 1,
+            "min_retrievals_during_update": 1,
+            "require_churn": True,
+        },
+    )
+
+
+def scenario_flash_crowd_new_item(seed: int = 83) -> Scenario:
+    """Flash crowd on a brand-new item (ISSUE 17 scenario family): a
+    never-seen item is pushed to the PS mid-run and a crowd of requests
+    aims straight at it. The drill measures push-ack → first appearance
+    in served candidates and gates it against the
+    EASYDL_RETRIEVAL_FRESHNESS_SLO_S contract, then converges to digest
+    parity against the bypass witness. scenarios/flash_crowd_new_item.yaml
+    pins this entry in the declarative catalog."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="flash_crowd_new_item", seed=seed,
+            notes="brand-new item pushed mid-run with a crowd aimed at "
+                  "it; push-ack → first-retrieval must land inside the "
+                  "freshness SLO",
+            faults=(),  # freshness pressure, not a process fault
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=2,
+        loop_drill={"kind": "retrieval", "items": 48, "users": 12,
+                    "dim": 8, "fields": 3, "k": 5, "nlist": 8,
+                    "incr_batches": 3, "incr_items": 6, "pace_s": 0.005,
+                    "flash": True},
+        expect={
+            "retrieval_consistent": True,
+            "min_retrieval_requests": 30,
+            "min_incremental_updates": 1,
+            "min_retrievals_during_update": 1,
+            "require_flash": True,
+        },
+    )
+
+
 def _yaml_scenario(filename: str, seed: int) -> Scenario:
     """Catalog entries whose definition lives in scenarios/*.yaml. A seed
     override re-seeds the compiled fault timeline (chaos_run --seed)."""
@@ -3083,6 +3601,10 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "trainer_crash_mid_loop": scenario_trainer_crash_mid_loop,
     "rollout_half_update": scenario_rollout_half_update,
     "multi_tenant_contention": scenario_multi_tenant_contention,
+    "retrieval_replica_death_mid_index_update":
+        scenario_retrieval_replica_death_mid_index_update,
+    "catalog_churn": scenario_catalog_churn,
+    "flash_crowd_new_item": scenario_flash_crowd_new_item,
     "straggler_mitigation": scenario_straggler_mitigation,
     "preempt_race": scenario_preempt_race,
 }

@@ -802,6 +802,76 @@ def check_scenario(
                 "min_rollout_requests": min_req,
             }
 
+    # ---------------------------------------------------- retrieval (r17.4)
+    if expect.get("retrieval_consistent"):
+        ev = {}
+        try:
+            with open(os.path.join(workdir,
+                                   "retrieval-evidence.json")) as f:
+                ev = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if not ev:
+            checks["retrieval_consistent"] = {
+                "ok": False,
+                "reason": "no retrieval-evidence.json in the workdir "
+                          "(drill crashed before writing evidence)",
+            }
+        else:
+            min_req = int(expect.get("min_retrieval_requests", 1))
+            min_incr = int(expect.get("min_incremental_updates", 1))
+            min_during = int(expect.get(
+                "min_retrievals_during_update", 1))
+            churn = ev.get("churn", {}) or {}
+            flash = ev.get("flash", {}) or {}
+            # The anchor: served candidates digest-match the brute-force
+            # bypass witness; anti-vacuous: requests flowed, the index
+            # really took incremental updates under live traffic, and no
+            # request hard-failed across builder death / churn / flash.
+            ok = (not ev.get("errors")
+                  and bool(ev.get("digests_match"))
+                  and int(ev.get("requests", 0)) >= min_req
+                  and int(ev.get("hard_failures", -1)) == 0
+                  and int(ev.get("incremental_updates", 0)) >= min_incr
+                  and int(ev.get(
+                      "retrievals_during_update", 0)) >= min_during)
+            if expect.get("require_kill"):
+                # The restore must be a real resume from a committed
+                # (snapshot, cursor) pair — not a cold re-tail.
+                ok = (ok and bool(ev.get("kill"))
+                      and int(ev.get("restarts", 0)) >= 1
+                      and int(ev.get("restored_version", 0)) >= 1
+                      and int(ev.get("restored_cursor_records", 0)) >= 1)
+            if expect.get("require_churn"):
+                ok = (ok and len(churn.get("retired", [])) >= 1
+                      and int(churn.get("retired_leaked", 1)) == 0)
+            if expect.get("require_flash"):
+                ok = (ok and bool(flash.get("within_slo"))
+                      and float(flash.get("first_retrievable_s", 0)) > 0)
+            checks["retrieval_consistent"] = {
+                "ok": ok,
+                "requests": ev.get("requests"),
+                "hard_failures": ev.get("hard_failures"),
+                "failure_samples": ev.get("failure_samples"),
+                "digests_match": ev.get("digests_match"),
+                "digest_served": ev.get("digest_served"),
+                "digest_witness": ev.get("digest_witness"),
+                "index_updates": ev.get("index_updates"),
+                "incremental_updates": ev.get("incremental_updates"),
+                "min_incremental_updates": min_incr,
+                "retrievals_during_update":
+                    ev.get("retrievals_during_update"),
+                "min_retrievals_during_update": min_during,
+                "restarts": ev.get("restarts"),
+                "restored_version": ev.get("restored_version"),
+                "restored_cursor_records":
+                    ev.get("restored_cursor_records"),
+                "churn": churn,
+                "flash": flash,
+                "errors": ev.get("errors"),
+                "min_retrieval_requests": min_req,
+            }
+
     # --------------------------------------------------- multi-tenant (r20)
     if expect.get("tenant_contention"):
         # Deferred import: chaos.invariants is imported BY sim.invariants

@@ -1,0 +1,35 @@
+"""Candidate generation: two-tower retrieval + incrementally-fresh ANN.
+
+The subsystem that turns the serving tier from a scorer into a
+recommender. Three pieces, each riding an existing contract:
+
+* :mod:`easydl_tpu.retrieval.two_tower` — the model (user/item towers
+  over ordinary PS tables, trained from the feedback spool with in-batch
+  softmax negatives);
+* :mod:`easydl_tpu.retrieval.index` — the ANN index, built by tailing
+  the PS push WAL and published as immutable versioned snapshots;
+* :mod:`easydl_tpu.retrieval.policy` — the pure rebuild/snapshot
+  decisions (rule-5 simulator-replayable).
+
+The request path (``Retrieve`` RPC → frontend index bank → router
+session affinity) lives in ``serve/``, next to the ranking path it
+feeds.
+"""
+
+from easydl_tpu.retrieval.index import AnnIndex, IndexBuilder, brute_force_topk
+from easydl_tpu.retrieval.two_tower import (
+    TwoTowerTrainer,
+    in_batch_softmax_grads,
+    pairs_from_events,
+    tower_forward,
+)
+
+__all__ = [
+    "AnnIndex",
+    "IndexBuilder",
+    "brute_force_topk",
+    "TwoTowerTrainer",
+    "in_batch_softmax_grads",
+    "pairs_from_events",
+    "tower_forward",
+]

@@ -1,0 +1,628 @@
+"""Incrementally-fresh ANN index over the PS item table.
+
+The retrieval half of the recommender needs every *pushed* item embedding
+to become *retrievable* within a bounded delay — the PR-9 freshness
+contract, extended from cached rows to index entries. The mechanism:
+
+* :class:`AnnIndex` — an IVF-flat index (seeded k-means centroids, exact
+  re-scoring inside probed buckets). Below the clustering threshold it IS
+  brute force (one bucket); past it, ``retrieval/policy.py`` decides when
+  to (re)cluster. Search is deterministic: float64 scoring with ties
+  broken by ascending id, so two replicas holding the same rows answer
+  byte-identically — the property the chaos drill's digest parity check
+  rides on.
+* :class:`IndexBuilder` — tails the PS push WAL (``<workdir>/ps-wal/
+  shard-*/epoch-*/seg-*.wal``) through the ``loop/spool.py`` cursor
+  machinery. A WAL push record is treated as a *change notification
+  only*: the authoritative row values are re-read live from the store
+  (through the shm mirror when co-located — ``ShardedPsClient(pull_shm=
+  True)`` — else gRPC), so replaying a record twice converges instead of
+  double-applying. That makes the checkpoint protocol simple:
+
+      1. publish the index snapshot (loop/publish.py — CRC manifest,
+         commit marker, versioned, rollback-capable);
+      2. write the cursor file naming that version (tmp+fsync+rename).
+
+  A SIGKILL between (1) and (2) re-tails the WAL window onto the older
+  snapshot — idempotent by construction. Serving replicas watch the
+  publish directory with the same ``ModelVersionWatcher`` that swaps
+  ranking models, so index rollback/canary pacing come for free.
+
+* ``python -m easydl_tpu.retrieval.index`` — the builder as a pod (the
+  chaos drill's SIGKILL target), same status-file/stop-file contract as
+  ``loop/continuous.py``.
+
+Catalog retirement (items withdrawn from sale) is an index-level
+decision, not a PS op: ids listed in ``--retired-file`` are removed and
+*pinned* removed — a later WAL record for a retired id is dropped, and
+the retired set rides the snapshot so a restore cannot resurrect them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from easydl_tpu.loop import publish
+from easydl_tpu.loop.spool import SpoolCursor, SpoolReader
+from easydl_tpu.obs import get_registry
+from easydl_tpu.ps import wal
+from easydl_tpu.retrieval.policy import decide_rebuild, snapshot_due
+from easydl_tpu.utils.env import knob_float, knob_int
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("retrieval", "index")
+
+ENV_NLIST = "EASYDL_RETRIEVAL_NLIST"
+ENV_NPROBE = "EASYDL_RETRIEVAL_NPROBE"
+ENV_POLL_S = "EASYDL_RETRIEVAL_POLL_S"
+ENV_CKPT_EVERY = "EASYDL_RETRIEVAL_CKPT_EVERY"
+ENV_REBUILD_MIN_ROWS = "EASYDL_RETRIEVAL_REBUILD_MIN_ROWS"
+
+#: cursor/state file the builder commits AFTER each published snapshot —
+#: the exactly-once boundary (snapshot first, cursor second).
+STATE_FILE = "index-state.json"
+
+_metrics_cache: Optional[tuple] = None
+
+
+def _index_metrics():
+    global _metrics_cache
+    if _metrics_cache is None:
+        reg = get_registry()
+        _metrics_cache = (
+            reg.counter(
+                "easydl_retrieval_index_updates_total",
+                "Incremental index mutations applied, by source (wal = "
+                "tailed push records, retire = catalog retirement, "
+                "rebuild = centroid re-cluster, restore = snapshot "
+                "restore).", ("replica", "source")),
+            reg.gauge(
+                "easydl_retrieval_index_rows",
+                "Items currently retrievable from this builder's index.",
+                ("replica",)),
+            reg.histogram(
+                "easydl_retrieval_freshness_seconds",
+                "Push->indexed apply lag per tailed WAL batch (lower "
+                "bound: measured against the segment's last-append time; "
+                "the push->retrievable SLO itself is gated end-to-end in "
+                "BENCH_RETRIEVAL.json).", ("replica",)),
+        )
+    return _metrics_cache
+
+
+def brute_force_topk(item_ids: np.ndarray, item_vecs: np.ndarray,
+                     queries: np.ndarray, k: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact inner-product top-k — the bypass witness the ANN index is
+    digest-compared against. Deterministic: float64 scores, ties broken
+    by ascending id; short corpora pad with id -1 / score 0."""
+    item_ids = np.asarray(item_ids, np.int64)
+    queries = np.atleast_2d(np.asarray(queries, np.float64))
+    out_ids = np.full((len(queries), k), -1, np.int64)
+    out_scores = np.zeros((len(queries), k), np.float32)
+    if len(item_ids) == 0:
+        return out_ids, out_scores
+    scores = queries @ np.asarray(item_vecs, np.float64).T
+    for q in range(len(queries)):
+        order = np.lexsort((item_ids, -scores[q]))[:k]
+        out_ids[q, :len(order)] = item_ids[order]
+        out_scores[q, :len(order)] = scores[q][order].astype(np.float32)
+    return out_ids, out_scores
+
+
+class AnnIndex:
+    """IVF-flat ANN index with deterministic search.
+
+    Flat (single implicit bucket = exact brute force) until the corpus
+    reaches the rebuild threshold; then seeded k-means buckets the rows
+    and queries probe the ``nprobe`` nearest centroids with exact
+    re-scoring inside them. ``upsert`` keeps bucket assignments current
+    in place; ``remove`` drops rows (catalog churn). Clustering is
+    deterministic in (seed, row content) — no wall clock, no global RNG.
+    """
+
+    def __init__(self, dim: int, nlist: Optional[int] = None,
+                 seed: int = 0, min_rebuild_rows: Optional[int] = None):
+        self.dim = int(dim)
+        self.nlist = int(knob_int(ENV_NLIST) if nlist is None else nlist)
+        self.seed = int(seed)
+        self.min_rebuild_rows = int(
+            knob_int(ENV_REBUILD_MIN_ROWS)
+            if min_rebuild_rows is None else min_rebuild_rows)
+        self.ids = np.zeros(0, np.int64)
+        self.vecs = np.zeros((0, self.dim), np.float32)
+        self.assign = np.zeros(0, np.int32)
+        self.centroids: Optional[np.ndarray] = None  # (nlist, dim) f32
+        self.rows_at_build = 0
+        self.rebuilds = 0
+        self._pos: Dict[int, int] = {}
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    # ---------------------------------------------------------- mutation
+    def upsert(self, ids: np.ndarray, vecs: np.ndarray) -> int:
+        """Insert-or-update rows; returns how many were NEW."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        vecs = np.ascontiguousarray(vecs, np.float32).reshape(len(ids),
+                                                              self.dim)
+        fresh_ids: List[int] = []
+        fresh_rows: List[np.ndarray] = []
+        for i, item in enumerate(ids):
+            pos = self._pos.get(int(item))
+            if pos is None:
+                fresh_ids.append(int(item))
+                fresh_rows.append(vecs[i])
+            else:
+                self.vecs[pos] = vecs[i]
+                self.assign[pos] = self._bucket_of(vecs[i])
+        if fresh_ids:
+            base = len(self.ids)
+            add = np.asarray(fresh_ids, np.int64)
+            rows = np.asarray(fresh_rows, np.float32)
+            self.ids = np.concatenate([self.ids, add])
+            self.vecs = np.concatenate([self.vecs, rows])
+            self.assign = np.concatenate([
+                self.assign,
+                np.asarray([self._bucket_of(r) for r in rows], np.int32)])
+            for j, item in enumerate(fresh_ids):
+                self._pos[item] = base + j
+        return len(fresh_ids)
+
+    def remove(self, ids: np.ndarray) -> int:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        drop = [self._pos[int(i)] for i in ids if int(i) in self._pos]
+        if not drop:
+            return 0
+        keep = np.ones(len(self.ids), bool)
+        keep[drop] = False
+        self.ids = self.ids[keep]
+        self.vecs = self.vecs[keep]
+        self.assign = self.assign[keep]
+        self._pos = {int(item): i for i, item in enumerate(self.ids)}
+        return len(drop)
+
+    def _bucket_of(self, vec: np.ndarray) -> int:
+        if self.centroids is None:
+            return 0
+        return int(np.argmax(self.centroids.astype(np.float64)
+                             @ np.asarray(vec, np.float64)))
+
+    # -------------------------------------------------------- clustering
+    def bucket_sizes(self) -> List[int]:
+        if self.centroids is None:
+            return []
+        return np.bincount(self.assign,
+                           minlength=len(self.centroids)).tolist()
+
+    def maybe_rebuild(self) -> str:
+        """Re-cluster if retrieval/policy.py says so; returns the reason
+        ("" = untouched)."""
+        reason = decide_rebuild(len(self.ids), self.bucket_sizes(),
+                                self.min_rebuild_rows,
+                                rows_at_last_build=self.rows_at_build)
+        if reason:
+            self._rebuild()
+        return reason
+
+    def _rebuild(self) -> None:
+        n = len(self.ids)
+        nlist = max(1, min(self.nlist, n))
+        rng = np.random.default_rng(self.seed)
+        centroids = self.vecs[rng.choice(n, size=nlist,
+                                         replace=False)].copy()
+        for _ in range(5):  # few Lloyd rounds: centroids only need to
+            sims = self.vecs @ centroids.T          # tile, not converge
+            assign = np.argmax(sims, axis=1).astype(np.int32)
+            for b in range(nlist):
+                members = self.vecs[assign == b]
+                if len(members):
+                    centroids[b] = members.mean(axis=0)
+        self.centroids = centroids.astype(np.float32)
+        self.assign = assign
+        self.rows_at_build = n
+        self.rebuilds += 1
+
+    # ------------------------------------------------------------ search
+    def search(self, queries: np.ndarray, k: int,
+               nprobe: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Top-k by inner product; ``(ids (q,k) i64, scores (q,k) f32)``,
+        padded with id -1 / score 0 when fewer than k rows qualify.
+        ``nprobe >= nlist`` (or a still-flat index) is exact — identical
+        to :func:`brute_force_topk` over the same rows."""
+        queries = np.atleast_2d(np.asarray(queries, np.float64))
+        nprobe = int(knob_int(ENV_NPROBE) if nprobe is None else nprobe)
+        if self.centroids is None or nprobe >= len(self.centroids):
+            return brute_force_topk(self.ids, self.vecs, queries, k)
+        cscores = queries @ self.centroids.astype(np.float64).T
+        out_ids = np.full((len(queries), k), -1, np.int64)
+        out_scores = np.zeros((len(queries), k), np.float32)
+        for q in range(len(queries)):
+            probe = np.argsort(-cscores[q])[:nprobe]
+            mask = np.isin(self.assign, probe)
+            cand_ids, cand_scores = brute_force_topk(
+                self.ids[mask], self.vecs[mask], queries[q:q + 1], k)
+            out_ids[q] = cand_ids[0]
+            out_scores[q] = cand_scores[0]
+        return out_ids, out_scores
+
+    # --------------------------------------------------------- snapshots
+    def snapshot_arrays(self) -> Dict[str, np.ndarray]:
+        arrays = {
+            "ids": self.ids,
+            "vecs": self.vecs,
+            "assign": self.assign,
+            "meta_counters": np.asarray(
+                [self.dim, self.nlist, self.seed, self.min_rebuild_rows,
+                 self.rows_at_build, self.rebuilds], np.int64),
+        }
+        if self.centroids is not None:
+            arrays["centroids"] = self.centroids
+        return arrays
+
+    @classmethod
+    def from_arrays(cls, manifest, arrays) -> "AnnIndex":
+        meta = np.asarray(arrays["meta_counters"], np.int64)
+        idx = cls(dim=int(meta[0]), nlist=int(meta[1]), seed=int(meta[2]),
+                  min_rebuild_rows=int(meta[3]))
+        idx.ids = np.asarray(arrays["ids"], np.int64)
+        idx.vecs = np.asarray(arrays["vecs"], np.float32)
+        idx.assign = np.asarray(arrays["assign"], np.int32)
+        idx.rows_at_build = int(meta[4])
+        idx.rebuilds = int(meta[5])
+        if "centroids" in arrays:
+            idx.centroids = np.asarray(arrays["centroids"], np.float32)
+        idx._pos = {int(item): i for i, item in enumerate(idx.ids)}
+        return idx
+
+    def digest(self) -> str:
+        """Content digest of the retrievable set (ids + row bytes) — the
+        drill's parity token."""
+        import hashlib
+
+        order = np.argsort(self.ids)
+        h = hashlib.blake2b(digest_size=16)
+        h.update(np.ascontiguousarray(self.ids[order], "<i8").tobytes())
+        h.update(np.ascontiguousarray(self.vecs[order], "<f4").tobytes())
+        return h.hexdigest()
+
+
+class IndexBuilder:
+    """Tail the PS push WAL into an :class:`AnnIndex`, snapshotting
+    through loop/publish.py.
+
+    ``row_reader(ids) -> (n, dim) float32`` supplies the authoritative
+    row values (a PS client's ``pull`` — which rides the shm mirror when
+    co-located — or an offline npz source for tests/benches). One cursor
+    per ``shard-<i>/epoch-<e>`` WAL directory, checkpointed only AFTER
+    the snapshot those records landed in committed.
+    """
+
+    def __init__(self, workdir: str, item_table: str,
+                 row_reader: Callable[[np.ndarray], np.ndarray],
+                 dim: int, state_dir: str, publish_dir: str,
+                 nlist: Optional[int] = None,
+                 ckpt_every: Optional[int] = None,
+                 retired_file: Optional[str] = None,
+                 replica: str = "index-0", seed: int = 0,
+                 keep: int = 32):
+        self.workdir = workdir
+        self.item_table = item_table
+        self.row_reader = row_reader
+        self.dim = int(dim)
+        self.state_dir = state_dir
+        self.publish_dir = publish_dir
+        self.ckpt_every = int(knob_int(ENV_CKPT_EVERY)
+                              if ckpt_every is None else ckpt_every)
+        self.retired_file = retired_file
+        self.replica = replica
+        #: snapshot versions kept on disk — generous vs the rollout
+        #: default because a fast incremental cadence must not retire a
+        #: version a serving watcher is still adopting
+        self.keep = int(keep)
+        self.index = AnnIndex(dim, nlist=nlist, seed=seed)
+        self.cursors: Dict[str, SpoolCursor] = {}
+        self.retired: set = set()
+        self._updates_since_snapshot = 0
+        self._retired_mtime = 0.0
+        self.counters: Dict[str, int] = {
+            "records": 0, "item_updates": 0, "polls": 0, "snapshots": 0,
+            "retired": 0, "rebuilds": 0, "dropped_retired": 0,
+        }
+        os.makedirs(state_dir, exist_ok=True)
+        os.makedirs(publish_dir, exist_ok=True)
+
+    # ----------------------------------------------------------- tailing
+    def _wal_dirs(self) -> List[Tuple[str, str]]:
+        """(cursor_key, directory) for every shard/epoch WAL dir."""
+        root = os.path.join(self.workdir, "ps-wal")
+        out: List[Tuple[str, str]] = []
+        if not os.path.isdir(root):
+            return out
+        for shard in sorted(os.listdir(root)):
+            shard_root = os.path.join(root, shard)
+            if not (shard.startswith("shard-")
+                    and os.path.isdir(shard_root)):
+                continue
+            for epoch, epoch_dir in wal.epoch_dirs(shard_root):
+                out.append((f"{shard}/epoch-{epoch}", epoch_dir))
+        return out
+
+    def poll_once(self) -> Dict[str, int]:
+        """One tail pass: new WAL records -> changed item ids -> live row
+        re-read -> index upsert. Returns per-poll stats."""
+        m = _index_metrics()
+        self.counters["polls"] += 1
+        changed: List[int] = []
+        lag_marks: List[float] = []
+        for key, d in self._wal_dirs():
+            cur = self.cursors.get(key, SpoolCursor())
+            reader = SpoolReader(d, suffix=".wal")
+            payloads, new_cur, _stats = reader.read_from(
+                cur, known_kinds=(wal.REC_PUSH, wal.REC_CREATE))
+            if new_cur == cur and not payloads:
+                continue
+            for p in payloads:
+                self.counters["records"] += 1
+                if wal.record_kind(p) != wal.REC_PUSH:
+                    continue
+                table, ids, _grads, _scale = wal.decode_push(p)
+                if table != self.item_table:
+                    continue
+                changed.extend(int(i) for i in ids)
+            self.cursors[key] = new_cur
+            if payloads:
+                try:
+                    seg = os.path.join(d, new_cur.segment)
+                    lag_marks.append(
+                        max(0.0, time.time() - os.path.getmtime(seg)))
+                except OSError:
+                    pass
+        applied = 0
+        if changed:
+            uniq = np.unique(np.asarray(changed, np.int64))
+            live = uniq[~np.isin(uniq, np.asarray(sorted(self.retired),
+                                                  np.int64))] \
+                if self.retired else uniq
+            self.counters["dropped_retired"] += len(uniq) - len(live)
+            if len(live):
+                rows = np.asarray(self.row_reader(live), np.float32)
+                self.index.upsert(live, rows.reshape(len(live), self.dim))
+                applied = len(live)
+                self.counters["item_updates"] += applied
+                self._updates_since_snapshot += 1
+                m[0].inc(applied, replica=self.replica, source="wal")
+                for lag in lag_marks:
+                    m[2].observe(lag, replica=self.replica)
+        retired_now = self._apply_retirements()
+        reason = self.index.maybe_rebuild()
+        if reason:
+            self.counters["rebuilds"] += 1
+            m[0].inc(replica=self.replica, source="rebuild")
+            log.info("retrieval index re-clustered (%s): %d rows, "
+                     "%d buckets", reason, len(self.index),
+                     0 if self.index.centroids is None
+                     else len(self.index.centroids))
+        m[1].set(len(self.index), replica=self.replica)
+        return {"applied": applied, "retired": retired_now,
+                "rebuilt": int(bool(reason))}
+
+    def _apply_retirements(self) -> int:
+        """Adopt the retirement file (a JSON id list) if it changed.
+        Retirement is PINNED: the ids join ``self.retired`` so later WAL
+        records for them are dropped, and the set rides the snapshot."""
+        if not self.retired_file:
+            return 0
+        try:
+            mtime = os.path.getmtime(self.retired_file)
+        except OSError:
+            return 0
+        if mtime == self._retired_mtime:
+            return 0
+        self._retired_mtime = mtime
+        try:
+            with open(self.retired_file) as f:
+                ids = [int(i) for i in json.load(f)]
+        except (OSError, ValueError):
+            return 0
+        fresh = [i for i in ids if i not in self.retired]
+        self.retired.update(fresh)
+        removed = self.index.remove(np.asarray(ids, np.int64))
+        if fresh:
+            self.counters["retired"] += len(fresh)
+            _index_metrics()[0].inc(len(fresh), replica=self.replica,
+                                    source="retire")
+            self._updates_since_snapshot += 1
+        return removed
+
+    # -------------------------------------------------------- durability
+    def snapshot_if_due(self, force: bool = False) -> int:
+        """Publish an index snapshot + commit the cursor file. Returns
+        the published version (0 = not due). Order is the exactly-once
+        contract: snapshot FIRST, cursor SECOND — a crash between them
+        re-tails an already-applied window, which converges because row
+        values come from the live store, not the log."""
+        if not force and not snapshot_due(self._updates_since_snapshot,
+                                          self.ckpt_every):
+            return 0
+        arrays = self.index.snapshot_arrays()
+        if self.retired:
+            arrays["retired"] = np.asarray(sorted(self.retired), np.int64)
+        version = publish.publish_version(
+            self.publish_dir, arrays, keep=self.keep,
+            meta={"kind": "retrieval-index", "rows": len(self.index),
+                  "item_table": self.item_table,
+                  "records": self.counters["records"]})
+        doc = {
+            "version": int(version),
+            "cursors": {k: c.to_dict() for k, c in self.cursors.items()},
+            "records": self.counters["records"],
+            "item_updates": self.counters["item_updates"],
+            "retired": sorted(self.retired),
+        }
+        path = os.path.join(self.state_dir, STATE_FILE)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._updates_since_snapshot = 0
+        self.counters["snapshots"] += 1
+        return int(version)
+
+    def restore(self) -> Dict[str, object]:
+        """Resume from the last committed (snapshot, cursor) pair.
+        Returns evidence for the chaos drill."""
+        path = os.path.join(self.state_dir, STATE_FILE)
+        if not os.path.exists(path):
+            return {"restored": False}
+        with open(path) as f:
+            doc = json.load(f)
+        version = int(doc.get("version", 0))
+        if version:
+            _manifest, arrays = publish.load_version(self.publish_dir,
+                                                     version)
+            self.index = AnnIndex.from_arrays(_manifest, arrays)
+            if "retired" in arrays:
+                self.retired = set(
+                    int(i) for i in np.asarray(arrays["retired"]))
+        self.retired.update(int(i) for i in doc.get("retired", []))
+        self.cursors = {k: SpoolCursor.from_dict(c)
+                        for k, c in dict(doc.get("cursors", {})).items()}
+        self.counters["item_updates"] = int(doc.get("item_updates", 0))
+        _index_metrics()[0].inc(replica=self.replica, source="restore")
+        _index_metrics()[1].set(len(self.index), replica=self.replica)
+        evidence = {
+            "restored": True,
+            "restored_version": version,
+            "restored_rows": len(self.index),
+            "restored_cursor_records": sum(
+                c.records for c in self.cursors.values()),
+        }
+        log.info("retrieval index restored: v%d, %d rows, %d WAL records "
+                 "consumed", version, len(self.index),
+                 evidence["restored_cursor_records"])
+        return evidence
+
+
+def _npz_row_reader(path: str, dim: int) -> Callable[[np.ndarray],
+                                                     np.ndarray]:
+    """Offline row source for tests/benches: an npz of {ids, vecs},
+    re-loaded when the file changes (so a 'push' is an npz rewrite +
+    a WAL append). Unknown ids read as zero rows — same lazy-init shape
+    the live store would hand back for a never-pulled id."""
+    state = {"mtime": 0.0, "rows": {}}
+
+    def read(ids: np.ndarray) -> np.ndarray:
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        if mtime != state["mtime"]:
+            with np.load(path) as doc:
+                state["rows"] = {
+                    int(i): v for i, v in zip(doc["ids"],
+                                              np.asarray(doc["vecs"],
+                                                         np.float32))}
+            state["mtime"] = mtime
+        return np.stack([
+            state["rows"].get(int(i), np.zeros(dim, np.float32))
+            for i in np.asarray(ids).reshape(-1)])
+
+    return read
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Run an index-builder pod: tail the WAL, snapshot on cadence, exit
+    on the stop file. The chaos drill SIGKILLs this process mid-update
+    and asserts the restore re-tails exactly-once."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="easydl_tpu retrieval index builder")
+    parser.add_argument("--workdir", required=True)
+    parser.add_argument("--table", required=True,
+                        help="item embedding table to index")
+    parser.add_argument("--dim", type=int, required=True)
+    parser.add_argument("--state-dir", required=True)
+    parser.add_argument("--publish-dir", required=True)
+    parser.add_argument("--shards", type=int, default=0,
+                        help="PS shard count (0 = offline --rows-npz "
+                             "source instead of a live cluster)")
+    parser.add_argument("--rows-npz", default="",
+                        help="offline row source (tests/benches): npz of "
+                             "{ids, vecs} standing in for the live store")
+    parser.add_argument("--retired-file", default="")
+    parser.add_argument("--poll-s", type=float,
+                        default=knob_float(ENV_POLL_S))
+    parser.add_argument("--ckpt-every", type=int,
+                        default=knob_int(ENV_CKPT_EVERY))
+    parser.add_argument("--nlist", type=int, default=knob_int(ENV_NLIST))
+    parser.add_argument("--stop-file", default="")
+    parser.add_argument("--status-file", default="")
+    parser.add_argument("--name", default="index-0")
+    args = parser.parse_args(argv)
+
+    def status(phase: str, **extra) -> None:
+        if not args.status_file:
+            return
+        doc = {"phase": phase, "pid": os.getpid(), "t": time.time()}
+        doc.update(extra)
+        with open(args.status_file, "a") as f:
+            f.write(json.dumps(doc) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    client = None
+    if args.rows_npz:
+        row_reader = _npz_row_reader(args.rows_npz, args.dim)
+    else:
+        # Live mode: pull through the trainer's own client. pull_shm
+        # rides the shard's shared-memory mirror when this builder is
+        # co-located (the negotiated fallback to gRPC is the contract).
+        from easydl_tpu.ps.client import ShardedPsClient
+
+        client = ShardedPsClient.from_registry(
+            args.workdir, args.shards or None, timeout=5.0,
+            drain_retry_s=60.0, transient_retry_s=30.0, pull_shm=True)
+        row_reader = lambda ids: client.pull(args.table, ids)  # noqa: E731
+
+    builder = IndexBuilder(
+        args.workdir, args.table, row_reader, args.dim,
+        state_dir=args.state_dir, publish_dir=args.publish_dir,
+        nlist=args.nlist, ckpt_every=args.ckpt_every,
+        retired_file=args.retired_file or None, replica=args.name)
+    evidence = builder.restore()
+    status("started", **{k: v for k, v in evidence.items()
+                         if not isinstance(v, dict)})
+    try:
+        while True:
+            stats = builder.poll_once()
+            version = builder.snapshot_if_due()
+            if version:
+                status("snapshot", version=version,
+                       rows=len(builder.index),
+                       records=builder.counters["records"])
+            if args.stop_file and os.path.exists(args.stop_file):
+                break
+            if not stats["applied"] and not stats["retired"]:
+                time.sleep(args.poll_s)
+    finally:
+        final = builder.snapshot_if_due(
+            force=builder._updates_since_snapshot > 0)
+        status("done", counters=builder.counters,
+               final_version=final or 0, rows=len(builder.index))
+        if client is not None:
+            client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

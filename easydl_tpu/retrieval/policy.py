@@ -1,0 +1,56 @@
+"""Index maintenance decisions — pure functions, simulator-replayable.
+
+The ANN index (retrieval/index.py) separates *mechanism* (k-means
+clustering, bucket upserts, snapshot publication) from *decision* (when a
+re-cluster or a snapshot is worth its cost). The decisions live here, as
+pure functions of observable state, for the same reason every other
+policy in the repo is pure (analysis/rules/purity.py rule 5): the offline
+simulator can replay them byte-identically, and the chaos drills can
+assert WHY a rebuild fired from the recorded inputs alone.
+
+No wall clock, no global RNG: cadence inputs are passed in by the caller
+(the builder counts updates; the bench counts rows).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def decide_rebuild(total_rows: int, bucket_sizes: Sequence[int],
+                   min_rows: int, skew_ratio: float = 4.0,
+                   growth_ratio: float = 2.0,
+                   rows_at_last_build: int = 0) -> str:
+    """Should the index re-cluster its buckets now? Returns a reason
+    string ("" = no rebuild):
+
+    * ``"first"`` — the index is still flat (never clustered) and has
+      reached ``min_rows``: clustering starts paying for itself.
+    * ``"growth"`` — the corpus grew past ``growth_ratio`` x the size the
+      current centroids were trained on: they no longer tile the space.
+    * ``"skew"`` — the fullest bucket holds ``skew_ratio`` x the mean:
+      probes over it degrade toward brute force while empty buckets
+      waste the probe budget.
+
+    Below ``min_rows`` the flat index IS brute force — exact and cheap —
+    so no rebuild ever fires there.
+    """
+    if total_rows < max(int(min_rows), 1):
+        return ""
+    if not bucket_sizes:
+        return "first"
+    if rows_at_last_build > 0 and total_rows >= growth_ratio * rows_at_last_build:
+        return "growth"
+    mean = total_rows / max(len(bucket_sizes), 1)
+    if mean > 0 and max(bucket_sizes) >= skew_ratio * mean:
+        return "skew"
+    return ""
+
+
+def snapshot_due(updates_since_snapshot: int, ckpt_every: int) -> bool:
+    """Should the builder publish an index snapshot now? True every
+    ``ckpt_every`` applied incremental updates (0/negative = snapshot on
+    every update — the drill setting, maximizing kill windows)."""
+    if updates_since_snapshot <= 0:
+        return False
+    return updates_since_snapshot >= max(int(ckpt_every), 1)

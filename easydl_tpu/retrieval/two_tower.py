@@ -1,0 +1,177 @@
+"""Two-tower retrieval model over the existing PS embedding machinery.
+
+The ranking path scores candidates the CALLER supplies; this module
+learns to *generate* candidates. Two towers, no new parameter store:
+
+* **item tower** — one embedding row per item id, in a PS table of its
+  own (``EASYDL_RETRIEVAL_ITEM_TABLE``). Every push to it lands in the
+  shard's push WAL, which is exactly the stream the index builder
+  (retrieval/index.py) tails — training freshness IS serving freshness.
+* **user tower** — mean-pool over the user's context ids (the trailing
+  columns of a feedback event's ``ids``), each a row in the user table.
+
+Training consumes the PR-13 feedback stream through the same
+``FeedbackBatcher`` the continuous ranker trainer uses, with **in-batch
+sampled-softmax negatives** (Covington et al., RecSys 2016): each
+positive (user, item) pair in a batch treats every OTHER item in the
+batch as a negative, so no separate negative-sampling service exists.
+The math lives in module-level pure functions (exact closed-form
+gradients, no autodiff dependency) so tests pin it numerically; the
+trainer just moves rows: pull → grads → push, and the tables' own sparse
+optimizers apply the step (the push-WAL/rescue/freshness contracts all
+hold because these are ordinary pushes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from easydl_tpu.loop.feedback import FeedbackEvent
+from easydl_tpu.utils.env import knob_float, knob_str
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("retrieval", "two_tower")
+
+ENV_USER_TABLE = "EASYDL_RETRIEVAL_USER_TABLE"
+ENV_ITEM_TABLE = "EASYDL_RETRIEVAL_ITEM_TABLE"
+ENV_TEMPERATURE = "EASYDL_RETRIEVAL_TEMPERATURE"
+
+
+def tower_forward(rows: np.ndarray) -> np.ndarray:
+    """Mean-pool a ``(batch, fields, dim)`` stack of embedding rows into
+    ``(batch, dim)`` tower outputs. Mean (not sum) keeps the output scale
+    independent of the field count; no normalization, so the gradients
+    below stay exact."""
+    rows = np.asarray(rows, np.float32)
+    return rows.mean(axis=1)
+
+
+def in_batch_softmax_grads(u: np.ndarray, v: np.ndarray,
+                           temperature: Optional[float] = None
+                           ) -> Tuple[float, np.ndarray, np.ndarray]:
+    """Sampled-softmax loss over in-batch negatives, with closed-form
+    gradients.
+
+    ``u``/``v`` are ``(B, D)`` user/item tower outputs where row ``i`` of
+    each is a POSITIVE pair and every ``j != i`` item is a negative for
+    user ``i``. Loss = mean cross-entropy of the diagonal under
+    ``softmax(u @ v.T / temperature)``. Returns ``(loss, du, dv)`` —
+    exact dense gradients w.r.t. the tower outputs.
+    """
+    temperature = float(knob_float(ENV_TEMPERATURE)
+                        if temperature is None else temperature)
+    u = np.asarray(u, np.float32)
+    v = np.asarray(v, np.float32)
+    b = len(u)
+    logits = (u @ v.T) / np.float32(temperature)
+    logits -= logits.max(axis=1, keepdims=True)  # stable softmax
+    p = np.exp(logits)
+    p /= p.sum(axis=1, keepdims=True)
+    eye = np.eye(b, dtype=np.float32)
+    loss = float(-np.log(np.clip(np.diag(p), 1e-12, None)).mean())
+    dlogits = (p - eye) / np.float32(b)
+    du = (dlogits @ v) / np.float32(temperature)
+    dv = (dlogits.T @ u) / np.float32(temperature)
+    return loss, du, dv
+
+
+def pairs_from_events(events: List[FeedbackEvent]
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Positive (user-context, item) pairs from labeled feedback events.
+
+    Convention (matches the serve emit path): ``ids[:, 0]`` is the
+    candidate item id, ``ids[:, 1:]`` the user's context ids. Rows with a
+    positive joined label are positives; an item id may repeat across
+    events (a popular item is a popular positive) but duplicates WITHIN
+    one returned batch are dropped — in-batch softmax needs distinct
+    negatives. Returns ``(item_ids (B,), user_ctx (B, F-1))``.
+    """
+    items: List[int] = []
+    ctx: List[np.ndarray] = []
+    seen: set = set()
+    for ev in events:
+        if ev.labels is None or ev.ids.shape[1] < 2:
+            continue
+        for r in range(len(ev.ids)):
+            item = int(ev.ids[r, 0])
+            if ev.labels[r] <= 0 or item in seen:
+                continue
+            seen.add(item)
+            items.append(item)
+            ctx.append(np.asarray(ev.ids[r, 1:], np.int64))
+    if not items:
+        return (np.zeros(0, np.int64),
+                np.zeros((0, 0), np.int64))
+    return np.asarray(items, np.int64), np.stack(ctx)
+
+
+class TwoTowerTrainer:
+    """Pull → exact grads → push, against live PS tables.
+
+    ``client`` is any PS client (Local or Sharded). The pushes are
+    ordinary sparse pushes: the tables' own optimizers apply the step
+    (``scale`` multiplies the pushed gradients, the table ``lr`` does the
+    descent), item-table pushes ride the WAL into the index builder's
+    tail, and a trainer crash loses nothing acked.
+    """
+
+    def __init__(self, client, dim: int,
+                 user_table: Optional[str] = None,
+                 item_table: Optional[str] = None,
+                 temperature: Optional[float] = None,
+                 scale: float = 1.0):
+        self.client = client
+        self.dim = int(dim)
+        self.user_table = (knob_str(ENV_USER_TABLE)
+                           if user_table is None else user_table)
+        self.item_table = (knob_str(ENV_ITEM_TABLE)
+                           if item_table is None else item_table)
+        self.temperature = (float(knob_float(ENV_TEMPERATURE))
+                            if temperature is None else float(temperature))
+        self.scale = float(scale)
+        self.counters: Dict[str, int] = {"batches": 0, "pairs": 0,
+                                         "skipped_small": 0}
+
+    def user_tower(self, user_ctx: np.ndarray) -> np.ndarray:
+        """``(B, F)`` context ids -> ``(B, D)`` user embeddings."""
+        rows = self.client.pull(self.user_table,
+                                user_ctx.reshape(-1))
+        return tower_forward(rows.reshape(user_ctx.shape + (self.dim,)))
+
+    def item_tower(self, item_ids: np.ndarray) -> np.ndarray:
+        return np.asarray(self.client.pull(self.item_table, item_ids),
+                          np.float32)
+
+    def train_batch(self, events: List[FeedbackEvent]) -> Optional[float]:
+        """One in-batch-softmax step from a batch of feedback events.
+        Returns the loss, or None when the batch yields < 2 distinct
+        positives (softmax over one candidate is degenerate)."""
+        item_ids, user_ctx = pairs_from_events(events)
+        if len(item_ids) < 2:
+            self.counters["skipped_small"] += 1
+            return None
+        u = self.user_tower(user_ctx)
+        v = self.item_tower(item_ids)
+        loss, du, dv = in_batch_softmax_grads(u, v, self.temperature)
+        # Mean-pool backprop: each of the F context rows receives du/F.
+        fields = user_ctx.shape[1]
+        ctx_grads = np.repeat(du / np.float32(fields), fields, axis=0)
+        self.client.push(self.user_table, user_ctx.reshape(-1),
+                         ctx_grads, scale=self.scale)
+        self.client.push(self.item_table, item_ids, dv, scale=self.scale)
+        self.counters["batches"] += 1
+        self.counters["pairs"] += len(item_ids)
+        return loss
+
+    def run(self, batcher, stop_check, batch_size: int = 32,
+            timeout_s: float = 1.0) -> Dict[str, int]:
+        """Drain a :class:`FeedbackBatcher` until ``stop_check()``."""
+        while not stop_check():
+            batch = batcher.next_batch(batch_size, timeout_s=timeout_s,
+                                       allow_partial=True)
+            if batch:
+                self.train_batch(batch)
+                batcher.mark_consumed()
+        return dict(self.counters)

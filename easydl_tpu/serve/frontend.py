@@ -48,7 +48,7 @@ from easydl_tpu.loop.rollout import CANARY, CONTROL, assign_arm
 from easydl_tpu.obs import get_registry, start_exporter, tracing
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.ps.read_client import PsReadClient
-from easydl_tpu.utils.env import knob_float, knob_str
+from easydl_tpu.utils.env import knob_float, knob_int, knob_str
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, ServiceDef, serve
 
@@ -58,12 +58,16 @@ SERVE_SERVICE = ServiceDef(
     "easydl.Serve",
     {
         "Infer": (pb.InferRequest, pb.InferResponse),
+        "Retrieve": (pb.RetrieveRequest, pb.RetrieveResponse),
         "Rollout": (pb.RolloutRequest, pb.RolloutResponse),
     },
 )
 
 ENV_CANARY_FRACTION = "EASYDL_ROLLOUT_CANARY_FRACTION"
 ENV_ROLLOUT_SALT = "EASYDL_ROLLOUT_SALT"
+ENV_RETRIEVAL_K = "EASYDL_RETRIEVAL_K"
+ENV_RETRIEVAL_NPROBE = "EASYDL_RETRIEVAL_NPROBE"
+ENV_RETRIEVAL_USER_TABLE = "EASYDL_RETRIEVAL_USER_TABLE"
 
 #: InferResponse.verdict prefix for a shed request — the RETRIABLE class
 #: (back off and re-send); anything else non-empty is a hard failure.
@@ -97,6 +101,17 @@ class InferResult:
     @property
     def retriable(self) -> bool:
         return (not self.ok) and self.verdict.startswith(OVERLOADED)
+
+
+@dataclass
+class RetrieveResult:
+    ok: bool
+    verdict: str                   # "" ok; non-empty = hard failure
+    candidate_ids: Optional[np.ndarray] = None   # (rows, k) int64, -1 pads
+    scores: Optional[np.ndarray] = None          # (rows, k) float32
+    index_version: int = 0
+    arm: str = CONTROL
+    latency_s: float = 0.0
 
 
 @dataclass
@@ -243,6 +258,32 @@ def _serve_metrics():
     return _serve_metrics_cache
 
 
+_retrieve_metrics_cache: Optional[tuple] = None
+
+
+def _retrieve_metrics():
+    global _retrieve_metrics_cache
+    if _retrieve_metrics_cache is None:
+        reg = get_registry()
+        _retrieve_metrics_cache = (
+            reg.counter(
+                "easydl_retrieval_requests_total",
+                "Retrieve (candidate-generation) requests, by replica and "
+                "verdict (ok | error).", ("replica", "verdict")),
+            reg.counter(
+                "easydl_retrieval_candidates_total",
+                "Candidates returned across ok Retrieve requests "
+                "(excludes -1 padding).", ("replica",)),
+            reg.gauge(
+                "easydl_retrieval_index_version",
+                "Published ANN index snapshot this replica answers "
+                "retrievals from, per arm (0 = no index installed; "
+                "visibility is commit-marker-gated like model "
+                "versions).", ("replica", "arm")),
+        )
+    return _retrieve_metrics_cache
+
+
 class ServeFrontend:
     """One serving replica: queue + batch runner + forward + gRPC surface.
 
@@ -266,6 +307,14 @@ class ServeFrontend:
         #: across model versions).
         self._models: Dict[str, Tuple[int, Callable]] = {
             CONTROL: (0, self.forward)}
+        #: per-arm (version, AnnIndex) bank for the Retrieve path — the
+        #: retrieval twin of the model bank, fed by a ModelVersionWatcher
+        #: over the index publish dir. Same swap discipline: a retrieve
+        #: snapshots one entry under the lock and answers wholly from it.
+        self._indexes: Dict[str, Tuple[int, Any]] = {}
+        #: user-tower table the Retrieve path pulls context rows from
+        #: (attach_retrieval sets it; None = Retrieve answers a verdict).
+        self._retrieval_user_table: Optional[str] = None
         #: loop/feedback.py FeedbackWriter (optional): the emit hook.
         #: Contract: emission NEVER blocks or fails a request — the
         #: writer itself is lossy-with-count, and emission runs on the
@@ -348,6 +397,77 @@ class ServeFrontend:
             return CONTROL
         return assign_arm(session_id, self.canary_fraction,
                           self.rollout_salt)
+
+    # ----------------------------------------------------------- index bank
+    def attach_retrieval(self, user_table: str) -> None:
+        """Arm the Retrieve path: context ids pull from ``user_table``
+        through the same hot-cached read client as ranking pulls."""
+        self._retrieval_user_table = str(user_table)
+
+    def set_index(self, version: int, index, arm: str = CONTROL) -> None:
+        """Install a loaded ANN index snapshot for ``arm`` (the retrieval
+        hot-swap; same between-requests atomicity as :meth:`set_model`)."""
+        with self._mu:
+            self._indexes[arm] = (int(version), index)
+        _retrieve_metrics()[2].set(int(version), replica=self.name,
+                                   arm=arm)
+
+    def clear_canary_index(self) -> None:
+        with self._mu:
+            self._indexes.pop(CANARY, None)
+        _retrieve_metrics()[2].set(0, replica=self.name, arm=CANARY)
+
+    def index_versions(self) -> Dict[str, int]:
+        with self._mu:
+            return {arm: v for arm, (v, _i) in self._indexes.items()}
+
+    def _assign_index_arm(self, session_id: str) -> str:
+        """Session-consistent retriever A/B: the same assign_arm hash as
+        model arms, gated on a canary INDEX being installed."""
+        with self._mu:
+            has_canary = CANARY in self._indexes
+        if not has_canary or not session_id:
+            return CONTROL
+        return assign_arm(session_id, self.canary_fraction,
+                          self.rollout_salt)
+
+    def retrieve(self, user_ids: np.ndarray, k: Optional[int] = None,
+                 session_id: str = "",
+                 nprobe: Optional[int] = None) -> RetrieveResult:
+        """Generate top-k candidates for ``(rows, user_fields)`` context
+        ids: pull the context rows, mean-pool them into user-tower
+        vectors, search the session's arm's index. Runs inline (cheap
+        numpy + one cached pull), not through the ranking micro-batch
+        queue — retrieval latency must not ride the scoring deadline."""
+        m = _retrieve_metrics()
+        t0 = time.monotonic()
+        k = int(knob_int(ENV_RETRIEVAL_K) if k is None or k <= 0 else k)
+        user_ids = np.asarray(user_ids, np.int64)
+        if user_ids.ndim != 2 or user_ids.shape[1] < 1:
+            raise ValueError(
+                f"user_ids must be (rows, user_fields), got "
+                f"{user_ids.shape}")
+        arm = self._assign_index_arm(session_id)
+        with self._mu:
+            entry = self._indexes.get(arm) or self._indexes.get(CONTROL)
+        table = self._retrieval_user_table
+        if entry is None or table is None:
+            m[0].inc(replica=self.name, verdict="error")
+            return RetrieveResult(
+                False, "error: no retrieval index attached",
+                arm=arm, latency_s=time.monotonic() - t0)
+        version, index = entry
+        rows = self.reads.pull(table, user_ids.reshape(-1))
+        u = rows.reshape(user_ids.shape + (rows.shape[-1],)) \
+                .mean(axis=1, dtype=np.float32)
+        cand, scores = index.search(u, k, nprobe=nprobe)
+        lat = time.monotonic() - t0
+        m[0].inc(replica=self.name, verdict="ok")
+        m[1].inc(int((cand >= 0).sum()), replica=self.name)
+        # Retrieval is offered load too: feed the rolling qps/p99 window
+        # the replica policy and the router's least-loaded dispatch read.
+        self._observe_latency(lat)
+        return RetrieveResult(True, "", cand, scores, version, arm, lat)
 
     # --------------------------------------------------------------- submit
     def infer(self, ids: np.ndarray, dense: Optional[np.ndarray] = None,
@@ -647,6 +767,42 @@ class ServeFrontend:
                     if result.scores is not None else b""),
             # Piggybacked rolling gauges: the fleet router's least-loaded
             # dispatch reads load off every answer instead of scraping.
+            qps_recent=qps, p99_seconds_recent=p99,
+        )
+
+    def Retrieve(self, req: pb.RetrieveRequest, ctx) -> pb.RetrieveResponse:
+        """Candidate generation over the wire — same malformed-input
+        verdict contract as Infer (a raise would surface as an opaque
+        UNKNOWN status; a verdict names the defect)."""
+        if len(req.raw_user_ids) % 8:
+            _retrieve_metrics()[0].inc(replica=self.name, verdict="error")
+            return pb.RetrieveResponse(
+                ok=False,
+                verdict=f"error: raw_user_ids is {len(req.raw_user_ids)} "
+                        "bytes, not a multiple of 8 (little-endian int64)")
+        ids = np.frombuffer(req.raw_user_ids, dtype="<i8")
+        fields = int(req.user_fields)
+        if fields <= 0 or len(ids) == 0 or len(ids) % fields:
+            _retrieve_metrics()[0].inc(replica=self.name, verdict="error")
+            return pb.RetrieveResponse(
+                ok=False,
+                verdict=f"error: {len(ids)} user ids not divisible by "
+                        f"user_fields={fields}")
+        try:
+            result = self.retrieve(ids.reshape(-1, fields),
+                                   k=int(req.k),
+                                   session_id=str(req.session_id))
+        except ValueError as e:
+            _retrieve_metrics()[0].inc(replica=self.name, verdict="error")
+            return pb.RetrieveResponse(ok=False, verdict=f"error: {e}")
+        qps, p99 = self.recent_gauges()
+        return pb.RetrieveResponse(
+            ok=result.ok, verdict=result.verdict,
+            candidate_ids=(result.candidate_ids.astype("<i8").tobytes()
+                           if result.candidate_ids is not None else b""),
+            scores=(result.scores.astype("<f4").tobytes()
+                    if result.scores is not None else b""),
+            index_version=int(result.index_version), arm=result.arm,
             qps_recent=qps, p99_seconds_recent=p99,
         )
 

@@ -465,6 +465,48 @@ class ServeRouter:
                                       message="error: replica vanished")
         return rec.client.Rollout(req)
 
+    def Retrieve(self, req: pb.RetrieveRequest, ctx) -> pb.RetrieveResponse:
+        """Proxy candidate generation with the same session affinity as
+        scoring: the session's HRW-preferred replica answers, so a
+        session's retriever arm AND its index snapshot stay consistent
+        across the retrieve->rank pair. Transport failure ejects-and-
+        reroutes exactly like Infer dispatch (one retry pass over the
+        remaining fleet)."""
+        self._refresh_replicas()
+        session_id = str(req.session_id)
+        tried: List[str] = []
+        self._count("requests")
+        last_error = "no healthy replica"
+        t0 = time.monotonic()
+        while True:
+            target = route_decision(self._views(), session_id=session_id,
+                                    exclude=tuple(tried), salt=self.salt)
+            if target is None:
+                self._count("error")
+                return pb.RetrieveResponse(
+                    ok=False, verdict=f"error: {last_error}")
+            with self._mu:
+                rec = self._replicas.get(target)
+            tried.append(target)
+            if rec is None:
+                last_error = "replica vanished"
+                continue
+            if len(tried) > 1:
+                self._count("reroutes")
+            try:
+                resp = rec.client.Retrieve(req)
+            except Exception as e:
+                count_swallowed("serve.router.retrieve_leg", e)
+                last_error = repr(e)
+                self._note_result(rec, ok=False, shed=False,
+                                  transport_fail=True)
+                continue
+            self._note_result(rec, ok=bool(resp.ok), shed=False,
+                              transport_fail=False, resp=resp)
+            self._observe(time.monotonic() - t0)
+            self._count("ok" if resp.ok else "error")
+            return resp
+
     def _dispatch(self, req: pb.InferRequest,
                   session_id: str) -> pb.InferResponse:
         m = _router_metrics()

@@ -195,6 +195,32 @@ KNOB_DECLS = (
      "active (sessions without an id always serve control)."),
     ("EASYDL_ROLLOUT_SALT", "str", "",
      "Session->arm hash salt; rotate to reshuffle the A/B population."),
+    # -- retrieval: two-tower + ANN index ---------------------------------
+    ("EASYDL_RETRIEVAL_USER_TABLE", "str", "tt_user",
+     "PS table holding the user-tower context embeddings."),
+    ("EASYDL_RETRIEVAL_ITEM_TABLE", "str", "tt_item",
+     "PS table holding the item-tower embeddings; pushes to it are what "
+     "the index builder tails into retrievability."),
+    ("EASYDL_RETRIEVAL_K", "int", 10,
+     "Default candidate count a Retrieve request gets when it asks for "
+     "k<=0."),
+    ("EASYDL_RETRIEVAL_NLIST", "int", 16,
+     "ANN index bucket count (k-means centroids) once clustered."),
+    ("EASYDL_RETRIEVAL_NPROBE", "int", 8,
+     "Centroid buckets probed per query; >= nlist degenerates to exact "
+     "brute force."),
+    ("EASYDL_RETRIEVAL_POLL_S", "float", 0.05,
+     "Index-builder WAL tail poll cadence on an exhausted log."),
+    ("EASYDL_RETRIEVAL_CKPT_EVERY", "int", 8,
+     "Applied incremental updates between index snapshot publications "
+     "(snapshot first, cursor second — the exactly-once boundary)."),
+    ("EASYDL_RETRIEVAL_FRESHNESS_SLO_S", "float", 5.0,
+     "Push->retrievable freshness SLO the bench gates p99 against."),
+    ("EASYDL_RETRIEVAL_TEMPERATURE", "float", 0.05,
+     "In-batch sampled-softmax temperature for two-tower training."),
+    ("EASYDL_RETRIEVAL_REBUILD_MIN_ROWS", "int", 64,
+     "Rows before the flat index first clusters; below it brute force is "
+     "exact and cheap."),
     # -- mesh-shape policy / MFU ------------------------------------------
     ("EASYDL_MESH_PIN", "str", "",
      "Operator override: pin the elastic mesh-shape policy to this shape "

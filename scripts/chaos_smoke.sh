@@ -46,6 +46,7 @@ env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
     --scenario serve_replica_death_mid_flood \
     --scenario trainer_crash_mid_loop \
     --scenario rollout_half_update \
+    --scenario retrieval_replica_death_mid_index_update \
     --scenario multi_tenant_contention --keep-workdir "$@" \
     2>&1 | tee "$LOG"
 
@@ -173,6 +174,34 @@ assert lp.get("replayed_window", 0) >= 1, (
     "path was never exercised")
 print(f"loop OK: {trained} events trained exactly-once, "
       f"{lp['replayed_window']} replayed after the kill, digests match")
+PY
+        ;;
+    *retrieval_replica_death_mid_index_update*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+lp = doc["loop"]
+incr = lp.get("incremental_updates", 0)
+assert incr >= 1, (
+    f"{sys.argv[1]}: ZERO incremental index updates committed under "
+    "live traffic — the builder never moved the index mid-run, the "
+    "pass is vacuous")
+during = lp.get("retrievals_during_update", 0)
+assert during >= 1, (
+    f"{sys.argv[1]}: ZERO retrievals served during the update window — "
+    "the frontend was never queried while the index was moving, the "
+    "pass is vacuous")
+assert lp.get("restarts", 0) >= 1 and lp.get("restored_version", 0) >= 1, (
+    f"{sys.argv[1]}: the builder was never killed + resumed from a "
+    "committed snapshot (restarts="
+    f"{lp.get('restarts')}, restored_version={lp.get('restored_version')})")
+assert lp.get("digests_match"), (
+    f"{sys.argv[1]}: served candidates diverged from the brute-force "
+    f"bypass witness ({lp.get('digest_served')} != "
+    f"{lp.get('digest_witness')})")
+print(f"retrieval OK: {incr} incremental updates, {during} retrievals "
+      f"mid-update, builder resumed from v{lp['restored_version']}, "
+      "served == bypass witness")
 PY
         ;;
     *rollout_half_update*)

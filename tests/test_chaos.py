@@ -497,9 +497,11 @@ def test_scenario_catalog_compiles_deterministically():
             assert sc.expect.get("ps_zero_loss")
         elif sc.loop_drill is not None:
             # production-loop drills: the goal invariant is exactly-once
-            # resume or commit-gated rollout, not a step target
+            # resume, commit-gated rollout, or retrieval digest parity —
+            # not a step target
             assert sc.expect.get("loop_exactly_once") \
-                or sc.expect.get("rollout_commit_gated")
+                or sc.expect.get("rollout_commit_gated") \
+                or sc.expect.get("retrieval_consistent")
         elif sc.fleet_drill is not None:
             # serve-fleet drills: the goal invariant is router resilience
             # (ejection + hedging + bit-exact freshness), not a step
