@@ -49,10 +49,10 @@ HISTOGRAM_UNITS = ("_seconds", "_bytes", "_examples", "_records", "_rows",
 #: declaring a new fleet-wide series dimension; every registration site
 #: must draw from it.
 KNOWN_LABELS = frozenset((
-    "agent", "arm", "axis", "component", "fault", "generation", "has_plan",
-    "job", "kind", "method", "op", "phase", "reason", "replica", "result",
-    "role", "scenario", "service", "shard", "site", "source", "table",
-    "target", "verb", "verdict",
+    "agent", "arm", "axis", "cell", "component", "fault", "generation",
+    "has_plan", "job", "kind", "method", "op", "phase", "reason", "replica",
+    "result", "role", "scenario", "service", "shard", "site", "source",
+    "table", "target", "verb", "verdict",
 ))
 
 _RESERVED_LABELS = frozenset(("le", "quantile"))

@@ -41,6 +41,7 @@ PURE_PATHS = (
     "easydl_tpu/brain/mesh_policy.py",
     "easydl_tpu/brain/policy.py",
     "easydl_tpu/brain/straggler.py",
+    "easydl_tpu/cell/policy.py",
     "easydl_tpu/core/mesh_shapes.py",
     "easydl_tpu/elastic/membership.py",
     "easydl_tpu/loop/rollout.py",

@@ -1,0 +1,41 @@
+"""Cross-cell disaster tolerance: WAL shipping, fenced promotion, standby
+serve.
+
+Every durability story below this package assumes the workdir survives —
+a lost host is rescued from *local* WAL + snapshots (ps/__main__.py). A
+lost CELL (power domain, rack row, availability zone) takes the workdir
+with it, so survival needs a second cell holding a near-line copy of
+everything a rescue would read:
+
+- :mod:`easydl_tpu.cell.ship` — the asynchronous replication pump. It
+  tails each PS shard's CRC-framed WAL segments with the spool cursor
+  discipline (loop/spool.py), re-frames verified records into an
+  identical layout under the standby workdir, and also replicates the
+  rescue lineage's snapshots (done-marker-last), the registry's epoch
+  counters, committed rollout versions (COMMITTED-marker-last) and serve
+  discovery. The shipped byte count behind the primary is the measured
+  RPO, exported as the ``easydl_cell_replication_lag`` gauge.
+- :mod:`easydl_tpu.cell.policy` — the PURE promote-or-wait decision
+  (easylint rule 5): evidence in, verdict out, no clocks, no I/O.
+- :mod:`easydl_tpu.cell.promote` — the fenced promotion protocol: raise
+  every shard's standby epoch counter to a floor strictly above anything
+  the primary ever served at, then boot standby shards through the
+  EXISTING rescue path (restore + WAL replay, bit-exact), so a
+  partitioned old primary's lineage is permanently fenced — its late
+  pushes answer ``stale-epoch``, never applied.
+
+The chaos drill (``cell_failover``) SIGKILLs every process in the
+primary cell mid-push-storm and proves the promoted standby tier
+digest-identical to the acked-push ledger, with the fenced late-push
+refusal as the required negative control.
+"""
+
+from easydl_tpu.cell.policy import promotion_decision  # noqa: F401
+from easydl_tpu.cell.promote import (  # noqa: F401
+    ensure_epoch_floor,
+    probe_fenced_push,
+    promoted_marker,
+    shipped_epoch_floor,
+    write_promoted_marker,
+)
+from easydl_tpu.cell.ship import CellShipper, ShipStats  # noqa: F401

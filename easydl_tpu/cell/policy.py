@@ -1,0 +1,98 @@
+"""The pure promote-or-wait decision for cell failover.
+
+Promotion is the one irreversible move in the cross-cell story: once the
+standby lineage's epochs are raised past the primary's, the old cell can
+never serve that workdir again (its pushes answer ``stale-epoch``
+forever). The decision to take that step must therefore be auditable and
+replayable — so it lives here as a pure function of the evidence the
+operator (or the failover controller) gathered: no clocks, no I/O, no
+registry reads. Callers measure; this module only judges.
+
+easylint rule 5 (PURE_PATHS) enforces the purity: wall-clock and global
+RNG references are banned in this file.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+
+def promotion_decision(
+    *,
+    num_shards: int,
+    primary_alive_shards: int,
+    shards_with_state: int,
+    lag_bytes: int,
+    lag_slo_bytes: int,
+    seconds_since_last_ship: float,
+    ship_interval_s: float,
+    gap_events: int = 0,
+    shipped_snapshot_steps: Optional[Mapping[int, int]] = None,
+) -> Dict[str, object]:
+    """Judge whether the standby cell should be promoted NOW.
+
+    Evidence (all caller-measured):
+
+    - ``primary_alive_shards``: primary shards still answering a liveness
+      probe. Any live shard vetoes promotion — promoting beside a living
+      primary is the split-brain the epoch fence exists to prevent, and
+      the fence only makes it *safe*, not *cheap* (every acked-but-
+      unshipped push on the survivor would be discarded).
+    - ``shards_with_state``: standby shards holding shipped WAL segments
+      or a complete snapshot. Promotion with missing shards would boot
+      empty tables under a fresh epoch — refused.
+    - ``lag_bytes`` / ``seconds_since_last_ship``: the shipper's last
+      measured replication lag. Promotion proceeds even past the SLO —
+      the cell is *lost*, waiting recovers nothing — but the breach is
+      recorded in the verdict so the operator knows the expected RPO
+      before the drill's ledger comparison confirms it.
+    - ``gap_events``: ship-cursor gaps (a segment retired before it was
+      fully shipped). Tolerable only when every shard also shipped a
+      snapshot (the snapshot covers retired segments by construction);
+      otherwise the standby provably lost acked bytes and the verdict
+      says so.
+
+    Returns a dict with ``promote`` (bool), ``reason``, and the derived
+    RPO expectation — the exact document the drill stores as evidence.
+    """
+    shipped_snapshot_steps = dict(shipped_snapshot_steps or {})
+    within_slo = int(lag_bytes) <= int(lag_slo_bytes)
+    stale_shipper = (ship_interval_s > 0
+                     and seconds_since_last_ship > 10.0 * ship_interval_s)
+    verdict: Dict[str, object] = {
+        "num_shards": int(num_shards),
+        "primary_alive_shards": int(primary_alive_shards),
+        "shards_with_state": int(shards_with_state),
+        "lag_bytes": int(lag_bytes),
+        "lag_slo_bytes": int(lag_slo_bytes),
+        "within_lag_slo": bool(within_slo),
+        "stale_shipper": bool(stale_shipper),
+        "gap_events": int(gap_events),
+        "snapshot_covered": bool(
+            gap_events == 0
+            or len(shipped_snapshot_steps) >= int(num_shards)),
+    }
+    if primary_alive_shards > 0:
+        verdict.update(promote=False, reason="primary-alive")
+        return verdict
+    if shards_with_state < num_shards:
+        verdict.update(
+            promote=False,
+            reason=(f"standby-incomplete: {shards_with_state}/{num_shards} "
+                    "shards have shipped state"))
+        return verdict
+    if gap_events and not verdict["snapshot_covered"]:
+        # Promote anyway — the primary is gone — but the reason string
+        # names the loss so nothing downstream mistakes this for a
+        # zero-RPO recovery.
+        verdict.update(
+            promote=True,
+            reason=(f"promote-with-known-loss: {gap_events} ship gap(s) "
+                    "not covered by a shipped snapshot"))
+        return verdict
+    verdict.update(
+        promote=True,
+        reason=("promote" if within_slo
+                else f"promote-past-slo: lag {lag_bytes}B > "
+                     f"SLO {lag_slo_bytes}B"))
+    return verdict

@@ -1,0 +1,246 @@
+"""Fenced promotion: turn a shipped standby workdir into the primary.
+
+The protocol is three moves, all riding machinery that already exists:
+
+1. **Fence** (:func:`fence_standby`): raise every shard's standby epoch
+   counter (``ps/registry.py`` — the same flock-serialized file
+   ``bump_epoch`` advances) to a floor at or above the highest epoch the
+   primary lineage ever served at. The floor is derived from what was
+   SHIPPED — the epoch-named WAL dirs plus the replicated counter file —
+   so the next ``bump_epoch`` on the standby returns an epoch strictly
+   greater than any epoch a partitioned old primary could stamp. Its
+   late pushes then answer ``stale-epoch`` forever: refused, never
+   applied, structurally — no timeout, no quorum, just monotonicity.
+2. **Mark** (:func:`write_promoted_marker`): persist the one-way switch
+   before any standby pod serves. A shipper that wakes up late refuses
+   to pump the dead primary's bytes into the new lineage
+   (:class:`easydl_tpu.cell.ship.ShipFenced`).
+3. **Boot**: start ordinary ``python -m easydl_tpu.ps`` pods on the
+   standby workdir WITHOUT ``--shard-index``. The existing rescue path
+   does the rest — ``resolve_fresh_shard`` sees the shipped WAL/
+   snapshots as prior state, claims the shard, bumps the (pre-floored)
+   epoch, restores the newest complete shipped snapshot and replays the
+   shipped WAL tail through the same store math the primary applied —
+   bit-exact against the acked-push ledger, up to the measured
+   replication lag.
+
+:func:`promote_standby` sequences the three and measures the wall clock
+(the RTO's first half); :func:`probe_fenced_push` is the negative
+control — a push stamped with the OLD primary epoch against the promoted
+tier, which must be refused.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from easydl_tpu.ps import registry as ps_registry
+from easydl_tpu.ps import wal as ps_wal
+from easydl_tpu.utils.env import knob_float
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("cell", "promote")
+
+ENV_RTO_BUDGET_S = "EASYDL_CELL_RTO_BUDGET_S"
+DEFAULT_RTO_BUDGET_S = 60.0
+
+_SHIP_DIR = "cell-ship"
+_PROMOTED = "PROMOTED.json"
+
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        from easydl_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        _METRICS = {
+            "fenced": reg.counter(
+                "easydl_cell_fenced_pushes_total",
+                "late pushes stamped with a fenced (pre-promotion) epoch "
+                "that the promoted tier refused",
+                labelnames=("cell",)),
+            "promotion": reg.histogram(
+                "easydl_cell_promotion_seconds",
+                "fence → every standby shard serving (the RTO's PS half)",
+                labelnames=("cell",)),
+        }
+    return _METRICS
+
+
+_METRICS = None
+
+
+def ensure_epoch_floor(workdir: str, shard: int, floor: int) -> bool:
+    """Raise (never lower) a shard's epoch counter to at least ``floor``.
+    Returns True when the counter moved. Same file, same flock discipline
+    as ``registry.bump_epoch`` — a concurrent bump composes (both are
+    monotonic raises)."""
+    d = os.path.join(workdir, ps_registry.REG_DIR)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"epoch-shard-{int(shard)}.json")
+    try:
+        os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+    except FileExistsError:
+        pass
+    moved = {"raised": False}
+
+    def mutate(doc: dict) -> Optional[dict]:
+        cur = int(doc.get("epoch", 0))
+        if cur >= int(floor):
+            return None
+        moved["raised"] = True
+        return {"epoch": int(floor)}
+
+    ps_registry.locked_mutate(path, mutate)
+    return moved["raised"]
+
+
+def shipped_epoch_floor(standby: str, shard: int) -> int:
+    """Highest primary epoch the standby knows about for ``shard``: the
+    max of the shipped epoch-named WAL dirs and the replicated epoch
+    counter. Every acked push was WAL'd under its server's epoch dir, so
+    any epoch that ever acked a push (and shipped) is visible here."""
+    root = os.path.join(standby, "ps-wal", f"shard-{shard}")
+    wal_max = max((e for e, _d in ps_wal.epoch_dirs(root)), default=0)
+    return max(wal_max, ps_registry.shard_epoch(standby, shard))
+
+
+def fence_standby(standby: str, num_shards: int,
+                  margin: int = 0) -> Dict[int, int]:
+    """Raise every shard's standby epoch counter to its shipped floor
+    (+ ``margin``); returns the floors. After this, ``bump_epoch`` on the
+    standby yields epochs strictly above anything the primary served at."""
+    floors: Dict[int, int] = {}
+    for shard in range(int(num_shards)):
+        floor = shipped_epoch_floor(standby, shard) + int(margin)
+        ensure_epoch_floor(standby, shard, floor)
+        floors[shard] = floor
+    return floors
+
+
+def promoted_marker(standby: str) -> Optional[Dict[str, Any]]:
+    """The promotion record, or None while the standby is still a standby."""
+    try:
+        with open(os.path.join(standby, _SHIP_DIR, _PROMOTED)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def write_promoted_marker(standby: str, doc: Dict[str, Any]) -> str:
+    """Persist the one-way promoted switch (atomically); returns the path.
+    Must land BEFORE any standby pod serves, so a late shipper pass can
+    never interleave a dead primary's bytes with the new lineage's."""
+    d = os.path.join(standby, _SHIP_DIR)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, _PROMOTED)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dict(doc, promoted=True), f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def promote_standby(standby: str, num_shards: int,
+                    spawn: Callable[[int], None],
+                    wait_s: float = 90.0, margin: int = 0,
+                    cell: str = "standby") -> Dict[str, Any]:
+    """Run the full promotion: fence, mark, boot, wait until every shard
+    publishes above its floor. ``spawn(shard)`` must start a PS pod on
+    the standby workdir WITHOUT an explicit shard index (the rescue path
+    resolves and claims it). Returns the promotion record (also persisted
+    as the marker), including ``promote_wall_s``."""
+    t0 = time.monotonic()
+    floors = fence_standby(standby, num_shards, margin=margin)
+    write_promoted_marker(standby, {
+        "floors": {str(s): f for s, f in floors.items()},
+        "num_shards": int(num_shards),
+        "promoted_wall": time.time(),
+    })
+    for shard in range(int(num_shards)):
+        spawn(shard)
+    deadline = time.monotonic() + float(wait_s)
+    epochs: Dict[int, int] = {}
+    while time.monotonic() < deadline:
+        smap = ps_registry.shard_map(standby)
+        epochs = {s: int(doc.get("epoch", 0)) for s, doc in smap.items()}
+        if all(epochs.get(s, 0) > floors[s] for s in floors):
+            break
+        time.sleep(0.2)
+    else:
+        raise TimeoutError(
+            f"promotion of {standby}: shards never published above their "
+            f"fence floors (floors={floors}, seen={epochs})")
+    wall_s = time.monotonic() - t0
+    _metrics()["promotion"].observe(wall_s, cell=cell)
+    record = {
+        "floors": {str(s): f for s, f in floors.items()},
+        "epochs": {str(s): epochs[s] for s in epochs},
+        "num_shards": int(num_shards),
+        "promote_wall_s": round(wall_s, 3),
+        "rto_budget_s": float(knob_float(ENV_RTO_BUDGET_S,
+                                         DEFAULT_RTO_BUDGET_S)),
+    }
+    log.info("promoted standby %s: epochs %s over floors %s in %.2fs",
+             standby, epochs, floors, wall_s)
+    return record
+
+
+def probe_fenced_push(standby: str, shard: int, table: str, dim: int,
+                      stale_epoch: int, num_shards: int,
+                      cell: str = "standby",
+                      timeout: float = 10.0) -> Dict[str, Any]:
+    """The negative control: push at the PROMOTED shard stamped with the
+    old primary lineage's epoch — the worst-case client of a partitioned
+    primary that never heard of the failover. The promoted server must
+    refuse it with ``stale-epoch`` and never apply it (the drill's digest
+    comparison runs AFTER this probe, so an applied row would surface as
+    divergence)."""
+    import numpy as np
+
+    from easydl_tpu.proto import easydl_pb2 as pb
+    from easydl_tpu.ps.server import PS_SERVICE, STALE_EPOCH
+    from easydl_tpu.ps.table import shard_of
+    from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, RpcClient
+
+    doc = ps_registry.shard_map(standby).get(int(shard)) or {}
+    address = str(doc.get("address", ""))
+    ids = np.arange(4096, dtype=np.int64)
+    ids = ids[shard_of(ids, int(num_shards)) == int(shard)][:16]
+    grads = np.full((len(ids), int(dim)), 7.0, np.float32)
+    out: Dict[str, Any] = {
+        "shard": int(shard), "address": address,
+        "stale_epoch": int(stale_epoch),
+        "served_epoch": int(doc.get("epoch", 0)),
+    }
+    try:
+        cl = RpcClient(PS_SERVICE, address, timeout=timeout,
+                       options=GRPC_MSG_OPTIONS)
+        try:
+            ack = cl.Push(pb.PushRequest(
+                table=table, raw_ids=ids.astype("<i8").tobytes(),
+                grads=grads.tobytes(), scale=1.0,
+                epoch=int(stale_epoch),
+            ))
+        finally:
+            cl.close()
+        refused = (not ack.ok and ack.message.startswith(STALE_EPOCH))
+        out.update(probe_acked_ok=bool(ack.ok),
+                   probe_message=str(ack.message),
+                   probe_rejected_stale_epoch=refused)
+        if refused:
+            _metrics()["fenced"].inc(cell=cell)
+    except Exception as e:
+        # An unreachable promoted shard refuses nothing — the invariant
+        # treats a missing refusal as a violation.
+        log.error("fenced-push probe against shard %d (%s) errored: %r",
+                  shard, address, e)
+        out.update(probe_acked_ok=False, probe_error=repr(e),
+                   probe_rejected_stale_epoch=False)
+    return out

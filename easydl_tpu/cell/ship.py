@@ -1,0 +1,616 @@
+"""The cross-cell WAL shipper: asynchronous replication with a measured RPO.
+
+One :class:`CellShipper` instance runs INSIDE the primary cell (or on a
+box that can read its workdir) and pumps everything a rescue would read
+into a standby cell's workdir, laid out identically, so promotion
+(:mod:`easydl_tpu.cell.promote`) is nothing more than booting PS pods on
+the standby workdir through the EXISTING rescue path:
+
+- **WAL segments** (``ps-wal/shard-<i>/epoch-<e>/seg-*.wal``): tailed
+  with the spool cursor discipline (loop/spool.py ``read_segment(start=)``
+  — a poll pays for new bytes only), every record CRC-verified, then
+  re-framed byte-identically into the standby's matching segment file.
+  Because rotation closes a segment before its successor is written
+  (SegmentWriter rotates BEFORE the write), a segment with a live
+  successor is immutable — the shipper only marks a segment *complete*
+  (and advances its cursor past it) once a successor exists and the read
+  reached a clean EOF. Ship order is strictly (epoch, segment, offset),
+  so the standby's copy is always a byte-prefix of the primary's stream:
+  replay on the standby applies a *prefix of the acked pushes*, never a
+  subset with holes.
+- **Snapshots** (``ps-ckpt/step_*``): only cluster-complete steps (all
+  ``.done-*`` markers present), staged into a temp dir and renamed into
+  place atomically — a half-shipped snapshot is invisible to
+  ``saved_steps`` on the standby.
+- **Epoch counters** (``ps/epoch-shard-<i>.json``): raised-to-floor on
+  the standby (never lowered), so promotion's bump yields an epoch
+  strictly above anything the primary ever served at — the fencing
+  token.
+- **Rollout versions** (``models/v_*`` + commit markers, loop/publish.py)
+  and **serve discovery** (``serve/*.json``): the standby fleet's serving
+  bootstrap.
+
+Durability of the ship position: the destination files themselves are
+append-only and frame-aligned, and the cursor marker
+(``cell-ship/ship-cursor.json``) is written atomically after every pass.
+A crash between the two is healed on the next pass by re-reading the
+destination tail (``read_segment``) and skipping already-landed frames —
+re-shipping never duplicates a record on the standby (a duplicate would
+replay as a double-apply: divergence).
+
+Loud degradation (never silent): a cursor whose segment was retired
+underneath it (``easydl_cell_ship_gaps_total``) or truncated below the
+shipped offset (``easydl_cell_ship_truncations_total``) is counted and
+logged at ERROR — the bytes are only safe if a shipped snapshot covers
+them, which the promotion decision (:mod:`easydl_tpu.cell.policy`)
+checks explicitly. The current unshipped byte count is exported as the
+``easydl_cell_replication_lag`` gauge: the measured RPO.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field, fields
+from typing import Dict, List, Optional, Tuple
+
+from easydl_tpu.loop.spool import frame, list_segments, read_segment
+from easydl_tpu.ps import registry as ps_registry
+from easydl_tpu.ps import wal as ps_wal
+from easydl_tpu.utils.env import knob_float, knob_int
+from easydl_tpu.utils.logging import get_logger
+
+log = get_logger("cell", "ship")
+
+ENV_SHIP_INTERVAL_S = "EASYDL_CELL_SHIP_INTERVAL_S"
+ENV_LAG_SLO_BYTES = "EASYDL_CELL_LAG_SLO_BYTES"
+
+DEFAULT_SHIP_INTERVAL_S = 0.5
+DEFAULT_LAG_SLO_BYTES = 4 << 20
+
+SHIP_DIR = "cell-ship"
+CURSOR_FILE = "ship-cursor.json"
+#: written by promote.write_promoted_marker — a promoted standby is a
+#: PRIMARY now; shipping into it would corrupt the new lineage.
+PROMOTED_MARKER = "PROMOTED.json"
+
+
+class ShipFenced(RuntimeError):
+    """The standby was promoted — it is a primary now. Shipping into it
+    would append a dead cell's bytes under the new lineage's feet, so
+    every pass against a promoted standby fails loudly."""
+
+
+def _metrics():
+    """Lazy metric families (import-cycle-free, registered once)."""
+    global _METRICS
+    if _METRICS is None:
+        from easydl_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        _METRICS = {
+            "segments": reg.counter(
+                "easydl_cell_shipped_segments_total",
+                "WAL segments fully shipped to the standby cell",
+                labelnames=("cell",)),
+            "bytes": reg.counter(
+                "easydl_cell_shipped_bytes_total",
+                "WAL payload bytes shipped to the standby cell",
+                labelnames=("cell",)),
+            "records": reg.counter(
+                "easydl_cell_shipped_records_total",
+                "WAL records shipped to the standby cell",
+                labelnames=("cell",)),
+            "snapshots": reg.counter(
+                "easydl_cell_shipped_snapshots_total",
+                "complete ps-ckpt steps shipped to the standby cell",
+                labelnames=("cell",)),
+            "versions": reg.counter(
+                "easydl_cell_shipped_versions_total",
+                "committed rollout versions shipped to the standby cell",
+                labelnames=("cell",)),
+            "torn": reg.counter(
+                "easydl_cell_ship_torn_segments_total",
+                "dead-writer torn tails truncated while shipping",
+                labelnames=("cell",)),
+            "truncations": reg.counter(
+                "easydl_cell_ship_truncations_total",
+                "source segments found truncated below the ship cursor",
+                labelnames=("cell",)),
+            "gaps": reg.counter(
+                "easydl_cell_ship_gaps_total",
+                "ship-cursor positions retired out from under the shipper",
+                labelnames=("cell",)),
+            "errors": reg.counter(
+                "easydl_cell_ship_errors_total",
+                "ship passes that raised",
+                labelnames=("cell",)),
+            "lag": reg.gauge(
+                "easydl_cell_replication_lag",
+                "bytes of acked WAL not yet shipped to the standby "
+                "cell (the measured RPO bound)",
+                labelnames=("cell",)),
+        }
+    return _METRICS
+
+
+_METRICS = None
+
+
+@dataclass
+class ShipStats:
+    """One pass's (or the lifetime's) replication accounting."""
+
+    segments_completed: int = 0
+    bytes_shipped: int = 0
+    records_shipped: int = 0
+    snapshots_shipped: int = 0
+    versions_shipped: int = 0
+    serve_files_shipped: int = 0
+    epochs_floored: int = 0
+    torn_skipped: int = 0
+    truncations: int = 0
+    gaps: int = 0
+    errors: int = 0
+    lag_bytes: int = 0
+
+    def merge(self, other: "ShipStats") -> None:
+        for f in fields(self):
+            if f.name == "lag_bytes":  # a level, not a count
+                self.lag_bytes = other.lag_bytes
+            else:
+                setattr(self, f.name,
+                        getattr(self, f.name) + getattr(other, f.name))
+
+    def to_dict(self) -> Dict[str, int]:
+        return {f.name: int(getattr(self, f.name)) for f in fields(self)}
+
+
+@dataclass
+class _Cursor:
+    """Durable per-shard ship position: everything before ``(epoch,
+    segment, offset)`` in (epoch, segment-name, byte) order is on the
+    standby. ``dst_offset`` is the matching byte count in the standby's
+    copy of ``segment`` — equal to ``offset`` minus the source start of
+    what we shipped, tracked separately so a source truncation anomaly
+    (offsets diverge) stays recoverable."""
+
+    epoch: int = 0
+    segment: str = ""
+    offset: int = 0
+    dst_offset: int = 0
+    records: int = 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"epoch": int(self.epoch), "segment": self.segment,
+                "offset": int(self.offset),
+                "dst_offset": int(self.dst_offset),
+                "records": int(self.records)}
+
+    @staticmethod
+    def from_dict(doc) -> "_Cursor":
+        doc = dict(doc or {})
+        return _Cursor(
+            epoch=int(doc.get("epoch", 0)),
+            segment=str(doc.get("segment", "")),
+            offset=int(doc.get("offset", 0)),
+            dst_offset=int(doc.get("dst_offset", 0)),
+            records=int(doc.get("records", 0)))
+
+
+def _fsync_write(path: str, data: bytes) -> None:
+    fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_copy(src: str, dst: str) -> None:
+    tmp = dst + ".ship-tmp"
+    shutil.copyfile(src, tmp)
+    with open(tmp, "rb") as f:
+        os.fsync(f.fileno())
+    os.replace(tmp, dst)
+
+
+class CellShipper:
+    """Pump one primary workdir's durable state into a standby workdir.
+
+    Single-threaded per instance: :meth:`ship_once` runs one full pass;
+    :meth:`start`/:meth:`stop` wrap it in a background cadence loop
+    (``EASYDL_CELL_SHIP_INTERVAL_S``). NOT safe to run two shippers into
+    the same standby."""
+
+    def __init__(self, primary: str, standby: str, num_shards: int,
+                 cell: str = "standby", models_dir: str = "models",
+                 interval_s: Optional[float] = None):
+        self.primary = primary
+        self.standby = standby
+        self.num_shards = int(num_shards)
+        self.cell = cell
+        self.models_dir = models_dir
+        self.interval_s = float(
+            knob_float(ENV_SHIP_INTERVAL_S, DEFAULT_SHIP_INTERVAL_S)
+            if interval_s is None else interval_s)
+        self.total = ShipStats()
+        self.last_pass_monotonic: float = float("-inf")
+        os.makedirs(os.path.join(standby, SHIP_DIR), exist_ok=True)
+        self._cursors: Dict[int, _Cursor] = self._load_cursors()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mu = threading.Lock()
+
+    # ------------------------------------------------------------- cursor io
+    def _cursor_path(self) -> str:
+        return os.path.join(self.standby, SHIP_DIR, CURSOR_FILE)
+
+    def _load_cursors(self) -> Dict[int, _Cursor]:
+        try:
+            with open(self._cursor_path()) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+        return {int(s): _Cursor.from_dict(c)
+                for s, c in dict(doc.get("shards", {})).items()}
+
+    def _save_cursors(self) -> None:
+        path = self._cursor_path()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"shards": {str(s): c.to_dict()
+                                  for s, c in self._cursors.items()}}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    # ------------------------------------------------------------- wal ship
+    def _wal_positions(self, shard: int
+                       ) -> List[Tuple[int, str, str, List[str]]]:
+        """Epoch-ordered ``(epoch, epoch_dirname, path, segments)`` of the
+        shard's source WAL."""
+        root = os.path.join(self.primary, "ps-wal", f"shard-{shard}")
+        out = []
+        for epoch, d in ps_wal.epoch_dirs(root):
+            out.append((epoch, os.path.basename(d), d,
+                        list_segments(d, ".wal")))
+        return out
+
+    def _ship_segment(self, shard: int, epoch: int, epoch_name: str,
+                      src_path: str, cur: _Cursor, stats: ShipStats) -> None:
+        """Tail one source segment from the cursor and append the verified
+        frames to the standby's copy, healing any crash-torn destination
+        tail first."""
+        name = os.path.basename(src_path)
+        dst_dir = os.path.join(self.standby, "ps-wal", f"shard-{shard}",
+                               epoch_name)
+        os.makedirs(dst_dir, exist_ok=True)
+        dst_path = os.path.join(dst_dir, name)
+        if cur.segment != name or cur.epoch != epoch:
+            cur.epoch, cur.segment = epoch, name
+            cur.offset = cur.dst_offset = 0
+        try:
+            src_size = os.path.getsize(src_path)
+        except OSError:
+            return  # raced a retirement; the caller's gap check judges it
+        if src_size < cur.offset:
+            # Source shrank below what we shipped. The only sanctioned
+            # writer-side shrink is SegmentWriter.rollback of a frame
+            # whose apply FAILED (never acked) — the standby now holds a
+            # frame the primary disowned. Harmless to replay (the push
+            # was never acked either way) but never silent.
+            stats.truncations += 1
+            _metrics()["truncations"].inc(cell=self.cell)
+            log.error(
+                "cell ship: source segment %s truncated to %d below ship "
+                "cursor %d (rolled-back frame already shipped); "
+                "re-syncing cursor", src_path, src_size, cur.offset)
+            cur.offset = src_size
+            return
+        # Heal a crash between dest-append and cursor-save: whatever
+        # clean frames sit past dst_offset in the destination are frames
+        # we already shipped from cur.offset on — skip them, and drop a
+        # torn destination tail (partial writev) before appending more.
+        try:
+            dst_size = os.path.getsize(dst_path)
+        except OSError:
+            dst_size = 0
+        if dst_size > cur.dst_offset:
+            landed, dst_clean_end, _clean = read_segment(
+                dst_path, start=cur.dst_offset)
+            if dst_clean_end < dst_size:
+                with open(dst_path, "rb+") as f:
+                    f.truncate(dst_clean_end)
+            for p in landed:
+                cur.offset += len(frame(p))
+                cur.dst_offset += len(frame(p))
+                cur.records += 1
+        elif dst_size < cur.dst_offset:
+            # The standby's copy lost bytes (manual tampering, fs loss):
+            # re-ship the difference from the source if it still has it.
+            log.error("cell ship: standby copy %s shorter (%d) than the "
+                      "cursor (%d); re-shipping the tail", dst_path,
+                      dst_size, cur.dst_offset)
+            cur.offset = max(0, cur.offset - (cur.dst_offset - dst_size))
+            cur.dst_offset = dst_size
+        payloads, consumed, clean = read_segment(src_path, start=cur.offset)
+        if payloads:
+            buf = b"".join(frame(p) for p in payloads)
+            _fsync_write(dst_path, buf)
+            cur.offset = consumed
+            cur.dst_offset += len(buf)
+            cur.records += len(payloads)
+            stats.bytes_shipped += len(buf)
+            stats.records_shipped += len(payloads)
+            _metrics()["bytes"].inc(len(buf), cell=self.cell)
+            _metrics()["records"].inc(len(payloads), cell=self.cell)
+        if not clean:
+            # Torn/corrupt frame. In the NEWEST segment of the NEWEST
+            # epoch this is a live writer mid-append — pending, not
+            # damage. Anywhere else the writer is dead or rotated away:
+            # count it; the caller advances past the segment.
+            stats.torn_skipped += 1
+
+    def _ship_wal_shard(self, shard: int, stats: ShipStats) -> int:
+        """One shard's WAL pass; returns this shard's remaining lag in
+        bytes (source bytes past the cursor after the pass)."""
+        cur = self._cursors.setdefault(shard, _Cursor())
+        positions = self._wal_positions(shard)
+        if not positions:
+            return 0
+        # Gap check: the cursor's position must still exist, unless the
+        # cursor is virgin. A retired epoch dir or segment under the
+        # cursor means bytes we never shipped are gone from the source —
+        # recoverable ONLY through a shipped snapshot, and always loud.
+        if cur.segment:
+            by_epoch = {e: segs for e, _n, _d, segs in positions}
+            live = cur.epoch in by_epoch and (
+                cur.segment in by_epoch[cur.epoch])
+            behind = any(
+                e > cur.epoch or (e == cur.epoch and any(
+                    s > cur.segment for s in segs))
+                for e, segs in by_epoch.items())
+            if not live and behind:
+                stats.gaps += 1
+                _metrics()["gaps"].inc(cell=self.cell)
+                nxt_e, nxt_name, _d, nxt_segs = next(
+                    (p for p in positions if p[0] >= cur.epoch and p[3]),
+                    positions[-1])
+                log.error(
+                    "cell ship: shard %d cursor %s/epoch-%d retired out "
+                    "from under the shipper; resyncing to epoch %d "
+                    "(acked bytes in the gap are only safe if a shipped "
+                    "snapshot covers them)", shard, cur.segment,
+                    cur.epoch, nxt_e)
+                self._cursors[shard] = cur = _Cursor(epoch=nxt_e)
+        torn_before = stats.torn_skipped
+        for idx, (epoch, epoch_name, d, segs) in enumerate(positions):
+            if epoch < cur.epoch:
+                continue
+            newest_epoch = idx == len(positions) - 1
+            for s_idx, name in enumerate(segs):
+                if epoch == cur.epoch and cur.segment and \
+                        name < cur.segment:
+                    continue
+                self._ship_segment(shard, epoch, epoch_name,
+                                   os.path.join(d, name), cur, stats)
+                closed = (s_idx < len(segs) - 1) or not newest_epoch
+                if closed:
+                    # Rotation wrote a successor, so this segment is
+                    # immutable — fully shipped, advance past it. (A
+                    # torn tail here is a dead writer's: already counted
+                    # by _ship_segment, safe to move on.)
+                    if stats.torn_skipped > torn_before:
+                        _metrics()["torn"].inc(
+                            stats.torn_skipped - torn_before,
+                            cell=self.cell)
+                        torn_before = stats.torn_skipped
+                    stats.segments_completed += 1
+                    _metrics()["segments"].inc(cell=self.cell)
+                    nxt = (segs[s_idx + 1] if s_idx < len(segs) - 1
+                           else "")
+                    cur.epoch, cur.segment = epoch, nxt
+                    cur.offset = cur.dst_offset = 0
+                    if not nxt:
+                        cur.epoch = epoch + 1  # move into the next epoch
+                else:
+                    # Open segment: the cursor rests inside it; a torn
+                    # tail is pending, not damage.
+                    stats.torn_skipped = torn_before
+        # Lag: source bytes at/past the cursor, from a fresh listing
+        # (bytes appended during this pass count — that is the RPO).
+        lag = 0
+        for epoch, _n, d, segs in self._wal_positions(shard):
+            if epoch < cur.epoch:
+                continue
+            for name in segs:
+                if epoch == cur.epoch and cur.segment and \
+                        name < cur.segment:
+                    continue
+                try:
+                    size = os.path.getsize(os.path.join(d, name))
+                except OSError:
+                    continue
+                if epoch == cur.epoch and name == cur.segment:
+                    lag += max(0, size - cur.offset)
+                else:
+                    lag += size
+        return lag
+
+    # -------------------------------------------------------- control plane
+    def _ship_snapshots(self, stats: ShipStats) -> None:
+        from easydl_tpu.ps.server import PsShard
+
+        src = os.path.join(self.primary, "ps-ckpt")
+        dst = os.path.join(self.standby, "ps-ckpt")
+        src_steps = PsShard.saved_steps(src)
+        if not src_steps:
+            return
+        os.makedirs(dst, exist_ok=True)
+        have = set(PsShard.saved_steps(dst))
+        for step in src_steps:
+            if step in have:
+                continue
+            sdir = os.path.join(src, f"step_{step:010d}")
+            tmp = os.path.join(dst, f".ship-tmp-step_{step:010d}")
+            final = os.path.join(dst, f"step_{step:010d}")
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            try:
+                names = sorted(os.listdir(sdir))
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+                continue  # snapshot retired mid-pass; next pass re-lists
+            # Completeness markers last, inside the staging dir; the
+            # rename is what makes the whole step appear atomically.
+            for name in [n for n in names if not n.startswith(".done-")] \
+                    + [n for n in names if n.startswith(".done-")]:
+                _atomic_copy(os.path.join(sdir, name),
+                             os.path.join(tmp, name))
+            shutil.rmtree(final, ignore_errors=True)
+            os.rename(tmp, final)
+            stats.snapshots_shipped += 1
+            _metrics()["snapshots"].inc(cell=self.cell)
+
+    def _ship_epochs(self, stats: ShipStats) -> None:
+        from easydl_tpu.cell.promote import ensure_epoch_floor
+
+        for shard in range(self.num_shards):
+            src_epoch = ps_registry.shard_epoch(self.primary, shard)
+            if src_epoch <= 0:
+                continue
+            if ensure_epoch_floor(self.standby, shard, src_epoch):
+                stats.epochs_floored += 1
+        routing = os.path.join(self.primary, ps_registry.REG_DIR,
+                               ps_registry.ROUTING_FILE)
+        if os.path.exists(routing):
+            dst_dir = os.path.join(self.standby, ps_registry.REG_DIR)
+            os.makedirs(dst_dir, exist_ok=True)
+            _atomic_copy(routing,
+                         os.path.join(dst_dir, ps_registry.ROUTING_FILE))
+
+    def _ship_rollout(self, stats: ShipStats) -> None:
+        from easydl_tpu.loop import publish
+
+        src = os.path.join(self.primary, self.models_dir)
+        if not os.path.isdir(src):
+            return
+        dst = os.path.join(self.standby, self.models_dir)
+        os.makedirs(dst, exist_ok=True)
+        have = set(publish.list_versions(dst))
+        for v in publish.list_versions(src):  # committed versions only
+            if v in have:
+                continue
+            sdir = os.path.join(src, f"v_{v:08d}")
+            tmp = os.path.join(dst, f".ship-tmp-v_{v:08d}")
+            final = os.path.join(dst, f"v_{v:08d}")
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            try:
+                names = sorted(os.listdir(sdir))
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+                continue
+            # COMMITTED strictly last within the staging dir (publish.py's
+            # own marker-last discipline), then one atomic rename.
+            for name in [n for n in names if n != "COMMITTED"] \
+                    + [n for n in names if n == "COMMITTED"]:
+                _atomic_copy(os.path.join(sdir, name),
+                             os.path.join(tmp, name))
+            shutil.rmtree(final, ignore_errors=True)
+            os.rename(tmp, final)
+            stats.versions_shipped += 1
+            _metrics()["versions"].inc(cell=self.cell)
+        rollback = os.path.join(src, "rollback.json")
+        if os.path.exists(rollback):
+            _atomic_copy(rollback, os.path.join(dst, "rollback.json"))
+
+    def _ship_serve_discovery(self, stats: ShipStats) -> None:
+        src = os.path.join(self.primary, "serve")
+        if not os.path.isdir(src):
+            return
+        dst = os.path.join(self.standby, "serve")
+        os.makedirs(dst, exist_ok=True)
+        for name in sorted(os.listdir(src)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                _atomic_copy(os.path.join(src, name),
+                             os.path.join(dst, name))
+                stats.serve_files_shipped += 1
+            except OSError:
+                continue  # replica stopped mid-copy; next pass re-lists
+
+    # ------------------------------------------------------------------ api
+    def ship_once(self) -> ShipStats:
+        """One full replication pass; returns the pass's stats (and folds
+        them into :attr:`total`). Raises :class:`ShipFenced` against a
+        promoted standby."""
+        with self._mu:
+            if os.path.exists(os.path.join(self.standby, SHIP_DIR,
+                                           PROMOTED_MARKER)):
+                raise ShipFenced(
+                    f"standby {self.standby} was promoted; refusing to "
+                    "ship a dead primary's bytes into a live lineage")
+            stats = ShipStats()
+            try:
+                lag = 0
+                for shard in range(self.num_shards):
+                    lag += self._ship_wal_shard(shard, stats)
+                self._save_cursors()
+                self._ship_snapshots(stats)
+                self._ship_epochs(stats)
+                self._ship_rollout(stats)
+                self._ship_serve_discovery(stats)
+                stats.lag_bytes = lag
+                _metrics()["lag"].set(lag, cell=self.cell)
+            except ShipFenced:
+                raise
+            except Exception:
+                stats.errors += 1
+                _metrics()["errors"].inc(cell=self.cell)
+                raise
+            finally:
+                self.total.merge(stats)
+                self.last_pass_monotonic = time.monotonic()
+            return stats
+
+    def lag_bytes(self) -> int:
+        """Last measured replication lag (bytes acked-but-unshipped)."""
+        return int(self.total.lag_bytes)
+
+    def start(self) -> "CellShipper":
+        """Run :meth:`ship_once` on the configured cadence until
+        :meth:`stop` (or the standby is promoted)."""
+        if self._thread is not None:
+            return self
+
+        def run() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.ship_once()
+                except ShipFenced:
+                    log.info("cell ship loop: standby promoted; stopping")
+                    return
+                except Exception as e:
+                    log.error("cell ship pass failed: %s", e)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="cell-ship")
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = False) -> None:
+        """Stop the cadence loop. With ``drain`` a final pass runs after
+        the loop exits (a clean handover wants lag 0; a DISASTER drill
+        must NOT drain — the unshipped tail is the measured RPO)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(10.0, 4 * self.interval_s))
+            self._thread = None
+        if drain:
+            self.ship_once()

@@ -142,6 +142,25 @@ class Scenario:
     #: max_chips, demand, scale_up{at_s, demand}}], traffic {steps, batch,
     #: vocab, dim, zipf_a, pace_s}.
     tenant_drill: Optional[Dict[str, Any]] = None
+    #: Cross-cell failover drill mode (ISSUE 18, ``cell_failover``): the
+    #: PS pods + a serving replica run against a PRIMARY cell workdir
+    #: while a :class:`easydl_tpu.cell.ship.CellShipper` asynchronously
+    #: replicates WAL segments, snapshots, epoch counters, rollout
+    #: versions and serve discovery into a STANDBY cell workdir.
+    #: Mid-push-storm every process in the primary cell is SIGKILLed (the
+    #: shipper is stopped WITHOUT draining first — the unshipped tail IS
+    #: the measured RPO), the standby is promoted through the fenced
+    #: protocol (cell/promote.py: epoch floors above the dead lineage,
+    #: then ordinary pods through the EXISTING rescue path), and the
+    #: verdict proves: the promoted tier digest-identical to snapshot +
+    #: shipped WAL tail, that tail an exact PREFIX of the acked-push
+    #: ledger with bounded loss, a fenced late push (old primary epoch)
+    #: refused and provably never applied (the digest runs after the
+    #: probe), a standby serve replica answering scores within the RTO
+    #: budget, and the replicated rollout version live on the standby.
+    #: Keys: steps, batch, vocab, dim, zipf_a, save_at, kill_at, pace_s,
+    #: ship_interval_s, serve_fields, rto_budget_s, wal_segment_bytes.
+    cell_drill: Optional[Dict[str, Any]] = None
 
     @property
     def name(self) -> str:
@@ -231,6 +250,8 @@ class ChaosHarness:
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> Dict[str, Any]:
+        if self.scenario.cell_drill is not None:
+            return self._run_cell_drill()
         if self.scenario.tenant_drill is not None:
             return self._run_tenant_drill()
         if self.scenario.fleet_drill is not None:
@@ -973,6 +994,537 @@ class ChaosHarness:
         }
 
     # ------------------------------------------------------- ps push storm
+    # ------------------------------------------------- cross-cell failover
+    def _run_cell_drill(self) -> Dict[str, Any]:
+        """The cell-loss drill (ISSUE 18): primary cell (PS pods + a
+        serving replica) under a push storm with the WAL shipper
+        replicating into a standby cell; SIGKILL the WHOLE primary
+        mid-storm, promote the standby through the fenced protocol, and
+        prove the promoted tier bit-identical to the acked-push ledger up
+        to a bounded RPO — fenced late pushes refused, serve answering
+        within the RTO budget."""
+        sc = self.scenario
+        plan_path = os.path.join(self.workdir, "chaos-plan.json")
+        _write_plan(plan_path, self.schedule)
+        from easydl_tpu.obs import tracing
+
+        saved_env: Dict[str, Optional[str]] = {}
+        for key, val in ((injectors.ENV_VAR, plan_path),
+                         (tracing.TRACE_ENV, "1"),
+                         ("EASYDL_PS_PROBE_TIMEOUT_S", "1.0")):
+            saved_env[key] = os.environ.get(key)
+            os.environ[key] = val
+        t_start = time.monotonic()
+        counts_before = injectors.injected_fault_counts()
+        evidence: Dict[str, Any] = {}
+        try:
+            evidence = self._drive_cell_storm()
+        finally:
+            self._teardown()
+            for key, val in saved_env.items():
+                if val is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = val
+        path = os.path.join(self.workdir, "cell-evidence.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(evidence, f, indent=2, sort_keys=True, default=str)
+        os.replace(tmp, path)
+        fault_counts = {
+            kind: count - counts_before.get(kind, 0.0)
+            for kind, count in injectors.injected_fault_counts().items()
+            if count - counts_before.get(kind, 0.0) > 0
+        }
+        verdict = invariants.check_scenario(
+            self.workdir, sc.expect, status={}, fault_counts=fault_counts,
+            outages=self.outages,
+        )
+        _scenario_counter().inc(scenario=sc.name,
+                                result="pass" if verdict["passed"]
+                                else "fail")
+        return {
+            "scenario": sc.name,
+            "seed": sc.chaos.seed,
+            "notes": sc.chaos.notes,
+            "workdir": self.workdir,
+            "wall_s": round(time.monotonic() - t_start, 2),
+            "schedule": self.schedule,
+            "expect": dict(sc.expect),
+            "faults_injected": fault_counts,
+            "cell": {k: v for k, v in evidence.items()
+                     if k not in ("live_digests", "reference_digests")},
+            "digests_match": evidence.get("digests_match"),
+            "final_status": {},
+            "invariants": verdict,
+            "passed": verdict["passed"],
+        }
+
+    def _launch_cell_ps(self, primary: str,
+                        wal_segment_bytes: int) -> None:
+        """Primary-cell PS pods: same pods as :meth:`_launch_ps` but over
+        the primary CELL workdir (the drill's unit of loss), not the
+        harness workdir. ``wal_segment_bytes`` forces a small rotation
+        threshold so the storm closes segments DETERMINISTICALLY — with
+        the 32MiB default the only closed segments come from the save's
+        cut, and the save retires those an instant later, so whether the
+        shipper ever completes one would be a poll-vs-retirement race."""
+        sc = self.scenario
+        from easydl_tpu.controller.pod_api import Pod
+        from easydl_tpu.controller.process_pod_api import LocalProcessPodApi
+        from easydl_tpu.ps import registry as ps_registry
+        from easydl_tpu.ps.wal import ENV_SEGMENT_BYTES
+
+        self._pod_api = LocalProcessPodApi(
+            self.workdir,
+            env={ENV_SEGMENT_BYTES: str(int(wal_segment_bytes))})
+        for i in range(sc.ps_shards):
+            self._pod_api.create_pod(Pod(
+                name=f"{sc.name}-ps-{i}", job=sc.name,
+                role="parameter_server",
+                command=(
+                    f"{sys.executable} -m easydl_tpu.ps"
+                    f" --name {sc.name}-ps-{i}"
+                    f" --workdir {primary} --num-shards {sc.ps_shards}"
+                    f" --shard-index {i}"
+                ),
+            ))
+        ps_registry.addresses(primary, sc.ps_shards, timeout=60.0)
+
+    def _drive_cell_storm(self) -> Dict[str, Any]:
+        import signal as _signal
+
+        import numpy as np
+
+        from easydl_tpu.cell import promote as cell_promote
+        from easydl_tpu.cell.policy import promotion_decision
+        from easydl_tpu.cell.ship import (
+            DEFAULT_LAG_SLO_BYTES, ENV_LAG_SLO_BYTES, CellShipper,
+        )
+        from easydl_tpu.loop import publish
+        from easydl_tpu.ps import registry as ps_registry
+        from easydl_tpu.ps import wal as ps_wal
+        from easydl_tpu.ps.client import ShardedPsClient
+        from easydl_tpu.ps.server import PsShard
+        from easydl_tpu.ps.table import TableSpec
+        from easydl_tpu.serve.launch import spawn_replicas
+        from easydl_tpu.serve.router import ServeRouter
+        from easydl_tpu.utils.env import knob_float, knob_int
+
+        sc = self.scenario
+        cfg = dict(sc.cell_drill or {})
+        steps = int(cfg.get("steps", 360))
+        batch = int(cfg.get("batch", 192))
+        vocab = int(cfg.get("vocab", 3000))
+        dim = int(cfg.get("dim", 8))
+        zipf_a = float(cfg.get("zipf_a", 1.1))
+        save_at = int(cfg.get("save_at", steps // 4))
+        kill_at = int(cfg.get("kill_at", (3 * steps) // 4))
+        pace_s = float(cfg.get("pace_s", 0.004))
+        ship_interval_s = float(cfg.get("ship_interval_s", 0.05))
+        serve_fields = int(cfg.get("serve_fields", 4))
+        wal_segment_bytes = int(cfg.get("wal_segment_bytes", 256 << 10))
+        rto_budget_s = float(cfg.get(
+            "rto_budget_s",
+            knob_float(cell_promote.ENV_RTO_BUDGET_S,
+                       cell_promote.DEFAULT_RTO_BUDGET_S)))
+        num_shards = sc.ps_shards
+        primary = os.path.join(self.workdir, "primary")
+        standby = os.path.join(self.workdir, "standby")
+        os.makedirs(primary, exist_ok=True)
+        os.makedirs(standby, exist_ok=True)
+        self._launch_cell_ps(primary, wal_segment_bytes)
+
+        specs = [
+            TableSpec(name="storm_adagrad", dim=dim, optimizer="adagrad",
+                      seed=5, lr=0.05),
+            TableSpec(name="storm_sgd", dim=dim, optimizer="sgd",
+                      seed=6, lr=0.05),
+        ]
+        # The full stream up front: the acked-push LEDGER is a pure
+        # function of the seed, so the post-promotion comparison can
+        # reconstruct exactly what the dead primary acked.
+        rng = np.random.default_rng(int(cfg.get("seed", sc.chaos.seed)))
+        stream = []
+        for _ in range(steps):
+            ids = (rng.zipf(zipf_a, batch) % vocab).astype(np.int64)
+            grads = [rng.standard_normal((batch, dim)).astype(np.float32)
+                     for _ in specs]
+            stream.append((ids, grads))
+        # coalesce=False: the ledger check decodes the standby's shipped
+        # WAL and proves it an exact prefix of the RAW acked sub-push
+        # stream — coalescing would make that a transform, not a prefix.
+        client = ShardedPsClient.from_registry(
+            primary, num_shards, timeout=2.0,
+            drain_retry_s=60.0, transient_retry_s=30.0, coalesce=False)
+        shipper = CellShipper(primary, standby, num_shards=num_shards,
+                              interval_s=ship_interval_s)
+        evidence: Dict[str, Any] = {
+            "primary": primary, "standby": standby,
+            "save_at": save_at, "kill_at": kill_at,
+            "ship_interval_s": ship_interval_s,
+        }
+        serve_procs: Dict[str, Any] = {}
+        router = None
+        try:
+            for spec in specs:
+                client.create_table(spec)
+            # A committed rollout artifact that must survive the cell.
+            version = publish.publish_version(
+                os.path.join(primary, "models"),
+                {"w": rng.standard_normal(8).astype(np.float32)},
+                meta={"drill": sc.name})
+            shipper.start()
+            ckpt_dir = os.path.join(primary, "ps-ckpt")
+            for i, (ids, grads) in enumerate(stream):
+                if i == 4:
+                    # The primary cell's serving replica: its discovery
+                    # file replicates, its death is part of the blast
+                    # radius. Spawned after a few batches so its boot
+                    # pull finds rows.
+                    serve_procs.update(spawn_replicas(
+                        1, primary, specs[1].name, serve_fields,
+                        cache_mb=16))
+                if i == save_at:
+                    # Mid-storm snapshot: the standby rescue will restore
+                    # it and replay only the shipped tail past its cut.
+                    client.save(ckpt_dir, step=i)
+                    _wait_for(
+                        lambda: save_at in PsShard.saved_steps(
+                            os.path.join(standby, "ps-ckpt")),
+                        60.0, "snapshot to ship to the standby cell")
+                if i == kill_at:
+                    break
+                for spec, g in zip(specs, grads):
+                    client.push(spec.name, ids, g, scale=0.125)
+                if i % 16 == 0:
+                    client.pull(specs[0].name, ids[:32])
+                time.sleep(pace_s)
+            # ---------------------------------------- the cell goes dark
+            # Stop the shipper FIRST, without draining: a real cell loss
+            # takes the source disk with it, so whatever the last pass
+            # did not ship IS the measured RPO.
+            shipper.stop(drain=False)
+            lag_at_kill = shipper.lag_bytes()
+            primary_epochs = {
+                s: ps_registry.shard_epoch(primary, s)
+                for s in range(num_shards)}
+            killed = []
+            t_kill = time.time()
+            for name, entry in list(self._pod_api._procs.items()):
+                if entry.proc.poll() is None:
+                    os.kill(entry.proc.pid, _signal.SIGKILL)
+                    injectors.count_fault("cell_kill")
+                    killed.append({"pod": name, "pid": entry.proc.pid})
+            for name, proc in serve_procs.items():
+                if proc.poll() is None:
+                    proc.kill()
+                    injectors.count_fault("cell_kill")
+                    killed.append({"pod": name, "pid": proc.pid})
+            for name, entry in list(self._pod_api._procs.items()):
+                try:
+                    entry.proc.wait(timeout=10.0)
+                except Exception:
+                    log.warning("cell drill: killed pod %s not reaped "
+                                "within 10s", name)
+            log.info("cell drill: primary cell dark (%d processes "
+                     "SIGKILLed at batch %d, lag %dB)",
+                     len(killed), kill_at, lag_at_kill)
+            evidence.update(
+                kill={"t": t_kill, "batch": kill_at, "procs": killed},
+                lag_bytes_at_kill=lag_at_kill,
+                ship=shipper.total.to_dict(),
+                rollout_version=version,
+            )
+            # ------------------------------------------------- promotion
+            t_promote0 = time.monotonic()
+            alive = sum(1 for _n, e in self._pod_api._procs.items()
+                        if e.proc.poll() is None)
+            snapshot_steps = PsShard.saved_steps(
+                os.path.join(standby, "ps-ckpt"))
+
+            def _has_state(s: int) -> bool:
+                root = os.path.join(standby, "ps-wal", f"shard-{s}")
+                return bool(snapshot_steps) or any(
+                    ps_wal.epoch_dirs(root))
+
+            decision = promotion_decision(
+                num_shards=num_shards,
+                primary_alive_shards=alive,
+                shards_with_state=sum(
+                    1 for s in range(num_shards) if _has_state(s)),
+                lag_bytes=lag_at_kill,
+                lag_slo_bytes=knob_int(ENV_LAG_SLO_BYTES,
+                                       DEFAULT_LAG_SLO_BYTES),
+                seconds_since_last_ship=(
+                    time.monotonic() - shipper.last_pass_monotonic),
+                ship_interval_s=ship_interval_s,
+                gap_events=shipper.total.gaps,
+                shipped_snapshot_steps=(
+                    {s: snapshot_steps[-1] for s in range(num_shards)}
+                    if snapshot_steps else {}),
+            )
+            evidence["decision"] = decision
+
+            def spawn(shard: int) -> None:
+                # NO --shard-index: the explicit-index path skips
+                # restore+replay; promotion must ride the rescue path.
+                from easydl_tpu.controller.pod_api import Pod
+
+                self._pod_api.create_pod(Pod(
+                    name=f"{sc.name}-standby-{shard}", job=sc.name,
+                    role="parameter_server",
+                    command=(
+                        f"{sys.executable} -m easydl_tpu.ps"
+                        f" --name {sc.name}-standby-{shard}"
+                        f" --workdir {standby}"
+                        f" --num-shards {num_shards}"
+                    ),
+                ))
+
+            promo = cell_promote.promote_standby(
+                standby, num_shards, spawn, wait_s=90.0)
+            evidence["promotion"] = promo
+            # RTO second half: a standby serving replica over the
+            # promoted tier. The router also sees the SHIPPED discovery
+            # files of the dead primary replica — ejecting those fast is
+            # part of "the fleet resumes".
+            serve_procs.update(spawn_replicas(
+                1, standby, specs[1].name, serve_fields,
+                cache_mb=16, name_prefix="cellserve-"))
+            router = ServeRouter(
+                workdir=standby, name="cell-router",
+                hedge_budget=0.3, hedge_min_ms=15.0, hedge_max_ms=120.0,
+                holddown_s=1.0, eject_fails=2, refresh_s=0.25,
+                timeout_s=20.0)
+            probe_ids = stream[0][0][:2 * serve_fields].reshape(
+                2, serve_fields)
+            first_ok = False
+            rto_deadline = t_promote0 + rto_budget_s
+            while time.monotonic() < rto_deadline:
+                r = router.infer(probe_ids, session_id="cell-rto")
+                if r.ok:
+                    first_ok = True
+                    break
+                time.sleep(0.1)
+            rto_s = time.monotonic() - t_promote0
+            evidence["serve"] = {
+                "rto_s": round(rto_s, 3),
+                "rto_budget_s": rto_budget_s,
+                "first_infer_ok": first_ok,
+                "replica": "cellserve-0",
+            }
+            # Fenced negative control BEFORE the verify save: an applied
+            # probe row would surface as digest divergence below.
+            evidence["fence_probes"] = [
+                cell_promote.probe_fenced_push(
+                    standby, s, specs[0].name, dim,
+                    stale_epoch=max(primary_epochs.get(s, 1), 1),
+                    num_shards=num_shards)
+                for s in range(num_shards)
+            ]
+            evidence.update(self._verify_cell_ledger(
+                standby, num_shards, specs, stream, save_at, kill_at,
+                promo))
+            # Rollout + discovery replication: the standby serves the
+            # SAME committed version the primary published.
+            standby_active = publish.active_version(
+                os.path.join(standby, "models"))
+            load_ok = False
+            if standby_active is not None:
+                try:
+                    publish.load_version(
+                        os.path.join(standby, "models"), standby_active)
+                    load_ok = True
+                except Exception as e:
+                    log.error("cell drill: shipped rollout version %s "
+                              "failed to load on the standby: %r",
+                              standby_active, e)
+                    evidence["rollout_error"] = repr(e)
+            evidence["rollout"] = {
+                "published": version,
+                "standby_active": standby_active,
+                "match": standby_active == version,
+                "load_ok": load_ok,
+            }
+            evidence["standby_counters"] = self._scrape_cell_counters(
+                standby)
+            return evidence
+        finally:
+            try:
+                shipper.stop(drain=False)
+            except Exception:
+                log.warning("cell drill: shipper stop failed")
+            if router is not None:
+                router.stop()
+            for proc in serve_procs.values():
+                try:
+                    proc.kill()
+                except OSError:
+                    pass  # already dead (the drill kills the primary's)
+            client.close()
+
+    def _verify_cell_ledger(self, standby: str, num_shards: int, specs,
+                            stream, save_at: int, kill_at: int,
+                            promo: Dict[str, Any]) -> Dict[str, Any]:
+        """The drill's core proof, in two halves.
+
+        **Prefix**: decode the standby's shipped WAL tail past the
+        snapshot's cut marker with the exact iteration the rescue used
+        (``iter_replay`` from the restored cut) and check it is an exact
+        per-shard PREFIX of the acked sub-push ledger — same tables, same
+        ids, same grads, same scale, in order. Ship order is strictly
+        (epoch, segment, offset), so anything else is a shipper bug.
+
+        **Digest**: replay snapshot-prefix + decoded tail through a
+        fault-free in-process reference and digest-compare against the
+        promoted tier's live save. Together: the standby equals the acked
+        ledger minus a bounded, measured tail — bit-exact."""
+        import numpy as np
+
+        from easydl_tpu.ps import wal as ps_wal
+        from easydl_tpu.ps.client import LocalPsClient, ShardedPsClient
+        from easydl_tpu.ps.table import shard_of
+
+        out: Dict[str, Any] = {}
+        # Acked ledger tail per shard: the raw sub-pushes the primary
+        # acked after the snapshot, in client issue order.
+        expected: Dict[int, list] = {s: [] for s in range(num_shards)}
+        for j in range(save_at, kill_at):
+            ids, grads = stream[j]
+            owner = shard_of(ids, num_shards)
+            for spec, g in zip(specs, grads):
+                for s in range(num_shards):
+                    mask = owner == s
+                    if mask.any():
+                        expected[s].append(
+                            (spec.name, ids[mask], g[mask], 0.125))
+        prefix_ok = True
+        mismatches: list = []
+        rpo: Dict[str, Any] = {"per_shard": {}}
+        applied_total = 0
+        lost_total = 0
+        acked_total = 0
+        for s in range(num_shards):
+            cut = None
+            marker = os.path.join(
+                standby, "ps-ckpt", f"step_{save_at:010d}",
+                f"wal-cut.shard-{s}-of-{num_shards}.json")
+            try:
+                with open(marker) as f:
+                    doc = json.load(f)
+                cut = (int(doc["epoch"]), str(doc["first_live_segment"]))
+            except (OSError, ValueError, KeyError):
+                prefix_ok = False
+                mismatches.append(f"shard {s}: no shipped cut marker")
+            decoded: list = []
+            root = os.path.join(standby, "ps-wal", f"shard-{s}")
+            before = int(promo.get("epochs", {}).get(str(s), 1 << 30))
+            for _e, _seg, payloads, _c, _clean in ps_wal.iter_replay(
+                    root, before_epoch=before, start=cut):
+                for p in payloads:
+                    if ps_wal.record_kind(p) == ps_wal.REC_PUSH:
+                        decoded.append(ps_wal.decode_push(p))
+            want = expected[s]
+            if len(decoded) > len(want):
+                prefix_ok = False
+                mismatches.append(
+                    f"shard {s}: {len(decoded)} shipped records > "
+                    f"{len(want)} acked — not a prefix")
+            for k, (table, ids_k, grads_k, scale) in enumerate(decoded):
+                if k >= len(want):
+                    break
+                w_table, w_ids, w_grads, w_scale = want[k]
+                if (table != w_table or scale != w_scale
+                        or not np.array_equal(ids_k, w_ids)
+                        or not np.array_equal(grads_k, w_grads)):
+                    prefix_ok = False
+                    mismatches.append(
+                        f"shard {s}: shipped record {k} diverges from "
+                        f"the acked ledger ({table} vs {w_table})")
+                    break
+            applied_total += len(decoded)
+            lost_total += max(0, len(want) - len(decoded))
+            acked_total += len(want)
+            rpo["per_shard"][str(s)] = {
+                "acked_subpushes": len(want),
+                "applied_subpushes": len(decoded),
+                "lost_subpushes": max(0, len(want) - len(decoded)),
+            }
+        rpo.update(acked_total=acked_total, applied_total=applied_total,
+                   lost_total=lost_total)
+        out["rpo"] = rpo
+        out["prefix_ok"] = prefix_ok
+        out["prefix_mismatches"] = mismatches[:8]
+        out["replayed_beyond_snapshot"] = applied_total
+        # The fault-free reference: snapshot prefix + the decoded tail.
+        reference = LocalPsClient(num_shards=num_shards, coalesce=False)
+        for spec in specs:
+            reference.create_table(spec)
+        for j in range(save_at):
+            ids, grads = stream[j]
+            for spec, g in zip(specs, grads):
+                reference.push(spec.name, ids, g, scale=0.125)
+        # Cross-shard replay order is irrelevant (disjoint id sets);
+        # within a shard the shipped order is the applied order.
+        for s in range(num_shards):
+            root = os.path.join(standby, "ps-wal", f"shard-{s}")
+            marker = os.path.join(
+                standby, "ps-ckpt", f"step_{save_at:010d}",
+                f"wal-cut.shard-{s}-of-{num_shards}.json")
+            try:
+                with open(marker) as f:
+                    doc = json.load(f)
+                cut = (int(doc["epoch"]), str(doc["first_live_segment"]))
+            except (OSError, ValueError, KeyError):
+                cut = None
+            before = int(promo.get("epochs", {}).get(str(s), 1 << 30))
+            for _e, _seg, payloads, _c, _clean in ps_wal.iter_replay(
+                    root, before_epoch=before, start=cut):
+                for p in payloads:
+                    if ps_wal.record_kind(p) == ps_wal.REC_PUSH:
+                        table, ids_p, grads_p, scale = \
+                            ps_wal.decode_push(p)
+                        reference.push(table, ids_p, grads_p, scale=scale)
+        verify_step = 999999
+        live_dir = os.path.join(self.workdir, "cell-verify-live")
+        ref_dir = os.path.join(self.workdir, "cell-verify-ref")
+        live = ShardedPsClient.from_registry(
+            standby, num_shards, timeout=10.0, coalesce=False)
+        try:
+            live.save(live_dir, verify_step)
+        finally:
+            live.close()
+        reference.save(ref_dir, verify_step)
+        out["live_digests"] = _table_digests(live_dir, verify_step)
+        out["reference_digests"] = _table_digests(ref_dir, verify_step)
+        out["digests_match"] = (
+            bool(out["live_digests"])
+            and out["live_digests"] == out["reference_digests"])
+        return out
+
+    def _scrape_cell_counters(self, standby: str) -> Dict[str, float]:
+        """The promoted pods' replay/fence counters, scraped from the
+        STANDBY workdir's exporters while they are still up."""
+        from easydl_tpu.obs.scrape import merge_snapshot
+
+        try:
+            merged = merge_snapshot(workdir=standby).get("merged", {})
+        except Exception as e:  # evidence, never a crash
+            log.warning("cell counter scrape failed: %s", e)
+            return {}
+
+        def total(name: str) -> float:
+            return float(sum(v for k, v in merged.items()
+                             if k.startswith(name)))
+
+        return {
+            "wal_replayed_records": total(
+                "easydl_ps_wal_replayed_records_total"),
+            "fence_rejected": total("easydl_ps_push_fence_rejected_total"),
+            "fenced_pushes": total("easydl_cell_fenced_pushes_total"),
+        }
+
     def _run_ps_storm(self) -> Dict[str, Any]:
         """The zero-loss drills: PS pods only, no training job. The harness
         drives a deterministic pull/push storm, a scheduled fault kills (or
@@ -3475,6 +4027,32 @@ def scenario_flash_crowd_new_item(seed: int = 83) -> Scenario:
     )
 
 
+def scenario_cell_failover(seed: int = 89) -> Scenario:
+    """Cell loss end to end (ISSUE 18 / ROADMAP item 5): a primary cell
+    — PS pods, a serving replica, committed rollout artifacts — takes a
+    deterministic push storm while the cross-cell WAL shipper
+    (easydl_tpu/cell/ship.py) replicates segments, snapshots, epochs,
+    rollout versions and serve discovery into a standby workdir. At a
+    fixed batch the WHOLE primary is SIGKILLed with the shipper frozen
+    un-drained (the unshipped tail IS the measured RPO), the pure
+    promotion policy rules on the shipped evidence, and the standby is
+    promoted through the fenced protocol: epoch floors raised above
+    anything the dead lineage served at, then ordinary PS pods booted
+    WITHOUT --shard-index so the existing rescue path restores the
+    shipped snapshot and replays the shipped WAL tail. Verdict: the
+    promoted tier digest-matches a fault-free reference fed snapshot
+    prefix + shipped tail (the shipped tail itself proven an exact
+    prefix of the acked sub-push ledger), a late push stamped with the
+    dead primary's epoch is refused on every shard (negative control),
+    the replicated rollout version serves CRC-clean, and a standby serve
+    replica answers scores inside the RTO budget.
+
+    Defined declaratively — this entry loads scenarios/cell_failover.yaml
+    through the validating loader, so the YAML is the single source of
+    truth."""
+    return _yaml_scenario("cell_failover.yaml", seed)
+
+
 def _yaml_scenario(filename: str, seed: int) -> Scenario:
     """Catalog entries whose definition lives in scenarios/*.yaml. A seed
     override re-seeds the compiled fault timeline (chaos_run --seed)."""
@@ -3607,6 +4185,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "flash_crowd_new_item": scenario_flash_crowd_new_item,
     "straggler_mitigation": scenario_straggler_mitigation,
     "preempt_race": scenario_preempt_race,
+    "cell_failover": scenario_cell_failover,
 }
 
 #: the cheapest deterministic drill — what scripts/chaos_smoke.sh runs and

@@ -695,6 +695,101 @@ def check_scenario(
                 "min_fleet_requests": min_req,
             }
 
+    # ------------------------------------------------ cell failover (r23)
+    if expect.get("cell_failover"):
+        ev: Dict[str, Any] = {}
+        try:
+            with open(os.path.join(workdir, "cell-evidence.json")) as f:
+                ev = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if not ev:
+            checks["cell_failover_survived"] = {
+                "ok": False,
+                "reason": "no cell-evidence.json in the workdir (drill "
+                          "crashed before writing evidence)",
+            }
+        else:
+            decision = ev.get("decision") or {}
+            ship = ev.get("ship") or {}
+            rpo = ev.get("rpo") or {}
+            probes = ev.get("fence_probes") or []
+            serve = ev.get("serve") or {}
+            rollout = ev.get("rollout") or {}
+            counters = ev.get("standby_counters") or {}
+            refused = sum(1 for p in probes
+                          if p.get("probe_rejected_stale_epoch"))
+            min_refused = int(expect.get("min_fenced_refusals", 1))
+            min_replayed = int(expect.get("min_replayed_subpushes", 1))
+            min_segments = int(expect.get("min_shipped_segments", 1))
+            max_rpo = expect.get("max_rpo_subpushes")
+            lost = int(rpo.get("lost_total", -1))
+            replayed = int(ev.get("replayed_beyond_snapshot", 0))
+            budget = float(serve.get("rto_budget_s", 0.0) or 0.0)
+            rto = float(serve.get("rto_s", -1.0))
+            # Anti-vacuous, all the way down: the policy really ruled
+            # promote on the shipped evidence; at least one COMPLETED
+            # segment shipped and the standby really replayed shipped
+            # sub-pushes past its snapshot (a run serving the snapshot
+            # alone proves nothing about WAL shipping); the shipped tail
+            # is an exact prefix of the acked ledger; the promoted tier
+            # digest-matches the snapshot+tail reference over non-empty
+            # digests; EVERY fenced probe was refused and at least
+            # min_fenced_refusals fired; acked loss stays under the RPO
+            # bound; the standby replica served a real score inside the
+            # RTO budget; and the replicated rollout version loads
+            # CRC-clean as the active version.
+            ok = (bool(decision.get("promote"))
+                  and int(ship.get("segments_completed", 0))
+                  >= min_segments
+                  and replayed >= min_replayed
+                  and float(counters.get("wal_replayed_records", 0.0))
+                  >= 1.0
+                  and bool(ev.get("prefix_ok"))
+                  and bool(ev.get("digests_match"))
+                  and bool(ev.get("live_digests"))
+                  and len(probes) >= 1
+                  and refused == len(probes)
+                  and refused >= min_refused
+                  and lost >= 0
+                  and (max_rpo is None or lost <= int(max_rpo))
+                  and bool(serve.get("first_infer_ok"))
+                  and 0.0 < rto <= budget
+                  and bool(rollout.get("match"))
+                  and bool(rollout.get("load_ok")))
+            checks["cell_failover_survived"] = {
+                "ok": ok,
+                "decision": {k: decision.get(k)
+                             for k in ("promote", "reason", "lag_bytes",
+                                       "within_lag_slo",
+                                       "snapshot_covered")},
+                "shipped_segments": ship.get("segments_completed"),
+                "min_shipped_segments": min_segments,
+                "ship_gaps": ship.get("gaps"),
+                "lag_bytes_at_kill": ev.get("lag_bytes_at_kill"),
+                "rpo": rpo,
+                "max_rpo_subpushes": max_rpo,
+                "prefix_ok": ev.get("prefix_ok"),
+                "prefix_mismatches": ev.get("prefix_mismatches"),
+                "replayed_beyond_snapshot": replayed,
+                "min_replayed_subpushes": min_replayed,
+                "standby_counters": counters,
+                "digests_match": ev.get("digests_match"),
+                "live_digests": ev.get("live_digests", {}),
+                "reference_digests": ev.get("reference_digests", {}),
+                "fenced_refused": refused,
+                "fenced_probes": len(probes),
+                "min_fenced_refusals": min_refused,
+                "probe_messages": [p.get("probe_message",
+                                         p.get("probe_error", ""))
+                                   for p in probes],
+                "rto_s": rto,
+                "rto_budget_s": budget,
+                "promote_wall_s": (ev.get("promotion") or {}).get(
+                    "promote_wall_s"),
+                "rollout": rollout,
+            }
+
     # ------------------------------------------------- production loop (r17)
     if expect.get("loop_exactly_once"):
         ev: Dict[str, Any] = {}

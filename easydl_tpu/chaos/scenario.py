@@ -19,6 +19,13 @@ Two kinds:
 - ``kind: catalog`` — a reference to a built-in drill by name (optional
   ``seed`` / ``expect`` overrides), so the classic single-job scenarios
   ride the same directory and runner.
+- ``kind: cell_failover`` — the cross-cell disaster drill (ISSUE 18): a
+  primary cell (PS pods + serving) under a push storm with the WAL
+  shipper replicating into a standby cell workdir; the WHOLE primary is
+  SIGKILLed mid-storm, the standby is promoted through the fenced
+  protocol, and ``expect`` bounds the acked loss (RPO), the
+  promote-to-serving latency (RTO), and the negative control (a late
+  push stamped with the dead lineage's epoch must be refused).
 
 The headline ``multi_tenant_contention`` drill is itself DEFINED by its
 YAML file — ``chaos.harness.scenario_multi_tenant_contention`` loads it —
@@ -188,6 +195,64 @@ def _tenant_scenario(doc: Mapping[str, Any], where: str):
     )
 
 
+#: cell_failover ``drill`` block knobs with (coercer, harness default)
+_CELL_DRILL_KEYS: Dict[str, Any] = {
+    "steps": int, "batch": int, "vocab": int, "dim": int,
+    "zipf_a": float, "save_at": int, "kill_at": int, "pace_s": float,
+    "ship_interval_s": float, "serve_fields": int, "rto_budget_s": float,
+    "wal_segment_bytes": int, "seed": int,
+}
+
+
+def _cell_scenario(doc: Mapping[str, Any], where: str):
+    from easydl_tpu.chaos.harness import Scenario
+
+    _check_keys(doc, {"name", "kind", "seed", "description", "ps_shards",
+                      "drill", "expect"}, where)
+    ps_shards = int(doc.get("ps_shards", 2))
+    if ps_shards < 1:
+        raise ScenarioSpecError(f"{where}: ps_shards must be >= 1")
+    drill_doc = dict(doc.get("drill") or {})
+    _check_keys(drill_doc, set(_CELL_DRILL_KEYS), f"{where}.drill")
+    drill: Dict[str, Any] = {}
+    for key, val in drill_doc.items():
+        try:
+            drill[key] = _CELL_DRILL_KEYS[key](val)
+        except (TypeError, ValueError) as e:
+            raise ScenarioSpecError(f"{where}.drill.{key}: {e}") from e
+    steps = int(drill.get("steps", 360))
+    save_at = int(drill.get("save_at", steps // 4))
+    kill_at = int(drill.get("kill_at", (3 * steps) // 4))
+    if not 0 < save_at < kill_at <= steps:
+        raise ScenarioSpecError(
+            f"{where}.drill: need 0 < save_at < kill_at <= steps, got "
+            f"save_at={save_at} kill_at={kill_at} steps={steps} — the "
+            "drill must snapshot mid-storm and lose the cell later")
+    expect = dict(_require(doc, "expect", where))
+    if not expect:
+        raise ScenarioSpecError(
+            f"{where}: expect must declare at least one invariant — a "
+            "drill that asserts nothing proves nothing")
+    if not expect.get("cell_failover"):
+        raise ScenarioSpecError(
+            f"{where}: expect.cell_failover must be true — it keys the "
+            "invariant block that gates RPO/RTO/fencing evidence")
+    return Scenario(
+        chaos=ChaosSpec(
+            name=str(_require(doc, "name", where)),
+            seed=int(doc.get("seed", 0)),
+            notes=str(doc.get("description", "")),
+            faults=(),
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=ps_shards,
+        steady_timeout_s=300.0,
+        cell_drill=drill,
+        expect=expect,
+    )
+
+
 def _catalog_scenario(doc: Mapping[str, Any], where: str):
     from easydl_tpu.chaos import harness
 
@@ -216,8 +281,11 @@ def load_scenario_doc(doc: Mapping[str, Any], where: str = "<doc>"):
         return _tenant_scenario(doc, where)
     if kind == "catalog":
         return _catalog_scenario(doc, where)
+    if kind == "cell_failover":
+        return _cell_scenario(doc, where)
     raise ScenarioSpecError(
-        f"{where}: unknown kind {kind!r} (tenant | catalog)")
+        f"{where}: unknown kind {kind!r} (tenant | catalog | "
+        "cell_failover)")
 
 
 def load_scenario_file(path: str):

@@ -140,6 +140,17 @@ KNOB_DECLS = (
      "the mirror (clients fall back to the wire)."),
     ("EASYDL_PS_STORE_LOOP", "bool", False,
      "Force the python reference row-apply loop (bench comparisons)."),
+    # -- cross-cell failover (cell/) --------------------------------------
+    ("EASYDL_CELL_STANDBY_WORKDIR", "str", "",
+     "Standby cell workdir the WAL shipper replicates into; '' = no "
+     "standby configured."),
+    ("EASYDL_CELL_SHIP_INTERVAL_S", "float", 0.5,
+     "Cross-cell ship pass cadence (bounds the async-replication RPO)."),
+    ("EASYDL_CELL_LAG_SLO_BYTES", "int", 4_194_304,  # 4 MiB
+     "Replication-lag SLO the promotion decision records breaches "
+     "against (easydl_cell_replication_lag gauge)."),
+    ("EASYDL_CELL_RTO_BUDGET_S", "float", 60.0,
+     "Promotion RTO budget: fence -> standby tier serving scores."),
     ("EASYDL_PS_SPLIT_HOT_RATIO", "float", 1.5,
      "Hot-shard split trigger: shard rows vs mean ratio."),
     ("EASYDL_PS_SPLIT_MIN_ROWS", "float", 100_000.0,

@@ -19,6 +19,9 @@
 # at least one completed row migration AND at least one mid-migration WAL
 # tail push replayed onto a destination — a "pass" where the cutover beat
 # every in-flight push would never have exercised the tail-replay path.
+# The cell-failover verdict likewise: at least one shipped WAL segment
+# replayed on the standby, every fenced late push refused, and digest
+# parity against the acked ledger — else the cross-cell path never ran.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -47,7 +50,8 @@ env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
     --scenario trainer_crash_mid_loop \
     --scenario rollout_half_update \
     --scenario retrieval_replica_death_mid_index_update \
-    --scenario multi_tenant_contention --keep-workdir "$@" \
+    --scenario multi_tenant_contention \
+    --scenario cell_failover --keep-workdir "$@" \
     2>&1 | tee "$LOG"
 
 # Verdict files from THIS run (chaos_run prints "PASS <name> ... -> <path>").
@@ -159,6 +163,34 @@ print(f"tenant OK: {len(preempts)} preemptions (all drained first), "
       "failures")
 PY
         ;;
+    *cell_failover*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+c = doc["cell"]
+replayed = c.get("replayed_beyond_snapshot", 0)
+segs = (c.get("ship") or {}).get("segments_completed", 0)
+assert segs >= 1 and replayed >= 1, (
+    f"{sys.argv[1]}: {segs} shipped segment(s) and {replayed} shipped "
+    "sub-pushes replayed on the standby — the WAL shipping path was "
+    "never exercised, the pass is vacuous")
+probes = c.get("fence_probes") or []
+refused = [p for p in probes if p.get("probe_rejected_stale_epoch")]
+assert probes and len(refused) == len(probes), (
+    f"{sys.argv[1]}: {len(refused)}/{len(probes)} fenced late pushes "
+    "refused — a partitioned old primary could still write into the "
+    "promoted lineage")
+assert doc.get("digests_match") and c.get("prefix_ok"), (
+    f"{sys.argv[1]}: the promoted tier diverged from the acked-push "
+    "ledger (prefix_ok="
+    f"{c.get('prefix_ok')}, digests_match={doc.get('digests_match')})")
+lost = (c.get("rpo") or {}).get("lost_total", -1)
+rto = (c.get("serve") or {}).get("rto_s")
+print(f"cell OK: {segs} segments shipped, {replayed} sub-pushes "
+      f"replayed on the standby, {len(refused)} fenced pushes refused, "
+      f"RPO {lost} sub-pushes, RTO {rto}s, digest parity")
+PY
+        ;;
     *trainer_crash_mid_loop*)
         python - "$verdict" <<'PY'
 import json, sys
@@ -225,7 +257,11 @@ PY
         ;;
     esac
     wd=$(python -c 'import json,sys; print(json.load(open(sys.argv[1]))["workdir"])' "$verdict")
-    python scripts/trace_export.py --workdir "$wd" --out "$wd/trace.json"
+    tracedir="$wd"
+    case "$verdict" in
+    *cell_failover*) tracedir="$wd/primary" ;;  # pods trace in the CELL dir
+    esac
+    python scripts/trace_export.py --workdir "$tracedir" --out "$wd/trace.json"
     python - "$wd/trace.json" <<'PY'
 import json, sys
 doc = json.load(open(sys.argv[1]))

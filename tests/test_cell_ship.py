@@ -1,0 +1,431 @@
+"""Cross-cell shipping + fenced promotion: the pure-filesystem half.
+
+The chaos drill (``cell_failover``) proves the end-to-end story with
+live pods; these tests pin the mechanisms it rides — cursor-disciplined
+WAL tailing via ``read_segment(start=)``, the loud-degradation paths
+(source truncated between polls, cursor pointing past a retired
+segment), marker-last snapshot/rollout shipping, the epoch fence, and
+the pure promotion decision.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from easydl_tpu.cell.policy import promotion_decision
+from easydl_tpu.cell.promote import (
+    ensure_epoch_floor,
+    fence_standby,
+    promoted_marker,
+    shipped_epoch_floor,
+    write_promoted_marker,
+)
+from easydl_tpu.cell.ship import CellShipper, ShipFenced
+from easydl_tpu.loop import publish
+from easydl_tpu.loop.spool import read_segment
+from easydl_tpu.ps import registry as ps_registry
+from easydl_tpu.ps import wal
+from easydl_tpu.ps.server import PsShard
+
+
+# --------------------------------------------------------------- fixtures
+def _cells(tmp_path):
+    primary = str(tmp_path / "primary")
+    standby = str(tmp_path / "standby")
+    os.makedirs(primary)
+    os.makedirs(standby)
+    return primary, standby
+
+
+def _wal_writer(primary, shard=0, epoch=1, segment_bytes=1 << 20):
+    d = os.path.join(primary, "ps-wal", f"shard-{shard}",
+                     f"epoch-{epoch:06d}")
+    os.makedirs(d, exist_ok=True)
+    return wal.PsWal(d, segment_bytes=segment_bytes, sync_s=-1)
+
+
+def _push(i, dim=4):
+    ids = np.arange(i * 8, i * 8 + 8, dtype=np.int64)
+    grads = np.full((8, dim), float(i), np.float32)
+    return wal.encode_push("t", ids, grads, 0.5)
+
+
+def _standby_payloads(standby, shard=0):
+    """Every payload on the standby's copy of the shard's WAL, in replay
+    order."""
+    root = os.path.join(standby, "ps-wal", f"shard-{shard}")
+    out = []
+    for _e, _seg, payloads, _c, _clean in wal.iter_replay(
+            root, before_epoch=1 << 30):
+        out.extend(payloads)
+    return out
+
+
+# -------------------------------------------------------------- wal ship
+def test_ship_roundtrip_byte_identical(tmp_path):
+    primary, standby = _cells(tmp_path)
+    w = _wal_writer(primary)
+    records = [_push(i) for i in range(16)]
+    for r in records:
+        w.append(r)
+    w.close()
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    stats = shipper.ship_once()
+    assert stats.records_shipped == 16
+    assert stats.bytes_shipped > 0
+    assert _standby_payloads(standby) == records
+    # The open segment is NOT marked complete (writer could still append);
+    # lag is zero — everything durable was shipped.
+    assert stats.segments_completed == 0
+    assert stats.lag_bytes == 0
+
+
+def test_ship_tails_incrementally_without_duplicates(tmp_path):
+    primary, standby = _cells(tmp_path)
+    w = _wal_writer(primary)
+    first = [_push(i) for i in range(4)]
+    for r in first:
+        w.append(r)
+    w.sync()
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    shipper.ship_once()
+    second = [_push(i) for i in range(4, 9)]
+    for r in second:
+        w.append(r)
+    w.close()
+    stats = shipper.ship_once()
+    assert stats.records_shipped == 5  # the new bytes only
+    assert _standby_payloads(standby) == first + second
+
+
+def test_ship_follows_rotation_between_polls(tmp_path):
+    """A segment rotated between polls: the shipper finishes the closed
+    segment, marks it complete, and moves into the successor — the
+    standby stream stays an exact prefix (here: equal)."""
+    primary, standby = _cells(tmp_path)
+    w = _wal_writer(primary)
+    records = [_push(i) for i in range(3)]
+    for r in records:
+        w.append(r)
+    w.sync()
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    shipper.ship_once()
+    w.cut()  # rotation closes the shipped segment mid-tail
+    tail = [_push(i) for i in range(3, 7)]
+    for r in tail:
+        w.append(r)
+    w.close()
+    stats = shipper.ship_once()
+    assert stats.segments_completed == 1
+    assert stats.records_shipped == 4
+    assert _standby_payloads(standby) == records + tail
+    # third pass is a no-op: cursor rests in the open segment
+    stats = shipper.ship_once()
+    assert stats.records_shipped == 0
+    assert _standby_payloads(standby) == records + tail
+
+
+def test_source_truncated_below_cursor_is_loud(tmp_path):
+    """Rollback (the only sanctioned source shrink) racing a ship: the
+    source segment is shorter than the shipped offset. The shipper counts
+    a truncation, resyncs, and keeps going — never a silent skip, never a
+    crash."""
+    primary, standby = _cells(tmp_path)
+    w = _wal_writer(primary)
+    w.append(_push(0))
+    n = w.append(_push(1))
+    w.sync()
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    stats = shipper.ship_once()
+    assert stats.records_shipped == 2
+    w.rollback(n)  # the apply failed; frame 1 was never acked
+    stats = shipper.ship_once()  # poll lands while the file is short
+    assert stats.truncations == 1
+    w.append(_push(2))
+    w.close()
+    # The disowned frame stays on the standby (it was never acked either
+    # way); after the resync the next pass picks up the replacement.
+    stats = shipper.ship_once()
+    assert stats.truncations == 0
+    got = _standby_payloads(standby)
+    assert got[0] == _push(0)
+    assert _push(2) in got
+
+
+def test_cursor_past_retired_segment_counts_a_gap(tmp_path):
+    """The shard retired WAL out from under the shipper (save() +
+    retire_segments while the shipper slept). The cursor position no
+    longer exists but newer bytes do: one loud gap, cursor resync, and
+    the surviving epoch ships — acked bytes in the hole are only covered
+    by a shipped snapshot, which the promotion decision checks."""
+    primary, standby = _cells(tmp_path)
+    w1 = _wal_writer(primary, epoch=1)
+    for i in range(4):
+        w1.append(_push(i))
+    w1.close()
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    shipper.ship_once()
+    # epoch-1 retired wholesale; epoch-2 carries on
+    import shutil
+    shutil.rmtree(os.path.join(primary, "ps-wal", "shard-0",
+                               "epoch-000001"))
+    w2 = _wal_writer(primary, epoch=2)
+    tail = [_push(i) for i in range(10, 13)]
+    for r in tail:
+        w2.append(r)
+    w2.close()
+    stats = shipper.ship_once()
+    assert stats.gaps == 1
+    assert stats.records_shipped == 3
+    got = _standby_payloads(standby)
+    assert got[-3:] == tail
+    # steady state again: no repeat gap
+    assert shipper.ship_once().gaps == 0
+
+
+def test_crash_between_append_and_cursor_save_heals(tmp_path):
+    """Shipped bytes landed on the standby but the cursor save never did
+    (shipper crash). The restarted shipper re-reads the destination tail
+    and skips already-landed frames — re-shipping never duplicates a
+    record (a duplicate would double-apply on replay)."""
+    primary, standby = _cells(tmp_path)
+    w = _wal_writer(primary)
+    records = [_push(i) for i in range(6)]
+    for r in records:
+        w.append(r)
+    w.close()
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    shipper.ship_once()
+    # wind the durable cursor back to zero: the crash window
+    cursor_path = os.path.join(standby, "cell-ship", "ship-cursor.json")
+    with open(cursor_path) as f:
+        doc = json.load(f)
+    doc["shards"]["0"].update(offset=0, dst_offset=0, records=0)
+    with open(cursor_path, "w") as f:
+        json.dump(doc, f)
+    restarted = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    stats = restarted.ship_once()
+    assert stats.records_shipped == 0  # all frames already landed
+    assert _standby_payloads(standby) == records
+
+
+def test_torn_destination_tail_truncated_on_heal(tmp_path):
+    """A partial append (shipper killed mid-writev) leaves a torn frame
+    on the STANDBY copy; the next pass drops it and re-ships cleanly."""
+    primary, standby = _cells(tmp_path)
+    w = _wal_writer(primary)
+    records = [_push(i) for i in range(4)]
+    for r in records:
+        w.append(r)
+    w.close()
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    shipper.ship_once()
+    seg = os.path.join(standby, "ps-wal", "shard-0", "epoch-000001")
+    seg = os.path.join(seg, sorted(os.listdir(seg))[0])
+    with open(seg, "ab") as f:
+        f.write(b"\xff" * 7)  # torn partial frame
+    # cursor still points at the clean end, so only the heal path sees it
+    cursor_path = os.path.join(standby, "cell-ship", "ship-cursor.json")
+    with open(cursor_path) as f:
+        doc = json.load(f)
+    doc["shards"]["0"].update(offset=0, dst_offset=0, records=0)
+    with open(cursor_path, "w") as f:
+        json.dump(doc, f)
+    restarted = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    restarted.ship_once()
+    payloads, consumed, clean = read_segment(seg)
+    assert clean and payloads == records
+    assert consumed == os.path.getsize(seg)  # torn tail gone
+
+
+def test_lag_counts_unshipped_bytes(tmp_path):
+    primary, standby = _cells(tmp_path)
+    w = _wal_writer(primary)
+    w.append(_push(0))
+    w.sync()
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    assert shipper.ship_once().lag_bytes == 0
+    n = w.append(_push(1))
+    w.sync()
+    # appended AFTER the pass: visible as lag on a listing-only probe
+    lag_stats = shipper.ship_once()
+    assert lag_stats.records_shipped == 1
+    w.close()
+    assert shipper.lag_bytes() == 0
+    assert n > 0
+
+
+# --------------------------------------------------- control-plane ship
+def test_snapshot_ships_complete_steps_only(tmp_path):
+    primary, standby = _cells(tmp_path)
+    src = os.path.join(primary, "ps-ckpt")
+    complete = os.path.join(src, "step_0000000010")
+    torn = os.path.join(src, "step_0000000020")
+    os.makedirs(complete)
+    os.makedirs(torn)
+    for d in (complete, torn):
+        with open(os.path.join(d, "t.shard-0-of-1.npz"), "wb") as f:
+            f.write(b"npzbytes")
+    with open(os.path.join(complete, ".done-0"), "w") as f:
+        f.write("1")  # expected shard count: complete
+    # torn step: no .done markers at all — invisible to saved_steps
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    stats = shipper.ship_once()
+    assert stats.snapshots_shipped == 1
+    assert PsShard.saved_steps(os.path.join(standby, "ps-ckpt")) == [10]
+    assert not os.path.exists(
+        os.path.join(standby, "ps-ckpt", "step_0000000020"))
+    # idempotent: already-shipped steps are skipped
+    assert shipper.ship_once().snapshots_shipped == 0
+
+
+def test_rollout_ships_committed_versions_and_rollback_pin(tmp_path):
+    primary, standby = _cells(tmp_path)
+    models = os.path.join(primary, "models")
+    v1 = publish.publish_version(models, {"w": np.ones(4, np.float32)})
+    publish.publish_version(models, {"w": np.zeros(4, np.float32)},
+                            _crash_before_commit=True)  # torn: no marker
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    stats = shipper.ship_once()
+    assert stats.versions_shipped == 1
+    dst = os.path.join(standby, "models")
+    assert publish.list_versions(dst) == [v1]
+    assert publish.active_version(dst) == v1
+    _meta, arrays = publish.load_version(dst, v1)  # CRC-verified read
+    np.testing.assert_array_equal(arrays["w"], np.ones(4, np.float32))
+
+
+def test_epoch_counters_ship_as_floors(tmp_path):
+    primary, standby = _cells(tmp_path)
+    ps_registry.bump_epoch(primary, 0)
+    ps_registry.bump_epoch(primary, 0)  # primary shard-0 at epoch 2
+    shipper = CellShipper(primary, standby, num_shards=2, interval_s=9)
+    stats = shipper.ship_once()
+    assert stats.epochs_floored == 1  # shard-1 never bumped
+    assert ps_registry.shard_epoch(standby, 0) == 2
+    # never lowered: a stale primary counter can't pull the floor back
+    ensure_epoch_floor(standby, 0, 5)
+    shipper.ship_once()
+    assert ps_registry.shard_epoch(standby, 0) == 5
+
+
+def test_serve_discovery_ships(tmp_path):
+    primary, standby = _cells(tmp_path)
+    os.makedirs(os.path.join(primary, "serve"))
+    with open(os.path.join(primary, "serve", "serve-0.json"), "w") as f:
+        json.dump({"address": "127.0.0.1:1", "pid": 1}, f)
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    stats = shipper.ship_once()
+    assert stats.serve_files_shipped == 1
+    with open(os.path.join(standby, "serve", "serve-0.json")) as f:
+        assert json.load(f)["address"] == "127.0.0.1:1"
+
+
+def test_promoted_standby_fences_the_shipper(tmp_path):
+    primary, standby = _cells(tmp_path)
+    shipper = CellShipper(primary, standby, num_shards=1, interval_s=9)
+    shipper.ship_once()
+    write_promoted_marker(standby, {"floors": {"0": 3}})
+    with pytest.raises(ShipFenced):
+        shipper.ship_once()
+
+
+# ----------------------------------------------------------- promotion
+def test_epoch_floor_raises_never_lowers(tmp_path):
+    wd = str(tmp_path)
+    assert ensure_epoch_floor(wd, 0, 4) is True
+    assert ps_registry.shard_epoch(wd, 0) == 4
+    assert ensure_epoch_floor(wd, 0, 2) is False
+    assert ps_registry.shard_epoch(wd, 0) == 4
+    # composes with bump_epoch: strictly above the floor afterwards
+    assert ps_registry.bump_epoch(wd, 0) == 5
+
+
+def test_shipped_epoch_floor_sees_wal_dirs_and_counter(tmp_path):
+    standby = str(tmp_path)
+    d = os.path.join(standby, "ps-wal", "shard-0", "epoch-000003")
+    os.makedirs(d)
+    assert shipped_epoch_floor(standby, 0) == 3
+    ensure_epoch_floor(standby, 0, 7)
+    assert shipped_epoch_floor(standby, 0) == 7
+
+
+def test_fence_standby_floors_every_shard(tmp_path):
+    standby = str(tmp_path)
+    os.makedirs(os.path.join(standby, "ps-wal", "shard-0",
+                             "epoch-000002"))
+    floors = fence_standby(standby, num_shards=2, margin=1)
+    assert floors == {0: 3, 1: 1}
+    assert ps_registry.shard_epoch(standby, 0) == 3
+    assert ps_registry.shard_epoch(standby, 1) == 1
+    # a post-fence bump lands strictly above anything the primary served
+    assert ps_registry.bump_epoch(standby, 0) == 4
+
+
+def test_promoted_marker_roundtrip(tmp_path):
+    standby = str(tmp_path)
+    assert promoted_marker(standby) is None
+    write_promoted_marker(standby, {"num_shards": 2})
+    doc = promoted_marker(standby)
+    assert doc["promoted"] is True and doc["num_shards"] == 2
+
+
+# ------------------------------------------------------ pure decision
+def test_promotion_decision_vetoes_live_primary():
+    v = promotion_decision(
+        num_shards=2, primary_alive_shards=1, shards_with_state=2,
+        lag_bytes=0, lag_slo_bytes=1 << 20,
+        seconds_since_last_ship=0.1, ship_interval_s=0.5)
+    assert v["promote"] is False and v["reason"] == "primary-alive"
+
+
+def test_promotion_decision_refuses_incomplete_standby():
+    v = promotion_decision(
+        num_shards=2, primary_alive_shards=0, shards_with_state=1,
+        lag_bytes=0, lag_slo_bytes=1 << 20,
+        seconds_since_last_ship=0.1, ship_interval_s=0.5)
+    assert v["promote"] is False
+    assert v["reason"].startswith("standby-incomplete")
+
+
+def test_promotion_decision_promotes_within_slo():
+    v = promotion_decision(
+        num_shards=2, primary_alive_shards=0, shards_with_state=2,
+        lag_bytes=1024, lag_slo_bytes=1 << 20,
+        seconds_since_last_ship=0.1, ship_interval_s=0.5)
+    assert v["promote"] is True and v["reason"] == "promote"
+    assert v["within_lag_slo"] is True
+
+
+def test_promotion_decision_names_slo_breach_but_promotes():
+    v = promotion_decision(
+        num_shards=2, primary_alive_shards=0, shards_with_state=2,
+        lag_bytes=2 << 20, lag_slo_bytes=1 << 20,
+        seconds_since_last_ship=0.1, ship_interval_s=0.5)
+    assert v["promote"] is True
+    assert v["reason"].startswith("promote-past-slo")
+    assert v["within_lag_slo"] is False
+
+
+def test_promotion_decision_gap_needs_snapshot_cover():
+    base = dict(num_shards=2, primary_alive_shards=0, shards_with_state=2,
+                lag_bytes=0, lag_slo_bytes=1 << 20,
+                seconds_since_last_ship=0.1, ship_interval_s=0.5,
+                gap_events=1)
+    uncovered = promotion_decision(**base)
+    assert uncovered["promote"] is True
+    assert uncovered["reason"].startswith("promote-with-known-loss")
+    covered = promotion_decision(
+        **base, shipped_snapshot_steps={0: 10, 1: 10})
+    assert covered["promote"] is True and covered["reason"] == "promote"
+    assert covered["snapshot_covered"] is True
+
+
+def test_promotion_decision_flags_stale_shipper():
+    v = promotion_decision(
+        num_shards=1, primary_alive_shards=0, shards_with_state=1,
+        lag_bytes=0, lag_slo_bytes=1 << 20,
+        seconds_since_last_ship=60.0, ship_interval_s=0.5)
+    assert v["stale_shipper"] is True

@@ -512,6 +512,10 @@ def test_scenario_catalog_compiles_deterministically():
             # family (priorities/starvation/thrash/isolation), not a step
             # target
             assert sc.expect.get("tenant_contention")
+        elif sc.cell_drill is not None:
+            # cross-cell drills: the goal invariant is the failover family
+            # (RPO/RTO/fencing/digest parity), not a step target
+            assert sc.expect.get("cell_failover")
         else:
             assert sc.expect.get("target_step") is not None
 
