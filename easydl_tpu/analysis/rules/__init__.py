@@ -16,6 +16,7 @@ from easydl_tpu.analysis.rules.locks import BlockingCallUnderLock
 from easydl_tpu.analysis.rules.metric_names import MetricNameLint
 from easydl_tpu.analysis.rules.naked_rpc import NakedRpc
 from easydl_tpu.analysis.rules.purity import VirtualClockPurity
+from easydl_tpu.analysis.rules.slo_refs import SloMetricRefs
 from easydl_tpu.analysis.rules.swallow import CountedSwallow
 
 
@@ -28,8 +29,10 @@ def all_rules() -> List[Rule]:
         CountedSwallow(),
         VirtualClockPurity(),
         MetricNameLint(),
+        SloMetricRefs(),
     ]
 
 
 __all__ = ["all_rules", "BlockingCallUnderLock", "NakedRpc", "KnobRegistry",
-           "CountedSwallow", "VirtualClockPurity", "MetricNameLint"]
+           "CountedSwallow", "VirtualClockPurity", "MetricNameLint",
+           "SloMetricRefs"]

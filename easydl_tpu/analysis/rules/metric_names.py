@@ -51,11 +51,147 @@ HISTOGRAM_UNITS = ("_seconds", "_bytes", "_examples", "_records", "_rows",
 KNOWN_LABELS = frozenset((
     "agent", "arm", "axis", "cell", "component", "fault", "generation",
     "has_plan", "job", "kind", "method", "op", "phase", "reason", "replica",
-    "result", "role", "scenario", "service", "shard", "site", "source",
-    "table", "target", "verb", "verdict",
+    "result", "role", "scenario", "service", "severity", "shard", "site",
+    "slo", "source", "table", "target", "verb", "verdict",
 ))
 
 _RESERVED_LABELS = frozenset(("le", "quantile"))
+
+#: Every metric family the tree registers — the reference the
+#: ``slo-metric-refs`` rule (analysis/rules/slo_refs.py) resolves SLO
+#: series selectors against, and what tests/test_easylint.py keeps in
+#: sync with the registration sites by AST scan. A name here and not in
+#: the tree is stale; a registration not here is undeclared — both fail
+#: the sync test. The ``easydl_rpc_{side}_*`` f-string family is listed
+#: expanded (side ∈ client/server).
+REGISTERED_METRICS = frozenset((
+    "easydl_agent_generation",
+    "easydl_agent_heartbeat_rate_per_s",
+    "easydl_agent_heartbeats_total",
+    "easydl_agent_master_outage_seconds",
+    "easydl_agent_master_outages_total",
+    "easydl_agent_outage_buffered_metrics",
+    "easydl_agent_phase_events_total",
+    "easydl_agent_phase_seconds",
+    "easydl_agent_worker_loss",
+    "easydl_agent_worker_samples_per_sec",
+    "easydl_agent_worker_step",
+    "easydl_agent_worker_step_time_seconds",
+    "easydl_alert_active",
+    "easydl_brain_metric_reports_total",
+    "easydl_brain_plan_requests_total",
+    "easydl_brain_plan_version",
+    "easydl_brain_plan_workers",
+    "easydl_brain_replans_total",
+    "easydl_cell_fenced_pushes_total",
+    "easydl_cell_promotion_seconds",
+    "easydl_cell_replication_lag",
+    "easydl_cell_ship_errors_total",
+    "easydl_cell_ship_gaps_total",
+    "easydl_cell_ship_torn_segments_total",
+    "easydl_cell_ship_truncations_total",
+    "easydl_cell_shipped_bytes_total",
+    "easydl_cell_shipped_records_total",
+    "easydl_cell_shipped_segments_total",
+    "easydl_cell_shipped_snapshots_total",
+    "easydl_cell_shipped_versions_total",
+    "easydl_chaos_faults_injected_total",
+    "easydl_chaos_scenarios_run_total",
+    "easydl_controller_jobs",
+    "easydl_controller_pod_ops_total",
+    "easydl_controller_reconcile_seconds",
+    "easydl_controller_reconcile_total",
+    "easydl_feedback_bytes_total",
+    "easydl_feedback_dropped_total",
+    "easydl_feedback_events_total",
+    "easydl_loop_checkpoints_total",
+    "easydl_loop_lag_seconds",
+    "easydl_loop_trained_events_total",
+    "easydl_master_desired_workers",
+    "easydl_master_directives_total",
+    "easydl_master_failovers_total",
+    "easydl_master_generation",
+    "easydl_master_journal_writes_total",
+    "easydl_master_membership_size",
+    "easydl_master_phase_seconds",
+    "easydl_master_plan_version",
+    "easydl_master_reconciled_agents_total",
+    "easydl_master_reshapes_total",
+    "easydl_master_straggler_evictions_total",
+    "easydl_master_train_loss",
+    "easydl_master_train_samples_per_sec",
+    "easydl_master_train_step",
+    "easydl_ps_client_dedup_ratio",
+    "easydl_ps_pull_bytes_total",
+    "easydl_ps_pull_ids_total",
+    "easydl_ps_push_bytes_total",
+    "easydl_ps_push_fence_rejected_total",
+    "easydl_ps_push_ids_total",
+    "easydl_ps_push_rejected_total",
+    "easydl_ps_push_stale_route_total",
+    "easydl_ps_reshard_replayed_records_total",
+    "easydl_ps_reshard_rows_migrated_total",
+    "easydl_ps_shard_epoch",
+    "easydl_ps_shm_client_fallbacks_total",
+    "easydl_ps_shm_client_ids_total",
+    "easydl_ps_shm_client_pulls_total",
+    "easydl_ps_table_rows",
+    "easydl_ps_wal_appends_total",
+    "easydl_ps_wal_bytes_total",
+    "easydl_ps_wal_deduped_pushes_total",
+    "easydl_ps_wal_replayed_records_total",
+    "easydl_ps_wal_retired_segments_total",
+    "easydl_retrieval_candidates_total",
+    "easydl_retrieval_freshness_seconds",
+    "easydl_retrieval_index_rows",
+    "easydl_retrieval_index_updates_total",
+    "easydl_retrieval_index_version",
+    "easydl_retrieval_requests_total",
+    "easydl_rollout_publishes_total",
+    "easydl_rollout_quarantines_total",
+    "easydl_rollout_rollbacks_total",
+    "easydl_rpc_client_errors_total",
+    "easydl_rpc_client_latency_seconds",
+    "easydl_rpc_client_requests_total",
+    "easydl_rpc_server_errors_total",
+    "easydl_rpc_server_latency_seconds",
+    "easydl_rpc_server_requests_total",
+    "easydl_scrape_attempts_total",
+    "easydl_scrape_failures_total",
+    "easydl_serve_batch_examples",
+    "easydl_serve_cache_bytes",
+    "easydl_serve_cache_evictions_total",
+    "easydl_serve_cache_hits_total",
+    "easydl_serve_cache_invalidations_total",
+    "easydl_serve_cache_misses_total",
+    "easydl_serve_examples_total",
+    "easydl_serve_model_version",
+    "easydl_serve_p99_seconds_recent",
+    "easydl_serve_qps_recent",
+    "easydl_serve_queue_examples",
+    "easydl_serve_request_latency_seconds",
+    "easydl_serve_requests_total",
+    "easydl_serve_router_ejections_total",
+    "easydl_serve_router_hedges_total",
+    "easydl_serve_router_known_replicas",
+    "easydl_serve_router_live_replicas",
+    "easydl_serve_router_offered_qps_recent",
+    "easydl_serve_router_p99_seconds_recent",
+    "easydl_serve_router_readmissions_total",
+    "easydl_serve_router_request_latency_seconds",
+    "easydl_serve_router_requests_total",
+    "easydl_serve_router_reroutes_total",
+    "easydl_serve_router_routed_total",
+    "easydl_swallowed_errors_total",
+    "easydl_timeline_listener_errors_total",
+    "easydl_train_loss",
+    "easydl_train_samples_per_sec",
+    "easydl_train_step",
+    "easydl_train_step_time_seconds",
+    "easydl_train_steps_total",
+    "easydl_worker_mesh_axis",
+    "easydl_worker_mfu",
+))
 
 
 def _module_tuple_constants(tree: ast.Module):

@@ -37,6 +37,7 @@ from easydl_tpu.analysis.core import (
 #: Modules the PR-8 simulator replays — the byte-identical set.
 PURE_PREFIXES = ("easydl_tpu/sim/",)
 PURE_PATHS = (
+    "easydl_tpu/brain/alert_policy.py",
     "easydl_tpu/brain/arbiter.py",
     "easydl_tpu/brain/mesh_policy.py",
     "easydl_tpu/brain/policy.py",

@@ -1,0 +1,131 @@
+"""slo-metric-refs: every series an SLO or the alerting stack names
+must exist in the metric registry.
+
+The failure mode this closes (ISSUE 19): an SLO spec referencing a
+misspelled or since-renamed family is silently vacuous — ``bound``
+objectives read absent-series-as-healthy by design, so the alert never
+fires and nobody notices until the drill that needed it. The registry
+in ``analysis/rules/metric_names.py`` (``REGISTERED_METRICS``, kept in
+sync with the registration sites by AST scan in tests/test_easylint.py)
+is the source of truth; this rule resolves against it in two places:
+
+* **the SLO catalog** — when the anchor module
+  (``easydl_tpu/obs/slo.py``) is analyzed, every ``slos/*.yaml`` is
+  loaded through the validating loader and each selector's family must
+  be registered (``_bucket``/``_sum``/``_count`` suffixes resolve to
+  their histogram base). easylint only collects ``.py`` files, so the
+  YAML catalog rides the anchor: the finding's path is the YAML file;
+* **the alerting modules** — string literals in ``obs/slo.py``,
+  ``obs/alerts.py`` and ``brain/alert_policy.py`` that parse as a
+  metric family (``easydl_<component>_<metric>``) must be registered,
+  so a hardcoded series name in the evaluator cannot drift either.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import FrozenSet, List, Optional
+
+from easydl_tpu.analysis.core import Finding, Rule
+from easydl_tpu.analysis.rules.metric_names import REGISTERED_METRICS
+
+#: The module whose analysis triggers the YAML-catalog half.
+ANCHOR = "easydl_tpu/obs/slo.py"
+
+#: Modules whose string literals are checked against the registry.
+LITERAL_PATHS = (
+    "easydl_tpu/obs/slo.py",
+    "easydl_tpu/obs/alerts.py",
+    "easydl_tpu/brain/alert_policy.py",
+)
+
+#: Suffixes that resolve to a histogram's base family.
+_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+_FAMILY_RE = re.compile(r"^easydl(_[a-z0-9]+){2,}$")
+
+
+def _registered(name: str, registry: FrozenSet[str]) -> bool:
+    if name in registry:
+        return True
+    for suffix in _DERIVED_SUFFIXES:
+        if name.endswith(suffix) and name[: -len(suffix)] in registry:
+            return True
+    return False
+
+
+class SloMetricRefs(Rule):
+    name = "slo-metric-refs"
+    invariant = ("Every series referenced by an SLO spec (slos/*.yaml) or "
+                 "named literally in the alerting modules resolves to a "
+                 "family in REGISTERED_METRICS — a misspelled selector is "
+                 "a lint failure, not a silently-vacuous alert.")
+
+    def __init__(self, slos_dir: Optional[str] = None,
+                 registry: Optional[FrozenSet[str]] = None) -> None:
+        #: override points for the fixture tests; defaults are the repo
+        #: catalog and the live registry
+        self.slos_dir = slos_dir
+        self.registry = registry if registry is not None else REGISTERED_METRICS
+
+    # -- the YAML-catalog half -------------------------------------------
+
+    def _check_catalog(self, findings: List[Finding]) -> None:
+        from easydl_tpu.obs import slo as slo_mod
+
+        d = self.slos_dir if self.slos_dir is not None else slo_mod.SLOS_DIR
+        if not os.path.isdir(d):
+            # a repo without a catalog has nothing to resolve; the
+            # anti-vacuous guarantee lives in the fixture tests
+            return
+        for path in slo_mod.list_slo_files(d):
+            rel = os.path.join("slos", os.path.basename(path))
+            try:
+                spec = slo_mod.load_slo_file(path)
+            except slo_mod.SloSpecError as e:
+                findings.append(Finding(
+                    rule=self.name, path=rel, line=1, scope="<slo>",
+                    detail=f"invalid-slo:{os.path.basename(path)}",
+                    message=f"spec fails the validating loader: {e}"))
+                continue
+            for series in slo_mod.referenced_series(spec):
+                family = series.split("{", 1)[0]
+                if not _registered(family, self.registry):
+                    findings.append(Finding(
+                        rule=self.name, path=rel, line=1,
+                        scope=str(spec.get("name", "<slo>")),
+                        detail=f"unknown-series:{family}",
+                        message=(f"selector {series!r} names a family not "
+                                 f"in REGISTERED_METRICS — a typo here is "
+                                 f"a silently-vacuous alert")))
+
+    # -- the literal half ------------------------------------------------
+
+    def _check_literals(self, path: str, tree: ast.Module,
+                        findings: List[Finding]) -> None:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            text = node.value
+            family = text.split("{", 1)[0]
+            if not _FAMILY_RE.match(family):
+                continue
+            if not _registered(family, self.registry):
+                findings.append(Finding(
+                    rule=self.name, path=path,
+                    line=getattr(node, "lineno", 1), scope="<literal>",
+                    detail=f"unknown-series:{family}",
+                    message=(f"literal {text!r} names a metric family not "
+                             f"in REGISTERED_METRICS")))
+
+    def check(self, path: str, tree: ast.Module,
+              source: str) -> List[Finding]:
+        findings: List[Finding] = []
+        if path in LITERAL_PATHS:
+            self._check_literals(path, tree, findings)
+        if path == ANCHOR:
+            self._check_catalog(findings)
+        return findings

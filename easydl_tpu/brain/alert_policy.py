@@ -1,0 +1,305 @@
+"""Pure multiwindow burn-rate alerting — the detection half of the Brain.
+
+The stack exports ~60 metric series and survives every drill in the
+chaos catalog, but nothing turned those series into "page a human, and
+here's the runbook section". This module is the decision core that does:
+:func:`alert_decision` maps (SLO specs, a short window of fleet metric
+snapshots, the prior alert state, now) → the canonical alert document,
+in the multiwindow burn-rate shape of Google's SRE Workbook ch. 5 — an
+alert fires only when BOTH a long and a short window burn through the
+objective's error budget (the long window rejects blips, the short
+window makes the page stop quickly once the burn stops), and it clears
+once the long window is clean again.
+
+Like every policy in ``brain/`` (easylint rule 5 ``PURE_PATHS``), the
+function is PURE: no clock, no RNG, no I/O — every input it consumes is
+in its argument list, the stateful :class:`AlertPolicy` wrapper logs the
+FULL inputs next to each verdict, and :func:`replay_decision_log`
+re-derives every live decision offline and byte-compares
+(:func:`decision_bytes`) — the chaos drills' detection evidence is
+accepted only when that replay is identical.
+
+Three objective shapes cover the shipped SLOs (``slos/*.yaml``, loaded
+and validated by :mod:`easydl_tpu.obs.slo`):
+
+- ``ratio`` — bad-event / total-event counter deltas over each window,
+  divided by the error ``budget`` (the allowed bad fraction): the
+  classic burn rate. No traffic → no burn (a silent fleet is not an
+  outage; dead exporters have their own SLO).
+- ``bound`` — a gauge compared against a threshold; the "burn" is the
+  fraction of snapshots in the window that breach. ``ignore_zero``
+  exempts exact zeros (``easydl_worker_mfu`` is 0 when the model
+  publishes no FLOP hint — idle instrumentation, not an outage).
+- ``increase`` — a counter that should not move at all (failovers,
+  quarantines, ejections): any delta beyond ``max_increase`` in both
+  windows fires; the alert clears ``long_s`` after the last increment.
+
+Series selectors are canonical sample keys — ``name`` (every labelset of
+the family, counters summed / gauges max-ed) or ``name{k="v"}`` (only
+labelsets containing those pairs), matching the sorted-label
+serialization both :meth:`MetricsRegistry.samples` and
+``obs.scrape.parse_text`` emit. NaN samples are treated as absent —
+scrape text can carry them and arithmetic must not.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Mapping, Sequence, Tuple
+
+__all__ = [
+    "alert_decision",
+    "decision_bytes",
+    "match_series",
+    "parse_selector",
+    "AlertPolicy",
+    "replay_decision_log",
+]
+
+#: severities an SLO may declare; "page" wakes a human, "ticket" waits
+#: for business hours — the fault-free negative control is stated over
+#: pages only.
+SEVERITIES = ("page", "ticket")
+
+
+def parse_selector(selector: str) -> Tuple[str, Dict[str, str]]:
+    """``name{k="v",k2="v2"}`` → (name, {k: v}); bare names select the
+    whole family. Tolerates only the canonical serialization the
+    registry and the scraper emit — selectors come from validated SLO
+    specs, not from the wire."""
+    sel = selector.strip()
+    if "{" not in sel:
+        return sel, {}
+    name, _, inner = sel.partition("{")
+    labels: Dict[str, str] = {}
+    inner = inner.rstrip("}")
+    if inner:
+        for pair in inner.split(","):
+            k, _, v = pair.partition("=")
+            labels[k.strip()] = v.strip().strip('"')
+    return name, labels
+
+
+def match_series(selector: str, samples: Mapping[str, float]) -> Dict[str, float]:
+    """Every sample key the selector covers → value. NaN values are
+    dropped here so no downstream arithmetic ever sees one."""
+    name, want = parse_selector(selector)
+    out: Dict[str, float] = {}
+    for key, value in samples.items():
+        v = float(value)
+        if v != v:  # NaN — scrape text can carry it; arithmetic must not
+            continue
+        base, _, inner = key.partition("{")
+        if base != name:
+            continue
+        if want:
+            have: Dict[str, str] = {}
+            for pair in inner.rstrip("}").split(","):
+                k, _, val = pair.partition("=")
+                have[k] = val.strip('"')
+            if any(have.get(k) != v2 for k, v2 in want.items()):
+                continue
+        out[key] = v
+    return out
+
+
+def _window(history: Sequence[Mapping[str, Any]], now: float,
+            span_s: float) -> List[Mapping[str, Any]]:
+    lo = now - float(span_s)
+    return [h for h in history if lo <= float(h.get("t", 0.0)) <= now]
+
+
+def _delta(selector: str, rounds: Sequence[Mapping[str, Any]]) -> float:
+    """Summed per-series counter increase across a window. A series
+    absent at the window start counts from 0 (fresh registries start
+    there); a series that vanishes (its pod died) contributes nothing —
+    the monotone clamp keeps a shrinking additive merge from reading as
+    negative traffic."""
+    if not rounds:
+        return 0.0
+    end = match_series(selector, rounds[-1].get("s") or {})
+    start_samples = match_series(selector, rounds[0].get("s") or {})
+    total = 0.0
+    for key, v_end in end.items():
+        total += max(0.0, v_end - start_samples.get(key, 0.0))
+    return total
+
+
+def _breach_fraction(objective: Mapping[str, Any],
+                     rounds: Sequence[Mapping[str, Any]]) -> float:
+    """bound objectives: fraction of window snapshots where any covered
+    series breaches. Snapshots where the series is absent count as
+    healthy — absence is the scrape-health SLO's job."""
+    if not rounds:
+        return 0.0
+    op = str(objective.get("op", "gt"))
+    bound = float(objective.get("bound", 0.0))
+    ignore_zero = bool(objective.get("ignore_zero", False))
+    breached = 0
+    for h in rounds:
+        values = match_series(str(objective.get("series", "")),
+                              h.get("s") or {})
+        hit = False
+        for v in values.values():
+            if ignore_zero and v == 0.0:
+                continue
+            if (v > bound) if op == "gt" else (v < bound):
+                hit = True
+                break
+        breached += 1 if hit else 0
+    return breached / len(rounds)
+
+
+def _burn(objective: Mapping[str, Any],
+          rounds: Sequence[Mapping[str, Any]]) -> float:
+    kind = str(objective.get("type", ""))
+    if kind == "ratio":
+        total = _delta(str(objective.get("total", "")), rounds)
+        if total <= 0.0:
+            return 0.0
+        bad = _delta(str(objective.get("bad", "")), rounds)
+        budget = max(1e-9, float(objective.get("budget", 1.0)))
+        return (bad / total) / budget
+    if kind == "bound":
+        return _breach_fraction(objective, rounds)
+    if kind == "increase":
+        inc = _delta(str(objective.get("series", "")), rounds)
+        return 1.0 if inc > float(objective.get("max_increase", 0.0)) else 0.0
+    return 0.0
+
+
+def alert_decision(specs: Sequence[Mapping[str, Any]],
+                   history: Sequence[Mapping[str, Any]],
+                   prior: Mapping[str, Mapping[str, Any]],
+                   now: float) -> Dict[str, Any]:
+    """One evaluation round → the canonical alert document.
+
+    ``history`` is the evaluator's snapshot window, oldest first:
+    ``[{"t": wall_s, "s": {sample_key: value}}, ...]``; ``prior`` the
+    previous round's ``{slo: {"active", "since"}}`` state. Returns::
+
+        {"now": r6, "alerts": {slo: {"active", "severity", "since",
+                                     "burn_long", "burn_short"}},
+         "firing": [slo...], "pages": [slo...],
+         "transitions": [{"slo", "to"}]}
+
+    Fire requires BOTH windows over threshold; once active, the alert
+    holds while the LONG window still burns (the short window going
+    quiet alone must not flap the page) and clears when it stops. The
+    function never mutates its inputs."""
+    now = round(float(now), 6)
+    hist = sorted((dict(h) for h in history),
+                  key=lambda h: float(h.get("t", 0.0)))
+    alerts: Dict[str, Any] = {}
+    transitions: List[Dict[str, str]] = []
+    for spec in specs:
+        name = str(spec.get("name", ""))
+        objective = dict(spec.get("objective") or {})
+        windows = dict(spec.get("windows") or {})
+        long_s = float(windows.get("long_s", 6.0))
+        short_s = float(windows.get("short_s", 1.5))
+        threshold = float(spec.get("burn_threshold", 1.0))
+        burn_long = _burn(objective, _window(hist, now, long_s))
+        burn_short = _burn(objective, _window(hist, now, short_s))
+        was = dict(prior.get(name) or {})
+        was_active = bool(was.get("active", False))
+        if was_active:
+            active = burn_long >= threshold
+        else:
+            active = burn_long >= threshold and burn_short >= threshold
+        since = float(was.get("since", now)) if was_active and active else now
+        alerts[name] = {
+            "active": active,
+            "severity": str(spec.get("severity", "ticket")),
+            "since": round(since, 6),
+            "burn_long": round(burn_long, 6),
+            "burn_short": round(burn_short, 6),
+        }
+        if active != was_active:
+            transitions.append({"slo": name,
+                                "to": "firing" if active else "clear"})
+    firing = sorted(n for n, a in alerts.items() if a["active"])
+    return {
+        "now": now,
+        "alerts": {n: alerts[n] for n in sorted(alerts)},
+        "firing": firing,
+        "pages": [n for n in firing if alerts[n]["severity"] == "page"],
+        "transitions": transitions,
+    }
+
+
+def decision_bytes(decision: Mapping[str, Any]) -> bytes:
+    """Canonical serialization — the byte identity the offline replay
+    gate (chaos verdicts, slo_report --smoke) is stated over."""
+    return json.dumps(decision, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+class AlertPolicy:
+    """Stateful wrapper owning the active/since bookkeeping — shared
+    verbatim between the live :class:`~easydl_tpu.obs.alerts.AlertEvaluator`
+    and the fleet-scale simulator, so the two can never drift. Every
+    entry point takes ``now`` (virtual-clock-pure)."""
+
+    def __init__(self, specs: Sequence[Mapping[str, Any]]):
+        #: canonical spec documents (plain JSON data) — logged with every
+        #: decision so a record replays with no side channel
+        self.specs: List[Dict[str, Any]] = [
+            json.loads(json.dumps(dict(s), sort_keys=True)) for s in specs]
+        #: slo -> {"active", "since"} carried between rounds
+        self.state: Dict[str, Dict[str, Any]] = {}
+        #: decision records ({"inputs": ..., "verdict": ...}) in order —
+        #: what the ledger persists and the replay re-derives
+        self.log: List[Dict[str, Any]] = []
+
+    def evaluate(self, history: Sequence[Mapping[str, Any]],
+                 now: float) -> Dict[str, Any]:
+        """Evaluate once; appends the full (inputs, verdict) record to
+        :attr:`log`. The inputs snapshot (including the prior state) is
+        taken BEFORE the state advances — replaying it through
+        :func:`alert_decision` must reproduce the verdict bytes."""
+        now = round(float(now), 6)
+        hist = [{"t": float(h.get("t", 0.0)), "s": dict(h.get("s") or {})}
+                for h in history]
+        inputs = {
+            "specs": self.specs,
+            "history": hist,
+            "prior": {k: dict(v) for k, v in sorted(self.state.items())},
+            "now": now,
+        }
+        decision = alert_decision(self.specs, hist, self.state, now)
+        self.state = {
+            name: {"active": a["active"], "since": a["since"]}
+            for name, a in decision["alerts"].items()
+        }
+        self.log.append({"inputs": inputs, "verdict": decision})
+        return decision
+
+
+def replay_decision_log(records: Sequence[Mapping[str, Any]]
+                        ) -> Dict[str, Any]:
+    """Re-derive every logged verdict from its own recorded inputs
+    through the pure function and byte-compare — the offline half of
+    every drill's ``detected_and_cleared`` gate. Returns::
+
+        {"decisions": N, "identical": bool, "mismatches": [...]}
+    """
+    mismatches: List[Dict[str, Any]] = []
+    for i, rec in enumerate(records):
+        inputs = dict(rec.get("inputs") or {})
+        want = rec.get("verdict")
+        got = alert_decision(
+            list(inputs.get("specs") or []),
+            list(inputs.get("history") or []),
+            dict(inputs.get("prior") or {}),
+            float(inputs.get("now", 0.0)),
+        )
+        if want is None or decision_bytes(got) != decision_bytes(want):
+            mismatches.append({
+                "index": i, "recorded": want, "replayed": got,
+            })
+    return {
+        "decisions": len(records),
+        "identical": not mismatches and len(records) > 0,
+        "mismatches": mismatches[:5],
+    }

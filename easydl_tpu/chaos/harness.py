@@ -39,7 +39,7 @@ from easydl_tpu.chaos.spec import (
     ChaosSpec, FaultSpec, compile_schedule, process_events,
 )
 from easydl_tpu.utils.logging import get_logger
-from easydl_tpu.utils.env import knob_raw
+from easydl_tpu.utils.env import knob_bool, knob_raw
 
 log = get_logger("chaos", "harness")
 
@@ -247,9 +247,12 @@ class ChaosHarness:
         #: that the drain beat the kill (a tolerated no-op kill IS the
         #: success case there)
         self.kill_marks: List[Dict[str, Any]] = []
+        self._alert_recorder = None
+        self._drill_t0 = 0.0
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> Dict[str, Any]:
+        self._start_alert_recorder()
         if self.scenario.cell_drill is not None:
             return self._run_cell_drill()
         if self.scenario.tenant_drill is not None:
@@ -3113,7 +3116,87 @@ class ChaosHarness:
         log.info("chaos: master restarted at %s over %s",
                  m.address, self.workdir)
 
+    # ----------------------------------------------------- alert detection
+    def _start_alert_recorder(self) -> None:
+        """Arm the drill's alerting witness: the AlertRecorder scrapes the
+        workdir fleet on a cadence and runs the real SLO policy over it —
+        the detected_and_cleared invariant family judges its evidence."""
+        if not knob_bool("EASYDL_ALERT_DRILL_RECORD"):
+            return
+        from easydl_tpu.obs import alerts as obs_alerts
+        from easydl_tpu.obs import slo as obs_slo
+
+        try:
+            specs = obs_slo.load_all()
+        except Exception as e:  # a broken spec dir must not kill drills
+            log.warning("alert recorder disabled: SLO load failed: %s", e)
+            return
+        if not specs:
+            return
+        wd = self.workdir
+
+        def scan_dirs() -> List[str]:
+            # the cell drill runs its fleets under primary/ and standby/;
+            # re-resolved each tick because they appear after start
+            dirs = [wd]
+            for sub in ("primary", "standby"):
+                p = os.path.join(wd, sub)
+                if os.path.isdir(p):
+                    dirs.append(p)
+            return dirs
+
+        self._drill_t0 = time.time()
+        # per-drill slice of the process-wide injection timeline (one
+        # pytest process runs many drills; only THIS drill's marks count)
+        self._fault_marks_base = len(injectors.fault_marks())
+        # scrape_timeout generous: a dead pod refuses instantly, but a
+        # busy-but-alive pod on this cpu-shares-throttled box must never
+        # read as a scrape failure (that would page the negative control)
+        self._alert_recorder = obs_alerts.AlertRecorder(
+            scan_dirs, specs, os.path.join(wd, "alerts"),
+            scrape_timeout=5.0).start()
+
+    def _stop_alert_recorder(self) -> None:
+        """First step of teardown — the final tick must still see the
+        recovered fleet alive, and a fault-free teardown must not read as
+        scrape failures. Writes ``alert-evidence.json`` with the fault
+        context the TTD measurement needs."""
+        rec, self._alert_recorder = self._alert_recorder, None
+        if rec is None:
+            return
+        detect = dict((self.scenario.expect or {}).get("detect") or {})
+        if detect.get("alert"):
+            # bounded settle: the clear half of detected_and_cleared
+            # needs one clean long window AFTER recovery — give the
+            # recorder time to observe it before the fleet is torn down
+            from easydl_tpu.utils.env import knob_float
+            alert = str(detect["alert"])
+            deadline = time.monotonic() + knob_float("EASYDL_ALERT_SETTLE_S")
+            while time.monotonic() < deadline:
+                a = dict(rec.evaluator.last.get("alerts") or {}).get(alert)
+                if a is None or not a.get("active"):
+                    break
+                time.sleep(0.2)
+        try:
+            evidence = rec.stop()
+        except Exception as e:  # evidence is judged, never a crash here
+            log.warning("alert recorder stop failed: %s", e)
+            return
+        evidence["fault_context"] = {
+            "t0": round(self._drill_t0, 6),
+            "plan": self.schedule,
+            "kill_marks": self.kill_marks,
+            "fault_marks": injectors.fault_marks()[
+                getattr(self, "_fault_marks_base", 0):],
+        }
+        path = os.path.join(self.workdir, "alert-evidence.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(evidence, f, sort_keys=True)
+        os.replace(tmp, path)
+
     def _teardown(self) -> None:
+        self._stop_alert_recorder()
         self._torn_down = True
         for t in self._timers:
             t.cancel()
@@ -3405,6 +3488,9 @@ def scenario_worker_kill(seed: int = 7) -> Scenario:
             "max_reshapes": 2,
             "min_final_generation": 2,    # the kill really forced a reshape
             "min_faults": 1,
+            # the kill's recovery reshape must page through the SLO
+            # policy within budget and clear once the world converges
+            "detect": {"alert": "elastic_reshape", "ttd_budget_s": 30.0},
         },
     )
 
@@ -3587,6 +3673,10 @@ def scenario_master_crash(seed: int = 29) -> Scenario:
             "max_reshapes_after_failover": 0,
             "min_steps_during_outage": 5,  # training never stopped
             "min_faults": 1,
+            # zero reshapes here, so detection must come from the
+            # journal-restore counter, not membership churn
+            "detect": {"alert": "control_plane_failover",
+                       "ttd_budget_s": 30.0},
         },
     )
 
@@ -3664,6 +3754,11 @@ def scenario_ps_shard_crash_zero_loss(seed: int = 37) -> Scenario:
             "ps_zero_loss": True,
             "min_wal_replays": 1,
             "min_faults": 1,
+            # the SIGKILLed pod leaves its discovery doc behind — the
+            # failed scrape is the detection; the rescue pod's republish
+            # (plus the recorder's dead-pid sweep) is the clear
+            "detect": {"alert": "fleet_scrape_health",
+                       "ttd_budget_s": 30.0},
         },
     )
 
@@ -3738,6 +3833,9 @@ def scenario_ps_reshard_under_fire(seed: int = 43) -> Scenario:
             "min_rows_migrated": 1,
             "min_reshard_replays": 1,      # the mid-migration WAL tail
             "min_faults": 2,               # ps_kill + ps_pause
+            # row migration into destinations is the change-event alert;
+            # budget covers pod launch + storm warm-up on this box
+            "detect": {"alert": "ps_reshard_active", "ttd_budget_s": 60.0},
         },
     )
 
@@ -3782,6 +3880,8 @@ def scenario_serve_during_reshard(seed: int = 59) -> Scenario:
             "serve_no_stale_reads": True,
             "min_serve_requests": 50,
             "min_serve_cache_hits": 1,
+            # no kill here — the live split itself must be visible
+            "detect": {"alert": "ps_reshard_active", "ttd_budget_s": 60.0},
         },
     )
 
@@ -3822,6 +3922,9 @@ def scenario_serve_replica_death_mid_flood(seed: int = 71) -> Scenario:
                                         # router timeout; this box is
                                         # cpu-shares throttled)
             "min_faults": 2,            # the stall AND the kill
+            # the router's ejection of the killed replica is the page
+            "detect": {"alert": "serve_replica_ejected",
+                       "ttd_budget_s": 60.0},
         },
     )
 
@@ -3857,6 +3960,10 @@ def scenario_trainer_crash_mid_loop(seed: int = 61) -> Scenario:
             "loop_exactly_once": True,
             "min_loop_events": 100,   # vacuous-pass refusal
             "min_faults": 1,          # the trainer kill
+            # the SIGKILLed trainer's orphaned exporter doc is the
+            # signal; its relaunch republishing the component clears it
+            "detect": {"alert": "fleet_scrape_health",
+                       "ttd_budget_s": 60.0},
         },
     )
 
@@ -3890,6 +3997,9 @@ def scenario_rollout_half_update(seed: int = 67) -> Scenario:
             "min_rollout_requests": 50,   # vacuous-pass refusal
             "min_version_swaps": 2,       # adoption + post-promote swap
             "min_faults": 2,              # publish_crash + publish_corrupt
+            # the CRC-quarantined corrupt publication is the page
+            "detect": {"alert": "rollout_quarantine",
+                       "ttd_budget_s": 60.0},
         },
     )
 
@@ -3956,6 +4066,10 @@ def scenario_retrieval_replica_death_mid_index_update(
             "min_retrievals_during_update": 1,  # ... under live traffic
             "require_kill": True,
             "min_faults": 1,                    # the builder kill
+            # the SIGKILLed builder's orphaned exporter doc is the
+            # signal; the relaunch republishing the component clears it
+            "detect": {"alert": "fleet_scrape_health",
+                       "ttd_budget_s": 60.0},
         },
     )
 
@@ -4051,6 +4165,35 @@ def scenario_cell_failover(seed: int = 89) -> Scenario:
     through the validating loader, so the YAML is the single source of
     truth."""
     return _yaml_scenario("cell_failover.yaml", seed)
+
+
+def scenario_fault_free_control(seed: int = 97) -> Scenario:
+    """The alerting catalog's ANTI-VACUOUS negative control: a healthy
+    push storm — live PS pods, real traffic, a planned mid-storm
+    snapshot, zero injected faults — run under the full ``slos/*.yaml``
+    policy. The ``no_false_pages`` invariant requires ZERO page-severity
+    alerts over the whole run (tickets are allowed: planned churn is
+    ticket-worthy) with the witness provably ticking and its decision
+    ledger replaying byte-identically. Without this drill, every
+    ``detected_and_cleared`` pass could come from a policy that simply
+    pages on everything."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="fault_free_control", seed=seed,
+            notes="healthy storm, zero faults — the SLO policy must "
+                  "page ZERO times or detection evidence means nothing",
+            faults=(),
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=2,
+        ps_storm={"steps": 240, "batch": 128, "vocab": 2000, "dim": 8,
+                  "zipf_a": 1.1, "save_at": 80, "arm_at": 120,
+                  "pace_s": 0.01},
+        expect={
+            "detect_none": True,
+        },
+    )
 
 
 def _yaml_scenario(filename: str, seed: int) -> Scenario:
@@ -4186,6 +4329,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "straggler_mitigation": scenario_straggler_mitigation,
     "preempt_race": scenario_preempt_race,
     "cell_failover": scenario_cell_failover,
+    "fault_free_control": scenario_fault_free_control,
 }
 
 #: the cheapest deterministic drill — what scripts/chaos_smoke.sh runs and

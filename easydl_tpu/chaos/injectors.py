@@ -150,6 +150,17 @@ def current_plan() -> Optional[ChaosPlan]:
 # ------------------------------------------------------------- obs counters
 _metrics_lock = threading.Lock()
 _fault_counter = None
+#: wall-stamped injection timeline for THIS process — the harness slices
+#: it per drill and the detected_and_cleared invariant measures TTD from
+#: the relevant mark (protocol-point faults have no plan offset to read).
+_fault_marks: List[Dict[str, Any]] = []
+
+
+def fault_marks() -> List[Dict[str, Any]]:
+    """Copy of this process' ``[{"t": wall, "kind"}]`` injection marks,
+    append order."""
+    with _metrics_lock:
+        return list(_fault_marks)
 
 
 def count_fault(kind: str) -> None:
@@ -169,6 +180,8 @@ def count_fault(kind: str) -> None:
                 ("kind",),
             )
     _fault_counter.inc(kind=kind)
+    with _metrics_lock:
+        _fault_marks.append({"t": time.time(), "kind": kind})
     try:
         from easydl_tpu.obs import tracing
 

@@ -60,7 +60,16 @@ verdicts:
   live worker — reactive crash-recovery after the kill fails the drill;
 - ``faults_observed`` (cross-check) — the obs counters saw at least the
   expected number of injected faults, so a "pass" can't come from a drill
-  that silently injected nothing.
+  that silently injected nothing;
+- ``detected_and_cleared`` — the drill's alerting witness (the harness'
+  AlertRecorder running the real ``slos/*.yaml`` policy) saw the
+  injected fault's expected alert fire within the per-scenario TTD
+  budget AND clear after recovery, and the recorded alert-decision log
+  re-derives byte-identically offline; a drill that ran without the
+  witness fails, never skips;
+- ``no_false_pages`` — the anti-vacuous negative control: a fault-free
+  run must fire ZERO page-severity alerts while the witness provably
+  ran.
 
 Expectations are a plain dict so scenarios stay declarative::
 
@@ -1053,6 +1062,20 @@ def check_scenario(
                         "races": races,
                     }
 
+    # ------------------------------------------------- detection (alerting)
+    # The drill's alerting witness (harness AlertRecorder) leaves
+    # alert-evidence.json; ``detect`` requires the named SLO alert to fire
+    # within the TTD budget AND clear after recovery AND the recorded
+    # decision log to re-derive byte-identically; ``detect_none`` is the
+    # anti-vacuous negative control — a fault-free run must page ZERO.
+    detect = expect.get("detect")
+    if detect is not None:
+        checks["detected_and_cleared"] = _check_detected(
+            dict(detect), _read_alert_evidence(workdir), kills=kills)
+    if expect.get("detect_none"):
+        checks["no_false_pages"] = _check_no_false_pages(
+            _read_alert_evidence(workdir))
+
     # ----------------------------------------------------- faults cross-check
     min_faults = expect.get("min_faults")
     if min_faults is not None:
@@ -1067,6 +1090,140 @@ def check_scenario(
         "passed": all(c["ok"] for c in checks.values()),
         "checks": checks,
     }
+
+
+def _read_alert_evidence(workdir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(workdir, "alert-evidence.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fault_time(evidence: Mapping[str, Any],
+                kills: Optional[List[Mapping[str, Any]]]) -> Optional[float]:
+    """Wall-clock moment the drill's first fault landed: the earliest
+    harness kill mark, else the armed plan's first event (t0 + start_s),
+    else the drill start — TTD is measured from here."""
+    ctx = dict(evidence.get("fault_context") or {})
+    candidates: List[float] = []
+    for mark in (list(ctx.get("kill_marks") or [])
+                 + list(ctx.get("fault_marks") or [])
+                 + list(kills or [])):
+        t = mark.get("t")
+        if t is not None:
+            candidates.append(float(t))
+    plan = dict(ctx.get("plan") or {})
+    t0 = plan.get("t0")
+    if t0 is not None:
+        starts = [float(e.get("start_s", 0.0))
+                  for e in plan.get("events") or []]
+        if starts:
+            candidates.append(float(t0) + min(starts))
+    if candidates:
+        return min(candidates)
+    start = ctx.get("t0")
+    return float(start) if start is not None else None
+
+
+def _check_detected(detect: Dict[str, Any],
+                    evidence: Optional[Mapping[str, Any]],
+                    kills: Optional[List[Mapping[str, Any]]] = None
+                    ) -> Dict[str, Any]:
+    """detected_and_cleared: the expected alert fired within the TTD
+    budget, cleared after recovery, and the alert-decision replay is
+    byte-identical and non-empty. A drill that ran without its witness
+    is a FAILURE, not a skip — detection claims must never pass
+    vacuously."""
+    from easydl_tpu.utils.env import knob_float
+
+    alert = str(detect.get("alert", ""))
+    out: Dict[str, Any] = {"ok": False, "alert": alert}
+    if not evidence:
+        out["reason"] = ("no alert-evidence.json — the drill ran without "
+                         "its alerting witness (vacuous)")
+        return out
+    budget = float(detect.get("ttd_budget_s",
+                              knob_float("EASYDL_ALERT_TTD_BUDGET_S")))
+    rounds = int(evidence.get("rounds", 0))
+    fault_t = _fault_time(evidence, kills)
+    # TTD anchors on the first firing transition AT/AFTER the fault (1s
+    # clock-rounding slack): drill setup is legitimate churn — a job
+    # placing its workers reshapes, and that setup-phase firing must not
+    # be mistaken for (or poison) detection of the fault injected later.
+    fired_t = None
+    for tr in evidence.get("transitions") or []:
+        if (str(tr.get("slo")) == alert and tr.get("to") == "firing"
+                and (fault_t is None
+                     or float(tr.get("t", 0.0)) >= float(fault_t) - 1.0)):
+            fired_t = float(tr["t"])
+            break
+    replay = dict(evidence.get("replay") or {})
+    ttd = (round(float(fired_t) - float(fault_t), 3)
+           if fired_t is not None and fault_t is not None else None)
+    # "cleared" = a clear transition AFTER the first fire. Judged from
+    # the timeline, not the final state: drill teardown SIGKILLs its own
+    # subprocess fleet, and the recorder's last ticks legitimately see
+    # that carnage re-fire scrape alerts — the detection claim is about
+    # the drill's recovery, which happened earlier.
+    cleared = False
+    if fired_t is not None:
+        for tr in evidence.get("transitions") or []:
+            if (str(tr.get("slo")) == alert and tr.get("to") == "clear"
+                    and float(tr.get("t", 0.0)) >= float(fired_t)):
+                cleared = True
+                break
+    out.update({
+        "rounds": rounds,
+        "fired": fired_t is not None,
+        "fault_t": fault_t,
+        "fired_t": fired_t,
+        "ttd_s": ttd,
+        "ttd_budget_s": budget,
+        "cleared": cleared,
+        "replay_decisions": int(replay.get("decisions", 0)),
+        "replay_identical": bool(replay.get("identical")),
+    })
+    # small negative slack: clock rounding between the kill mark and the
+    # recorder tick; an alert firing well BEFORE its fault is a policy
+    # bug, not a detection
+    out["ok"] = bool(
+        rounds > 0
+        and ttd is not None
+        and -1.0 <= ttd <= budget
+        and out["cleared"]
+        and out["replay_identical"]
+        and out["replay_decisions"] > 0
+    )
+    return out
+
+
+def _check_no_false_pages(evidence: Optional[Mapping[str, Any]]
+                          ) -> Dict[str, Any]:
+    """The negative control: a fault-free run must fire ZERO
+    page-severity alerts (tickets are allowed — planned churn is
+    ticket-worthy, never page-worthy), with the witness provably
+    running and its decision log replaying byte-identically."""
+    out: Dict[str, Any] = {"ok": False}
+    if not evidence:
+        out["reason"] = ("no alert-evidence.json — the negative control "
+                         "ran without its alerting witness (vacuous)")
+        return out
+    rounds = int(evidence.get("rounds", 0))
+    replay = dict(evidence.get("replay") or {})
+    out.update({
+        "rounds": rounds,
+        "pages_fired": list(evidence.get("pages_fired") or []),
+        "replay_decisions": int(replay.get("decisions", 0)),
+        "replay_identical": bool(replay.get("identical")),
+    })
+    out["ok"] = bool(
+        rounds > 0
+        and not out["pages_fired"]
+        and out["replay_identical"]
+        and out["replay_decisions"] > 0
+    )
+    return out
 
 
 def _straggler_onset(workdir: str, agent: str) -> Optional[float]:

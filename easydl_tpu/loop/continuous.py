@@ -366,6 +366,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     spec = TableSpec(name=args.table, dim=args.dim,
                      optimizer=args.optimizer, seed=11, lr=0.05)
+    from easydl_tpu.obs import get_registry, start_exporter
+    exporter = start_exporter(component=args.name, registry=get_registry(),
+                              workdir=args.workdir)
     client = ShardedPsClient.from_registry(
         args.workdir, args.shards, timeout=5.0,
         drain_retry_s=120.0, transient_retry_s=60.0)
@@ -386,6 +389,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         status(dict(summary, phase="done"))
     finally:
         client.close()
+        # clean exits deregister: only a KILLED trainer leaves its
+        # discovery doc behind for the fleet_scrape_health SLO to see.
+        if exporter is not None:
+            exporter.stop()
     return 0
 
 

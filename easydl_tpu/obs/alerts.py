@@ -1,0 +1,360 @@
+"""The live alerting half: evaluator, alert ledger, and drill recorder.
+
+:mod:`easydl_tpu.brain.alert_policy` decides; this module feeds it. An
+:class:`AlertEvaluator` owns one :class:`~AlertPolicy` over the loaded
+SLO specs and, each tick, folds a fleet metric snapshot into a bounded
+history window, runs the pure decision, and
+
+- appends the FULL (inputs, verdict) record to a spool-framed JSONL
+  ledger (``loop/spool.py`` framing: CRC-checked, torn-tail-safe — the
+  same machinery every other durable stream in the repo rides), which is
+  what :func:`replay_ledger` re-derives byte-identically offline;
+- exports ``easydl_alert_active{slo,severity}`` so the alert state is
+  itself a scrape-able series;
+- serves a ``/healthz`` rollup (:meth:`AlertEvaluator.healthz`) naming
+  each firing SLO and its runbook anchor — the thing a human reads
+  first.
+
+:class:`AlertRecorder` is the chaos harness' witness thread: during a
+drill it snapshots the harness process' own registry plus every
+subprocess exporter discovered under the drill workdir(s), feeds the
+evaluator, and on stop returns the evidence document the
+``detected_and_cleared`` invariant family judges — when the expected
+alert fired (TTD), whether it cleared, what paged, and whether the
+ledger replays byte-identically.
+
+The recorder also acts as the scrape-side janitor: a discovery doc
+whose scrape failed AND whose pid is provably dead is retired (the
+mirror of ``exporter._sweep_stale``, which only runs when a NEW exporter
+publishes into the same directory — after a whole-cell kill nothing
+ever publishes into the dead primary's workdir, and without the janitor
+the scrape-health alert could never clear). The failed scrape is always
+COUNTED first — detection before cleanup — and a SIGSTOPped (alive)
+target is never retired, so a zombie keeps failing scrapes until it
+wakes, exactly the alert shape a partition should have.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+from easydl_tpu.brain.alert_policy import (
+    AlertPolicy, decision_bytes, parse_selector, replay_decision_log,
+)
+from easydl_tpu.loop import spool
+from easydl_tpu.obs.exporter import OBS_DIR
+from easydl_tpu.obs.registry import MetricsRegistry, get_registry
+from easydl_tpu.utils.env import knob_float, knob_int
+
+log = logging.getLogger("easydl.alerts")
+
+#: ledger record kind byte (spool payloads lead with one)
+ALERT_RECORD = 7
+
+#: ledger segment filename suffix
+LEDGER_SUFFIX = ".alerts"
+
+
+def _relevant_families(specs: Sequence[Mapping[str, Any]]) -> frozenset:
+    from easydl_tpu.obs.slo import referenced_series
+
+    fams = set()
+    for spec in specs:
+        for sel in referenced_series(spec):
+            fams.add(parse_selector(sel)[0])
+    return frozenset(fams)
+
+
+class AlertEvaluator:
+    """Tick-driven: ``tick(samples, now)`` → the canonical decision.
+
+    Owns the history window (trimmed to the longest spec window plus
+    slack), the ledger writer, and the ``easydl_alert_active`` gauge.
+    Thread-compatible, not thread-safe — one caller ticks it."""
+
+    def __init__(self, specs: Sequence[Mapping[str, Any]],
+                 ledger_dir: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 segment_bytes: Optional[int] = None):
+        self.policy = AlertPolicy(specs)
+        self.runbooks = {str(s.get("name", "")): str(s.get("runbook", ""))
+                         for s in specs}
+        self._families = _relevant_families(specs)
+        self._history: List[Dict[str, Any]] = []
+        self._span_s = max(
+            [float(dict(s.get("windows") or {}).get("long_s", 6.0))
+             for s in specs] or [6.0]) + 2.0
+        self._writer: Optional[spool.SegmentWriter] = None
+        if ledger_dir:
+            self._writer = spool.SegmentWriter(
+                ledger_dir,
+                int(segment_bytes
+                    or knob_int("EASYDL_ALERT_LEDGER_SEGMENT_BYTES")),
+                sync_s=0.2, suffix=LEDGER_SUFFIX)
+        reg = registry or get_registry()
+        self._gauge = reg.gauge(
+            "easydl_alert_active",
+            "1 while the SLO's multiwindow burn-rate alert is firing.",
+            ("slo", "severity"))
+        self.last: Dict[str, Any] = {}
+
+    def tick(self, samples: Mapping[str, float], now: float
+             ) -> Dict[str, Any]:
+        restricted = {
+            key: float(v) for key, v in samples.items()
+            if key.partition("{")[0] in self._families}
+        self._history.append({"t": round(float(now), 6), "s": restricted})
+        lo = float(now) - self._span_s
+        self._history = [h for h in self._history if h["t"] >= lo]
+        decision = self.policy.evaluate(self._history, now)
+        if self._writer is not None:
+            record = self.policy.log[-1]
+            try:
+                self._writer.append(
+                    bytes([ALERT_RECORD]) + json.dumps(
+                        record, sort_keys=True,
+                        separators=(",", ":")).encode())
+            except spool.SpoolError as e:  # alerting outlives its ledger
+                log.warning("alert ledger append failed: %s", e)
+        for name, a in decision["alerts"].items():
+            self._gauge.set(1.0 if a["active"] else 0.0,
+                            slo=name, severity=a["severity"])
+        self.last = decision
+        return decision
+
+    def healthz(self) -> Dict[str, Any]:
+        """The /healthz rollup: every firing SLO with its severity and
+        runbook anchor (what start_exporter's health_fn serves)."""
+        alerts = dict(self.last.get("alerts") or {})
+        firing = [n for n in sorted(alerts) if alerts[n]["active"]]
+        return {
+            "alerts_ok": not firing,
+            "firing": [
+                {"slo": n, "severity": alerts[n]["severity"],
+                 "since": alerts[n]["since"],
+                 "runbook": self.runbooks.get(n, "")}
+                for n in firing],
+            "pages": [n for n in firing
+                      if alerts[n]["severity"] == "page"],
+        }
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+def read_ledger(directory: str) -> List[Dict[str, Any]]:
+    """Every decision record in the ledger, append order — the replay
+    gate's input. Torn tails stop the read (spool semantics); a torn
+    final record just shortens the log."""
+    out: List[Dict[str, Any]] = []
+    for name in spool.list_segments(directory, LEDGER_SUFFIX):
+        payloads, _, _ = spool.read_segment(os.path.join(directory, name))
+        for p in payloads:
+            if spool.record_kind(p) != ALERT_RECORD:
+                continue
+            try:
+                out.append(json.loads(p[1:].decode()))
+            except ValueError:
+                continue
+    return out
+
+
+def replay_ledger(directory: str) -> Dict[str, Any]:
+    """Offline byte-replay of a persisted ledger — every drill verdict
+    carries this result."""
+    return replay_decision_log(read_ledger(directory))
+
+
+def _is_zombie(pid: int) -> bool:
+    """True iff ``pid`` is a zombie (Linux: state field of
+    /proc/<pid>/stat, after the parenthesised comm which may itself
+    contain spaces). Unreadable/absent procfs reads as not-a-zombie."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        return stat.rpartition(b")")[2].split()[0] == b"Z"
+    except (OSError, IndexError):
+        return False
+
+
+class AlertRecorder:
+    """Background witness for chaos drills: scrape + evaluate on a
+    cadence, return the detection evidence on stop.
+
+    ``scan_dirs`` may grow mid-drill (the cell drill's primary/standby
+    subdirectories appear after start); each tick re-resolves the
+    callable."""
+
+    def __init__(self, scan_dirs: Callable[[], List[str]],
+                 specs: Sequence[Mapping[str, Any]],
+                 ledger_dir: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 interval_s: Optional[float] = None,
+                 scrape_timeout: float = 1.0):
+        self._scan_dirs = scan_dirs
+        self._registry = registry or get_registry()
+        self._interval = float(
+            interval_s if interval_s is not None
+            else knob_float("EASYDL_ALERT_EVAL_INTERVAL_S"))
+        self._timeout = float(scrape_timeout)
+        self.ledger_dir = ledger_dir
+        self.evaluator = AlertEvaluator(
+            specs, ledger_dir=ledger_dir, registry=self._registry)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.rounds = 0
+        #: [{"slo", "to", "t"}] — wall-stamped state changes
+        self.transitions: List[Dict[str, Any]] = []
+        self.scrape_stats = {"attempts": 0, "failures": 0}
+        self._swept: List[str] = []
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "AlertRecorder":
+        self._thread = threading.Thread(
+            target=self._run, name="alert-recorder", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> Dict[str, Any]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        with self._lock:
+            self._tick()  # final state AFTER recovery settled
+        self.evaluator.close()
+        return self.evidence()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            with self._lock:
+                try:
+                    self._tick()
+                except Exception as e:  # witness must never kill a drill
+                    log.warning("alert recorder tick failed: %s", e)
+
+    # ----------------------------------------------------------- one round
+    def _discover(self) -> Dict[str, Dict[str, Any]]:
+        from easydl_tpu.obs import scrape
+
+        docs: Dict[str, Dict[str, Any]] = {}
+        for d in self._scan_dirs():
+            for component, doc in scrape.discover_docs(d).items():
+                if doc.get("pid") == os.getpid():
+                    continue  # in-process registries are read directly
+                doc = dict(doc, _dir=os.path.join(d, OBS_DIR))
+                docs[f"{os.path.basename(d) or 'root'}/{component}"] = doc
+        return docs
+
+    def _sweep(self, doc: Mapping[str, Any]) -> None:
+        """Retire a failed target's discovery doc IFF its pid is dead —
+        the scrape-side mirror of exporter._sweep_stale (see module
+        docstring). Counting happened before this call."""
+        pid = doc.get("pid")
+        addr = str(doc.get("address", ""))
+        host = addr.rsplit(":", 1)[0] if ":" in addr else ""
+        if not isinstance(pid, int) or host not in ("127.0.0.1",
+                                                    "localhost"):
+            return
+        try:
+            os.kill(pid, 0)
+            # The pid exists — but a SIGKILLed child is a ZOMBIE until its
+            # parent reaps it, and a zombie holds no sockets: its exporter
+            # is gone for good. Waiting for the reap would keep the scrape
+            # failure counter climbing (and the scrape-health page pinned)
+            # for as long as the parent is busy. A live (maybe SIGSTOPped)
+            # process keeps failing instead — not swept.
+            if not _is_zombie(pid):
+                return
+        except ProcessLookupError:
+            pass
+        except OSError:
+            return
+        path = os.path.join(str(doc.get("_dir", "")),
+                            f"{doc.get('component')}.json")
+        try:
+            os.unlink(path)
+            self._swept.append(path)
+        except OSError:
+            pass
+
+    def _tick(self) -> None:
+        from easydl_tpu.obs import scrape
+
+        docs = self._discover()
+        targets = {key: str(doc.get("address", ""))
+                   for key, doc in docs.items() if doc.get("address")}
+        scraped = scrape.scrape_fleet(targets, timeout=self._timeout) \
+            if targets else {}
+        for key, result in scraped.items():
+            self.scrape_stats["attempts"] += 1
+            if not result.get("ok"):
+                self.scrape_stats["failures"] += 1
+                self._sweep(docs[key])
+        # In-process registry AFTER the scrape: this tick's scrape
+        # failure counters are visible to this tick's decision.
+        merged: Dict[str, float] = dict(self._registry.samples())
+        for key, result in sorted(scraped.items()):
+            if not result.get("ok"):
+                continue
+            for series, value in result["metrics"].items():  # type: ignore[union-attr]
+                if series in merged and scrape._is_additive(series):
+                    merged[series] += float(value)
+                else:
+                    merged[series] = float(value)
+        now = time.time()
+        decision = self.evaluator.tick(merged, now)
+        self.rounds += 1
+        for tr in decision["transitions"]:
+            self.transitions.append(dict(tr, t=round(now, 6)))
+
+    # ------------------------------------------------------------ evidence
+    def evidence(self) -> Dict[str, Any]:
+        firing: Dict[str, float] = {}
+        first_fire: Dict[str, float] = {}
+        cleared: Dict[str, bool] = {}
+        for tr in self.transitions:
+            slo = str(tr["slo"])
+            if tr["to"] == "firing":
+                first_fire.setdefault(slo, float(tr["t"]))
+                firing[slo] = float(tr["t"])
+                cleared[slo] = False
+            else:
+                cleared[slo] = True
+        alerts = dict(self.evaluator.last.get("alerts") or {})
+        pages = sorted({
+            str(tr["slo"]) for tr in self.transitions
+            if tr["to"] == "firing"
+            and alerts.get(str(tr["slo"]), {}).get("severity",
+                                                   "page") == "page"})
+        return {
+            "rounds": self.rounds,
+            "interval_s": self._interval,
+            "transitions": self.transitions,
+            "first_fire": {k: round(v, 6)
+                           for k, v in sorted(first_fire.items())},
+            "cleared": cleared,
+            "firing_final": sorted(
+                n for n, a in alerts.items() if a.get("active")),
+            "pages_fired": pages,
+            "decisions": len(self.evaluator.policy.log),
+            "replay": replay_ledger(self.ledger_dir),
+            "scrape": dict(self.scrape_stats, swept=list(self._swept)),
+        }
+
+
+def decision_digest(records: Sequence[Mapping[str, Any]]) -> str:
+    """Stable digest over a decision log's verdict bytes (fixture
+    pinning for the fleet-scale sim)."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for rec in records:
+        h.update(decision_bytes(rec.get("verdict") or {}))
+    return h.hexdigest()

@@ -14,9 +14,11 @@ import json
 import os
 import re
 import urllib.request
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from easydl_tpu.obs.exporter import OBS_DIR
+from easydl_tpu.utils.env import knob_int
 
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
@@ -73,6 +75,48 @@ def scrape_target(address: str, timeout: float = 5.0) -> Dict[str, object]:
     except Exception:
         pass  # metrics answered; health is advisory
     return doc
+
+
+def scrape_fleet(targets: Dict[str, str], timeout: float = 5.0,
+                 pool: Optional[int] = None) -> Dict[str, Dict[str, object]]:
+    """``{component: address}`` → ``{component: scrape_target(...)}``,
+    fetched CONCURRENTLY through a bounded worker pool (default
+    ``EASYDL_SCRAPE_POOL``). Serial scraping does not survive scale: a
+    100-replica fleet with one dead exporter at the 5 s per-target
+    timeout turns every snapshot into minutes of wall clock, which is
+    exactly when the snapshot matters most.
+
+    Every attempt increments ``easydl_scrape_attempts_total{target}`` in
+    this process' registry and every failed one
+    ``easydl_scrape_failures_total{target}`` — a dead exporter is itself
+    a detectable signal (the ``fleet_scrape_health`` SLO pages on the
+    failure counter's burn, which is how process-kill drills are
+    detected at all)."""
+    from easydl_tpu.obs.registry import get_registry
+
+    reg = get_registry()
+    attempts = reg.counter(
+        "easydl_scrape_attempts_total",
+        "Fleet scrape attempts by target component.", ("target",))
+    failures = reg.counter(
+        "easydl_scrape_failures_total",
+        "Fleet scrape attempts that got no /metrics answer, by target "
+        "component.", ("target",))
+    workers = max(1, int(pool if pool is not None
+                         else knob_int("EASYDL_SCRAPE_POOL")))
+    items = sorted(targets.items())
+    out: Dict[str, Dict[str, object]] = {}
+    if not items:
+        return out
+    with ThreadPoolExecutor(max_workers=min(workers, len(items))) as ex:
+        docs = ex.map(lambda kv: scrape_target(kv[1], timeout=timeout),
+                      items)
+        for (component, _), doc in zip(items, docs):
+            attempts.inc(target=component)
+            if not doc.get("ok"):
+                failures.inc(target=component)
+            out[component] = doc
+    return out
 
 
 def discover_docs(workdir: str) -> Dict[str, dict]:
@@ -138,11 +182,11 @@ def merge_snapshot(
             all_targets[component] = (addr, key)
     for component, addr in (targets or {}).items():
         all_targets[component] = (addr, ("target", component))
-    services: Dict[str, object] = {}
+    services = scrape_fleet(
+        {c: addr for c, (addr, _) in all_targets.items()}, timeout=timeout)
     by_source: Dict[tuple, Dict[str, float]] = {}
-    for component, (address, key) in sorted(all_targets.items()):
-        doc = scrape_target(address, timeout=timeout)
-        services[component] = doc
+    for component, (_, key) in sorted(all_targets.items()):
+        doc = services[component]
         if doc["ok"]:
             by_source.setdefault(key, {}).update(doc["metrics"])  # type: ignore[arg-type]
     merged: Dict[str, float] = {}

@@ -1,0 +1,237 @@
+"""Declarative SLO specs: ``slos/*.yaml`` → validated alert-policy input.
+
+An SLO here is DATA, not code (the same stance as ``scenarios/*.yaml``):
+one YAML document binds an objective to *already-exported* metric series
+and declares how it pages — which pure :mod:`easydl_tpu.brain.alert_policy`
+objective shape evaluates it, over which long/short burn windows, at
+which severity, and which ``docs/operations.md`` runbook section the
+page should name. :func:`load_slo_file` validates the document — every
+error names the file and the offending field — and compiles it into the
+canonical plain-JSON spec dict the pure policy (and its byte-replay)
+consumes.
+
+A typoed series name would be a silent never-fires alert, which is why
+two independent layers reject it: the easylint ``slo-metric-refs`` rule
+(analysis/rules/slo_refs.py) gates the tree against the registered
+metric-name inventory, and :func:`load_slo_doc` re-checks at load time
+when given a registry (the live evaluator always passes one).
+
+Numeric bounds may come from the environment instead of the file:
+``bound_knob: EASYDL_CELL_LAG_SLO_BYTES`` resolves through the declared
+knob registry at load time, so the alert threshold and the shipper's
+pacing target can never drift apart. Only knobs named in
+:data:`BOUND_KNOBS` are resolvable — an arbitrary env read from a data
+file would bypass the knob declaration discipline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+import yaml
+
+from easydl_tpu.brain.alert_policy import SEVERITIES, parse_selector
+from easydl_tpu.utils.env import knob_int, knob_str
+
+#: repo-relative default SLO directory (overridable via EASYDL_SLO_DIR)
+SLOS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "slos")
+
+#: knobs an SLO may bind a bound to — each entry resolves through the
+#: declared accessor with a literal name (the knob-discipline lint).
+BOUND_KNOBS: Dict[str, Any] = {
+    "EASYDL_CELL_LAG_SLO_BYTES":
+        lambda: float(knob_int("EASYDL_CELL_LAG_SLO_BYTES")),
+}
+
+_OBJECTIVE_KEYS = {
+    "ratio": {"type", "bad", "total", "budget"},
+    "bound": {"type", "series", "op", "bound", "bound_knob", "ignore_zero"},
+    "increase": {"type", "series", "max_increase"},
+}
+
+
+class SloSpecError(ValueError):
+    """An SLO document failed validation; the message names the file
+    (when known) and the offending field."""
+
+
+def _require(doc: Mapping[str, Any], key: str, where: str) -> Any:
+    if key not in doc:
+        raise SloSpecError(f"{where}: missing required key {key!r}")
+    return doc[key]
+
+
+def _check_keys(doc: Mapping[str, Any], allowed: set, where: str) -> None:
+    unknown = sorted(set(doc) - allowed)
+    if unknown:
+        raise SloSpecError(
+            f"{where}: unknown key(s) {unknown}; allowed: {sorted(allowed)}")
+
+
+def _selector(value: Any, where: str) -> str:
+    sel = str(value)
+    name, labels = parse_selector(sel)
+    if not name or not name.startswith("easydl_"):
+        raise SloSpecError(
+            f"{where}: series selector {sel!r} must name an easydl_* "
+            "metric family")
+    for k, v in labels.items():
+        if not k or not v:
+            raise SloSpecError(
+                f"{where}: selector {sel!r} has an empty label "
+                "name or value")
+    return sel
+
+
+def referenced_series(spec: Mapping[str, Any]) -> List[str]:
+    """Every series selector the spec binds to — what the lint rule and
+    the load-time registry check validate."""
+    obj = dict(spec.get("objective") or {})
+    keys = ("bad", "total") if obj.get("type") == "ratio" else ("series",)
+    return [str(obj[k]) for k in keys if obj.get(k)]
+
+
+def load_slo_doc(doc: Mapping[str, Any], where: str = "<doc>",
+                 known_metrics: Optional[frozenset] = None
+                 ) -> Dict[str, Any]:
+    """Validate + compile one parsed document into the canonical spec."""
+    if not isinstance(doc, Mapping):
+        raise SloSpecError(f"{where}: document must be a mapping")
+    _check_keys(doc, {"name", "description", "severity", "runbook",
+                      "objective", "windows", "burn_threshold"}, where)
+    name = str(_require(doc, "name", where))
+    severity = str(_require(doc, "severity", where))
+    if severity not in SEVERITIES:
+        raise SloSpecError(
+            f"{where}: severity {severity!r} must be one of "
+            f"{list(SEVERITIES)}")
+    runbook = str(_require(doc, "runbook", where))
+    if "#" not in runbook:
+        raise SloSpecError(
+            f"{where}: runbook {runbook!r} must be a doc anchor "
+            "(docs/operations.md#section) — a page without a runbook "
+            "link is half an alert")
+    obj = dict(_require(doc, "objective", where))
+    kind = str(_require(obj, "type", f"{where}.objective"))
+    if kind not in _OBJECTIVE_KEYS:
+        raise SloSpecError(
+            f"{where}.objective: unknown type {kind!r} (known: "
+            f"{sorted(_OBJECTIVE_KEYS)})")
+    _check_keys(obj, _OBJECTIVE_KEYS[kind], f"{where}.objective")
+    out_obj: Dict[str, Any] = {"type": kind}
+    if kind == "ratio":
+        out_obj["bad"] = _selector(_require(obj, "bad", f"{where}.objective"),
+                                   f"{where}.objective.bad")
+        out_obj["total"] = _selector(
+            _require(obj, "total", f"{where}.objective"),
+            f"{where}.objective.total")
+        budget = float(_require(obj, "budget", f"{where}.objective"))
+        if not 0.0 < budget <= 1.0:
+            raise SloSpecError(
+                f"{where}.objective.budget: {budget} must be in (0, 1] — "
+                "it is the allowed bad fraction")
+        out_obj["budget"] = budget
+    else:
+        out_obj["series"] = _selector(
+            _require(obj, "series", f"{where}.objective"),
+            f"{where}.objective.series")
+    if kind == "bound":
+        op = str(obj.get("op", "gt"))
+        if op not in ("gt", "lt"):
+            raise SloSpecError(
+                f"{where}.objective.op: {op!r} must be gt or lt")
+        out_obj["op"] = op
+        knob = obj.get("bound_knob")
+        if knob is not None:
+            if str(knob) not in BOUND_KNOBS:
+                raise SloSpecError(
+                    f"{where}.objective.bound_knob: {knob!r} is not a "
+                    f"bindable knob (known: {sorted(BOUND_KNOBS)})")
+            if "bound" in obj:
+                raise SloSpecError(
+                    f"{where}.objective: bound and bound_knob are "
+                    "mutually exclusive")
+            out_obj["bound"] = float(BOUND_KNOBS[str(knob)]())
+            out_obj["bound_knob"] = str(knob)
+        else:
+            out_obj["bound"] = float(_require(obj, "bound",
+                                              f"{where}.objective"))
+        if obj.get("ignore_zero") is not None:
+            out_obj["ignore_zero"] = bool(obj["ignore_zero"])
+    if kind == "increase":
+        out_obj["max_increase"] = float(obj.get("max_increase", 0.0))
+    windows = dict(doc.get("windows") or {})
+    _check_keys(windows, {"long_s", "short_s"}, f"{where}.windows")
+    long_s = float(windows.get("long_s", 6.0))
+    short_s = float(windows.get("short_s", 1.5))
+    if not 0.0 < short_s < long_s:
+        raise SloSpecError(
+            f"{where}.windows: need 0 < short_s < long_s, got "
+            f"short_s={short_s} long_s={long_s} — multiwindow burn "
+            "alerting degenerates without both")
+    threshold = float(doc.get("burn_threshold", 1.0))
+    if threshold <= 0.0:
+        raise SloSpecError(
+            f"{where}.burn_threshold: {threshold} must be > 0 — a zero "
+            "threshold pages on a healthy fleet")
+    spec = {
+        "name": name,
+        "severity": severity,
+        "runbook": runbook,
+        "objective": out_obj,
+        "windows": {"long_s": long_s, "short_s": short_s},
+        "burn_threshold": threshold,
+    }
+    if known_metrics is not None:
+        for sel in referenced_series(spec):
+            family, _ = parse_selector(sel)
+            if family not in known_metrics:
+                raise SloSpecError(
+                    f"{where}: series {family!r} is not a registered "
+                    "metric name — a typoed series is a silent "
+                    "never-fires alert")
+    return spec
+
+
+def load_slo_file(path: str,
+                  known_metrics: Optional[frozenset] = None
+                  ) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    return load_slo_doc(doc, where=os.path.basename(path),
+                        known_metrics=known_metrics)
+
+
+def slos_dir() -> str:
+    """The active SLO directory: EASYDL_SLO_DIR when set, else the
+    repo's ``slos/``."""
+    return knob_str("EASYDL_SLO_DIR") or SLOS_DIR
+
+
+def list_slo_files(directory: Optional[str] = None) -> List[str]:
+    d = directory or slos_dir()
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return []
+    return [os.path.join(d, n) for n in names
+            if n.endswith((".yaml", ".yml"))]
+
+
+def load_all(directory: Optional[str] = None,
+             known_metrics: Optional[frozenset] = None
+             ) -> List[Dict[str, Any]]:
+    """Name-sorted specs for every file in the directory; duplicate
+    names across files are an error (one alert namespace)."""
+    by_name: Dict[str, Dict[str, Any]] = {}
+    for path in list_slo_files(directory):
+        spec = load_slo_file(path, known_metrics=known_metrics)
+        if spec["name"] in by_name:
+            raise SloSpecError(
+                f"{os.path.basename(path)}: duplicate SLO name "
+                f"{spec['name']!r}")
+        by_name[spec["name"]] = spec
+    return [by_name[n] for n in sorted(by_name)]

@@ -580,6 +580,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             f.flush()
             os.fsync(f.fileno())
 
+    from easydl_tpu.obs import get_registry, start_exporter
+    exporter = start_exporter(component=args.name, registry=get_registry(),
+                              workdir=args.workdir)
     client = None
     if args.rows_npz:
         row_reader = _npz_row_reader(args.rows_npz, args.dim)
@@ -621,6 +624,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                final_version=final or 0, rows=len(builder.index))
         if client is not None:
             client.close()
+        # clean exits deregister; a SIGKILLed builder leaves its
+        # discovery doc behind for the fleet_scrape_health SLO to see.
+        if exporter is not None:
+            exporter.stop()
     return 0
 
 

@@ -14,6 +14,9 @@ Entry points: :func:`easydl_tpu.sim.simulator.simulate` in-process, or
 ``python scripts/policy_replay.py`` from a shell / chaos_smoke.sh.
 """
 
+from easydl_tpu.sim.alerts import (  # noqa: F401
+    simulate_alerts, synthetic_alert_fleet,
+)
 from easydl_tpu.sim.multijob import (  # noqa: F401
     simulate_tenants, synthetic_tenant_contention,
     synthetic_tenant_starvation,

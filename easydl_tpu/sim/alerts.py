@@ -1,0 +1,272 @@
+"""Fleet-scale offline replay of the alert policy (ISSUE 19).
+
+A timeline carries ``meta.alert_profile``: an O(100)-tenant serve fleet
+shape (per-tenant request counters + p99 gauges, a deterministic fault
+window in which every ``sick_every``-th tenant starts shedding most of
+its traffic) plus the SLO documents under test.
+:func:`simulate_alerts` drives the REAL
+:class:`easydl_tpu.brain.alert_policy.AlertPolicy` — the same stateful
+wrapper the live :class:`easydl_tpu.obs.alerts.AlertEvaluator` owns —
+over that synthetic history on a virtual clock: no wall time, no RNG,
+every sample a closed-form function of the tick. The invariants judged:
+
+- ``alert_fired`` — every expected SLO fires within its virtual TTD
+  budget of the fault onset AND clears after recovery (detection that
+  never clears is a stuck page, not detection);
+- ``alert_quiet`` — SLOs the fault does not implicate stay silent for
+  the whole run;
+- ``alert_no_false_fire`` — nothing fires BEFORE the fault: a policy
+  that pages a healthy fleet is mis-tuned, and the ``*_negative``
+  catalog entry (budget squeezed under the healthy shed rate) is
+  exactly that shape — this check must CATCH it;
+- ``alert_replay_identical`` — every logged decision re-derives
+  byte-identically through the pure function (the same gate every live
+  drill's ``detected_and_cleared`` verdict rides).
+
+Same timeline + same override ⇒ byte-identical verdict (chaos_smoke.sh
+replays the committed fixture twice and compares bytes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+from easydl_tpu.brain.alert_policy import AlertPolicy, replay_decision_log
+from easydl_tpu.obs.slo import load_slo_doc
+
+
+def _r6(x: float) -> float:
+    return round(float(x), 6)
+
+
+#: The SLO documents the synthetic fleet is judged against — the same
+#: document grammar slos/*.yaml uses, windows sized for the sim's 1 s
+#: tick. ``fleet_error_burn`` is the must-stay-quiet coverage: the
+#: synthetic fleet never emits error verdicts, so any burn on it is a
+#: policy bug, not a fleet event.
+_FLEET_SLOS: List[Dict[str, Any]] = [
+    {"name": "fleet_shed_ratio", "severity": "page",
+     "runbook": "docs/operations.md#17-serve-fleet-runbook",
+     "objective": {
+         "type": "ratio",
+         "bad": 'easydl_serve_router_requests_total{verdict="shed"}',
+         "total": "easydl_serve_router_requests_total",
+         "budget": 0.05},
+     "windows": {"long_s": 10.0, "short_s": 3.0},
+     "burn_threshold": 1.0},
+    {"name": "fleet_p99", "severity": "ticket",
+     "runbook": "docs/operations.md#17-serve-fleet-runbook",
+     "objective": {
+         "type": "bound",
+         "series": "easydl_serve_router_p99_seconds_recent",
+         "op": "gt", "bound": 2.5},
+     "windows": {"long_s": 10.0, "short_s": 3.0},
+     "burn_threshold": 0.5},
+    {"name": "fleet_error_burn", "severity": "ticket",
+     "runbook": "docs/operations.md#11-troubleshooting",
+     "objective": {
+         "type": "ratio",
+         "bad": 'easydl_serve_router_requests_total{verdict="error"}',
+         "total": "easydl_serve_router_requests_total",
+         "budget": 0.25},
+     "windows": {"long_s": 10.0, "short_s": 3.0},
+     "burn_threshold": 1.0},
+]
+
+
+def synthetic_alert_fleet(n_tenants: int = 100,
+                          duration_s: float = 60.0,
+                          tick_s: float = 1.0,
+                          fault_at_s: float = 20.0,
+                          recover_at_s: float = 38.0,
+                          sick_every: int = 7) -> Dict[str, Any]:
+    """The fleet storm shape: ``n_tenants`` healthy serve tenants (1%
+    shed, 20 ms p99); inside the fault window every ``sick_every``-th
+    tenant sheds 80% of its traffic and its p99 jumps to 5 s. Aggregate
+    shed ratio lands ~12% against the 5% budget — loud, but only from
+    the sick cohort, so the policy must detect it from fleet-summed
+    window deltas, not any single series."""
+    from easydl_tpu.sim.timeline import make_timeline
+
+    profile = {
+        "tenants": int(n_tenants),
+        "duration_s": _r6(duration_s),
+        "tick_s": _r6(tick_s),
+        "fault_at_s": _r6(fault_at_s),
+        "recover_at_s": _r6(recover_at_s),
+        "sick_every": int(sick_every),
+        "slos": [dict(s) for s in _FLEET_SLOS],
+    }
+    return make_timeline("alert_fleet_storm", agents={}, faults=[],
+                         meta={"alert_profile": profile})
+
+
+def _overlap(t: float, lo: float, hi: float) -> float:
+    return max(0.0, min(t, hi) - lo)
+
+
+def _fleet_samples(profile: Mapping[str, Any], t: float) -> Dict[str, float]:
+    """Every tenant's exported samples at virtual time ``t`` — counters
+    are closed-form integrals of the piecewise rates, so any tick is
+    computable without simulating the ones before it."""
+    n = int(profile.get("tenants", 0))
+    fault_at = float(profile.get("fault_at_s", 0.0))
+    recover_at = float(profile.get("recover_at_s", 0.0))
+    sick_every = max(1, int(profile.get("sick_every", 1)))
+    out: Dict[str, float] = {}
+    for i in range(n):
+        job = f"t{i:03d}"
+        sick_now = i % sick_every == 0 and fault_at <= t < recover_at
+        sick_s = _overlap(t, fault_at, recover_at) \
+            if i % sick_every == 0 else 0.0
+        healthy_s = t - sick_s
+        # healthy: 100 ok/s + 1 shed/s; sick: 20 ok/s + 80 shed/s
+        ok = 100.0 * healthy_s + 20.0 * sick_s
+        shed = 1.0 * healthy_s + 80.0 * sick_s
+        out[f'easydl_serve_router_requests_total'
+            f'{{job="{job}",verdict="ok"}}'] = _r6(ok)
+        out[f'easydl_serve_router_requests_total'
+            f'{{job="{job}",verdict="shed"}}'] = _r6(shed)
+        p99 = 5.0 if sick_now else 0.02 + (i % 5) * 0.001
+        out[f'easydl_serve_router_p99_seconds_recent'
+            f'{{job="{job}"}}'] = _r6(p99)
+    return out
+
+
+def _compile_specs(profile: Mapping[str, Any],
+                   config_override: Optional[Mapping[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+    """Validate every profile SLO through the real loader;
+    ``config_override`` (the negative controls' lever) rewrites the
+    objective budget / bound / burn threshold before compilation."""
+    specs: List[Dict[str, Any]] = []
+    override = dict(config_override or {})
+    for doc in profile.get("slos", []):
+        d = {k: (dict(v) if isinstance(v, Mapping) else v)
+             for k, v in dict(doc).items()}
+        obj = dict(d.get("objective") or {})
+        if "budget" in override and obj.get("type") == "ratio":
+            obj["budget"] = float(override["budget"])
+        if "bound" in override and obj.get("type") == "bound":
+            obj["bound"] = float(override["bound"])
+        d["objective"] = obj
+        if "burn_threshold" in override:
+            d["burn_threshold"] = float(override["burn_threshold"])
+        specs.append(load_slo_doc(d, where=str(d.get("name", "<sim>"))))
+    return specs
+
+
+def check_alerts(result: Mapping[str, Any], expect: Dict[str, Any],
+                 profile: Mapping[str, Any]) -> Dict[str, Any]:
+    """The invariant half — stated over the transition timeline, the
+    decision log and the fault window the profile declares."""
+    checks: Dict[str, Dict[str, Any]] = {}
+    transitions = list(result.get("transitions", []))
+    decisions = list(result.get("decision_log", []))
+    fault_at = float(profile.get("fault_at_s", 0.0))
+
+    def _fires(slo: str) -> List[float]:
+        return [float(tr["t"]) for tr in transitions
+                if tr["slo"] == slo and tr["to"] == "firing"]
+
+    def _clears_after(slo: str, t0: float) -> bool:
+        return any(tr["slo"] == slo and tr["to"] == "clear"
+                   and float(tr["t"]) >= t0 for tr in transitions)
+
+    for slo, budget in dict(expect.get("fired") or {}).items():
+        fires = _fires(slo)
+        ttd = _r6(fires[0] - fault_at) if fires else None
+        checks[f"alert_fired:{slo}"] = {
+            "ok": (bool(fires) and ttd is not None
+                   and 0.0 <= ttd <= float(budget)
+                   and _clears_after(slo, fires[0])),
+            "ttd_s": ttd, "ttd_budget_s": _r6(float(budget)),
+            "fired": bool(fires),
+            "cleared": bool(fires) and _clears_after(slo, fires[0]),
+        }
+
+    for slo in list(expect.get("quiet") or []):
+        fires = _fires(slo)
+        checks[f"alert_quiet:{slo}"] = {
+            "ok": not fires, "fired_at": fires[:3],
+        }
+
+    if expect.get("no_false_fire"):
+        early = [dict(tr) for tr in transitions
+                 if tr["to"] == "firing" and float(tr["t"]) < fault_at]
+        checks["alert_no_false_fire"] = {
+            "ok": not early, "fault_at_s": _r6(fault_at),
+            "early": early[:5],
+        }
+
+    min_decisions = int(expect.get("min_decisions", 1))
+    rep = replay_decision_log(decisions)
+    checks["alert_replay_identical"] = {
+        "ok": bool(rep["identical"]) and rep["decisions"] >= min_decisions,
+        "decisions": rep["decisions"],
+        "min_decisions": min_decisions,
+        "mismatches": rep["mismatches"],
+    }
+
+    return {"passed": all(c["ok"] for c in checks.values()) and bool(checks),
+            "checks": checks}
+
+
+def simulate_alerts(timeline: Mapping[str, Any],
+                    config_override: Optional[Mapping[str, Any]] = None,
+                    expect: Optional[Mapping[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """Replay the fleet profile through the real AlertPolicy on the
+    virtual clock. The subject is the DECISION sequence — the same
+    (inputs, verdict) records the live evaluator's ledger persists, so
+    the byte-replay gate is identical in both worlds."""
+    profile = dict(dict(timeline.get("meta", {})).get("alert_profile") or {})
+    if not profile:
+        raise ValueError("timeline has no meta.alert_profile")
+    specs = _compile_specs(profile, config_override)
+    policy = AlertPolicy(specs)
+    duration = float(profile.get("duration_s", 60.0))
+    tick = max(1e-3, float(profile.get("tick_s", 1.0)))
+    long_max = max(
+        [float(dict(s.get("windows") or {}).get("long_s", 6.0))
+         for s in specs] or [6.0])
+
+    history: List[Dict[str, Any]] = []
+    transitions: List[Dict[str, Any]] = []
+    pages_fired: List[str] = []
+    now = 0.0
+    while now <= duration:
+        history.append({"t": _r6(now), "s": _fleet_samples(profile, now)})
+        cutoff = now - long_max - 2.0 * tick
+        while history and float(history[0]["t"]) < cutoff:
+            history.pop(0)
+        decision = policy.evaluate(history, now)
+        for tr in decision["transitions"]:
+            transitions.append({"t": _r6(now), "slo": tr["slo"],
+                                "to": tr["to"]})
+            if tr["to"] == "firing" \
+                    and decision["alerts"][tr["slo"]]["severity"] == "page":
+                pages_fired.append(tr["slo"])
+        now = _r6(now + tick)
+
+    result: Dict[str, Any] = {
+        "name": str(timeline.get("name", "alerts")),
+        "kind": "alert_replay",
+        "tenants": int(profile.get("tenants", 0)),
+        "slos": sorted(str(s.get("name")) for s in specs),
+        "decision_log": policy.log,
+        "decisions": len(policy.log),
+        "transitions": transitions,
+        "pages_fired": sorted(set(pages_fired)),
+        "firing_final": list(policy.log[-1]["verdict"]["firing"]) \
+            if policy.log else [],
+        "events_simulated": len(policy.log),
+        "sim_end_t": _r6(min(now, duration)),
+        "reshapes": [],
+    }
+    if expect is not None:
+        verdict = check_alerts(result, dict(expect), profile)
+        result["expect"] = dict(expect)
+        result["invariants"] = verdict
+        result["passed"] = verdict["passed"]
+    return result

@@ -250,6 +250,28 @@ KNOB_DECLS = (
      "GCS base URL override (fake server / proxy)."),
     ("EASYDL_GCE_METADATA_URL", "str", "",
      "GCE metadata server override (tests, proxies)."),
+    # -- SLOs / alerting (obs/slo.py, obs/alerts.py) ----------------------
+    ("EASYDL_SLO_DIR", "str", "",
+     "SLO spec directory the alert evaluator loads; '' = the repo's "
+     "slos/."),
+    ("EASYDL_ALERT_EVAL_INTERVAL_S", "float", 0.5,
+     "Alert evaluator cadence: one fleet snapshot + one pure burn-rate "
+     "decision per tick."),
+    ("EASYDL_ALERT_LEDGER_SEGMENT_BYTES", "int", 4_194_304,  # 4 MiB
+     "Alert-decision ledger (spool-framed JSONL) segment roll size."),
+    ("EASYDL_ALERT_TTD_BUDGET_S", "float", 15.0,
+     "Default time-to-detect budget a drill's expected alert must fire "
+     "within (per-scenario expect.detect.ttd_budget_s overrides)."),
+    ("EASYDL_ALERT_DRILL_RECORD", "bool", True,
+     "Chaos harness records the alert timeline during every drill "
+     "(detected_and_cleared evidence); off skips the recorder thread."),
+    ("EASYDL_ALERT_SETTLE_S", "float", 12.0,
+     "Max seconds teardown waits for a drill's expected alert to clear "
+     "before stopping the recorder (the clear half of "
+     "detected_and_cleared needs one clean long window)."),
+    ("EASYDL_SCRAPE_POOL", "int", 8,
+     "Bounded worker pool for concurrent fleet scrapes "
+     "(obs.scrape.scrape_fleet)."),
     # -- chaos / harness child markers ------------------------------------
     ("EASYDL_CHAOS_SPEC", "str", "",
      "Armed chaos scenario spec path; unset = every hook is one dict "

@@ -22,6 +22,13 @@
 # The cell-failover verdict likewise: at least one shipped WAL segment
 # replayed on the standby, every fenced late push refused, and digest
 # parity against the acked ledger — else the cross-cell path never ran.
+#
+# The detection loop (ISSUE 19) gates every drill the same way: a verdict
+# whose scenario declares an expected alert must carry a PASSING
+# detected_and_cleared check (alert fired within the TTD budget, cleared
+# after recovery, decision ledger byte-replayed); the fault-free control
+# must carry no_false_pages with ZERO pages; and the per-drill measured
+# TTDs aggregate into DETECT.json via scripts/slo_report.py --detect.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -51,7 +58,8 @@ env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
     --scenario rollout_half_update \
     --scenario retrieval_replica_death_mid_index_update \
     --scenario multi_tenant_contention \
-    --scenario cell_failover --keep-workdir "$@" \
+    --scenario cell_failover \
+    --scenario fault_free_control --keep-workdir "$@" \
     2>&1 | tee "$LOG"
 
 # Verdict files from THIS run (chaos_run prints "PASS <name> ... -> <path>").
@@ -59,6 +67,44 @@ VERDICTS=$(awk '/^(PASS|FAIL) .* -> .*\.json$/{print $NF}' "$LOG")
 test -n "$VERDICTS" || { echo "chaos_smoke: no verdicts found" >&2; exit 1; }
 
 for verdict in $VERDICTS; do
+    # Detection gate (every drill): a scenario that declares an expected
+    # alert must carry a PASSING detected_and_cleared check — a verdict
+    # with the expectation but no check means the drill ran without its
+    # alerting witness, and the smoke refuses to count it. The fault-free
+    # control must carry no_false_pages with ZERO page-severity alerts.
+    # Either way the recorded alert-decision ledger must have re-derived
+    # byte-identically (replay_identical) — non-reproducible detection is
+    # no detection.
+    python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+expect = doc.get("expect") or {}
+checks = (doc.get("invariants") or {}).get("checks") or {}
+if expect.get("detect"):
+    det = checks.get("detected_and_cleared")
+    assert det is not None, (
+        f"{sys.argv[1]}: scenario declares expect.detect but the verdict "
+        "carries NO detected_and_cleared check — the drill ran without "
+        "its alerting witness, the detection claim is vacuous")
+    assert det.get("ok"), (
+        f"{sys.argv[1]}: detected_and_cleared FAILED: {det}")
+    assert det.get("replay_identical") and det.get("replay_decisions", 0) > 0, (
+        f"{sys.argv[1]}: alert decision ledger did not byte-replay: {det}")
+    print(f"detect OK: {det['alert']} fired ttd={det['ttd_s']}s "
+          f"(budget {det['ttd_budget_s']}s), cleared, "
+          f"{det['replay_decisions']} decisions byte-replayed")
+if expect.get("detect_none"):
+    ctl = checks.get("no_false_pages")
+    assert ctl is not None, (
+        f"{sys.argv[1]}: fault-free control carries NO no_false_pages "
+        "check — the negative control never armed its witness")
+    assert ctl.get("ok") and not ctl.get("pages_fired"), (
+        f"{sys.argv[1]}: the fault-free control PAGED: {ctl}")
+    assert ctl.get("replay_identical") and ctl.get("replay_decisions", 0) > 0, (
+        f"{sys.argv[1]}: control alert ledger did not byte-replay: {ctl}")
+    print(f"control OK: {ctl['rounds']} rounds, ZERO pages, "
+          f"{ctl['replay_decisions']} decisions byte-replayed")
+PY
     case "$verdict" in
     *ps_shard_crash_zero_loss*)
         python - "$verdict" <<'PY'
@@ -274,12 +320,24 @@ PY
     rm -rf "$wd"   # kept only for the export; drop after the check
 done
 
+# Aggregate the measured per-drill TTDs into the committed detection
+# report — itself a gate: a drill whose expectation declares detection
+# but whose verdict carries no check, or a control that paged, makes the
+# aggregator exit non-zero.
+env JAX_PLATFORMS=cpu python scripts/slo_report.py --detect $VERDICTS \
+    --out DETECT.json
+
+# The tier-1 SLO pulse, run here too so a catalog rot fails the smoke
+# even when the drills themselves pass.
+env JAX_PLATFORMS=cpu python scripts/slo_report.py --smoke
+
 # Offline policy replay gate: every committed simulator fixture (recorded
 # chaos timelines AND the mesh-shape autoscale surface — fixtures with a
 # meta.shape_profile replay through the real MeshShapePolicy with the
 # mesh_shape_converged invariant) plus the synthetic catalog (incl. the
 # mis-tuned negative controls: hair-trigger straggler, too-short preempt
-# grace, pinned-pathological mesh shape) must pass its policy invariants,
+# grace, pinned-pathological mesh shape, alert budget squeezed under the
+# healthy shed baseline) must pass its policy invariants,
 # and each fixture replay must be byte-identical across back-to-back runs
 # — the simulator's determinism contract, checked where the drills that
 # feed it live.

@@ -47,10 +47,10 @@ from easydl_tpu.brain.straggler import StragglerConfig  # noqa: E402
 from easydl_tpu.core.mesh_shapes import MeshConstraints  # noqa: E402
 from easydl_tpu.sim import (  # noqa: E402
     MeshSimConfig, SimPolicy, load_fixture, load_workdir, save_fixture,
-    simulate, simulate_rollout, simulate_tenants, synthetic_autoscale,
-    synthetic_mesh_autoscale, synthetic_preempt, synthetic_rollout_pacing,
-    synthetic_straggler, synthetic_tenant_contention,
-    synthetic_tenant_starvation,
+    simulate, simulate_alerts, simulate_rollout, simulate_tenants,
+    synthetic_alert_fleet, synthetic_autoscale, synthetic_mesh_autoscale,
+    synthetic_preempt, synthetic_rollout_pacing, synthetic_straggler,
+    synthetic_tenant_contention, synthetic_tenant_starvation,
 )
 
 #: the default drill policy for replays: matches the live chaos drills'
@@ -118,6 +118,10 @@ def _is_tenant(timeline: Dict[str, Any]) -> bool:
     return bool(dict(timeline.get("meta", {})).get("tenant_profile"))
 
 
+def _is_alert(timeline: Dict[str, Any]) -> bool:
+    return bool(dict(timeline.get("meta", {})).get("alert_profile"))
+
+
 #: expectations for the multi-tenant contention scenario/fixture: the
 #: high-priority scale-up is satisfied BY preemption (anti-vacuous floor),
 #: every floor holds throughout, no chip ping-pongs, and the decision log
@@ -126,6 +130,17 @@ _TENANT_EXPECT: Dict[str, Any] = {
     "priorities_honored": True, "no_starvation": True, "no_thrash": True,
     "final_allocations": {"hi": 3, "mid": 1, "lo": 1},
     "min_preemptions": 2, "max_moves": 5,
+}
+
+#: expectations for the alert-fleet scenario/fixture (ISSUE 19): both
+#: implicated SLOs fire within their virtual TTD budgets and clear after
+#: recovery, the untouched SLO stays quiet, NOTHING fires on the healthy
+#: fleet before the fault, and every decision byte-replays.
+_ALERT_EXPECT: Dict[str, Any] = {
+    "fired": {"fleet_shed_ratio": 15.0, "fleet_p99": 15.0},
+    "quiet": ["fleet_error_burn"],
+    "no_false_fire": True,
+    "min_decisions": 30,
 }
 
 
@@ -231,6 +246,23 @@ def _scenarios() -> Dict[str, Tuple[Any, SimPolicy, Dict[str, Any]]]:
             {"priorities_honored": True, "no_starvation": True,
              "no_thrash": True},
         ),
+        # Alert policy over an O(100)-tenant serve fleet (ISSUE 19): a
+        # sick cohort sheds 80% of its traffic mid-run; the burn-rate
+        # policy must fire both implicated SLOs within budget, clear
+        # them after recovery, and byte-replay every decision.
+        "alert_fleet_storm": (
+            synthetic_alert_fleet(),
+            None,
+            dict(_ALERT_EXPECT),
+        ),
+        # Negative control: the shed budget squeezed below the HEALTHY
+        # fleet's 1% baseline — a policy that pages a healthy fleet is
+        # mis-tuned, and alert_no_false_fire must CATCH it.
+        "alert_fleet_storm_negative": (
+            synthetic_alert_fleet(),
+            {"budget": 0.002},
+            dict(_ALERT_EXPECT),
+        ),
     }
 
 
@@ -247,6 +279,8 @@ def _policy_and_expect_for(timeline: Dict[str, Any]
         return None, dict(_ROLLOUT_EXPECT)
     if _is_tenant(timeline):
         return None, dict(_TENANT_EXPECT)
+    if _is_alert(timeline):
+        return None, dict(_ALERT_EXPECT)
     if dict(timeline.get("meta", {})).get("shape_profile"):
         return _mesh_policy(), dict(_MESH_EXPECT)
     return _drill_policy(), _recorded_expect(timeline)
@@ -347,6 +381,8 @@ def main() -> None:
             result = simulate_rollout(tl, pol, expect)
         elif _is_tenant(tl):
             result = simulate_tenants(tl, pol, expect)
+        elif _is_alert(tl):
+            result = simulate_alerts(tl, pol, expect)
         else:
             result = simulate(tl, pol, expect)
         ok = (not result["passed"]) if invert else result["passed"]

@@ -1,0 +1,249 @@
+"""SLO catalog health + drill detection report (ISSUE 19).
+
+Two modes, both gates (non-zero exit on failure), both deterministic:
+
+``--smoke``
+    The tier-1 pulse: load the repo SLO catalog through the validating
+    loader with every selector resolved against REGISTERED_METRICS,
+    then push a synthetic breach-and-recovery history for each
+    objective type (ratio / bound / increase) through the REAL
+    :class:`easydl_tpu.brain.alert_policy.AlertPolicy` — the alert must
+    fire on the breach, stay quiet on the healthy twin, clear after
+    recovery, and the whole decision log must re-derive
+    byte-identically through the pure function.
+
+``--detect VERDICT.json... --out DETECT.json``
+    The drill-evidence aggregator chaos_smoke.sh runs after a round:
+    collect every verdict's ``detected_and_cleared`` /
+    ``no_false_pages`` check into one committed document — the
+    measured time-to-detect per drill. A drill whose expectation
+    declares detection but whose verdict carries no check fails the
+    report (detection claims never pass vacuously).
+
+Usage::
+
+    python scripts/slo_report.py --smoke
+    python scripts/slo_report.py --detect CHAOS_r24_*.json \
+        --out DETECT.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from easydl_tpu.analysis.rules.metric_names import (  # noqa: E402
+    REGISTERED_METRICS,
+)
+from easydl_tpu.brain.alert_policy import (  # noqa: E402
+    AlertPolicy, replay_decision_log,
+)
+from easydl_tpu.obs.slo import load_all, load_slo_doc  # noqa: E402
+
+#: the smoke floor: the committed catalog must keep at least this many
+#: objectives — a gutted slos/ directory is a silent detection outage
+_MIN_CATALOG = 10
+
+
+def _spec(kind: str) -> Dict[str, Any]:
+    """One synthetic spec per objective type, compiled through the real
+    loader so the smoke exercises the same validation the catalog gets."""
+    objective = {
+        "ratio": {"type": "ratio",
+                  "bad": 'easydl_rpc_client_errors_total',
+                  "total": "easydl_rpc_client_requests_total",
+                  "budget": 0.1},
+        "bound": {"type": "bound", "series": "easydl_loop_lag_seconds",
+                  "op": "gt", "bound": 5.0},
+        "increase": {"type": "increase",
+                     "series": "easydl_master_failovers_total",
+                     "max_increase": 0},
+    }[kind]
+    return load_slo_doc({
+        "name": f"smoke_{kind}", "severity": "ticket",
+        "runbook": "docs/operations.md#4-observability",
+        "objective": objective,
+        "windows": {"long_s": 6.0, "short_s": 1.5},
+        # bound burns are breach FRACTIONS of the window — 0.5 (the
+        # catalog's own threshold for bounds) fires half a long window
+        # after onset instead of a full one
+        "burn_threshold": 0.5 if kind == "bound" else 1.0,
+    }, where=f"<smoke:{kind}>")
+
+
+def _samples(kind: str, t: float, breach_at: float,
+             recover_at: float) -> Dict[str, float]:
+    """Closed-form synthetic series: healthy before ``breach_at``,
+    loudly bad until ``recover_at``, healthy again after."""
+    bad_s = max(0.0, min(t, recover_at) - breach_at)
+    healthy_s = t - bad_s
+    if kind == "ratio":
+        # healthy: 1% errors; breached: 60% errors against the 10% budget
+        return {
+            "easydl_rpc_client_requests_total": round(
+                100.0 * healthy_s + 100.0 * bad_s, 6),
+            "easydl_rpc_client_errors_total": round(
+                1.0 * healthy_s + 60.0 * bad_s, 6),
+        }
+    if kind == "bound":
+        lag = 30.0 if breach_at <= t < recover_at else 0.5
+        return {"easydl_loop_lag_seconds": lag}
+    # increase: one failover increment inside the breach window
+    return {"easydl_master_failovers_total":
+            1.0 if t >= breach_at else 0.0}
+
+
+def _exercise(kind: str) -> Tuple[bool, str]:
+    """Drive one objective type through breach-and-recovery plus a
+    healthy twin; returns (ok, detail)."""
+    spec = _spec(kind)
+    tick, duration = 0.5, 30.0
+    breach_at, recover_at = 10.0, 18.0
+
+    policy = AlertPolicy([spec])
+    quiet = AlertPolicy([spec])
+    history: List[Dict[str, Any]] = []
+    healthy: List[Dict[str, Any]] = []
+    fired_t: Optional[float] = None
+    cleared = False
+    t = 0.0
+    while t <= duration:
+        history.append(
+            {"t": round(t, 6),
+             "s": _samples(kind, t, breach_at, recover_at)})
+        healthy.append(
+            {"t": round(t, 6),
+             "s": _samples(kind, t, duration * 2, duration * 3)})
+        for h in (history, healthy):
+            while len(h) > 20:
+                h.pop(0)
+        d = policy.evaluate(history, t)
+        for tr in d["transitions"]:
+            if tr["to"] == "firing" and fired_t is None:
+                fired_t = t
+            if tr["to"] == "clear" and fired_t is not None:
+                cleared = True
+        dq = quiet.evaluate(healthy, t)
+        if dq["firing"]:
+            return False, f"{kind}: fired on the HEALTHY twin at t={t}"
+        t = round(t + tick, 6)
+
+    if fired_t is None:
+        return False, f"{kind}: never fired on the breach"
+    if not (breach_at <= fired_t <= breach_at + 4.0):
+        return False, (f"{kind}: fired at t={fired_t}, outside the "
+                       f"breach-onset window")
+    if not cleared:
+        return False, f"{kind}: never cleared after recovery"
+    for name, log in (("breach", policy.log), ("healthy", quiet.log)):
+        rep = replay_decision_log(log)
+        if not (rep["identical"] and rep["decisions"] > 0):
+            return False, (f"{kind}: {name} decision log does not "
+                           f"byte-replay ({rep['mismatches'][:1]})")
+    return True, (f"{kind}: fired t={fired_t}, cleared, "
+                  f"{len(policy.log)} decisions byte-replay")
+
+
+def run_smoke() -> int:
+    specs = load_all(known_metrics=REGISTERED_METRICS)
+    print(f"catalog: {len(specs)} SLOs validated, every selector "
+          f"resolved against {len(REGISTERED_METRICS)} registered "
+          f"families")
+    ok = len(specs) >= _MIN_CATALOG
+    if not ok:
+        print(f"FAIL catalog: {len(specs)} < floor {_MIN_CATALOG}")
+    pages = sorted(s["name"] for s in specs if s["severity"] == "page")
+    print(f"page-severity: {pages}")
+    for kind in ("ratio", "bound", "increase"):
+        good, detail = _exercise(kind)
+        print(f"{'ok  ' if good else 'FAIL'} {detail}")
+        ok = ok and good
+    print("SMOKE " + ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+def run_detect(verdicts: List[str], out: Optional[str]) -> int:
+    drills: Dict[str, Any] = {}
+    controls: Dict[str, Any] = {}
+    problems: List[str] = []
+    for path in sorted(verdicts):
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        name = str(doc.get("scenario", os.path.basename(path)))
+        checks = dict(dict(doc.get("invariants") or {}).get("checks") or {})
+        expect = dict(doc.get("expect") or {})
+        det = checks.get("detected_and_cleared")
+        if det is not None:
+            drills[name] = {k: det.get(k) for k in (
+                "ok", "alert", "ttd_s", "ttd_budget_s", "cleared",
+                "replay_decisions", "replay_identical")}
+            if not det.get("ok"):
+                problems.append(f"{name}: detected_and_cleared failed")
+        elif expect.get("detect"):
+            problems.append(f"{name}: expectation declares detection but "
+                            f"the verdict carries no check (vacuous)")
+        ctl = checks.get("no_false_pages")
+        if ctl is not None:
+            controls[name] = {k: ctl.get(k) for k in (
+                "ok", "rounds", "pages_fired", "replay_decisions",
+                "replay_identical")}
+            if not ctl.get("ok"):
+                problems.append(f"{name}: no_false_pages failed")
+        elif expect.get("detect_none"):
+            problems.append(f"{name}: negative control carries no "
+                            f"no_false_pages check (vacuous)")
+    report = {
+        "drills": {k: drills[k] for k in sorted(drills)},
+        "controls": {k: controls[k] for k in sorted(controls)},
+        "verdicts": [os.path.basename(p) for p in sorted(verdicts)],
+        "problems": problems,
+        "ok": not problems and bool(drills),
+    }
+    payload = json.dumps(report, indent=2, sort_keys=True) + "\n"
+    if out:
+        tmp = out + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(payload)
+        os.replace(tmp, out)
+        print(f"detection report -> {out}")
+    else:
+        sys.stdout.write(payload)
+    for name in sorted(drills):
+        d = drills[name]
+        print(f"  {name}: alert={d['alert']} ttd={d['ttd_s']}s "
+              f"(budget {d['ttd_budget_s']}s) "
+              f"{'ok' if d['ok'] else 'FAIL'}")
+    for p in problems:
+        print(f"  PROBLEM {p}")
+    return 0 if report["ok"] else 1
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="validate the catalog + exercise every "
+                         "objective type through the real policy")
+    ap.add_argument("--detect", nargs="+", default=None,
+                    metavar="VERDICT",
+                    help="aggregate chaos verdict JSONs into a "
+                         "detection report")
+    ap.add_argument("--out", default=None,
+                    help="with --detect: where the report lands "
+                         "(default stdout)")
+    args = ap.parse_args()
+    if args.smoke:
+        raise SystemExit(run_smoke())
+    if args.detect:
+        raise SystemExit(run_detect(args.detect, args.out))
+    ap.error("pick a mode: --smoke or --detect")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Known-bad fixture for slo-metric-refs: literals in an alerting
+module naming families the registry has never heard of."""
+
+# a plain misspelling (extra 's') — the classic silently-vacuous alert
+SERIES = "easydl_serve_router_request_total"
+
+# a selector literal whose family is made up entirely
+SELECTOR = "easydl_made_up_family_total{shard=\"0\"}"
+
+
+def relevant():
+    # registered name is fine; the derived _bucket suffix resolves too
+    return ["easydl_alert_active", "easydl_rpc_client_latency_seconds_bucket",
+            SERIES, SELECTOR]

@@ -1,0 +1,15 @@
+"""Known-good fixture for slo-metric-refs: every family-shaped literal
+resolves against the registry; non-family strings are ignored."""
+
+ACTIVE = "easydl_alert_active"
+
+# selector labels don't participate in resolution — family only
+SELECTOR = "easydl_serve_router_requests_total{verdict=\"shed\"}"
+
+# derived histogram suffixes resolve to their base family
+DERIVED = "easydl_rpc_client_latency_seconds_bucket"
+
+# not family-shaped (one segment / wrong prefix / prose) — out of scope
+PREFIX = "easydl_"
+PROSE = "exports easydl_alert_active per firing SLO"
+OTHER = "prometheus_build_info"

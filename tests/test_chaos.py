@@ -493,8 +493,10 @@ def test_scenario_catalog_compiles_deterministically():
             schedule_bytes(compile_schedule(builder().chaos))
         if sc.ps_storm is not None:
             # push-storm drills run no training job: their goal invariant
-            # is digest parity, not a step target
-            assert sc.expect.get("ps_zero_loss")
+            # is digest parity, not a step target — except the fault-free
+            # negative control, whose goal is firing ZERO pages
+            assert (sc.expect.get("ps_zero_loss")
+                    or sc.expect.get("detect_none"))
         elif sc.loop_drill is not None:
             # production-loop drills: the goal invariant is exactly-once
             # resume, commit-gated rollout, or retrieval digest parity —

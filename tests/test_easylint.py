@@ -26,6 +26,7 @@ from easydl_tpu.analysis.rules import (
     KnobRegistry,
     MetricNameLint,
     NakedRpc,
+    SloMetricRefs,
     VirtualClockPurity,
     all_rules,
 )
@@ -69,6 +70,9 @@ CASES = [
       "bad-label:le",
       "unknown-label:made_up_lbl",
       "unverifiable-name"}),
+    (SloMetricRefs, "slo_refs", "easydl_tpu/brain/alert_policy.py",
+     {"unknown-series:easydl_serve_router_request_total",
+      "unknown-series:easydl_made_up_family_total"}),
 ]
 
 
@@ -116,6 +120,103 @@ def test_purity_rule_scoped_to_replayed_modules():
 def test_naked_rpc_allowed_inside_blessed_seams():
     assert run_rule(NakedRpc(), "naked_rpc_bad.py",
                     "easydl_tpu/utils/rpc.py") == []
+
+
+def test_slo_refs_scoped_to_alerting_modules():
+    # the same unknown-family literals outside obs/slo.py, obs/alerts.py
+    # and brain/alert_policy.py are out of the rule's scope
+    assert run_rule(SloMetricRefs(), "slo_refs_bad.py",
+                    "easydl_tpu/serve/fake.py") == []
+
+
+def test_slo_refs_yaml_catalog_half():
+    """Analyzing the anchor module resolves every slos/*.yaml: unknown
+    selector families and loader-invalid specs are findings anchored on
+    the YAML file, and a clean catalog stays quiet."""
+    bad = SloMetricRefs(slos_dir=os.path.join(FIXTURES, "slos_bad"))
+    findings = bad.check("easydl_tpu/obs/slo.py", ast.parse(""), "")
+    details = {f.detail for f in findings}
+    assert "unknown-series:easydl_no_such_family_total" in details
+    assert "invalid-slo:invalid.yaml" in details
+    assert {f.path for f in findings} == {
+        "slos/unknown_series.yaml", "slos/invalid.yaml"}
+
+    good = SloMetricRefs(slos_dir=os.path.join(FIXTURES, "slos_good"))
+    assert good.check("easydl_tpu/obs/slo.py", ast.parse(""), "") == []
+
+
+def test_committed_slo_catalog_resolves_against_registry():
+    """The repo's own slos/ directory rides the anchor in the tree gate;
+    assert it directly too so a catalog regression names this test."""
+    findings = SloMetricRefs().check(
+        "easydl_tpu/obs/slo.py", ast.parse(""), "")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def _scan_registered_names():
+    """AST-scan every registration site in easydl_tpu/ for the literal
+    metric name; the rpc ``f"easydl_rpc_{side}_*"`` family expands over
+    side in client/server. Any other dynamic name is a hard failure
+    (the metric-name rule flags it too — this keeps the scan honest)."""
+    from easydl_tpu.analysis.core import dotted_name
+
+    expansions = {"side": ("client", "server")}
+    names, unverifiable = set(), []
+    for path in collect_files(["easydl_tpu"], root=REPO):
+        with open(os.path.join(REPO, path), encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "gauge", "histogram")
+                    and node.args):
+                continue
+            recv = (dotted_name(node.func.value) or "").lower()
+            if not ("reg" in recv.rsplit(".", 1)[-1]
+                    or isinstance(node.func.value, ast.Call)):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                names.add(arg.value)
+            elif isinstance(arg, ast.JoinedStr):
+                variants = [""]
+                for part in arg.values:
+                    if (isinstance(part, ast.Constant)
+                            and isinstance(part.value, str)):
+                        variants = [v + part.value for v in variants]
+                    elif (isinstance(part, ast.FormattedValue)
+                          and isinstance(part.value, ast.Name)
+                          and part.value.id in expansions):
+                        variants = [v + sub for v in variants
+                                    for sub in expansions[part.value.id]]
+                    else:
+                        unverifiable.append(f"{path}:{node.lineno}")
+                        variants = []
+                        break
+                names.update(variants)
+            else:
+                unverifiable.append(f"{path}:{node.lineno}")
+    assert not unverifiable, (
+        f"registration sites with names the sync scan cannot expand: "
+        f"{unverifiable}")
+    return names
+
+
+def test_registered_metrics_matches_registration_sites():
+    """REGISTERED_METRICS (what slo-metric-refs resolves SLO selectors
+    against) is exactly the set of families the tree registers — a stale
+    entry and an undeclared registration both fail, in both directions."""
+    from easydl_tpu.analysis.rules.metric_names import REGISTERED_METRICS
+
+    scanned = _scan_registered_names()
+    stale = REGISTERED_METRICS - scanned
+    undeclared = scanned - REGISTERED_METRICS
+    assert not stale, (
+        f"REGISTERED_METRICS entries with no registration site (delete "
+        f"them): {sorted(stale)}")
+    assert not undeclared, (
+        f"registration sites missing from REGISTERED_METRICS (declare "
+        f"them): {sorted(undeclared)}")
 
 
 # ------------------------------------------------------------------ baseline

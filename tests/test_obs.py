@@ -297,6 +297,43 @@ def test_parse_text_normalises_label_order():
     assert parse_text(text) == {'a_total{a="1",b="2"}': 3.0, "naked": 1.5}
 
 
+def test_parse_text_escaped_label_values():
+    # escaped quotes and backslashes inside label values must not
+    # truncate the label (the exposition format escapes both)
+    text = (
+        'esc{a="x\\"y",b="c\\\\d"} 1\n'
+        'esc{b="c\\\\d",a="x\\"y"} 2\n'  # same series, reordered labels
+    )
+    out = parse_text(text)
+    key = 'esc{a="x\\"y",b="c\\\\d"}'
+    assert list(out) == [key]
+    assert out[key] == 2.0  # later line wins, proving key equality
+
+
+def test_parse_text_nan_and_infinities():
+    out = parse_text(
+        "sick NaN\n"
+        "hot +Inf\n"
+        "cold -Inf\n"
+    )
+    assert out["sick"] != out["sick"]  # NaN preserved for the caller
+    assert out["hot"] == float("inf")
+    assert out["cold"] == float("-inf")
+
+
+def test_parse_text_histogram_inf_bucket():
+    # the +Inf bucket's le label is a VALUE, not a sample value — it
+    # must survive as part of the series key
+    out = parse_text(
+        'lat_seconds_bucket{le="0.5"} 3\n'
+        'lat_seconds_bucket{le="+Inf"} 7\n'
+        "lat_seconds_count 7\n"
+    )
+    assert out['lat_seconds_bucket{le="+Inf"}'] == 7.0
+    assert out['lat_seconds_bucket{le="0.5"}'] == 3.0
+    assert out["lat_seconds_count"] == 7.0
+
+
 def test_merge_snapshot_across_services(tmp_path):
     r1, r2 = MetricsRegistry(), MetricsRegistry()
     r1.gauge("easydl_one", "1").set(1)
