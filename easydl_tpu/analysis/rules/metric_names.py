@@ -136,6 +136,11 @@ REGISTERED_METRICS = frozenset((
     "easydl_ps_shm_client_ids_total",
     "easydl_ps_shm_client_pulls_total",
     "easydl_ps_table_rows",
+    "easydl_ps_tier_cold_hits_total",
+    "easydl_ps_tier_cold_rows",
+    "easydl_ps_tier_demotions_total",
+    "easydl_ps_tier_hot_rows",
+    "easydl_ps_tier_promotions_total",
     "easydl_ps_wal_appends_total",
     "easydl_ps_wal_bytes_total",
     "easydl_ps_wal_deduped_pushes_total",

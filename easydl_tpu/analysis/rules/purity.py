@@ -42,6 +42,7 @@ PURE_PATHS = (
     "easydl_tpu/brain/mesh_policy.py",
     "easydl_tpu/brain/policy.py",
     "easydl_tpu/brain/straggler.py",
+    "easydl_tpu/brain/tier_policy.py",
     "easydl_tpu/cell/policy.py",
     "easydl_tpu/core/mesh_shapes.py",
     "easydl_tpu/elastic/membership.py",

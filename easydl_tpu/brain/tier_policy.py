@@ -1,0 +1,264 @@
+"""Two-tier PS placement policy: ONE pure decision function turning per-table
+tier stats into per-table hot-row targets under a namespace-fair byte budget.
+
+The native store (easydl_tpu/ps/native/embedding_store.cc) mechanically
+executes promotion/demotion rounds; WHICH rows move is its deterministic
+frequency order, but HOW MUCH hot capacity each table gets — the eviction
+pressure — is a policy question, and with PR-15 namespaces it is a FAIRNESS
+question: one tenant's cold long tail must never evict another tenant's hot
+set. This module is that policy, in the same shape as every other Brain
+decision (autoscaler, mesh planner, arbiter):
+
+- **pure** (easylint rule 5 PURE_PATHS): no clocks, no RNG, no I/O — same
+  inputs ⇒ byte-identical verdict (:func:`decision_bytes`).
+- **namespace-fair water-fill** — each namespace's DEMAND is the bytes its
+  hot rows plus its warm cold rows (decayed freq >= promote_min_freq) would
+  occupy. The shard's hot byte budget water-fills across namespaces: a
+  namespace under its fair share keeps its whole demand, surplus
+  redistributes among the still-hungry. Therefore a namespace's grant is
+  never below ``min(demand, budget/num_namespaces)`` — tenant A's long tail
+  can inflate only A's own pressure, and tenant B's hot set (while under
+  B's fair share) is untouchable. The eviction fairness test pins exactly
+  this invariant.
+- **proportional within a namespace** — a namespace's grant splits across
+  its tables proportionally to table demand (largest remainder on the
+  residue, name-ordered, so the split is deterministic).
+- **logged + replayable** — the shard's maintenance loop records every
+  decision as ``{"inputs": ..., "verdict": ...}``;
+  :func:`replay_decision_log` re-derives each verdict through this very
+  function and byte-compares, the same offline gate as the arbiter's.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Sequence
+
+__all__ = [
+    "TierConfig",
+    "TableTierStats",
+    "decision_bytes",
+    "replay_decision_log",
+    "stats_from_dict",
+    "tier_plan",
+]
+
+
+@dataclass(frozen=True)
+class TierConfig:
+    """The EASYDL_PS_TIER_* knobs, as the policy sees them."""
+
+    #: shard-wide hot tier byte budget (EASYDL_PS_TIER_HOT_MB)
+    hot_budget_bytes: int
+    #: per-tick multiplicative frequency decay (EASYDL_PS_TIER_DECAY)
+    decay: float = 0.9
+    #: a cold row is promotion-worthy at this decayed frequency
+    promote_min_freq: float = 1.0
+    #: a cold row swaps in only when this factor hotter than the coldest
+    #: hot row — hysteresis against promote/demote ping-pong
+    swap_margin: float = 1.25
+    #: per-table cap on moves per tick (0 = unbounded churn)
+    max_moves: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "hot_budget_bytes": int(self.hot_budget_bytes),
+            "decay": float(self.decay),
+            "promote_min_freq": float(self.promote_min_freq),
+            "swap_margin": float(self.swap_margin),
+            "max_moves": int(self.max_moves),
+        }
+
+
+@dataclass(frozen=True)
+class TableTierStats:
+    """One table's occupancy snapshot (from EmbeddingTable.tier_stats)."""
+
+    name: str
+    namespace: str
+    row_bytes: int
+    hot_rows: int
+    cold_rows: int
+    #: cold rows whose decayed frequency clears promote_min_freq — the
+    #: table's promotion demand
+    warm_cold_rows: int
+
+    def demand_bytes(self) -> int:
+        """Bytes this table's deserving set (current hot + warm cold)
+        would occupy if fully hot."""
+        return (max(0, self.hot_rows) + max(0, self.warm_cold_rows)) * \
+            max(1, self.row_bytes)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "namespace": self.namespace,
+            "row_bytes": int(self.row_bytes),
+            "hot_rows": int(self.hot_rows),
+            "cold_rows": int(self.cold_rows),
+            "warm_cold_rows": int(self.warm_cold_rows),
+        }
+
+
+def stats_from_dict(d: Mapping[str, Any]) -> TableTierStats:
+    return TableTierStats(
+        name=str(d["name"]), namespace=str(d.get("namespace", "")),
+        row_bytes=int(d.get("row_bytes", 1)),
+        hot_rows=int(d.get("hot_rows", 0)),
+        cold_rows=int(d.get("cold_rows", 0)),
+        warm_cold_rows=int(d.get("warm_cold_rows", 0)),
+    )
+
+
+def _waterfill(demands: Mapping[str, int], budget: int) -> Dict[str, int]:
+    """Deterministic integer water-fill: everyone whose demand fits under
+    the current equal share is granted in full; the freed surplus
+    redistributes among the still-hungry until shares stabilise."""
+    grant = {k: 0 for k in demands}
+    active = sorted(k for k, d in demands.items() if d > 0)
+    left = max(0, int(budget))
+    while active and left > 0:
+        share = left // len(active)
+        if share == 0:
+            # fewer bytes than claimants: deterministic name order gets
+            # the last crumbs (at most len(active)-1 bytes in play)
+            for k in active:
+                if left == 0:
+                    break
+                take = min(1, demands[k] - grant[k])
+                grant[k] += take
+                left -= take
+            break
+        satisfied = [k for k in active if demands[k] - grant[k] <= share]
+        if satisfied:
+            for k in satisfied:
+                need = demands[k] - grant[k]
+                grant[k] += need
+                left -= need
+            active = [k for k in active if k not in satisfied]
+        else:
+            for k in active:
+                grant[k] += share
+                left -= share
+            break  # everyone took a full equal share: stable
+    return grant
+
+
+def _split_proportional(demands: Mapping[str, int],
+                        total: int) -> Dict[str, int]:
+    """Split ``total`` across keys proportional to demand, largest
+    remainder first (name-ordered on ties) — deterministic and exact."""
+    dsum = sum(max(0, d) for d in demands.values())
+    if dsum <= 0 or total <= 0:
+        return {k: 0 for k in demands}
+    total = min(total, dsum)
+    shares = {}
+    rems = []
+    used = 0
+    for k in sorted(demands):
+        exact = total * max(0, demands[k])
+        shares[k] = exact // dsum
+        used += shares[k]
+        rems.append((-(exact % dsum), k))
+    for _, k in sorted(rems):
+        if used >= total:
+            break
+        if shares[k] < demands[k]:
+            shares[k] += 1
+            used += 1
+    return shares
+
+
+def tier_plan(tables: Sequence[TableTierStats],
+              config: TierConfig) -> Dict[str, Any]:
+    """One maintenance round → the canonical decision document.
+
+    Returns::
+
+        {"budget_bytes": int,
+         "namespaces": {ns: {"demand_bytes", "granted_bytes"}},
+         "tables": {table: {"namespace", "demand_bytes", "granted_bytes",
+                            "hot_target_rows", "max_moves"}},
+         "params": {"decay", "promote_min_freq", "swap_margin"}}
+
+    ``hot_target_rows`` is what the executor passes straight to
+    ``eds_tier_maintain`` — at least 1 row per table, so a starved table
+    still serves its very hottest row from RAM."""
+    tables = list(tables)
+    ns_demand: Dict[str, int] = {}
+    for t in tables:
+        ns_demand[t.namespace] = ns_demand.get(t.namespace, 0) + \
+            t.demand_bytes()
+    ns_grant = _waterfill(ns_demand, config.hot_budget_bytes)
+
+    table_doc: Dict[str, Any] = {}
+    for ns in sorted(ns_demand):
+        members = [t for t in tables if t.namespace == ns]
+        demands = {t.name: t.demand_bytes() for t in members}
+        split = _split_proportional(demands, ns_grant[ns])
+        for t in sorted(members, key=lambda t: t.name):
+            granted = split[t.name]
+            target = max(1, granted // max(1, t.row_bytes))
+            table_doc[t.name] = {
+                "namespace": ns,
+                "demand_bytes": int(demands[t.name]),
+                "granted_bytes": int(granted),
+                "hot_target_rows": int(target),
+                "max_moves": int(config.max_moves),
+            }
+
+    return {
+        "budget_bytes": int(config.hot_budget_bytes),
+        "namespaces": {
+            ns: {"demand_bytes": int(ns_demand[ns]),
+                 "granted_bytes": int(ns_grant[ns])}
+            for ns in sorted(ns_demand)
+        },
+        "tables": table_doc,
+        "params": {
+            "decay": float(config.decay),
+            "promote_min_freq": float(config.promote_min_freq),
+            "swap_margin": float(config.swap_margin),
+        },
+    }
+
+
+def decision_bytes(decision: Mapping[str, Any]) -> bytes:
+    """Canonical serialization — the byte identity the offline replay
+    gate (and the determinism tests) are stated over."""
+    return json.dumps(decision, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def replay_decision_log(records: Sequence[Mapping[str, Any]]
+                        ) -> Dict[str, Any]:
+    """Re-derive every logged verdict from its own recorded inputs
+    through the pure function and byte-compare — the offline half of the
+    beyond-RAM drill's acceptance gate. Returns::
+
+        {"decisions": N, "identical": bool, "mismatches": [...]}
+    """
+    mismatches: List[Dict[str, Any]] = []
+    for i, rec in enumerate(records):
+        inputs = dict(rec.get("inputs") or {})
+        want = rec.get("verdict")
+        cfg_doc = dict(inputs.get("config") or {})
+        got = tier_plan(
+            [stats_from_dict(t) for t in inputs.get("tables", [])],
+            TierConfig(
+                hot_budget_bytes=int(cfg_doc.get("hot_budget_bytes", 0)),
+                decay=float(cfg_doc.get("decay", 0.9)),
+                promote_min_freq=float(cfg_doc.get("promote_min_freq", 1.0)),
+                swap_margin=float(cfg_doc.get("swap_margin", 1.25)),
+                max_moves=int(cfg_doc.get("max_moves", 0)),
+            ),
+        )
+        if want is None or decision_bytes(got) != decision_bytes(want):
+            mismatches.append({
+                "index": i, "recorded": want, "replayed": got,
+            })
+    return {
+        "decisions": len(records),
+        "identical": not mismatches and len(records) > 0,
+        "mismatches": mismatches[:5],
+    }

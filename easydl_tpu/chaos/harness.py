@@ -1549,6 +1549,24 @@ class ChaosHarness:
 
         trace_before = os.environ.get(tracing.TRACE_ENV)
         os.environ[tracing.TRACE_ENV] = "1"
+        # Beyond-RAM drills: arm the two-tier store in every PS pod the
+        # storm launches (rescue and reshard-destination pods inherit the
+        # same environment, so a recovered or migrated shard is tiered
+        # too). A fast maintenance cadence makes the spill happen inside
+        # the drill window instead of minutes after it.
+        tier_cfg = dict((sc.ps_storm or {}).get("tier") or {})
+        tier_before = {
+            k: os.environ.get(k)
+            for k in ("EASYDL_PS_TIER_HOT_MB", "EASYDL_PS_TIER_COLD_MB",
+                      "EASYDL_PS_TIER_PROMOTE_INTERVAL_S")
+        }
+        if tier_cfg:
+            os.environ["EASYDL_PS_TIER_HOT_MB"] = str(
+                int(tier_cfg.get("hot_mb", 1)))
+            os.environ["EASYDL_PS_TIER_COLD_MB"] = str(
+                int(tier_cfg.get("cold_mb", 64)))
+            os.environ["EASYDL_PS_TIER_PROMOTE_INTERVAL_S"] = str(
+                float(tier_cfg.get("interval_s", 0.5)))
         t_start = time.monotonic()
         counts_before = injectors.injected_fault_counts()
         self._zombie: Optional[Dict[str, Any]] = None
@@ -1571,6 +1589,11 @@ class ChaosHarness:
                 os.environ.pop(tracing.TRACE_ENV, None)
             else:
                 os.environ[tracing.TRACE_ENV] = trace_before
+            for k, v in tier_before.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         fault_counts = {
             kind: count - counts_before.get(kind, 0.0)
             for kind, count in injectors.injected_fault_counts().items()
@@ -1988,6 +2011,15 @@ class ChaosHarness:
                 "easydl_ps_reshard_rows_migrated_total"),
             "reshard_replayed_records": total(
                 "easydl_ps_reshard_replayed_records_total"),
+            # Two-tier store: final resident split plus cumulative
+            # promotion/demotion/cold-hit traffic — the beyond-RAM drills'
+            # anti-vacuous evidence that rows actually spilled and the
+            # cold path actually served.
+            "tier_hot_rows": total("easydl_ps_tier_hot_rows"),
+            "tier_cold_rows": total("easydl_ps_tier_cold_rows"),
+            "tier_promotions": total("easydl_ps_tier_promotions_total"),
+            "tier_demotions": total("easydl_ps_tier_demotions_total"),
+            "tier_cold_hits": total("easydl_ps_tier_cold_hits_total"),
         }
 
     def _ps_pause_and_rescue(self, shard: int, respawn_after_s: float) -> None:
@@ -3840,6 +3872,55 @@ def scenario_ps_reshard_under_fire(seed: int = 43) -> Scenario:
     )
 
 
+def scenario_ps_tier_beyond_ram(seed: int = 107) -> Scenario:
+    """The beyond-RAM drill: every PS pod runs the two-tier store with a
+    hot arena (1 MB) several times smaller than the tables the storm
+    builds, so most rows live in the mmap cold tier — then the drill runs
+    BOTH recovery paths over that spilled state. A shard is SIGKILLed
+    mid-storm after a snapshot commit (its rescue must restore + WAL-replay
+    rows it will immediately re-spill), and later a live 2→4 online split
+    migrates the same beyond-arena tables while pushes keep flowing. The
+    verdict is the strongest the subsystem has — bitwise digest parity
+    (embedding AND optimizer rows, both tiers exported) against a
+    fault-free single-tier in-process replay of the exact same stream —
+    plus the anti-vacuous ``ps_tier_spilled`` check: the tier counters
+    must show rows actually resident cold, at least one demotion, and at
+    least one access served from the cold tier, or the pass is refused."""
+    return Scenario(
+        chaos=ChaosSpec(
+            name="ps_tier_beyond_ram", seed=seed,
+            notes="two-tier PS with a 1MB hot arena under a storm that "
+                  "builds multi-MB tables; SIGKILL+rescue of a spilled "
+                  "shard, then a live 2->4 split of the same tables; "
+                  "digest parity vs a single-tier fault-free reference",
+            faults=(
+                FaultSpec(kind="ps_kill", at_s=0.3, target={"shard": 1},
+                          params={"respawn_after_s": 0.3}),
+            ),
+        ),
+        tier="smoke",
+        job_cfg={},
+        ps_shards=2,
+        ps_storm={"steps": 320, "batch": 256, "vocab": 60_000, "dim": 32,
+                  "zipf_a": 1.05, "save_at": 60, "arm_at": 90,
+                  "pace_s": 0.006,
+                  "tier": {"hot_mb": 1, "cold_mb": 64, "interval_s": 0.5},
+                  "reshard": {"at": 200, "to_shards": 4}},
+        expect={
+            "ps_zero_loss": True,
+            "min_wal_replays": 1,
+            "min_reshard_migrations": 1,
+            "min_rows_migrated": 1,
+            "min_reshard_replays": 1,
+            "min_tier_cold_rows": 1000,
+            "min_faults": 1,
+            # the SIGKILLed spilled shard stops answering scrapes — same
+            # detection surface as ps_shard_crash_zero_loss
+            "detect": {"alert": "fleet_scrape_health", "ttd_budget_s": 30.0},
+        },
+    )
+
+
 def scenario_serve_during_reshard(seed: int = 59) -> Scenario:
     """The serving tier rides a live 2→4 shard split under load: a
     serving replica (full frontend — micro-batch queue, admission
@@ -4317,6 +4398,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "ps_shard_crash_zero_loss": scenario_ps_shard_crash_zero_loss,
     "ps_zombie_writer": scenario_ps_zombie_writer,
     "ps_reshard_under_fire": scenario_ps_reshard_under_fire,
+    "ps_tier_beyond_ram": scenario_ps_tier_beyond_ram,
     "serve_during_reshard": scenario_serve_during_reshard,
     "serve_replica_death_mid_flood": scenario_serve_replica_death_mid_flood,
     "trainer_crash_mid_loop": scenario_trainer_crash_mid_loop,

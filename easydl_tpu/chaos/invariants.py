@@ -45,6 +45,11 @@ verdicts:
   pushes — a "pass" where the migration never ran, or ran against a
   silent tier, is refused (same no-vacuous-pass stance as
   ``ps_wal_replayed``);
+- ``ps_tier_spilled`` — a drill billed as beyond-RAM really ran beyond the
+  hot arena: the pods' tier counters show at least ``min_tier_cold_rows``
+  rows resident in the mmap cold tier, at least one demotion, and at least
+  one access served from the cold tier — a "pass" where the table fit in
+  RAM the whole time would prove nothing about spilled-state recovery;
 - ``straggler_mitigated`` — the master's skew detector actually evicted
   the declared straggler (``straggler_evicted`` WAL record), the final
   membership excludes it, and — when the scenario declares
@@ -570,6 +575,24 @@ def check_scenario(
                     "errors": errors,
                     "committed_routing": [m.get("committed_routing")
                                           for m in committed],
+                }
+            min_cold = expect.get("min_tier_cold_rows")
+            if min_cold is not None:
+                counters = evidence.get("counters", {}) or {}
+                cold_rows = float(counters.get("tier_cold_rows", 0.0))
+                demotions = float(counters.get("tier_demotions", 0.0))
+                cold_hits = float(counters.get("tier_cold_hits", 0.0))
+                checks["ps_tier_spilled"] = {
+                    "ok": (cold_rows >= float(min_cold)
+                           and demotions >= 1.0 and cold_hits >= 1.0),
+                    "tier_cold_rows": cold_rows,
+                    "min_tier_cold_rows": float(min_cold),
+                    "tier_demotions": demotions,
+                    "tier_cold_hits": cold_hits,
+                    "tier_hot_rows": float(
+                        counters.get("tier_hot_rows", 0.0)),
+                    "tier_promotions": float(
+                        counters.get("tier_promotions", 0.0)),
                 }
             if (expect.get("serve_no_hard_failures")
                     or expect.get("serve_no_stale_reads")

@@ -246,19 +246,35 @@ def reconcile(job: str, plan: ResourcePlan, pods: List[Pod],
 PS_SPLIT_HOT_RATIO = 1.5
 PS_SPLIT_MIN_ROWS = 100_000
 PS_SPLIT_MAX_SHARDS = 64
+#: Access skew fires later than row skew (2.0 vs 1.5): pull traffic is
+#: noisier than resident rows (a batch of lookups against one shard
+#: spikes the counter without meaning sustained heat), so the trigger
+#: demands a wider margin before paying a migration for it.
+PS_SPLIT_ACCESS_RATIO = 2.0
 
 
 def ps_split_decision(shard_rows: Dict[int, float], num_shards: int,
                       hot_ratio: float = PS_SPLIT_HOT_RATIO,
                       min_total_rows: float = PS_SPLIT_MIN_ROWS,
-                      max_shards: int = PS_SPLIT_MAX_SHARDS) -> Optional[int]:
-    """Pure decision: observed per-shard row counts → target shard count
-    for an online split (ps/reshard.py), or None.
+                      max_shards: int = PS_SPLIT_MAX_SHARDS,
+                      shard_access: Optional[Dict[int, float]] = None,
+                      access_ratio: float = PS_SPLIT_ACCESS_RATIO,
+                      ) -> Optional[int]:
+    """Pure decision: observed per-shard row counts (and optionally
+    per-shard access counts) → target shard count for an online split
+    (ps/reshard.py), or None.
 
     Doubles the shard count when the hottest shard holds ≥ ``hot_ratio``
-    × the mean (static hash-sharding concentrating a Zipf id stream) and
-    the tier holds at least ``min_total_rows`` rows; capped at
-    ``max_shards``. Deliberately the same shape as the reconcile core:
+    × the mean row count (static hash-sharding concentrating a Zipf id
+    stream), OR — when ``shard_access`` is supplied — when one shard
+    serves ≥ ``access_ratio`` × the mean access count. The second
+    trigger exists for the two-tier store: a shard can be balanced by
+    resident ROWS yet concentrate the hot WORKING SET, burning its hot
+    arena on traffic the hash layout cannot spread. Both triggers share
+    the ``min_total_rows`` floor (a small table never pays a migration,
+    however skewed its traffic) and the ``max_shards`` cap. Callers
+    that pass no access counts get the legacy row-count-only verdict,
+    bit for bit. Deliberately the same shape as the reconcile core:
     pure inputs → pure verdict, so policy is unit-testable without a
     live tier."""
     if num_shards <= 0 or not shard_rows:
@@ -270,9 +286,15 @@ def ps_split_decision(shard_rows: Dict[int, float], num_shards: int,
     if target > max_shards:
         return None
     hottest = max(shard_rows.values())
-    if hottest < hot_ratio * (total / num_shards):
-        return None
-    return target
+    if hottest >= hot_ratio * (total / num_shards):
+        return target
+    if shard_access:
+        atotal = float(sum(shard_access.values()))
+        if atotal > 0.0:
+            ahot = max(shard_access.values())
+            if ahot >= access_ratio * (atotal / num_shards):
+                return target
+    return None
 
 
 def maybe_split_ps(workdir: str,
@@ -293,8 +315,9 @@ def maybe_split_ps(workdir: str,
 
     The thresholds default from the environment
     (``EASYDL_PS_SPLIT_HOT_RATIO`` / ``EASYDL_PS_SPLIT_MIN_ROWS`` /
-    ``EASYDL_PS_SPLIT_MAX_SHARDS``) so a deployed operator loop is
-    tunable without a rollout; explicit args win."""
+    ``EASYDL_PS_SPLIT_MAX_SHARDS`` / ``EASYDL_PS_SPLIT_ACCESS_RATIO``)
+    so a deployed operator loop is tunable without a rollout; explicit
+    args win."""
     import re as _re
 
     if hot_ratio is None:
@@ -306,6 +329,8 @@ def maybe_split_ps(workdir: str,
     if max_shards is None:
         max_shards = knob_int("EASYDL_PS_SPLIT_MAX_SHARDS",
                               PS_SPLIT_MAX_SHARDS)
+    access_ratio = knob_float("EASYDL_PS_SPLIT_ACCESS_RATIO",
+                              PS_SPLIT_ACCESS_RATIO)
 
     from easydl_tpu.obs.scrape import merge_snapshot
     from easydl_tpu.ps import registry as ps_registry
@@ -331,7 +356,14 @@ def maybe_split_ps(workdir: str,
     # decision phantom (pre-split) counts.
     committed = {f"ps-{d['pod']}" for d in smap.values() if d.get("pod")}
     rows_re = _re.compile(r'^easydl_ps_table_rows\{.*shard="(\d+)"')
+    # Access signal for the two-tier store: a shard balanced by resident
+    # rows can still concentrate the hot working set. Cumulative served-id
+    # counters are a coarse proxy for that heat — good enough here because
+    # the decision only compares shards against each other and the
+    # counters all started at the same reshard generation.
+    pulls_re = _re.compile(r'^easydl_ps_pull_ids_total\{.*shard="(\d+)"')
     shard_rows: Dict[int, float] = {}
+    shard_access: Dict[int, float] = {}
     for component, svc in (snap.get("services") or {}).items():
         if component not in committed:
             continue
@@ -340,9 +372,16 @@ def maybe_split_ps(workdir: str,
             if m2:
                 s = int(m2.group(1))
                 shard_rows[s] = shard_rows.get(s, 0.0) + float(value)
+                continue
+            m3 = pulls_re.match(series)
+            if m3:
+                s = int(m3.group(1))
+                shard_access[s] = shard_access.get(s, 0.0) + float(value)
     return ps_split_decision(shard_rows, num_shards, hot_ratio=hot_ratio,
                              min_total_rows=min_total_rows,
-                             max_shards=max_shards)
+                             max_shards=max_shards,
+                             shard_access=shard_access,
+                             access_ratio=access_ratio)
 
 
 # ------------------------------------------------- serve replica autoscale

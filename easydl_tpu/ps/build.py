@@ -34,6 +34,19 @@ def _bind(lib: ctypes.CDLL) -> None:
     ]
     lib.eds_export_snapshot.restype = ctypes.c_int64
     lib.eds_import.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
+    # Two-tier backend (PR 20): enable the cold mmap tier, run one
+    # promotion/demotion round, read tier stats for the Brain policy.
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.eds_tier_enable.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.eds_tier_enable.restype = ctypes.c_int
+    lib.eds_tier_maintain.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int64, ctypes.c_int64, i64p,
+    ]
+    lib.eds_tier_maintain.restype = ctypes.c_int
+    lib.eds_tier_stats.argtypes = [ctypes.c_void_p, ctypes.c_double, f64p]
     # Shared-memory mirror (zero-copy pull transport, PR 14): server side
     # export/version/revoke on the store handle, client side open/gather
     # on a read-only mapping of the named segment.
@@ -50,6 +63,8 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.eds_shm_close.argtypes = [ctypes.c_void_p]
     lib.eds_shm_reader_dim.argtypes = [ctypes.c_void_p]
     lib.eds_shm_reader_dim.restype = ctypes.c_int64
+    lib.eds_shm_reader_tiered.argtypes = [ctypes.c_void_p]
+    lib.eds_shm_reader_tiered.restype = ctypes.c_int
     lib.eds_shm_reader_meta.argtypes = [
         ctypes.c_void_p, u64p, ctypes.POINTER(ctypes.c_float), u64p,
     ]

@@ -939,6 +939,10 @@ class ShardedPsClient(_PsClientBase):
             rows = self._shm_pull(s, table, ids, route_gen, vout)
             if rows is not None:
                 return rows
+        return self._wire_pull(s, table, ids, route_gen, vout)
+
+    def _wire_pull(self, s, table, ids, route_gen=None, vout=None):
+        """The chunked gRPC pull for one shard's id slice."""
         ranges = self._chunks(len(ids), self._table_dim(table))
         parts = self._chunk_fan(
             [lambda lo=lo, hi=hi: self._pull_chunk(s, table, ids[lo:hi],
@@ -967,7 +971,16 @@ class ShardedPsClient(_PsClientBase):
 
         m = _shm_metrics()
         try:
-            rows, version = reader.pull(ids)
+            if reader.tiered:
+                # Tiered store behind the mirror: only the HOT tier is
+                # mirrored. Misses may be cold rows with real trained
+                # state, so they come back as a mask and are fetched on
+                # the wire — a partial fallback, not a full one (the
+                # segment is NOT revoked by demotion).
+                rows, version, miss = reader.pull_partial(ids)
+            else:
+                rows, version = reader.pull(ids)
+                miss = None
         except _shm.ShmUnavailable as e:
             m[2].inc(reason="revoked" if e.revoked else "contention")
             if e.revoked:
@@ -976,10 +989,15 @@ class ShardedPsClient(_PsClientBase):
                         self._shm_readers.pop((s, table), None)
                 reader.close()
             return None
+        if miss is not None:
+            m[2].inc(reason="cold-miss")
+            rows[miss] = self._wire_pull(s, table, ids[miss], route_gen,
+                                         vout)
         if vout is not None:
             vout.record(s, version)
         m[0].inc(table=table)
-        m[1].inc(int(ids.size), table=table)
+        m[1].inc(int(ids.size - (0 if miss is None else int(miss.sum()))),
+                 table=table)
         return rows
 
     def _shm_negotiate(self, s, table, name, nonce) -> None:

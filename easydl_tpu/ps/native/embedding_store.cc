@@ -6,7 +6,7 @@
 // DRAM behind pull/push).
 //
 // Design:
-//   * lock-striped: 64 stripes, each an open hash map id -> row offset into a
+//   * lock-striped: 64 stripes, each an open hash map id -> row ref into a
 //     per-stripe arena. Pull/push from many gRPC threads proceed in parallel
 //     unless they hit the same stripe.
 //   * lazy deterministic init: a row materialises on first touch with values
@@ -31,11 +31,25 @@
 //     check and the gather retried; persistent contention or a revoked
 //     segment returns a sentinel and the caller falls back to the wire.
 
+//   * two-tier layout (PR 20): eds_tier_enable splits storage into a HOT
+//     tier (per-stripe arenas, byte-budgeted) and a COLD tier (one mmap'd
+//     file under the shard workdir, shared slot allocator). Every row
+//     carries a decayed access-frequency counter; eds_tier_maintain demotes
+//     the coldest hot rows and promotes warm cold rows toward a target hot
+//     row count, mechanically executing a plan whose SELECTION lives in the
+//     pure Brain policy (easydl_tpu/brain/tier_policy.py). The shm mirror
+//     stays hot-only: demotion TOMBSTONES the mirrored slot (readers miss
+//     and fall back to the wire — the segment is never revoked for tiering),
+//     promotion writes through inside the usual seqlock critical section.
+//     Pull/Push/Import/Export/WAL-replay are tier-transparent: a row's bytes
+//     and optimizer semantics are identical in either tier.
+
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
@@ -75,18 +89,26 @@ enum Optimizer : int { kSgd = 0, kAdagrad = 1 };
 // Segment layout (8-byte aligned):
 //   ShmHeader | int64 slot_id[nslots] | int32 slot_row[nslots]
 //             | float rows[capacity_rows * dim]
-// The index is insertion-only open addressing (hash = splitmix64(id),
-// linear probe; slot_row == -1 marks a free slot, so any int64 — negative
-// ids included — is a valid key). Only the VALUE half of each row is
-// mirrored: readers are serving pulls, optimizer slots never ride this
-// path. Consistency is one segment-wide seqlock: writers (serialized by
-// the store's shm mutex) bump `seq` odd before touching the index/rows
-// and even after; a reader that observes an odd or changed seq retries.
-// Every shared word is accessed through __atomic builtins so the
+// The index is open addressing (hash = splitmix64(id), linear probe) with
+// tombstones: slot_row == kSlotFree (-1) marks a never-used slot (ends a
+// probe chain), slot_row == kSlotDead (-2) marks a DEMOTED entry whose row
+// storage was recycled — readers treat it as a miss but keep probing, so
+// any int64 — negative ids included — is a valid key. Only the VALUE half
+// of each row is mirrored: readers are serving pulls, optimizer slots never
+// ride this path. Consistency is one segment-wide seqlock: writers
+// (serialized by the store's shm mutex) bump `seq` odd before touching the
+// index/rows and even after; a reader that observes an odd or changed seq
+// retries. Every shared word is accessed through __atomic builtins so the
 // TSan-instrumented stress driver sees no data race — the seqlock makes
 // the RESULT consistent, the atomics make the bytes well-defined.
 
-constexpr uint64_t kShmMagic = 0x4544535348'4d3031ULL;  // "EDSSHM01"
+constexpr uint64_t kShmMagic = 0x4544535348'4d3032ULL;  // "EDSSHM02"
+
+constexpr int32_t kSlotFree = -1;  // never used: terminates probe chains
+constexpr int32_t kSlotDead = -2;  // tombstone: row recycled, keep probing
+
+// Header flag bits.
+constexpr uint32_t kShmFlagTiered = 1u;  // store behind the mirror is tiered
 
 struct ShmHeader {
   uint64_t magic;
@@ -97,10 +119,10 @@ struct ShmHeader {
   int64_t dim;
   int64_t capacity_rows;
   int64_t nslots;        // power of two
-  int64_t nrows;
+  int64_t nrows;         // high-water row allocation mark
   uint64_t seed;         // TableSpec seed — client-side lazy init
   float init_std;        //   "      init_std
-  float pad_;
+  uint32_t flags;        // kShmFlag* bits (tiered: a miss may be a COLD row)
 };
 
 inline uint64_t a_load(const uint64_t* p) {
@@ -120,6 +142,12 @@ inline int32_t a_load32(const int32_t* p) {
 }
 inline void a_store32(int32_t* p, int32_t v) {
   __atomic_store_n(p, v, __ATOMIC_RELAXED);
+}
+inline uint32_t a_loadu32(const uint32_t* p) {
+  return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+}
+inline void a_storeu32(uint32_t* p, uint32_t v) {
+  __atomic_store_n(p, v, __ATOMIC_RELEASE);
 }
 // float rows move as relaxed 32-bit words (seqlock provides the ordering).
 inline void row_copy_in(float* dst_shm, const float* src, int64_t n) {
@@ -195,6 +223,7 @@ class ShmMirror {
     h->nrows = 0;
     h->seed = seed;
     h->init_std = init_std;
+    h->flags = 0;
     h->valid = 1;
     l_ = shm_layout(base_);
     // ftruncate zero-fills, but 0 is a VALID row index: free slots are
@@ -229,6 +258,11 @@ class ShmMirror {
     if (live_) a_store(&l_.h->push_version, v);
   }
 
+  void SetTiered(bool tiered) {
+    if (live_)
+      a_storeu32(&l_.h->flags, tiered ? kShmFlagTiered : 0u);
+  }
+
   // One seqlock critical section for a whole batch of row upserts.
   // Returns false (and revokes) on overflow — the caller stops mirroring.
   bool WriteBatch(const int64_t* ids, const float* rows, int64_t n,
@@ -251,24 +285,105 @@ class ShmMirror {
     return fit;
   }
 
+  // One seqlock critical section re-publishing a batch of ids from the
+  // AUTHORITATIVE store: for each id, `fetch(id, dst)` copies the current
+  // value row (under its stripe lock) and returns true when the row is
+  // hot. Hot rows upsert; cold/absent rows tombstone — the slot stays in
+  // the probe chain (kSlotDead) but its row storage is recycled, and the
+  // segment is NOT revoked: a reader missing the id falls back to the
+  // wire, which is exactly the cold-tier contract. Reading the live row
+  // inside the critical section (rather than trusting a scratch copy
+  // taken earlier) is what makes concurrent publishes order-free: two
+  // racing pushes to the same id both publish the LATEST row, never a
+  // stale intermediate. Returns false (and revokes) on overflow.
+  template <typename F>
+  bool SyncBatch(const int64_t* ids, int64_t n, F&& fetch) {
+    if (!live_ || n == 0) return live_;
+    if (scratch_.size() < static_cast<size_t>(dim_)) scratch_.resize(dim_);
+    ShmHeader* h = l_.h;
+    __atomic_fetch_add(&h->seq, 1, __ATOMIC_ACQ_REL);  // odd: writing
+    bool fit = true;
+    for (int64_t i = 0; i < n; ++i) {
+      if (fetch(ids[i], scratch_.data())) {
+        int32_t row = FindOrInsert(ids[i]);
+        if (row < 0) {
+          fit = false;
+          break;
+        }
+        row_copy_in(l_.rows + static_cast<size_t>(row) * dim_,
+                    scratch_.data(), dim_);
+      } else {
+        TombstoneOne(ids[i]);
+      }
+    }
+    __atomic_fetch_add(&h->seq, 1, __ATOMIC_ACQ_REL);  // even: consistent
+    if (!fit) Revoke();
+    return fit;
+  }
+
  private:
-  int32_t FindOrInsert(int64_t id) {
+  // Tombstone one id (inside a caller-opened seqlock section). Absent id
+  // is a no-op — tombstoning never inserts.
+  void TombstoneOne(int64_t id) {
     const uint64_t mask = static_cast<uint64_t>(nslots_ - 1);
     uint64_t slot = splitmix64(static_cast<uint64_t>(id)) & mask;
     for (int64_t probes = 0; probes < nslots_; ++probes) {
-      int32_t row = a_load32(l_.slot_row + slot);
-      if (row >= 0) {
-        if (a_load64(l_.slot_id + slot) == id) return row;
-        slot = (slot + 1) & mask;
-        continue;
+      int32_t r = a_load32(l_.slot_row + slot);
+      if (r == kSlotFree) return;  // absent: nothing to tombstone
+      if (a_load64(l_.slot_id + slot) == id) {
+        if (r >= 0) {
+          free_rows_.push_back(r);
+          a_store32(l_.slot_row + slot, kSlotDead);
+        }
+        return;
       }
-      // free slot: claim it (single writer — no CAS needed)
-      int64_t nrows = l_.h->nrows;
-      if (nrows >= capacity_) return -1;
-      a_store64(l_.slot_id + slot, id);
-      a_store32(l_.slot_row + slot, static_cast<int32_t>(nrows));
-      l_.h->nrows = nrows + 1;
-      return static_cast<int32_t>(nrows);
+      slot = (slot + 1) & mask;
+    }
+  }
+
+  // Row storage allocator: recycle tombstoned rows first, then the
+  // high-water mark. -1 = truly full.
+  int32_t AllocRow() {
+    if (!free_rows_.empty()) {
+      int32_t row = free_rows_.back();
+      free_rows_.pop_back();
+      return row;
+    }
+    int64_t nrows = l_.h->nrows;
+    if (nrows >= capacity_) return -1;
+    l_.h->nrows = nrows + 1;
+    return static_cast<int32_t>(nrows);
+  }
+
+  int32_t FindOrInsert(int64_t id) {
+    const uint64_t mask = static_cast<uint64_t>(nslots_ - 1);
+    uint64_t slot = splitmix64(static_cast<uint64_t>(id)) & mask;
+    int64_t first_dead = -1;
+    for (int64_t probes = 0; probes < nslots_; ++probes) {
+      int32_t r = a_load32(l_.slot_row + slot);
+      if (r == kSlotFree) {
+        // Not present anywhere in the chain: insert (reusing the first
+        // tombstone passed, to keep probe chains short under churn).
+        int32_t row = AllocRow();
+        if (row < 0) return -1;
+        uint64_t target =
+            first_dead >= 0 ? static_cast<uint64_t>(first_dead) : slot;
+        a_store64(l_.slot_id + target, id);
+        a_store32(l_.slot_row + target, row);
+        return row;
+      }
+      if (a_load64(l_.slot_id + slot) == id) {
+        if (r >= 0) return r;
+        // Tombstoned entry for this exact id (demoted, now promoted back):
+        // revive in place with fresh row storage.
+        int32_t row = AllocRow();
+        if (row < 0) return -1;
+        a_store32(l_.slot_row + slot, row);
+        return row;
+      }
+      if (r == kSlotDead && first_dead < 0)
+        first_dead = static_cast<int64_t>(slot);
+      slot = (slot + 1) & mask;
     }
     return -1;
   }
@@ -281,6 +396,8 @@ class ShmMirror {
   size_t bytes_ = 0;
   ShmLayout l_{};
   bool live_ = false;
+  std::vector<int32_t> free_rows_;  // rows recycled by TombstoneOne
+  std::vector<float> scratch_;      // SyncBatch fetch staging (one row)
 };
 
 // Reader-side view (the co-located CLIENT process): read-only mapping,
@@ -323,10 +440,16 @@ class ShmReaderView {
   uint64_t seed() const { return l_.h->seed; }
   float init_std() const { return l_.h->init_std; }
   uint64_t nonce() const { return l_.h->nonce; }
+  bool tiered() const {
+    return (a_loadu32(const_cast<uint32_t*>(&l_.h->flags)) &
+            kShmFlagTiered) != 0;
+  }
 
   // Gather rows for `ids` into `out` ([n, dim]); found[i] = 1 when the id
-  // is mirrored, 0 when absent (caller materialises the deterministic
-  // lazy init — identical bits to what the server would answer).
+  // is mirrored, 0 when absent (for an UNTIERED store the caller
+  // materialises the deterministic lazy init — identical bits to what the
+  // server would answer; for a TIERED store an absent id may be a COLD row
+  // with real trained state, so the caller must fetch misses on the wire).
   // *version_out = the table push-version the gather is consistent at
   // (read INSIDE the seqlock window, so it can only be too old — the
   // safe direction for the caching contract). Returns the found count,
@@ -348,9 +471,9 @@ class ShmReaderView {
             splitmix64(static_cast<uint64_t>(ids[i])) & mask;
         for (int64_t probes = 0; probes < h->nslots; ++probes) {
           int32_t r = a_load32(l_.slot_row + slot);
-          if (r < 0) break;  // free slot terminates the probe chain
+          if (r == kSlotFree) break;  // free slot terminates the chain
           if (a_load64(l_.slot_id + slot) == ids[i]) {
-            row = r;
+            if (r >= 0) row = r;  // tombstone (kSlotDead) = miss
             break;
           }
           slot = (slot + 1) & mask;
@@ -380,10 +503,33 @@ class ShmReaderView {
   ShmLayout l_{};
 };
 
+// ---------------------------------------------------------------- stripes
+//
+// Every row carries a decayed access-frequency counter (freq): +1 on each
+// pull/push touch, multiplied by EASYDL_PS_TIER_DECAY at each maintenance
+// tick — so yesterday's hot set ages out. freq travels WITH the row across
+// tier moves but is process-local state (not exported/WAL'd): after a
+// restart frequencies re-learn from live traffic, which is exactly the
+// cache-warming behaviour wanted.
+
+// One index entry per row, BOTH tiers: loc >= 0 is an offset into the
+// stripe arena (hot); loc < 0 encodes cold mmap slot -(loc+1). A single
+// map keeps the tiered lookup exactly one hash probe — the cold tier's
+// whole point is that a cold ACCESS costs a DRAM-resident mmap copy, not
+// a second cache-missing hash walk on every tail id.
+struct RowRef {
+  int64_t loc;
+  float freq;
+};
+
+inline int64_t cold_slot_of(int64_t loc) { return -(loc + 1); }
+inline int64_t cold_loc_of(int64_t slot) { return -(slot + 1); }
+
 struct Stripe {
   std::mutex mu;
-  std::unordered_map<int64_t, size_t> index;  // id -> offset into arena
+  std::unordered_map<int64_t, RowRef> index;  // id -> row (either tier)
   std::vector<float> arena;                   // row_width floats per row
+  std::vector<size_t> free_hot;               // recycled arena offsets
 };
 
 class EmbeddingStore {
@@ -398,6 +544,8 @@ class EmbeddingStore {
         eps_(eps),
         row_width_(optimizer == kAdagrad ? 2 * dim : dim) {}
 
+  ~EmbeddingStore() { TierTeardown(); }
+
   int dim() const { return dim_; }
   int row_width() const { return row_width_; }
 
@@ -407,7 +555,9 @@ class EmbeddingStore {
     for (int64_t i = 0; i < n; ++i) {
       Stripe& s = stripes_[stripe_of(ids[i])];
       std::lock_guard<std::mutex> lock(s.mu);
-      float* row = FindOrInit(&s, ids[i]);
+      bool is_cold = false;
+      float* row = LocateRow(&s, ids[i], /*init_values=*/true,
+                             /*touch=*/true, &is_cold);
       std::memcpy(out + i * dim_, row, sizeof(float) * dim_);
     }
   }
@@ -435,24 +585,22 @@ class EmbeddingStore {
         for (int d = 0; d < dim_; ++d) dst[d] += src[d];
       }
     }
-    // shm write-through: post-update value rows are copied to scratch
-    // INSIDE the stripe lock (consistent row bytes) and mirrored in one
-    // seqlock critical section after the optimizer loop.
-    const bool mirror = mirror_on_.load(std::memory_order_acquire);
-    std::vector<float> mrows;
-    if (mirror) mrows.resize(uniq.size() * static_cast<size_t>(dim_));
     for (size_t u = 0; u < uniq.size(); ++u) {
       Stripe& s = stripes_[stripe_of(uniq[u])];
       std::lock_guard<std::mutex> lock(s.mu);
-      float* row = FindOrInit(&s, uniq[u]);
+      bool is_cold = false;
+      float* row = LocateRow(&s, uniq[u], /*init_values=*/true,
+                             /*touch=*/true, &is_cold);
       const float* g = acc.data() + u * dim_;
       ApplyUpdate(row, g, scale);
-      if (mirror)
-        std::memcpy(mrows.data() + u * dim_, row, sizeof(float) * dim_);
     }
-    if (mirror)
-      MirrorBatch(uniq.data(), mrows.data(),
-                  static_cast<int64_t>(uniq.size()), dim_);
+    // shm write-through: one seqlock critical section AFTER the optimizer
+    // loop re-reads each touched row from the store (under its stripe
+    // lock) and publishes it — hot rows upsert, cold rows tombstone (the
+    // mirror is hot-only; a stale hot value must not shadow a cold
+    // update, so the reader wires the miss instead).
+    if (mirror_on_.load(std::memory_order_acquire))
+      MirrorSync(uniq.data(), static_cast<int64_t>(uniq.size()));
   }
 
   int64_t Size() {
@@ -470,7 +618,8 @@ class EmbeddingStore {
   // pulling/pushing from other threads: no row in a single export straddles
   // an optimizer step, and the export is complete whenever
   // capacity >= Size() sampled under the same barrier (see SizeLocked use in
-  // eds_export_snapshot).
+  // eds_export_snapshot). BOTH tiers are exported — checkpoint/rescue/
+  // reshard semantics are layout-independent.
   int64_t Export(int64_t* ids_out, float* rows_out, int64_t capacity) {
     ExclusiveBarrier snap(this);
     return ExportLocked(ids_out, rows_out, capacity);
@@ -483,7 +632,13 @@ class EmbeddingStore {
       for (const auto& kv : s.index) {
         if (w >= capacity) return w;
         ids_out[w] = kv.first;
-        std::memcpy(rows_out + w * row_width_, s.arena.data() + kv.second,
+        const float* row =
+            kv.second.loc >= 0
+                ? s.arena.data() + kv.second.loc
+                : cold_base_ +
+                      static_cast<size_t>(cold_slot_of(kv.second.loc)) *
+                          row_width_;
+        std::memcpy(rows_out + w * row_width_, row,
                     sizeof(float) * row_width_);
         ++w;
       }
@@ -507,23 +662,27 @@ class EmbeddingStore {
     return ExportLocked(ids_out, rows_out, capacity);
   }
 
-  // rows: [n, row_width]; inserts or overwrites.
+  // rows: [n, row_width]; inserts or overwrites. A restore/replay lands in
+  // whichever tier currently OWNS the row (an unknown id places like any
+  // other first touch), so WAL replay and rescue are tier-transparent.
   void Import(const int64_t* ids, const float* rows, int64_t n) {
     std::shared_lock<std::shared_mutex> snap(SharedBarrier());
     for (int64_t i = 0; i < n; ++i) {
       Stripe& s = stripes_[stripe_of(ids[i])];
       std::lock_guard<std::mutex> lock(s.mu);
-      float* row = FindOrAlloc(&s, ids[i]);
+      bool is_cold = false;
+      float* row = LocateRow(&s, ids[i], /*init_values=*/false,
+                             /*touch=*/false, &is_cold);
       std::memcpy(row, rows + i * row_width_, sizeof(float) * row_width_);
     }
     if (mirror_on_.load(std::memory_order_acquire))
-      MirrorBatch(ids, rows, n, row_width_);  // value half of each row
+      MirrorSync(ids, n);  // hot rows upsert, cold rows tombstone
   }
 
   // ------------------------------------------------------------ shm export
-  // Publish a named seqlock-guarded mirror of this table's VALUE rows.
-  // Point-in-time under the exclusive barrier (mutators drained), then
-  // pushes/imports write through. Returns 0 on success.
+  // Publish a named seqlock-guarded mirror of this table's HOT-TIER VALUE
+  // rows. Point-in-time under the exclusive barrier (mutators drained),
+  // then pushes/imports write through. Returns 0 on success.
   int ShmExport(const char* name, uint64_t nonce, int64_t capacity_rows) {
     ExclusiveBarrier snap(this);
     std::lock_guard<std::mutex> lk(shm_mu_);
@@ -534,20 +693,22 @@ class EmbeddingStore {
       shm_.reset();
       return -1;
     }
+    shm_->SetTiered(tiered_.load(std::memory_order_acquire));
     std::vector<int64_t> sids;
     std::vector<float> srows;
     for (auto& s : stripes_) {
       sids.clear();
       srows.clear();
       for (const auto& kv : s.index) {
+        if (kv.second.loc < 0) continue;  // hot tier only
         sids.push_back(kv.first);
-        const float* row = s.arena.data() + kv.second;
+        const float* row = s.arena.data() + kv.second.loc;
         srows.insert(srows.end(), row, row + dim_);
       }
       if (!sids.empty() &&
           !shm_->WriteBatch(sids.data(), srows.data(),
                             static_cast<int64_t>(sids.size()), dim_)) {
-        shm_.reset();  // capacity too small for the existing table
+        shm_.reset();  // capacity too small for the existing hot tier
         return -1;
       }
     }
@@ -564,6 +725,222 @@ class EmbeddingStore {
     mirror_on_.store(false, std::memory_order_release);
     std::lock_guard<std::mutex> lk(shm_mu_);
     if (shm_) shm_->Revoke();
+  }
+
+  // ------------------------------------------------------------- tiering
+
+  // Split storage into hot (stripe arenas) and cold (one mmap'd file at
+  // `path`, created/truncated here, then unlinked IMMEDIATELY so the
+  // mapping is private to this store: the cold file is pure scratch
+  // (checkpoints/WAL are the durable artifacts), and keeping it linked
+  // invites aliasing — a second process opening the same path would
+  // O_TRUNC the live mapping and share its pages, silently cross-writing
+  // both stores' cold rows. Unlinking also means a SIGKILL'd shard leaks
+  // no on-disk file: the kernel reclaims the inode with the last mapping.
+  // All existing rows stay hot; maintenance moves them later. Returns 0
+  // on success.
+  int TierEnable(const char* path, int64_t hot_budget_bytes,
+                 int64_t cold_capacity_bytes) {
+    ExclusiveBarrier snap(this);
+    if (tiered_.load(std::memory_order_acquire)) return -1;
+    const int64_t row_bytes =
+        static_cast<int64_t>(row_width_) * static_cast<int64_t>(sizeof(float));
+    int64_t cap_rows = cold_capacity_bytes / row_bytes;
+    if (cap_rows < 1) cap_rows = 1;
+    int fd = open(path, O_CREAT | O_RDWR | O_TRUNC, 0600);
+    if (fd < 0) return -1;
+    size_t bytes = static_cast<size_t>(cap_rows) *
+                   static_cast<size_t>(row_bytes);
+    if (ftruncate(fd, static_cast<off_t>(bytes)) != 0) {
+      close(fd);
+      unlink(path);
+      return -1;
+    }
+    void* base =
+        mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    unlink(path);  // mapping stays valid; inode dies with the last mapper
+    if (base == MAP_FAILED) return -1;
+    {
+      std::lock_guard<std::mutex> ck(cold_mu_);
+      cold_path_ = path;
+      cold_base_ = static_cast<float*>(base);
+      cold_bytes_ = bytes;
+      cold_cap_rows_ = cap_rows;
+      cold_next_ = 0;
+      cold_free_.clear();
+    }
+    int64_t hot = 0;
+    for (auto& s : stripes_) {
+      std::lock_guard<std::mutex> lock(s.mu);
+      hot += static_cast<int64_t>(s.index.size());
+    }
+    hot_rows_.store(hot, std::memory_order_relaxed);
+    cold_rows_.store(0, std::memory_order_relaxed);
+    int64_t cap = hot_budget_bytes / row_bytes;
+    hot_cap_rows_.store(cap < 1 ? 1 : cap, std::memory_order_relaxed);
+    tiered_.store(true, std::memory_order_release);
+    {
+      std::lock_guard<std::mutex> lk(shm_mu_);
+      if (shm_) shm_->SetTiered(true);
+    }
+    return 0;
+  }
+
+  // Mechanically execute one promotion/demotion round. The SELECTION
+  // thresholds (decay, promote_min_freq, swap_margin, hot_target_rows)
+  // come from the pure Brain policy; this routine is deterministic given
+  // the store state: rows sort by (freq, id) so equal-frequency ties break
+  // identically on every run.
+  //   1. decay every freq (hot and cold) by `decay`;
+  //   2. demote the lowest-freq hot rows until hot fits hot_target_rows;
+  //   3. promote cold rows with freq >= promote_min_freq while under
+  //      target;
+  //   4. swap pass: while the warmest remaining cold row beats the coldest
+  //      remaining hot row by swap_margin, exchange them.
+  // max_moves bounds per-tick churn (0 = unbounded). out = {promoted,
+  // demoted}. Returns 0, or -1 when tiering is not enabled.
+  int TierMaintain(double decay, double promote_min_freq, double swap_margin,
+                   int64_t hot_target_rows, int64_t max_moves,
+                   int64_t* out) {
+    if (out != nullptr) out[0] = out[1] = 0;
+    if (!tiered_.load(std::memory_order_acquire)) return -1;
+    std::shared_lock<std::shared_mutex> snap(SharedBarrier());
+    if (hot_target_rows < 1) hot_target_rows = 1;
+    hot_cap_rows_.store(hot_target_rows, std::memory_order_relaxed);
+
+    struct Cand {
+      float freq;
+      int64_t id;
+    };
+    std::vector<Cand> hot;
+    std::vector<Cand> cold;
+    const float df = static_cast<float>(decay);
+    const float pmin = static_cast<float>(promote_min_freq);
+    for (auto& s : stripes_) {
+      std::lock_guard<std::mutex> lock(s.mu);
+      for (auto& kv : s.index) {
+        kv.second.freq *= df;
+        if (kv.second.loc >= 0) {
+          hot.push_back({kv.second.freq, kv.first});
+        } else if (kv.second.freq >= pmin) {
+          // Only promotable cold rows are candidates (steps 3 and 4 both
+          // require freq >= pmin) — the long tail's near-zero decayed
+          // freqs would otherwise make every steady-state tick pay a
+          // full sort of the WHOLE cold tier just to move nothing.
+          cold.push_back({kv.second.freq, kv.first});
+        }
+      }
+    }
+    // Deterministic orders: hot coldest-first, cold warmest-first; id
+    // breaks ties so replay is byte-stable. The hot side only needs its
+    // coldest k rows ordered: step 2 consumes at most (hot - target) and
+    // the swap pass at most one per cold candidate, so a partial sort
+    // bounds the steady-state tick at O(hot + k log hot).
+    const int64_t over =
+        std::max<int64_t>(static_cast<int64_t>(hot.size()) - hot_target_rows,
+                          0);
+    const size_t k = std::min(hot.size(),
+                              static_cast<size_t>(over) + cold.size());
+    std::partial_sort(hot.begin(), hot.begin() + k, hot.end(),
+                      [](const Cand& a, const Cand& b) {
+                        if (a.freq != b.freq) return a.freq < b.freq;
+                        return a.id < b.id;
+                      });
+    std::sort(cold.begin(), cold.end(), [](const Cand& a, const Cand& b) {
+      if (a.freq != b.freq) return a.freq > b.freq;
+      return a.id < b.id;
+    });
+
+    std::vector<int64_t> demote_ids;
+    std::vector<int64_t> promote_ids;
+    size_t hi = 0;  // next hot demotion candidate (coldest first)
+    size_t cj = 0;  // next cold promotion candidate (warmest first)
+    int64_t hot_n = static_cast<int64_t>(hot.size());
+    const float margin = static_cast<float>(swap_margin);
+    auto budget_left = [&]() {
+      return max_moves <= 0 ||
+             static_cast<int64_t>(demote_ids.size() + promote_ids.size()) <
+                 max_moves;
+    };
+    // 2. shrink hot to target
+    while (hot_n > hot_target_rows && hi < hot.size() && budget_left()) {
+      demote_ids.push_back(hot[hi].id);
+      ++hi;
+      --hot_n;
+    }
+    // 3. fill spare hot capacity with warm cold rows
+    while (hot_n < hot_target_rows && cj < cold.size() &&
+           cold[cj].freq >= pmin && budget_left()) {
+      promote_ids.push_back(cold[cj].id);
+      ++cj;
+      ++hot_n;
+    }
+    // 4. swap clearly-hotter cold rows in for clearly-colder hot rows
+    while (hi < hot.size() && cj < cold.size() && budget_left() &&
+           cold[cj].freq > hot[hi].freq * margin && cold[cj].freq >= pmin) {
+      demote_ids.push_back(hot[hi].id);
+      promote_ids.push_back(cold[cj].id);
+      ++hi;
+      ++cj;
+    }
+
+    int64_t demoted = 0;
+    for (int64_t id : demote_ids)
+      if (DemoteRow(id)) ++demoted;
+    int64_t promoted = 0;
+    for (int64_t id : promote_ids)
+      if (PromoteRow(id)) ++promoted;
+    // One mirror publication for the whole round: each moved id re-reads
+    // its CURRENT tier under the stripe lock, so demotions tombstone and
+    // promotions upsert the freshest value even when a push raced the
+    // move.
+    if (mirror_on_.load(std::memory_order_acquire)) {
+      std::vector<int64_t> moved(demote_ids);
+      moved.insert(moved.end(), promote_ids.begin(), promote_ids.end());
+      if (!moved.empty())
+        MirrorSync(moved.data(), static_cast<int64_t>(moved.size()));
+    }
+
+    promotions_.fetch_add(promoted, std::memory_order_relaxed);
+    demotions_.fetch_add(demoted, std::memory_order_relaxed);
+    if (out != nullptr) {
+      out[0] = promoted;
+      out[1] = demoted;
+    }
+    return 0;
+  }
+
+  // out[10] = {tiered, hot_rows, cold_rows, promotions, demotions,
+  //            cold_hits, hot_bytes, cold_bytes, warm_cold_rows,
+  //            hot_cap_rows}. warm_cold_rows counts cold rows whose decayed
+  //            freq >= warm_min_freq — the policy's promotion demand signal.
+  void TierStats(double warm_min_freq, double* out) {
+    const int64_t row_bytes =
+        static_cast<int64_t>(row_width_) * static_cast<int64_t>(sizeof(float));
+    const bool tiered = tiered_.load(std::memory_order_acquire);
+    int64_t warm = 0;
+    if (tiered) {
+      const float wmin = static_cast<float>(warm_min_freq);
+      for (auto& s : stripes_) {
+        std::lock_guard<std::mutex> lock(s.mu);
+        for (const auto& kv : s.index)
+          if (kv.second.loc < 0 && kv.second.freq >= wmin) ++warm;
+      }
+    }
+    const int64_t hot = hot_rows_.load(std::memory_order_relaxed);
+    const int64_t cold = cold_rows_.load(std::memory_order_relaxed);
+    out[0] = tiered ? 1.0 : 0.0;
+    out[1] = static_cast<double>(hot);
+    out[2] = static_cast<double>(cold);
+    out[3] = static_cast<double>(promotions_.load(std::memory_order_relaxed));
+    out[4] = static_cast<double>(demotions_.load(std::memory_order_relaxed));
+    out[5] = static_cast<double>(cold_hits_.load(std::memory_order_relaxed));
+    out[6] = static_cast<double>(hot * row_bytes);
+    out[7] = static_cast<double>(cold * row_bytes);
+    out[8] = static_cast<double>(warm);
+    out[9] =
+        static_cast<double>(hot_cap_rows_.load(std::memory_order_relaxed));
   }
 
  private:
@@ -583,24 +960,125 @@ class EmbeddingStore {
     for (int d = dim_; d < row_width_; ++d) row[d] = 0.0f;  // optimizer slots
   }
 
-  float* FindOrAlloc(Stripe* s, int64_t id) {
-    auto it = s->index.find(id);
-    if (it != s->index.end()) return s->arena.data() + it->second;
-    const size_t off = s->arena.size();
-    s->arena.resize(off + row_width_);
-    s->index.emplace(id, off);
-    return s->arena.data() + off;
+  // Hot arena allocator: recycle demoted slots first, then grow. Returns
+  // the arena offset; the caller owns the index entry.
+  size_t AllocHotArena(Stripe* s) {
+    size_t off;
+    if (!s->free_hot.empty()) {
+      off = s->free_hot.back();
+      s->free_hot.pop_back();
+    } else {
+      off = s->arena.size();
+      s->arena.resize(off + row_width_);
+    }
+    hot_rows_.fetch_add(1, std::memory_order_relaxed);
+    return off;
   }
 
-  float* FindOrInit(Stripe* s, int64_t id) {
+  // Cold slot allocator (store-wide, under cold_mu_). -1 = cold tier full;
+  // the caller overflows into the hot tier so capacity never hard-fails.
+  int64_t AllocColdSlot() {
+    std::lock_guard<std::mutex> ck(cold_mu_);
+    if (!cold_free_.empty()) {
+      int64_t slot = cold_free_.back();
+      cold_free_.pop_back();
+      return slot;
+    }
+    if (cold_next_ >= cold_cap_rows_) return -1;
+    return cold_next_++;
+  }
+
+  void FreeColdSlot(int64_t slot) {
+    std::lock_guard<std::mutex> ck(cold_mu_);
+    cold_free_.push_back(slot);
+  }
+
+  // Resolve (or place) a row; caller holds the stripe lock, and the
+  // returned pointer is valid only while it does. `touch` bumps the access
+  // frequency (pull/push traffic); `init_values` materialises the lazy
+  // deterministic init on a miss (Import overwrites anyway and skips it).
+  // New rows go hot while hot_rows_ < hot_cap_rows_, else cold — so a
+  // >RAM table never outgrows its hot budget between maintenance ticks.
+  float* LocateRow(Stripe* s, int64_t id, bool init_values, bool touch,
+                   bool* is_cold) {
     auto it = s->index.find(id);
-    if (it != s->index.end()) return s->arena.data() + it->second;
-    const size_t off = s->arena.size();
-    s->arena.resize(off + row_width_);
-    s->index.emplace(id, off);
+    if (it != s->index.end()) {
+      if (touch) it->second.freq += 1.0f;
+      if (it->second.loc >= 0) {
+        *is_cold = false;
+        return s->arena.data() + it->second.loc;
+      }
+      if (touch) cold_hits_.fetch_add(1, std::memory_order_relaxed);
+      *is_cold = true;
+      return cold_base_ +
+             static_cast<size_t>(cold_slot_of(it->second.loc)) * row_width_;
+    }
+    if (tiered_.load(std::memory_order_acquire) &&
+        hot_rows_.load(std::memory_order_relaxed) >=
+            hot_cap_rows_.load(std::memory_order_relaxed)) {
+      int64_t slot = AllocColdSlot();
+      if (slot >= 0) {
+        s->index.emplace(id, RowRef{cold_loc_of(slot), 1.0f});
+        cold_rows_.fetch_add(1, std::memory_order_relaxed);
+        float* row = cold_base_ + static_cast<size_t>(slot) * row_width_;
+        if (init_values) InitRow(id, row);
+        *is_cold = true;
+        return row;
+      }
+      // cold tier full: overflow hot rather than fail
+    }
+    size_t off = AllocHotArena(s);
+    s->index.emplace(id, RowRef{static_cast<int64_t>(off), 1.0f});
     float* row = s->arena.data() + off;
-    InitRow(id, row);
+    if (init_values) InitRow(id, row);
+    *is_cold = false;
     return row;
+  }
+
+  // Move one row hot -> cold. Returns false when the row vanished, is
+  // already cold, or the cold tier is full (all benign: the plan is
+  // advisory).
+  bool DemoteRow(int64_t id) {
+    Stripe& s = stripes_[stripe_of(id)];
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.index.find(id);
+    if (it == s.index.end() || it->second.loc < 0) return false;
+    int64_t slot = AllocColdSlot();
+    if (slot < 0) return false;
+    std::memcpy(cold_base_ + static_cast<size_t>(slot) * row_width_,
+                s.arena.data() + it->second.loc,
+                sizeof(float) * row_width_);
+    s.free_hot.push_back(static_cast<size_t>(it->second.loc));
+    it->second.loc = cold_loc_of(slot);  // freq rides the same entry
+    hot_rows_.fetch_sub(1, std::memory_order_relaxed);
+    cold_rows_.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+
+  // Move one row cold -> hot; the caller republishes it to the mirror via
+  // MirrorSync afterwards.
+  bool PromoteRow(int64_t id) {
+    Stripe& s = stripes_[stripe_of(id)];
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.index.find(id);
+    if (it == s.index.end() || it->second.loc >= 0) return false;
+    const int64_t slot = cold_slot_of(it->second.loc);
+    size_t off = AllocHotArena(&s);
+    std::memcpy(s.arena.data() + off,
+                cold_base_ + static_cast<size_t>(slot) * row_width_,
+                sizeof(float) * row_width_);
+    it->second.loc = static_cast<int64_t>(off);  // freq preserved in place
+    FreeColdSlot(slot);
+    cold_rows_.fetch_sub(1, std::memory_order_relaxed);
+    return true;
+  }
+
+  void TierTeardown() {
+    std::lock_guard<std::mutex> ck(cold_mu_);
+    if (cold_base_ != nullptr) {
+      munmap(cold_base_, cold_bytes_);  // file already unlinked at enable
+      cold_base_ = nullptr;
+    }
   }
 
   void ApplyUpdate(float* row, const float* grad, float scale) {
@@ -651,12 +1129,25 @@ class EmbeddingStore {
     EmbeddingStore* s_;
   };
 
-  void MirrorBatch(const int64_t* ids, const float* rows, int64_t n,
-                   int64_t stride) {
+  // Republish `ids` to the mirror from the authoritative store: hot rows
+  // upsert (value re-read under the stripe lock INSIDE the seqlock
+  // section — see SyncBatch for why that kills stale-publish races), cold
+  // and absent rows tombstone. Callers must hold NO stripe lock (lock
+  // order: shm_mu_ before stripe.mu).
+  void MirrorSync(const int64_t* ids, int64_t n) {
     std::lock_guard<std::mutex> lk(shm_mu_);
     if (!shm_) return;
-    if (!shm_->WriteBatch(ids, rows, n, stride))
-      mirror_on_.store(false, std::memory_order_release);  // revoked
+    bool ok = shm_->SyncBatch(ids, n, [this](int64_t id, float* dst) {
+      Stripe& s = stripes_[stripe_of(id)];
+      std::lock_guard<std::mutex> lock(s.mu);
+      auto it = s.index.find(id);
+      if (it == s.index.end() || it->second.loc < 0)
+        return false;  // cold or absent: tombstone
+      std::memcpy(dst, s.arena.data() + it->second.loc,
+                  sizeof(float) * dim_);
+      return true;
+    });
+    if (!ok) mirror_on_.store(false, std::memory_order_release);  // revoked
   }
 
   const int row_width_;
@@ -665,6 +1156,29 @@ class EmbeddingStore {
   std::mutex shm_mu_;
   std::unique_ptr<ShmMirror> shm_;
   std::atomic<bool> mirror_on_{false};
+
+  // Cold tier: one mmap'd file; the slot ALLOCATOR is store-wide (under
+  // cold_mu_), but a cold row's DATA is guarded by its owning stripe's
+  // mutex — a slot belongs to exactly one id at a time, and free/realloc
+  // transitions pass through cold_mu_. Lock order everywhere:
+  // barrier -> shm_mu_ -> stripe.mu -> cold_mu_ (never two stripes; no
+  // path acquires shm_mu_ while holding a stripe lock — MirrorSync is
+  // always called after the mutation loop releases its stripe locks).
+  std::atomic<bool> tiered_{false};
+  std::mutex cold_mu_;
+  std::string cold_path_;
+  float* cold_base_ = nullptr;
+  size_t cold_bytes_ = 0;
+  int64_t cold_cap_rows_ = 0;
+  int64_t cold_next_ = 0;
+  std::vector<int64_t> cold_free_;
+  std::atomic<int64_t> hot_rows_{0};
+  std::atomic<int64_t> cold_rows_{0};
+  std::atomic<int64_t> hot_cap_rows_{INT64_MAX};
+  std::atomic<int64_t> promotions_{0};
+  std::atomic<int64_t> demotions_{0};
+  std::atomic<int64_t> cold_hits_{0};
+
   Stripe stripes_[kNumStripes];
 };
 
@@ -709,6 +1223,24 @@ void eds_import(void* h, const int64_t* ids, const float* rows, int64_t n) {
   static_cast<EmbeddingStore*>(h)->Import(ids, rows, n);
 }
 
+// ------------------------------------------------------ tier entry points
+int eds_tier_enable(void* h, const char* path, int64_t hot_budget_bytes,
+                    int64_t cold_capacity_bytes) {
+  return static_cast<EmbeddingStore*>(h)->TierEnable(path, hot_budget_bytes,
+                                                     cold_capacity_bytes);
+}
+
+int eds_tier_maintain(void* h, double decay, double promote_min_freq,
+                      double swap_margin, int64_t hot_target_rows,
+                      int64_t max_moves, int64_t* out) {
+  return static_cast<EmbeddingStore*>(h)->TierMaintain(
+      decay, promote_min_freq, swap_margin, hot_target_rows, max_moves, out);
+}
+
+void eds_tier_stats(void* h, double warm_min_freq, double* out) {
+  static_cast<EmbeddingStore*>(h)->TierStats(warm_min_freq, out);
+}
+
 // ------------------------------------------------------- shm entry points
 // Server side (store handle): export / version write-through / revoke.
 int eds_shm_export(void* h, const char* name, uint64_t nonce,
@@ -734,6 +1266,10 @@ void eds_shm_close(void* r) { delete static_cast<ShmReaderView*>(r); }
 
 int64_t eds_shm_reader_dim(void* r) {
   return static_cast<ShmReaderView*>(r)->dim();
+}
+
+int eds_shm_reader_tiered(void* r) {
+  return static_cast<ShmReaderView*>(r)->tiered() ? 1 : 0;
 }
 
 void eds_shm_reader_meta(void* r, uint64_t* seed, float* init_std,

@@ -3,12 +3,14 @@
 // store's translation unit directly so the sanitizer instruments the real
 // code, then hammers the concurrent surface the gRPC shard exposes: many
 // threads pulling/pushing overlapping id ranges while another exports for
-// checkpointing.
+// checkpointing. Phase 3 arms the two-tier backend and races background
+// promotion/demotion against the same pushers and shm gatherers.
 
 #include "embedding_store.cc"  // NOLINT(build/include)
 
 #include <atomic>
 #include <cassert>
+#include <chrono>
 #include <cstdio>
 #include <thread>
 #include <vector>
@@ -136,6 +138,74 @@ int main() {
                          direct.data() + i * kDim,
                          sizeof(float) * kDim) == 0);
     }
+    eds_shm_close(r);
+  }
+
+  // ---- phase 3: tier maintenance vs pushers vs shm gatherers ----
+  // Arm the two-tier backend with a hot arena far smaller than the id
+  // space, then race a maintenance thread (decay + demote + promote,
+  // every move rewriting the mirror via tombstone/write-through batches)
+  // against the same pusher and gather workload. This is the surface the
+  // shard's _tier_loop exposes in production; TSan must see the stripe
+  // mutex + seqlock discipline hold across tier moves.
+  constexpr int64_t kHotCap = kIds / 4;
+  assert(eds_tier_enable(store, "/tmp/eds-stress-tier.cold",
+                         kHotCap * 2 * kDim * sizeof(float),
+                         kIds * 4 * 2 * kDim * sizeof(float)) == 0);
+  stop.store(false);
+  std::vector<std::thread> phase3;
+  for (int t = 0; t < 3; ++t) {
+    phase3.emplace_back(shm_reader, kSeg, &stop, &gathers);
+  }
+  std::thread maintainer([&]() {
+    int64_t out2[2];
+    while (!stop.load()) {
+      eds_tier_maintain(store, /*decay=*/0.9, /*promote_min_freq=*/1.0,
+                        /*swap_margin=*/1.25, /*hot_target_rows=*/kHotCap,
+                        /*max_moves=*/64, out2);
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  for (int t = 0; t < kThreads; ++t) {
+    phase3.emplace_back(worker, store, 200 + t, &stop);
+  }
+  for (size_t t = phase3.size() - kThreads; t < phase3.size(); ++t) {
+    phase3[t].join();  // pushers run their kIters then exit
+  }
+  stop.store(true);
+  maintainer.join();
+  for (int t = 0; t < 3; ++t) phase3[t].join();
+  double stats[10];
+  eds_tier_stats(store, /*warm_min_freq=*/1.0, stats);
+  assert(stats[0] == 1.0);               // tiered
+  assert(stats[4] > 0.0);                // demotions happened: not vacuous
+  assert(eds_size(store) == rows);       // tier moves never lose rows
+
+  // quiesced consistency again, now across both tiers: rows the mirror
+  // still holds (hot) must match eds_pull bitwise; demoted rows surface
+  // as found=0 (the wire-fallback contract), never as stale values.
+  {
+    void* r = eds_shm_open(kSeg, 0xabcdef);
+    assert(r != nullptr);
+    assert(eds_shm_reader_tiered(r) == 1);
+    std::vector<int64_t> ids(kIds);
+    for (int64_t i = 0; i < kIds; ++i) ids[i] = i;
+    std::vector<float> via_shm(kIds * kDim), direct(kIds * kDim);
+    std::vector<uint8_t> found(kIds);
+    uint64_t version = 0;
+    int64_t n = eds_shm_gather(r, ids.data(), kIds, via_shm.data(),
+                               found.data(), &version);
+    assert(n >= 0);
+    int64_t hot_found = 0;
+    eds_pull(store, ids.data(), kIds, direct.data());
+    for (int64_t i = 0; i < kIds; ++i) {
+      if (!found[i]) continue;
+      ++hot_found;
+      assert(std::memcmp(via_shm.data() + i * kDim,
+                         direct.data() + i * kDim,
+                         sizeof(float) * kDim) == 0);
+    }
+    assert(hot_found < static_cast<int64_t>(rows));  // some rows spilled
     eds_shm_close(r);
   }
 

@@ -31,7 +31,12 @@ from easydl_tpu.obs import get_registry, start_exporter
 from easydl_tpu.proto import easydl_pb2 as pb
 from easydl_tpu.ps import quant as _quant
 from easydl_tpu.ps import wal as _wal
-from easydl_tpu.ps.table import EmbeddingTable, TableSpec, shard_of
+from easydl_tpu.ps.table import (
+    EmbeddingTable,
+    TableSpec,
+    shard_of,
+    split_namespace,
+)
 from easydl_tpu.utils.env import env_flag as _env_flag
 from easydl_tpu.utils.logging import get_logger
 from easydl_tpu.utils.rpc import GRPC_MSG_OPTIONS, ServiceDef, serve
@@ -295,6 +300,34 @@ class PsShard:
             "epoch (0 = fencing off).", ("shard",))
         self._m_epoch.set(self.epoch, shard=shard_l)
         self._shard_label = shard_l
+        # Two-tier store (PR 20): EASYDL_PS_TIER_HOT_MB > 0 arms the cold
+        # mmap spill under the shard workdir at table creation; a
+        # maintenance loop then decays access frequencies and walks rows
+        # between tiers toward the pure policy's per-table hot targets
+        # (brain/tier_policy.py — every decision logged, byte-replayable).
+        self._tier_hot_bytes = knob_int("EASYDL_PS_TIER_HOT_MB") << 20
+        self._tier_cold_bytes = knob_int("EASYDL_PS_TIER_COLD_MB") << 20
+        self._tier_interval_s = knob_float("EASYDL_PS_TIER_PROMOTE_INTERVAL_S")
+        self._tier_decay = knob_float("EASYDL_PS_TIER_DECAY")
+        self._tier_thread: Optional[threading.Thread] = None
+        self._tier_stop = threading.Event()
+        self._tier_last: Dict[str, Dict[str, int]] = {}
+        self.tier_decision_log: list = []
+        self._m_tier_hot = reg.gauge(
+            "easydl_ps_tier_hot_rows", "Hot-tier (in-arena) rows per table "
+            "on this shard.", ("shard", "table"))
+        self._m_tier_cold = reg.gauge(
+            "easydl_ps_tier_cold_rows", "Cold-tier (mmap-spilled) rows per "
+            "table on this shard.", ("shard", "table"))
+        self._m_tier_promotions = reg.counter(
+            "easydl_ps_tier_promotions_total", "Rows promoted cold -> hot "
+            "by tier maintenance.", ("shard", "table"))
+        self._m_tier_demotions = reg.counter(
+            "easydl_ps_tier_demotions_total", "Rows demoted hot -> cold by "
+            "tier maintenance.", ("shard", "table"))
+        self._m_tier_cold_hits = reg.counter(
+            "easydl_ps_tier_cold_hits_total", "Pull/push touches served "
+            "from the cold tier.", ("shard", "table"))
 
     # ----------------------------------------------------------- table admin
     def create_table(self, spec: TableSpec) -> EmbeddingTable:
@@ -321,6 +354,23 @@ class PsShard:
                 t = EmbeddingTable(spec, backend=self._backend,
                                    version_base=max(self.epoch, 0) << 32)
                 self._tables[spec.name] = t
+            if self._tier_hot_bytes > 0:
+                # Arm the cold spill BEFORE any shm export, so the mirror
+                # is born tiered (its misses mean "maybe cold", and the
+                # client wires them instead of lazy-initialising). Never
+                # load-bearing: a failed enable leaves the table
+                # single-tier, which is always correct.
+                try:
+                    if t.tier_enable(self._tier_cold_path(spec.name),
+                                     self._tier_hot_bytes,
+                                     self._tier_cold_bytes):
+                        log.info("ps shard %d: table %r tiered (hot budget "
+                                 "%d MiB, cold cap %d MiB)",
+                                 self.shard_index, spec.name,
+                                 self._tier_hot_bytes >> 20,
+                                 self._tier_cold_bytes >> 20)
+                except Exception as e:
+                    count_swallowed("ps.server.tier_enable", e)
             if _env_flag(ENV_SHM, False):
                 # Arm the zero-copy mirror (native backend only —
                 # shm_export is a no-op on numpy). Never load-bearing: a
@@ -343,6 +393,107 @@ class PsShard:
         if t is None:
             raise KeyError(f"no such table {name!r}")
         return t
+
+    # ------------------------------------------------------------- tiering
+    #: A cold row is promotion-worthy once its decayed frequency clears
+    #: this; the swap margin is the hysteresis keeping borderline rows from
+    #: ping-ponging between tiers every tick. Constants, not knobs: they
+    #: shape WHICH rows move, the knobs shape HOW MUCH room there is.
+    TIER_PROMOTE_MIN_FREQ = 1.0
+    TIER_SWAP_MARGIN = 1.25
+
+    def _tier_dir(self) -> str:
+        import tempfile
+
+        base = self._workdir or tempfile.gettempdir()
+        d = os.path.join(base, "ps-tier", f"shard-{self.shard_index}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _tier_cold_path(self, table: str) -> str:
+        # The pid makes the path unique per shard INCARNATION, not just per
+        # shard index: during an online reshard, source shard k-of-N and
+        # destination shard k-of-2N are alive at once with the same index
+        # and workdir, and a shared cold file would alias their mmap'd cold
+        # tiers (the dest's O_TRUNC zeroes the source's live spill, then
+        # both scribble the same pages). The native store unlinks the file
+        # right after mmap, so these never accumulate on disk.
+        return os.path.join(self._tier_dir(),
+                            "%s.%d.cold" % (table.replace(":", "_"),
+                                            os.getpid()))
+
+    def tier_maintain_once(self) -> Optional[dict]:
+        """One maintenance tick: snapshot every tiered table's stats, run
+        the pure policy, log the (inputs, verdict) record, mechanically
+        execute the per-table plan, publish the tier metrics. Returns the
+        decision record (None when nothing is tiered)."""
+        from easydl_tpu.brain import tier_policy as _tp
+
+        with self._lock:
+            tables = list(self._tables.values())
+        stats = {}
+        docs = []
+        for t in tables:
+            st = t.tier_stats(warm_min_freq=self.TIER_PROMOTE_MIN_FREQ)
+            if not st["tiered"]:
+                continue
+            stats[t.name] = st
+            docs.append(_tp.TableTierStats(
+                name=t.name, namespace=split_namespace(t.name)[0],
+                row_bytes=t.spec.row_width * 4,
+                hot_rows=st["hot_rows"], cold_rows=st["cold_rows"],
+                warm_cold_rows=st["warm_cold_rows"]))
+        if not docs:
+            return None
+        cfg = _tp.TierConfig(
+            hot_budget_bytes=self._tier_hot_bytes, decay=self._tier_decay,
+            promote_min_freq=self.TIER_PROMOTE_MIN_FREQ,
+            swap_margin=self.TIER_SWAP_MARGIN, max_moves=0)
+        plan = _tp.tier_plan(docs, cfg)
+        record = {
+            "inputs": {"tables": [d.to_dict() for d in docs],
+                       "config": cfg.to_dict()},
+            "verdict": plan,
+        }
+        self.tier_decision_log.append(record)
+        try:
+            with open(os.path.join(self._tier_dir(), "decisions.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(record, sort_keys=True) + "\n")
+        except OSError as e:
+            count_swallowed("ps.server.tier_log", e)
+        shard_l = self._shard_label
+        for t in tables:
+            doc = plan["tables"].get(t.name)
+            if doc is None:
+                continue
+            t.tier_maintain(
+                plan["params"]["decay"],
+                plan["params"]["promote_min_freq"],
+                plan["params"]["swap_margin"],
+                doc["hot_target_rows"], doc["max_moves"])
+            st = t.tier_stats(warm_min_freq=self.TIER_PROMOTE_MIN_FREQ)
+            self._m_tier_hot.set(st["hot_rows"], shard=shard_l,
+                                 table=t.name)
+            self._m_tier_cold.set(st["cold_rows"], shard=shard_l,
+                                  table=t.name)
+            last = self._tier_last.get(t.name, {})
+            for key, counter in (
+                    ("promotions", self._m_tier_promotions),
+                    ("demotions", self._m_tier_demotions),
+                    ("cold_hits", self._m_tier_cold_hits)):
+                delta = st[key] - last.get(key, 0)
+                if delta > 0:
+                    counter.inc(delta, shard=shard_l, table=t.name)
+            self._tier_last[t.name] = st
+        return record
+
+    def _tier_loop(self) -> None:
+        while not self._tier_stop.wait(max(self._tier_interval_s, 0.05)):
+            try:
+                self.tier_maintain_once()
+            except Exception as e:
+                count_swallowed("ps.server.tier_maintain", e)
 
     # ------------------------------------------------------------ checkpoint
     def save(self, directory: str, step: int,
@@ -1183,6 +1334,11 @@ class PsShard:
                          self.shard_index, n)
         self._server = serve(PS_SERVICE, self, port=port,
                              options=GRPC_MSG_OPTIONS)
+        if self._tier_hot_bytes > 0 and self._tier_thread is None:
+            self._tier_stop.clear()
+            self._tier_thread = threading.Thread(
+                target=self._tier_loop, name="ps-tier", daemon=True)
+            self._tier_thread.start()
         self._exporter = start_exporter(
             obs_name or f"ps-{self.shard_index}", workdir=obs_workdir,
             health_fn=lambda: {
@@ -1202,6 +1358,10 @@ class PsShard:
         return self._server
 
     def stop(self) -> None:
+        if self._tier_thread is not None:
+            self._tier_stop.set()
+            self._tier_thread.join(timeout=5.0)
+            self._tier_thread = None
         self._shm_revoke_all()  # unlink segments; readers see `revoked`
         if self._server is not None:
             self._server.stop()

@@ -74,6 +74,13 @@ class ShmReader:
             ctypes.byref(std), None)
         self.seed = int(seed[0])
         self.init_std = float(std.value)
+        #: Tiered-store flag, read once at open: the server enables tiering
+        #: BEFORE exporting the mirror (EmbeddingTable.tier_enable enforces
+        #: the order), so the flag is fixed for the segment's lifetime. On
+        #: a tiered segment a miss may be a COLD row with real trained
+        #: state — lazy-initialising it locally would serve wrong values,
+        #: so pulls return the miss mask and the caller wires the misses.
+        self.tiered = bool(lib.eds_shm_reader_tiered(handle))
 
     def _release(self) -> None:
         h, self._h = self._h, None
@@ -100,19 +107,33 @@ class ShmReader:
         deterministic lazy init (an id never pushed/imported has exactly
         that value on the shard too). Raises :class:`ShmUnavailable` on a
         revoked segment or persistent write contention."""
+        out, version, _miss = self._pull(ids, partial=False)
+        return out, version
+
+    def pull_partial(
+            self, ids: np.ndarray
+    ) -> Tuple[np.ndarray, int, Optional[np.ndarray]]:
+        """Like :meth:`pull`, but misses are returned instead of filled:
+        ``(rows, version, miss_mask_or_None)``. Rows where ``miss`` is True
+        are UNDEFINED and must be fetched on the wire — this is the only
+        correct gather on a tiered segment, where an absent id may be a
+        cold row carrying real trained state."""
+        return self._pull(ids, partial=True)
+
+    def _pull(self, ids: np.ndarray, partial: bool):
         with self._mu:
             if self._closed or not self._h:
                 raise ShmUnavailable("reader closed", revoked=True)
             self._pins += 1
         try:
-            return self._pull_pinned(ids)
+            return self._pull_pinned(ids, partial)
         finally:
             with self._mu:
                 self._pins -= 1
                 if self._closed and self._pins == 0:
                     self._release()
 
-    def _pull_pinned(self, ids: np.ndarray) -> Tuple[np.ndarray, int]:
+    def _pull_pinned(self, ids: np.ndarray, partial: bool):
         ids = np.ascontiguousarray(ids, np.int64)
         n = len(ids)
         out = np.empty((n, self.dim), np.float32)
@@ -128,11 +149,21 @@ class ShmReader:
             raise ShmUnavailable("segment revoked", revoked=True)
         if rc < 0:
             raise ShmUnavailable("seqlock contention", revoked=False)
+        miss = None
         if rc < n:
             miss = found == 0
-            out[miss] = init_rows(ids[miss], self.dim, self.dim,
-                                  self.seed, self.init_std)[:, :self.dim]
-        return out, int(version[0])
+            if not self.tiered:
+                # Untiered: an absent id has never been pushed/imported,
+                # so its value IS the deterministic lazy init.
+                out[miss] = init_rows(ids[miss], self.dim, self.dim,
+                                      self.seed, self.init_std)[:, :self.dim]
+                miss = None
+            elif not partial:
+                # A plain pull cannot materialise tiered misses (the row
+                # may be cold, not unborn) — the whole batch goes to the
+                # wire rather than ever serving a wrong lazy init.
+                raise ShmUnavailable("cold miss", revoked=False)
+        return out, int(version[0]), miss
 
 
 def sweep_stale_segments(root: str = "/dev/shm") -> int:

@@ -368,6 +368,41 @@ class _NativeStore:
     def shm_revoke(self) -> None:
         self._lib.eds_shm_revoke(self._h)
 
+    # ------------------------------------------------------------ two-tier
+    def tier_enable(self, path: str, hot_budget_bytes: int,
+                    cold_capacity_bytes: int) -> bool:
+        return self._lib.eds_tier_enable(
+            self._h, path.encode(), int(hot_budget_bytes),
+            int(cold_capacity_bytes)) == 0
+
+    def tier_maintain(self, decay: float, promote_min_freq: float,
+                      swap_margin: float, hot_target_rows: int,
+                      max_moves: int) -> Tuple[int, int]:
+        out = np.zeros(2, np.int64)
+        self._lib.eds_tier_maintain(
+            self._h, ctypes.c_double(decay), ctypes.c_double(promote_min_freq),
+            ctypes.c_double(swap_margin), int(hot_target_rows),
+            int(max_moves), self._i64p(out))
+        return int(out[0]), int(out[1])
+
+    def tier_stats(self, warm_min_freq: float = 1.0) -> dict:
+        out = np.zeros(10, np.float64)
+        self._lib.eds_tier_stats(
+            self._h, ctypes.c_double(warm_min_freq),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return {
+            "tiered": bool(out[0]),
+            "hot_rows": int(out[1]),
+            "cold_rows": int(out[2]),
+            "promotions": int(out[3]),
+            "demotions": int(out[4]),
+            "cold_hits": int(out[5]),
+            "hot_bytes": int(out[6]),
+            "cold_bytes": int(out[7]),
+            "warm_cold_rows": int(out[8]),
+            "hot_cap_rows": int(out[9]),
+        }
+
 
 class EmbeddingTable:
     """One named table. ``backend`` is ``"auto"`` (native if buildable),
@@ -472,6 +507,48 @@ class EmbeddingTable:
         if self._shm is not None:
             self._shm = None
             self._store.shm_revoke()
+
+    # ------------------------------------------------------------ two-tier
+    def tier_enable(self, path: str, hot_budget_bytes: int,
+                    cold_capacity_bytes: int) -> bool:
+        """Split this table's storage into a byte-budgeted hot tier (the
+        stripe arenas) and an mmap'd cold file at ``path`` (native store
+        only — the numpy fallback stays single-tier and this is a no-op
+        returning False, the same honest gating as :meth:`shm_export`).
+        Must run BEFORE :meth:`shm_export` so the mirror is born with the
+        tiered flag (a miss then means "maybe cold", and the client
+        fetches it on the wire instead of lazy-initialising locally)."""
+        if self.backend != "native":
+            return False
+        if self._shm is not None:
+            raise RuntimeError("tier_enable must precede shm_export")
+        return self._store.tier_enable(path, hot_budget_bytes,
+                                       cold_capacity_bytes)
+
+    def tier_maintain(self, decay: float, promote_min_freq: float,
+                      swap_margin: float, hot_target_rows: int,
+                      max_moves: int = 0) -> Tuple[int, int]:
+        """Execute one promotion/demotion round (native + tiered only).
+        Returns ``(promoted, demoted)``. Tier moves copy row bytes without
+        changing them, so the push-version does NOT bump — cached rows stay
+        exactly as fresh as before the move."""
+        if self.backend != "native":
+            return (0, 0)
+        return self._store.tier_maintain(decay, promote_min_freq,
+                                         swap_margin, hot_target_rows,
+                                         max_moves)
+
+    def tier_stats(self, warm_min_freq: float = 1.0) -> dict:
+        """Tier occupancy/counter snapshot (``tiered`` False on the numpy
+        backend or before :meth:`tier_enable`)."""
+        if self.backend != "native":
+            return {"tiered": False, "hot_rows": self._store.size(),
+                    "cold_rows": 0, "promotions": 0, "demotions": 0,
+                    "cold_hits": 0,
+                    "hot_bytes": self._store.size() * self.spec.row_width * 4,
+                    "cold_bytes": 0, "warm_cold_rows": 0,
+                    "hot_cap_rows": 0}
+        return self._store.tier_stats(warm_min_freq)
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         """ids of any shape -> float32 values of shape ``ids.shape + (dim,)``."""

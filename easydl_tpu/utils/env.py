@@ -140,6 +140,17 @@ KNOB_DECLS = (
      "the mirror (clients fall back to the wire)."),
     ("EASYDL_PS_STORE_LOOP", "bool", False,
      "Force the python reference row-apply loop (bench comparisons)."),
+    ("EASYDL_PS_TIER_HOT_MB", "int", 0,
+     "Hot-tier byte budget per shard for the two-tier native store; 0 = "
+     "single-tier (no cold spill)."),
+    ("EASYDL_PS_TIER_COLD_MB", "int", 4096,
+     "Cold-tier mmap file capacity per table (under the shard workdir)."),
+    ("EASYDL_PS_TIER_PROMOTE_INTERVAL_S", "float", 2.0,
+     "Tier maintenance cadence: decay frequencies, demote cold hot rows, "
+     "promote warm cold rows."),
+    ("EASYDL_PS_TIER_DECAY", "float", 0.9,
+     "Per-tick multiplicative access-frequency decay (ages out "
+     "yesterday's hot set)."),
     # -- cross-cell failover (cell/) --------------------------------------
     ("EASYDL_CELL_STANDBY_WORKDIR", "str", "",
      "Standby cell workdir the WAL shipper replicates into; '' = no "
@@ -157,6 +168,9 @@ KNOB_DECLS = (
      "Minimum total rows before split decisions engage."),
     ("EASYDL_PS_SPLIT_MAX_SHARDS", "int", 64,
      "Upper bound on PS shard fan-out from auto-splits."),
+    ("EASYDL_PS_SPLIT_ACCESS_RATIO", "float", 2.0,
+     "Max/mean per-shard access ratio that counts as hot-working-set "
+     "skew (the two-tier split trigger)."),
     # -- serving ----------------------------------------------------------
     ("EASYDL_SERVE_TARGET_QPS", "float", 500.0,
      "Per-replica QPS target for the autoscale policy."),

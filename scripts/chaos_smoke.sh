@@ -22,6 +22,10 @@
 # The cell-failover verdict likewise: at least one shipped WAL segment
 # replayed on the standby, every fenced late push refused, and digest
 # parity against the acked ledger — else the cross-cell path never ran.
+# The beyond-RAM tier drill demands real spill evidence on top of the
+# zero-loss gates: thousands of cold (mmap-spilled) rows, at least one
+# demotion and one cold hit — else the table fit in its hot arena and
+# the "crash + reshard a spilled table" claim is vacuous.
 #
 # The detection loop (ISSUE 19) gates every drill the same way: a verdict
 # whose scenario declares an expected alert must carry a PASSING
@@ -52,6 +56,7 @@ env JAX_PLATFORMS=cpu python scripts/chaos_run.py \
     --scenario worker_kill --scenario master_crash \
     --scenario ps_shard_crash_zero_loss \
     --scenario ps_reshard_under_fire \
+    --scenario ps_tier_beyond_ram \
     --scenario serve_during_reshard \
     --scenario serve_replica_death_mid_flood \
     --scenario trainer_crash_mid_loop \
@@ -135,6 +140,36 @@ assert tail >= 1, (
     "tail-replay path was never exercised")
 print(f"reshard OK: {len(migrations)} migration(s), {rows} rows "
       f"migrated, {tail} tail pushes replayed")
+PY
+        ;;
+    *ps_tier_beyond_ram*)
+        python - "$verdict" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+counters = doc["zero_loss"]["counters"]
+cold = counters.get("tier_cold_rows", 0)
+demotions = counters.get("tier_demotions", 0)
+cold_hits = counters.get("tier_cold_hits", 0)
+assert cold >= 1000, (
+    f"{sys.argv[1]}: only {int(cold)} cold rows at the end of the drill "
+    "— the table fit in its hot arena, the kill and the split never "
+    "touched a spilled table, the beyond-RAM pass is vacuous")
+assert demotions >= 1 and cold_hits >= 1, (
+    f"{sys.argv[1]}: {int(demotions)} demotions / {int(cold_hits)} cold "
+    "hits — tier maintenance (or cold serving) never ran under fire")
+replayed = counters.get("wal_replayed_records", 0)
+assert replayed >= 1, (
+    f"{sys.argv[1]}: the rescued spilled shard replayed {int(replayed)} "
+    "WAL records — the crash never exercised the log")
+resh = doc["zero_loss"]["reshard"]
+migrations = resh.get("migrations", [])
+rows = sum(m.get("rows_migrated", 0) for m in migrations)
+assert migrations and rows >= 1, (
+    f"{sys.argv[1]}: the live split of the spilled table moved "
+    f"{rows} rows — no migration actually ran")
+print(f"tier OK: {int(cold)} cold rows ({int(demotions)} demotions, "
+      f"{int(cold_hits)} cold hits), {int(replayed)} WAL records "
+      f"replayed into the rescue, {rows} rows migrated across tiers")
 PY
         ;;
     *serve_during_reshard*)

@@ -341,6 +341,60 @@ class TestSplitDecision:
         assert ps_split_decision({}, 2) is None
         assert ps_split_decision({0: 1e6}, 0) is None
 
+    def test_access_skew_triggers_without_row_skew(self):
+        """The two-tier trigger: rows perfectly balanced, but one shard
+        concentrates the hot working set — split anyway."""
+        rows = {0: 5e5, 1: 5e5}
+        assert ps_split_decision(rows, 2) is None  # rows alone: no
+        # at the default 2.0x ratio, 2 shards trigger only on total
+        # concentration; exactly at the threshold counts as hot
+        assert ps_split_decision(rows, 2,
+                                 shard_access={0: 6e6, 1: 0.0}) == 4
+        assert ps_split_decision(rows, 2,
+                                 shard_access={0: 9e6, 1: 1e6}) is None
+        # a tuned ratio sees the 90/10 skew
+        assert ps_split_decision(rows, 2, access_ratio=1.5,
+                                 shard_access={0: 9e6, 1: 1e6}) == 4
+        # balanced traffic does not trip the access trigger
+        assert ps_split_decision(rows, 2,
+                                 shard_access={0: 5e6, 1: 5e6}) is None
+        # wider tiers make the default ratio reachable: 4 shards, one
+        # serving half the traffic (2x its fair quarter)
+        rows4 = {i: 2.5e5 for i in range(4)}
+        assert ps_split_decision(
+            rows4, 4,
+            shard_access={0: 5e6, 1: 2e6, 2: 2e6, 3: 1e6}) == 8
+
+    def test_access_skew_shares_floor_and_cap(self):
+        # a tiny table never splits, however skewed its traffic (the
+        # same access pattern splits once the table clears the floor)
+        skew = {0: 9e6, 1: 1e6}
+        assert ps_split_decision({0: 5e5, 1: 5e5}, 2, access_ratio=1.5,
+                                 shard_access=skew) == 4
+        assert ps_split_decision({0: 500, 1: 500}, 2, access_ratio=1.5,
+                                 shard_access=skew) is None
+        # max_shards caps the access trigger exactly like the row one
+        assert ps_split_decision({0: 5e5, 1: 5e5}, 2, max_shards=2,
+                                 access_ratio=1.5,
+                                 shard_access=skew) is None
+        # zero traffic is not skew
+        assert ps_split_decision({0: 5e5, 1: 5e5}, 2,
+                                 shard_access={0: 0.0, 1: 0.0}) is None
+
+    def test_access_and_row_triggers_are_an_or(self):
+        # row skew alone still decides, with access balanced
+        assert ps_split_decision({0: 4e5, 1: 1e5}, 2,
+                                 shard_access={0: 5e6, 1: 5e6}) == 4
+
+    def test_no_access_input_keeps_legacy_verdict(self):
+        """Callers that pass no access counts get the row-count verdict
+        bit for bit — the pre-tier policy surface is frozen."""
+        cases = [({0: 5e5, 1: 5e5}, None), ({0: 900, 1: 100}, None),
+                 ({0: 4e5, 1: 1e5}, 4)]
+        for rows, want in cases:
+            assert ps_split_decision(rows, 2) == \
+                ps_split_decision(rows, 2, shard_access=None) == want
+
 
 # ----------------------------------------------------------- coordinator
 class _Cluster:

@@ -1,0 +1,275 @@
+"""Two-tier (beyond-RAM) embedding store: spill correctness, the
+demote-without-revoke mirror contract, the client's cold-miss wire
+fallback, and the namespace-fair placement policy.
+
+The tier's contract (architecture.md §PS two-tier layout): splitting
+storage NEVER changes what a pull/push/export observes — only where the
+bytes live. The shm mirror publishes the HOT tier only; demotion
+tombstones rows out of the mirror without revoking the segment, and a
+reader's miss means "fetch on the wire", not "lazy-init locally". The
+placement policy is pure: per-namespace water-fill over byte demands,
+byte-replayable from its own decision log. Skipped wholesale when the
+native toolchain is unavailable (the numpy fallback is single-tier and
+says so)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from easydl_tpu.brain import tier_policy as tp
+from easydl_tpu.obs.registry import get_registry
+from easydl_tpu.ps import PsShard, ShardedPsClient, TableSpec
+from easydl_tpu.ps import build as ps_build
+from easydl_tpu.ps import shm as ps_shm
+from easydl_tpu.ps.table import EmbeddingTable
+
+pytestmark = pytest.mark.skipif(
+    ps_build.load_native() is None,
+    reason="native embedding store unavailable (no toolchain)")
+
+DIM = 8
+ROW_BYTES = 2 * DIM * 4  # adagrad: value half + accumulator half
+
+
+def spec(**kw):
+    base = dict(name="emb", dim=DIM, init_std=0.01, seed=7,
+                optimizer="adagrad", lr=0.05)
+    base.update(kw)
+    return TableSpec(**base)
+
+
+def tiered_table(tmp_path, hot_rows=32, cold_rows=4096, **kw):
+    t = EmbeddingTable(spec(**kw), backend="native")
+    assert t.tier_enable(str(tmp_path / "t.cold"), hot_rows * ROW_BYTES,
+                         cold_rows * ROW_BYTES)
+    return t
+
+
+def force_spill(t, n=512, seed=11, hot_target=32):
+    """Push n rows through a hot_target-row arena, then converge
+    maintenance so most of the table demotes."""
+    rng = np.random.default_rng(seed)
+    ids = np.arange(n, dtype=np.int64)
+    t.push(ids, rng.standard_normal((n, DIM)).astype(np.float32), 0.5)
+    for _ in range(4):
+        t.tier_maintain(decay=0.5, promote_min_freq=1.0, swap_margin=1.25,
+                        hot_target_rows=hot_target, max_moves=0)
+    return ids
+
+
+# ------------------------------------------------------------ table level
+def test_spill_is_invisible_to_pull_and_export(tmp_path):
+    """A tiered table and a single-tier table fed the same pushes are
+    bit-identical through pull AND export — placement never leaks into
+    values."""
+    rng = np.random.default_rng(2)
+    ids = np.arange(600, dtype=np.int64)
+    grads = rng.standard_normal((600, DIM)).astype(np.float32)
+
+    plain = EmbeddingTable(spec(), backend="native")
+    tiered = tiered_table(tmp_path, hot_rows=48)
+    for t in (plain, tiered):
+        t.push(ids, grads, 0.5)
+    tiered.tier_maintain(0.5, 1.0, 1.25, hot_target_rows=48, max_moves=0)
+    st = tiered.tier_stats()
+    assert st["tiered"] and st["cold_rows"] > 0  # really spilled
+
+    np.testing.assert_array_equal(tiered.pull(ids), plain.pull(ids))
+    tids, trows = tiered.export_rows()
+    pids, prows = plain.export_rows()
+    order_t, order_p = np.argsort(tids), np.argsort(pids)
+    np.testing.assert_array_equal(tids[order_t], pids[order_p])
+    np.testing.assert_array_equal(trows[order_t], prows[order_p])
+
+
+def test_export_import_roundtrip_across_tiers(tmp_path):
+    """export_rows covers BOTH tiers; importing it into a fresh tiered
+    table reproduces every row — the checkpoint/rescue path a spilled
+    shard rides."""
+    src = tiered_table(tmp_path, hot_rows=32)
+    ids = force_spill(src)
+    eids, erows = src.export_rows()
+    assert len(eids) == len(ids)
+
+    (tmp_path / "dst").mkdir()
+    dst = tiered_table(tmp_path / "dst", hot_rows=32)
+    dst.import_rows(eids, erows)
+    np.testing.assert_array_equal(dst.pull(ids), src.pull(ids))
+
+
+def test_cold_miss_overflows_hot_when_cold_full(tmp_path):
+    """Cold-capacity exhaustion overflows NEW rows into the hot tier
+    rather than failing the push — capacity pressure degrades placement,
+    never availability."""
+    t = tiered_table(tmp_path, hot_rows=8, cold_rows=8)
+    ids = np.arange(64, dtype=np.int64)
+    t.push(ids, np.ones((64, DIM), np.float32), 0.5)
+    st = t.tier_stats()
+    assert st["hot_rows"] + st["cold_rows"] == 64
+    assert st["cold_rows"] <= 8
+
+
+# ----------------------------------------------- mirror: demote ≠ revoke
+def test_demotion_tombstones_without_revoking(tmp_path):
+    """Demotion removes rows from the shm mirror as tombstones; the
+    segment stays live (no revocation), surviving rows stay bit-exact,
+    and demoted rows surface as misses — never stale values."""
+    # Enable with headroom so every row lands hot and is published, THEN
+    # shrink the target: the maintain pass must demote live mirrored rows.
+    t = tiered_table(tmp_path, hot_rows=512)
+    rng = np.random.default_rng(5)
+    ids = np.arange(256, dtype=np.int64)
+    t.push(ids, rng.standard_normal((256, DIM)).astype(np.float32), 0.5)
+    assert t.shm_export(8 << 20)
+    name, nonce = t.shm_info()
+    r = ps_shm.open_reader(name, nonce)
+    assert r is not None and r.tiered
+
+    rows0, _version, miss0 = r.pull_partial(ids)
+    if miss0 is None:  # all found: every row is hot and mirrored
+        miss0 = np.zeros(len(ids), bool)
+    served0 = int((~miss0).sum())
+    promoted, demoted = t.tier_maintain(0.5, 1.0, 1.25,
+                                        hot_target_rows=32, max_moves=0)
+    assert demoted > 0
+
+    # Reader still works — demotion never revoked the segment.
+    rows1, _version, miss1 = r.pull_partial(ids)
+    served1 = int((~miss1).sum())
+    assert served1 < served0          # tombstones took effect
+    assert served1 > 0                # the hot tier is still published
+    direct = t.pull(ids)
+    np.testing.assert_array_equal(rows1[~miss1], direct[~miss1])
+    # Missed rows hold trained state the mirror must NOT have invented.
+    assert np.any(miss1)
+    r.close()
+
+
+# ------------------------------------------- client: cold-miss fallback
+def test_client_cold_miss_falls_back_to_wire_and_is_counted(tmp_path,
+                                                            monkeypatch):
+    """End to end over gRPC + shm: once the shard's table spills, a
+    shm-negotiated client still returns bit-parity pulls — cold rows ride
+    the wire — and each partial fallback is counted under
+    easydl_ps_shm_client_fallbacks_total{reason="cold-miss"}."""
+    monkeypatch.setenv("EASYDL_PS_SHM", "1")
+    monkeypatch.setenv("EASYDL_PS_TIER_HOT_MB", "1")
+    monkeypatch.setenv("EASYDL_PS_TIER_COLD_MB", "64")
+    # Interval 0 would mean "every tick"; keep the loop out of the way and
+    # drive maintenance by hand for determinism.
+    monkeypatch.setenv("EASYDL_PS_TIER_PROMOTE_INTERVAL_S", "3600")
+    shard = PsShard(shard_index=0, num_shards=1, workdir=str(tmp_path))
+    server = shard.serve()
+    client = ShardedPsClient([server.address], pull_shm=True)
+    plain = ShardedPsClient([server.address], pull_shm=False)
+    try:
+        client.create_table(spec())
+        rng = np.random.default_rng(9)
+        # 1 MiB hot budget = 16384 adagrad rows of dim 8; overflow it so
+        # demotion has real work.
+        n = 40_000
+        ids = np.arange(n, dtype=np.int64)
+        client.push("emb", ids,
+                    rng.standard_normal((n, DIM)).astype(np.float32), 0.5)
+        shard.tier_maintain_once()
+        st = shard.table("emb").tier_stats()
+        assert st["cold_rows"] > 0
+
+        client.pull("emb", ids[:16])  # first pull negotiates the segment
+        assert client._shm_readers  # really negotiated shm
+        counter = get_registry().counter(
+            "easydl_ps_shm_client_fallbacks_total", "", ("reason",))
+        before = counter.value(reason="cold-miss")
+        got = client.pull("emb", ids)
+        np.testing.assert_array_equal(got, plain.pull("emb", ids))
+        assert counter.value(reason="cold-miss") > before
+    finally:
+        client.close()
+        plain.close()
+        server.stop()
+
+
+# ------------------------------------------------- policy: tenant fairness
+def _stats(name, ns, hot, warm, cold=0):
+    return tp.TableTierStats(name=name, namespace=ns, row_bytes=ROW_BYTES,
+                             hot_rows=hot, cold_rows=cold,
+                             warm_cold_rows=warm)
+
+
+def test_two_namespace_fairness_pin():
+    """The eviction-fairness invariant, pinned: tenant A's enormous warm
+    long tail inflates only A's own pressure. Tenant B, under its fair
+    share (budget/2), is granted its FULL demand — A cannot evict B."""
+    budget = 1000 * ROW_BYTES
+    a = _stats("jobA:emb", "jobA", hot=400, warm=100_000)
+    b = _stats("jobB:emb", "jobB", hot=300, warm=50)
+    plan = tp.tier_plan([a, b], tp.TierConfig(hot_budget_bytes=budget))
+
+    nsdoc = plan["namespaces"]
+    assert nsdoc["jobB"]["granted_bytes"] == b.demand_bytes()
+    assert plan["tables"]["jobB:emb"]["hot_target_rows"] == 350
+    # A gets everything B left on the table, and no more.
+    assert nsdoc["jobA"]["granted_bytes"] == budget - b.demand_bytes()
+    assert (plan["tables"]["jobA:emb"]["hot_target_rows"]
+            == (budget - b.demand_bytes()) // ROW_BYTES)
+
+
+def test_fair_share_floor_holds_under_mutual_pressure():
+    """Both tenants over-demand: each lands exactly on budget/2 — neither
+    can push the other below the fair-share floor."""
+    budget = 1000 * ROW_BYTES
+    a = _stats("jobA:emb", "jobA", hot=100, warm=90_000)
+    b = _stats("jobB:emb", "jobB", hot=100, warm=80_000)
+    plan = tp.tier_plan([a, b], tp.TierConfig(hot_budget_bytes=budget))
+    assert plan["namespaces"]["jobA"]["granted_bytes"] == budget // 2
+    assert plan["namespaces"]["jobB"]["granted_bytes"] == budget // 2
+
+
+def test_proportional_split_within_namespace_is_exact():
+    a1 = _stats("jobA:big", "jobA", hot=600, warm=0)
+    a2 = _stats("jobA:small", "jobA", hot=200, warm=0)
+    budget = 400 * ROW_BYTES  # half of the joint demand
+    plan = tp.tier_plan([a1, a2], tp.TierConfig(hot_budget_bytes=budget))
+    t = plan["tables"]
+    assert t["jobA:big"]["granted_bytes"] + \
+        t["jobA:small"]["granted_bytes"] == budget
+    assert t["jobA:big"]["granted_bytes"] == 3 * \
+        t["jobA:small"]["granted_bytes"]
+
+
+def test_decision_log_replays_byte_identically(tmp_path, monkeypatch):
+    """The shard's maintenance loop logs (inputs, verdict) records;
+    replay_decision_log re-derives each verdict through the pure policy
+    and byte-compares — the offline half of the beyond-RAM drill gate."""
+    monkeypatch.setenv("EASYDL_PS_TIER_HOT_MB", "1")
+    monkeypatch.setenv("EASYDL_PS_TIER_COLD_MB", "16")
+    monkeypatch.setenv("EASYDL_PS_TIER_PROMOTE_INTERVAL_S", "3600")
+    shard = PsShard(shard_index=0, num_shards=1, workdir=str(tmp_path))
+    try:
+        shard.create_table(spec())
+        ids = np.arange(30_000, dtype=np.int64)
+        shard.table("emb").push(
+            ids, np.ones((len(ids), DIM), np.float32), 0.5)
+        for _ in range(3):
+            shard.tier_maintain_once()
+        assert len(shard.tier_decision_log) == 3
+        report = tp.replay_decision_log(shard.tier_decision_log)
+        assert report["identical"], report["mismatches"]
+        # A tampered verdict is caught, not waved through.
+        import copy
+        bad = copy.deepcopy(list(shard.tier_decision_log))
+        next(iter(bad[0]["verdict"]["tables"].values()))[
+            "hot_target_rows"] += 1
+        assert not tp.replay_decision_log(bad)["identical"]
+    finally:
+        shard.stop()
+
+
+def test_policy_is_pure_and_deterministic():
+    tables = [_stats("jobA:emb", "jobA", hot=10, warm=5),
+              _stats("jobB:emb", "jobB", hot=7, warm=3)]
+    cfg = tp.TierConfig(hot_budget_bytes=12 * ROW_BYTES)
+    one = tp.decision_bytes(tp.tier_plan(tables, cfg))
+    two = tp.decision_bytes(tp.tier_plan(list(reversed(tables)), cfg))
+    assert one == two
